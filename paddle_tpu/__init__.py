@@ -1,0 +1,39 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: kuke/Paddle ~1.5).
+
+Program-description IR + layers DSL + IR-level autodiff, executed by lowering
+whole blocks to XLA via JAX; data/model parallelism via jax.sharding meshes
+(GSPMD collectives over ICI instead of NCCL). See SURVEY.md at the repo root
+for the capability map.
+"""
+
+from . import ops  # registers all op lowering rules
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        program_guard, default_main_program,
+                        default_startup_program, unique_name, name_scope,
+                        Executor, Scope, global_scope, scope_guard,
+                        append_backward, gradients, LayerHelper, ParamAttr)
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import layers
+from . import optimizer
+from . import initializer
+from . import regularizer
+from . import clip
+from . import io
+from .framework.core import Program as _P
+
+__version__ = "0.1.0"
+
+# fluid-style places: accepted and ignored (JAX manages devices)
+
+
+class CPUPlace:
+    pass
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+
+CUDAPlace = TPUPlace  # source compat for reference scripts

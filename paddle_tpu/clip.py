@@ -1,0 +1,94 @@
+"""Gradient clipping as IR rewrites (reference: python/paddle/fluid/clip.py).
+
+GradientClipByGlobalNorm builds the global-norm reduction in-graph; under
+data parallelism the norm is computed on the full (psum-ed) gradients because
+clipping runs after GSPMD's gradient reduction — same semantics as the
+reference's ClipByGlobalNorm over allreduced grads.
+"""
+
+from .framework.core import unique_name
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm"]
+
+
+class GradientClipByValue:
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            blk = g.block
+            c = blk.create_var(name=unique_name(g.name + "@CLIP"),
+                               shape=g.shape, dtype=g.dtype)
+            blk.append_op("clip", {"X": [g.name]}, {"Out": [c.name]},
+                          {"min": self.min, "max": self.max},
+                          infer_shape=False)
+            out.append((p, c))
+        return out
+
+
+class GradientClipByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            blk = g.block
+            c = blk.create_var(name=unique_name(g.name + "@CLIP"),
+                               shape=g.shape, dtype=g.dtype)
+            blk.append_op("clip_by_norm", {"X": [g.name]}, {"Out": [c.name]},
+                          {"max_norm": self.clip_norm}, infer_shape=False)
+            out.append((p, c))
+        return out
+
+
+class GradientClipByGlobalNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        if not params_grads:
+            return params_grads
+        blk = params_grads[0][1].block
+        sq_names = []
+        for _, g in params_grads:
+            sq = blk.create_var(name=unique_name(g.name + "@SQNORM"),
+                                shape=(1,), dtype="float32")
+            blk.append_op("squared_l2_norm", {"X": [g.name]},
+                          {"Out": [sq.name]}, infer_shape=False)
+            sq_names.append(sq.name)
+        total = blk.create_var(name=unique_name("global_sqnorm"), shape=(1,),
+                               dtype="float32")
+        blk.append_op("sum", {"X": sq_names}, {"Out": [total.name]},
+                      infer_shape=False)
+        gnorm = blk.create_var(name=unique_name("global_norm"), shape=(1,),
+                               dtype="float32")
+        blk.append_op("sqrt", {"X": [total.name]}, {"Out": [gnorm.name]},
+                      infer_shape=False)
+        # scale = clip_norm / max(gnorm, clip_norm)
+        maxed = blk.create_var(name=unique_name("global_norm_max"),
+                               shape=(1,), dtype="float32")
+        cn = blk.create_var(name=unique_name("clip_norm_const"), shape=(1,),
+                            dtype="float32")
+        blk.append_op("fill_constant", {}, {"Out": [cn.name]},
+                      {"shape": [1], "dtype": "float32",
+                       "value": self.clip_norm}, infer_shape=False)
+        blk.append_op("elementwise_max", {"X": [gnorm.name], "Y": [cn.name]},
+                      {"Out": [maxed.name]}, infer_shape=False)
+        scale = blk.create_var(name=unique_name("clip_scale"), shape=(1,),
+                               dtype="float32")
+        blk.append_op("elementwise_div", {"X": [cn.name], "Y": [maxed.name]},
+                      {"Out": [scale.name]}, infer_shape=False)
+        out = []
+        for p, g in params_grads:
+            c = blk.create_var(name=unique_name(g.name + "@CLIP"),
+                               shape=g.shape, dtype=g.dtype)
+            blk.append_op("elementwise_mul",
+                          {"X": [g.name], "Y": [scale.name]},
+                          {"Out": [c.name]}, {"axis": -1}, infer_shape=False)
+            out.append((p, c))
+        return out

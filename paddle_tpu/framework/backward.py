@@ -1,0 +1,260 @@
+"""IR-level reverse-mode autodiff: append_backward.
+
+Reference: python/paddle/fluid/backward.py:558 append_backward — walks the
+forward ops in reverse, appends one grad op per forward op, sums duplicated
+gradient contributions (:135 _addup_repetitive_outputs_), and prunes branches
+cut by stop_gradient (:211).
+
+The TPU twist: grad ops here are *descriptions only*. Their lowering is the
+generic jax.vjp path in registry.py (no hand-written grad kernels); ops with
+RNG or saved state register a custom grad_maker/grad_lower (e.g. dropout).
+
+Grad-op desc convention (mirrors the reference's GradOpDescMaker defaults,
+paddle/fluid/framework/grad_op_desc_maker.h):
+  inputs:  every forward input slot under its own name,
+           every forward output slot under "__out__"+slot,
+           output gradients under slot+"@GRAD" ("" where unavailable)
+  outputs: input gradients under slot+"@GRAD" ("" where not required)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (Block, Operator, Parameter, Program, Variable,
+                   grad_var_name, GRAD_SUFFIX)
+from .registry import get_op_def
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _find_loss_op_idx(block: Block, loss: Variable) -> int:
+    for i in reversed(range(len(block.ops))):
+        if loss.name in block.ops[i].output_names():
+            return i
+    raise ValueError(f"loss var {loss.name!r} is not produced by any op")
+
+
+def _collect_path_ops(block: Block, loss_idx: int) -> List[int]:
+    """Indices of ops that (transitively) produce the loss."""
+    needed: Set[str] = set(block.ops[loss_idx].output_names())
+    path = []
+    for i in reversed(range(loss_idx + 1)):
+        op = block.ops[i]
+        if set(op.output_names()) & needed:
+            path.append(i)
+            needed.update(op.input_names())
+    return list(reversed(path))
+
+
+def _var_wants_grad(block: Block, name: str, no_grad_set: Set[str]) -> bool:
+    if name in no_grad_set:
+        return False
+    try:
+        v = block.var(name)
+    except KeyError:
+        return False
+    return not v.stop_gradient
+
+
+class _GradAccum:
+    """Tracks per-var gradient contributions; duplicates become a sum op
+    (the reference's _addup_repetitive_outputs_)."""
+
+    def __init__(self, block: Block):
+        self.block = block
+        self.contribs: Dict[str, List[str]] = {}
+        self.pending_ops: List[Operator] = []
+
+    def new_contrib_name(self, var: str) -> str:
+        lst = self.contribs.setdefault(var, [])
+        name = grad_var_name(var) if not lst else \
+            f"{grad_var_name(var)}@RENAME@{len(lst)}"
+        lst.append(name)
+        return name
+
+    def finalize(self, var: str) -> str:
+        """Return the (merged) grad var name for `var`, or "" if none."""
+        lst = self.contribs.get(var, [])
+        if not lst:
+            return ""
+        if len(lst) == 1:
+            return lst[0]
+        out = grad_var_name(var)
+        op = Operator(self.block, "sum", {"X": list(lst)}, {"Out": [out]})
+        self.pending_ops.append(op)
+        self._declare_grad_var(out, var)
+        self.contribs[var] = [out]
+        return out
+
+    def _declare_grad_var(self, gname: str, src: str):
+        if gname and gname not in self.block.vars:
+            sv = self.block.var(src)
+            self.block.create_var(name=gname, shape=sv.shape, dtype=sv.dtype)
+
+
+def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
+                        no_grad_set: Set[str]) -> List[Operator]:
+    opdef = get_op_def(op.type)
+    if opdef.not_differentiable:
+        return []
+
+    if opdef.grad_maker is not None:
+        descs = opdef.grad_maker(op, block, no_grad_set)
+        ops = []
+        for d in descs:
+            # rewrite canonical out-grad input names to merged contributions
+            ins = {}
+            for slot, names in d["inputs"].items():
+                if slot.endswith(GRAD_SUFFIX):
+                    ins[slot] = [accum.finalize(n[: -len(GRAD_SUFFIX)])
+                                 if n.endswith(GRAD_SUFFIX) else n
+                                 for n in names]
+                else:
+                    ins[slot] = list(names)
+            outs = {}
+            for slot, names in d["outputs"].items():
+                fixed = []
+                for n in names:
+                    src = n[: -len(GRAD_SUFFIX)] if n.endswith(GRAD_SUFFIX) \
+                        else n
+                    if not _var_wants_grad(block, src, no_grad_set):
+                        fixed.append("")
+                        continue
+                    gname = accum.new_contrib_name(src)
+                    accum._declare_grad_var(gname, src)
+                    fixed.append(gname)
+                outs[slot] = fixed
+            ops.append(Operator(block, d["type"], ins, outs,
+                                d.get("attrs", {})))
+        return ops
+
+    # ---- generic maker ----
+    ins: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = list(names)
+    for slot, names in op.outputs.items():
+        ins["__out__" + slot] = list(names)
+        ins[slot + GRAD_SUFFIX] = [accum.finalize(n) for n in names]
+
+    outs: Dict[str, List[str]] = {}
+    any_grad = False
+    for slot, names in op.inputs.items():
+        if slot in opdef.no_grad_inputs:
+            continue
+        gnames = []
+        for n in names:
+            if _var_wants_grad(block, n, no_grad_set):
+                gname = accum.new_contrib_name(n)
+                accum._declare_grad_var(gname, n)
+                gnames.append(gname)
+                any_grad = True
+            else:
+                gnames.append("")
+        if any(gnames):
+            outs[slot + GRAD_SUFFIX] = gnames
+    if not any_grad:
+        return []
+    return [Operator(block, op.type + "_grad", ins, outs, dict(op.attrs))]
+
+
+def append_backward(loss: Variable,
+                    parameter_list: Optional[Sequence[str]] = None,
+                    no_grad_set: Optional[Set[str]] = None,
+                    callbacks=None) -> List[Tuple[Variable, Variable]]:
+    """Append grad ops computing d(loss)/d(param); returns [(param, grad)].
+
+    reference: python/paddle/fluid/backward.py:558.
+    """
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+
+    if loss.shape not in ((1,), ()):
+        raise ValueError(f"loss must be scalar, got shape {loss.shape}")
+
+    loss_idx = _find_loss_op_idx(block, loss)
+    path = _collect_path_ops(block, loss_idx)
+
+    accum = _GradAccum(block)
+
+    # seed: d(loss)/d(loss) = 1
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype)
+    block.append_op(
+        "fill_constant", {}, {"Out": [loss_grad]},
+        {"shape": list(loss.shape), "dtype": loss.dtype, "value": 1.0,
+         "force_cpu": False, "op_role": "backward"},
+        infer_shape=False)
+    accum.contribs[loss.name] = [loss_grad]
+
+    grad_ops: List[Operator] = []
+    for i in reversed(path):
+        op = block.ops[i]
+        accum.pending_ops.clear()
+        new_ops = _make_grad_op_descs(op, block, accum, no_grad)
+        # sum-merge ops created while finalizing out-grads must run first
+        grad_ops.extend(accum.pending_ops)
+        grad_ops.extend(new_ops)
+
+    # leaf merges (params used by multiple ops)
+    accum.pending_ops.clear()
+    params = [p for p in block.all_parameters() if p.trainable]
+    if parameter_list is not None:
+        params = [p for p in params if p.name in set(parameter_list)]
+    param_final: Dict[str, str] = {}
+    for p in params:
+        param_final[p.name] = accum.finalize(p.name)
+    grad_ops.extend(accum.pending_ops)
+
+    for gop in grad_ops:
+        gop.attrs.setdefault("op_role", "backward")
+        block.ops.append(gop)
+    program._bump_version()
+
+    params_grads: List[Tuple[Variable, Variable]] = []
+    for p in params:
+        gname = param_final.get(p.name, "")
+        if not gname:
+            continue
+        params_grads.append((p, block.var(gname)))
+    return params_grads
+
+
+def gradients(targets: Sequence[Variable], inputs: Sequence[Variable],
+              target_gradients=None,
+              no_grad_set: Optional[Set[str]] = None) -> List[Variable]:
+    """Compute grads of sum(targets) w.r.t. inputs (fluid.gradients analog)."""
+    if len(targets) != 1:
+        raise NotImplementedError("gradients() supports one target for now")
+    loss = targets[0]
+    block = loss.block
+    no_grad = set(no_grad_set or ())
+
+    loss_idx = _find_loss_op_idx(block, loss)
+    path = _collect_path_ops(block, loss_idx)
+    accum = _GradAccum(block)
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype)
+    block.append_op("fill_constant", {}, {"Out": [loss_grad]},
+                    {"shape": list(loss.shape or (1,)), "dtype": loss.dtype,
+                     "value": 1.0, "op_role": "backward"},
+                    infer_shape=False)
+    accum.contribs[loss.name] = [loss_grad]
+
+    grad_ops: List[Operator] = []
+    for i in reversed(path):
+        op = block.ops[i]
+        accum.pending_ops.clear()
+        new_ops = _make_grad_op_descs(op, block, accum, no_grad)
+        grad_ops.extend(accum.pending_ops)
+        grad_ops.extend(new_ops)
+
+    accum.pending_ops.clear()
+    finals = [accum.finalize(v.name) for v in inputs]
+    grad_ops.extend(accum.pending_ops)
+    for gop in grad_ops:
+        gop.attrs.setdefault("op_role", "backward")
+        block.ops.append(gop)
+    block.program._bump_version()
+    return [block.var(f) if f else None for f in finals]

@@ -1,0 +1,236 @@
+"""Executor: lowers a whole Program block to ONE jitted XLA computation.
+
+Replaces the reference's op-by-op C++ interpreter (paddle/fluid/framework/
+executor.cc:172 Executor::Run / :397 RunPreparedContext) with the TPU-idiomatic
+model: trace every op's JAX lowering rule into a single function
+
+    (mutable_scope, readonly_scope, feed, rng_key) -> (new_scope, fetches)
+
+jit it with XLA, donate the mutable scope buffers (param updates reuse HBM
+in-place — the analog of the reference's in-place optimizer ops + buffer-reuse
+passes, ir/memory_optimize_pass/), and cache the executable keyed on
+(program version, feed signature). The reference's GarbageCollector
+(executor.cc:411) is unnecessary: XLA liveness does it at compile time.
+
+Scope maps var name -> jax.Array and persists across runs
+(reference: framework/scope.h:46, python global_scope executor.py:38).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .core import Program, Variable, default_main_program
+from .registry import LowerContext, lower_op, get_op_def
+
+__all__ = ["Scope", "Executor", "global_scope", "scope_guard"]
+
+
+class Scope:
+    """name -> device array map; values persist across Executor.run calls."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def find_var(self, name: str):
+        return self._vars.get(name)
+
+    def set_var(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._vars
+
+    def get_numpy(self, name: str) -> np.ndarray:
+        v = self._vars[name]
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+_scope_stack = threading.local()
+
+
+def global_scope() -> Scope:
+    stack = getattr(_scope_stack, "stack", None)
+    if stack:
+        return stack[-1]
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self._scope = scope
+
+    def __enter__(self):
+        if not hasattr(_scope_stack, "stack"):
+            _scope_stack.stack = []
+        _scope_stack.stack.append(self._scope)
+        return self
+
+    def __exit__(self, *exc):
+        _scope_stack.stack.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+
+
+def _as_feed_array(value, var: Optional[Variable]):
+    import jax.numpy as jnp
+    arr = np.asarray(value)
+    if var is not None and var.dtype is not None:
+        arr = arr.astype(var.dtype, copy=False)
+    return jnp.asarray(arr)
+
+
+class Executor:
+    """fluid.Executor analog. `place` is accepted for API compatibility but
+    devices are managed by JAX; pass place=None for the default device."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True):
+        from ..compiler import CompiledProgram  # lazy import
+
+        if program is None:
+            program = default_main_program()
+
+        dist_plan = None
+        if isinstance(program, CompiledProgram):
+            dist_plan = program._plan()
+            program = program._program
+
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = [f.name if isinstance(f, Variable) else f
+                       for f in (fetch_list or [])]
+
+        blk = program.global_block
+
+        # Classify persistables: a var must come IN from the scope only if
+        # some op reads it before any op writes it; vars defined by earlier
+        # ops (e.g. params created by startup init ops) are internal.
+        written = set()
+        external_reads = set()
+        written_so_far = set(feed)
+        for op in blk.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            for n in op.input_names():
+                if n not in written_so_far:
+                    external_reads.add(n)
+            outs = op.output_names()
+            written.update(outs)
+            written_so_far.update(outs)
+        for n in fetch_names:
+            if n not in written_so_far:
+                external_reads.add(n)
+
+        persist = {v.name for v in blk.vars.values() if v.persistable}
+        # persistables updated in place: donated in, returned out
+        mutable = sorted((persist & written & external_reads) - set(feed))
+        # persistables created by this program (startup init): out only
+        created = sorted((persist & written) - set(mutable) - set(feed))
+        readonly = sorted((persist & external_reads)
+                          - set(mutable) - set(feed))
+
+        # ensure rng state
+        if "@RNG@" not in scope:
+            import jax
+            scope.set_var("@RNG@", jax.random.PRNGKey(program.random_seed))
+
+        feed_sig = tuple(sorted(
+            (k, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
+            for k, v in feed.items()))
+        cache_key = (id(program), program.version, feed_sig,
+                     tuple(fetch_names), tuple(mutable), tuple(readonly),
+                     id(dist_plan) if dist_plan else None)
+        compiled = self._cache.get(cache_key)
+        if compiled is None:
+            feed_shapes = {k: tuple(np.asarray(v).shape)
+                           for k, v in feed.items()}
+            compiled = self._compile(program, feed_shapes, fetch_names,
+                                     mutable, created, readonly, dist_plan)
+            self._cache[cache_key] = compiled
+
+        mut_in = {}
+        for n in mutable:
+            val = scope.find_var(n)
+            if val is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} not initialized in scope; "
+                    "run the startup program first")
+            mut_in[n] = val
+        ro_in = {n: scope.find_var(n) for n in readonly}
+        for n, v in ro_in.items():
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} not initialized in scope; "
+                    "run the startup program first")
+        feed_in = {k: _as_feed_array(v, blk.vars.get(k))
+                   for k, v in feed.items()}
+        if dist_plan is not None:
+            feed_in = dist_plan.shard_feed(feed_in)
+            mut_in = dist_plan.place_scope(mut_in)
+            ro_in = dist_plan.place_scope(ro_in)
+
+        key = scope.find_var("@RNG@")
+
+        new_mut, fetches, new_key = compiled(mut_in, ro_in, feed_in, key)
+
+        for n, v in new_mut.items():
+            scope.set_var(n, v)
+        scope.set_var("@RNG@", new_key)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- compilation ---------------------------------------------------------
+    def _compile(self, program: Program, feed_shapes, fetch_names,
+                 mutable, created, readonly, dist_plan):
+        import jax
+
+        blk = program.global_block
+        ops = [op for op in blk.ops if op.type not in ("feed", "fetch")]
+        out_names = list(mutable) + list(created)
+
+        def fn(mut_scope, ro_scope, feed_vals, rng_key):
+            env: Dict[str, Any] = {}
+            env.update(ro_scope)
+            env.update(mut_scope)
+            env.update(feed_vals)
+            ctx = LowerContext(rng_key=rng_key,
+                               mesh=dist_plan.mesh if dist_plan else None)
+            for op in ops:
+                lower_op(ctx, op, env)
+                if dist_plan is not None:
+                    dist_plan.constrain(op, env)
+            new_mut = {n: env[n] for n in out_names}
+            fetches = [env[n] for n in fetch_names]
+            new_key = jax.random.fold_in(rng_key, 0x5eed)
+            return new_mut, fetches, new_key
+
+        if dist_plan is not None:
+            return dist_plan.jit(fn, mutable, created, readonly, feed_shapes)
+        return jax.jit(fn, donate_argnums=(0,))
+
+    # -- utilities -----------------------------------------------------------
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,101 @@
+"""LayerHelper: shared machinery for layers DSL functions.
+
+Reference: python/paddle/fluid/layer_helper.py — creates parameters (with
+their init ops in the startup program), temp output vars, and applies
+activations/bias.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .core import (default_main_program, default_startup_program,
+                   unique_name, Variable)
+
+__all__ = ["LayerHelper", "ParamAttr"]
+
+
+class ParamAttr:
+    """reference: python/paddle/fluid/param_attr.py"""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"bad param_attr {attr!r}")
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        self.name = kwargs.get("name") or unique_name(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    @property
+    def block(self):
+        return self.main_program.current_block()
+
+    def create_parameter(self, attr, shape, dtype="float32",
+                         is_bias: bool = False, default_initializer=None):
+        from ..initializer import Constant, Xavier
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        name = attr.name or unique_name(f"{self.name}.w"
+                                        if not is_bias else f"{self.name}.b")
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else Xavier())
+        param = self.block.create_parameter(
+            name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
+            regularizer=attr.regularizer)
+        sb = self.startup_program.global_block
+        sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True,
+                      stop_gradient=True)
+        init(param, sb)
+        return param
+
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient=False) -> Variable:
+        return self.block.create_var(name=unique_name(self.name + ".tmp"),
+                                     dtype=dtype, stop_gradient=stop_gradient)
+
+    def append_op(self, *args, **kw):
+        return self.block.append_op(*args, **kw)
+
+    def append_activation(self, out: Variable, act: Optional[str]):
+        if act is None:
+            return out
+        v = self.create_variable_for_type_inference(out.dtype)
+        self.block.append_op(act, {"X": [out.name]}, {"Out": [v.name]})
+        return v
+
+    def append_bias_op(self, out: Variable, bias, dim_start=1):
+        if bias is None:
+            return out
+        v = self.create_variable_for_type_inference(out.dtype)
+        self.block.append_op("elementwise_add",
+                             {"X": [out.name], "Y": [bias.name]},
+                             {"Out": [v.name]}, {"axis": dim_start})
+        return v

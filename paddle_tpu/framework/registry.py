@@ -1,0 +1,312 @@
+"""Op registry: one JAX lowering rule per op type.
+
+Replaces the reference's OpRegistry / OpInfoMap / REGISTER_OPERATOR machinery
+(paddle/fluid/framework/op_registry.h:68,199; op_info.h). Key design change
+for TPU: an op is *defined by its JAX lowering rule*. That single rule gives
+
+  * build-time shape/dtype inference  — via jax.eval_shape (replaces the
+    reference's per-op InferShape, operator.h:430),
+  * runtime lowering                  — traced into the block-level jit
+    (replaces per-op CPU/CUDA kernels),
+  * gradients                         — via jax.vjp over the rule (replaces
+    the reference's hand-written grad kernels + GradOpDescMaker,
+    grad_op_desc_maker.h). XLA CSE dedupes the recomputed forward.
+
+Ops can still override the grad-desc maker or the grad lowering when the
+generic path is wrong (rng ops like dropout, ops with saved intermediates).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from .core import Block, Operator, GRAD_SUFFIX
+
+__all__ = ["OpDef", "register_op", "get_op_def", "has_op_def",
+           "infer_op_shapes", "LowerContext", "lower_op", "DUMMY_BATCH"]
+
+# Dummy concrete size substituted for -1 (batch) dims during eval_shape-based
+# inference; a large prime so a genuine layer dim colliding with it (and
+# being wrongly mapped back to -1) is vanishingly unlikely.
+DUMMY_BATCH = 8191
+
+
+@dataclass
+class OpDef:
+    type: str
+    # lower(ctx, ins, attrs) -> {out_slot: [jax arrays]}
+    lower: Callable[["LowerContext", Dict[str, List[Any]], Dict[str, Any]],
+                    Dict[str, List[Any]]]
+    # input slots that never receive gradients (indices, labels, ...)
+    no_grad_inputs: Set[str] = field(default_factory=set)
+    # output slots that are not differentiable / get zero cotangents
+    non_diff_outputs: Set[str] = field(default_factory=set)
+    # uses ctx.rng() — requires a custom grad path
+    stateful: bool = False
+    # in-place update op (optimizer ops): outputs alias inputs by name
+    is_optimizer_op: bool = False
+    # custom grad-op desc maker: (op, block, no_grad_set) -> list[dict] |None
+    grad_maker: Optional[Callable] = None
+    # custom grad lowering: (ctx, grad_op, env_getter, attrs) -> {slot: [..]}
+    grad_lower: Optional[Callable] = None
+    # if True, op has NO gradient (grads of its inputs are zeros / skipped)
+    not_differentiable: bool = False
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(op_type: str, **kw):
+    """Decorator: @register_op("relu") def _(ctx, ins, attrs): ..."""
+    def deco(fn):
+        _REGISTRY[op_type] = OpDef(type=op_type, lower=fn, **kw)
+        return fn
+    return deco
+
+
+def get_op_def(op_type: str) -> OpDef:
+    if op_type not in _REGISTRY:
+        raise NotImplementedError(f"no lowering registered for op {op_type!r}")
+    return _REGISTRY[op_type]
+
+
+def has_op_def(op_type: str) -> bool:
+    return op_type in _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Lowering context
+# ---------------------------------------------------------------------------
+
+class LowerContext:
+    """Per-trace state handed to lowering rules.
+
+    Functional RNG: rules call ctx.rng() for a fresh PRNG key; keys are
+    fold_in(base_key, counter) so the whole block stays a pure function of
+    (scope, feed, base_key).
+    """
+
+    def __init__(self, rng_key=None, is_test: bool = False,
+                 abstract: bool = False, mesh=None):
+        self._rng_key = rng_key
+        self._counter = 0
+        self.is_test = is_test
+        self.abstract = abstract  # True during eval_shape inference
+        self.mesh = mesh          # jax.sharding.Mesh when running sharded
+
+    def rng(self):
+        import jax
+        if self._rng_key is None:
+            # abstract inference path — any key works, shapes are identical
+            key = jax.random.PRNGKey(0)
+        else:
+            key = jax.random.fold_in(self._rng_key, self._counter)
+        self._counter += 1
+        return key
+
+
+# ---------------------------------------------------------------------------
+# Generic op lowering (forward + grad) given an environment
+# ---------------------------------------------------------------------------
+
+def lower_op(ctx: LowerContext, op: Operator, env: Dict[str, Any]) -> None:
+    """Lower one op: read inputs from env, write outputs into env."""
+    if op.type.endswith("_grad"):
+        _lower_grad_op(ctx, op, env)
+        return
+    opdef = get_op_def(op.type)
+    ins = {slot: [env[n] for n in names]
+           for slot, names in op.inputs.items() if names}
+    outs = opdef.lower(ctx, ins, op.attrs)
+    _bind_outputs(op, outs, env)
+
+
+def _bind_outputs(op: Operator, outs: Dict[str, List[Any]], env):
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if len(vals) != len(names):
+            raise RuntimeError(
+                f"op {op.type}: slot {slot} produced {len(vals)} values for "
+                f"{len(names)} output vars")
+        for n, v in zip(names, vals):
+            env[n] = v
+
+
+def _lower_grad_op(ctx: LowerContext, op: Operator, env: Dict[str, Any]):
+    import jax
+    import jax.numpy as jnp
+
+    fwd_type = op.type[: -len("_grad")]
+    opdef = get_op_def(fwd_type)
+
+    if opdef.grad_lower is not None:
+        ins = {slot: [env[n] for n in names]
+               for slot, names in op.inputs.items() if names}
+        outs = opdef.grad_lower(ctx, ins, op.attrs)
+        _bind_outputs(op, outs, env)
+        return
+
+    if opdef.stateful:
+        raise RuntimeError(
+            f"op {fwd_type} uses rng; it must define a custom grad_lower")
+
+    # Split grad-op inputs into forward inputs, forward outputs, out-grads.
+    fwd_in_slots: Dict[str, List[str]] = {}
+    out_grad_slots: Dict[str, List[str]] = {}
+    fwd_out_slots: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        if not names:
+            continue
+        if slot.endswith(GRAD_SUFFIX):
+            out_grad_slots[slot[: -len(GRAD_SUFFIX)]] = names
+        elif slot.startswith("__out__"):
+            fwd_out_slots[slot[len("__out__"):]] = names
+        else:
+            fwd_in_slots[slot] = names
+
+    # Which forward-input slots need grads (appear in grad-op outputs).
+    req_slots = [s[: -len(GRAD_SUFFIX)] for s in op.outputs
+                 if s.endswith(GRAD_SUFFIX) and op.outputs[s]]
+    diff_slots = [s for s in fwd_in_slots
+                  if s in req_slots and s not in opdef.no_grad_inputs]
+
+    flat_primals = [env[n] for s in diff_slots for n in fwd_in_slots[s]]
+    slot_lens = [len(fwd_in_slots[s]) for s in diff_slots]
+
+    out_index: List = []  # filled during first trace: (slot, idx) per output
+
+    def f(*flat):
+        ins: Dict[str, List[Any]] = {}
+        it = iter(flat)
+        for s, ln in zip(diff_slots, slot_lens):
+            ins[s] = [next(it) for _ in range(ln)]
+        for s, names in fwd_in_slots.items():
+            if s not in ins:
+                ins[s] = [env[n] for n in names]
+        sub_ctx = LowerContext(is_test=ctx.is_test, abstract=ctx.abstract,
+                               mesh=ctx.mesh)
+        outs = opdef.lower(sub_ctx, ins, op.attrs)
+        out_index.clear()
+        flat_outs = []
+        for slot in sorted(outs):
+            if slot in opdef.non_diff_outputs:
+                continue
+            for i, v in enumerate(outs[slot]):
+                if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact):
+                    out_index.append((slot, i))
+                    flat_outs.append(v)
+        return tuple(flat_outs)
+
+    primals_out, vjp_fn = jax.vjp(f, *flat_primals)
+
+    # Cotangents: out-grad from env when present, else zeros.
+    cots = []
+    for (slot, i), primal in zip(out_index, primals_out):
+        names = out_grad_slots.get(slot)
+        g = None
+        if names is not None and i < len(names) and names[i] in env:
+            g = env[names[i]]
+        cots.append(jnp.zeros_like(primal) if g is None
+                    else jnp.asarray(g, dtype=primal.dtype))
+
+    grads = vjp_fn(tuple(cots))
+
+    it = iter(grads)
+    grads_by_slot = {s: [next(it) for _ in range(ln)]
+                     for s, ln in zip(diff_slots, slot_lens)}
+    for slot, names in op.outputs.items():
+        if not slot.endswith(GRAD_SUFFIX):
+            continue
+        base = slot[: -len(GRAD_SUFFIX)]
+        vals = grads_by_slot.get(base)
+        if vals is None:
+            continue
+        for n, v in zip(names, vals):
+            if n:  # empty name == grad not needed for this var
+                env[n] = v
+
+
+# ---------------------------------------------------------------------------
+# Shape inference by abstract evaluation
+# ---------------------------------------------------------------------------
+
+def infer_op_shapes(op: Operator, block: Block) -> None:
+    """Set output var shapes/dtypes by abstract-evaluating the lowering rule.
+
+    -1 (batch) dims are substituted with DUMMY_BATCH for tracing and mapped
+    back to -1 in the outputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if op.type in ("feed", "fetch"):
+        return
+    if op.type.endswith("_grad"):
+        _infer_grad_shapes(op, block)
+        return
+    opdef = get_op_def(op.type)
+
+    specs: Dict[str, List[Any]] = {}
+    saw_dummy = False
+    for slot, names in op.inputs.items():
+        if not names:
+            continue
+        lst = []
+        for n in names:
+            v = block.var(n)
+            if v.shape is None:
+                raise RuntimeError(f"input var {n!r} of op {op.type} has no "
+                                   "shape; declare it first")
+            shape = tuple(DUMMY_BATCH if d == -1 else d for d in v.shape)
+            saw_dummy = saw_dummy or (-1 in v.shape)
+            lst.append(jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype)))
+        specs[slot] = lst
+
+    ctx = LowerContext(abstract=True)
+
+    def f(ins):
+        return opdef.lower(ctx, ins, op.attrs)
+
+    try:
+        outs = jax.eval_shape(f, specs)
+    except Exception as e:
+        raise RuntimeError(
+            f"shape inference failed for op {op.type} "
+            f"(inputs={{{', '.join(f'{s}:{[block.var(n).shape for n in ns]}' for s, ns in op.inputs.items() if ns)}}}, "
+            f"attrs={op.attrs}): {e}") from e
+
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for n, sds in zip(names, vals):
+            v = (block.vars.get(n) or
+                 block.create_var(name=n))
+            shape = tuple(sds.shape)
+            if saw_dummy:
+                shape = tuple(-1 if d == DUMMY_BATCH else d for d in shape)
+            v.shape = shape
+            v.dtype = str(np.dtype(sds.dtype))
+
+
+def _infer_grad_shapes(op: Operator, block: Block) -> None:
+    """Grad var shape == forward var shape; no tracing needed."""
+    for slot, names in op.outputs.items():
+        if not slot.endswith(GRAD_SUFFIX):
+            continue
+        fwd_names = op.inputs.get(slot[: -len(GRAD_SUFFIX)], [])
+        for i, n in enumerate(names):
+            if not n:
+                continue
+            v = block.vars.get(n)
+            if v is None:
+                v = block.create_var(name=n)
+            if i < len(fwd_names) and block.has_var(fwd_names[i]):
+                fv = block.var(fwd_names[i])
+                v.shape = fv.shape
+                v.dtype = fv.dtype

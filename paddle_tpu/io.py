@@ -1,0 +1,131 @@
+"""Checkpoint / model export (reference: python/paddle/fluid/io.py).
+
+save_persistables:487 / load_persistables:726 / save_inference_model:933 /
+load_inference_model:1113 analogs. The reference implements save/load as
+ops inside a program (save_op.cc/load_op.cc); here persistables live in the
+Scope as device arrays and are staged through numpy .npz archives — the
+device->host copy is one fetch, not per-op. Program serialization uses the
+JSON IR format (framework/core.py Program.serialize_to_string).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .framework.core import Program, Variable, default_main_program
+from .framework.executor import Executor, Scope, global_scope
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model"]
+
+_PARAMS_FILE = "params.npz"
+_PROGRAM_FILE = "__model__"
+
+
+def _mangle(name: str) -> str:
+    return name.replace("/", "%2F")
+
+
+def _unmangle(name: str) -> str:
+    return name.replace("%2F", "/")
+
+
+def save_vars(executor: Optional[Executor], dirname: str,
+              main_program: Optional[Program] = None,
+              vars: Optional[Sequence[Variable]] = None,
+              predicate=None, filename: Optional[str] = None,
+              scope: Optional[Scope] = None) -> None:
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate(v) if predicate else True)]
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(f"var {v.name!r} not found in scope")
+        arrays[_mangle(v.name)] = np.asarray(val)
+    np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    from .framework.core import Parameter
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename, scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename,
+                     scope=scope)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars()
+                if (predicate(v) if predicate else True)]
+    import jax.numpy as jnp
+    path = os.path.join(dirname, filename or _PARAMS_FILE)
+    with np.load(path) as data:
+        names = {_unmangle(k): k for k in data.files}
+        for v in vars:
+            if v.name in names:
+                scope.set_var(v.name, jnp.asarray(data[names[v.name]]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    from .framework.core import Parameter
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename,
+                     scope=scope)
+
+
+def save_inference_model(dirname: str, feeded_var_names: List[str],
+                         target_vars: List[Variable], executor=None,
+                         main_program: Optional[Program] = None,
+                         scope=None) -> None:
+    """Prune to the inference subgraph + save program & params
+    (reference: io.py:933)."""
+    program = main_program or default_main_program()
+    inference_program = program.clone(for_test=True)
+    targets = [v.name for v in target_vars]
+    inference_program = inference_program._prune(targets)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"feed": list(feeded_var_names), "fetch": targets}
+    with open(os.path.join(dirname, _PROGRAM_FILE), "wb") as f:
+        f.write(inference_program.serialize_to_string())
+    with open(os.path.join(dirname, "__meta__"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, inference_program, scope=scope)
+
+
+def load_inference_model(dirname: str, executor=None, scope=None):
+    with open(os.path.join(dirname, _PROGRAM_FILE), "rb") as f:
+        program = Program.parse_from_string(f.read())
+    with open(os.path.join(dirname, "__meta__")) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, program, scope=scope)
+    blk = program.global_block
+    fetch_vars = [blk.var(n) for n in meta["fetch"]]
+    return program, meta["feed"], fetch_vars

@@ -1,0 +1,11 @@
+"""Layers DSL (reference: python/paddle/fluid/layers/ — ~300 functions)."""
+
+from .math import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+
+from . import math  # noqa: F401
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401

@@ -1,0 +1,390 @@
+"""Tensor layers: data declaration, fill/cast/shape manipulation wrappers.
+
+Reference: python/paddle/fluid/layers/tensor.py and layers/io.py (data:…).
+"""
+
+from ..framework.core import Variable, unique_name, convert_np_dtype
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["data", "fill_constant", "fill_constant_batch_size_like",
+           "zeros", "ones", "zeros_like", "ones_like", "cast", "concat",
+           "split", "stack", "unstack", "reshape", "squeeze", "unsqueeze",
+           "flatten", "transpose", "slice", "expand", "gather", "gather_nd",
+           "scatter", "assign", "shape", "arange", "argmax", "argmin",
+           "argsort", "where", "pad", "pad2d", "uniform_random",
+           "gaussian_random", "increment", "create_global_var",
+           "create_tensor", "flip", "roll", "tile"]
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         stop_gradient=True):
+    """Declare a feed variable (reference: layers/io.py data)."""
+    from ..framework.core import default_main_program
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    blk = default_main_program().global_block
+    return blk.create_var(name=name, shape=shape,
+                          dtype=convert_np_dtype(dtype),
+                          stop_gradient=stop_gradient, is_data=True)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op("fill_constant", {}, {"Out": [out.name]},
+                     {"shape": list(shape), "dtype": convert_np_dtype(dtype),
+                      "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    helper = LayerHelper("fill_constant_batch_size_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op("fill_constant_batch_size_like",
+                     {"Input": [input.name]}, {"Out": [out.name]},
+                     {"shape": list(shape), "dtype": convert_np_dtype(dtype),
+                      "value": float(value), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def zeros(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 0.0, name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 1.0, name)
+
+
+def zeros_like(x, name=None):
+    helper = LayerHelper("fill_zeros_like", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("fill_zeros_like", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def ones_like(x, name=None):
+    helper = LayerHelper("fill_any_like", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("fill_any_like", {"X": [x.name]}, {"Out": [out.name]},
+                     {"value": 1.0})
+    return out
+
+
+def cast(x, dtype, name=None):
+    helper = LayerHelper("cast", name=name)
+    dtype = convert_np_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", {"X": [x.name]}, {"Out": [out.name]},
+                     {"out_dtype": dtype})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", {"X": [v.name for v in input]},
+                     {"Out": [out.name]}, {"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim % len(input.shape)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", {"X": [input.name]},
+                     {"Out": [o.name for o in outs]}, attrs)
+    return outs
+
+
+def stack(x, axis=0, name=None):
+    helper = LayerHelper("stack", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("stack", {"X": [v.name for v in xs]},
+                     {"Y": [out.name]}, {"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None, name=None):
+    helper = LayerHelper("unstack", name=name)
+    n = num if num is not None else int(x.shape[axis])
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(n)]
+    helper.append_op("unstack", {"X": [x.name]},
+                     {"Y": [o.name for o in outs]}, {"axis": axis})
+    return outs
+
+
+def reshape(x, shape, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("reshape2", {"X": [x.name]},
+                     {"Out": [out.name], "XShape": [xshape.name]},
+                     {"shape": list(shape)})
+    return out
+
+
+def squeeze(x, axes=None, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("squeeze2", {"X": [x.name]},
+                     {"Out": [out.name], "XShape": [xshape.name]},
+                     {"axes": axes or []})
+    return out
+
+
+def unsqueeze(x, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    axes = axes if isinstance(axes, (list, tuple)) else [axes]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("unsqueeze2", {"X": [x.name]},
+                     {"Out": [out.name], "XShape": [xshape.name]},
+                     {"axes": list(axes)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("flatten2", {"X": [x.name]},
+                     {"Out": [out.name], "XShape": [xshape.name]},
+                     {"axis": axis})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("transpose2", {"X": [x.name]},
+                     {"Out": [out.name], "XShape": [xshape.name]},
+                     {"axis": list(perm)})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", {"Input": [input.name]}, {"Out": [out.name]},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends)})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand", {"X": [x.name]}, {"Out": [out.name]},
+                     {"expand_times": list(expand_times)})
+    return out
+
+
+def tile(x, repeat_times, name=None):
+    helper = LayerHelper("tile", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tile", {"X": [x.name]}, {"Out": [out.name]},
+                     {"repeat_times": list(repeat_times)})
+    return out
+
+
+def flip(x, axis, name=None):
+    helper = LayerHelper("flip", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flip", {"X": [x.name]}, {"Out": [out.name]},
+                     {"axis": axis if isinstance(axis, list) else [axis]})
+    return out
+
+
+def roll(x, shifts, axis, name=None):
+    helper = LayerHelper("roll", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("roll", {"X": [x.name]}, {"Out": [out.name]},
+                     {"shifts": shifts,
+                      "axis": axis if isinstance(axis, list) else [axis]})
+    return out
+
+
+def gather(input, index, name=None):
+    helper = LayerHelper("gather", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", {"X": [input.name], "Index": [index.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", {"X": [input.name], "Index": [index.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     {"X": [input.name], "Ids": [index.name],
+                      "Updates": [updates.name]},
+                     {"Out": [out.name]}, {"overwrite": overwrite})
+    return out
+
+
+def assign(input, output=None, name=None):
+    helper = LayerHelper("assign", name=name)
+    if output is None:
+        output = helper.create_variable_for_type_inference(
+            input.dtype if isinstance(input, Variable) else "float32")
+    if isinstance(input, Variable):
+        helper.append_op("assign", {"X": [input.name]},
+                         {"Out": [output.name]})
+    else:
+        import numpy as np
+        arr = np.asarray(input)
+        helper.append_op("assign_value", {}, {"Out": [output.name]},
+                         {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                          "values": arr.reshape(-1).tolist()})
+    return output
+
+
+def shape(input, name=None):
+    helper = LayerHelper("shape", name=name)
+    out = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("shape", {"Input": [input.name]}, {"Out": [out.name]})
+    return out
+
+
+def arange(start, end, step=1, dtype="float32", name=None):
+    import numpy as np
+    vals = np.arange(start, end, step).astype(dtype)
+    helper = LayerHelper("arange", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("assign_value", {}, {"Out": [out.name]},
+                     {"shape": list(vals.shape), "dtype": dtype,
+                      "values": vals.reshape(-1).tolist()})
+    return out
+
+
+def argmax(x, axis=-1, dtype="int64", keepdims=False, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("arg_max", {"X": [x.name]}, {"Out": [out.name]},
+                     {"axis": axis, "dtype": dtype, "keepdims": keepdims})
+    return out
+
+
+def argmin(x, axis=-1, dtype="int64", name=None):
+    helper = LayerHelper("arg_min", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("arg_min", {"X": [x.name]}, {"Out": [out.name]},
+                     {"axis": axis, "dtype": dtype})
+    return out
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("argsort", {"X": [x.name]},
+                     {"Out": [out.name], "Indices": [idx.name]},
+                     {"axis": axis, "descending": descending})
+    return out, idx
+
+
+def where(condition, x, y, name=None):
+    helper = LayerHelper("where", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where",
+                     {"Condition": [condition.name], "X": [x.name],
+                      "Y": [y.name]}, {"Out": [out.name]})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", {"X": [x.name]}, {"Out": [out.name]},
+                     {"paddings": list(paddings), "pad_value": pad_value})
+    return out
+
+
+def pad2d(x, paddings, mode="constant", pad_value=0.0, name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad2d", {"X": [x.name]}, {"Out": [out.name]},
+                     {"paddings": list(paddings), "mode": mode,
+                      "pad_value": pad_value})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    helper = LayerHelper("uniform_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("uniform_random", {}, {"Out": [out.name]},
+                     {"shape": list(shape), "dtype": dtype, "min": min,
+                      "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0,
+                    name=None):
+    helper = LayerHelper("gaussian_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("gaussian_random", {}, {"Out": [out.name]},
+                     {"shape": list(shape), "dtype": dtype, "mean": mean,
+                      "std": std, "seed": seed})
+    return out
+
+
+def increment(x, value=1.0, in_place=True, name=None):
+    helper = LayerHelper("increment", name=name)
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", {"X": [x.name]}, {"Out": [out.name]},
+                     {"step": float(value)}, infer_shape=False)
+    return out
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    from ..framework.core import default_main_program
+    blk = default_main_program().global_block
+    return blk.create_var(name=name or unique_name("tensor"), dtype=dtype,
+                          persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Creates a persistable var initialized in the startup program."""
+    from ..framework.core import (default_main_program,
+                                  default_startup_program)
+    name = name or unique_name("global_var")
+    blk = default_main_program().global_block
+    var = blk.create_var(name=name, shape=shape, dtype=dtype,
+                         persistable=persistable, stop_gradient=True)
+    sb = default_startup_program().global_block
+    sb.create_var(name=name, shape=shape, dtype=dtype,
+                  persistable=persistable, stop_gradient=True)
+    sb.append_op("fill_constant", {}, {"Out": [name]},
+                 {"shape": list(shape), "dtype": dtype,
+                  "value": float(value)}, infer_shape=False)
+    return var

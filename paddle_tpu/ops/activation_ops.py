@@ -1,0 +1,109 @@
+"""Activation ops (reference: operators/activation_op.cc, ~30 functors).
+
+Single-input elementwise maps; XLA fuses these into neighboring matmuls so
+there is no need for the reference's fused activation kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _register_act(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], attrs)]}
+
+
+_ACTS = {
+    "relu": lambda x, a: jax.nn.relu(x),
+    "relu6": lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "gelu": lambda x, a: jax.nn.gelu(
+        x, approximate=a.get("approximate", False)),
+    "leaky_relu": lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)),
+    "elu": lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)),
+    "selu": lambda x, a: jax.nn.selu(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: jax.nn.soft_sign(x),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "silu": lambda x, a: jax.nn.silu(x),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    "hard_swish": lambda x, a: x * jnp.clip(
+        x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0))
+        / a.get("scale", 6.0),
+    "mish": lambda x, a: x * jnp.tanh(jax.nn.softplus(x)),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "log2": lambda x, a: jnp.log2(x),
+    "log10": lambda x, a: jnp.log10(x),
+    "log1p": lambda x, a: jnp.log1p(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: jax.lax.rsqrt(x),
+    "square": lambda x, a: jnp.square(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "sin": lambda x, a: jnp.sin(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "tan": lambda x, a: jnp.tan(x),
+    "asin": lambda x, a: jnp.arcsin(x),
+    "acos": lambda x, a: jnp.arccos(x),
+    "atan": lambda x, a: jnp.arctan(x),
+    "sinh": lambda x, a: jnp.sinh(x),
+    "cosh": lambda x, a: jnp.cosh(x),
+    "erf": lambda x, a: jax.scipy.special.erf(x),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+        a.get("scale_a", 0.67) * x),
+    "softshrink": lambda x, a: jnp.where(
+        x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+        jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "thresholded_relu": lambda x, a: jnp.where(
+        x > a.get("threshold", 1.0), x, 0.0),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0),
+                                   a.get("t_max", 24.0)),
+}
+
+for _name, _fn in _ACTS.items():
+    _register_act(_name, _fn)
+
+
+# non-differentiable rounding ops
+def _register_round(name, fn):
+    @register_op(name, not_differentiable=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0])]}
+
+
+_register_round("floor", jnp.floor)
+_register_round("ceil", jnp.ceil)
+_register_round("round", jnp.round)
+_register_round("sign", jnp.sign)
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    """reference: operators/softmax_op.cc (+cudnn). XLA fuses the
+    max/sub/exp/sum/div chain; a Pallas kernel is unnecessary at these sizes."""
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0],
+                                       axis=attrs.get("axis", -1))]}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    x = ins["X"][0]
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)]}

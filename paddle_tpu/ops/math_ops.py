@@ -1,0 +1,240 @@
+"""Math ops: elementwise (broadcasting), matmul family, reductions, compares.
+
+Reference inventory: paddle/fluid/operators/elementwise/ (4.6k LoC),
+reduce_ops/ (1.7k LoC), matmul_op.cc, mul_op.cc. Here each op is a few lines
+of jax.numpy — gradients come from the registry's generic jax.vjp path, and
+XLA fuses elementwise chains into matmul epilogues (the job of the
+reference's fused ops / fuse_elewise_add_act_pass, ir/).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops with fluid's axis-broadcast semantics
+# (reference: operators/elementwise/elementwise_op_function.h)
+# ---------------------------------------------------------------------------
+
+def _broadcast_y(x, y, axis):
+    if x.ndim == y.ndim:
+        return y
+    if y.ndim > x.ndim:
+        return y  # numpy broadcasting handles leading-dim expansion of x
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return jnp.reshape(y, new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+
+
+_register_elementwise("elementwise_add", jnp.add)
+_register_elementwise("elementwise_sub", jnp.subtract)
+_register_elementwise("elementwise_mul", jnp.multiply)
+_register_elementwise("elementwise_div", jnp.divide)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_pow", jnp.power)
+_register_elementwise("elementwise_mod", jnp.mod)
+_register_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+# ---------------------------------------------------------------------------
+# matmul / mul (fc matmul with flattening)
+# ---------------------------------------------------------------------------
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    """reference: operators/matmul_op.cc — batched matmul w/ transpose flags."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """reference: operators/mul_op.cc — flatten-to-2D matmul used by fc."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    x2 = x.reshape((np_prod(x.shape[:xn]), np_prod(x.shape[xn:])))
+    y2 = y.reshape((np_prod(y.shape[:yn]), np_prod(y.shape[yn:])))
+    out = x2 @ y2
+    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    return {"Out": [out.reshape(out_shape)]}
+
+
+def np_prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
+@register_op("bmm")
+def _bmm(ctx, ins, attrs):
+    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: operators/reduce_ops/)
+# ---------------------------------------------------------------------------
+
+def _register_reduce(name, fn, not_diff=False):
+    @register_op(name, not_differentiable=not_diff)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            dim = None
+        else:
+            dim = attrs.get("dim", [0])
+            dim = tuple(d % max(x.ndim, 1) for d in
+                        (dim if isinstance(dim, (list, tuple)) else [dim]))
+        keep = attrs.get("keep_dim", False)
+        out = _fn(x, axis=dim, keepdims=keep)
+        if out.ndim == 0:
+            out = out.reshape((1,))
+        return {"Out": [out]}
+
+
+_register_reduce("reduce_sum", jnp.sum)
+_register_reduce("reduce_mean", jnp.mean)
+_register_reduce("reduce_max", jnp.max)
+_register_reduce("reduce_min", jnp.min)
+_register_reduce("reduce_prod", jnp.prod)
+_register_reduce("reduce_all", jnp.all, not_diff=True)
+_register_reduce("reduce_any", jnp.any, not_diff=True)
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0]).reshape((1,))]}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    """add_n: sum a list of tensors (grad-accumulation workhorse,
+    reference: operators/sum_op.cc)."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# scalar-ish math
+# ---------------------------------------------------------------------------
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return {"Out": [x * scale.astype(x.dtype)]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x.astype(jnp.float32) ** 2).reshape((1,))]}
+
+
+@register_op("p_norm")
+def _p_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keep = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    y = x / jnp.maximum(norm, eps)
+    return {"Out": [y], "Norm": [norm]}
+
+
+@register_op("log_sum_exp")
+def _logsumexp(ctx, ins, attrs):
+    x = ins["X"][0]
+    dim = tuple(attrs.get("dim", [-1]))
+    return {"Out": [jax.scipy.special.logsumexp(
+        x, axis=dim, keepdims=attrs.get("keep_dim", False))]}
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (bool outputs, non-differentiable)
+# ---------------------------------------------------------------------------
+
+def _register_cmp(name, fn):
+    @register_op(name, not_differentiable=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], ins["Y"][0])]}
+
+
+_register_cmp("equal", jnp.equal)
+_register_cmp("not_equal", jnp.not_equal)
+_register_cmp("less_than", jnp.less)
+_register_cmp("less_equal", jnp.less_equal)
+_register_cmp("greater_than", jnp.greater)
+_register_cmp("greater_equal", jnp.greater_equal)
+_register_cmp("logical_and", jnp.logical_and)
+_register_cmp("logical_or", jnp.logical_or)
+_register_cmp("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", not_differentiable=True)
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register_op("isfinite", not_differentiable=True)
+def _isfinite(ctx, ins, attrs):
+    """reference: operators/isfinite_op.cc — nan/inf sanitizer primitive."""
+    x = ins["X"][0]
+    return {"Out": [jnp.all(jnp.isfinite(x)).reshape((1,))]}

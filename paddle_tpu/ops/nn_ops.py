@@ -1,0 +1,472 @@
+"""NN ops: conv, pool, normalization, dropout, losses, metrics.
+
+Reference: paddle/fluid/operators/ conv_op.cc + conv_cudnn_op.cu.cc,
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc,
+softmax_with_cross_entropy_op.cc, cross_entropy_op.cc. Convs map onto
+lax.conv_general_dilated (MXU); normalizations are jnp reductions that XLA
+fuses; dropout carries an explicit Mask output so its gradient is exact
+(custom grad rule — the one place the generic vjp path can't be used because
+of RNG).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op, get_op_def
+
+
+# ---------------------------------------------------------------------------
+# convolution (reference: conv_op.cc; cudnn variant conv_cudnn_op.cu.cc)
+# ---------------------------------------------------------------------------
+
+def _conv_padding(paddings, algo, ksize, dilations):
+    if algo == "SAME":
+        return "SAME"
+    if algo == "VALID":
+        return "VALID"
+    if len(paddings) == 2:
+        return [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    return [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pad = _conv_padding(attrs.get("paddings", [0, 0]),
+                        attrs.get("padding_algorithm", "EXPLICIT"),
+                        w.shape[2:], dil)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return _conv2d(ctx, {"Input": [x], "Filter": [w]}, attrs)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    """reference: conv_transpose_op.cc. Filter layout [C_in, C_out/g, kh, kw];
+    implemented as the gradient-of-conv: input-dilated conv with a flipped,
+    IO-swapped kernel."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = tuple(attrs.get("strides", [1, 1]))
+    p = attrs.get("paddings", [0, 0])
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose TBD")
+    kh, kw = w.shape[2], w.shape[3]
+    wf = jnp.flip(w, axis=(2, 3)).transpose(1, 0, 2, 3)  # -> OIHW
+    eh = dil[0] * (kh - 1)
+    ew = dil[1] * (kw - 1)
+    pad = [(eh - p[0], eh - p[0]), (ew - p[1], ew - p[1])]
+    out = jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1, 1), padding=pad, lhs_dilation=s,
+        rhs_dilation=dil, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": [out]}
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    p = attrs.get("paddings", [0, 0, 0])
+    pad = [(pi, pi) for pi in p] if len(p) == 3 else \
+        [(p[0], p[1]), (p[2], p[3]), (p[4], p[5])]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+        feature_group_count=attrs.get("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference: pool_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) \
+            and tuple(attrs.get("ksize")) == (1, 1):
+        if ptype == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
+        else:
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        return {"Out": [out]}
+    ksize = tuple(attrs["ksize"])
+    strides = tuple(attrs.get("strides", ksize))
+    p = attrs.get("paddings", [0, 0])
+    pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    if attrs.get("ceil_mode", False):
+        extra = []
+        for i, (dim, k, s, pp) in enumerate(
+                zip(x.shape[2:], ksize, strides, p)):
+            rem = (dim + 2 * pp - k) % s
+            extra.append((s - rem) % s if rem else 0)
+        pads = [(0, 0), (0, 0), (p[0], p[0] + extra[0]),
+                (p[1], p[1] + extra[1])]
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
+                                    pads)
+    else:
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4,
+                                     pads)
+        if attrs.get("exclusive", True):
+            ones = jnp.ones(x.shape, x.dtype)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides4, pads)
+            out = ssum / cnt
+        else:
+            out = ssum / float(np.prod(ksize))
+    return {"Out": [out]}
+
+
+@register_op("adaptive_pool2d")
+def _adaptive_pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs["pooling_size"]
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        if attrs.get("pooling_type", "avg") == "max":
+            out = jnp.max(xr, axis=(3, 5))
+        else:
+            out = jnp.mean(xr, axis=(3, 5))
+        return {"Out": [out]}
+    raise NotImplementedError("adaptive pool with non-divisible sizes")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm",
+             non_diff_outputs={"MeanOut", "VarianceOut", "SavedMean",
+                               "SavedVariance"},
+             no_grad_inputs={"Mean", "Variance"})
+def _batch_norm(ctx, ins, attrs):
+    """reference: batch_norm_op.cc. Train mode normalizes with batch stats
+    and emits updated running stats (MeanOut/VarianceOut alias the Mean/
+    Variance persistables in the IR, like the reference's in-place outputs)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    if x.ndim == 2:
+        axes, shape = (0,), (1, -1)
+    elif layout == "NCHW":
+        axes, shape = (0, 2, 3), (1, -1, 1, 1)
+    else:
+        axes, shape = (0, 1, 2), (1, 1, 1, -1)
+
+    if attrs.get("is_test", False) or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean
+        var_out = momentum * var + (1.0 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (x - use_mean.reshape(shape)) * (inv * scale).reshape(shape) \
+        + bias.reshape(shape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register_op("layer_norm", non_diff_outputs={"Mean", "Variance"})
+def _layer_norm(ctx, ins, attrs):
+    """reference: layer_norm_op.cc; normalizes over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    nshape = (1,) * axis + x.shape[axis:]
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(nshape)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(nshape)
+    return {"Y": [y], "Mean": [jnp.squeeze(mean)],
+            "Variance": [jnp.squeeze(var)]}
+
+
+@register_op("group_norm", non_diff_outputs={"Mean", "Variance"})
+def _group_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs["groups"]
+    eps = attrs.get("epsilon", 1e-5)
+    n, c, h, w = x.shape
+    xr = x.reshape(n, g, c // g, h, w)
+    mean = jnp.mean(xr, axis=(2, 3, 4), keepdims=True)
+    var = jnp.var(xr, axis=(2, 3, 4), keepdims=True)
+    y = ((xr - mean) / jnp.sqrt(var + eps)).reshape(n, c, h, w)
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(1, -1, 1, 1)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Y": [y], "Mean": [mean.reshape(n, g)],
+            "Variance": [var.reshape(n, g)]}
+
+
+@register_op("instance_norm", non_diff_outputs={"SavedMean", "SavedVariance"})
+def _instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+    var = jnp.var(x, axis=(2, 3), keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(1, -1, 1, 1)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Y": [y], "SavedMean": [mean.reshape(x.shape[:2])],
+            "SavedVariance": [var.reshape(x.shape[:2])]}
+
+
+@register_op("lrn", non_diff_outputs={"MidOut"})
+def _lrn(ctx, ins, attrs):
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    pad = n // 2
+    sqp = jnp.pad(sq, [(0, 0), (pad, n - 1 - pad), (0, 0), (0, 0)])
+    acc = sum(sqp[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / mid ** beta], "MidOut": [mid]}
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape(1, -1, *([1] * (x.ndim - 2)))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+# ---------------------------------------------------------------------------
+# dropout — custom grad (RNG mask must match between fwd and bwd)
+# ---------------------------------------------------------------------------
+
+def _dropout_grad_maker(op, block, no_grad_set):
+    from ..framework.core import grad_var_name
+    return [{
+        "type": "dropout_grad",
+        "inputs": {"Mask": op.output("Mask"),
+                   "Out@GRAD": [grad_var_name(op.output("Out")[0])]},
+        "outputs": {"X@GRAD": [grad_var_name(op.input("X")[0])]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+def _dropout_grad_lower(ctx, ins, attrs):
+    mask = ins["Mask"][0]
+    dout = ins["Out@GRAD"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        g = dout if impl == "upscale_in_train" else dout * (1.0 - p)
+    elif impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        g = dout * mask.astype(dout.dtype) * scale
+    else:
+        g = dout * mask.astype(dout.dtype)
+    return {"X@GRAD": [g]}
+
+
+@register_op("dropout", stateful=True, non_diff_outputs={"Mask"},
+             grad_maker=_dropout_grad_maker, grad_lower=_dropout_grad_lower)
+def _dropout(ctx, ins, attrs):
+    """reference: dropout_op.cc. Mask is a real output (uint8), as in the
+    reference, so the grad op replays the same mask."""
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones(x.shape, jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        out = x * keep.astype(x.dtype) * scale
+    else:
+        out = x * keep.astype(x.dtype)
+    return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _squeeze_label(label):
+    if label.ndim > 1 and label.shape[-1] == 1:
+        return jnp.squeeze(label, -1)
+    return label
+
+
+@register_op("softmax_with_cross_entropy", no_grad_inputs={"Label"})
+def _softmax_xent(ctx, ins, attrs):
+    """reference: softmax_with_cross_entropy_op.cc — the numerically stable
+    fused path (log-softmax + NLL in one)."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1) % logits.ndim
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis)
+        idx = jnp.expand_dims(lab.astype(jnp.int32), axis)
+        nll = -jnp.take_along_axis(logp, idx, axis=axis)
+        ignore = attrs.get("ignore_index", -100)
+        nll = jnp.where(jnp.expand_dims(lab == ignore, axis), 0.0, nll)
+        loss = nll
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("cross_entropy", no_grad_inputs={"Label"})
+def _cross_entropy(ctx, ins, attrs):
+    """reference: cross_entropy_op.cc — takes probabilities (post-softmax)."""
+    x, label = ins["X"][0], ins["Label"][0]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1,
+                        keepdims=True)
+    else:
+        lab = _squeeze_label(label)
+        p = jnp.take_along_axis(x, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(p, 1e-20))
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where((lab == ignore)[..., None], 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", no_grad_inputs={"Label"})
+def _sigmoid_xent(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(loss.dtype)), 1.0)
+        loss = loss / n
+    return {"Out": [loss]}
+
+
+@register_op("huber_loss", non_diff_outputs={"Residual"},
+             no_grad_inputs={"Y"})
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    loss = jnp.where(jnp.abs(r) <= d, 0.5 * r * r,
+                     d * (jnp.abs(r) - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss", non_diff_outputs={"Diff"},
+             no_grad_inputs={"Y", "InsideWeight", "OutsideWeight"})
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if "InsideWeight" in ins:
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if "OutsideWeight" in ins:
+        loss = loss * ins["OutsideWeight"][0]
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, x.ndim)),
+                            keepdims=False)[..., None]],
+            "Diff": [diff]}
+
+
+@register_op("square_error_cost", no_grad_inputs={"Label"})
+def _square_error_cost(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Label"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("kldiv_loss", no_grad_inputs={"Target"})
+def _kldiv_loss(ctx, ins, attrs):
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = t * (jnp.log(jnp.maximum(t, 1e-20)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss).reshape((1,))
+    elif red == "sum":
+        loss = jnp.sum(loss).reshape((1,))
+    elif red == "batchmean":
+        loss = (jnp.sum(loss) / x.shape[0]).reshape((1,))
+    return {"Loss": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference: operators/metrics/)
+# ---------------------------------------------------------------------------
+
+@register_op("accuracy", not_differentiable=True)
+def _accuracy(ctx, ins, attrs):
+    """reference: metrics/accuracy_op.cc — takes top-k Indices + Label."""
+    idx = ins["Indices"][0]
+    label = _squeeze_label(ins["Label"][0])
+    correct = jnp.any(idx == label[:, None], axis=1)
+    n = idx.shape[0]
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    return {"Accuracy": [(num_correct / n).reshape((1,))],
+            "Correct": [num_correct.astype(jnp.int32).reshape((1,))],
+            "Total": [jnp.asarray([n], jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# resize / interpolate
+# ---------------------------------------------------------------------------
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), "nearest")
+    return {"Out": [out]}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), "bilinear")
+    return {"Out": [out]}

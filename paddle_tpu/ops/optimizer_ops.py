@@ -1,0 +1,212 @@
+"""Optimizer update ops (reference: paddle/fluid/operators/optimizers/).
+
+Each is a pure function (param, grad, state...) -> (param', state...); the
+IR gives the outputs the same var names as the inputs (in-place semantics,
+like the reference's ParamOut aliasing Param), and the executor's donated
+scope makes the update truly in-place in HBM.
+
+State tensors (moments etc.) are kept in float32 even for bf16 params —
+master-weight style numerics for TPU (the reference's AMP decorator keeps
+fp32 master weights similarly, contrib/mixed_precision/decorator.py:194).
+"""
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"][0].reshape(()).astype(jnp.float32)
+
+
+@register_op("sgd", not_differentiable=True, is_optimizer_op=True)
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [(p.astype(jnp.float32)
+                          - _lr(ins) * g.astype(jnp.float32)).astype(p.dtype)]}
+
+
+@register_op("momentum", not_differentiable=True, is_optimizer_op=True)
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs["mu"]
+    lr = _lr(ins)
+    g32 = g.astype(jnp.float32)
+    v_new = mu * v + g32
+    if attrs.get("use_nesterov", False):
+        p_new = p.astype(jnp.float32) - (g32 + mu * v_new) * lr
+    else:
+        p_new = p.astype(jnp.float32) - lr * v_new
+    return {"ParamOut": [p_new.astype(p.dtype)], "VelocityOut": [v_new]}
+
+
+@register_op("adam", not_differentiable=True, is_optimizer_op=True)
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    g32 = g.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g32
+    m2n = b2 * m2 + (1 - b2) * g32 * g32
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_new = p.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m1n],
+            "Moment2Out": [m2n], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("adamw", not_differentiable=True, is_optimizer_op=True)
+def _adamw(ctx, ins, attrs):
+    p = ins["Param"][0]
+    coeff = attrs.get("coeff", 0.01)
+    with_decay = attrs.get("with_decay", True)
+    outs = _adam(ctx, ins, attrs)
+    if with_decay:
+        lr = _lr(ins)
+        pw = outs["ParamOut"][0].astype(jnp.float32) \
+            - lr * coeff * p.astype(jnp.float32)
+        outs["ParamOut"] = [pw.astype(p.dtype)]
+    return outs
+
+
+@register_op("adagrad", not_differentiable=True, is_optimizer_op=True)
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    g32 = g.astype(jnp.float32)
+    mom_new = mom + g32 * g32
+    p_new = p.astype(jnp.float32) - _lr(ins) * g32 / (jnp.sqrt(mom_new) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "MomentOut": [mom_new]}
+
+
+@register_op("decayed_adagrad", not_differentiable=True, is_optimizer_op=True)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g32 = g.astype(jnp.float32)
+    mom_new = decay * mom + (1 - decay) * g32 * g32
+    p_new = p.astype(jnp.float32) - _lr(ins) * g32 / (jnp.sqrt(mom_new) + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "MomentOut": [mom_new]}
+
+
+@register_op("adadelta", not_differentiable=True, is_optimizer_op=True)
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq, avg_upd = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g32 = g.astype(jnp.float32)
+    sq_new = rho * avg_sq + (1 - rho) * g32 * g32
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(sq_new + eps) * g32
+    upd_new = rho * avg_upd + (1 - rho) * upd * upd
+    p_new = p.astype(jnp.float32) - _lr(ins) * upd
+    return {"ParamOut": [p_new.astype(p.dtype)],
+            "AvgSquaredGradOut": [sq_new], "AvgSquaredUpdateOut": [upd_new]}
+
+
+@register_op("adamax", not_differentiable=True, is_optimizer_op=True)
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g32 = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * g32
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g32))
+    lr_t = _lr(ins) / (1 - b1p.reshape(()))
+    p_new = p.astype(jnp.float32) - lr_t * m_new / (inf_new + eps)
+    return {"ParamOut": [p_new.astype(p.dtype)], "MomentOut": [m_new],
+            "InfNormOut": [inf_new]}
+
+
+@register_op("rmsprop", not_differentiable=True, is_optimizer_op=True)
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    g32 = g.astype(jnp.float32)
+    ms_new = rho * ms + (1 - rho) * g32 * g32
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_new = rho * mg + (1 - rho) * g32
+        denom = jnp.sqrt(ms_new - mg_new * mg_new + eps)
+        mom_new = mu * mom + _lr(ins) * g32 / denom
+        p_new = p.astype(jnp.float32) - mom_new
+        return {"ParamOut": [p_new.astype(p.dtype)],
+                "MeanSquareOut": [ms_new], "MomentOut": [mom_new],
+                "MeanGradOut": [mg_new]}
+    mom_new = mu * mom + _lr(ins) * g32 / jnp.sqrt(ms_new + eps)
+    p_new = p.astype(jnp.float32) - mom_new
+    return {"ParamOut": [p_new.astype(p.dtype)], "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new]}
+
+
+@register_op("lamb", not_differentiable=True, is_optimizer_op=True)
+def _lamb(ctx, ins, attrs):
+    """reference: optimizers/lamb_op.cc — layer-adaptive large-batch opt."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g32
+    m2n = b2 * m2 + (1 - b2) * g32 * g32
+    m1h = m1n / (1 - b1p.reshape(()))
+    m2h = m2n / (1 - b2p.reshape(()))
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * p32
+    p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_new = p32 - _lr(ins) * trust * r
+    return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m1n],
+            "Moment2Out": [m2n], "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2]}
+
+
+@register_op("lars_momentum", not_differentiable=True, is_optimizer_op=True)
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs["mu"]
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+    g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+    local_lr = _lr(ins) * coeff * p_norm / (g_norm + wd * p_norm + 1e-12)
+    v_new = mu * v + local_lr * (g32 + wd * p32)
+    p_new = p32 - v_new
+    return {"ParamOut": [p_new.astype(p.dtype)], "VelocityOut": [v_new]}
+
+
+@register_op("ftrl", not_differentiable=True, is_optimizer_op=True)
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    g32 = g.astype(jnp.float32)
+    new_sq = sq + g32 * g32
+    sigma = (new_sq ** -power - sq ** -power) / lr
+    lin_new = lin + g32 - sigma * p.astype(jnp.float32)
+    pre = jnp.where(jnp.abs(lin_new) > l1, l1 * jnp.sign(lin_new) - lin_new,
+                    0.0)
+    denom = new_sq ** -power / lr + 2 * l2
+    p_new = pre / denom
+    return {"ParamOut": [p_new.astype(p.dtype)], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_new]}

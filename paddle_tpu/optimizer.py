@@ -1,0 +1,412 @@
+"""Optimizers: build update ops onto the program IR.
+
+Reference: python/paddle/fluid/optimizer.py (Optimizer base :50, 15
+optimizers, _create_optimization_pass). The learning rate is a graph
+variable (so LR schedules are themselves ops, see
+layers/learning_rate_scheduler.py); accumulators are persistable vars
+initialized in the startup program; update ops are the in-place ops of
+ops/optimizer_ops.py executed inside the same XLA computation as the
+backward pass — zero host round-trips per step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .framework.core import (Parameter, Program, Variable,
+                             default_main_program,
+                             default_startup_program, unique_name)
+from .framework.backward import append_backward
+
+__all__ = [
+    "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "Adam", "AdamOptimizer", "AdamW", "AdamWOptimizer", "Adagrad",
+    "AdagradOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
+    "Adadelta", "AdadeltaOptimizer", "Adamax", "AdamaxOptimizer", "RMSProp",
+    "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
+    "LarsMomentum", "LarsMomentumOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, grad_clip=None,
+                 name: Optional[str] = None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self.grad_clip = grad_clip
+        self._name = name or type(self).__name__.lower()
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self.type = "sgd"
+
+    # -- learning rate var ---------------------------------------------------
+    def _global_lr(self, program: Program, startup: Program) -> Variable:
+        if isinstance(self._learning_rate, Variable):
+            return self._learning_rate
+        blk = program.global_block
+        name = unique_name(f"{self._name}/learning_rate")
+        lr = blk.create_var(name=name, shape=(1,), dtype="float32",
+                            persistable=True, stop_gradient=True)
+        sb = startup.global_block
+        sb.create_var(name=name, shape=(1,), dtype="float32",
+                      persistable=True, stop_gradient=True)
+        sb.append_op("fill_constant", {}, {"Out": [name]},
+                     {"shape": [1], "dtype": "float32",
+                      "value": float(self._learning_rate)},
+                     infer_shape=False)
+        self._learning_rate = lr
+        return lr
+
+    # -- accumulators --------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Parameter, startup: Program,
+                         fill_value: float = 0.0, shape=None,
+                         dtype: str = "float32") -> Variable:
+        shape = tuple(shape) if shape is not None else tuple(param.shape)
+        vname = unique_name(f"{self._name}/{param.name}/{name}")
+        blk = param.block
+        acc = blk.create_var(name=vname, shape=shape, dtype=dtype,
+                             persistable=True, stop_gradient=True)
+        sb = startup.global_block
+        sb.create_var(name=vname, shape=shape, dtype=dtype, persistable=True,
+                      stop_gradient=True)
+        sb.append_op("fill_constant", {}, {"Out": [vname]},
+                     {"shape": list(shape), "dtype": dtype,
+                      "value": float(fill_value)}, infer_shape=False)
+        self._accumulators.setdefault(name, {})[param.name] = acc
+        return acc
+
+    # -- per-optimizer hooks -------------------------------------------------
+    def _create_accumulators(self, param: Parameter, startup: Program):
+        pass
+
+    def _append_optimize_op(self, block, param, grad, lr) -> None:
+        raise NotImplementedError
+
+    # -- regularization / clip ----------------------------------------------
+    def _apply_regularization(self, params_grads):
+        from .regularizer import append_regularization_ops
+        return append_regularization_ops(params_grads, self.regularization)
+
+    # -- main entry ----------------------------------------------------------
+    def minimize(self, loss: Variable,
+                 startup_program: Optional[Program] = None,
+                 parameter_list: Optional[Sequence[str]] = None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, parameter_list=parameter_list,
+                                     no_grad_set=no_grad_set)
+        opt_ops = self.apply_gradients(
+            params_grads, loss.block.program,
+            startup_program or default_startup_program())
+        return opt_ops, params_grads
+
+    def backward(self, loss, parameter_list=None, no_grad_set=None,
+                 callbacks=None):
+        return append_backward(loss, parameter_list=parameter_list,
+                               no_grad_set=no_grad_set)
+
+    def apply_gradients(self, params_grads, program=None, startup=None):
+        program = program or default_main_program()
+        startup = startup or default_startup_program()
+        block = program.global_block
+        n_before = len(block.ops)
+        # clip raw gradients first, then add weight decay
+        # (reference optimizer.py:526-529 order)
+        if self.grad_clip is not None:
+            params_grads = self.grad_clip(params_grads)
+        params_grads = self._apply_regularization(params_grads)
+        lr = self._global_lr(program, startup)
+        ops = []
+        for p, g in params_grads:
+            self._create_accumulators(p, startup)
+            ops.append(self._append_optimize_op(block, p, g, lr))
+        self._finish_update(block, params_grads, startup)
+        # tag everything appended here so clone(for_test=True) prunes it
+        for op in block.ops[n_before:]:
+            op.attrs.setdefault("op_role", "optimize")
+        return ops
+
+    def _finish_update(self, block, params_grads, startup):
+        pass
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, p, g, lr):
+        return block.append_op(
+            "sgd",
+            {"Param": [p.name], "Grad": [g.name], "LearningRate": [lr.name]},
+            {"ParamOut": [p.name]}, infer_shape=False)
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("velocity", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        v = self._accumulators["velocity"][p.name]
+        return block.append_op(
+            "momentum",
+            {"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name], "VelocityOut": [v.name]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+            infer_shape=False)
+
+
+class LarsMomentumOptimizer(MomentumOptimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, momentum, **kw)
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, p, g, lr):
+        v = self._accumulators["velocity"][p.name]
+        return block.append_op(
+            "lars_momentum",
+            {"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name], "VelocityOut": [v.name]},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay},
+            infer_shape=False)
+
+
+class _AdamLike(Optimizer):
+    op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("moment1", p, startup)
+        self._add_accumulator("moment2", p, startup)
+        self._add_accumulator("beta1_pow", p, startup, shape=(1,),
+                              fill_value=self._beta1)
+        self._add_accumulator("beta2_pow", p, startup, shape=(1,),
+                              fill_value=self._beta2)
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, p, g, lr):
+        a = self._accumulators
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs())
+        return block.append_op(
+            self.op_type,
+            {"Param": [p.name], "Grad": [g.name], "LearningRate": [lr.name],
+             "Moment1": [a["moment1"][p.name].name],
+             "Moment2": [a["moment2"][p.name].name],
+             "Beta1Pow": [a["beta1_pow"][p.name].name],
+             "Beta2Pow": [a["beta2_pow"][p.name].name]},
+            {"ParamOut": [p.name],
+             "Moment1Out": [a["moment1"][p.name].name],
+             "Moment2Out": [a["moment2"][p.name].name],
+             "Beta1PowOut": [a["beta1_pow"][p.name].name],
+             "Beta2PowOut": [a["beta2_pow"][p.name].name]},
+            attrs, infer_shape=False)
+
+
+class AdamOptimizer(_AdamLike):
+    op_type = "adam"
+
+
+class AdamWOptimizer(_AdamLike):
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, coeff=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._coeff = coeff
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff, "with_decay": True}
+
+
+class LambOptimizer(_AdamLike):
+    op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("moment", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        m = self._accumulators["moment"][p.name]
+        return block.append_op(
+            "adagrad",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"epsilon": self._epsilon}, infer_shape=False)
+
+
+class DecayedAdagradOptimizer(AdagradOptimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, epsilon, **kw)
+        self._decay = decay
+
+    def _append_optimize_op(self, block, p, g, lr):
+        m = self._accumulators["moment"][p.name]
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"decay": self._decay, "epsilon": self._epsilon},
+            infer_shape=False)
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("avg_squared_grad", p, startup)
+        self._add_accumulator("avg_squared_update", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        a = self._accumulators
+        return block.append_op(
+            "adadelta",
+            {"Param": [p.name], "Grad": [g.name],
+             "AvgSquaredGrad": [a["avg_squared_grad"][p.name].name],
+             "AvgSquaredUpdate": [a["avg_squared_update"][p.name].name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name],
+             "AvgSquaredGradOut": [a["avg_squared_grad"][p.name].name],
+             "AvgSquaredUpdateOut": [a["avg_squared_update"][p.name].name]},
+            {"rho": self._rho, "epsilon": self._epsilon}, infer_shape=False)
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("moment", p, startup)
+        self._add_accumulator("inf_norm", p, startup)
+        self._add_accumulator("beta1_pow", p, startup, shape=(1,),
+                              fill_value=self._beta1)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        a = self._accumulators
+        return block.append_op(
+            "adamax",
+            {"Param": [p.name], "Grad": [g.name], "LearningRate": [lr.name],
+             "Moment": [a["moment"][p.name].name],
+             "InfNorm": [a["inf_norm"][p.name].name],
+             "Beta1Pow": [a["beta1_pow"][p.name].name]},
+            {"ParamOut": [p.name], "MomentOut": [a["moment"][p.name].name],
+             "InfNormOut": [a["inf_norm"][p.name].name]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon}, infer_shape=False)
+
+    def _finish_update(self, block, params_grads, startup):
+        # beta1_pow update: scale in-graph
+        for p, g in params_grads:
+            b1p = self._accumulators["beta1_pow"][p.name]
+            block.append_op("scale", {"X": [b1p.name]}, {"Out": [b1p.name]},
+                            {"scale": self._beta1}, infer_shape=False)
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("mean_square", p, startup)
+        self._add_accumulator("moment", p, startup)
+        if self._centered:
+            self._add_accumulator("mean_grad", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        a = self._accumulators
+        ins = {"Param": [p.name], "Grad": [g.name],
+               "MeanSquare": [a["mean_square"][p.name].name],
+               "Moment": [a["moment"][p.name].name],
+               "LearningRate": [lr.name]}
+        outs = {"ParamOut": [p.name],
+                "MeanSquareOut": [a["mean_square"][p.name].name],
+                "MomentOut": [a["moment"][p.name].name]}
+        if self._centered:
+            ins["MeanGrad"] = [a["mean_grad"][p.name].name]
+            outs["MeanGradOut"] = [a["mean_grad"][p.name].name]
+        return block.append_op(
+            "rmsprop", ins, outs,
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered},
+            infer_shape=False)
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("squared", p, startup)
+        self._add_accumulator("linear", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        a = self._accumulators
+        return block.append_op(
+            "ftrl",
+            {"Param": [p.name], "Grad": [g.name],
+             "SquaredAccumulator": [a["squared"][p.name].name],
+             "LinearAccumulator": [a["linear"][p.name].name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name],
+             "SquaredAccumOut": [a["squared"][p.name].name],
+             "LinearAccumOut": [a["linear"][p.name].name]},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+            infer_shape=False)
+
+
+# short aliases matching paddle 2.x style
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+Adamax = AdamaxOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

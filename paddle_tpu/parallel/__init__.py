@@ -1,0 +1,1 @@
+from .plan import ShardingPlan  # noqa: F401

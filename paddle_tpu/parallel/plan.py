@@ -1,0 +1,112 @@
+"""ShardingPlan: mesh construction + sharding placement for the executor.
+
+The TPU-native replacement for the reference's multi-device SSA graph
+machinery (parallel_executor.cc:380-606 + ir/multi_devices_graph_pass/):
+instead of cloning ops per device and inserting AllReduceOpHandles, we
+annotate shardings on a jax.sharding.Mesh and let GSPMD partition the single
+XLA computation — collectives ride ICI and are inserted/scheduled by the
+compiler.
+
+Default plan = pure data parallel: feed batch sharded on axis 'dp', scope
+replicated. With param_shardings, params get PartitionSpecs (tensor
+parallelism / sharded optimizer state).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+__all__ = ["ShardingPlan"]
+
+
+class ShardingPlan:
+    def __init__(self, param_shardings: Optional[Dict[str, tuple]] = None,
+                 mesh_shape: Optional[Tuple[int, ...]] = None,
+                 axis_names: Tuple[str, ...] = ("dp",),
+                 places=None, devices=None):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        self.param_shardings = dict(param_shardings or {})
+        devs = devices if devices is not None else jax.devices()
+        if places is not None and isinstance(places, int):
+            devs = devs[:places]
+        if mesh_shape is None:
+            mesh_shape = (len(devs),)
+            axis_names = axis_names[:1]
+        self.axis_names = tuple(axis_names)
+        self.mesh = Mesh(
+            np.asarray(devs).reshape(mesh_shape), self.axis_names)
+        self.batch_axis = self.axis_names[0]
+
+    # -- shardings -----------------------------------------------------------
+    def _spec(self, *parts):
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(*parts)
+
+    def _nsh(self, spec):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, spec)
+
+    def feed_sharding(self, shape=None):
+        """Batch-shard when the leading dim divides over the dp axis;
+        replicate small/scalar feeds (e.g. a (1,)-shaped lr)."""
+        n = self.mesh.shape[self.batch_axis]
+        if shape is not None and (not shape or shape[0] % n != 0):
+            return self._nsh(self._spec())
+        return self._nsh(self._spec(self.batch_axis))
+
+    def scope_sharding(self, name: str):
+        if name in self.param_shardings:
+            return self._nsh(self._spec(*self.param_shardings[name]))
+        return self._nsh(self._spec())
+
+    # -- executor hooks ------------------------------------------------------
+    def shard_feed(self, feed: Dict):
+        """Place feed arrays batch-sharded across the mesh."""
+        import jax
+        out = {}
+        for k, v in feed.items():
+            out[k] = jax.device_put(v, self.feed_sharding(tuple(v.shape)))
+        return out
+
+    def place_scope(self, scope_vals: Dict):
+        import jax
+        out = {}
+        for k, v in scope_vals.items():
+            sh = self.scope_sharding(k)
+            arr = getattr(v, "sharding", None)
+            if arr is not None and arr == sh:
+                out[k] = v
+            else:
+                out[k] = jax.device_put(v, sh)
+        return out
+
+    def constrain(self, op, env) -> None:
+        """Re-assert shardings on sharded-param outputs so GSPMD keeps TP
+        layouts stable through the step (with_sharding_constraint)."""
+        if not self.param_shardings:
+            return
+        import jax
+        for name in op.output_names():
+            if name in self.param_shardings:
+                env[name] = jax.lax.with_sharding_constraint(
+                    env[name], self.scope_sharding(name))
+
+    def jit(self, fn, mutable, created, readonly, feed_shapes):
+        import jax
+
+        mut_sh = {n: self.scope_sharding(n) for n in mutable}
+        ro_sh = {n: self.scope_sharding(n) for n in readonly}
+        feed_sh = {n: self.feed_sharding(s) for n, s in feed_shapes.items()}
+        out_sh = dict(mut_sh)
+        for n in created:
+            out_sh[n] = self.scope_sharding(n)
+        rep = self._nsh(self._spec())
+
+        return jax.jit(
+            fn,
+            in_shardings=(mut_sh, ro_sh, feed_sh, rep),
+            out_shardings=(out_sh, None, rep),
+            donate_argnums=(0,))
