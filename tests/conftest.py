@@ -1,0 +1,29 @@
+"""Test env: deterministic CPU backend with an 8-device virtual mesh.
+
+Mirrors the reference's strategy of running device-dependent tests on a
+fake/emulated backend (SURVEY.md §4.6): sharding tests use
+xla_force_host_platform_device_count instead of real chips.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# full-precision matmuls: numeric-gradient checks need loss evaluations
+# accurate to f32, not the bf16-ish default
+os.environ["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A pytest plugin may have imported jax before this conftest ran, in which
+# case jax.config already captured JAX_PLATFORMS=axon (the TPU tunnel) from
+# the ambient env — force it back before any backend initializes.
+import sys  # noqa: E402
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,122 @@
+"""append_backward machinery tests (reference: test_backward.py +
+backward.py:135 _addup_repetitive_outputs_ behavior)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.core import grad_var_name
+
+
+class TestDuplicateGradSum(unittest.TestCase):
+    def test_var_used_twice_grads_sum(self):
+        """d/dx of mean(x*x_used_twice...) — x feeds two ops, grads add."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4], append_batch_size=False,
+                               stop_gradient=False)
+            a = pt.layers.scale(x, scale=2.0)
+            b = pt.layers.scale(x, scale=3.0)
+            s = a + b
+            loss = pt.layers.reduce_sum(s)
+        gx, = pt.gradients([loss], [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            g, = exe.run(main, feed={"x": np.ones(4, "f")},
+                         fetch_list=[gx])
+        np.testing.assert_allclose(g, np.full(4, 5.0), rtol=1e-6)
+
+    def test_sum_op_inserted(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4], append_batch_size=False,
+                               stop_gradient=False)
+            y = x * x  # x used as both inputs of elementwise_mul
+            loss = pt.layers.reduce_sum(y)
+        pt.gradients([loss], [x])
+        types = [op.type for op in main.global_block.ops]
+        self.assertIn("sum", types)
+
+    def test_param_shared_between_branches(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3])
+            w = pt.ParamAttr(name="shared_w",
+                             initializer=pt.initializer.Constant(0.5))
+            h1 = pt.layers.fc(x, 4, param_attr=w, bias_attr=False)
+            h2 = pt.layers.fc(x, 4, param_attr="shared_w", bias_attr=False)
+            loss = pt.layers.mean(h1 + h2)
+            pgs = pt.append_backward(loss)
+        names = [p.name for p, g in pgs]
+        self.assertEqual(names.count("shared_w"), 1)
+
+
+class TestStopGradient(unittest.TestCase):
+    def test_stop_gradient_blocks_path(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4], append_batch_size=False,
+                               stop_gradient=False)
+            y = pt.layers.scale(x, scale=2.0)
+            y.stop_gradient = True
+            z = pt.layers.scale(y, scale=3.0)
+            w = pt.layers.scale(x, scale=4.0)
+            loss = pt.layers.reduce_sum(z + w)
+        gx, = pt.gradients([loss], [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            g, = exe.run(main, feed={"x": np.ones(4, "f")},
+                         fetch_list=[gx])
+        # only the w-branch contributes: d(4x)/dx = 4
+        np.testing.assert_allclose(g, np.full(4, 4.0), rtol=1e-6)
+
+    def test_no_grad_for_nontrainable_param(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3])
+            h = pt.layers.fc(x, 2, bias_attr=False,
+                             param_attr=pt.ParamAttr(trainable=False))
+            h2 = pt.layers.fc(h, 2, bias_attr=False)
+            loss = pt.layers.mean(h2)
+            pgs = pt.append_backward(loss)
+        self.assertEqual(len(pgs), 1)  # only the trainable fc weight
+
+
+class TestChainRule(unittest.TestCase):
+    def test_deep_chain(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4], append_batch_size=False,
+                               stop_gradient=False)
+            h = x
+            for _ in range(5):
+                h = pt.layers.tanh(pt.layers.scale(h, scale=0.9))
+            loss = pt.layers.reduce_sum(h)
+        gx, = pt.gradients([loss], [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xs = np.array([0.1, -0.2, 0.3, 0.0], "f")
+            g, = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+        # numeric check
+        d = 1e-3
+
+        def f(v):
+            h = v.astype(np.float64)
+            for _ in range(5):
+                h = np.tanh(0.9 * h)
+            return h.sum()
+
+        num = np.zeros(4)
+        for i in range(4):
+            e = np.zeros(4)
+            e[i] = d
+            num[i] = (f(xs + e) - f(xs - e)) / (2 * d)
+        np.testing.assert_allclose(g, num, rtol=1e-3, atol=1e-5)
+
+
+if __name__ == "__main__":
+    unittest.main()

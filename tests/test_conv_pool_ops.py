@@ -1,0 +1,208 @@
+"""conv2d / pool2d op tests vs naive numpy references
+(reference: test_conv2d_op.py, test_pool2d_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=3):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("f")
+
+
+def conv2d_ref(x, w, stride, pad, dilation=(1, 1), groups=1):
+    n, cin, h, ww = x.shape
+    cout, cin_g, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilation
+    xp = np.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (ww + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((n, cout, oh, ow), dtype=np.float64)
+    cpg = cout // groups
+    for g in range(groups):
+        for oc in range(g * cpg, (g + 1) * cpg):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[:, g * cin_g:(g + 1) * cin_g,
+                               i * sh:i * sh + dh * kh:dh,
+                               j * sw:j * sw + dw * kw:dw]
+                    out[:, oc, i, j] = np.sum(
+                        patch * w[oc][None], axis=(1, 2, 3))
+    return out.astype("f")
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setUp(self):
+        x = _rand(2, 3, 7, 7)
+        w = _rand(4, 3, 3, 3, seed=4)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": conv2d_ref(x, w, (1, 1), (0, 0))}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input_in", "Filter_in"], "Output_out",
+                        max_relative_error=0.02)
+
+
+class TestConv2dStridePad(OpTest):
+    op_type = "conv2d"
+
+    def setUp(self):
+        x = _rand(2, 3, 8, 8)
+        w = _rand(6, 3, 3, 3, seed=5)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": conv2d_ref(x, w, (2, 2), (1, 1))}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv2dGroups(OpTest):
+    op_type = "conv2d"
+
+    def setUp(self):
+        x = _rand(2, 4, 6, 6)
+        w = _rand(8, 2, 3, 3, seed=6)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": conv2d_ref(x, w, (1, 1), (1, 1), groups=2)}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 2}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv2dDilation(OpTest):
+    op_type = "conv2d"
+
+    def setUp(self):
+        x = _rand(1, 2, 9, 9)
+        w = _rand(3, 2, 3, 3, seed=7)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": conv2d_ref(x, w, (1, 1), (2, 2),
+                                             dilation=(2, 2))}
+        self.attrs = {"strides": [1, 1], "paddings": [2, 2],
+                      "dilations": [2, 2], "groups": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDepthwiseConv2d(OpTest):
+    op_type = "depthwise_conv2d"
+
+    def setUp(self):
+        x = _rand(2, 3, 6, 6)
+        w = _rand(3, 1, 3, 3, seed=8)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": conv2d_ref(x, w, (1, 1), (1, 1), groups=3)}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+def pool2d_ref(x, ksize, stride, pad, ptype="max", exclusive=True):
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = stride
+    ph, pw = pad
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    fill = -np.inf if ptype == "max" else 0.0
+    xp = np.full((n, c, h + 2 * ph, w + 2 * pw), fill, dtype=np.float64)
+    xp[:, :, ph:ph + h, pw:pw + w] = x
+    out = np.zeros((n, c, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if ptype == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            else:
+                if exclusive:
+                    cnt = (min(i * sh + kh, h + ph) - max(i * sh, ph)) * \
+                          (min(j * sw + kw, w + pw) - max(j * sw, pw))
+                else:
+                    cnt = kh * kw
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / cnt
+    return out.astype("f")
+
+
+class TestMaxPool2d(OpTest):
+    op_type = "pool2d"
+
+    def setUp(self):
+        x = _rand(2, 3, 6, 6, seed=9)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": pool2d_ref(x, (2, 2), (2, 2), (0, 0), "max")}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out", max_relative_error=0.02)
+
+
+class TestAvgPool2d(OpTest):
+    op_type = "pool2d"
+
+    def setUp(self):
+        x = _rand(2, 3, 6, 6, seed=10)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": pool2d_ref(x, (3, 3), (2, 2), (1, 1), "avg",
+                                          exclusive=True)}
+        self.attrs = {"pooling_type": "avg", "ksize": [3, 3],
+                      "strides": [2, 2], "paddings": [1, 1],
+                      "exclusive": True}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out", max_relative_error=0.02)
+
+
+class TestAvgPool2dInclusive(OpTest):
+    op_type = "pool2d"
+
+    def setUp(self):
+        x = _rand(1, 2, 6, 6, seed=11)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": pool2d_ref(x, (3, 3), (2, 2), (1, 1), "avg",
+                                          exclusive=False)}
+        self.attrs = {"pooling_type": "avg", "ksize": [3, 3],
+                      "strides": [2, 2], "paddings": [1, 1],
+                      "exclusive": False}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestGlobalPool(OpTest):
+    op_type = "pool2d"
+
+    def setUp(self):
+        x = _rand(2, 3, 5, 5, seed=12)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "global_pooling": True}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")

@@ -1,0 +1,83 @@
+"""Data-parallel training on an 8-device virtual mesh
+(reference pattern: parallel_executor convergence tests, SURVEY.md §4.4 —
+run the same model single- vs multi-device and compare losses).
+
+GSPMD inserts the gradient all-reduces the reference's AllReduceOpHandle
+performed; correctness shows up as bitwise-close loss trajectories.
+"""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _build(seed=5):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8])
+        y = pt.layers.data("y", [1], dtype="int64")
+        h = pt.layers.fc(x, 16, act="relu",
+                         param_attr=pt.ParamAttr(
+                             initializer=pt.initializer.Constant(0.05)))
+        logits = pt.layers.fc(h, 4, param_attr=pt.ParamAttr(
+            initializer=pt.initializer.Constant(0.1)))
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.Momentum(0.1, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _data(step):
+    rng = np.random.RandomState(100 + step)
+    x = rng.randn(32, 8).astype("f")
+    y = rng.randint(0, 4, (32, 1)).astype(np.int64)
+    return {"x": x, "y": y}
+
+
+class TestDataParallel(unittest.TestCase):
+    def test_dp_loss_matches_single_device(self):
+        import jax
+        self.assertGreaterEqual(len(jax.devices()), 8)
+
+        main, startup, loss = _build()
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            single = [float(exe.run(main, feed=_data(s),
+                                    fetch_list=[loss])[0][0])
+                      for s in range(5)]
+
+        main2, startup2, loss2 = _build()
+        compiled = pt.CompiledProgram(main2).with_data_parallel(
+            loss_name=loss2.name)
+        exe2 = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe2.run(startup2)
+            par = [float(exe2.run(compiled, feed=_data(s),
+                                  fetch_list=[loss2])[0][0])
+                   for s in range(5)]
+
+        np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-5)
+
+    def test_tensor_parallel_sharding_compiles(self):
+        main, startup, loss = _build(seed=6)
+        # shard the first fc weight column-wise over a 2x4 dp x mp mesh
+        w_name = main.all_parameters()[0].name
+        compiled = pt.CompiledProgram(main).with_sharding(
+            {w_name: (None, "mp")}, mesh_shape=(2, 4),
+            axis_names=("dp", "mp"))
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            l0 = float(exe.run(compiled, feed=_data(0),
+                               fetch_list=[loss])[0][0])
+            l1 = float(exe.run(compiled, feed=_data(1),
+                               fetch_list=[loss])[0][0])
+        self.assertTrue(np.isfinite(l0) and np.isfinite(l1))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,89 @@
+"""Dropout semantics + RNG determinism (reference: test_dropout_op.py)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _run_dropout(prob, impl, is_test, seed=0, n=4096):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = seed
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [n], append_batch_size=False,
+                           stop_gradient=False)
+        out = pt.layers.dropout(x, dropout_prob=prob, is_test=is_test,
+                                dropout_implementation=impl)
+        loss = pt.layers.mean(out)
+    grads = pt.gradients([loss], [x])
+    exe = pt.Executor()
+    exe.run(startup)
+    with pt.scope_guard(pt.Scope()):
+        xs = np.ones(n, "f")
+        o, g = exe.run(main, feed={"x": xs},
+                       fetch_list=[out, grads[0]])
+    return o, g
+
+
+class TestDropout(unittest.TestCase):
+    def test_downgrade_in_infer_train(self):
+        o, g = _run_dropout(0.3, "downgrade_in_infer", False)
+        kept = o != 0
+        self.assertAlmostEqual(kept.mean(), 0.7, delta=0.05)
+        np.testing.assert_allclose(o[kept], 1.0)  # no scaling at train
+        # grad == mask / n
+        np.testing.assert_allclose(g, kept.astype("f") / o.size, atol=1e-7)
+
+    def test_downgrade_in_infer_test(self):
+        o, g = _run_dropout(0.3, "downgrade_in_infer", True)
+        np.testing.assert_allclose(o, 0.7, atol=1e-6)  # scaled at infer
+
+    def test_upscale_in_train(self):
+        o, g = _run_dropout(0.25, "upscale_in_train", False)
+        kept = o != 0
+        np.testing.assert_allclose(o[kept], 1.0 / 0.75, rtol=1e-5)
+        np.testing.assert_allclose(
+            g[kept], 1.0 / 0.75 / o.size, rtol=1e-5)
+
+    def test_upscale_in_train_test_mode(self):
+        o, g = _run_dropout(0.25, "upscale_in_train", True)
+        np.testing.assert_allclose(o, 1.0, atol=1e-6)  # identity at infer
+
+    def test_rng_advances_between_runs(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [256], append_batch_size=False)
+            out = pt.layers.dropout(x, dropout_prob=0.5)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xs = np.ones(256, "f")
+            o1, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+            o2, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        self.assertFalse(np.array_equal(o1, o2))
+
+    def test_program_seed_reproducible(self):
+        o1, _ = _run_dropout(0.5, "downgrade_in_infer", False, seed=7)
+        o2, _ = _run_dropout(0.5, "downgrade_in_infer", False, seed=7)
+        np.testing.assert_array_equal(o1, o2)
+
+
+class TestRandomInit(unittest.TestCase):
+    def test_uniform_bounds_and_gaussian_moments(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            u = pt.layers.uniform_random([10000], min=-2.0, max=3.0)
+            g = pt.layers.gaussian_random([10000], mean=1.0, std=2.0)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            uv, gv = exe.run(main, feed={}, fetch_list=[u, g])
+        self.assertGreaterEqual(uv.min(), -2.0)
+        self.assertLessEqual(uv.max(), 3.0)
+        self.assertAlmostEqual(uv.mean(), 0.5, delta=0.1)
+        self.assertAlmostEqual(gv.mean(), 1.0, delta=0.1)
+        self.assertAlmostEqual(gv.std(), 2.0, delta=0.1)
+
+
+if __name__ == "__main__":
+    unittest.main()

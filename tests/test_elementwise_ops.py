@@ -1,0 +1,176 @@
+"""Elementwise op tests (reference: test_elementwise_*_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape):
+    return np.random.RandomState(42).uniform(0.1, 1.0, shape).astype("f")
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setUp(self):
+        x, y = _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.attrs = {"axis": -1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def setUp(self):
+        x, y = _rand(2, 3, 4), _rand(3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestElementwiseSub(OpTest):
+    op_type = "elementwise_sub"
+
+    def setUp(self):
+        x, y = _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestElementwiseMul(OpTest):
+    op_type = "elementwise_mul"
+
+    def setUp(self):
+        x, y = _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setUp(self):
+        x, y = _rand(3, 4), _rand(3, 4) + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out",
+                        max_relative_error=0.01)
+
+
+class TestElementwiseMax(OpTest):
+    op_type = "elementwise_max"
+
+    def setUp(self):
+        x = _rand(3, 4)
+        y = x.T.reshape(3, 4) + 0.01  # avoid ties for grad check
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.maximum(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwiseMin(OpTest):
+    op_type = "elementwise_min"
+
+    def setUp(self):
+        x, y = _rand(3, 4), _rand(4, 3).reshape(3, 4) + 0.02
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.minimum(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwisePow(OpTest):
+    op_type = "elementwise_pow"
+
+    def setUp(self):
+        x, y = _rand(3, 4) + 0.5, _rand(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.power(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out", max_relative_error=0.01)
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setUp(self):
+        x = _rand(4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestSumOp(OpTest):
+    op_type = "sum"
+
+    def setUp(self):
+        xs = [("x0", _rand(3, 4)), ("x1", _rand(3, 4)), ("x2", _rand(3, 4))]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": xs[0][1] + xs[1][1] + xs[2][1]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0", "x1"], "Out_out")
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def setUp(self):
+        x = np.random.RandomState(0).uniform(-2, 2, (4, 5)).astype("f")
+        # keep away from clip boundaries for finite differences
+        x[np.abs(np.abs(x) - 1.0) < 0.05] = 0.0
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.clip(x, -1.0, 1.0)}
+        self.attrs = {"min": -1.0, "max": 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")

@@ -1,0 +1,115 @@
+"""Executor semantics + program serialization + checkpoint io tests
+(reference: framework tests + test_io_save_load style)."""
+
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _toy_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [3])
+        y = pt.layers.data("y", [1])
+        h = pt.layers.fc(x, 8, act="relu")
+        pred = pt.layers.fc(h, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss, pred
+
+
+class TestExecutor(unittest.TestCase):
+    def test_program_mutation_invalidates_cache(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3], append_batch_size=False,
+                               stop_gradient=False)
+            a = pt.layers.scale(x, scale=2.0)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            o, = exe.run(main, feed={"x": np.ones(3, "f")}, fetch_list=[a])
+            np.testing.assert_allclose(o, 2.0)
+            with pt.program_guard(main, startup):
+                b = pt.layers.scale(a, scale=5.0)
+            o2, = exe.run(main, feed={"x": np.ones(3, "f")},
+                          fetch_list=[b])
+            np.testing.assert_allclose(o2, 10.0)
+
+    def test_scope_isolation(self):
+        main, startup, loss, pred = _toy_program()
+        exe = pt.Executor()
+        s1, s2 = pt.Scope(), pt.Scope()
+        f = {"x": np.ones((4, 3), "f"), "y": np.zeros((4, 1), "f")}
+        with pt.scope_guard(s1):
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed=f, fetch_list=[loss])
+        with pt.scope_guard(s2):
+            exe.run(startup)
+        w1 = np.asarray(s1.find_var("fc_0.w_0")
+                        if s1.find_var("fc_0.w_0") is not None else 0)
+        # different scopes hold independent params
+        names1 = set(s1.var_names())
+        names2 = set(s2.var_names())
+        self.assertEqual({n for n in names1 if not n.startswith("@")},
+                         {n for n in names2 if not n.startswith("@")})
+
+    def test_batch_size_change_recompiles(self):
+        main, startup, loss, pred = _toy_program()
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for bs in (2, 8, 2):
+                f = {"x": np.ones((bs, 3), "f"),
+                     "y": np.zeros((bs, 1), "f")}
+                l, = exe.run(main, feed=f, fetch_list=[loss])
+                self.assertEqual(l.shape, (1,))
+
+
+class TestProgramSerialization(unittest.TestCase):
+    def test_roundtrip(self):
+        main, startup, loss, pred = _toy_program()
+        data = main.serialize_to_string()
+        main2 = pt.Program.parse_from_string(data)
+        self.assertEqual(
+            [op.type for op in main.global_block.ops],
+            [op.type for op in main2.global_block.ops])
+        exe = pt.Executor()
+        f = {"x": np.ones((4, 3), "f"), "y": np.zeros((4, 1), "f")}
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            l1, = exe.run(main, feed=f, fetch_list=[loss])
+            l2, = exe.run(main2, feed=f, fetch_list=[loss.name])
+        # second run of main applied one sgd step; rerun main2 from same
+        # params is not identical — instead compare op-for-op structure and
+        # that main2 executes at all
+        self.assertEqual(l2.shape, (1,))
+
+
+class TestSaveLoad(unittest.TestCase):
+    def test_persistables_roundtrip(self):
+        main, startup, loss, pred = _toy_program()
+        exe = pt.Executor()
+        f = {"x": np.random.RandomState(0).randn(4, 3).astype("f"),
+             "y": np.zeros((4, 1), "f")}
+        d = tempfile.mkdtemp()
+        s1, s2 = pt.Scope(), pt.Scope()
+        with pt.scope_guard(s1):
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed=f, fetch_list=[loss])
+            p1, = exe.run(main.clone(for_test=True), feed=f,
+                          fetch_list=[pred])
+            pt.io.save_persistables(exe, d, main)
+        with pt.scope_guard(s2):
+            pt.io.load_persistables(exe, d, main)
+            p2, = exe.run(main.clone(for_test=True), feed=f,
+                          fetch_list=[pred])
+        np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+if __name__ == "__main__":
+    unittest.main()

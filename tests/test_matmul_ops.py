@@ -1,0 +1,84 @@
+"""matmul / mul op tests (reference: test_matmul_op.py, test_mul_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=1):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("f")
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        x, y = _rand(4, 5), _rand(5, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        x, y = _rand(5, 4), _rand(3, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x.T @ y.T}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestMatmulBatched(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        x, y = _rand(2, 4, 5), _rand(2, 5, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")
+
+
+class TestMatmulAlpha(OpTest):
+    op_type = "matmul"
+
+    def setUp(self):
+        x, y = _rand(3, 4), _rand(4, 2)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": 0.5 * (x @ y)}
+        self.attrs = {"alpha": 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setUp(self):
+        x, y = _rand(3, 2, 4), _rand(8, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x.reshape(3, 8) @ y).reshape(3, 5)}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], "Out_out")

@@ -1,0 +1,138 @@
+"""batch_norm / layer_norm / group_norm op tests
+(reference: test_batch_norm_op.py, test_layer_norm_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=21):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("f")
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def setUp(self):
+        x = _rand(3, 4, 5, 5)
+        scale = _rand(4, seed=22) + 1.5
+        bias = _rand(4, seed=23)
+        mean = np.zeros(4, "f")
+        var = np.ones(4, "f")
+        eps, mom = 1e-5, 0.9
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        y = (x - mu.reshape(1, -1, 1, 1)) / np.sqrt(
+            v.reshape(1, -1, 1, 1) + eps) * scale.reshape(1, -1, 1, 1) \
+            + bias.reshape(1, -1, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mom * mean + (1 - mom) * mu,
+            "VarianceOut": mom * var + (1 - mom) * v,
+            "SavedMean": mu,
+            "SavedVariance": 1.0 / np.sqrt(v + eps),
+        }
+        self.attrs = {"epsilon": eps, "momentum": mom, "is_test": False}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Scale_in", "Bias_in"], "Y_out",
+                        max_relative_error=0.02)
+
+
+class TestBatchNormInference(OpTest):
+    op_type = "batch_norm"
+
+    def setUp(self):
+        x = _rand(3, 4, 5, 5, seed=24)
+        scale = _rand(4, seed=25) + 1.5
+        bias = _rand(4, seed=26)
+        mean = _rand(4, seed=27)
+        var = np.abs(_rand(4, seed=28)) + 0.5
+        eps = 1e-5
+        y = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+            var.reshape(1, -1, 1, 1) + eps) * scale.reshape(1, -1, 1, 1) \
+            + bias.reshape(1, -1, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.outputs = {"Y": y, "MeanOut": mean, "VarianceOut": var,
+                        "SavedMean": None, "SavedVariance": None}
+        self.attrs = {"epsilon": eps, "is_test": True}
+
+    def test_output(self):
+        self.check_output(atol=1e-4,
+                          no_check_set=("SavedMean_out",
+                                        "SavedVariance_out"))
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setUp(self):
+        x = _rand(4, 6, seed=31)
+        scale = _rand(6, seed=32) + 1.5
+        bias = _rand(6, seed=33)
+        eps = 1e-5
+        mu = x.mean(axis=1, keepdims=True)
+        v = x.var(axis=1, keepdims=True)
+        y = (x - mu) / np.sqrt(v + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": y, "Mean": mu.reshape(4),
+                        "Variance": v.reshape(4)}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": eps}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Scale_in", "Bias_in"], "Y_out",
+                        max_relative_error=0.02)
+
+
+class TestLayerNorm3D(OpTest):
+    op_type = "layer_norm"
+
+    def setUp(self):
+        x = _rand(2, 3, 4, seed=34)
+        eps = 1e-5
+        mu = x.mean(axis=(1, 2), keepdims=True)
+        v = x.var(axis=(1, 2), keepdims=True)
+        y = (x - mu) / np.sqrt(v + eps)
+        self.inputs = {"X": x}
+        self.outputs = {"Y": y, "Mean": mu.reshape(2),
+                        "Variance": v.reshape(2)}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": eps}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestGroupNorm(OpTest):
+    op_type = "group_norm"
+
+    def setUp(self):
+        x = _rand(2, 4, 3, 3, seed=35)
+        scale = _rand(4, seed=36) + 1.0
+        bias = _rand(4, seed=37)
+        eps = 1e-5
+        g = 2
+        xr = x.reshape(2, g, 2, 3, 3)
+        mu = xr.mean(axis=(2, 3, 4), keepdims=True)
+        v = xr.var(axis=(2, 3, 4), keepdims=True)
+        y = ((xr - mu) / np.sqrt(v + eps)).reshape(2, 4, 3, 3) \
+            * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": y, "Mean": mu.reshape(2, g),
+                        "Variance": v.reshape(2, g)}
+        self.attrs = {"groups": g, "epsilon": eps}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Scale_in"], "Y_out",
+                        max_relative_error=0.02)

@@ -1,0 +1,207 @@
+"""Optimizer update rules vs numpy references over multiple steps
+(reference: test_sgd_op.py, test_momentum_op.py, test_adam_op.py ...)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _train(opt_factory, steps=5, seed=11):
+    """Run `steps` updates of a 1-layer linear model; return final weight."""
+    rng = np.random.RandomState(seed)
+    x0 = rng.randn(8, 4).astype("f")
+    y0 = rng.randn(8, 1).astype("f")
+    w0 = rng.randn(4, 1).astype("f")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [1])
+        pred = pt.layers.fc(
+            x, 1, bias_attr=False,
+            param_attr=pt.ParamAttr(
+                name="w", initializer=pt.initializer.NumpyArrayInitializer(
+                    w0)))
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        opt_factory().minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={"x": x0, "y": y0}, fetch_list=[loss])
+        w = pt.global_scope().get_numpy("w")
+    return x0, y0, w0, w
+
+
+def _ref_grad(w, x, y):
+    pred = x @ w
+    return 2.0 / x.shape[0] * x.T @ (pred - y)
+
+
+class TestSGD(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr = 0.1
+        x0, y0, w0, w = _train(lambda: pt.optimizer.SGD(lr))
+        ref = w0.copy()
+        for _ in range(5):
+            ref -= lr * _ref_grad(ref, x0, y0)
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestMomentum(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, mu = 0.1, 0.9
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.Momentum(lr, momentum=mu))
+        ref, v = w0.copy(), np.zeros_like(w0)
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            v = mu * v + g
+            ref -= lr * v
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestNesterov(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, mu = 0.05, 0.9
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.Momentum(lr, momentum=mu,
+                                          use_nesterov=True))
+        ref, v = w0.copy(), np.zeros_like(w0)
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            v = mu * v + g
+            ref -= (g + mu * v) * lr
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestAdam(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.Adam(lr, beta1=b1, beta2=b2, epsilon=eps))
+        ref = w0.copy()
+        m1 = np.zeros_like(w0)
+        m2 = np.zeros_like(w0)
+        b1p, b2p = b1, b2
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            m1 = b1 * m1 + (1 - b1) * g
+            m2 = b2 * m2 + (1 - b2) * g * g
+            lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+            ref -= lr_t * m1 / (np.sqrt(m2) + eps)
+            b1p *= b1
+            b2p *= b2
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestAdagrad(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, eps = 0.1, 1e-6
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.Adagrad(lr, epsilon=eps))
+        ref = w0.copy()
+        acc = np.zeros_like(w0)
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            acc += g * g
+            ref -= lr * g / (np.sqrt(acc) + eps)
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestRMSProp(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, rho, eps, mu = 0.01, 0.95, 1e-6, 0.9
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.RMSProp(lr, rho=rho, epsilon=eps,
+                                         momentum=mu))
+        ref = w0.copy()
+        ms = np.zeros_like(w0)
+        mom = np.zeros_like(w0)
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            ms = rho * ms + (1 - rho) * g * g
+            mom = mu * mom + lr * g / np.sqrt(ms + eps)
+            ref -= mom
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestWeightDecayAndClip(unittest.TestCase):
+    def test_l2_decay(self):
+        lr, coeff = 0.1, 0.01
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.SGD(
+                lr, regularization=pt.regularizer.L2Decay(coeff)))
+        ref = w0.copy()
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0) + coeff * ref
+            ref -= lr * g
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+    def test_global_norm_clip(self):
+        lr, clip_norm = 0.1, 0.05
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.SGD(
+                lr, grad_clip=pt.clip.GradientClipByGlobalNorm(clip_norm)))
+        ref = w0.copy()
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            norm = np.sqrt((g ** 2).sum())
+            if norm > clip_norm:
+                g = g * clip_norm / norm
+            ref -= lr * g
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestLRScheduler(unittest.TestCase):
+    def test_piecewise_decay(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [2])
+            y = pt.layers.data("y", [1])
+            pred = pt.layers.fc(x, 1, bias_attr=False)
+            loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+            lr = pt.layers.piecewise_decay([2, 4], [0.1, 0.01, 0.001])
+            pt.optimizer.SGD(lr).minimize(loss)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            seen = []
+            for _ in range(6):
+                v, = exe.run(main,
+                             feed={"x": np.ones((2, 2), "f"),
+                                   "y": np.ones((2, 1), "f")},
+                             fetch_list=[lr])
+                seen.append(float(v[0]))
+        # steps 1..6 -> boundaries at 2 and 4 (step incremented pre-use)
+        np.testing.assert_allclose(
+            seen, [0.1, 0.01, 0.01, 0.001, 0.001, 0.001], rtol=1e-6)
+
+    def test_noam_decay_shape(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [2])
+            y = pt.layers.data("y", [1])
+            pred = pt.layers.fc(x, 1, bias_attr=False)
+            loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+            lr = pt.layers.noam_decay(64, warmup_steps=4)
+            pt.optimizer.Adam(lr).minimize(loss)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            vals = []
+            for _ in range(8):
+                v, = exe.run(main,
+                             feed={"x": np.ones((2, 2), "f"),
+                                   "y": np.ones((2, 1), "f")},
+                             fetch_list=[lr])
+                vals.append(float(v[0]))
+        peak = np.argmax(vals)
+        self.assertEqual(peak, 3)  # warmup peaks at warmup_steps
+        self.assertTrue(all(a <= b for a, b in zip(vals[:4], vals[1:5]))
+                        or vals[3] >= vals[4])
+
+
+if __name__ == "__main__":
+    unittest.main()

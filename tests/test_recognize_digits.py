@@ -1,0 +1,56 @@
+"""Book-style model test: LeNet digit classifier trains to >97% accuracy
+(reference: tests/book/test_recognize_digits.py — the MNIST gate in
+BASELINE.md). Uses a synthetic 10-class image dataset (class prototypes +
+noise) since the environment has no network for dataset download; the gate
+exercises the same conv/pool/fc/xent/optimizer path end to end.
+"""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models.lenet import lenet
+
+
+def make_dataset(n, rng, noise=0.35):
+    protos = np.random.RandomState(1234).randn(10, 1, 28, 28).astype("f")
+    y = rng.randint(0, 10, size=(n, 1)).astype(np.int64)
+    x = protos[y[:, 0]] + noise * rng.randn(n, 1, 28, 28).astype("f")
+    return x, y
+
+
+class TestRecognizeDigits(unittest.TestCase):
+    def test_lenet_trains_above_97(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = pt.layers.data("img", [1, 28, 28])
+            label = pt.layers.data("label", [1], dtype="int64")
+            logits = lenet(img)
+            loss = pt.layers.mean(
+                pt.layers.softmax_with_cross_entropy(logits, label))
+            acc = pt.layers.accuracy(pt.layers.softmax(logits), label)
+            pt.optimizer.Adam(1e-3).minimize(loss)
+        test_prog = main.clone(for_test=True)
+
+        exe = pt.Executor()
+        rng = np.random.RandomState(0)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            first_loss = None
+            for step in range(150):
+                x, y = make_dataset(64, rng)
+                l, = exe.run(main, feed={"img": x, "label": y},
+                             fetch_list=[loss])
+                if first_loss is None:
+                    first_loss = float(l[0])
+            xt, yt = make_dataset(512, np.random.RandomState(999))
+            a, = exe.run(test_prog, feed={"img": xt, "label": yt},
+                         fetch_list=[acc])
+        self.assertLess(float(l[0]), first_loss)
+        self.assertGreater(float(a[0]), 0.97,
+                           msg=f"accuracy {float(a[0])} <= 0.97")
+
+
+if __name__ == "__main__":
+    unittest.main()

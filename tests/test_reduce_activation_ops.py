@@ -1,0 +1,149 @@
+"""Reduce + activation op tests (reference: test_reduce_op.py,
+test_activation_op.py)."""
+
+import numpy as np
+
+import paddle_tpu  # noqa: F401
+from op_test import OpTest
+
+
+def _rand(*shape, seed=61, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("f")
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def setUp(self):
+        x = _rand(3, 4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.attrs = {"dim": [1]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestReduceSumAll(OpTest):
+    op_type = "reduce_sum"
+
+    def setUp(self):
+        x = _rand(3, 4, seed=62)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([x.sum()], "f")}
+        self.attrs = {"reduce_all": True}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestReduceMeanKeepDim(OpTest):
+    op_type = "reduce_mean"
+
+    def setUp(self):
+        x = _rand(3, 4, 5, seed=63)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.mean(axis=(0, 2), keepdims=True)}
+        self.attrs = {"dim": [0, 2], "keep_dim": True}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestReduceMax(OpTest):
+    op_type = "reduce_max"
+
+    def setUp(self):
+        x = _rand(4, 5, seed=64)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.max(axis=1)}
+        self.attrs = {"dim": [1]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReduceProd(OpTest):
+    op_type = "reduce_prod"
+
+    def setUp(self):
+        x = _rand(3, 4, seed=65, lo=0.5, hi=1.5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.prod(axis=1)}
+        self.attrs = {"dim": [1]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out", max_relative_error=0.01)
+
+
+class TestMean(OpTest):
+    op_type = "mean"
+
+    def setUp(self):
+        x = _rand(4, 5, seed=66)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([x.mean()], "f")}
+
+    def test_output(self):
+        self.check_output(atol=1e-6)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+def _act_case(name, op_type, fn, lo=-1.0, hi=1.0, grad=True, tol=0.01,
+              seed=70):
+    x = _rand(4, 5, seed=seed, lo=lo, hi=hi)
+
+    class _T(OpTest):
+        def setUp(self):
+            self.op_type = op_type
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x)}
+
+        def test_output(self):
+            self.check_output(atol=1e-5)
+
+        if grad:
+            def test_grad(self):
+                self.check_grad(["X_in"], "Out_out",
+                                max_relative_error=tol)
+
+    _T.__name__ = name
+    return _T
+
+
+def sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+TestRelu = _act_case("TestRelu", "relu", lambda x: np.maximum(x, 0),
+                     seed=71)
+TestSigmoid = _act_case("TestSigmoid", "sigmoid", sigmoid, seed=72)
+TestTanh = _act_case("TestTanh", "tanh", np.tanh, seed=73)
+TestExp = _act_case("TestExp", "exp", np.exp, seed=74)
+TestLog = _act_case("TestLog", "log", np.log, lo=0.2, hi=2.0, seed=75)
+TestSqrt = _act_case("TestSqrt", "sqrt", np.sqrt, lo=0.2, hi=2.0, seed=76)
+TestSquare = _act_case("TestSquare", "square", np.square, seed=77)
+TestAbs = _act_case("TestAbs", "abs", np.abs, grad=False, seed=78)
+TestSoftplus = _act_case("TestSoftplus", "softplus",
+                         lambda x: np.log1p(np.exp(x)), seed=79)
+TestGelu = _act_case(
+    "TestGelu", "gelu",
+    lambda x: x * 0.5 * (1.0 + np.vectorize(__import__('math').erf)(
+        x / np.sqrt(2.0))), seed=80)
+TestLeakyRelu = _act_case(
+    "TestLeakyRelu", "leaky_relu",
+    lambda x: np.where(x > 0, x, 0.02 * x), seed=81)

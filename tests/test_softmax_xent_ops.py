@@ -1,0 +1,156 @@
+"""softmax / cross-entropy family op tests
+(reference: test_softmax_op.py, test_softmax_with_cross_entropy_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=41):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("f")
+
+
+def softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setUp(self):
+        x = _rand(4, 7)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": softmax_np(x)}
+        self.attrs = {"axis": -1}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out", max_relative_error=0.02)
+
+
+class TestSoftmaxAxis(OpTest):
+    op_type = "softmax"
+
+    def setUp(self):
+        x = _rand(3, 5, 4, seed=42)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": softmax_np(x, axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setUp(self):
+        logits = _rand(5, 7, seed=43)
+        label = np.random.RandomState(44).randint(0, 7, (5, 1)).astype(
+            np.int64)
+        sm = softmax_np(logits)
+        loss = -np.log(sm[np.arange(5), label[:, 0]])[:, None]
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.attrs = {"soft_label": False}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Logits_in"], "Loss_out",
+                        max_relative_error=0.02)
+
+
+class TestSoftmaxWithCrossEntropySoftLabel(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setUp(self):
+        logits = _rand(5, 7, seed=45)
+        label = softmax_np(_rand(5, 7, seed=46))
+        sm = softmax_np(logits)
+        loss = -(label * np.log(sm)).sum(axis=1, keepdims=True)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.attrs = {"soft_label": True}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Logits_in"], "Loss_out",
+                        max_relative_error=0.02)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setUp(self):
+        x = softmax_np(_rand(5, 6, seed=47))
+        label = np.random.RandomState(48).randint(0, 6, (5, 1)).astype(
+            np.int64)
+        loss = -np.log(x[np.arange(5), label[:, 0]])[:, None]
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": loss}
+        self.attrs = {"soft_label": False}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Y_out", max_relative_error=0.02)
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def setUp(self):
+        x = _rand(4, 5, seed=49)
+        label = np.random.RandomState(50).randint(0, 2, (4, 5)).astype("f")
+        loss = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out", max_relative_error=0.02)
+
+
+class TestSquareErrorCost(OpTest):
+    op_type = "square_error_cost"
+
+    def setUp(self):
+        x = _rand(4, 3, seed=51)
+        y = _rand(4, 3, seed=52)
+        self.inputs = {"X": x, "Label": y}
+        self.outputs = {"Out": (x - y) ** 2}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestAccuracy(OpTest):
+    op_type = "accuracy"
+
+    def setUp(self):
+        rng = np.random.RandomState(53)
+        vals = rng.uniform(0, 1, (6, 3)).astype("f")
+        idx = rng.randint(0, 10, (6, 3)).astype(np.int64)
+        label = idx[:, 1:2].copy()
+        label[0] = (idx[0, 0] + idx[0, 1] + idx[0, 2] + 1) % 10  # miss
+        correct = sum(1 for i in range(6) if label[i, 0] in idx[i])
+        self.inputs = {"Out": vals, "Indices": idx, "Label": label}
+        self.outputs = {"Accuracy": np.array([correct / 6.0], "f"),
+                        "Correct": np.array([correct], np.int32),
+                        "Total": np.array([6], np.int32)}
+
+    def test_output(self):
+        self.check_output()

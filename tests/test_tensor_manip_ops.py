@@ -1,0 +1,345 @@
+"""Tensor manipulation op tests (reference: test_reshape_op.py,
+test_transpose_op.py, test_concat_op.py, test_gather_op.py, ...)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=91):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("f")
+
+
+class TestReshape2(OpTest):
+    op_type = "reshape2"
+
+    def setUp(self):
+        x = _rand(2, 3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 12), "XShape": None}
+        self.attrs = {"shape": [2, 12]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape_out",))
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestReshapeInfer(OpTest):
+    op_type = "reshape2"
+
+    def setUp(self):
+        x = _rand(2, 3, 4, seed=92)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(6, 4), "XShape": None}
+        self.attrs = {"shape": [-1, 4]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape_out",))
+
+
+class TestReshapeZeroDim(OpTest):
+    op_type = "reshape2"
+
+    def setUp(self):
+        x = _rand(2, 3, 4, seed=93)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 12), "XShape": None}
+        self.attrs = {"shape": [0, -1]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape_out",))
+
+
+class TestTranspose2(OpTest):
+    op_type = "transpose2"
+
+    def setUp(self):
+        x = _rand(2, 3, 4, seed=94)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.transpose(2, 0, 1), "XShape": None}
+        self.attrs = {"axis": [2, 0, 1]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape_out",))
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setUp(self):
+        xs = [("a", _rand(2, 3, seed=95)), ("b", _rand(2, 2, seed=96)),
+              ("c", _rand(2, 4, seed=97))]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": np.concatenate([v for _, v in xs], axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a", "b", "c"], "Out_out")
+
+
+class TestSplit(OpTest):
+    op_type = "split"
+
+    def setUp(self):
+        x = _rand(4, 6, seed=98)
+        parts = np.split(x, [2, 5], axis=1)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": [("o0", parts[0]), ("o1", parts[1]),
+                                ("o2", parts[2])]}
+        self.attrs = {"sections": [2, 3, 1], "axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], ["o0", "o1", "o2"])
+
+
+class TestSlice(OpTest):
+    op_type = "slice"
+
+    def setUp(self):
+        x = _rand(4, 5, 6, seed=99)
+        self.inputs = {"Input": x}
+        self.outputs = {"Out": x[1:3, :, 2:5]}
+        self.attrs = {"axes": [0, 2], "starts": [1, 2], "ends": [3, 5]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Input_in"], "Out_out")
+
+
+class TestSliceNegative(OpTest):
+    op_type = "slice"
+
+    def setUp(self):
+        x = _rand(4, 5, seed=100)
+        self.inputs = {"Input": x}
+        self.outputs = {"Out": x[-2:, :]}
+        self.attrs = {"axes": [0], "starts": [-2], "ends": [100]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setUp(self):
+        x = _rand(6, 4, seed=101)
+        idx = np.array([0, 2, 5, 2], np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestGatherNd(OpTest):
+    op_type = "gather_nd"
+
+    def setUp(self):
+        x = _rand(3, 4, 5, seed=102)
+        idx = np.array([[0, 1], [2, 3]], np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[[0, 2], [1, 3]]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestScatterOverwrite(OpTest):
+    op_type = "scatter"
+
+    def setUp(self):
+        x = _rand(5, 3, seed=103)
+        ids = np.array([1, 3], np.int64)
+        upd = _rand(2, 3, seed=104)
+        out = x.copy()
+        out[ids] = upd
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.outputs = {"Out": out}
+        self.attrs = {"overwrite": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setUp(self):
+        w = _rand(10, 4, seed=105)
+        ids = np.array([[1], [3], [9], [3]], np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids[:, 0]]}
+        self.attrs = {"padding_idx": -1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W_in"], "Out_out")
+
+
+class TestLookupTablePadding(OpTest):
+    op_type = "lookup_table"
+
+    def setUp(self):
+        w = _rand(10, 4, seed=106)
+        ids = np.array([[1], [0], [5]], np.int64)
+        out = w[ids[:, 0]].copy()
+        out[1] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": out}
+        self.attrs = {"padding_idx": 0}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    op_type = "one_hot"
+
+    def setUp(self):
+        x = np.array([[1], [0], [3]], np.int64)
+        out = np.zeros((3, 4), "f")
+        out[np.arange(3), x[:, 0]] = 1.0
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"depth": 4}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setUp(self):
+        x = _rand(3, 6, seed=107)
+        idx = np.argsort(-x, axis=1)[:, :2]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": vals, "Indices": idx.astype(np.int64)}
+        self.attrs = {"k": 2}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestArgMax(OpTest):
+    op_type = "arg_max"
+
+    def setUp(self):
+        x = _rand(3, 6, seed=108)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.argmax(x, axis=1).astype(np.int64)}
+        self.attrs = {"axis": 1, "dtype": "int64"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCumsum(OpTest):
+    op_type = "cumsum"
+
+    def setUp(self):
+        x = _rand(3, 4, seed=109)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.cumsum(x, axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestCumsumExclusiveReverse(OpTest):
+    op_type = "cumsum"
+
+    def setUp(self):
+        x = np.array([[1., 2., 3.]], "f")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([[5., 3., 0.]], "f")}
+        self.attrs = {"axis": 1, "exclusive": True, "reverse": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def setUp(self):
+        x = _rand(3, 4, seed=110)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.astype(np.float64)}
+        self.attrs = {"out_dtype": "float64"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestStack(OpTest):
+    op_type = "stack"
+
+    def setUp(self):
+        xs = [("s0", _rand(3, 4, seed=111)), ("s1", _rand(3, 4, seed=112))]
+        self.inputs = {"X": xs}
+        self.outputs = {"Y": np.stack([v for _, v in xs], axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["s0", "s1"], "Y_out")
+
+
+class TestExpand(OpTest):
+    op_type = "expand"
+
+    def setUp(self):
+        x = _rand(2, 3, seed=113)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tile(x, (2, 2))}
+        self.attrs = {"expand_times": [2, 2]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
+
+
+class TestPad(OpTest):
+    op_type = "pad"
+
+    def setUp(self):
+        x = _rand(2, 3, seed=114)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.pad(x, [(1, 0), (0, 2)],
+                                      constant_values=0.5)}
+        self.attrs = {"paddings": [1, 0, 0, 2], "pad_value": 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], "Out_out")
