@@ -2,6 +2,7 @@ from .core import (Program, Block, Operator, Variable, Parameter,
                    program_guard, default_main_program,
                    default_startup_program, unique_name, name_scope,
                    grad_var_name)
-from .executor import Executor, Scope, global_scope, scope_guard
+from .executor import (Executor, Scope, global_scope, scope_guard,
+                       as_jax_function)
 from .backward import append_backward, gradients
 from .layer_helper import LayerHelper, ParamAttr

@@ -9,7 +9,7 @@ import numpy as np
 from ..framework.core import Variable, unique_name
 from ..framework.layer_helper import LayerHelper
 
-__all__ = ["elementwise_add", "elementwise_sub", "elementwise_mul",
+__all__ = ["einsum", "elementwise_add", "elementwise_sub", "elementwise_mul",
            "elementwise_div", "elementwise_min", "elementwise_max",
            "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
            "matmul", "mul", "scale", "sum", "sums", "reduce_sum",
@@ -83,6 +83,14 @@ def elementwise_mod(x, y, axis=-1, act=None, name=None):
 
 def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
     return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+def einsum(equation, *operands, name=None):
+    helper = LayerHelper("einsum", name=name)
+    out = helper.create_variable_for_type_inference(operands[0].dtype)
+    helper.append_op("einsum", {"Operands": [v.name for v in operands]},
+                     {"Out": [out.name]}, {"equation": equation})
+    return out
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):

@@ -91,8 +91,11 @@ _register_round("sign", jnp.sign)
 @register_op("softmax")
 def _softmax(ctx, ins, attrs):
     """reference: operators/softmax_op.cc (+cudnn). XLA fuses the
-    max/sub/exp/sum/div chain; a Pallas kernel is unnecessary at these sizes."""
-    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+    max/sub/exp/sum/div chain; internal math is f32 so bf16 inputs (AMP)
+    only reduce memory traffic."""
+    x = ins["X"][0]
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=attrs.get("axis", -1))
+    return {"Out": [out.astype(x.dtype)]}
 
 
 @register_op("log_softmax")

@@ -92,6 +92,14 @@ def _bmm(ctx, ins, attrs):
     return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
 
 
+@register_op("einsum")
+def _einsum(ctx, ins, attrs):
+    """General contraction (lowered to one dot_general, no layout copies) —
+    lets attention run in b,s,n,d layout with zero physical transposes,
+    replacing the reference's transpose+matmul pattern."""
+    return {"Out": [jnp.einsum(attrs["equation"], *ins["Operands"])]}
+
+
 @register_op("dot")
 def _dot(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]

@@ -202,20 +202,22 @@ def _batch_norm(ctx, ins, attrs):
 
 @register_op("layer_norm", non_diff_outputs={"Mean", "Variance"})
 def _layer_norm(ctx, ins, attrs):
-    """reference: layer_norm_op.cc; normalizes over dims >= begin_norm_axis."""
+    """reference: layer_norm_op.cc; normalizes over dims >= begin_norm_axis.
+    Stats are computed in f32 even for bf16 activations (AMP-safe)."""
     x = ins["X"][0]
     eps = attrs.get("epsilon", 1e-5)
     axis = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) / jnp.sqrt(var + eps)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    y = ((x32 - mean) / jnp.sqrt(var + eps))
     nshape = (1,) * axis + x.shape[axis:]
     if "Scale" in ins:
-        y = y * ins["Scale"][0].reshape(nshape)
+        y = y * ins["Scale"][0].astype(jnp.float32).reshape(nshape)
     if "Bias" in ins:
-        y = y + ins["Bias"][0].reshape(nshape)
-    return {"Y": [y], "Mean": [jnp.squeeze(mean)],
+        y = y + ins["Bias"][0].astype(jnp.float32).reshape(nshape)
+    return {"Y": [y.astype(x.dtype)], "Mean": [jnp.squeeze(mean)],
             "Variance": [jnp.squeeze(var)]}
 
 
@@ -342,8 +344,9 @@ def _softmax_xent(ctx, ins, attrs):
     fused path (log-softmax + NLL in one)."""
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = attrs.get("axis", -1) % logits.ndim
-    logp = jax.nn.log_softmax(logits, axis=axis)
-    softmax = jnp.exp(logp)
+    # f32 internal math: bf16 logits only halve HBM traffic (AMP-safe)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    softmax = jnp.exp(logp).astype(logits.dtype)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
     else:
