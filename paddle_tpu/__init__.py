@@ -20,7 +20,10 @@ from . import initializer
 from . import regularizer
 from . import clip
 from . import io
-from .framework.core import Program as _P
+from . import metrics
+from . import profiler
+from . import contrib
+from .framework.executor import as_jax_function
 
 __version__ = "0.1.0"
 

@@ -18,6 +18,7 @@ Scope maps var name -> jax.Array and persists across runs
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -147,21 +148,42 @@ class Executor:
 
         blk = program.global_block
 
+        def _expand(ops):
+            """Flatten macro ops' sub-blocks for read/write classification
+            (sub-block reads are reads of the enclosing op). The macro op is
+            yielded BEFORE its sub-block ops: its implicit reads (carry-in /
+            branch pass-through) happen before any write inside it."""
+            for op in ops:
+                yield op
+                for key in ("sub_block", "sub_block_t", "sub_block_f"):
+                    if key in op.attrs:
+                        yield from _expand(
+                            program.blocks[op.attrs[key]].ops)
+
         # Classify persistables: a var must come IN from the scope only if
         # some op reads it before any op writes it; vars defined by earlier
         # ops (e.g. params created by startup init ops) are internal.
         written = set()
         external_reads = set()
         written_so_far = set(feed)
-        for op in blk.ops:
+        sub_local = set()
+        for b in program.blocks[1:]:
+            sub_local.update(b.vars)
+        macro_attrs = ("sub_block", "sub_block_t", "sub_block_f")
+        for op in _expand(blk.ops):
             if op.type in ("feed", "fetch"):
                 continue
-            for n in op.input_names():
-                if n not in written_so_far:
+            reads = list(op.input_names())
+            if any(k in op.attrs for k in macro_attrs):
+                # a macro op's outputs are also implicit reads: while carries
+                # state in, cond_block's untaken branch passes values through
+                reads += op.output_names()
+            for n in reads:
+                if n not in written_so_far and n not in sub_local:
                     external_reads.add(n)
-            outs = op.output_names()
+            outs = [n for n in op.output_names() if n not in sub_local]
             written.update(outs)
-            written_so_far.update(outs)
+            written_so_far.update(op.output_names())
         for n in fetch_names:
             if n not in written_so_far:
                 external_reads.add(n)
@@ -216,11 +238,20 @@ class Executor:
 
         key = scope.find_var("@RNG@")
 
-        new_mut, fetches, new_key = compiled(mut_in, ro_in, feed_in, key)
+        new_mut, fetches, new_key, finite_flags = compiled(
+            mut_in, ro_in, feed_in, key)
 
         for n, v in new_mut.items():
             scope.set_var(n, v)
         scope.set_var("@RNG@", new_key)
+
+        if finite_flags:
+            for tag, ok in finite_flags.items():
+                if not bool(ok):
+                    idx, op_type, var = tag.split(":", 2)
+                    raise FloatingPointError(
+                        f"nan/inf detected in output {var!r} of op "
+                        f"#{idx} ({op_type}) — FLAGS_check_nan_inf")
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]
@@ -235,21 +266,35 @@ class Executor:
         ops = [op for op in blk.ops if op.type not in ("feed", "fetch")]
         out_names = list(mutable) + list(created)
 
+        check_nan_inf = os.environ.get("FLAGS_check_nan_inf", "0") == "1"
+
         def fn(mut_scope, ro_scope, feed_vals, rng_key):
+            import jax.numpy as jnp
+
             env: Dict[str, Any] = {}
             env.update(ro_scope)
             env.update(mut_scope)
             env.update(feed_vals)
             ctx = LowerContext(rng_key=rng_key,
                                mesh=dist_plan.mesh if dist_plan else None)
-            for op in ops:
+            finite_flags = {}
+            for i, op in enumerate(ops):
                 lower_op(ctx, op, env)
                 if dist_plan is not None:
                     dist_plan.constrain(op, env)
+                if check_nan_inf:
+                    # FLAGS_check_nan_inf sanitizer
+                    # (reference: operator.cc:949 CheckNanInf)
+                    for n in op.output_names():
+                        v = env.get(n)
+                        if v is not None and jnp.issubdtype(
+                                jnp.asarray(v).dtype, jnp.inexact):
+                            finite_flags[f"{i}:{op.type}:{n}"] = \
+                                jnp.all(jnp.isfinite(v))
             new_mut = {n: env[n] for n in out_names}
             fetches = [env[n] for n in fetch_names]
             new_key = jax.random.fold_in(rng_key, 0x5eed)
-            return new_mut, fetches, new_key
+            return new_mut, fetches, new_key, finite_flags
 
         if dist_plan is not None:
             return dist_plan.jit(fn, mutable, created, readonly, feed_shapes)

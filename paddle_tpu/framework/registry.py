@@ -26,7 +26,8 @@ import numpy as np
 from .core import Block, Operator, GRAD_SUFFIX
 
 __all__ = ["OpDef", "register_op", "get_op_def", "has_op_def",
-           "infer_op_shapes", "LowerContext", "lower_op", "DUMMY_BATCH"]
+           "infer_op_shapes", "LowerContext", "lower_op", "DUMMY_BATCH",
+           "register_macro_op"]
 
 # Dummy concrete size substituted for -1 (batch) dims during eval_shape-based
 # inference; a large prime so a genuine layer dim colliding with it (and
@@ -73,6 +74,23 @@ def get_op_def(op_type: str) -> OpDef:
     return _REGISTRY[op_type]
 
 
+# Macro ops (control flow) lower with full context: fn(ctx, op, env) where
+# env is the live name->array binding and op carries sub-block attrs. They
+# reach their sub-blocks via op.block.program. The reference analog is
+# operators/controlflow/ (while_op.cc runs a sub-block with a nested
+# Executor); here the sub-block lowers into lax.while_loop/cond/scan bodies.
+_MACROS: Dict[str, Callable] = {}
+
+
+def register_macro_op(op_type: str, **opdef_kw):
+    def deco(fn):
+        _MACROS[op_type] = fn
+        _REGISTRY[op_type] = OpDef(type=op_type, lower=None,
+                                   not_differentiable=True, **opdef_kw)
+        return fn
+    return deco
+
+
 def has_op_def(op_type: str) -> bool:
     return op_type in _REGISTRY
 
@@ -113,15 +131,23 @@ class LowerContext:
 # ---------------------------------------------------------------------------
 
 def lower_op(ctx: LowerContext, op: Operator, env: Dict[str, Any]) -> None:
-    """Lower one op: read inputs from env, write outputs into env."""
-    if op.type.endswith("_grad"):
-        _lower_grad_op(ctx, op, env)
-        return
-    opdef = get_op_def(op.type)
-    ins = {slot: [env[n] for n in names]
-           for slot, names in op.inputs.items() if names}
-    outs = opdef.lower(ctx, ins, op.attrs)
-    _bind_outputs(op, outs, env)
+    """Lower one op: read inputs from env, write outputs into env. Each op
+    traces under jax.named_scope so XLA metadata (and profiler traces) carry
+    op-level names — the RecordEvent analog at zero runtime cost."""
+    import jax
+
+    with jax.named_scope(op.type):
+        if op.type in _MACROS:
+            _MACROS[op.type](ctx, op, env)
+            return
+        if op.type.endswith("_grad"):
+            _lower_grad_op(ctx, op, env)
+            return
+        opdef = get_op_def(op.type)
+        ins = {slot: [env[n] for n in names]
+               for slot, names in op.inputs.items() if names}
+        outs = opdef.lower(ctx, ins, op.attrs)
+        _bind_outputs(op, outs, env)
 
 
 def _bind_outputs(op: Operator, outs: Dict[str, List[Any]], env):
@@ -285,8 +311,10 @@ def infer_op_shapes(op: Operator, block: Block) -> None:
         if vals is None:
             continue
         for n, sds in zip(names, vals):
-            v = (block.vars.get(n) or
-                 block.create_var(name=n))
+            # resolve through the parent chain: writing an outer var from a
+            # sub-block must NOT create a shadow in the sub-block
+            v = block.var(n) if block.has_var(n) else block.create_var(
+                name=n)
             shape = tuple(sds.shape)
             if saw_dummy:
                 shape = tuple(-1 if d == DUMMY_BATCH else d for d in shape)
@@ -303,9 +331,8 @@ def _infer_grad_shapes(op: Operator, block: Block) -> None:
         for i, n in enumerate(names):
             if not n:
                 continue
-            v = block.vars.get(n)
-            if v is None:
-                v = block.create_var(name=n)
+            v = block.var(n) if block.has_var(n) else block.create_var(
+                name=n)
             if i < len(fwd_names) and block.has_var(fwd_names[i]):
                 fv = block.var(fwd_names[i])
                 v.shape = fv.shape

@@ -4,8 +4,14 @@ from .math import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 
 from . import math  # noqa: F401
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401
 from . import learning_rate_scheduler  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import sequence  # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,225 @@
+"""Control-flow layers: While / while_loop / cond / Switch.
+
+Reference: python/paddle/fluid/layers/control_flow.py (While:644,
+ConditionalBlock:1366, Switch:1450). Sub-blocks are real IR blocks; the
+macro ops in ops/control_flow_ops.py lower them into lax.while_loop /
+lax.cond bodies.
+"""
+
+import contextlib
+
+from ..framework.core import (Variable, default_main_program, unique_name)
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["While", "while_loop", "cond", "Switch"]
+
+
+def _outer_writes(sub_block):
+    """Names written by sub-block ops that live in an OUTER block (these are
+    the vars that persist past the construct)."""
+    writes = []
+    for op in sub_block.ops:
+        for n in op.output_names():
+            if n not in sub_block.vars and n not in writes:
+                writes.append(n)
+    return writes
+
+
+class While:
+    """fluid.layers.While(cond) analog:
+
+        i = layers.fill_constant([1], 'int64', 0)
+        loop_cond = layers.less_than(i, limit)
+        w = layers.While(loop_cond)
+        with w.block():
+            ...
+            layers.increment(i)
+            layers.assign(layers.less_than(i, limit), loop_cond)
+
+    Vars assigned inside the block persist across iterations iff they were
+    created outside. Shapes must be loop-invariant.
+    """
+
+    def __init__(self, cond: Variable, name=None):
+        self._cond = cond
+        self._helper = LayerHelper("while", name=name)
+        if cond.dtype != "bool":
+            raise TypeError("While condition must be bool")
+
+    @contextlib.contextmanager
+    def block(self):
+        program = default_main_program()
+        parent = program.current_block()
+        from ..framework.core import _prog_state
+        sub = program.create_block()
+        _prog_state.current_block_idx = sub.idx
+        try:
+            yield
+        finally:
+            _prog_state.current_block_idx = parent.idx
+            parent.append_op(
+                "while",
+                {"Condition": [self._cond.name], "X": []},
+                {"Out": _outer_writes(sub)},
+                {"sub_block": sub.idx}, infer_shape=False)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, name=None):
+    """paddle.static.nn.while_loop-style functional API built on While."""
+    from . import tensor as t_layers
+    from . import math as m_layers
+
+    program = default_main_program()
+    parent = program.current_block()
+    from ..framework.core import _prog_state
+
+    # evaluate cond once outside to create the condition var
+    c0 = cond_fn(*loop_vars)
+    # loop state vars must be assignable: copy into fresh vars
+    states = []
+    for v in loop_vars:
+        nv = t_layers.assign(v)
+        nv.stop_gradient = True
+        states.append(nv)
+    cond_var = t_layers.assign(c0)
+    cond_var.stop_gradient = True
+
+    sub = program.create_block()
+    _prog_state.current_block_idx = sub.idx
+    try:
+        new_states = body_fn(*states)
+        if not isinstance(new_states, (list, tuple)):
+            new_states = [new_states]
+        if len(new_states) != len(states):
+            raise ValueError(
+                f"body_fn returned {len(new_states)} values for "
+                f"{len(states)} loop_vars")
+        for s, ns in zip(states, new_states):
+            t_layers.assign(ns, output=s)
+        t_layers.assign(cond_fn(*states), output=cond_var)
+    finally:
+        _prog_state.current_block_idx = parent.idx
+
+    parent.append_op("while",
+                     {"Condition": [cond_var.name], "X": []},
+                     {"Out": _outer_writes(sub)},
+                     {"sub_block": sub.idx}, infer_shape=False)
+    return states
+
+
+def cond(pred: Variable, true_fn, false_fn, name=None):
+    """paddle.static.nn.cond analog — both branches traced as sub-blocks,
+    lowered to lax.cond. Branch returns must match in shape/dtype."""
+    program = default_main_program()
+    parent = program.current_block()
+    from ..framework.core import _prog_state
+    helper = LayerHelper("cond", name=name)
+
+    def trace(fn):
+        sub = program.create_block()
+        _prog_state.current_block_idx = sub.idx
+        try:
+            rets = fn()
+        finally:
+            _prog_state.current_block_idx = parent.idx
+        if rets is None:
+            rets = []
+        if not isinstance(rets, (list, tuple)):
+            rets = [rets]
+        return sub, [r.name for r in rets], list(rets)
+
+    tb, t_names, t_vars = trace(true_fn)
+    fb, f_names, f_vars = trace(false_fn)
+    if len(t_names) != len(f_names):
+        raise ValueError("cond branches must return the same structure")
+
+    outs = []
+    for tv in t_vars:
+        o = parent.create_var(name=unique_name(f"{helper.name}.out"),
+                              shape=tv.shape, dtype=tv.dtype)
+        outs.append(o)
+    parent.append_op("cond_block",
+                     {"Cond": [pred.name]},
+                     {"Out": [o.name for o in outs]},
+                     {"sub_block_t": tb.idx, "sub_block_f": fb.idx,
+                      "true_rets": t_names, "false_rets": f_names},
+                     infer_shape=False)
+    return outs[0] if len(outs) == 1 else outs
+
+
+class Switch:
+    """fluid.layers.Switch analog (control_flow.py:1450), built on nested
+    cond():
+
+        with Switch() as switch:
+            with switch.case(cond1): ...assign lr1 to out...
+            with switch.default(): ...assign lr2 to out...
+
+    Implemented at build time by rewriting to where() chains over the
+    assigned var — the common fluid use (piecewise LR) writes one var per
+    branch via layers.assign.
+    """
+
+    def __init__(self, name=None):
+        self._cases = []  # (cond_var or None, [captured assigns])
+        self._inside = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        self._pre_case(condition)
+        yield
+        self._post_case()
+
+    @contextlib.contextmanager
+    def default(self):
+        self._pre_case(None)
+        yield
+        self._post_case()
+
+    def _pre_case(self, condition):
+        program = default_main_program()
+        parent = program.current_block()
+        from ..framework.core import _prog_state
+        sub = program.create_block()
+        self._inside = (condition, sub, parent)
+        _prog_state.current_block_idx = sub.idx
+
+    def _post_case(self):
+        condition, sub, parent = self._inside
+        from ..framework.core import _prog_state
+        _prog_state.current_block_idx = parent.idx
+        # hoist case body as a cond_block writing the assigned outer vars
+        writes = _outer_writes(sub)
+        if condition is None:
+            # default: execute only if no prior case matched — build the
+            # negation of the OR of previous conditions
+            from . import math as m
+            prev = None
+            for c, _ in self._cases:
+                prev = c if prev is None else m.logical_or(prev, c)
+            condition = m.logical_not(prev) if prev is not None else None
+        self._cases.append((condition, writes))
+        if condition is None:
+            # unconditional default with no prior case: inline ops
+            for op in sub.ops:
+                parent.ops.append(op)
+            return
+        # guarded: cond_block whose false branch returns current values
+        fb = default_main_program().create_block()
+        t_rets = writes
+        f_rets = writes  # false branch: pass through outer values
+        parent.append_op("cond_block", {"Cond": [condition.name]},
+                         {"Out": writes},
+                         {"sub_block_t": sub.idx, "sub_block_f": fb.idx,
+                          "true_rets": t_rets, "false_rets": f_rets},
+                         infer_shape=False)
+
+
+def increment_op_block():  # placeholder for API listing parity
+    raise NotImplementedError

@@ -1,0 +1,105 @@
+"""Sequence layers over the dense [b, s, ...] + lengths representation
+(reference: layers/sequence ops exposed via layers/nn.py)."""
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["sequence_mask", "sequence_pool", "sequence_softmax",
+           "sequence_reverse", "sequence_expand", "sequence_concat",
+           "sequence_last_step", "sequence_first_step", "sequence_slice",
+           "sequence_enumerate", "sequence_erase", "sequence_pad",
+           "sequence_unpad"]
+
+
+def _op(helper_name, op_type, ins, outs_spec, attrs=None, dtypes=None):
+    helper = LayerHelper(helper_name)
+    outs = {}
+    ret = []
+    for i, slot in enumerate(outs_spec):
+        dt = (dtypes or {}).get(slot, "float32")
+        v = helper.create_variable_for_type_inference(dt)
+        outs[slot] = [v.name]
+        ret.append(v)
+    helper.append_op(op_type, ins, outs, attrs or {})
+    return ret[0] if len(ret) == 1 else ret
+
+
+def sequence_mask(x, maxlen, dtype="float32", name=None):
+    return _op("sequence_mask", "sequence_mask", {"X": [x.name]}, ["Y"],
+               {"maxlen": int(maxlen), "out_dtype": dtype},
+               {"Y": dtype})
+
+
+def _with_len(x, lengths):
+    ins = {"X": [x.name]}
+    if lengths is not None:
+        ins["Length"] = [lengths.name]
+    return ins
+
+
+def sequence_pool(input, pool_type, lengths=None, name=None):
+    return _op("sequence_pool", "sequence_pool", _with_len(input, lengths),
+               ["Out"], {"pooltype": pool_type.upper()},
+               {"Out": input.dtype})
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    return _op("sequence_softmax", "sequence_softmax",
+               _with_len(input, lengths), ["Out"], {},
+               {"Out": input.dtype})
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    return _op("sequence_reverse", "sequence_reverse", _with_len(x, lengths),
+               ["Y"], {}, {"Y": x.dtype})
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    return _op("sequence_expand", "sequence_expand",
+               {"X": [x.name], "Y": [y.name]}, ["Out"], {},
+               {"Out": x.dtype})
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat")
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sequence_concat",
+                     {"X": [v.name for v in input]}, {"Out": [out.name]})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    return _op("sequence_slice", "sequence_slice", {"X": [input.name]},
+               ["Out"], {"offset": int(offset), "length": int(length)},
+               {"Out": input.dtype})
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    return _op("sequence_enumerate", "sequence_enumerate",
+               {"X": [input.name]}, ["Out"],
+               {"win_size": win_size, "pad_value": pad_value},
+               {"Out": input.dtype})
+
+
+def sequence_erase(input, tokens, name=None):
+    return _op("sequence_erase", "sequence_erase", {"X": [input.name]},
+               ["Out"], {"tokens": list(tokens)}, {"Out": input.dtype})
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, lengths=None, name=None):
+    ins = _with_len(x, lengths)
+    return _op("sequence_pad", "sequence_pad", ins, ["Out", "Length"], {},
+               {"Out": x.dtype, "Length": "int64"})
+
+
+def sequence_unpad(x, length, name=None):
+    return _op("sequence_unpad", "sequence_unpad",
+               {"X": [x.name], "Length": [length.name]}, ["Out"], {},
+               {"Out": x.dtype})

@@ -1,0 +1,167 @@
+"""Host-side metric accumulators (reference: python/paddle/fluid/metrics.py,
+889 LoC: MetricBase, CompositeMetric, Precision, Recall, Accuracy, Auc...).
+These accumulate numpy fetches across batches; in-graph per-batch metrics
+come from the metric ops (accuracy op, ops/nn_ops.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Accuracy", "Precision",
+           "Recall", "ChunkEvaluator", "Auc"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *a, **kw):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    """Accumulates the in-graph accuracy op's (value, weight) pairs."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels == 0)))
+
+    def eval(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+
+    def eval(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Auc(MetricBase):
+    """Histogram-bucketed ROC AUC (reference metrics.py Auc)."""
+
+    def __init__(self, name=None, num_thresholds=4095):
+        super().__init__(name)
+        self._n = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self._n + 1, np.int64)
+        self._neg = np.zeros(self._n + 1, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self._n).astype(np.int64), 0, self._n)
+        np.add.at(self._pos, idx[labels == 1], 1)
+        np.add.at(self._neg, idx[labels == 0], 1)
+
+    def eval(self):
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # walk thresholds high->low accumulating TPR/FPR trapezoids
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0
+        self.num_label = 0
+        self.num_correct = 0
+
+    def update(self, num_infer, num_label, num_correct):
+        self.num_infer += int(num_infer)
+        self.num_label += int(num_label)
+        self.num_correct += int(num_correct)
+
+    def eval(self):
+        precision = self.num_correct / self.num_infer if self.num_infer \
+            else 0.0
+        recall = self.num_correct / self.num_label if self.num_label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1

@@ -5,3 +5,6 @@ from . import activation_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import control_flow_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401

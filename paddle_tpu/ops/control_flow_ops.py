@@ -1,0 +1,96 @@
+"""Control-flow macro ops: while / cond over sub-blocks.
+
+Reference: paddle/fluid/operators/controlflow/while_op.cc (runs a sub-block
+with a nested Executor per iteration) and conditional_block_op.cc. TPU
+redesign: the sub-block's ops are traced into a lax.while_loop body /
+lax.cond branches — compiler-friendly structured control flow instead of a
+host interpreter loop, so the whole loop lives inside the single XLA
+computation.
+
+Carried state = every var written in the sub-block that was defined outside
+it (same liveness rule the reference's while_op uses to decide what
+persists across step scopes). Shapes must be loop-invariant (XLA).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_macro_op, lower_op, LowerContext
+
+
+def _carry_names(sub_block, env):
+    """Vars written in the sub-block that already exist in the outer env."""
+    written = []
+    seen = set()
+    for op in sub_block.ops:
+        for n in op.output_names():
+            if n in env and n not in seen:
+                seen.add(n)
+                written.append(n)
+    return written
+
+
+def _run_block(sub_block, env, ctx):
+    for op in sub_block.ops:
+        lower_op(ctx, op, env)
+
+
+@register_macro_op("while")
+def _while(ctx, op, env):
+    program = op.block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    cond_name = op.input("Condition")[0]
+    carry = _carry_names(sub, env)
+    if cond_name not in carry:
+        carry = carry + [cond_name]
+
+    init = {n: env[n] for n in carry}
+    init["@iter@"] = jnp.zeros((), jnp.int32)
+    base_key = ctx.rng()
+
+    def cond_fn(c):
+        return jnp.asarray(c[cond_name]).reshape(()).astype(jnp.bool_)
+
+    def body_fn(c):
+        body_env = dict(env)
+        body_env.update({k: v for k, v in c.items() if k != "@iter@"})
+        body_ctx = LowerContext(is_test=ctx.is_test, mesh=ctx.mesh)
+        # per-iteration rng stream keyed on the loop counter
+        body_ctx._rng_key = jax.random.fold_in(base_key, c["@iter@"])
+        _run_block(sub, body_env, body_ctx)
+        out = {n: body_env[n] for n in carry}
+        out["@iter@"] = c["@iter@"] + 1
+        return out
+
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n in carry:
+        env[n] = final[n]
+
+
+@register_macro_op("cond_block")
+def _cond_block(ctx, op, env):
+    """Two-branch conditional: attrs sub_block_t / sub_block_f; outputs Out
+    are filled from attr-listed branch result names (true_rets/false_rets)."""
+    program = op.block.program
+    tb = program.blocks[op.attrs["sub_block_t"]]
+    fb = program.blocks[op.attrs["sub_block_f"]]
+    pred = jnp.asarray(env[op.input("Cond")[0]]).reshape(()).astype(
+        jnp.bool_)
+    t_rets = op.attrs["true_rets"]
+    f_rets = op.attrs["false_rets"]
+    out_names = op.output("Out")
+
+    def make_branch(block, rets):
+        def branch(_):
+            benv = dict(env)
+            bctx = LowerContext(rng_key=ctx.rng() if not ctx.abstract
+                                else None,
+                                is_test=ctx.is_test, mesh=ctx.mesh)
+            _run_block(block, benv, bctx)
+            return [benv[r] for r in rets]
+        return branch
+
+    outs = jax.lax.cond(pred, make_branch(tb, t_rets),
+                        make_branch(fb, f_rets), operand=None)
+    for n, v in zip(out_names, outs):
+        env[n] = v

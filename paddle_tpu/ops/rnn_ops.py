@@ -1,0 +1,134 @@
+"""Recurrent ops lowered to lax.scan — differentiable, static-shape.
+
+Reference: operators/lstm_op.cc + math/lstm_compute (gate order i,c,f,o in
+paddle; here documented i,f,c,o), gru_op.cc, cudnn_lstm_op.cu.cc. TPU
+redesign: the whole sequence recurrence is ONE lax.scan per layer — XLA
+unrolls/pipelines it; reverse-mode AD through scan gives the BPTT gradients
+the reference hand-writes.
+
+Dense layout: [batch, seq, feat] + optional SequenceLength [batch] mask
+(replaces LoD ragged batching). Beyond a sequence's length, state carries
+through FROZEN — Hidden[t >= len] repeats the last valid hidden state, so
+LastH/LastC and last-step pooling are correct without extra gathers; mask
+the output (sequence_unpad / sequence_mask) if zeros are needed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("dynamic_lstm", no_grad_inputs={"SequenceLength"},
+             non_diff_outputs={"LastH", "LastC"})
+def _dynamic_lstm(ctx, ins, attrs):
+    """Input: pre-projected gates [b, s, 4h] (x @ Wx done by an fc outside,
+    as in the reference's dynamic_lstm); Weight [h, 4h] recurrent; Bias
+    [1, 4h]. Gate order i, f, c, o. Outputs Hidden [b, s, h], Cell."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+    b, s, four_h = x.shape
+    h_size = four_h // 4
+    lengths = ins["SequenceLength"][0] if "SequenceLength" in ins else None
+
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((b, h_size), x.dtype)
+    c0 = ins["C0"][0] if "C0" in ins else jnp.zeros((b, h_size), x.dtype)
+
+    use_peepholes = attrs.get("use_peepholes", False)
+    if use_peepholes:
+        raise NotImplementedError("peephole lstm TBD")
+
+    xs = jnp.swapaxes(x, 0, 1)  # [s, b, 4h]
+
+    def step(carry, inp):
+        h, c, t = carry
+        gates = inp + h @ w
+        if bias is not None:
+            gates = gates + bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if lengths is not None:
+            m = (t < lengths).astype(x.dtype)[:, None]
+            c_new = m * c_new + (1 - m) * c
+            h_new = m * h_new + (1 - m) * h
+        return (h_new, c_new, t + 1), (h_new, c_new)
+
+    (h_last, c_last, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0, jnp.zeros((), jnp.int32)), xs)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell],
+            "LastH": [h_last], "LastC": [c_last]}
+
+
+@register_op("dynamic_gru", no_grad_inputs={"SequenceLength"},
+             non_diff_outputs={"LastH"})
+def _dynamic_gru(ctx, ins, attrs):
+    """Input [b, s, 3h] pre-projected; Weight [h, 3h] packed as
+    [update|reset | candidate]; gate order u, r, c (reference gru_op.cc)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+    b, s, three_h = x.shape
+    h_size = three_h // 3
+    lengths = ins["SequenceLength"][0] if "SequenceLength" in ins else None
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((b, h_size), x.dtype)
+
+    w_ur = w[:, :2 * h_size]
+    w_c = w[:, 2 * h_size:]
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, inp):
+        h, t = carry
+        x_ur = inp[:, :2 * h_size]
+        x_c = inp[:, 2 * h_size:]
+        ur = x_ur + h @ w_ur
+        if bias is not None:
+            ur = ur + bias[:2 * h_size]
+        u, r = jnp.split(jax.nn.sigmoid(ur), 2, axis=-1)
+        cand = x_c + (r * h) @ w_c
+        if bias is not None:
+            cand = cand + bias[2 * h_size:]
+        cand = jnp.tanh(cand)
+        h_new = u * h + (1 - u) * cand
+        if lengths is not None:
+            m = (t < lengths).astype(x.dtype)[:, None]
+            h_new = m * h_new + (1 - m) * h
+        return (h_new, t + 1), h_new
+
+    (h_last, _), hs = jax.lax.scan(step, (h0, jnp.zeros((), jnp.int32)), xs)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register_op("simple_rnn", no_grad_inputs={"SequenceLength"},
+             non_diff_outputs={"LastH"})
+def _simple_rnn(ctx, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+    b, s, h_size = x.shape
+    lengths = ins["SequenceLength"][0] if "SequenceLength" in ins else None
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((b, h_size), x.dtype)
+    act = attrs.get("activation", "tanh")
+    actf = jnp.tanh if act == "tanh" else jax.nn.relu
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, inp):
+        h, t = carry
+        pre = inp + h @ w
+        if bias is not None:
+            pre = pre + bias
+        h_new = actf(pre)
+        if lengths is not None:
+            m = (t < lengths).astype(x.dtype)[:, None]
+            h_new = m * h_new + (1 - m) * h
+        return (h_new, t + 1), h_new
+
+    (h_last, _), hs = jax.lax.scan(step, (h0, jnp.zeros((), jnp.int32)), xs)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}

@@ -1,0 +1,171 @@
+"""Sequence ops on the dense [batch, seq, ...] + lengths representation.
+
+Reference: operators/sequence_ops/ (5.3k LoC over LoD ragged tensors,
+lod_tensor.h:104). TPU redesign: XLA needs static shapes, so ragged
+sequences become padded dense tensors + a lengths vector; every LoD op maps
+to a masked dense op (SURVEY.md §7.3 "LoD/ragged via dense padding").
+sequence_mask is the bridge: lengths -> mask.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("sequence_mask", not_differentiable=True)
+def _sequence_mask(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_mask_op.cc"""
+    x = ins["X"][0].reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask requires a static maxlen on TPU")
+    steps = jnp.arange(maxlen)
+    mask = (steps[None, :] < x[:, None])
+    return {"Y": [mask.astype(attrs.get("out_dtype", "float32"))]}
+
+
+def _len_mask(ins, x, dtype=None):
+    """[b, s, 1...] mask from optional Length input."""
+    if "Length" not in ins:
+        return None
+    ln = ins["Length"][0].reshape(-1)
+    s = x.shape[1]
+    m = (jnp.arange(s)[None, :] < ln[:, None])
+    extra = x.ndim - 2
+    m = m.reshape(m.shape + (1,) * extra)
+    return m
+
+
+@register_op("sequence_pool", no_grad_inputs={"Length"},
+             non_diff_outputs={"MaxIndex"})
+def _sequence_pool(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_pool_op.cc — types sum/average/
+    sqrt/max/last/first over each sequence."""
+    x = ins["X"][0]  # [b, s, d...]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _len_mask(ins, x)
+    ln = (ins["Length"][0].reshape(-1).astype(x.dtype)
+          if "Length" in ins else
+          jnp.full((x.shape[0],), x.shape[1], x.dtype))
+    extra = x.ndim - 2
+    ln_b = ln.reshape((-1,) + (1,) * extra)
+    if ptype in ("SUM", "AVERAGE", "SQRT"):
+        xm = x if m is None else x * m.astype(x.dtype)
+        tot = jnp.sum(xm, axis=1)
+        if ptype == "SUM":
+            out = tot
+        elif ptype == "AVERAGE":
+            out = tot / jnp.maximum(ln_b, 1)
+        else:
+            out = tot / jnp.sqrt(jnp.maximum(ln_b, 1))
+    elif ptype == "MAX":
+        xm = x if m is None else jnp.where(m, x, -jnp.inf)
+        out = jnp.max(xm, axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(ln - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * extra).astype(jnp.int32),
+            axis=1).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool type {ptype}")
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", no_grad_inputs={"Length"})
+def _sequence_softmax(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_softmax_op.cc — softmax over each
+    sequence's valid positions."""
+    x = ins["X"][0]  # [b, s]
+    m = _len_mask(ins, x[..., None])
+    if m is not None:
+        x = jnp.where(m.squeeze(-1), x, -1e30)
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=1).astype(x.dtype)
+    if m is not None:
+        out = out * m.squeeze(-1).astype(x.dtype)
+    return {"Out": [out]}
+
+
+@register_op("sequence_reverse", no_grad_inputs={"Length"})
+def _sequence_reverse(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_reverse_op.cc — reverse each
+    sequence's valid prefix, keep padding in place."""
+    x = ins["X"][0]
+    s = x.shape[1]
+    if "Length" not in ins:
+        return {"Y": [jnp.flip(x, axis=1)]}
+    ln = ins["Length"][0].reshape(-1)
+    steps = jnp.arange(s)[None, :]
+    idx = jnp.where(steps < ln[:, None], ln[:, None] - 1 - steps, steps)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    return {"Y": [out]}
+
+
+@register_op("sequence_expand", no_grad_inputs={"Y"})
+def _sequence_expand(ctx, ins, attrs):
+    """Dense analog: broadcast per-sequence vector [b, d] across steps to
+    [b, s, d] where s comes from the reference input Y [b, s, ...]."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    s = y.shape[1]
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], s)
+                                     + x.shape[1:])]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@register_op("sequence_slice", no_grad_inputs={"Offset", "Length"})
+def _sequence_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    off = int(attrs.get("offset", 0))
+    ln = int(attrs["length"])
+    return {"Out": [x[:, off:off + ln]]}
+
+
+@register_op("sequence_pad", no_grad_inputs={"PadValue", "Length"},
+             non_diff_outputs={"Length"})
+def _sequence_pad(ctx, ins, attrs):
+    # dense rep is already padded; pass through with lengths
+    x = ins["X"][0]
+    ln = (ins["Length"][0] if "Length" in ins
+          else jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+    return {"Out": [x], "Length": [ln]}
+
+
+@register_op("sequence_unpad", no_grad_inputs={"Length"})
+def _sequence_unpad(ctx, ins, attrs):
+    # dense rep stays padded; mask invalid steps to zero
+    x = ins["X"][0]
+    m = _len_mask(ins, x)
+    return {"Out": [x if m is None else x * m.astype(x.dtype)]}
+
+
+@register_op("sequence_enumerate", not_differentiable=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    x = ins["X"][0]  # [b, s] int ids
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    b, s = x.shape
+    cols = []
+    for k in range(win):
+        shifted = jnp.concatenate(
+            [x[:, k:], jnp.full((b, k), pad, x.dtype)], axis=1)
+        cols.append(shifted)
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register_op("sequence_erase", not_differentiable=True)
+def _sequence_erase(ctx, ins, attrs):
+    """Dense analog: replace erased tokens with pad (0) instead of
+    compacting (static shapes)."""
+    x = ins["X"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    hit = jnp.isin(x, tokens)
+    return {"Out": [jnp.where(hit, jnp.zeros((), x.dtype), x)]}

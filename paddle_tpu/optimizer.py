@@ -24,7 +24,8 @@ __all__ = [
     "AdagradOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
     "Adadelta", "AdadeltaOptimizer", "Adamax", "AdamaxOptimizer", "RMSProp",
     "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
-    "LarsMomentum", "LarsMomentumOptimizer",
+    "LarsMomentum", "LarsMomentumOptimizer", "ExponentialMovingAverage",
+    "ModelAverage",
 ]
 
 
@@ -395,6 +396,192 @@ class FtrlOptimizer(Optimizer):
              "LinearAccumOut": [a["linear"][p.name].name]},
             {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
             infer_shape=False)
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable params (reference: optimizer.py:2435). update() is
+    appended into the training program (runs on device inside the same XLA
+    step); apply()/restore() swap scope values host-side."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._shadows = {}  # param name -> shadow var name
+        self._backup = {}
+
+    def update(self, program: Optional[Program] = None,
+               startup: Optional[Program] = None):
+        program = program or default_main_program()
+        startup = startup or default_startup_program()
+        blk = program.global_block
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            sname = unique_name(f"{self._name}/{p.name}")
+            blk.create_var(name=sname, shape=p.shape, dtype=p.dtype,
+                           persistable=True, stop_gradient=True)
+            sb = startup.global_block
+            sb.create_var(name=sname, shape=p.shape, dtype=p.dtype,
+                          persistable=True, stop_gradient=True)
+            # shadow starts at the initial param value
+            sb.append_op("assign", {"X": [p.name]}, {"Out": [sname]},
+                         infer_shape=False)
+            # shadow = decay*shadow + (1-decay)*param
+            scaled_s = unique_name(f"{self._name}/tmp")
+            blk.create_var(name=scaled_s, shape=p.shape, dtype=p.dtype)
+            blk.append_op("scale", {"X": [sname]}, {"Out": [scaled_s]},
+                          {"scale": self._decay, "op_role": "optimize"},
+                          infer_shape=False)
+            scaled_p = unique_name(f"{self._name}/tmp")
+            blk.create_var(name=scaled_p, shape=p.shape, dtype=p.dtype)
+            blk.append_op("scale", {"X": [p.name]}, {"Out": [scaled_p]},
+                          {"scale": 1.0 - self._decay,
+                           "op_role": "optimize"}, infer_shape=False)
+            blk.append_op("sum", {"X": [scaled_s, scaled_p]},
+                          {"Out": [sname]}, {"op_role": "optimize"},
+                          infer_shape=False)
+            self._shadows[p.name] = sname
+
+    def apply(self, executor=None, need_restore=True):
+        from .framework.executor import global_scope
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            scope = global_scope()
+            self._backup = {p: scope.find_var(p) for p in self._shadows}
+            for p, s in self._shadows.items():
+                sv = scope.find_var(s)
+                if sv is not None:
+                    scope.set_var(p, sv)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        for p, v in self._backup.items():
+            scope.set_var(p, v)
+        self._backup = {}
+
+
+class ModelAverage:
+    """Windowed parameter average (reference: optimizer.py:2245). The
+    accumulation restarts whenever the window exceeds max_average_window
+    (the reference's restart semantics, without its 3-tier sum cascade):
+    sum/cnt reset to the current param once cnt reaches the cap, so apply()
+    averages at most the last max_average_window steps."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._name = name or "model_average"
+        self._max_window = float(max_average_window)
+        self._sums = {}
+        self._cnt_name = None
+        self._backup = {}
+
+    def _build(self, program, startup):
+        blk = program.global_block
+        sb = startup.global_block
+
+        def _pvar(name, shape, fill):
+            blk.create_var(name=name, shape=shape, dtype="float32",
+                           persistable=True, stop_gradient=True)
+            sb.create_var(name=name, shape=shape, dtype="float32",
+                          persistable=True, stop_gradient=True)
+            sb.append_op("fill_constant", {}, {"Out": [name]},
+                         {"shape": list(shape), "dtype": "float32",
+                          "value": fill}, infer_shape=False)
+
+        self._cnt_name = unique_name(f"{self._name}/cnt")
+        _pvar(self._cnt_name, (1,), 0.0)
+        # restart flag: cnt >= max_window
+        cap = unique_name(f"{self._name}/cap")
+        blk.create_var(name=cap, shape=(1,), dtype="float32",
+                       stop_gradient=True)
+        blk.append_op("fill_constant", {}, {"Out": [cap]},
+                      {"shape": [1], "dtype": "float32",
+                       "value": self._max_window, "op_role": "optimize"},
+                      infer_shape=False)
+        restart = unique_name(f"{self._name}/restart")
+        blk.create_var(name=restart, shape=(1,), dtype="bool",
+                       stop_gradient=True)
+        blk.append_op("greater_equal",
+                      {"X": [self._cnt_name], "Y": [cap]},
+                      {"Out": [restart]}, {"op_role": "optimize"},
+                      infer_shape=False)
+        one = unique_name(f"{self._name}/one")
+        blk.create_var(name=one, shape=(1,), dtype="float32",
+                       stop_gradient=True)
+        blk.append_op("fill_constant", {}, {"Out": [one]},
+                      {"shape": [1], "dtype": "float32", "value": 1.0,
+                       "op_role": "optimize"}, infer_shape=False)
+        nxt = unique_name(f"{self._name}/next_cnt")
+        blk.create_var(name=nxt, shape=(1,), dtype="float32",
+                       stop_gradient=True)
+        blk.append_op("sum", {"X": [self._cnt_name, one]}, {"Out": [nxt]},
+                      {"op_role": "optimize"}, infer_shape=False)
+        blk.append_op("where",
+                      {"Condition": [restart], "X": [one], "Y": [nxt]},
+                      {"Out": [self._cnt_name]}, {"op_role": "optimize"},
+                      infer_shape=False)
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            sname = unique_name(f"{self._name}/{p.name}/sum")
+            _pvar(sname, tuple(p.shape), 0.0)
+            acc = unique_name(f"{self._name}/acc")
+            blk.create_var(name=acc, shape=p.shape, dtype="float32",
+                           stop_gradient=True)
+            blk.append_op("sum", {"X": [sname, p.name]}, {"Out": [acc]},
+                          {"op_role": "optimize"}, infer_shape=False)
+            # on restart the window begins again at the current param
+            blk.append_op("where",
+                          {"Condition": [restart], "X": [p.name],
+                           "Y": [acc]},
+                          {"Out": [sname]}, {"op_role": "optimize"},
+                          infer_shape=False)
+            self._sums[p.name] = sname
+
+    def update(self, program=None, startup=None):
+        self._build(program or default_main_program(),
+                    startup or default_startup_program())
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+        import numpy as np
+        from .framework.executor import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            import jax.numpy as jnp
+            scope = global_scope()
+            cnt = float(np.asarray(scope.find_var(self._cnt_name))[0])
+            self._backup = {p: scope.find_var(p) for p in self._sums}
+            for p, s in self._sums.items():
+                sv = scope.find_var(s)
+                pv = self._backup[p]
+                scope.set_var(p, (jnp.asarray(sv) / max(cnt, 1.0)).astype(
+                    jnp.asarray(pv).dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        for p, v in self._backup.items():
+            scope.set_var(p, v)
+        self._backup = {}
 
 
 # short aliases matching paddle 2.x style

@@ -108,5 +108,5 @@ class ShardingPlan:
         return jax.jit(
             fn,
             in_shardings=(mut_sh, ro_sh, feed_sh, rep),
-            out_shardings=(out_sh, None, rep),
+            out_shardings=(out_sh, None, rep, None),
             donate_argnums=(0,))

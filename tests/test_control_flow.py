@@ -1,0 +1,105 @@
+"""Control flow: While / while_loop / cond / Switch
+(reference: test_while_op.py, test_cond.py, test_switch.py)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+class TestWhile(unittest.TestCase):
+    def test_classic_while_sums_to_ten(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = pt.layers.fill_constant([1], "int32", 0)
+            i.stop_gradient = True
+            limit = pt.layers.fill_constant([1], "int32", 10)
+            total = pt.layers.fill_constant([1], "float32", 0.0)
+            cond_v = pt.layers.less_than(i, limit)
+            w = pt.layers.While(cond_v)
+            with w.block():
+                new_total = pt.layers.elementwise_add(
+                    total, pt.layers.cast(i, "float32"))
+                pt.layers.assign(new_total, output=total)
+                pt.layers.assign(
+                    pt.layers.elementwise_add(
+                        i, pt.layers.fill_constant([1], "int32", 1)),
+                    output=i)
+                pt.layers.assign(pt.layers.less_than(i, limit),
+                                 output=cond_v)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            t, iv = exe.run(main, feed={}, fetch_list=[total, i])
+        self.assertEqual(float(t[0]), sum(range(10)))
+        self.assertEqual(int(iv[0]), 10)
+
+    def test_while_loop_functional(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.fill_constant([1], "float32", 1.0)
+
+            def cond_fn(v):
+                return pt.layers.less_than(
+                    v, pt.layers.fill_constant([1], "float32", 100.0))
+
+            def body_fn(v):
+                return pt.layers.scale(v, scale=2.0)
+
+            out, = pt.layers.while_loop(cond_fn, body_fn, [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            v, = exe.run(main, feed={}, fetch_list=[out])
+        self.assertEqual(float(v[0]), 128.0)
+
+
+class TestCond(unittest.TestCase):
+    def test_cond_branches(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            pred = pt.layers.greater_than(
+                pt.layers.reduce_sum(x),
+                pt.layers.fill_constant([1], "float32", 0.0))
+            out = pt.layers.cond(
+                pred,
+                lambda: pt.layers.scale(x, scale=2.0),
+                lambda: pt.layers.scale(x, scale=-1.0))
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            a, = exe.run(main, feed={"x": np.array([3.0], "f")},
+                         fetch_list=[out])
+            b, = exe.run(main, feed={"x": np.array([-3.0], "f")},
+                         fetch_list=[out])
+        self.assertEqual(float(a[0]), 6.0)
+        self.assertEqual(float(b[0]), 3.0)
+
+
+class TestSwitch(unittest.TestCase):
+    def test_switch_lr_style(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            step = pt.layers.data("step", [1], append_batch_size=False)
+            lr = pt.layers.fill_constant([1], "float32", 0.0)
+            b1 = pt.layers.fill_constant([1], "float32", 10.0)
+            with pt.layers.Switch() as sw:
+                with sw.case(pt.layers.less_than(step, b1)):
+                    pt.layers.assign(
+                        pt.layers.fill_constant([1], "float32", 0.1),
+                        output=lr)
+                with sw.default():
+                    pt.layers.assign(
+                        pt.layers.fill_constant([1], "float32", 0.01),
+                        output=lr)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            a, = exe.run(main, feed={"step": np.array([5.0], "f")},
+                         fetch_list=[lr])
+            b, = exe.run(main, feed={"step": np.array([50.0], "f")},
+                         fetch_list=[lr])
+        self.assertAlmostEqual(float(a[0]), 0.1, places=6)
+        self.assertAlmostEqual(float(b[0]), 0.01, places=6)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,195 @@
+"""RNN + sequence op tests vs numpy references
+(reference: test_lstm_op.py, test_gru_op.py, test_sequence_* tests)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestDynamicLSTM(unittest.TestCase):
+    def test_matches_numpy(self):
+        b, s, h = 2, 5, 4
+        rng = np.random.RandomState(3)
+        x = rng.randn(b, s, 4 * h).astype("f") * 0.5
+        w = rng.randn(h, 4 * h).astype("f") * 0.5
+        bias = rng.randn(1, 4 * h).astype("f") * 0.1
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [s, 4 * h])
+            hidden, cell = pt.layers.dynamic_lstm(
+                xv, 4 * h,
+                param_attr=pt.ParamAttr(
+                    name="w",
+                    initializer=pt.initializer.NumpyArrayInitializer(w)),
+                bias_attr=pt.ParamAttr(
+                    name="b",
+                    initializer=pt.initializer.NumpyArrayInitializer(bias)))
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            hv, cv = exe.run(main, feed={"x": x},
+                             fetch_list=[hidden, cell])
+
+        # numpy reference
+        hh = np.zeros((b, h), "f")
+        cc = np.zeros((b, h), "f")
+        ref_h = np.zeros((b, s, h))
+        for t in range(s):
+            gates = x[:, t] + hh @ w + bias[0]
+            i, f, g, o = np.split(gates, 4, axis=-1)
+            i, f, o = sigmoid(i), sigmoid(f), sigmoid(o)
+            g = np.tanh(g)
+            cc = f * cc + i * g
+            hh = o * np.tanh(cc)
+            ref_h[:, t] = hh
+        np.testing.assert_allclose(hv, ref_h, rtol=1e-4, atol=1e-5)
+
+    def test_lengths_freeze_state(self):
+        b, s, h = 2, 6, 3
+        rng = np.random.RandomState(4)
+        x = rng.randn(b, s, 4 * h).astype("f")
+        lens = np.array([3, 6], np.int64)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [s, 4 * h])
+            lv = pt.layers.data("lens", [], dtype="int64")
+            hidden, cell = pt.layers.dynamic_lstm(xv, 4 * h,
+                                                  sequence_length=lv)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            hv, = exe.run(main, feed={"x": x, "lens": lens},
+                          fetch_list=[hidden])
+        # beyond length, hidden stays frozen at the last valid value
+        np.testing.assert_allclose(hv[0, 3], hv[0, 2], atol=1e-6)
+        np.testing.assert_allclose(hv[0, 5], hv[0, 2], atol=1e-6)
+
+    def test_grad_flows(self):
+        b, s, h = 2, 4, 3
+        rng = np.random.RandomState(5)
+        x = rng.randn(b, s, 4 * h).astype("f") * 0.3
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [s, 4 * h], stop_gradient=False)
+            hidden, cell = pt.layers.dynamic_lstm(xv, 4 * h)
+            loss = pt.layers.mean(hidden)
+        grads = pt.gradients([loss], [xv])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            g, = exe.run(main, feed={"x": x}, fetch_list=[grads[0]])
+        self.assertEqual(g.shape, x.shape)
+        self.assertGreater(np.abs(g).max(), 0)
+
+
+class TestDynamicGRU(unittest.TestCase):
+    def test_matches_numpy(self):
+        b, s, h = 2, 4, 3
+        rng = np.random.RandomState(6)
+        x = rng.randn(b, s, 3 * h).astype("f") * 0.5
+        w = rng.randn(h, 3 * h).astype("f") * 0.5
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [s, 3 * h])
+            hidden = pt.layers.dynamic_gru(
+                xv, h,
+                param_attr=pt.ParamAttr(
+                    name="w",
+                    initializer=pt.initializer.NumpyArrayInitializer(w)),
+                bias_attr=False)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            hv, = exe.run(main, feed={"x": x}, fetch_list=[hidden])
+
+        hh = np.zeros((b, h), "f")
+        ref = np.zeros((b, s, h))
+        w_ur, w_c = w[:, :2 * h], w[:, 2 * h:]
+        for t in range(s):
+            x_ur, x_c = x[:, t, :2 * h], x[:, t, 2 * h:]
+            ur = sigmoid(x_ur + hh @ w_ur)
+            u, r = np.split(ur, 2, axis=-1)
+            cand = np.tanh(x_c + (r * hh) @ w_c)
+            hh = u * hh + (1 - u) * cand
+            ref[:, t] = hh
+        np.testing.assert_allclose(hv, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestSequenceOps(unittest.TestCase):
+    def _run(self, build):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            fetches, feed = build()
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            return exe.run(main, feed=feed, fetch_list=fetches)
+
+    def test_sequence_mask(self):
+        def build():
+            ln = pt.layers.data("ln", [], dtype="int64")
+            m = pt.layers.sequence_mask(ln, maxlen=5)
+            return [m], {"ln": np.array([2, 5, 0], np.int64)}
+
+        m, = self._run(build)
+        np.testing.assert_array_equal(
+            m, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1], [0, 0, 0, 0, 0]])
+
+    def test_sequence_pool_types(self):
+        x = np.arange(24, dtype="f").reshape(2, 3, 4)
+        lens = np.array([2, 3], np.int64)
+
+        def build():
+            xv = pt.layers.data("x", [3, 4])
+            lv = pt.layers.data("ln", [], dtype="int64")
+            outs = [pt.layers.sequence_pool(xv, t, lengths=lv)
+                    for t in ("sum", "average", "max", "last", "first")]
+            return outs, {"x": x, "ln": lens}
+
+        s, a, mx, last, first = self._run(build)
+        np.testing.assert_allclose(s[0], x[0, :2].sum(0))
+        np.testing.assert_allclose(a[1], x[1].mean(0))
+        np.testing.assert_allclose(mx[0], x[0, :2].max(0))
+        np.testing.assert_allclose(last[0], x[0, 1])
+        np.testing.assert_allclose(first[1], x[1, 0])
+
+    def test_sequence_softmax_masks(self):
+        x = np.random.RandomState(0).randn(2, 4).astype("f")
+        lens = np.array([2, 4], np.int64)
+
+        def build():
+            xv = pt.layers.data("x", [4])
+            lv = pt.layers.data("ln", [], dtype="int64")
+            return [pt.layers.sequence_softmax(xv, lengths=lv)], \
+                {"x": x, "ln": lens}
+
+        o, = self._run(build)
+        self.assertAlmostEqual(o[0, :2].sum(), 1.0, places=5)
+        np.testing.assert_allclose(o[0, 2:], 0.0)
+        self.assertAlmostEqual(o[1].sum(), 1.0, places=5)
+
+    def test_sequence_reverse(self):
+        x = np.arange(8, dtype="f").reshape(2, 4)
+        lens = np.array([3, 4], np.int64)
+
+        def build():
+            xv = pt.layers.data("x", [4])
+            lv = pt.layers.data("ln", [], dtype="int64")
+            return [pt.layers.sequence_reverse(xv, lv)], \
+                {"x": x, "ln": lens}
+
+        o, = self._run(build)
+        np.testing.assert_allclose(o[0], [2, 1, 0, 3])
+        np.testing.assert_allclose(o[1], [7, 6, 5, 4])
+
+
+if __name__ == "__main__":
+    unittest.main()
