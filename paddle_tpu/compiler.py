@@ -64,13 +64,16 @@ class CompiledProgram:
         return self
 
     def with_sharding(self, param_shardings: Dict[str, tuple],
-                      mesh_shape=None, axis_names=("dp", "mp")):
+                      mesh_shape=None, axis_names=("dp", "mp"),
+                      feed_shardings: Optional[Dict[str, tuple]] = None):
         """Tensor-parallel / hybrid sharding: map param name -> PartitionSpec
-        tuple over the mesh axes."""
+        tuple over the mesh axes. feed_shardings maps feed name -> spec
+        (e.g. {"src_ids": ("dp", "cp")} for context-parallel sequences)."""
         self._dp = True
         self._param_shardings = dict(param_shardings)
         self._mesh_shape = mesh_shape
         self._axis_names = tuple(axis_names)
+        self._feed_shardings = dict(feed_shardings or {})
         return self
 
     def _plan(self):
@@ -82,5 +85,6 @@ class CompiledProgram:
                 param_shardings=self._param_shardings,
                 mesh_shape=getattr(self, "_mesh_shape", None),
                 axis_names=getattr(self, "_axis_names", ("dp",)),
-                places=self._places)
+                places=self._places,
+                feed_shardings=getattr(self, "_feed_shardings", None))
         return self._plan_obj

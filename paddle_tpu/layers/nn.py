@@ -22,7 +22,7 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
            "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
            "square_error_cost", "huber_loss", "kldiv_loss", "smooth_l1",
            "accuracy", "topk", "one_hot", "lrn", "prelu", "mse_loss",
-           "label_smooth"]
+           "label_smooth", "fused_attention"]
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +266,27 @@ def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
                      {"Y": [y.name], "SavedMean": [m.name],
                       "SavedVariance": [v.name]}, {"epsilon": epsilon})
     return y
+
+
+def fused_attention(q, k, v, bias_k=None, causal=False, sm_scale=0.0,
+                    cp_axis="", seq_parallel="ring", impl="",
+                    batch_axis="dp", name=None):
+    """Fused multi-head attention over (b, s, n, d) q/k/v.
+
+    bias_k: optional (b, s_k) per-key additive bias (attention mask).
+    cp_axis: mesh axis name for context parallelism — 'ring' rotates K/V
+    shards via ppermute, 'ulysses' all-to-alls seq for heads. Lowers to the
+    Pallas flash kernel on TPU (ops/flash_attention.py)."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    ins = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+    if bias_k is not None:
+        ins["BiasK"] = [bias_k.name]
+    helper.append_op("fused_attention", ins, {"Out": [out.name]},
+                     {"causal": causal, "sm_scale": float(sm_scale),
+                      "cp_axis": cp_axis, "seq_parallel": seq_parallel,
+                      "impl": impl, "batch_axis": batch_axis})
+    return out
 
 
 def dropout(x, dropout_prob, is_test=False, seed=None,

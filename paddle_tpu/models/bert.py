@@ -23,7 +23,8 @@ __all__ = ["BertConfig", "bert_encoder", "bert_pretrain_program",
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  ffn=3072, max_pos=512, type_vocab=2, dropout=0.1,
-                 init_range=0.02):
+                 init_range=0.02, attn_impl="einsum", cp_axis="",
+                 seq_parallel="ring"):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -33,13 +34,20 @@ class BertConfig:
         self.type_vocab = type_vocab
         self.dropout = dropout
         self.init_range = init_range
+        # attn_impl: "einsum" (composed graph, supports attn-prob dropout) |
+        # "fused" (flash kernel / ring / ulysses via the fused_attention op;
+        # no attention-prob dropout, as is standard for flash kernels)
+        self.attn_impl = attn_impl
+        self.cp_axis = cp_axis          # mesh axis for context parallelism
+        self.seq_parallel = seq_parallel  # "ring" | "ulysses"
 
 
 def _attr(name, cfg):
     return ParamAttr(name=name, initializer=Normal(0.0, cfg.init_range))
 
 
-def _attention(x, mask_4d, cfg: BertConfig, prefix: str, is_test: bool):
+def _attention(x, mask_4d, mask_k, cfg: BertConfig, prefix: str,
+               is_test: bool):
     b_s_h = x.shape  # (-1, seq, hidden)
     seq = int(b_s_h[1])
     h = cfg.hidden
@@ -58,15 +66,20 @@ def _attention(x, mask_4d, cfg: BertConfig, prefix: str, is_test: bool):
     q = pt.layers.reshape(q, [0, seq, nh, hd])
     k = pt.layers.reshape(k, [0, seq, nh, hd])
     v = pt.layers.reshape(v, [0, seq, nh, hd])
-    q = pt.layers.scale(q, scale=1.0 / math.sqrt(hd))
-
-    scores = pt.layers.einsum("bqnd,bknd->bnqk", q, k)
-    scores = scores + mask_4d  # additive mask, broadcast (b,1,1,s)
-    probs = pt.layers.softmax(scores, axis=-1)
-    if cfg.dropout > 0:
-        probs = pt.layers.dropout(probs, cfg.dropout, is_test=is_test,
-                                  dropout_implementation="upscale_in_train")
-    ctx = pt.layers.einsum("bnqk,bknd->bqnd", probs, v)
+    if cfg.attn_impl == "fused":
+        ctx = pt.layers.fused_attention(
+            q, k, v, bias_k=mask_k, sm_scale=1.0 / math.sqrt(hd),
+            cp_axis=cfg.cp_axis, seq_parallel=cfg.seq_parallel)
+    else:
+        q = pt.layers.scale(q, scale=1.0 / math.sqrt(hd))
+        scores = pt.layers.einsum("bqnd,bknd->bnqk", q, k)
+        scores = scores + mask_4d  # additive mask, broadcast (b,1,1,s)
+        probs = pt.layers.softmax(scores, axis=-1)
+        if cfg.dropout > 0:
+            probs = pt.layers.dropout(
+                probs, cfg.dropout, is_test=is_test,
+                dropout_implementation="upscale_in_train")
+        ctx = pt.layers.einsum("bnqk,bknd->bqnd", probs, v)
     ctx = pt.layers.reshape(ctx, [0, seq, h])
     out = pt.layers.fc(ctx, h, num_flatten_dims=2,
                        param_attr=_attr(f"{prefix}/out.w", cfg),
@@ -117,11 +130,14 @@ def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
     # additive attention mask (b,1,1,s): 0 keep, -1e4 drop
     m = pt.layers.reshape(input_mask, [0, 1, 1, seq])
     neg = pt.layers.scale(m, scale=1e4, bias=-1e4)  # mask=1 -> 0, 0 -> -1e4
+    # per-key variant (b, s) for the fused/ring path
+    neg_k = (pt.layers.scale(input_mask, scale=1e4, bias=-1e4)
+             if cfg.attn_impl == "fused" else None)
 
     x = emb
     for i in range(cfg.layers):
         p = f"{prefix}/l{i}"
-        att = _attention(x, neg, cfg, p, is_test)
+        att = _attention(x, neg, neg_k, cfg, p, is_test)
         x = _ln(x + att, f"{p}/ln1")
         ff = _ffn(x, cfg, p)
         x = _ln(x + ff, f"{p}/ln2")

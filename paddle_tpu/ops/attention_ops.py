@@ -1,0 +1,72 @@
+"""fused_attention program op: flash kernel / ring / Ulysses dispatch.
+
+The program-IR face of the attention stack (flash_attention.py + parallel/
+ring.py). Replaces the reference's composed attention graphs (nets.py
+scaled_dot_product_attention) and the operators/fused/ family with one op
+whose lowering picks the right TPU implementation:
+
+  * no cp_axis          -> Pallas flash kernel on TPU, XLA reference on CPU
+  * cp_axis + 'ring'    -> ring attention over the mesh axis (ppermute)
+  * cp_axis + 'ulysses' -> all-to-all sequence parallelism
+
+Inputs  Q/K/V: (b, s, n, d); BiasK (optional): (b, s_k) per-key additive.
+Attrs   causal, sm_scale (0 = 1/sqrt(d)), cp_axis, seq_parallel, impl.
+"""
+
+import numpy as np
+
+from ..framework.registry import register_op
+
+__all__ = []
+
+
+@register_op("fused_attention", no_grad_inputs={"BiasK"})
+def _fused_attention(ctx, ins, attrs):
+    from .flash_attention import attention
+    from ..parallel.ring import (ring_attention_sharded,
+                                 ulysses_attention_sharded)
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias_k = ins.get("BiasK", [None])[0]
+    causal = bool(attrs.get("causal", False))
+    sm_scale = float(attrs.get("sm_scale", 0.0)) or None
+    cp_axis = attrs.get("cp_axis", "")
+    mode = attrs.get("seq_parallel", "ring")
+    impl = attrs.get("impl", None) or None
+
+    mesh = ctx.mesh
+    if cp_axis and mesh is not None and cp_axis in mesh.axis_names \
+            and mesh.shape[cp_axis] > 1:
+        import functools
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        if mode == "ulysses":
+            fn = functools.partial(ulysses_attention_sharded,
+                                   axis_name=cp_axis, causal=causal,
+                                   sm_scale=sm_scale, impl=impl)
+        else:
+            fn = functools.partial(ring_attention_sharded,
+                                   axis_name=cp_axis, causal=causal,
+                                   sm_scale=sm_scale)
+        # shard batch over the dp axis too (hybrid dp x cp meshes would
+        # otherwise all-gather the global batch onto every dp rank)
+        batch_axis = attrs.get("batch_axis", "dp")
+        ba = batch_axis if (batch_axis in mesh.axis_names
+                            and batch_axis != cp_axis
+                            and mesh.shape[batch_axis] > 1
+                            and q.shape[0] % mesh.shape[batch_axis] == 0) \
+            else None
+        spec = P(ba, cp_axis, None, None)
+        bspec = P(ba, cp_axis) if bias_k is not None else None
+        out = jax.shard_map(
+            lambda a, b, c, d: fn(a, b, c, d),
+            mesh=mesh, in_specs=(spec, spec, spec, bspec),
+            out_specs=spec, check_vma=False)(q, k, v, bias_k)
+        return {"Out": [out]}
+
+    bias4 = None
+    if bias_k is not None:
+        bias4 = bias_k[:, None, None, :]
+    return {"Out": [attention(q, k, v, bias4, causal=causal,
+                              sm_scale=sm_scale, impl=impl)]}

@@ -1,0 +1,505 @@
+"""Fused multi-head attention: Pallas TPU flash kernel + XLA reference.
+
+The TPU-native replacement for the reference's composed attention
+(python/paddle/fluid/nets.py scaled_dot_product_attention: matmul + scale +
+softmax + dropout + matmul, materialising the (s, s) score matrix in HBM)
+and for the operators/fused/ fusion-op family: one online-softmax kernel that
+keeps scores in VMEM, O(s) memory, with a custom VJP whose backward is also
+a Pallas kernel.
+
+Layout is (batch, seq, heads, head_dim) end-to-end — no transposes around
+the kernel. Row statistics (m, l, lse, delta) are stored lane-padded to 128
+(Mosaic tiling requires the last dim be a lane multiple or the full array
+dim). `attention()` dispatches: Pallas on TPU backends, the einsum
+reference elsewhere (CPU tests) or when shapes are tiny/unaligned.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["attention", "flash_attention", "mha_reference"]
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def mha_reference(q, k, v, bias=None, causal: bool = False,
+                  sm_scale: Optional[float] = None):
+    """Plain-XLA attention. q: (b, sq, n, d); k/v: (b, sk, n, d);
+    bias: additive, broadcastable to (b, n, sq, sk). Returns (b, sq, n, d)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(qi[None, None] >= ki[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", p, v)
+
+
+def _lanes_to(x, n):
+    """(rows, 128) all-lanes-equal -> (rows, n)."""
+    if n == _LANES:
+        return x
+    if n < _LANES:
+        return x[:, :n]
+    assert n % _LANES == 0
+    return jnp.tile(x, (1, n // _LANES))
+
+
+def _masked_scores(q, k, b_ref, k_idx, q_idx, block_q, block_k, kv_len,
+                   sm_scale, causal):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if b_ref is not None:
+        s = s + b_ref[0].astype(jnp.float32)       # (1, block_k) broadcast
+    col = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+           + k_idx * block_k)
+    s = jnp.where(col < kv_len, s, _NEG_INF)       # mask kv padding
+    if causal:
+        row = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+               + q_idx * block_q)
+        s = jnp.where(row >= col, s, _NEG_INF)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal,
+                block_q, block_k, kv_len):
+    from jax.experimental import pallas as pl
+
+    q_idx, k_idx = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    d = q_ref.shape[-1]
+
+    @pl.when(k_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        s = _masked_scores(q_ref[0], k_ref[0], b_ref, k_idx, q_idx,
+                           block_q, block_k, kv_len, sm_scale, causal)
+        m_prev, l_prev = m_scr[:], l_scr[:]          # (block_q, 128)
+        m_curr = jnp.max(s, axis=1)[:, None]         # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_curr)          # (block_q, 128)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - _lanes_to(m_new, s.shape[1]))
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * _lanes_to(alpha, d) + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        @pl.when(k_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(k_idx == nk - 1)
+    def _fin():
+        d_ = o_ref.shape[-1]
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)         # fully-masked rows
+        o_ref[0] = (acc_scr[:] / _lanes_to(l_safe, d_)).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l_safe)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
+                    dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr, *,
+                    sm_scale, causal, block_q, block_k, kv_len):
+    from jax.experimental import pallas as pl
+
+    k_idx, q_idx = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        if db_scr is not None:
+            db_scr[:] = jnp.zeros_like(db_scr)
+
+    def _compute():
+        q, v = q_ref[0], v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q, k_ref[0], b_ref, k_idx, q_idx,
+                           block_q, block_k, kv_len, sm_scale, causal)
+        p = jnp.exp(s - lse_ref[0][:, :1])           # (block_q, block_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0][:, :1]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if db_scr is not None:
+            # per-key bias grad: sum of ds over query rows (note ds already
+            # carries sm_scale; the bias enters the scores unscaled, so
+            # divide it back out)
+            db_scr[:] += jnp.broadcast_to(
+                jnp.sum(ds, axis=0, keepdims=True) / sm_scale,
+                db_scr.shape)
+
+    if causal:
+        @pl.when(q_idx * block_q + block_q - 1 >= k_idx * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(q_idx == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        if db_ref is not None:
+            db_ref[0] = db_scr[:]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
+                   dq_ref, dq_scr, *, sm_scale, causal,
+                   block_q, block_k, kv_len):
+    from jax.experimental import pallas as pl
+
+    q_idx, k_idx = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q, k, b_ref, k_idx, q_idx,
+                           block_q, block_k, kv_len, sm_scale, causal)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0][:, :1]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(k_idx == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+def _pick_blocks(sq, sk):
+    block_q = min(512, sq) if sq % min(512, sq) == 0 else 128
+    block_k = min(512, sk) if sk % min(512, sk) == 0 else 128
+    return block_q, block_k
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_call(q, k, v, bias, causal, sm_scale, interpret):
+    """q: (bn, sq, d); k/v: (bn, sk, d); bias: (bn, sk) or None.
+    Returns o (bn, sq, d) unpadded and lse (bn, sq_pad, 128) lane-padded."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bn, sq0, d = q.shape
+    sk0 = k.shape[1]
+    block_q, block_k = _pick_blocks(sq0, sk0)
+    q = _pad_to(q, 1, block_q)
+    k = _pad_to(k, 1, block_k)
+    v = _pad_to(v, 1, block_k)
+    sq, sk = q.shape[1], k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+    ]
+    args = [q, k, v]
+    kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+              block_k=block_k, kv_len=sk0)
+    if bias is not None:
+        args.append(_pad_to(bias, 1, block_k)[:, None, :])  # (bn, 1, sk)
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda i, j, kk: (i, 0, kk)))
+        kern = functools.partial(_fwd_kernel, **kw)
+    else:
+        def kern(q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s):
+            _fwd_kernel(q_r, k_r, v_r, None, o_r, lse_r, m_s, l_s, a_s, **kw)
+
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bn, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args)
+    return o[:, :sq0], lse
+
+
+def _flash_bwd_call(q, k, v, bias, o, lse, do, causal, sm_scale, interpret):
+    """lse: lane-padded (bn, sq_pad, 128) from _flash_call."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bn, sq0, d = q.shape
+    sk0 = k.shape[1]
+    block_q, block_k = _pick_blocks(sq0, sk0)
+
+    dl = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    q = _pad_to(q, 1, block_q)
+    do_p = _pad_to(do, 1, block_q)
+    dl_p = jnp.broadcast_to(
+        _pad_to(dl, 1, block_q)[:, :, None],
+        (bn, q.shape[1], _LANES))
+    k = _pad_to(k, 1, block_k)
+    v = _pad_to(v, 1, block_k)
+    bias3 = None
+    if bias is not None:
+        bias3 = _pad_to(bias, 1, block_k)[:, None, :]
+    sq, sk = q.shape[1], k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+
+    common_in = [q, k, v] + ([bias3] if bias3 is not None else []) \
+        + [do_p, lse, dl_p]
+    kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+              block_k=block_k, kv_len=sk0)
+
+    if bias is not None:
+        dkv_kern = functools.partial(_bwd_dkv_kernel, **kw)
+        dq_kern = functools.partial(_bwd_dq_kernel, **kw)
+    else:
+        def dkv_kern(q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r, ks, vs):
+            _bwd_dkv_kernel(q_r, k_r, v_r, None, do_r, lse_r, dl_r,
+                            dk_r, dv_r, None, ks, vs, None, **kw)
+
+        def dq_kern(q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, qs):
+            _bwd_dq_kernel(q_r, k_r, v_r, None, do_r, lse_r, dl_r,
+                           dq_r, qs, **kw)
+
+    # dk/dv: grid (bn, nk, nq)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, kk, j: (i, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, kk, j: (i, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, kk, j: (i, kk, 0)),
+    ]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda i, kk, j: (i, 0, kk)))
+    in_specs += [
+        pl.BlockSpec((1, block_q, d), lambda i, kk, j: (i, j, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda i, kk, j: (i, j, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda i, kk, j: (i, j, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, block_k, d), lambda i, kk, j: (i, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, kk, j: (i, kk, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bn, sk, d), k.dtype),
+        jax.ShapeDtypeStruct((bn, sk, d), v.dtype),
+    ]
+    scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    if bias is not None:
+        out_specs.append(pl.BlockSpec((1, 8, block_k),
+                                      lambda i, kk, j: (i, 0, kk)))
+        out_shape.append(jax.ShapeDtypeStruct((bn, 8, sk), jnp.float32))
+        scratch.append(pltpu.VMEM((8, block_k), jnp.float32))
+    outs = pl.pallas_call(
+        dkv_kern,
+        grid=(bn, nk, nq),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*common_in)
+    if bias is not None:
+        dk, dv, db8 = outs
+        db = db8[:, 0, :sk0]
+    else:
+        dk, dv = outs
+        db = None
+
+    # dq: grid (bn, nq, nk)
+    in_specs2 = [
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+    ]
+    if bias is not None:
+        in_specs2.append(pl.BlockSpec((1, 1, block_k),
+                                      lambda i, j, kk: (i, 0, kk)))
+    in_specs2 += [
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0)),
+    ]
+    dq, = pl.pallas_call(
+        dq_kern,
+        grid=(bn, nq, nk),
+        in_specs=in_specs2,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bn, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*common_in)
+
+    return dq[:, :sq0], dk[:, :sk0], dv[:, :sk0], db
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp public entry
+# ---------------------------------------------------------------------------
+
+def _to_bn(x):
+    b, s, n, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+
+
+def _from_bn(x, b, n):
+    bn, s, d = x.shape
+    return x.reshape(b, n, s, d).transpose(0, 2, 1, 3)
+
+
+def _bias_to_bn(bias, b, n, sk):
+    """Accepts (b, 1, 1, sk) / (b, sk) per-key additive bias → (b*n, sk)."""
+    bias = bias.reshape(b, -1)[:, -sk:]
+    return jnp.repeat(bias, n, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, bias, causal, sm_scale, interpret):
+    o, _ = _flash_fwd(q, k, v, bias, causal, sm_scale, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, causal, sm_scale, interpret):
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    bb = None if bias is None else _bias_to_bn(bias, b, n, sk)
+    o, lse = _flash_call(_to_bn(q), _to_bn(k), _to_bn(v), bb,
+                         causal, sm_scale, interpret)
+    return _from_bn(o, b, n), (q, k, v, bias, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, interpret, res, g):
+    q, k, v, bias, o_bn, lse = res
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    bb = None if bias is None else _bias_to_bn(bias, b, n, sk)
+    dq, dk, dv, db_bn = _flash_bwd_call(
+        _to_bn(q), _to_bn(k), _to_bn(v), bb, o_bn, lse, _to_bn(g),
+        causal, sm_scale, interpret)
+    db = None
+    if bias is not None:
+        # db_bn: (b*n, sk) -> sum heads -> original (per-key) bias shape
+        db = db_bn.reshape(b, n, sk).sum(axis=1).reshape(bias.shape) \
+            .astype(bias.dtype)
+    return _from_bn(dq, b, n), _from_bn(dk, b, n), _from_bn(dv, b, n), db
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(q, k, v, bias=None, causal: bool = False,
+              sm_scale: Optional[float] = None, impl: Optional[str] = None):
+    """Dispatching fused attention. impl: None (auto) | 'flash' | 'xla'.
+
+    bias, when given to the flash path, must be per-key additive
+    (broadcastable from (b, 1, 1, sk)); arbitrary (b, n, sq, sk) biases fall
+    back to the XLA reference.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if impl is None:
+        impl = os.environ.get("FLAGS_attention_impl", "")
+    flag_ok = impl in ("", "auto", "flash")
+    on_tpu = jax.default_backend() == "tpu"
+    # flash supports only per-key biases: (b, sk) or (b, 1, 1, sk)
+    bias_ok = bias is None or bias.ndim == 2 or (
+        bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1)
+    shapes_ok = (q.shape[-1] % 8 == 0 and q.shape[1] % 8 == 0
+                 and k.shape[1] % 128 == 0)
+    if impl == "flash" and not bias_ok:
+        raise ValueError(
+            "flash attention requires a per-key bias of shape (b, sk) or "
+            f"(b, 1, 1, sk); got {bias.shape}. Use impl='xla' for general "
+            "biases.")
+    if impl == "flash" or (flag_ok and on_tpu and bias_ok and shapes_ok
+                           and impl != "xla"):
+        interpret = not on_tpu
+        return flash_attention(q, k, v, bias, causal, float(sm_scale),
+                               interpret)
+    return mha_reference(q, k, v, bias, causal, sm_scale)

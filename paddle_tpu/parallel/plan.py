@@ -23,12 +23,14 @@ class ShardingPlan:
     def __init__(self, param_shardings: Optional[Dict[str, tuple]] = None,
                  mesh_shape: Optional[Tuple[int, ...]] = None,
                  axis_names: Tuple[str, ...] = ("dp",),
-                 places=None, devices=None):
+                 places=None, devices=None,
+                 feed_shardings: Optional[Dict[str, tuple]] = None):
         import jax
         import numpy as np
         from jax.sharding import Mesh
 
         self.param_shardings = dict(param_shardings or {})
+        self.feed_shardings = dict(feed_shardings or {})
         devs = devices if devices is not None else jax.devices()
         if places is not None and isinstance(places, int):
             devs = devs[:places]
@@ -49,9 +51,12 @@ class ShardingPlan:
         from jax.sharding import NamedSharding
         return NamedSharding(self.mesh, spec)
 
-    def feed_sharding(self, shape=None):
-        """Batch-shard when the leading dim divides over the dp axis;
-        replicate small/scalar feeds (e.g. a (1,)-shaped lr)."""
+    def feed_sharding(self, shape=None, name=None):
+        """Explicit per-feed PartitionSpec when given (e.g. sequence dim on
+        a 'cp' axis); else batch-shard when the leading dim divides over the
+        dp axis; replicate small/scalar feeds (e.g. a (1,)-shaped lr)."""
+        if name is not None and name in self.feed_shardings:
+            return self._nsh(self._spec(*self.feed_shardings[name]))
         n = self.mesh.shape[self.batch_axis]
         if shape is not None and (not shape or shape[0] % n != 0):
             return self._nsh(self._spec())
@@ -68,7 +73,8 @@ class ShardingPlan:
         import jax
         out = {}
         for k, v in feed.items():
-            out[k] = jax.device_put(v, self.feed_sharding(tuple(v.shape)))
+            out[k] = jax.device_put(
+                v, self.feed_sharding(tuple(v.shape), name=k))
         return out
 
     def place_scope(self, scope_vals: Dict):
@@ -99,7 +105,8 @@ class ShardingPlan:
 
         mut_sh = {n: self.scope_sharding(n) for n in mutable}
         ro_sh = {n: self.scope_sharding(n) for n in readonly}
-        feed_sh = {n: self.feed_sharding(s) for n, s in feed_shapes.items()}
+        feed_sh = {n: self.feed_sharding(s, name=n)
+                   for n, s in feed_shapes.items()}
         out_sh = dict(mut_sh)
         for n in created:
             out_sh[n] = self.scope_sharding(n)
