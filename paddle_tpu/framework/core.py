@@ -381,6 +381,7 @@ class Program:
         self._version = 0
         self._seed: Optional[int] = None
         self.random_seed = 0
+        self._pipeline = None  # PipelineMeta when PipelineOptimizer is used
 
     # -- mutation tracking ---------------------------------------------------
     def _bump_version(self):
@@ -434,6 +435,8 @@ class Program:
                 nb.ops.append(nop)
             p.blocks.append(nb)
         p.random_seed = self.random_seed
+        if not for_test:
+            p._pipeline = self._pipeline  # test clones prune backward anyway
         p._bump_version()
         return p
 

@@ -262,6 +262,18 @@ class Executor:
                  mutable, created, readonly, dist_plan):
         import jax
 
+        if getattr(program, "_pipeline", None) is not None:
+            if dist_plan is not None:
+                raise NotImplementedError(
+                    "PipelineOptimizer programs manage their own 'pp' mesh "
+                    "and cannot be combined with a CompiledProgram "
+                    "distribution plan yet — run the pipelined Program "
+                    "directly")
+            from ..parallel.pipeline import compile_pipeline_step
+            return compile_pipeline_step(
+                program, program._pipeline, feed_shapes, fetch_names,
+                mutable, created, readonly)
+
         blk = program.global_block
         ops = [op for op in blk.ops if op.type not in ("feed", "fetch")]
         out_names = list(mutable) + list(created)

@@ -105,8 +105,14 @@ def _ln(x, name):
 
 
 def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
-                 is_test: bool = False, prefix: str = "bert"):
-    """src_ids/sent_ids: int64 (-1, seq); input_mask: float32 (-1, seq)."""
+                 is_test: bool = False, prefix: str = "bert",
+                 cut_vars=None):
+    """src_ids/sent_ids: int64 (-1, seq); input_mask: float32 (-1, seq).
+
+    cut_vars: optional list; when given, pipeline cut-point var names are
+    appended (embedding/mask boundary + each encoder layer output) so the
+    program can be pipelined with PipelineOptimizer — the encoder layers
+    form the uniform stage run."""
     seq = int(src_ids.shape[1])
 
     word_emb = pt.layers.embedding(
@@ -134,6 +140,8 @@ def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
     neg_k = (pt.layers.scale(input_mask, scale=1e4, bias=-1e4)
              if cfg.attn_impl == "fused" else None)
 
+    if cut_vars is not None:
+        cut_vars.append((neg_k if neg_k is not None else neg).name)
     x = emb
     for i in range(cfg.layers):
         p = f"{prefix}/l{i}"
@@ -141,23 +149,29 @@ def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
         x = _ln(x + att, f"{p}/ln1")
         ff = _ffn(x, cfg, p)
         x = _ln(x + ff, f"{p}/ln2")
+        if cut_vars is not None:
+            cut_vars.append(x.name)
     return x
 
 
 def bert_pretrain_program(cfg: BertConfig, seq_len: int, is_test=False,
                           learning_rate=1e-4, optimizer="adam",
-                          amp=False):
+                          amp=False, pipeline_microbatches=None):
     """Build (main, startup, fetch dict) for an MLM pretraining step with
     tied output embeddings (logits over full vocab at every position).
-    amp=True applies the bf16 mixed-precision rewrite (f32 master weights)."""
+    amp=True applies the bf16 mixed-precision rewrite (f32 master weights).
+    pipeline_microbatches=M wraps the optimizer in PipelineOptimizer with
+    cut points at the encoder layers (SPMD GPipe over the 'pp' axis)."""
     main, startup = pt.Program(), pt.Program()
+    cuts = [] if pipeline_microbatches else None
     with pt.program_guard(main, startup):
         src = pt.layers.data("src_ids", [seq_len], dtype="int64")
         sent = pt.layers.data("sent_ids", [seq_len], dtype="int64")
         mask = pt.layers.data("input_mask", [seq_len], dtype="float32")
         labels = pt.layers.data("mlm_labels", [seq_len], dtype="int64")
 
-        enc = bert_encoder(src, sent, mask, cfg, is_test=is_test)
+        enc = bert_encoder(src, sent, mask, cfg, is_test=is_test,
+                           cut_vars=cuts)
 
         # tied-softmax MLM head: logits = enc @ word_emb^T
         word_emb = main.global_block.var("bert/word_embedding")
@@ -174,6 +188,10 @@ def bert_pretrain_program(cfg: BertConfig, seq_len: int, is_test=False,
         if amp:
             from ..contrib.mixed_precision import decorate
             opt = decorate(opt)
+        if pipeline_microbatches:
+            opt = pt.optimizer.PipelineOptimizer(
+                opt, cut_list=cuts,
+                num_microbatches=pipeline_microbatches)
         opt.minimize(mean_loss)
     return main, startup, {"loss": mean_loss}
 

@@ -25,7 +25,7 @@ __all__ = [
     "Adadelta", "AdadeltaOptimizer", "Adamax", "AdamaxOptimizer", "RMSProp",
     "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
     "LarsMomentum", "LarsMomentumOptimizer", "ExponentialMovingAverage",
-    "ModelAverage",
+    "ModelAverage", "PipelineOptimizer",
 ]
 
 
@@ -583,6 +583,8 @@ class ModelAverage:
             scope.set_var(p, v)
         self._backup = {}
 
+
+from .parallel.pipeline import PipelineOptimizer  # noqa: E402
 
 # short aliases matching paddle 2.x style
 SGD = SGDOptimizer

@@ -1,0 +1,493 @@
+"""Pipeline parallelism: SPMD GPipe over a 'pp' mesh axis.
+
+The TPU-native redesign of the reference's pipeline stack
+(PipelineOptimizer optimizer.py:2665 cutting the program by cut_list;
+PipelineTrainer/SectionWorker pipeline_trainer.cc:24, section_worker.cc:141
+running async section threads connected by scope queues; configured by
+trainer_desc.proto:61 SectionWorkerParameter). Instead of host threads and
+queues, the whole schedule compiles into ONE XLA computation:
+
+* the program is cut at `cut_list` vars into stages; the longest run of
+  structurally-identical stages (validated by op-signature comparison) is
+  pipelined — their params are stacked into (K, ...) arrays sharded over
+  the 'pp' mesh axis,
+* a lax.scan over M + K - 1 rounds runs the GPipe schedule under
+  shard_map: each device applies its stage to its current microbatch and
+  hands the activation to its right neighbor via lax.ppermute (ICI hop),
+* stages before/after the uniform run (embedding prologue, loss-head
+  epilogue) execute replicated on all pp devices per microbatch,
+* gradients flow through the scan/ppermute transpose (the reverse ring),
+  so forward+backward+update is ONE jit — no queues, no section threads,
+* non-uniform cuts (or K > device count) fall back to a sequential
+  microbatched grad-accumulation schedule with identical numerics.
+
+`PipelineOptimizer` builds the usual fwd+bwd+opt program so optimizer ops
+and grad names stay standard IR; the pipelined executor replaces the
+backward *ops* with jax.grad through the pipelined loss, then runs the
+program's optimizer ops unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["PipelineOptimizer", "gpipe_spmd"]
+
+GRAD_SUFFIX = "@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# core SPMD GPipe schedule
+# ---------------------------------------------------------------------------
+
+def gpipe_spmd(stage_fn, stacked_params, acts_mb, mesh, axis: str,
+               base_key=None):
+    """Run M microbatches through K uniform stages over mesh axis `axis`.
+
+    stage_fn(params_i, act, key) -> act   (same pytree structure in/out;
+        key is None when base_key is None)
+    stacked_params: pytree, each leaf (K, ...) — stacked per-stage params
+    acts_mb: pytree, each leaf (M, mb, ...) — stage-0 inputs per microbatch
+    Returns pytree (M, mb, ...): stage-(K-1) outputs per microbatch,
+    replicated. Differentiable (scan + ppermute transpose = reverse ring).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    K = mesh.shape[axis]
+    M = jax.tree_util.tree_leaves(acts_mb)[0].shape[0]
+    T = M + K - 1
+    perm_fwd = [(i, (i + 1) % K) for i in range(K)]
+    key_data = (None if base_key is None
+                else jax.random.key_data(base_key))
+
+    def per_device(params_stk, acts, kd):
+        params = jax.tree.map(lambda x: x[0], params_stk)
+        idx = jax.lax.axis_index(axis)
+        zero_act = jax.tree.map(lambda x: jnp.zeros_like(x[0]), acts)
+        out_buf = jax.tree.map(
+            lambda x: jnp.zeros(x.shape, x.dtype), acts)
+
+        def round_fn(carry, r):
+            recv, buf = carry
+            m = r - idx                      # microbatch this device runs
+            m_in = jnp.clip(m, 0, M - 1)
+            act_in = jax.tree.map(
+                lambda full, rcv: jnp.where(idx == 0, full[m_in], rcv),
+                acts, recv)
+            if kd is None:
+                key = None
+            else:
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.wrap_key_data(kd), m_in),
+                    idx)
+            act_out = stage_fn(params, act_in, key)
+            valid = (idx == K - 1) & (m >= 0) & (m < M)
+            buf = jax.tree.map(
+                lambda b, a: jnp.where(
+                    valid, jax.lax.dynamic_update_index_in_dim(b, a, m_in, 0),
+                    b),
+                buf, act_out)
+            recv = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis, perm_fwd), act_out)
+            return (recv, buf), ()
+
+        (_, out_buf), _ = jax.lax.scan(
+            round_fn, (zero_act, out_buf), jnp.arange(T))
+        # only the last device holds real outputs; replicate via psum
+        return jax.tree.map(
+            lambda x: jax.lax.psum(
+                jnp.where(idx == K - 1, x, jnp.zeros_like(x)), axis),
+            out_buf)
+
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    rep = jax.tree.map(lambda _: P(), acts_mb)
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pspec, rep, None if key_data is None else P()),
+        out_specs=rep, check_vma=False)(stacked_params, acts_mb, key_data)
+
+
+# ---------------------------------------------------------------------------
+# PipelineOptimizer (program-level API)
+# ---------------------------------------------------------------------------
+
+class PipelineMeta:
+    def __init__(self, cut_vars, num_microbatches, axis, loss_name):
+        self.cut_vars = cut_vars
+        self.num_microbatches = num_microbatches
+        self.axis = axis
+        self.loss_name = loss_name
+
+
+class PipelineOptimizer:
+    """Reference: optimizer.py:2665 PipelineOptimizer(optimizer, cut_list,
+    place_list, concurrency_list, queue_size, start_cpu_core_id). The
+    place/queue/concurrency knobs configured host threads in the reference;
+    under XLA the schedule is compiled, so they are accepted and ignored."""
+
+    def __init__(self, optimizer, cut_list=None, num_microbatches: int = 4,
+                 axis: str = "pp", place_list=None, concurrency_list=None,
+                 queue_size=None, start_cpu_core_id=None):
+        self._inner = optimizer
+        self._cut_list = cut_list or []
+        self._m = num_microbatches
+        self._axis = axis
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._inner.minimize(loss, startup_program=startup_program,
+                                      parameter_list=parameter_list,
+                                      no_grad_set=no_grad_set)
+        cut_names = [v if isinstance(v, str) else v.name
+                     for v in self._cut_list]
+        prog = loss.block.program
+        prog._pipeline = PipelineMeta(cut_names, self._m, self._axis,
+                                      loss.name)
+        return result
+
+
+# ---------------------------------------------------------------------------
+# program cutting + stage analysis
+# ---------------------------------------------------------------------------
+
+def _stage_partition(fwd_ops, cut_vars):
+    stages, cur, cuts = [], [], list(cut_vars)
+    for op in fwd_ops:
+        cur.append(op)
+        if cuts and cuts[0] in op.output_names():
+            stages.append(cur)
+            cur = []
+            cuts.pop(0)
+    stages.append(cur)
+    if cuts:
+        raise ValueError(f"cut vars {cuts} are not produced by any op")
+    return stages
+
+
+def _stage_io(ops, produced_before, feeds, persist):
+    """Ordered (param_reads, act_reads, feed_reads, writes) for a segment."""
+    writes, params, acts, freads = [], [], [], []
+    local = set()
+    for op in ops:
+        for n in op.input_names():
+            if n in local:
+                continue
+            if n in persist:
+                if n not in params:
+                    params.append(n)
+            elif n in feeds:
+                if n not in freads:
+                    freads.append(n)
+            elif n in produced_before and n not in acts:
+                acts.append(n)
+        for n in op.output_names():
+            local.add(n)
+            writes.append(n)
+    return params, acts, freads, writes
+
+
+def _signature(ops):
+    """Structural stage signature: op types, slot arities, attrs, and input
+    var shapes/dtypes (so a 16->32 fc is distinct from a 32->32 one)."""
+    sig = []
+    for op in ops:
+        blk = op.block
+        attrs = {k: v for k, v in sorted(op.attrs.items())
+                 if k not in ("name", "op_role")}
+
+        def vsig(n):
+            if blk.has_var(n):
+                v = blk.var(n)
+                return (tuple(v.shape or ()), v.dtype)
+            return None
+
+        sig.append((op.type,
+                    tuple((s, tuple(vsig(n) for n in ns))
+                          for s, ns in sorted(op.inputs.items()) if ns),
+                    tuple((s, len(ns))
+                          for s, ns in sorted(op.outputs.items()) if ns),
+                    repr(attrs)))
+    return sig
+
+
+def _longest_uniform_run(sigs):
+    """[s, e) of the longest run of equal consecutive signatures."""
+    best_s, best_e = 0, 1
+    s = 0
+    for i in range(1, len(sigs)):
+        if sigs[i] != sigs[s]:
+            s = i
+        if i + 1 - s > best_e - best_s:
+            best_s, best_e = s, i + 1
+    return best_s, best_e
+
+
+# ---------------------------------------------------------------------------
+# pipelined executor compilation
+# ---------------------------------------------------------------------------
+
+def compile_pipeline_step(program, meta: PipelineMeta, feed_shapes,
+                          fetch_names, mutable, created, readonly):
+    """fn(mut_scope, ro_scope, feed, rng_key) ->
+    (new_mut, fetches, new_key, {}): the pipelined train step. Called from
+    Executor._compile when program._pipeline is set."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from ..framework.registry import LowerContext, lower_op
+
+    blk = program.global_block
+    all_ops = [op for op in blk.ops if op.type not in ("feed", "fetch")]
+    fwd_ops = [op for op in all_ops
+               if op.attrs.get("op_role") not in ("backward", "optimize",
+                                                  "lr_sched")]
+    upd_ops = [op for op in all_ops
+               if op.attrs.get("op_role") in ("optimize", "lr_sched")]
+
+    persist = {v.name for v in blk.vars.values() if v.persistable}
+    feeds = set(feed_shapes)
+    M = meta.num_microbatches
+
+    stages = _stage_partition(fwd_ops, meta.cut_vars)
+    produced = set()
+    smeta = []
+    for ops in stages:
+        io = _stage_io(ops, produced, feeds, persist)
+        smeta.append(io)
+        produced.update(io[3])
+
+    grad_names = {n for op in upd_ops for n in op.input_names()
+                  if n.endswith(GRAD_SUFFIX)}
+    train_params = sorted(n[: -len(GRAD_SUFFIX)] for n in grad_names)
+
+    # persistable state written by forward ops (batch_norm moving stats):
+    # carried through the microbatch scan; forces the sequential schedule
+    # (stacked per-stage running stats are not supported in the SPMD run)
+    stat_names = []
+    seen = set(train_params)
+    for op in fwd_ops:
+        for n in op.output_names():
+            if n in persist and n not in seen:
+                stat_names.append(n)
+                seen.add(n)
+
+    plan = (None if stat_names
+            else _plan_uniform_run(program, stages, smeta, meta, feeds))
+
+    def run_ops(ops, env, key):
+        ctx = LowerContext(rng_key=key)
+        for op in ops:
+            lower_op(ctx, op, env)
+        return env
+
+    def microbatch(name, x):
+        b = x.shape[0] if x.ndim else 1
+        if x.ndim and b % M == 0:
+            return x.reshape((M, b // M) + x.shape[1:])
+        if b > 1:
+            raise ValueError(
+                f"feed {name!r} batch size {b} is not divisible by "
+                f"num_microbatches={M}")
+        return jnp.broadcast_to(x[None], (M,) + x.shape)  # per-step scalars
+
+    def step(mut_scope, ro_scope, feed_vals, rng_key):
+        scope = {}
+        scope.update(ro_scope)
+        scope.update(mut_scope)
+        feed_mb = {k: microbatch(k, jnp.asarray(v))
+                   for k, v in feed_vals.items()}
+        params_all = {n: scope[n] for n in train_params if n in scope}
+        frozen = {n: scope[n] for n in persist
+                  if n in scope and n not in params_all}
+
+        def sequential_loss(params_all, key):
+            env_base = dict(frozen)
+            env_base.update(params_all)
+            stats0 = {n: env_base[n] for n in stat_names}
+
+            def body(carry, m):
+                acc, stats = carry
+                env = dict(env_base)
+                env.update(stats)
+                for fk, fv in feed_mb.items():
+                    env[fk] = fv[m]
+                run_ops(fwd_ops, env, jax.random.fold_in(key, m))
+                new_stats = {n: env[n] for n in stats0}
+                loss_m = env[meta.loss_name].astype(jnp.float32).reshape(())
+                return (acc + loss_m, new_stats), ()
+
+            (total, stats), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), stats0), jnp.arange(M))
+            return total / M, stats
+
+        if plan is None:
+            loss_fn = sequential_loss
+        else:
+            def loss_fn(p, k):
+                return _pipelined_loss(plan, frozen, p, feed_mb, k, M,
+                                       meta, run_ops), {}
+
+        (loss, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params_all, rng_key)
+
+        env = dict(scope)
+        env.update(stats)                       # fwd-updated moving stats
+        for n, g in grads.items():
+            env[n + GRAD_SUFFIX] = g
+        env[meta.loss_name] = jnp.reshape(loss, (1,))
+        run_ops(upd_ops, env, jax.random.fold_in(rng_key, 0x9e37))
+
+        for n in fetch_names:
+            if n not in env:
+                raise NotImplementedError(
+                    f"fetch of forward variable {n!r} is not supported "
+                    "under PipelineOptimizer — forward activations exist "
+                    "only inside the pipelined gradient computation; fetch "
+                    "the loss, persistable vars, or optimizer outputs")
+        new_mut = {n: env[n] for n in list(mutable) + list(created)}
+        fetches = [env[n] for n in fetch_names]
+        new_key = jax.random.fold_in(rng_key, 0x5eed)
+        return new_mut, fetches, new_key, {}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def _plan_uniform_run(program, stages, smeta, meta, feeds):
+    """Validate + assemble the uniform-run pipeline plan, or None for the
+    sequential fallback."""
+    import jax
+
+    sigs = [_signature(ops) for ops in stages]
+    s, e = _longest_uniform_run(sigs)
+    K = e - s
+    if K < 2 or len(jax.devices()) < K or s == 0:
+        return None
+
+    # positional io alignment across the run
+    run_meta = smeta[s:e]
+    p0, a0, f0, w0 = run_meta[0]
+    for pi, ai, fi, wi in run_meta[1:]:
+        if len(pi) != len(p0) or len(ai) != len(a0) or fi != f0 \
+                or len(wi) != len(w0):
+            return None
+    if f0:
+        return None  # feeds read inside the run: not supported, fallback
+
+    # slot j: stage i reads a_i[j]; produced slots resolve positionally in
+    # the previous stage's writes, passthrough slots keep their name
+    a_next = smeta[s + 1][1]           # reads of the 2nd stage in the run
+    w_prev = smeta[s][3]
+    slot_pos, passthrough = [], []
+    for j, name in enumerate(a_next):
+        if name in w_prev:
+            slot_pos.append(len(w_prev) - 1 - w_prev[::-1].index(name))
+            passthrough.append(False)
+        elif name == a0[j]:
+            slot_pos.append(-1)
+            passthrough.append(True)
+        else:
+            return None
+
+    last = e - 1
+    final_names = []
+    for j in range(len(a0)):
+        if passthrough[j]:
+            final_names.append(smeta[last][1][j])
+        else:
+            final_names.append(smeta[last][3][slot_pos[j]])
+
+    pro_ops = [op for seg in stages[:s] for op in seg]
+    epi_ops = [op for seg in stages[e:] for op in seg]
+    pro_writes = {n for seg in smeta[:s] for n in seg[3]}
+
+    # epilogue reads must be reachable: final slots, prologue outputs,
+    # feeds, or persistables (checked at trace time via env lookup)
+    from jax.sharding import Mesh
+    devices = jax.devices()[:K]
+    mesh = Mesh(np.asarray(devices).reshape(K), (meta.axis,))
+
+    return {
+        "s": s, "e": e, "K": K, "mesh": mesh,
+        "stage_ops": stages[s],          # canonical (stage-s) op segment
+        "stage_params": [m[0] for m in smeta[s:e]],
+        "a0": a0, "slot_pos": slot_pos, "passthrough": passthrough,
+        "final_names": final_names, "w0": w0,
+        "pro_ops": pro_ops, "epi_ops": epi_ops,
+        "pro_writes": sorted(pro_writes),
+        "stage0_acts": smeta[s][1],
+    }
+
+
+def _pipelined_loss(plan, frozen, params_all, feed_mb, key, M, meta,
+                    run_ops):
+    import jax
+    import jax.numpy as jnp
+
+    mesh, axis = plan["mesh"], meta.axis
+    a0, w0 = plan["a0"], plan["w0"]
+    slot_pos, passthrough = plan["slot_pos"], plan["passthrough"]
+
+    env_base = dict(frozen)
+    env_base.update(params_all)
+
+    # ---- prologue per microbatch (replicated compute) ----
+    def pro_one(m):
+        env = dict(env_base)
+        for fk, fv in feed_mb.items():
+            env[fk] = fv[m]
+        run_ops(plan["pro_ops"], env,
+                jax.random.fold_in(jax.random.fold_in(key, 7001), m))
+        keep = set(a0) | set(plan["pro_writes"])
+        return {n: env[n] for n in keep if n in env}
+
+    def pro_scan(_, m):
+        return (), pro_one(m)
+
+    _, pro_out = jax.lax.scan(pro_scan, (), jnp.arange(M))
+    acts_mb = {n: pro_out[n] for n in a0}      # (M, ...) per slot
+
+    # ---- stacked stage params (positional against canonical names) ----
+    names0 = plan["stage_params"][0]
+    stacked = {}
+    for j, n0 in enumerate(names0):
+        stacked[n0] = jnp.stack(
+            [env_base[pl[j]] for pl in plan["stage_params"]])
+
+    def stage_fn(params, act, skey):
+        env = dict(frozen)
+        env.update(params)                     # canonical stage-s names
+        env.update({n: act[n] for n in a0})
+        run_ops(plan["stage_ops"], env, skey)
+        wvals = [env[n] for n in w0]
+        out = {}
+        for j, n in enumerate(a0):
+            out[n] = act[n] if passthrough[j] else wvals[slot_pos[j]]
+        return out
+
+    out_acts = gpipe_spmd(stage_fn, stacked, acts_mb, mesh, axis,
+                          base_key=key)
+
+    # ---- epilogue per microbatch ----
+    def epi_one(m):
+        env = dict(env_base)
+        for fk, fv in feed_mb.items():
+            env[fk] = fv[m]
+        for n in plan["pro_writes"]:
+            if n in pro_out:
+                env[n] = pro_out[n][m]
+        for j, fn_ in enumerate(plan["final_names"]):
+            env[fn_] = out_acts[a0[j]][m]
+        run_ops(plan["epi_ops"], env,
+                jax.random.fold_in(jax.random.fold_in(key, 7002), m))
+        return env[meta.loss_name].astype(jnp.float32).reshape(())
+
+    def epi_scan(acc, m):
+        return acc + epi_one(m), ()
+
+    total, _ = jax.lax.scan(epi_scan, jnp.zeros((), jnp.float32),
+                            jnp.arange(M))
+    return total / M
