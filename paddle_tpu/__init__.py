@@ -23,6 +23,7 @@ from . import io
 from . import metrics
 from . import profiler
 from . import contrib
+from . import dygraph
 from .framework.executor import as_jax_function
 
 __version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""Dygraph (eager/imperative) mode.
+
+Reference: python/paddle/fluid/dygraph/ + paddle/fluid/imperative/ — the
+eager counterpart to the static Program path. Ops execute immediately via
+their JAX lowering rules; gradients come from a vjp tape (base.py)."""
+
+from .base import (guard, enabled, to_variable, no_grad, VarBase, Tracer,
+                   trace_op)
+from .layers import Layer
+from . import nn
+from .nn import (Conv2D, Conv2DTranspose, Pool2D, FC, Linear, BatchNorm,
+                 Embedding, LayerNorm, GroupNorm, PRelu, GRUUnit, Dropout)
+from .checkpoint import save_dygraph, load_dygraph
+
+__all__ = ["guard", "enabled", "to_variable", "no_grad", "VarBase",
+           "Tracer", "trace_op", "Layer", "nn", "Conv2D", "Conv2DTranspose",
+           "Pool2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm",
+           "GroupNorm", "PRelu", "GRUUnit", "Dropout", "save_dygraph",
+           "load_dygraph"]

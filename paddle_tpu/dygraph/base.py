@@ -1,0 +1,360 @@
+"""Dygraph (eager) mode: VarBase + tape tracer.
+
+Reference: paddle/fluid/imperative/ (Tracer tracer.h:41, VarBase layer.h:133)
+and python/paddle/fluid/dygraph/base.py (guard :98, to_variable).
+
+TPU-native redesign: instead of a C++ tracer that runs op kernels and
+records a grad-op graph, every eager op call executes the op's registered
+JAX lowering rule (the same rule the static-graph executor traces) under
+``jax.vjp``; the returned vjp closure is pushed onto a tape. ``backward()``
+walks the tape in reverse, feeding cotangents through the stored closures.
+Ops run asynchronously on the TPU (JAX dispatch), so eager mode still
+overlaps host Python with device compute.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import weakref
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import convert_np_dtype, unique_name
+from ..framework.registry import LowerContext, get_op_def
+
+__all__ = ["guard", "enabled", "to_variable", "no_grad", "VarBase",
+           "trace_op", "Tracer"]
+
+_active_tracer: Optional["Tracer"] = None
+
+
+def enabled() -> bool:
+    """True inside a dygraph.guard() block (fluid.in_dygraph_mode analog)."""
+    return _active_tracer is not None
+
+
+def _tracer() -> "Tracer":
+    if _active_tracer is None:
+        raise RuntimeError("dygraph API used outside dygraph.guard()")
+    return _active_tracer
+
+
+class _TapeEntry:
+    __slots__ = ("vjp_fn", "in_vars", "out_refs")
+
+    def __init__(self, vjp_fn, in_vars, out_vars):
+        self.vjp_fn = vjp_fn
+        self.in_vars = in_vars    # VarBases that require grad, vjp order
+        # Outputs held weakly: an entry whose outputs have all died can
+        # never receive a cotangent, so it (and its vjp residuals) can be
+        # pruned — the analog of the reference's refcounted grad-graph
+        # release. Shape/dtype kept for zero cotangents of dead outputs.
+        self.out_refs = [(weakref.ref(ov), tuple(ov.value.shape),
+                          ov.value.dtype) for ov in out_vars]
+
+    def alive(self) -> bool:
+        return any(r() is not None for r, _, _ in self.out_refs)
+
+
+class Tracer:
+    """Eager op recorder (reference: imperative/tracer.h:41 Tracer::Trace)."""
+
+    _PRUNE_EVERY = 512
+
+    def __init__(self, seed: int = 0):
+        import jax
+        from ..framework.executor import _ensure_prng_default
+        _ensure_prng_default()  # must precede PRNGKey creation (impl match)
+        self._key = jax.random.PRNGKey(seed)
+        self._counter = 0
+        self._since_prune = 0
+        self.tape: List[_TapeEntry] = []
+        self.grad_enabled = True
+
+    def next_key(self):
+        import jax
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+    def record(self, entry: _TapeEntry) -> None:
+        if not self.grad_enabled:
+            return
+        self.tape.append(entry)
+        self._since_prune += 1
+        if self._since_prune >= self._PRUNE_EVERY:
+            # drop unreachable entries so a long loop that never calls
+            # backward (eval without no_grad) doesn't pin every activation
+            self.tape = [e for e in self.tape if e.alive()]
+            self._since_prune = 0
+
+    def backward(self, root: "VarBase", retain_graph: bool = False) -> None:
+        import jax.numpy as jnp
+
+        grads: Dict[int, Any] = {id(root): jnp.ones_like(root.value)}
+        for entry in reversed(self.tape):
+            cots = []
+            any_live = False
+            for r, shape, dtype in entry.out_refs:
+                ov = r()
+                g = grads.get(id(ov)) if ov is not None else None
+                if g is None:
+                    cots.append(jnp.zeros(shape, dtype))
+                else:
+                    any_live = True
+                    cots.append(g.astype(dtype))
+            if not any_live:
+                continue
+            in_grads = entry.vjp_fn(tuple(cots))
+            for iv, g in zip(entry.in_vars, in_grads):
+                prev = grads.get(id(iv))
+                grads[id(iv)] = g if prev is None else prev + g
+        # Publish accumulated grads onto the VarBases (reference semantics:
+        # grads accumulate across backward calls until clear_gradients).
+        seen = set()
+        for entry in self.tape:
+            outs = [r() for r, _, _ in entry.out_refs]
+            for vb in list(entry.in_vars) + [o for o in outs if o is not None]:
+                if id(vb) in seen:
+                    continue
+                seen.add(id(vb))
+                g = grads.get(id(vb))
+                if g is not None and vb is not root:
+                    vb._grad = g if vb._grad is None else vb._grad + g
+        if not retain_graph:
+            self.tape.clear()
+
+
+class guard:
+    """Enable dygraph mode (fluid.dygraph.guard analog). `place` accepted
+    for source compatibility; JAX manages devices."""
+
+    def __init__(self, place=None, seed: int = 0):
+        self._tracer = Tracer(seed)
+        self._prev = None
+
+    def __enter__(self):
+        global _active_tracer
+        from ..framework.executor import _ensure_prng_default
+        _ensure_prng_default()
+        self._prev = _active_tracer
+        _active_tracer = self._tracer
+        return self
+
+    def __exit__(self, *exc):
+        global _active_tracer
+        _active_tracer = self._prev
+        return False
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Disable tape recording (dygraph.no_grad analog)."""
+    t = _tracer()
+    prev = t.grad_enabled
+    t.grad_enabled = False
+    try:
+        yield
+    finally:
+        t.grad_enabled = prev
+
+
+class VarBase:
+    """Eager tensor: a JAX device array + autograd state
+    (reference: imperative/layer.h:133 VarBase)."""
+
+    def __init__(self, value, name: Optional[str] = None,
+                 stop_gradient: bool = False, persistable: bool = False):
+        import jax.numpy as jnp
+        self.value = value if hasattr(value, "dtype") and hasattr(
+            value, "shape") and not isinstance(value, np.ndarray) \
+            else jnp.asarray(value)
+        self.name = name or unique_name("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = True
+        self._grad = None
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self) -> str:
+        return str(self.value.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def astype(self, dtype) -> "VarBase":
+        return trace_op("cast", {"X": [self]},
+                        {"out_dtype": convert_np_dtype(dtype)})["Out"][0]
+
+    def detach(self) -> "VarBase":
+        return VarBase(self.value, name=self.name + ".detach",
+                       stop_gradient=True)
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, retain_graph: bool = False) -> None:
+        _tracer().backward(self, retain_graph)
+
+    def gradient(self) -> Optional[np.ndarray]:
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def _grad_ivar(self):
+        return self._grad
+
+    def clear_gradient(self) -> None:
+        self._grad = None
+
+    # -- operator sugar ------------------------------------------------------
+    def _binary(self, other, op_type, reverse=False):
+        other = _as_varbase(other, like=self)
+        x, y = (other, self) if reverse else (self, other)
+        return trace_op(op_type, {"X": [x], "Y": [y]}, {"axis": -1})["Out"][0]
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
+    def __matmul__(self, o):
+        return trace_op("matmul", {"X": [self], "Y": [o]}, {})["Out"][0]
+
+    def __neg__(self):
+        return trace_op("scale", {"X": [self]}, {"scale": -1.0})["Out"][0]
+
+    def __len__(self):
+        return int(self.value.shape[0])
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, stop_gradient={self.stop_gradient})")
+
+
+def _as_varbase(v, like: Optional[VarBase] = None) -> VarBase:
+    import jax.numpy as jnp
+    if isinstance(v, VarBase):
+        return v
+    dtype = like.value.dtype if like is not None and isinstance(
+        v, (int, float)) else None
+    return VarBase(jnp.asarray(v, dtype=dtype), stop_gradient=True)
+
+
+def to_variable(value, name: Optional[str] = None,
+                block=None) -> VarBase:
+    """numpy array -> eager VarBase (fluid.dygraph.to_variable analog)."""
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        arr = arr.astype(np.int32)
+    return VarBase(arr, name=name)
+
+
+def trace_op(op_type: str, ins: Dict[str, Sequence[VarBase]],
+             attrs: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, List[VarBase]]:
+    """Run one op eagerly through its registered lowering rule and record
+    its vjp on the tape (reference: Tracer::Trace imperative/tracer.h:47).
+
+    The rng key is drawn eagerly and captured in the vjp closure, so
+    stateful ops (dropout) differentiate correctly without the static
+    path's custom grad makers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    attrs = dict(attrs or {})
+    opdef = get_op_def(op_type)
+    tracer = _tracer()
+    ins = {s: list(vbs) for s, vbs in ins.items() if vbs}
+    arrs = {s: [vb.value for vb in vbs] for s, vbs in ins.items()}
+    key = tracer.next_key()
+
+    record = tracer.grad_enabled and not opdef.not_differentiable
+    diff: List = []  # (slot, idx, VarBase)
+    if record:
+        for s, vbs in ins.items():
+            if s in opdef.no_grad_inputs:
+                continue
+            for i, vb in enumerate(vbs):
+                if not vb.stop_gradient and jnp.issubdtype(
+                        vb.value.dtype, jnp.inexact):
+                    diff.append((s, i, vb))
+        record = bool(diff)
+
+    def run(ins_arrays):
+        ctx = LowerContext(rng_key=key,
+                           is_test=bool(attrs.get("is_test", False)))
+        return opdef.lower(ctx, ins_arrays, attrs)
+
+    if not record:
+        outs = run(arrs)
+    else:
+        out_index: List = []
+
+        def f(*flat):
+            ins2 = {s: list(a) for s, a in arrs.items()}
+            for (s, i, _), v in zip(diff, flat):
+                ins2[s][i] = v
+            outs = run(ins2)
+            out_index.clear()
+            flat_outs = []
+            for slot in sorted(outs):
+                if slot in opdef.non_diff_outputs:
+                    continue
+                for j, v in enumerate(outs[slot]):
+                    if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact):
+                        out_index.append((slot, j))
+                        flat_outs.append(v)
+            return tuple(flat_outs), outs
+
+        primals = [vb.value for _, _, vb in diff]
+        flat_outs, vjp_fn, outs = jax.vjp(f, *primals, has_aux=True)
+        # rebind differentiable outputs to the vjp-traced primals
+        outs = {s: list(vs) for s, vs in outs.items()}
+        for (slot, j), v in zip(out_index, flat_outs):
+            outs[slot][j] = v
+
+    result: Dict[str, List[VarBase]] = {}
+    out_vbs_by_index: List[VarBase] = []
+    for slot in sorted(outs):
+        vbs = []
+        for j, v in enumerate(outs[slot]):
+            sg = (not record) or slot in opdef.non_diff_outputs or \
+                not jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)
+            vbs.append(VarBase(v, name=unique_name(f"{op_type}.out"),
+                               stop_gradient=sg))
+        result[slot] = vbs
+
+    if record:
+        for slot, j in out_index:
+            out_vbs_by_index.append(result[slot][j])
+        tracer.record(_TapeEntry(
+            lambda cots, _fn=vjp_fn: _fn(cots),
+            [vb for _, _, vb in diff], out_vbs_by_index))
+    return result

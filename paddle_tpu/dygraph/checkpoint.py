@@ -1,0 +1,43 @@
+"""save_dygraph / load_dygraph (reference: python/paddle/fluid/dygraph/
+checkpoint.py save_dygraph/load_dygraph). State dicts are stored as a
+single .npz per model/optimizer — the dygraph analog of the static path's
+save_persistables tensor files."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from .base import VarBase
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict: Dict[str, object], model_path: str) -> None:
+    """Save a Layer.state_dict() (or optimizer state dict) to
+    `model_path + '.pdparams'` (.npz container)."""
+    arrays = {}
+    for name, v in state_dict.items():
+        arrays[name] = np.asarray(v.value if isinstance(v, VarBase) else v)
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path: str):
+    """Returns (param_dict, optimizer_dict) like the reference API; the
+    optimizer dict is None unless one was saved alongside."""
+    path = model_path + ".pdparams.npz"
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with np.load(path) as data:
+        params = {k: data[k] for k in data.files}
+    opt_path = model_path + ".pdopt.npz"
+    opt = None
+    if os.path.exists(opt_path):
+        with np.load(opt_path) as data:
+            opt = {k: data[k] for k in data.files}
+    return params, opt

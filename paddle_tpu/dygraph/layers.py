@@ -1,0 +1,233 @@
+"""Layer: dygraph module base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py:31 (Layer) — parameter
+registration via __setattr__, sublayer tracking, state_dict, train/eval.
+Parameters are initialized eagerly (no startup program) by sampling the
+initializer distribution with the tracer's PRNG.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.core import unique_name
+from ..framework.layer_helper import ParamAttr
+from .base import VarBase, _tracer
+
+__all__ = ["Layer"]
+
+
+def _fan_in_out(shape) -> Tuple[int, int]:
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    # fluid convention: weight shapes are [in, out] for fc, [out, in, k, k]
+    # for conv; fan computed as in initializer.py:83 region
+    return shape[0] * receptive, shape[1] * receptive
+
+
+def eager_initialize(shape, dtype, initializer, key) -> "np.ndarray":
+    """Sample an initializer eagerly (the dygraph analog of running the
+    startup program's init ops; reference: initializer.py init ops)."""
+    import jax
+    import jax.numpy as jnp
+    from .. import initializer as I
+
+    shape = tuple(int(s) for s in shape)
+    if initializer is None:
+        initializer = I.Xavier()
+    if isinstance(initializer, I.ConstantInitializer):
+        return jnp.full(shape, initializer._value, dtype=dtype)
+    if isinstance(initializer, I.NumpyArrayInitializer):
+        return jnp.asarray(initializer._value, dtype=dtype).reshape(shape)
+    if isinstance(initializer, I.UniformInitializer):
+        return jax.random.uniform(key, shape, jnp.float32,
+                                  initializer._low,
+                                  initializer._high).astype(dtype)
+    if isinstance(initializer, I.TruncatedNormalInitializer):
+        return (initializer._mean + initializer._std * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, jnp.float32)).astype(dtype)
+    if isinstance(initializer, I.NormalInitializer):
+        return (initializer._mean + initializer._std *
+                jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+    if isinstance(initializer, I.XavierInitializer):
+        fi, fo = _fan_in_out(shape)
+        fi = initializer._fan_in if initializer._fan_in is not None else fi
+        fo = initializer._fan_out if initializer._fan_out is not None else fo
+        if initializer._uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return jax.random.uniform(key, shape, jnp.float32, -limit,
+                                      limit).astype(dtype)
+        std = float(np.sqrt(2.0 / (fi + fo)))
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+    if isinstance(initializer, I.MSRAInitializer):
+        fi, _ = _fan_in_out(shape)
+        fi = initializer._fan_in if initializer._fan_in is not None else fi
+        if initializer._uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return jax.random.uniform(key, shape, jnp.float32, -limit,
+                                      limit).astype(dtype)
+        std = float(np.sqrt(2.0 / fi))
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+    raise TypeError(f"unsupported initializer {initializer!r} in dygraph")
+
+
+class Layer:
+    """Base class for eager modules (fluid.dygraph.Layer analog).
+
+    `name_scope` is accepted positionally for source compatibility with the
+    fluid 1.5 constructor signature Layer(name_scope, dtype=...).
+    """
+
+    def __init__(self, name_scope: Optional[str] = None,
+                 dtype: str = "float32"):
+        base = name_scope or self.__class__.__name__.lower()
+        self._full_name = unique_name(base)
+        self._dtype = dtype
+        self._parameters: Dict[str, VarBase] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, VarBase] = collections.OrderedDict()
+        self.training = True
+
+    # -- naming --------------------------------------------------------------
+    def full_name(self) -> str:
+        return self._full_name
+
+    # -- mode ----------------------------------------------------------------
+    def train(self) -> "Layer":
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+        return self
+
+    def eval(self) -> "Layer":
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+        return self
+
+    # -- parameters ----------------------------------------------------------
+    def create_parameter(self, shape, dtype=None, attr=None,
+                         is_bias: bool = False, default_initializer=None
+                         ) -> Optional[VarBase]:
+        from .. import initializer as I
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer or (
+            I.Constant(0.0) if is_bias else I.Xavier())
+        name = attr.name or unique_name(
+            f"{self._full_name}.{'b' if is_bias else 'w'}")
+        value = eager_initialize(shape, dtype, init, _tracer().next_key())
+        p = VarBase(value, name=name, persistable=True)
+        p.trainable = attr.trainable
+        p.stop_gradient = not attr.trainable
+        p.regularizer = attr.regularizer
+        p.optimize_attrs = {"learning_rate": attr.learning_rate}
+        return p
+
+    def add_parameter(self, name: str, parameter: VarBase) -> VarBase:
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, value: VarBase) -> VarBase:
+        value.stop_gradient = True
+        value.persistable = True
+        self._buffers[name] = value
+        return value
+
+    def parameters(self, include_sublayers: bool = True) -> List[VarBase]:
+        return [p for _, p in self.named_parameters(include_sublayers)]
+
+    def named_parameters(self, include_sublayers: bool = True,
+                         prefix: str = "") -> Iterator[Tuple[str, VarBase]]:
+        for n, p in self._parameters.items():
+            if p is not None:
+                yield (f"{prefix}.{n}" if prefix else n), p
+        if include_sublayers:
+            for ln, l in self._sub_layers.items():
+                sub_prefix = f"{prefix}.{ln}" if prefix else ln
+                yield from l.named_parameters(True, sub_prefix)
+
+    def sublayers(self, include_sublayers: bool = True) -> List["Layer"]:
+        out = []
+        for l in self._sub_layers.values():
+            out.append(l)
+            if include_sublayers:
+                out.extend(l.sublayers(True))
+        return out
+
+    def clear_gradients(self) -> None:
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, include_sublayers: bool = True, prefix: str = ""
+                   ) -> Dict[str, VarBase]:
+        """Keys are structured (hierarchy-relative) names, so a state dict
+        loads into a fresh instance regardless of global unique-name
+        counters."""
+        d = collections.OrderedDict()
+        for n, p in self.named_parameters(include_sublayers, prefix):
+            d[n] = p
+        for n, b in self._named_buffers(include_sublayers, prefix):
+            d[n] = b
+        return d
+
+    def _named_buffers(self, include_sublayers=True, prefix=""):
+        for n, b in self._buffers.items():
+            yield (f"{prefix}.{n}" if prefix else n), b
+        if include_sublayers:
+            for ln, l in self._sub_layers.items():
+                yield from l._named_buffers(
+                    True, f"{prefix}.{ln}" if prefix else ln)
+
+    def set_dict(self, stat_dict: Dict[str, object]) -> None:
+        import jax.numpy as jnp
+        own = self.state_dict()
+        by_raw_name = {p.name: p for p in own.values()}
+        for name, value in stat_dict.items():
+            target = own.get(name) or by_raw_name.get(name)
+            if target is None:
+                continue
+            arr = value.value if isinstance(value, VarBase) else \
+                jnp.asarray(np.asarray(value))
+            target.value = arr.astype(target.value.dtype)
+
+    load_dict = set_dict
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    # -- attribute magic -----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and value.persistable and \
+                params is not None and not name.startswith("_"):
+            params[name] = value
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")

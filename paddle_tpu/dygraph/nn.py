@@ -1,0 +1,406 @@
+"""Eager layers (reference: python/paddle/fluid/dygraph/nn.py — Conv2D, FC,
+BatchNorm, Embedding, LayerNorm, GRUUnit, PRelu, GroupNorm, Pool2D,
+Conv2DTranspose) plus functional helpers. Forward passes execute the same op
+lowering rules as the static graph via trace_op, so eager and static results
+match bit-for-bit given the same params (the property the reference's
+test_imperative_* tests assert)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .base import VarBase, to_variable, trace_op
+from .layers import Layer
+
+__all__ = ["Conv2D", "Conv2DTranspose", "Pool2D", "FC", "Linear",
+           "BatchNorm", "Embedding", "LayerNorm", "GroupNorm", "PRelu",
+           "GRUUnit", "Dropout",
+           "relu", "sigmoid", "tanh", "softmax", "dropout", "reshape",
+           "concat", "reduce_mean", "reduce_sum", "mean", "cross_entropy",
+           "softmax_with_cross_entropy", "accuracy", "pool2d", "log_softmax"]
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 param_attr=None, bias_attr=None, act: Optional[str] = None,
+                 dtype: str = "float32", name_scope: Optional[str] = None):
+        super().__init__(name_scope or "conv2d", dtype)
+        self._act = act
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        fs = _pair(filter_size)
+        from ..initializer import Normal
+        std = float(np.sqrt(2.0 / (fs[0] * fs[1] * num_channels)))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fs, dtype, param_attr,
+            default_initializer=Normal(0.0, std))
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = trace_op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        return _act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 stride=1, padding=0, dilation=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32",
+                 name_scope: Optional[str] = None):
+        super().__init__(name_scope or "conv2d_transpose", dtype)
+        self._act = act
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation)}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters] + _pair(filter_size), dtype,
+            param_attr)
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = trace_op("conv2d_transpose",
+                       {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type: str = "max", pool_stride=1,
+                 pool_padding=0, global_pooling: bool = False,
+                 ceil_mode: bool = False, exclusive: bool = True,
+                 name_scope: Optional[str] = None):
+        super().__init__(name_scope or "pool2d")
+        self._attrs = {"ksize": _pair(pool_size), "pooling_type": pool_type,
+                       "strides": _pair(pool_stride),
+                       "paddings": _pair(pool_padding),
+                       "global_pooling": global_pooling,
+                       "ceil_mode": ceil_mode, "exclusive": exclusive}
+
+    def forward(self, x: VarBase) -> VarBase:
+        return trace_op("pool2d", {"X": [x]}, self._attrs)["Out"][0]
+
+
+class FC(Layer):
+    """fluid.dygraph.FC: lazy weight creation on first forward (input dim
+    unknown at construction), num_flatten_dims semantics of the mul op."""
+
+    def __init__(self, size: int, num_flatten_dims: int = 1, param_attr=None,
+                 bias_attr=None, act: Optional[str] = None,
+                 dtype: str = "float32", name_scope: Optional[str] = None):
+        super().__init__(name_scope or "fc", dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight: Optional[VarBase] = None
+        self.bias: Optional[VarBase] = None
+
+    def _build_once(self, x: VarBase) -> None:
+        in_dim = int(np.prod(x.shape[self._nfd:]))
+        self.weight = self.create_parameter([in_dim, self._size], self._dtype,
+                                            self._param_attr)
+        self.bias = self.create_parameter([self._size], self._dtype,
+                                          self._bias_attr, is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        if self.weight is None:
+            self._build_once(x)
+        out = trace_op("mul", {"X": [x], "Y": [self.weight]},
+                       {"x_num_col_dims": self._nfd,
+                        "y_num_col_dims": 1})["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": self._nfd})["Out"][0]
+        return _act(out, self._act)
+
+
+class Linear(Layer):
+    """Eager linear with explicit input_dim (the later-era Linear API)."""
+
+    def __init__(self, input_dim: int, output_dim: int, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__("linear", dtype)
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim], dtype,
+                                            param_attr)
+        self.bias = self.create_parameter([output_dim], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = trace_op("matmul", {"X": [x], "Y": [self.weight]}, {})["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                           {"axis": -1})["Out"][0]
+        return _act(out, self._act)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels: int, act=None, is_test: bool = False,
+                 momentum: float = 0.9, epsilon: float = 1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout: str = "NCHW", use_global_stats: bool = False,
+                 name_scope: Optional[str] = None):
+        super().__init__(name_scope or "batch_norm", dtype)
+        from ..initializer import Constant
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_channels], dtype, param_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], dtype, bias_attr,
+                                          is_bias=True)
+        self._mean = self.register_buffer("_mean", VarBase(
+            np.zeros([num_channels], dtype), name=self._full_name + ".mean",
+            stop_gradient=True, persistable=True))
+        self._variance = self.register_buffer("_variance", VarBase(
+            np.ones([num_channels], dtype), name=self._full_name + ".var",
+            stop_gradient=True, persistable=True))
+
+    def forward(self, x: VarBase) -> VarBase:
+        outs = trace_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            {"momentum": self._momentum, "epsilon": self._epsilon,
+             "data_layout": self._layout, "is_test": not self.training,
+             "use_global_stats": self._use_global_stats})
+        if self.training and not self._use_global_stats:
+            self._mean.value = outs["MeanOut"][0].value
+            self._variance.value = outs["VarianceOut"][0].value
+        return _act(outs["Y"][0], self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, size: Sequence[int], is_sparse: bool = False,
+                 padding_idx: Optional[int] = None, param_attr=None,
+                 dtype: str = "float32", name_scope: Optional[str] = None):
+        super().__init__(name_scope or "embedding", dtype)
+        from ..initializer import Uniform
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        scale = 1.0 / np.sqrt(size[1])
+        self.weight = self.create_parameter(
+            list(size), dtype, param_attr,
+            default_initializer=Uniform(-scale, scale))
+
+    def forward(self, ids: VarBase) -> VarBase:
+        return trace_op("lookup_table_v2",
+                        {"W": [self.weight], "Ids": [ids]},
+                        {"padding_idx": self._padding_idx})["Out"][0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale: bool = True,
+                 shift: bool = True, begin_norm_axis: int = 1,
+                 epsilon: float = 1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope or "layer_norm", dtype)
+        from ..initializer import Constant
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self._attrs = {"epsilon": epsilon, "begin_norm_axis": begin_norm_axis}
+        self._act = act
+        self.weight = self.create_parameter(
+            [n], dtype, param_attr,
+            default_initializer=Constant(1.0)) if scale else None
+        self.bias = self.create_parameter([n], dtype, bias_attr,
+                                          is_bias=True) if shift else None
+
+    def forward(self, x: VarBase) -> VarBase:
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _act(trace_op("layer_norm", ins, self._attrs)["Y"][0],
+                    self._act)
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels: int, groups: int, epsilon: float = 1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope or "group_norm", dtype)
+        from ..initializer import Constant
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+        self.weight = self.create_parameter(
+            [channels], dtype, param_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([channels], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _act(trace_op(
+            "group_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            self._attrs)["Y"][0], self._act)
+
+
+class PRelu(Layer):
+    def __init__(self, mode: str = "all", channel: Optional[int] = None,
+                 input_shape=None, param_attr=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope or "prelu", dtype)
+        from ..initializer import Constant
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)
+        self.weight = self.create_parameter(
+            shape, dtype, param_attr, default_initializer=Constant(0.25))
+
+    def forward(self, x: VarBase) -> VarBase:
+        return trace_op("prelu", {"X": [x], "Alpha": [self.weight]},
+                        {"mode": self._mode})["Out"][0]
+
+
+class GRUUnit(Layer):
+    """Single GRU step (reference: dygraph/nn.py GRUUnit / gru_unit_op.cc)."""
+
+    def __init__(self, size: int, param_attr=None, bias_attr=None,
+                 activation: str = "tanh", gate_activation: str = "sigmoid",
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "gru_unit", dtype)
+        self._size = size  # 3 * hidden
+        hidden = size // 3
+        self._hidden = hidden
+        self._act = activation
+        self._gate_act = gate_activation
+        self.weight = self.create_parameter([hidden, 3 * hidden], dtype,
+                                            param_attr)
+        self.bias = self.create_parameter([1, 3 * hidden], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, inputs: VarBase, hidden: VarBase) -> VarBase:
+        ins = {"Input": [inputs], "HiddenPrev": [hidden],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        outs = trace_op("gru_unit", ins,
+                        {"activation": self._act,
+                         "gate_activation": self._gate_act})
+        return outs["Hidden"][0]
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5):
+        super().__init__("dropout")
+        self._p = p
+
+    def forward(self, x: VarBase) -> VarBase:
+        if not self.training or self._p == 0.0:
+            return x
+        return dropout(x, self._p)
+
+
+# ---------------------------------------------------------------------------
+# functional helpers
+# ---------------------------------------------------------------------------
+
+def _act(x: VarBase, act: Optional[str]) -> VarBase:
+    if act is None:
+        return x
+    return trace_op(act, {"X": [x]}, {})["Out"][0]
+
+
+def relu(x):
+    return _act(x, "relu")
+
+
+def sigmoid(x):
+    return _act(x, "sigmoid")
+
+
+def tanh(x):
+    return _act(x, "tanh")
+
+
+def softmax(x, axis: int = -1):
+    return trace_op("softmax", {"X": [x]}, {"axis": axis})["Out"][0]
+
+
+def log_softmax(x, axis: int = -1):
+    return trace_op("log_softmax", {"X": [x]}, {"axis": axis})["Out"][0]
+
+
+def dropout(x, dropout_prob: float = 0.5):
+    return trace_op("dropout", {"X": [x]},
+                    {"dropout_prob": dropout_prob, "is_test": False,
+                     "dropout_implementation": "upscale_in_train"})["Out"][0]
+
+
+def reshape(x, shape):
+    return trace_op("reshape", {"X": [x]}, {"shape": list(shape)})["Out"][0]
+
+
+def concat(xs, axis: int = 0):
+    return trace_op("concat", {"X": list(xs)}, {"axis": axis})["Out"][0]
+
+
+def reduce_mean(x, dim=None, keep_dim: bool = False):
+    return trace_op("reduce_mean", {"X": [x]},
+                    {"dim": dim if dim is None else list(np.atleast_1d(dim)),
+                     "keep_dim": keep_dim,
+                     "reduce_all": dim is None})["Out"][0]
+
+
+def reduce_sum(x, dim=None, keep_dim: bool = False):
+    return trace_op("reduce_sum", {"X": [x]},
+                    {"dim": dim if dim is None else list(np.atleast_1d(dim)),
+                     "keep_dim": keep_dim,
+                     "reduce_all": dim is None})["Out"][0]
+
+
+def mean(x):
+    return trace_op("mean", {"X": [x]}, {})["Out"][0]
+
+
+def cross_entropy(input, label, soft_label: bool = False):
+    return trace_op("cross_entropy",
+                    {"X": [input], "Label": [label]},
+                    {"soft_label": soft_label})["Y"][0]
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False):
+    return trace_op("softmax_with_cross_entropy",
+                    {"Logits": [logits], "Label": [label]},
+                    {"soft_label": soft_label})["Loss"][0]
+
+
+def accuracy(input, label, k: int = 1):
+    topk = trace_op("top_k", {"X": [input]}, {"k": k})
+    return trace_op("accuracy",
+                    {"Out": [topk["Out"][0]], "Indices": [topk["Indices"][0]],
+                     "Label": [label]},
+                    {"k": k})["Accuracy"][0]
+
+
+def pool2d(x, pool_size=2, pool_type="max", pool_stride=2, pool_padding=0,
+           global_pooling=False):
+    return trace_op("pool2d", {"X": [x]},
+                    {"ksize": _pair(pool_size), "pooling_type": pool_type,
+                     "strides": _pair(pool_stride),
+                     "paddings": _pair(pool_padding),
+                     "global_pooling": global_pooling})["Out"][0]

@@ -109,7 +109,14 @@ class scope_guard:
 
 
 def _as_feed_array(value, var: Optional[Variable]):
+    import jax
     import jax.numpy as jnp
+    if isinstance(value, jax.Array):
+        # device-resident feed: no host round-trip
+        if var is not None and var.dtype is not None and \
+                str(value.dtype) != var.dtype:
+            value = value.astype(var.dtype)
+        return value
     arr = np.asarray(value)
     if var is not None and var.dtype is not None:
         arr = arr.astype(var.dtype, copy=False)
@@ -120,8 +127,12 @@ class Executor:
     """fluid.Executor analog. `place` is accepted for API compatibility but
     devices are managed by JAX; pass place=None for the default device."""
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, donate: bool = True):
+        """donate=False keeps input param buffers alive after run — needed
+        when callers hold aliases to scope arrays (the dygraph optimizer
+        path), at the cost of double-buffered updates."""
         self.place = place
+        self._donate = donate
         self._cache: Dict[Any, Any] = {}
         _ensure_prng_default()
 
@@ -201,16 +212,19 @@ class Executor:
             import jax
             scope.set_var("@RNG@", jax.random.PRNGKey(program.random_seed))
 
-        feed_sig = tuple(sorted(
-            (k, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
-            for k, v in feed.items()))
+        def _sig(v):
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return tuple(v.shape), str(v.dtype)
+            a = np.asarray(v)
+            return tuple(a.shape), str(a.dtype)
+
+        feed_sig = tuple(sorted((k,) + _sig(v) for k, v in feed.items()))
         cache_key = (id(program), program.version, feed_sig,
                      tuple(fetch_names), tuple(mutable), tuple(readonly),
                      id(dist_plan) if dist_plan else None)
         compiled = self._cache.get(cache_key)
         if compiled is None:
-            feed_shapes = {k: tuple(np.asarray(v).shape)
-                           for k, v in feed.items()}
+            feed_shapes = {k: _sig(v)[0] for k, v in feed.items()}
             compiled = self._compile(program, feed_shapes, fetch_names,
                                      mutable, created, readonly, dist_plan)
             self._cache[cache_key] = compiled
@@ -310,7 +324,7 @@ class Executor:
 
         if dist_plan is not None:
             return dist_plan.jit(fn, mutable, created, readonly, feed_shapes)
-        return jax.jit(fn, donate_argnums=(0,))
+        return jax.jit(fn, donate_argnums=(0,) if self._donate else ())
 
     # -- utilities -----------------------------------------------------------
     def close(self):

@@ -70,6 +70,7 @@ class LayerHelper:
         param = self.block.create_parameter(
             name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
             regularizer=attr.regularizer)
+        param.optimize_attrs["learning_rate"] = attr.learning_rate
         sb = self.startup_program.global_block
         sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True,
                       stop_gradient=True)

@@ -80,23 +80,11 @@ def _dynamic_gru(ctx, ins, attrs):
     lengths = ins["SequenceLength"][0] if "SequenceLength" in ins else None
     h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((b, h_size), x.dtype)
 
-    w_ur = w[:, :2 * h_size]
-    w_c = w[:, 2 * h_size:]
     xs = jnp.swapaxes(x, 0, 1)
 
     def step(carry, inp):
         h, t = carry
-        x_ur = inp[:, :2 * h_size]
-        x_c = inp[:, 2 * h_size:]
-        ur = x_ur + h @ w_ur
-        if bias is not None:
-            ur = ur + bias[:2 * h_size]
-        u, r = jnp.split(jax.nn.sigmoid(ur), 2, axis=-1)
-        cand = x_c + (r * h) @ w_c
-        if bias is not None:
-            cand = cand + bias[2 * h_size:]
-        cand = jnp.tanh(cand)
-        h_new = u * h + (1 - u) * cand
+        h_new, _, _ = _gru_cell(inp, h, w, bias)
         if lengths is not None:
             m = (t < lengths).astype(x.dtype)[:, None]
             h_new = m * h_new + (1 - m) * h
@@ -132,3 +120,37 @@ def _simple_rnn(ctx, ins, attrs):
 
     (h_last, _), hs = jax.lax.scan(step, (h0, jnp.zeros((), jnp.int32)), xs)
     return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+_ACTS = {"sigmoid": lambda v: jax.nn.sigmoid(v),
+         "tanh": lambda v: jnp.tanh(v),
+         "relu": lambda v: jax.nn.relu(v),
+         "identity": lambda v: v}
+
+
+def _gru_cell(x, h, w, bias, act="tanh", gate_act="sigmoid"):
+    """Shared GRU cell: x [b, 3h] pre-projected, w [h, 3h] packed
+    [update|reset|candidate], h_new = u*h + (1-u)*c (reference gru
+    convention, gru_op.cc / gru_unit_op.cc). Returns (h_new, gates, r*h)."""
+    h_size = h.shape[-1]
+    ur = x[:, :2 * h_size] + h @ w[:, :2 * h_size]
+    if bias is not None:
+        ur = ur + bias[:2 * h_size]
+    u, r = jnp.split(_ACTS[gate_act](ur), 2, axis=-1)
+    cand = x[:, 2 * h_size:] + (r * h) @ w[:, 2 * h_size:]
+    if bias is not None:
+        cand = cand + bias[2 * h_size:]
+    c = _ACTS[act](cand)
+    h_new = u * h + (1 - u) * c
+    return h_new, jnp.concatenate([u, r, c], axis=-1), r * h
+
+
+@register_op("gru_unit", non_diff_outputs={"Gate", "ResetHiddenPrev"})
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (reference: gru_unit_op.cc)."""
+    h_new, gate, rh = _gru_cell(
+        ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0],
+        ins["Bias"][0].reshape(-1) if "Bias" in ins else None,
+        attrs.get("activation", "tanh"),
+        attrs.get("gate_activation", "sigmoid"))
+    return {"Hidden": [h_new], "Gate": [gate], "ResetHiddenPrev": [rh]}

@@ -92,12 +92,75 @@ class Optimizer:
                  startup_program: Optional[Program] = None,
                  parameter_list: Optional[Sequence[str]] = None,
                  no_grad_set=None):
+        from .dygraph import base as _dy
+        if _dy.enabled():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, parameter_list=parameter_list,
                                      no_grad_set=no_grad_set)
         opt_ops = self.apply_gradients(
             params_grads, loss.block.program,
             startup_program or default_startup_program())
         return opt_ops, params_grads
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Eager update (reference: dygraph path of optimizer.minimize).
+
+        Reuses the static optimize-op builders: on first call, the update
+        ops for this parameter set are appended to a throwaway Program via
+        _append_optimize_op, jitted once by the Executor, and then run each
+        step against a private scope that holds the accumulators. User must
+        have called loss.backward() first (grads live on the VarBases)."""
+        from .framework.executor import Executor, Scope, scope_guard
+
+        if parameter_list is None:
+            raise ValueError(
+                "dygraph minimize requires parameter_list (e.g. "
+                "model.parameters())")
+        params = [p for p in parameter_list
+                  if p.trainable and p._grad is not None]
+        if not params:
+            return [], []
+        sig = tuple((p.name, p.shape, str(p.dtype)) for p in params)
+        state = self.__dict__.setdefault("_dy_state", {})
+        entry = state.get(sig)
+        if entry is None:
+            if isinstance(self._learning_rate, Variable):
+                raise TypeError("dygraph mode needs a numeric learning rate")
+            from .framework import program_guard
+            main, startup = Program(), Program()
+            self._accumulators = {}
+            lr_backup = self._learning_rate
+            with program_guard(main, startup):
+                pgs = []
+                for p in params:
+                    pv = main.global_block.create_parameter(
+                        name=p.name, shape=p.shape, dtype=str(p.dtype),
+                        regularizer=getattr(p, "regularizer", None))
+                    pv.optimize_attrs.update(
+                        getattr(p, "optimize_attrs", {}))
+                    gv = main.global_block.create_var(
+                        name=p.name + "@GRAD", shape=p.shape,
+                        dtype=str(p.dtype))
+                    pgs.append((pv, gv))
+                self.apply_gradients(pgs, main, startup)
+            self._learning_rate = lr_backup  # keep float for future builds
+            scope = Scope()
+            # no donation: eager code may hold aliases of p.value (detach,
+            # saved refs); donating would delete those buffers under them
+            exe = Executor(donate=False)
+            with scope_guard(scope):
+                exe.run(startup)
+            entry = (main, exe, scope)
+            state[sig] = entry
+        main, exe, scope = entry
+        for p in params:
+            scope.set_var(p.name, p.value)
+        feed = {p.name + "@GRAD": p._grad for p in params}
+        with scope_guard(scope):
+            exe.run(main, feed=feed)
+        for p in params:
+            p.value = scope.find_var(p.name)
+        return [], [(p, p._grad) for p in params]
 
     def backward(self, loss, parameter_list=None, no_grad_set=None,
                  callbacks=None):
@@ -118,12 +181,25 @@ class Optimizer:
         ops = []
         for p, g in params_grads:
             self._create_accumulators(p, startup)
-            ops.append(self._append_optimize_op(block, p, g, lr))
+            ops.append(self._append_optimize_op(
+                block, p, g, self._param_lr(block, lr, p)))
         self._finish_update(block, params_grads, startup)
         # tag everything appended here so clone(for_test=True) prunes it
         for op in block.ops[n_before:]:
             op.attrs.setdefault("op_role", "optimize")
         return ops
+
+    def _param_lr(self, block, lr: Variable, param) -> Variable:
+        """Per-parameter LR multiplier (ParamAttr.learning_rate; reference:
+        optimizer.py _create_param_lr)."""
+        mult = getattr(param, "optimize_attrs", {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return lr
+        v = block.create_var(name=unique_name(f"{param.name}/lr"),
+                             shape=(1,), dtype="float32", stop_gradient=True)
+        block.append_op("scale", {"X": [lr.name]}, {"Out": [v.name]},
+                        {"scale": float(mult)})
+        return v
 
     def _finish_update(self, block, params_grads, startup):
         pass

@@ -1,0 +1,240 @@
+"""Dygraph (eager) mode tests.
+
+Mirrors the reference's test_imperative_*.py suites: basic autograd,
+layers, eager-vs-static parity, optimizer updates, save/load."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph
+
+
+def test_to_variable_and_numpy():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert x.shape == (2, 3)
+        np.testing.assert_allclose(
+            x.numpy(), np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_basic_autograd():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0, 3.0], np.float32))
+        y = x * x + x  # dy/dx = 2x + 1
+        loss = dygraph.nn.reduce_sum(y)
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), [5.0, 7.0], rtol=1e-6)
+
+
+def test_grad_accumulation_and_clear():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones(3, np.float32))
+        for expect in (3.0, 6.0):
+            y = dygraph.nn.reduce_sum(x * 3.0)
+            y.backward()
+            np.testing.assert_allclose(x.gradient(), [expect] * 3, rtol=1e-6)
+        x.clear_gradient()
+        assert x.gradient() is None
+
+
+def test_no_grad():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones(2, np.float32))
+        with dygraph.no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+
+
+def test_stop_gradient_blocks_flow():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones(2, np.float32))
+        d = (x * 2.0).detach()
+        z = dygraph.nn.reduce_sum(d * x)
+        z.backward()
+        # only the direct x path contributes: dz/dx = d = 2
+        np.testing.assert_allclose(x.gradient(), [2.0, 2.0], rtol=1e-6)
+
+
+def test_linear_matches_numpy():
+    with dygraph.guard():
+        fc = dygraph.Linear(4, 3)
+        x = dygraph.to_variable(np.random.RandomState(0)
+                                .randn(2, 4).astype(np.float32))
+        out = fc(x)
+        ref = x.numpy() @ fc.weight.numpy() + fc.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv_pool_batchnorm_forward_shapes():
+    with dygraph.guard():
+        conv = dygraph.Conv2D(3, 8, 3, padding=1)
+        pool = dygraph.Pool2D(2, "max", 2)
+        bn = dygraph.BatchNorm(8)
+        x = dygraph.to_variable(
+            np.random.randn(2, 3, 8, 8).astype(np.float32))
+        h = bn(pool(conv(x)))
+        assert h.shape == (2, 8, 4, 4)
+        # train-mode BN updated running stats
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        h2 = bn(pool(conv(x)))
+        assert h2.shape == (2, 8, 4, 4)
+
+
+def test_embedding_padding_idx():
+    with dygraph.guard():
+        emb = dygraph.Embedding([10, 4], padding_idx=0)
+        ids = dygraph.to_variable(np.array([[0, 3]], np.int64))
+        out = emb(ids)
+        np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4), atol=0)
+
+
+def test_layer_parameter_registration():
+    with dygraph.guard():
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = dygraph.Linear(4, 8)
+                self.fc2 = dygraph.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(dygraph.nn.relu(self.fc1(x)))
+
+        net = Net()
+        assert len(net.parameters()) == 4
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc1.weight" in names and "fc2.bias" in names
+        assert len(net.sublayers()) == 2
+
+
+def test_sgd_training_converges():
+    rng = np.random.RandomState(7)
+    xs = rng.randn(64, 4).astype(np.float32)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    ys = xs @ w_true
+
+    with dygraph.guard():
+        fc = dygraph.Linear(4, 1)
+        opt = pt.optimizer.SGDOptimizer(learning_rate=0.1)
+        first = None
+        for _ in range(60):
+            x = dygraph.to_variable(xs)
+            y = dygraph.to_variable(ys)
+            pred = fc(x)
+            loss = dygraph.nn.reduce_mean((pred - y) * (pred - y))
+            loss.backward()
+            opt.minimize(loss, parameter_list=fc.parameters())
+            fc.clear_gradients()
+            if first is None:
+                first = float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.05
+
+
+def test_adam_training_step_changes_params():
+    with dygraph.guard():
+        fc = dygraph.Linear(3, 2)
+        before = fc.weight.numpy().copy()
+        opt = pt.optimizer.AdamOptimizer(learning_rate=0.01)
+        x = dygraph.to_variable(np.ones((4, 3), np.float32))
+        loss = dygraph.nn.reduce_mean(fc(x))
+        loss.backward()
+        opt.minimize(loss, parameter_list=fc.parameters())
+        assert not np.allclose(fc.weight.numpy(), before)
+
+
+def test_eager_static_parity_mlp():
+    """Same params -> same loss in eager and static mode (the reference's
+    test_imperative_mnist-style parity check)."""
+    rng = np.random.RandomState(3)
+    x_np = rng.randn(8, 16).astype(np.float32)
+    y_np = rng.randint(0, 10, (8, 1)).astype(np.int64)
+
+    with dygraph.guard():
+        fc1 = dygraph.Linear(16, 32, act="relu")
+        fc2 = dygraph.Linear(32, 10)
+        x = dygraph.to_variable(x_np)
+        y = dygraph.to_variable(y_np)
+        logits = fc2(fc1(x))
+        loss = dygraph.nn.reduce_mean(
+            dygraph.nn.softmax_with_cross_entropy(logits, y))
+        eager_loss = float(loss.numpy())
+        w1, b1 = fc1.weight.numpy(), fc1.bias.numpy()
+        w2, b2 = fc2.weight.numpy(), fc2.bias.numpy()
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xv = pt.layers.data("x", [16])
+        yv = pt.layers.data("y", [1], dtype="int64")
+        h = pt.layers.fc(xv, 32, act="relu",
+                         param_attr=pt.ParamAttr(
+                             name="w1",
+                             initializer=pt.initializer.NumpyArrayInitializer(w1)),
+                         bias_attr=pt.ParamAttr(
+                             name="b1",
+                             initializer=pt.initializer.NumpyArrayInitializer(b1)))
+        logits = pt.layers.fc(h, 10,
+                              param_attr=pt.ParamAttr(
+                                  name="w2",
+                                  initializer=pt.initializer.NumpyArrayInitializer(w2)),
+                              bias_attr=pt.ParamAttr(
+                                  name="b2",
+                                  initializer=pt.initializer.NumpyArrayInitializer(b2)))
+        loss_v = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, yv))
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        static_loss = exe.run(main, feed={"x": x_np, "y": y_np},
+                              fetch_list=[loss_v])[0]
+    np.testing.assert_allclose(eager_loss, float(static_loss),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dropout_train_eval():
+    with dygraph.guard():
+        drop = dygraph.Dropout(0.5)
+        x = dygraph.to_variable(np.ones((100,), np.float32))
+        out = drop(x)
+        assert (out.numpy() == 0).any()
+        drop.eval()
+        np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_save_load_dygraph(tmp_path):
+    with dygraph.guard():
+        fc = dygraph.Linear(4, 2)
+        path = str(tmp_path / "model")
+        dygraph.save_dygraph(fc.state_dict(), path)
+        w_orig = fc.weight.numpy().copy()
+        # perturb then restore
+        fc.weight.value = fc.weight.value * 0.0
+        params, opt = dygraph.load_dygraph(path)
+        fc.set_dict(params)
+        np.testing.assert_allclose(fc.weight.numpy(), w_orig)
+        assert opt is None
+
+
+def test_gru_unit_step():
+    with dygraph.guard():
+        gru = dygraph.GRUUnit(3 * 5)
+        x = dygraph.to_variable(np.random.randn(2, 15).astype(np.float32))
+        h = dygraph.to_variable(np.zeros((2, 5), np.float32))
+        h1 = gru(x, h)
+        assert h1.shape == (2, 5)
+        loss = dygraph.nn.reduce_sum(h1)
+        loss.backward()
+        assert gru.weight.gradient() is not None
+
+
+def test_varbase_operators():
+    with dygraph.guard():
+        a = dygraph.to_variable(np.array([4.0], np.float32))
+        b = dygraph.to_variable(np.array([2.0], np.float32))
+        assert float((a + b).numpy()) == 6.0
+        assert float((a - b).numpy()) == 2.0
+        assert float((a * b).numpy()) == 8.0
+        assert float((a / b).numpy()) == 2.0
+        assert float((1.0 - b).numpy()) == -1.0
+        assert float((-a).numpy()) == -4.0
+        assert float((a ** b).numpy()) == 16.0
