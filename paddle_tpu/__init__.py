@@ -24,6 +24,9 @@ from . import metrics
 from . import profiler
 from . import contrib
 from . import dygraph
+from . import transpiler
+from . import incubate
+from . import distributed
 from .framework.executor import as_jax_function
 
 __version__ = "0.1.0"

@@ -76,9 +76,26 @@ class CompiledProgram:
         self._feed_shardings = dict(feed_shardings or {})
         return self
 
+    def with_collective(self, nranks: Optional[int] = None,
+                        axis_name: str = "dp"):
+        """Explicit-SPMD mode: run the block under shard_map so program-level
+        c_* collective ops (layers/collective.py) perform the communication —
+        the analog of multi-process collective training
+        (transpiler/collective.py + distributed.launch). The program must
+        carry its own gradient c_allreduce ops (fleet.CollectiveOptimizer
+        inserts them)."""
+        self._dp = True
+        self._collective = (nranks, axis_name)
+        return self
+
     def _plan(self):
         if not self._dp:
             return None
+        if self._plan_obj is None and getattr(self, "_collective", None):
+            from .parallel.plan import CollectiveSpmdPlan
+            nranks, axis_name = self._collective
+            self._plan_obj = CollectiveSpmdPlan(nranks=nranks,
+                                                axis_name=axis_name)
         if self._plan_obj is None:
             from .parallel.plan import ShardingPlan
             self._plan_obj = ShardingPlan(

@@ -437,6 +437,8 @@ class Program:
         p.random_seed = self.random_seed
         if not for_test:
             p._pipeline = self._pipeline  # test clones prune backward anyway
+            if getattr(self, "_collective_nranks", None) is not None:
+                p._collective_nranks = self._collective_nranks
         p._bump_version()
         return p
 

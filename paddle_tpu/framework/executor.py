@@ -154,6 +154,22 @@ class Executor:
 
         scope = scope or global_scope()
         feed = feed or {}
+
+        # Collective-transpiled programs carry the replica count they were
+        # rewritten for; running on a different mesh width silently mis-
+        # scales gradients, so refuse.
+        transpiled_n = getattr(program, "_collective_nranks", None)
+        if transpiled_n is not None:
+            spmd_axes = getattr(dist_plan, "spmd_axes", ()) \
+                if dist_plan else ()
+            mesh_n = (int(dist_plan.mesh.shape[spmd_axes[0]])
+                      if spmd_axes else 1)
+            if mesh_n != transpiled_n:
+                raise ValueError(
+                    f"program was collective-transpiled for "
+                    f"{transpiled_n} replicas but is running on "
+                    f"{mesh_n} mesh shard(s); use CompiledProgram"
+                    f".with_collective(nranks={transpiled_n})")
         fetch_names = [f.name if isinstance(f, Variable) else f
                        for f in (fetch_list or [])]
 
@@ -302,7 +318,9 @@ class Executor:
             env.update(mut_scope)
             env.update(feed_vals)
             ctx = LowerContext(rng_key=rng_key,
-                               mesh=dist_plan.mesh if dist_plan else None)
+                               mesh=dist_plan.mesh if dist_plan else None,
+                               spmd_axes=getattr(dist_plan, "spmd_axes", ())
+                               if dist_plan else ())
             finite_flags = {}
             for i, op in enumerate(ops):
                 lower_op(ctx, op, env)

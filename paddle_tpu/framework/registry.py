@@ -108,12 +108,16 @@ class LowerContext:
     """
 
     def __init__(self, rng_key=None, is_test: bool = False,
-                 abstract: bool = False, mesh=None):
+                 abstract: bool = False, mesh=None, spmd_axes=()):
         self._rng_key = rng_key
         self._counter = 0
         self.is_test = is_test
         self.abstract = abstract  # True during eval_shape inference
         self.mesh = mesh          # jax.sharding.Mesh when running sharded
+        # mesh axis names live under an enclosing shard_map (explicit-SPMD
+        # execution mode): collective ops (c_allreduce_* ...) lower to named
+        # lax collectives over these axes; empty = GSPMD/single-device mode
+        self.spmd_axes = tuple(spmd_axes)
 
     def rng(self):
         import jax
@@ -215,7 +219,7 @@ def _lower_grad_op(ctx: LowerContext, op: Operator, env: Dict[str, Any]):
             if s not in ins:
                 ins[s] = [env[n] for n in names]
         sub_ctx = LowerContext(is_test=ctx.is_test, abstract=ctx.abstract,
-                               mesh=ctx.mesh)
+                               mesh=ctx.mesh, spmd_axes=ctx.spmd_axes)
         outs = opdef.lower(sub_ctx, ins, op.attrs)
         out_index.clear()
         flat_outs = []

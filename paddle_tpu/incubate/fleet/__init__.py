@@ -1,0 +1,2 @@
+"""Fleet: unified distributed-training API (reference:
+python/paddle/fluid/incubate/fleet/)."""

@@ -15,3 +15,4 @@ from . import learning_rate_scheduler  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
+from . import collective  # noqa: F401

@@ -1,0 +1,81 @@
+"""Collective communication layers.
+
+Reference: python/paddle/fluid/layers/collective.py (_c_allreduce:64,
+_c_allgather:108, _c_reducescatter, _c_broadcast). Used by the collective
+transpiler (transpiler/collective.py) and available for manual SPMD
+programming under CompiledProgram.with_collective.
+"""
+
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["_c_allreduce", "_c_allgather", "_c_reducescatter", "_c_broadcast",
+           "_c_identity", "_c_sync_calc_stream", "_c_sync_comm_stream"]
+
+
+def _c_allreduce(x, out=None, reduce_type="sum", ring_id=0,
+                 use_calc_stream=False):
+    helper = LayerHelper("c_allreduce")
+    if reduce_type not in ("sum", "prod", "max", "min"):
+        raise TypeError(f"reduce type {reduce_type!r} can only be"
+                        " sum, prod, max or min")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(f"c_allreduce_{reduce_type}", {"X": [x.name]},
+                     {"Out": [out.name]},
+                     {"ring_id": ring_id,
+                      "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_allgather(x, nranks, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_allgather")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("c_allgather", {"X": [x.name]}, {"Out": [out.name]},
+                     {"nranks": nranks, "ring_id": ring_id,
+                      "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_reducescatter(x, nranks, ring_id=0, use_calc_stream=False):
+    if x.shape[0] is not None and x.shape[0] > 0 and x.shape[0] % nranks != 0:
+        raise ValueError(f"x.shape[0]({x.shape[0]}) must be divisible by "
+                         f"nranks({nranks})")
+    helper = LayerHelper("c_reducescatter")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("c_reducescatter", {"X": [x.name]}, {"Out": [out.name]},
+                     {"nranks": nranks, "ring_id": ring_id,
+                      "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_broadcast(x, root=0, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_broadcast")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("c_broadcast", {"X": [x.name]}, {"Out": [out.name]},
+                     {"root": root, "ring_id": ring_id,
+                      "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_identity(x, ring_id=0):
+    helper = LayerHelper("c_identity")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("c_identity", {"X": [x.name]}, {"Out": [out.name]},
+                     {"ring_id": ring_id})
+    return out
+
+
+def _c_sync_calc_stream(x):
+    helper = LayerHelper("c_sync_calc_stream")
+    helper.append_op("c_sync_calc_stream", {"X": [x.name]},
+                     {"Out": [x.name]}, {})
+    return x
+
+
+def _c_sync_comm_stream(x, ring_id=0):
+    helper = LayerHelper("c_sync_comm_stream")
+    helper.append_op("c_sync_comm_stream", {"X": [x.name]},
+                     {"Out": [x.name]}, {"ring_id": ring_id})
+    return x

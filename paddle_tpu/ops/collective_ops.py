@@ -1,0 +1,172 @@
+"""Program-level collective ops (c_* family).
+
+Reference: paddle/fluid/operators/collective/ — c_allreduce_{sum,max,min,prod}
+(c_allreduce_op.h), c_allgather, c_reducescatter, c_broadcast, c_comm_init_op,
+c_sync_calc_stream_op, c_sync_comm_stream_op — NCCL collectives keyed by
+ring_id for multi-ring communication.
+
+TPU redesign: rings map to mesh axis names. Under the explicit-SPMD execution
+mode (CompiledProgram.with_collective -> shard_map over the mesh, see
+parallel/plan.py CollectiveSpmdPlan) these lower to named lax collectives
+riding ICI (psum / all_gather / psum_scatter / ppermute). Outside SPMD
+(single device, or GSPMD mode where the compiler inserts collectives itself)
+they are identities — matching the reference's single-trainer behavior where
+nranks == 1 collapses the collective.
+
+There is no c_gen_nccl_id / c_comm_init bootstrap problem on TPU: the JAX
+runtime owns device topology, so ring registration is just a name-table entry
+(init_ring below).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..framework.registry import register_op
+
+__all__ = ["init_ring", "ring_axis"]
+
+# ring_id -> mesh axis name. Ring 0 is the default data-parallel ring, the
+# analog of the reference's default NCCL communicator (ring_id attr of every
+# collective/*.cc op).
+_RINGS: Dict[int, str] = {0: "dp"}
+
+
+def init_ring(ring_id: int, axis_name: str) -> None:
+    """Register a communication ring = mesh axis (c_comm_init_op analog)."""
+    _RINGS[int(ring_id)] = axis_name
+
+
+def ring_axis(ring_id: int) -> str:
+    return _RINGS.get(int(ring_id), "dp")
+
+
+def _active_axis(ctx, attrs):
+    """Resolve the op's ring to a live SPMD axis, or None when the op should
+    collapse to identity (single device / GSPMD mode). A ring whose
+    registered axis is not live falls back to the (sole) live SPMD axis —
+    all rings ride the same ICI fabric, so a program transpiled for ring 0
+    works unchanged under with_collective(axis_name='mp')."""
+    axis = attrs.get("axis_name") or ring_axis(attrs.get("ring_id", 0))
+    if ctx.abstract:
+        # shape inference: collectives are shape-preserving except
+        # allgather/reducescatter, which handle abstract mode themselves
+        return None
+    if axis in ctx.spmd_axes:
+        return axis
+    if ctx.spmd_axes:
+        return ctx.spmd_axes[0]
+    return None
+
+
+def _spmd_size(ctx, attrs) -> int:
+    """World size of the op's ring under SPMD, else the static nranks attr."""
+    axis = attrs.get("axis_name") or ring_axis(attrs.get("ring_id", 0))
+    if ctx.mesh is not None and axis in ctx.mesh.shape:
+        return int(ctx.mesh.shape[axis])
+    return int(attrs.get("nranks", 1))
+
+
+def _register_allreduce(kind, fn_name):
+    @register_op(f"c_allreduce_{kind}")
+    def _(ctx, ins, attrs, _fn=fn_name):
+        import jax
+        x = ins["X"][0]
+        axis = _active_axis(ctx, attrs)
+        if axis is None:
+            return {"Out": [x]}
+        return {"Out": [getattr(jax.lax, _fn)(x, axis)]}
+
+
+_register_allreduce("sum", "psum")
+_register_allreduce("max", "pmax")
+_register_allreduce("min", "pmin")
+
+
+@register_op("c_allreduce_prod")
+def _c_allreduce_prod(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+    x = ins["X"][0]
+    axis = _active_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    # no lax.pprod; product = exp(psum(log)) is unstable, use all_gather+prod
+    g = jax.lax.all_gather(x, axis)
+    return {"Out": [jnp.prod(g, axis=0)]}
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    """Concatenate shards along dim 0 (reference c_allgather_op.h: output
+    leading dim = nranks * local)."""
+    import jax
+    import jax.numpy as jnp
+    x = ins["X"][0]
+    axis = _active_axis(ctx, attrs)
+    if axis is None:
+        n = _spmd_size(ctx, attrs)
+        if n == 1:
+            return {"Out": [x]}
+        # abstract/shape-inference path: result shape as if gathered
+        return {"Out": [jnp.tile(x, (n,) + (1,) * (x.ndim - 1))]}
+    return {"Out": [jax.lax.all_gather(x, axis, tiled=True)]}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    """Sum across the ring, scatter along dim 0 (reference
+    c_reducescatter_op.cc: out dim0 = in dim0 / nranks)."""
+    import jax
+    x = ins["X"][0]
+    axis = _active_axis(ctx, attrs)
+    if axis is None:
+        n = _spmd_size(ctx, attrs)
+        if n == 1:
+            return {"Out": [x]}
+        return {"Out": [x[: x.shape[0] // n]]}
+    return {"Out": [jax.lax.psum_scatter(x, axis, tiled=True)]}
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    """Every shard gets root's value (reference c_broadcast_op.h).
+    Lowered as psum of the root-masked value — O(1) memory per shard,
+    unlike all_gather+index which would materialize nranks copies."""
+    import jax
+    import jax.numpy as jnp
+    x = ins["X"][0]
+    axis = _active_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    root = int(attrs.get("root", 0))
+    is_root = jax.lax.axis_index(axis) == root
+    masked = jnp.where(is_root, x, jnp.zeros_like(x))
+    if jnp.issubdtype(masked.dtype, jnp.bool_):
+        return {"Out": [jax.lax.psum(masked.astype(jnp.int32), axis)
+                        .astype(jnp.bool_)]}
+    return {"Out": [jax.lax.psum(masked, axis)]}
+
+
+@register_op("c_identity")
+def _c_identity(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+# Stream-ordering ops: XLA schedules collectives itself; these exist so
+# reference programs (transpiler/collective.py inserts them around every
+# c_allreduce) lower cleanly as no-ops.
+@register_op("c_sync_calc_stream")
+def _c_sync_calc(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_sync_comm_stream")
+def _c_sync_comm(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_comm_init")
+def _c_comm_init(ctx, ins, attrs):
+    # ring registration is host-side (init_ring); in-graph it is a no-op
+    return {}

@@ -54,7 +54,8 @@ def _while(ctx, op, env):
     def body_fn(c):
         body_env = dict(env)
         body_env.update({k: v for k, v in c.items() if k != "@iter@"})
-        body_ctx = LowerContext(is_test=ctx.is_test, mesh=ctx.mesh)
+        body_ctx = LowerContext(is_test=ctx.is_test, mesh=ctx.mesh,
+                                spmd_axes=ctx.spmd_axes)
         # per-iteration rng stream keyed on the loop counter
         body_ctx._rng_key = jax.random.fold_in(base_key, c["@iter@"])
         _run_block(sub, body_env, body_ctx)
@@ -85,7 +86,8 @@ def _cond_block(ctx, op, env):
             benv = dict(env)
             bctx = LowerContext(rng_key=ctx.rng() if not ctx.abstract
                                 else None,
-                                is_test=ctx.is_test, mesh=ctx.mesh)
+                                is_test=ctx.is_test, mesh=ctx.mesh,
+                                spmd_axes=ctx.spmd_axes)
             _run_block(block, benv, bctx)
             return [benv[r] for r in rets]
         return branch

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
-__all__ = ["ShardingPlan"]
+__all__ = ["ShardingPlan", "CollectiveSpmdPlan"]
 
 
 class ShardingPlan:
@@ -117,3 +117,76 @@ class ShardingPlan:
             in_shardings=(mut_sh, ro_sh, feed_sh, rep),
             out_shardings=(out_sh, None, rep, None),
             donate_argnums=(0,))
+
+
+class CollectiveSpmdPlan(ShardingPlan):
+    """Explicit-SPMD execution: the whole block runs under shard_map over a
+    mesh axis, so each shard executes the program replica-style — the
+    TPU-native analog of the reference's one-process-per-device collective
+    mode (transpiler/collective.py GradAllReduce + paddle.distributed.launch).
+
+    Unlike the GSPMD ShardingPlan (where the compiler inserts gradient
+    reductions), nothing is synchronized implicitly: programs must carry
+    explicit c_allreduce_* ops on their gradients (inserted by
+    fleet.CollectiveOptimizer or transpiler.collective.GradAllReduce),
+    exactly as reference multi-process programs must. The c_* lowering rules
+    (ops/collective_ops.py) see `spmd_axes` on the LowerContext and emit
+    psum/all_gather/... over the named axis, which XLA maps onto ICI rings.
+    """
+
+    def __init__(self, nranks: Optional[int] = None, axis_name: str = "dp",
+                 devices=None):
+        super().__init__(mesh_shape=None, axis_names=(axis_name,),
+                         places=nranks, devices=devices)
+        self.spmd_axes = (axis_name,)
+
+    def constrain(self, op, env) -> None:
+        pass  # inside shard_map there are no global shardings to assert
+
+    def jit(self, fn, mutable, created, readonly, feed_shapes):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.spmd_axes[0]
+        n = self.mesh.shape[axis]
+
+        def feed_spec(shape):
+            return P(axis) if shape and shape[0] % n == 0 else P()
+
+        feed_specs = {k: feed_spec(s) for k, s in feed_shapes.items()}
+        mut_specs = {k: P() for k in mutable}
+        ro_specs = {k: P() for k in readonly}
+        out_mut_specs = {k: P() for k in list(mutable) + list(created)}
+
+        def spmd_fn(mut, ro, feed, key):
+            # per-shard rng stream (dropout masks differ across replicas,
+            # like per-trainer seeds in the reference)
+            local_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            new_mut, fetches, _, flags = fn(mut, ro, feed, local_key)
+            # fetch semantics match single-process training: scalar float
+            # fetches (losses/metrics on the sharded batch) are averaged
+            # over shards; everything else is gathered along dim 0 so the
+            # full batch is reassembled in order — the analog of the
+            # reference's FetchOpHandle merging per-device fetch tensors
+            # (details/fetch_op_handle.cc)
+            outs = []
+            for f in fetches:
+                f = jnp.asarray(f)
+                if f.size == 1 and jnp.issubdtype(f.dtype, jnp.inexact):
+                    outs.append(jax.lax.pmean(f, axis))
+                elif f.ndim == 0:
+                    outs.append(jax.lax.pmax(f, axis))
+                else:
+                    outs.append(jax.lax.all_gather(f, axis, tiled=True))
+            flags = {k: jax.lax.pmin(jnp.asarray(v).astype(jnp.int32), axis)
+                     for k, v in flags.items()}
+            new_key = jax.random.fold_in(key, 0x5eed)  # from the global key
+            return new_mut, outs, new_key, flags
+
+        smapped = jax.shard_map(
+            spmd_fn, mesh=self.mesh,
+            in_specs=(mut_specs, ro_specs, feed_specs, P()),
+            out_specs=(out_mut_specs, P(), P(), P()),
+            check_vma=False)
+        return jax.jit(smapped, donate_argnums=(0,))

@@ -1,0 +1,5 @@
+"""Program transpilers (reference: python/paddle/fluid/transpiler/)."""
+
+from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401
+
+__all__ = ["Collective", "GradAllReduce", "LocalSGD"]

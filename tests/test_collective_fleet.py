@@ -1,0 +1,293 @@
+"""Collective ops, SPMD execution mode, fleet API, launcher.
+
+Mirrors the reference's collective tests (test_collective_*.py,
+test_dist_mnist_ring_allreduce.py, transpiler/collective.py) on the virtual
+8-device CPU mesh instead of multi-process NCCL.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.fleet.base.role_maker import (UserDefinedRoleMaker,
+                                                       PaddleCloudRoleMaker,
+                                                       Role)
+from paddle_tpu.incubate.fleet.collective import (fleet, CollectiveOptimizer,
+                                                  DistributedStrategy)
+
+NDEV = 8
+
+
+def _fresh_fleet():
+    fleet.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                    worker_num=NDEV))
+    return fleet
+
+
+# ---------------------------------------------------------------------------
+# c_* op semantics under shard_map SPMD
+# ---------------------------------------------------------------------------
+
+def test_c_allreduce_sum():
+    x = np.arange(NDEV * 3, dtype=np.float32).reshape(NDEV, 3)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = pt.layers.data("x", [3], dtype="float32")
+        out = pt.layers.collective._c_allreduce(data, reduce_type="sum")
+        tot = pt.layers.reduce_sum(out)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        cp = pt.CompiledProgram(main).with_collective(nranks=NDEV)
+        (res,) = exe.run(cp, feed={"x": x}, fetch_list=[tot])
+    # each shard's row summed over all shards -> every shard sees total sum
+    assert np.allclose(res, x.sum())
+
+
+def test_c_allreduce_max_min():
+    x = np.arange(NDEV, dtype=np.float32).reshape(NDEV, 1)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = pt.layers.data("x", [1], dtype="float32")
+        mx = pt.layers.collective._c_allreduce(data, reduce_type="max")
+        mn = pt.layers.collective._c_allreduce(data, reduce_type="min")
+        s_mx = pt.layers.reduce_mean(mx)
+        s_mn = pt.layers.reduce_mean(mn)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        cp = pt.CompiledProgram(main).with_collective(nranks=NDEV)
+        mxv, mnv = exe.run(cp, feed={"x": x}, fetch_list=[s_mx, s_mn])
+    assert np.allclose(mxv, NDEV - 1)
+    assert np.allclose(mnv, 0.0)
+
+
+def test_c_allgather_reducescatter_broadcast():
+    # per-shard rows = NDEV so reducescatter's dim0 divides evenly
+    x = np.arange(NDEV * NDEV, dtype=np.float32).reshape(NDEV * NDEV, 1)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = pt.layers.data("x", [1], dtype="float32")  # (8,1) per shard
+        gathered = pt.layers.collective._c_allgather(data, nranks=NDEV)
+        g_sum = pt.layers.reduce_sum(gathered)          # total over all
+        rs = pt.layers.collective._c_reducescatter(data, nranks=NDEV)
+        rs_sum = pt.layers.reduce_sum(
+            pt.layers.collective._c_allgather(rs, nranks=NDEV))
+        bc = pt.layers.collective._c_broadcast(data, root=3)
+        bc_mean = pt.layers.reduce_mean(bc)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        cp = pt.CompiledProgram(main).with_collective(nranks=NDEV)
+        gs, rss, bcm = exe.run(cp, feed={"x": x},
+                               fetch_list=[g_sum, rs_sum, bc_mean])
+    assert np.allclose(gs, x.sum())
+    assert np.allclose(rss, x.sum())
+    # broadcast root=3: every shard sees shard 3's rows (24..31)
+    assert np.allclose(bcm, x[3 * NDEV:4 * NDEV, 0].mean())
+
+
+def test_single_device_identity():
+    """Outside SPMD mode c_* ops are identities (nranks==1 semantics)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = pt.layers.data("x", [3], dtype="float32")
+        out = pt.layers.collective._c_allreduce(data, reduce_type="sum")
+    exe = pt.Executor()
+    scope = pt.Scope()
+    x = np.ones((2, 3), np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert np.allclose(res, x)
+
+
+# ---------------------------------------------------------------------------
+# GradAllReduce end-to-end: SPMD training matches single-device training
+# ---------------------------------------------------------------------------
+
+def _build_mlp_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4], dtype="float32")
+        y = pt.layers.data("y", [1], dtype="float32")
+        h = pt.layers.fc(x, size=8, act="tanh",
+                         param_attr=pt.ParamAttr(
+                             name="w0",
+                             initializer=pt.initializer.Constant(0.1)),
+                         bias_attr=pt.ParamAttr(
+                             name="b0",
+                             initializer=pt.initializer.Constant(0.0)))
+        pred = pt.layers.fc(h, size=1,
+                            param_attr=pt.ParamAttr(
+                                name="w1",
+                                initializer=pt.initializer.Constant(0.05)),
+                            bias_attr=pt.ParamAttr(
+                                name="b1",
+                                initializer=pt.initializer.Constant(0.0)))
+        loss = pt.layers.reduce_mean(pt.layers.square(pred - y))
+    return main, startup, loss
+
+
+def test_grad_allreduce_matches_single_device():
+    rng = np.random.RandomState(0)
+    bs = NDEV * 4
+    x = rng.randn(bs, 4).astype(np.float32)
+    y = rng.randn(bs, 1).astype(np.float32)
+
+    # single-device reference
+    main_s, startup_s, loss_s = _build_mlp_program()
+    with pt.program_guard(main_s, startup_s):
+        pt.optimizer.SGD(0.1).minimize(loss_s)
+    exe = pt.Executor()
+    ref_scope = pt.Scope()
+    with pt.scope_guard(ref_scope):
+        exe.run(startup_s)
+        ref_losses = [float(exe.run(main_s, feed={"x": x, "y": y},
+                                    fetch_list=[loss_s])[0])
+                      for _ in range(3)]
+        ref_w = ref_scope.get_numpy("w0").copy()
+
+    # SPMD collective: same model, fleet-transpiled, 8 shards
+    _fresh_fleet()
+    main_c, startup_c, loss_c = _build_mlp_program()
+    with pt.program_guard(main_c, startup_c):
+        opt = CollectiveOptimizer(pt.optimizer.SGD(0.1))
+        opt.minimize(loss_c)
+    spmd_scope = pt.Scope()
+    with pt.scope_guard(spmd_scope):
+        exe.run(startup_c)
+        cp = pt.CompiledProgram(main_c).with_collective(nranks=NDEV)
+        col_losses = [float(exe.run(cp, feed={"x": x, "y": y},
+                                    fetch_list=[loss_c])[0])
+                      for _ in range(3)]
+        col_w = spmd_scope.get_numpy("w0").copy()
+
+    # grad of mean-loss on full batch == mean over shards of shard-grads:
+    # losses and final weights must match the single-device run
+    np.testing.assert_allclose(ref_losses, col_losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ref_w, col_w, rtol=1e-5, atol=1e-6)
+
+
+def test_nranks_mismatch_raises():
+    """A program transpiled for N replicas refuses to run on a different
+    mesh width (the 1/N gradient scale would be silently wrong)."""
+    _fresh_fleet()
+    main, startup, loss = _build_mlp_program()
+    with pt.program_guard(main, startup):
+        CollectiveOptimizer(pt.optimizer.SGD(0.1)).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        cp = pt.CompiledProgram(main).with_collective(nranks=2)
+        with pytest.raises(ValueError, match="transpiled for 8"):
+            exe.run(cp, feed=feed, fetch_list=[loss])
+        # plain single-device run also refuses
+        with pytest.raises(ValueError, match="transpiled for 8"):
+            exe.run(main, feed=feed, fetch_list=[loss])
+
+
+def test_batch_fetch_reassembled():
+    """Non-scalar fetches come back as the full batch in order (the
+    FetchOpHandle-merge semantic), not per-shard averages."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        data = pt.layers.data("x", [3], dtype="float32")
+        out = pt.layers.scale(data, scale=2.0)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    x = np.arange(NDEV * 2 * 3, dtype=np.float32).reshape(NDEV * 2, 3)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        cp = pt.CompiledProgram(main).with_collective(nranks=NDEV)
+        (res,) = exe.run(cp, feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(res, 2.0 * x)
+
+
+def test_local_sgd_transpiler():
+    _fresh_fleet()
+    main, startup, loss = _build_mlp_program()
+    with pt.program_guard(main, startup):
+        strat = DistributedStrategy()
+        strat.use_local_sgd = True
+        opt = CollectiveOptimizer(pt.optimizer.SGD(0.1), strat)
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block.ops]
+    assert "c_allreduce_sum" in types
+    # param averaging ops appended after optimizer ops
+    rng = np.random.RandomState(1)
+    x = rng.randn(NDEV * 2, 4).astype(np.float32)
+    y = rng.randn(NDEV * 2, 1).astype(np.float32)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        cp = pt.CompiledProgram(main).with_collective(nranks=NDEV)
+        l0 = float(exe.run(cp, feed={"x": x, "y": y},
+                           fetch_list=[loss])[0])
+        l1 = float(exe.run(cp, feed={"x": x, "y": y},
+                           fetch_list=[loss])[0])
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0  # training decreases loss
+
+
+# ---------------------------------------------------------------------------
+# fleet API + role makers + launcher
+# ---------------------------------------------------------------------------
+
+def test_role_maker_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "h1:6170,h1:6171,h2:6170,h2:6171")
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    rm.generate_role()
+    assert rm.is_worker() and rm.worker_index() == 2
+    assert rm.worker_num() == 4
+
+
+def test_role_maker_ps_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "127.0.0.1:6174,127.0.0.1:6175")
+    monkeypatch.setenv("POD_IP", "127.0.0.1")
+    monkeypatch.setenv("PADDLE_PORT", "6175")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    rm = PaddleCloudRoleMaker(is_collective=False)
+    rm.generate_role()
+    assert rm.is_server() and rm.server_index() == 1
+    assert rm.server_num() == 2 and rm.worker_num() == 2
+
+
+def test_fleet_identity():
+    f = _fresh_fleet()
+    assert f.is_worker() and f.is_first_worker()
+    assert f.worker_num() == NDEV
+    assert len(f.worker_endpoints()) == NDEV
+
+
+def test_launcher_dry_run(capsys):
+    from paddle_tpu.distributed.launch import launch
+    rc = launch(["--nproc_per_node=4", "--dry_run", "train.py", "--lr=0.1"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 4
+    assert "rank=0" in out[0] and "world=4" in out[0]
+
+
+def test_launcher_env_build():
+    from paddle_tpu.distributed.launch import _parse_args, build_env
+    args = _parse_args(["--hosts=10.0.0.1,10.0.0.2", "--node_ip=10.0.0.2",
+                        "--nproc_per_node=1", "t.py"])
+    env = build_env(1, args)
+    assert env["PADDLE_TRAINER_ID"] == "1"
+    assert env["PADDLE_CURRENT_ENDPOINT"] == "10.0.0.2:6170"
+    assert env["PADDLE_NUM_PROCESSES"] == "2"
+    assert env["PADDLE_COORDINATOR_ADDRESS"].startswith("10.0.0.1:")
