@@ -84,6 +84,10 @@ class _GradAccum:
         op = Operator(self.block, "sum", {"X": list(lst)}, {"Out": [out]})
         self.pending_ops.append(op)
         self._declare_grad_var(out, var)
+        # the merged grad stays sparse only if every contribution is sparse
+        if all(self.block.has_var(c) and
+               self.block.var(c).type == "selected_rows" for c in lst):
+            self.block.var(out).type = "selected_rows"
         self.contribs[var] = [out]
         return out
 
@@ -139,6 +143,8 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
 
     outs: Dict[str, List[str]] = {}
     any_grad = False
+    sparse_slots = (opdef.sparse_grad_slots(op)
+                    if opdef.sparse_grad_slots is not None else set())
     for slot, names in op.inputs.items():
         if slot in opdef.no_grad_inputs:
             continue
@@ -147,6 +153,8 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
             if _var_wants_grad(block, n, no_grad_set):
                 gname = accum.new_contrib_name(n)
                 accum._declare_grad_var(gname, n)
+                if slot in sparse_slots:
+                    block.var(gname).type = "selected_rows"
                 gnames.append(gname)
                 any_grad = True
             else:

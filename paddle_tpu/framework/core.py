@@ -112,7 +112,7 @@ class Variable:
 
     def __init__(self, block: "Block", name: str, shape=None, dtype="float32",
                  persistable: bool = False, stop_gradient: bool = False,
-                 is_data: bool = False):
+                 is_data: bool = False, type: str = "lod_tensor"):
         self.block = block
         self.name = name
         self.shape = tuple(int(s) for s in shape) if shape is not None else None
@@ -120,6 +120,8 @@ class Variable:
         self.persistable = persistable
         self.stop_gradient = stop_gradient
         self.is_data = is_data
+        # "lod_tensor" | "selected_rows" (reference: VarType framework.proto)
+        self.type = type
 
     # -- DSL sugar: build ops by operating on Variables ---------------------
     def _binary(self, other, op_type, reverse=False):
@@ -182,6 +184,7 @@ class Variable:
             "is_data": self.is_data,
             "is_parameter": isinstance(self, Parameter),
             "trainable": getattr(self, "trainable", None),
+            "type": self.type,
         }
 
 
@@ -481,7 +484,8 @@ class Program:
                                  dtype=vd["dtype"],
                                  persistable=vd["persistable"],
                                  stop_gradient=vd["stop_gradient"],
-                                 is_data=vd.get("is_data", False))
+                                 is_data=vd.get("is_data", False),
+                                 type=vd.get("type", "lod_tensor"))
                 b.vars[v.name] = v
             for od in bd["ops"]:
                 b.ops.append(Operator(b, od["type"], od["inputs"],

@@ -329,14 +329,19 @@ class Executor:
                 if check_nan_inf:
                     # FLAGS_check_nan_inf sanitizer
                     # (reference: operator.cc:949 CheckNanInf)
+                    from .selected_rows import SelectedRows
                     for n in op.output_names():
                         v = env.get(n)
+                        if isinstance(v, SelectedRows):
+                            v = v.values
                         if v is not None and jnp.issubdtype(
                                 jnp.asarray(v).dtype, jnp.inexact):
                             finite_flags[f"{i}:{op.type}:{n}"] = \
                                 jnp.all(jnp.isfinite(v))
+            from .selected_rows import to_dense
             new_mut = {n: env[n] for n in out_names}
-            fetches = [env[n] for n in fetch_names]
+            # fetched SelectedRows densify at the boundary (as_numpy analog)
+            fetches = [to_dense(env[n]) for n in fetch_names]
             new_key = jax.random.fold_in(rng_key, 0x5eed)
             return new_mut, fetches, new_key, finite_flags
 

@@ -55,6 +55,10 @@ class OpDef:
     grad_lower: Optional[Callable] = None
     # if True, op has NO gradient (grads of its inputs are zeros / skipped)
     not_differentiable: bool = False
+    # fn(op) -> set of forward-input slots whose grads are SelectedRows
+    # (e.g. lookup_table with is_sparse=True); backward marks those grad
+    # vars' Variable.type = "selected_rows"
+    sparse_grad_slots: Optional[Callable] = None
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -175,8 +179,9 @@ def _lower_grad_op(ctx: LowerContext, op: Operator, env: Dict[str, Any]):
     opdef = get_op_def(fwd_type)
 
     if opdef.grad_lower is not None:
-        ins = {slot: [env[n] for n in names]
-               for slot, names in op.inputs.items() if names}
+        ins = {slot: [env[n] for n in names if n]
+               for slot, names in op.inputs.items()
+               if any(n for n in names)}
         outs = opdef.grad_lower(ctx, ins, op.attrs)
         _bind_outputs(op, outs, env)
         return

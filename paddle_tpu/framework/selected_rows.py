@@ -1,0 +1,97 @@
+"""SelectedRows: sparse row-slice value type for gradients of embeddings.
+
+TPU-native re-design of the reference's SelectedRows
+(paddle/fluid/framework/selected_rows.h:32): a {rows, value, height} triple
+representing a tall tensor where only `rows` are non-zero. In the reference
+it is a first-class Variable type produced by lookup_table_grad when
+is_sparse=True and consumed by SelectedRows optimizer kernels
+(operators/optimizers/*_op.h SelectedRows specializations).
+
+Here it is a JAX pytree, so it flows through the jitted block trace like any
+array. XLA constraint: `rows` keeps its static length (batch*seq ids,
+duplicates allowed) rather than being uniquified — jnp.unique is not
+jittable. Duplicate handling:
+  * scatter-ADD consumers (sgd, sum) are correct with duplicates as-is;
+  * read-modify-write consumers (adam, adagrad, momentum) first merge
+    duplicates with `merge_rows` so each touched row is updated exactly once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "merge_rows", "to_dense"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int array [n]; values: [n, d...]; height: static vocab size."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.rows.shape[0]}, height={self.height}, "
+                f"dim={tuple(self.values.shape[1:])})")
+
+    # pytree protocol: height is static metadata
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SelectedRows) else x
+
+
+def merge_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum values of duplicate rows so every occurrence of a row carries the
+    full merged value (reference: operators/math/selected_rows_functor.cc
+    MergeAdd). Keeps the static length; after this, scatter-SET consumers are
+    duplicate-safe because all duplicates write identical values.
+
+    Implementation: accumulate into a dense [height, d] buffer, gather back
+    at `rows`. One transient dense buffer of the table's size — XLA fuses the
+    scatter/gather pair and never materializes it in many cases; a
+    sort+segment-sum alternative avoids it but costs O(n log n) sorts of the
+    id vector per step.
+    """
+    dense = jnp.zeros((sr.height,) + tuple(sr.values.shape[1:]),
+                      jnp.promote_types(sr.values.dtype, jnp.float32))
+    dense = dense.at[sr.rows].add(sr.values.astype(dense.dtype))
+    return SelectedRows(sr.rows, dense[sr.rows].astype(sr.values.dtype),
+                        sr.height)
+
+
+def row_mask(sr: SelectedRows):
+    """[n] float mask that is 1 for exactly one occurrence of each row (the
+    first, in sorted order) — used to make per-row counters correct under
+    duplicates."""
+    order = jnp.argsort(sr.rows)
+    sorted_rows = sr.rows[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_rows[1:] != sorted_rows[:-1]])
+    mask = jnp.zeros_like(first).at[order].set(first)
+    return mask

@@ -66,8 +66,9 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None):
-    """reference: layers/nn.py:448 (lookup_table). is_sparse is accepted for
-    API parity; on TPU dense scatter-add grads are used either way."""
+    """reference: layers/nn.py:448 (lookup_table). is_sparse=True gives the
+    embedding a SelectedRows gradient (rows=ids, values=out-grad) consumed
+    by sparse optimizer kernels and the parameter-server path."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, list(size), dtype,
                                 default_initializer=Xavier())
@@ -79,7 +80,8 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     else:
         pad = padding_idx
     helper.append_op("lookup_table", {"W": [w.name], "Ids": [input.name]},
-                     {"Out": [out.name]}, {"padding_idx": pad})
+                     {"Out": [out.name]},
+                     {"padding_idx": pad, "is_sparse": bool(is_sparse)})
     return out
 
 

@@ -69,10 +69,24 @@ def _spmd_size(ctx, attrs) -> int:
 
 def _register_allreduce(kind, fn_name):
     @register_op(f"c_allreduce_{kind}")
-    def _(ctx, ins, attrs, _fn=fn_name):
+    def _(ctx, ins, attrs, _fn=fn_name, _kind=kind):
         import jax
+        from ..framework.selected_rows import SelectedRows
         x = ins["X"][0]
         axis = _active_axis(ctx, attrs)
+        if isinstance(x, SelectedRows):
+            if _kind != "sum":
+                x = x.to_dense()  # only sum has sparse semantics
+            elif axis is None:
+                return {"Out": [x]}
+            else:
+                # sparse allreduce = allgather of (rows, values) shards —
+                # summing row INDICES leaf-wise would corrupt them; this is
+                # the reference's sparse path (allgather in
+                # details/sparse_all_reduce_op_handle.cc)
+                rows = jax.lax.all_gather(x.rows, axis, tiled=True)
+                vals = jax.lax.all_gather(x.values, axis, tiled=True)
+                return {"Out": [SelectedRows(rows, vals, x.height)]}
         if axis is None:
             return {"Out": [x]}
         return {"Out": [getattr(jax.lax, _fn)(x, axis)]}

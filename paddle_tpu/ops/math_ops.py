@@ -31,8 +31,17 @@ def _broadcast_y(x, y, axis):
 
 def _register_elementwise(name, fn):
     @register_op(name)
-    def _lower(ctx, ins, attrs, _fn=fn):
+    def _lower(ctx, ins, attrs, _fn=fn, _name=name):
+        from ..framework.selected_rows import SelectedRows
         x, y = ins["X"][0], ins["Y"][0]
+        if isinstance(x, SelectedRows):
+            # scalar multiply is linear in the rows -> stays sparse
+            # (grad scaling / clip paths); anything else densifies
+            if _name == "elementwise_mul" and jnp.size(y) == 1:
+                return {"Out": [SelectedRows(
+                    x.rows, x.values * y.reshape(()).astype(x.values.dtype),
+                    x.height)]}
+            x = x.to_dense()
         y = _broadcast_y(x, y, attrs.get("axis", -1))
         return {"Out": [_fn(x, y)]}
 
@@ -144,8 +153,18 @@ def _mean(ctx, ins, attrs):
 @register_op("sum")
 def _sum(ctx, ins, attrs):
     """add_n: sum a list of tensors (grad-accumulation workhorse,
-    reference: operators/sum_op.cc)."""
+    reference: operators/sum_op.cc). Handles SelectedRows inputs like the
+    reference's SumKernel SelectedRows branch: all-sparse inputs concatenate
+    into one sparse result; a dense/sparse mix densifies."""
+    from ..framework.selected_rows import SelectedRows
+
     xs = ins["X"]
+    if any(isinstance(x, SelectedRows) for x in xs):
+        if all(isinstance(x, SelectedRows) for x in xs):
+            rows = jnp.concatenate([x.rows for x in xs])
+            vals = jnp.concatenate([x.values for x in xs])
+            return {"Out": [SelectedRows(rows, vals, xs[0].height)]}
+        xs = [x.to_dense() if isinstance(x, SelectedRows) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
@@ -158,23 +177,56 @@ def _sum(ctx, ins, attrs):
 
 @register_op("scale")
 def _scale(ctx, ins, attrs):
+    from ..framework.selected_rows import SelectedRows
     x = ins["X"][0]
     s = attrs.get("scale", 1.0)
     b = attrs.get("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        if b != 0.0:
+            x = x.to_dense()  # bias is affine, not additive-safe
+        else:
+            return {"Out": [SelectedRows(x.rows, x.values * s, x.height)]}
     if attrs.get("bias_after_scale", True):
         return {"Out": [x * s + b]}
     return {"Out": [(x + b) * s]}
 
 
+def _sparse_merged_and_mask(sr):
+    """(rows, merged values, one-occurrence mask). For NONLINEAR rewrites of
+    SelectedRows grads apply the function to the MERGED per-row value first,
+    then zero all but one occurrence with the mask — f must never see the
+    mask's zero slots (clip(0) is not 0 when min>0)."""
+    from ..framework.selected_rows import merge_rows, row_mask
+    merged = merge_rows(sr)
+    mask = row_mask(sr)[:, None].astype(merged.values.dtype)
+    return merged.rows, merged.values, mask
+
+
 @register_op("clip")
 def _clip(ctx, ins, attrs):
-    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+    from ..framework.selected_rows import SelectedRows
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        rows, merged, mask = _sparse_merged_and_mask(x)
+        return {"Out": [SelectedRows(
+            rows, mask * jnp.clip(merged, attrs["min"], attrs["max"]),
+            x.height)]}
+    return {"Out": [jnp.clip(x, attrs["min"], attrs["max"])]}
 
 
 @register_op("clip_by_norm")
 def _clip_by_norm(ctx, ins, attrs):
+    from ..framework.selected_rows import SelectedRows
     x = ins["X"][0]
     max_norm = attrs["max_norm"]
+    if isinstance(x, SelectedRows):
+        rows, merged, mask = _sparse_merged_and_mask(x)
+        vals = merged * mask
+        norm = jnp.sqrt(jnp.sum(vals.astype(jnp.float32) ** 2))
+        scale = jnp.where(norm > max_norm,
+                          max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return {"Out": [SelectedRows(rows, vals * scale.astype(vals.dtype),
+                                     x.height)]}
     norm = jnp.sqrt(jnp.sum(x * x))
     scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
                       1.0)
@@ -183,7 +235,12 @@ def _clip_by_norm(ctx, ins, attrs):
 
 @register_op("squared_l2_norm")
 def _squared_l2_norm(ctx, ins, attrs):
+    from ..framework.selected_rows import SelectedRows
     x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        _, merged, mask = _sparse_merged_and_mask(x)
+        vals = merged * mask
+        return {"Out": [jnp.sum(vals.astype(jnp.float32) ** 2).reshape((1,))]}
     return {"Out": [jnp.sum(x.astype(jnp.float32) ** 2).reshape((1,))]}
 
 

@@ -13,15 +13,29 @@ fp32 master weights similarly, contrib/mixed_precision/decorator.py:194).
 import jax.numpy as jnp
 
 from ..framework.registry import register_op
+from ..framework.selected_rows import SelectedRows, merge_rows
 
 
 def _lr(ins):
     return ins["LearningRate"][0].reshape(()).astype(jnp.float32)
 
 
+def _dense_grad(ins):
+    """Optimizers without a sparse kernel densify SelectedRows grads
+    (matches reference ops that only register LoDTensor grad kernels)."""
+    g = ins["Grad"][0]
+    return g.to_dense() if isinstance(g, SelectedRows) else g
+
+
 @register_op("sgd", not_differentiable=True, is_optimizer_op=True)
 def _sgd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
+    if isinstance(g, SelectedRows):
+        # sparse update: touch only the embedding rows that appeared
+        # (reference: optimizers/sgd_op.h SelectedRows branch); scatter-add
+        # is duplicate-safe, no merge needed
+        upd = (-_lr(ins) * g.values.astype(jnp.float32)).astype(p.dtype)
+        return {"ParamOut": [p.at[g.rows].add(upd)]}
     return {"ParamOut": [(p.astype(jnp.float32)
                           - _lr(ins) * g.astype(jnp.float32)).astype(p.dtype)]}
 
@@ -31,6 +45,19 @@ def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = attrs["mu"]
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        # merged duplicates make every occurrence of a row compute the SAME
+        # new value, so scatter-set is duplicate-safe (read-modify-write)
+        g = merge_rows(g)
+        rows = g.rows
+        g32 = g.values.astype(jnp.float32)
+        v_r = mu * v[rows] + g32
+        if attrs.get("use_nesterov", False):
+            p_r = p[rows].astype(jnp.float32) - (g32 + mu * v_r) * lr
+        else:
+            p_r = p[rows].astype(jnp.float32) - lr * v_r
+        return {"ParamOut": [p.at[rows].set(p_r.astype(p.dtype))],
+                "VelocityOut": [v.at[rows].set(v_r)]}
     g32 = g.astype(jnp.float32)
     v_new = mu * v + g32
     if attrs.get("use_nesterov", False):
@@ -49,10 +76,25 @@ def _adam(ctx, ins, attrs):
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if isinstance(g, SelectedRows):
+        # lazy sparse adam (reference: optimizers/adam_op.h SelectedRows
+        # branch): moments and param update only on touched rows;
+        # beta-pow accumulators still advance globally
+        g = merge_rows(g)
+        rows = g.rows
+        g32 = g.values.astype(jnp.float32)
+        m1_r = b1 * m1[rows] + (1 - b1) * g32
+        m2_r = b2 * m2[rows] + (1 - b2) * g32 * g32
+        p_r = p[rows].astype(jnp.float32) \
+            - lr_t * m1_r / (jnp.sqrt(m2_r) + eps)
+        return {"ParamOut": [p.at[rows].set(p_r.astype(p.dtype))],
+                "Moment1Out": [m1.at[rows].set(m1_r)],
+                "Moment2Out": [m2.at[rows].set(m2_r)],
+                "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
     g32 = g.astype(jnp.float32)
     m1n = b1 * m1 + (1 - b1) * g32
     m2n = b2 * m2 + (1 - b2) * g32 * g32
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_new = p.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
     return {"ParamOut": [p_new.astype(p.dtype)], "Moment1Out": [m1n],
             "Moment2Out": [m2n], "Beta1PowOut": [b1p * b1],
@@ -61,15 +103,23 @@ def _adam(ctx, ins, attrs):
 
 @register_op("adamw", not_differentiable=True, is_optimizer_op=True)
 def _adamw(ctx, ins, attrs):
-    p = ins["Param"][0]
+    p, g = ins["Param"][0], ins["Grad"][0]
     coeff = attrs.get("coeff", 0.01)
     with_decay = attrs.get("with_decay", True)
     outs = _adam(ctx, ins, attrs)
     if with_decay:
         lr = _lr(ins)
-        pw = outs["ParamOut"][0].astype(jnp.float32) \
-            - lr * coeff * p.astype(jnp.float32)
-        outs["ParamOut"] = [pw.astype(p.dtype)]
+        po = outs["ParamOut"][0]
+        if isinstance(g, SelectedRows):
+            # lazy semantics: decay only the touched rows (duplicates write
+            # identical values, so scatter-set is safe)
+            rows = g.rows
+            dec = po[rows].astype(jnp.float32) \
+                - lr * coeff * p[rows].astype(jnp.float32)
+            outs["ParamOut"] = [po.at[rows].set(dec.astype(p.dtype))]
+        else:
+            pw = po.astype(jnp.float32) - lr * coeff * p.astype(jnp.float32)
+            outs["ParamOut"] = [pw.astype(p.dtype)]
     return outs
 
 
@@ -77,6 +127,15 @@ def _adamw(ctx, ins, attrs):
 def _adagrad(ctx, ins, attrs):
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        g = merge_rows(g)
+        rows = g.rows
+        g32 = g.values.astype(jnp.float32)
+        mom_r = mom[rows] + g32 * g32
+        p_r = p[rows].astype(jnp.float32) \
+            - _lr(ins) * g32 / (jnp.sqrt(mom_r) + eps)
+        return {"ParamOut": [p.at[rows].set(p_r.astype(p.dtype))],
+                "MomentOut": [mom.at[rows].set(mom_r)]}
     g32 = g.astype(jnp.float32)
     mom_new = mom + g32 * g32
     p_new = p.astype(jnp.float32) - _lr(ins) * g32 / (jnp.sqrt(mom_new) + eps)
@@ -85,7 +144,7 @@ def _adagrad(ctx, ins, attrs):
 
 @register_op("decayed_adagrad", not_differentiable=True, is_optimizer_op=True)
 def _decayed_adagrad(ctx, ins, attrs):
-    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    p, g, mom = ins["Param"][0], _dense_grad(ins), ins["Moment"][0]
     decay = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
     g32 = g.astype(jnp.float32)
@@ -96,7 +155,7 @@ def _decayed_adagrad(ctx, ins, attrs):
 
 @register_op("adadelta", not_differentiable=True, is_optimizer_op=True)
 def _adadelta(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     avg_sq, avg_upd = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
     rho = attrs.get("rho", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -111,7 +170,7 @@ def _adadelta(ctx, ins, attrs):
 
 @register_op("adamax", not_differentiable=True, is_optimizer_op=True)
 def _adamax(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     m, inf = ins["Moment"][0], ins["InfNorm"][0]
     b1p = ins["Beta1Pow"][0]
     b1 = attrs.get("beta1", 0.9)
@@ -128,7 +187,7 @@ def _adamax(ctx, ins, attrs):
 
 @register_op("rmsprop", not_differentiable=True, is_optimizer_op=True)
 def _rmsprop(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
     rho = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -153,7 +212,7 @@ def _rmsprop(ctx, ins, attrs):
 @register_op("lamb", not_differentiable=True, is_optimizer_op=True)
 def _lamb(ctx, ins, attrs):
     """reference: optimizers/lamb_op.cc — layer-adaptive large-batch opt."""
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
     b1 = attrs.get("beta1", 0.9)
@@ -178,7 +237,7 @@ def _lamb(ctx, ins, attrs):
 
 @register_op("lars_momentum", not_differentiable=True, is_optimizer_op=True)
 def _lars_momentum(ctx, ins, attrs):
-    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    p, g, v = ins["Param"][0], _dense_grad(ins), ins["Velocity"][0]
     mu = attrs["mu"]
     coeff = attrs.get("lars_coeff", 0.001)
     wd = attrs.get("lars_weight_decay", 0.0005)
@@ -194,7 +253,7 @@ def _lars_momentum(ctx, ins, attrs):
 
 @register_op("ftrl", not_differentiable=True, is_optimizer_op=True)
 def _ftrl(ctx, ins, attrs):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)

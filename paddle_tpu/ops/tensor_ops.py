@@ -253,12 +253,40 @@ def _scatter_nd_add(ctx, ins, attrs):
     return {"Out": [x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
 
 
-@register_op("lookup_table", no_grad_inputs={"Ids"})
+def _lookup_sparse_slots(op):
+    return {"W"} if op.attrs.get("is_sparse", False) else set()
+
+
+def _lookup_table_grad(ctx, ins, attrs, squeeze_trailing):
+    """Custom grad: dense scatter-add, or — with is_sparse=True — a
+    SelectedRows of (ids, out-grad rows), the reference's sparse-embedding
+    gradient (operators/lookup_table_op.h LookupTableGradKernel SelectedRows
+    branch). The sparse form is what the PS path ships over the wire."""
+    from ..framework.selected_rows import SelectedRows
+
+    w, ids, og = ins["W"][0], ins["Ids"][0], ins["Out@GRAD"][0]
+    if squeeze_trailing and ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    pad = attrs.get("padding_idx", -1)
+    rows = ids.reshape(-1)
+    vals = og.reshape(-1, og.shape[-1])
+    if pad is not None and pad >= 0:
+        vals = jnp.where((rows != pad)[:, None], vals, 0.0)
+    if attrs.get("is_sparse", False):
+        return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+    dense = jnp.zeros_like(w).at[rows].add(vals.astype(w.dtype))
+    return {"W@GRAD": [dense]}
+
+
+@register_op("lookup_table", no_grad_inputs={"Ids"},
+             sparse_grad_slots=_lookup_sparse_slots,
+             grad_lower=lambda ctx, ins, attrs:
+             _lookup_table_grad(ctx, ins, attrs, squeeze_trailing=True))
 def _lookup_table(ctx, ins, attrs):
     """Embedding (reference: operators/lookup_table_op.cc). Ids carry a
-    trailing 1 dim in fluid; vjp gives a dense scatter-add gradient — on TPU
-    dense grads beat the reference's SelectedRows sparse rows for typical
-    vocab sizes (XLA lowers to efficient scatter)."""
+    trailing 1 dim in fluid. With is_sparse=False the gradient is a dense
+    scatter-add (XLA lowers it efficiently); is_sparse=True produces a
+    SelectedRows grad consumed by sparse optimizer kernels / the PS path."""
     w, ids = ins["W"][0], ins["Ids"][0]
     squeeze = ids.ndim > 1 and ids.shape[-1] == 1
     if squeeze:
@@ -271,7 +299,10 @@ def _lookup_table(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register_op("lookup_table_v2", no_grad_inputs={"Ids"})
+@register_op("lookup_table_v2", no_grad_inputs={"Ids"},
+             sparse_grad_slots=_lookup_sparse_slots,
+             grad_lower=lambda ctx, ins, attrs:
+             _lookup_table_grad(ctx, ins, attrs, squeeze_trailing=False))
 def _lookup_table_v2(ctx, ins, attrs):
     w, ids = ins["W"][0], ins["Ids"][0]
     out = jnp.take(w, ids, axis=0)

@@ -51,10 +51,17 @@ L2Decay = L2DecayRegularizer
 
 
 def append_regularization_ops(params_grads, global_regularizer=None):
+    import warnings
     out = []
     for p, g in params_grads:
         reg = p.regularizer or global_regularizer
         if reg is None:
+            out.append((p, g))
+        elif g.type == "selected_rows":
+            # decay of untouched rows would densify the sparse grad
+            # (reference regularizer.py warns and skips likewise)
+            warnings.warn(
+                f"regularizer skipped for sparse gradient of {p.name!r}")
             out.append((p, g))
         else:
             out.append((p, reg.append(p, g, g.block)))

@@ -1,0 +1,219 @@
+"""SelectedRows sparse-gradient path: embedding is_sparse=True must match
+the dense path bit-for-bit-ish for every sparse-capable optimizer, with
+duplicate ids in the batch (the hard case: read-modify-write updates must
+apply once per row, scatter-adds once per occurrence).
+
+Reference analog: test_lookup_table_op.py sparse cases +
+operators/optimizers/*_op.h SelectedRows kernels.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+VOCAB, DIM, BATCH = 13, 4, 6
+
+
+def _build(optimizer_factory, is_sparse, seed=3):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", [1], dtype="int64")
+        emb = pt.layers.embedding(ids, size=[VOCAB, DIM],
+                                  is_sparse=is_sparse)
+        fc = pt.layers.fc(emb, size=3)
+        label = pt.layers.data("label", [1], dtype="int64")
+        loss = pt.layers.mean(
+            pt.layers.cross_entropy(pt.layers.softmax(fc), label))
+        optimizer_factory().minimize(loss)
+    main.random_seed = startup.random_seed = seed
+    return main, startup, loss
+
+
+def _train(optimizer_factory, is_sparse, steps=4, all_rows=False):
+    main, startup, loss = _build(optimizer_factory, is_sparse)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            if all_rows:
+                # lazy optimizers (adam/momentum) only match dense when
+                # every row is touched; duplicates still exercise merging
+                ids = np.concatenate(
+                    [rng.permutation(VOCAB),
+                     rng.randint(0, VOCAB, 3)]).astype(np.int64)[:, None]
+            else:
+                # duplicates on purpose
+                ids = rng.randint(0, VOCAB, (BATCH, 1)).astype(np.int64)
+                ids[1] = ids[0]
+                ids[3] = ids[0]
+            label = rng.randint(0, 3, (ids.shape[0], 1)).astype(np.int64)
+            (lv,) = exe.run(main, feed={"ids": ids, "label": label},
+                            fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        emb_w = None
+        for n in scope.var_names():
+            v = scope.find_var(n)
+            if hasattr(v, "shape") and tuple(v.shape) == (VOCAB, DIM):
+                emb_w = np.asarray(v)
+    return losses, emb_w
+
+
+# (factory, all_rows): lazy sparse kernels (adam, momentum) equal dense only
+# when every row is touched each step; sgd/adagrad are exactly equal always,
+# rmsprop uses the densify fallback.
+OPTIMIZERS = {
+    "sgd": (lambda: pt.optimizer.SGD(learning_rate=0.1), False),
+    "momentum": (lambda: pt.optimizer.Momentum(learning_rate=0.1,
+                                               momentum=0.9), True),
+    "adam": (lambda: pt.optimizer.Adam(learning_rate=0.05), True),
+    "adagrad": (lambda: pt.optimizer.Adagrad(learning_rate=0.1), False),
+    "rmsprop": (lambda: pt.optimizer.RMSProp(learning_rate=0.05), False),
+}
+
+
+@pytest.mark.parametrize("name", sorted(OPTIMIZERS))
+def test_sparse_matches_dense(name):
+    factory, all_rows = OPTIMIZERS[name]
+    dense_losses, dense_w = _train(factory, is_sparse=False,
+                                   all_rows=all_rows)
+    sparse_losses, sparse_w = _train(factory, is_sparse=True,
+                                     all_rows=all_rows)
+    np.testing.assert_allclose(sparse_losses, dense_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_with_global_norm_clip():
+    def factory():
+        return pt.optimizer.SGD(
+            learning_rate=0.1,
+            grad_clip=pt.clip.GradientClipByGlobalNorm(0.1))
+
+    dense_losses, dense_w = _train(factory, is_sparse=False)
+    sparse_losses, sparse_w = _train(factory, is_sparse=True)
+    np.testing.assert_allclose(sparse_losses, dense_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-4, atol=1e-5)
+
+
+def test_fetch_sparse_grad_densifies():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", [1], dtype="int64")
+        emb = pt.layers.embedding(ids, size=[VOCAB, DIM], is_sparse=True)
+        loss = pt.layers.mean(emb)
+        pt.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    gname = None
+    for v in main.global_block.vars.values():
+        if v.type == "selected_rows":
+            gname = v.name
+    assert gname is not None, "sparse grad var not marked selected_rows"
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        ids = np.array([[1], [1], [2]], dtype=np.int64)
+        (g,) = exe.run(main, feed={"ids": ids}, fetch_list=[gname])
+    assert g.shape == (VOCAB, DIM)
+    # mean over 3*DIM elements; rows 1 (twice) and 2 touched
+    np.testing.assert_allclose(g[1], 2.0 / (3 * DIM), rtol=1e-5)
+    np.testing.assert_allclose(g[2], 1.0 / (3 * DIM), rtol=1e-5)
+    np.testing.assert_allclose(g[0], 0.0)
+
+
+def test_merge_rows_and_mask():
+    import jax.numpy as jnp
+    from paddle_tpu.framework.selected_rows import (SelectedRows, merge_rows,
+                                                    row_mask)
+    rows = jnp.array([2, 5, 2, 7])
+    vals = jnp.array([[1.0], [2.0], [3.0], [4.0]])
+    sr = SelectedRows(rows, vals, 10)
+    merged = merge_rows(sr)
+    np.testing.assert_allclose(np.asarray(merged.values),
+                               [[4.0], [2.0], [4.0], [4.0]])
+    mask = np.asarray(row_mask(sr))
+    assert mask.sum() == 3  # three unique rows
+    dense = np.asarray(sr.to_dense())
+    assert dense[2, 0] == 4.0 and dense[5, 0] == 2.0 and dense[7, 0] == 4.0
+
+
+def test_sparse_clip_duplicates_no_zero_injection():
+    """clip must act on the MERGED per-row grad, never on masked zero slots
+    (clip(0)=min would add spurious mass when min > 0)."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework.registry import get_op_def, LowerContext
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    sr = SelectedRows(jnp.array([3, 3]), jnp.array([[0.5], [0.5]]), 10)
+    out = get_op_def("clip").lower(LowerContext(), {"X": [sr]},
+                                   {"min": 0.1, "max": 1.0})["Out"][0]
+    dense = np.asarray(out.to_dense())
+    np.testing.assert_allclose(dense[3], [1.0])  # clip(0.5+0.5), once
+    assert np.count_nonzero(dense) == 1
+
+
+def test_sparse_allreduce_gathers_rows():
+    """c_allreduce_sum on a SelectedRows grad must allgather (rows, values)
+    across replicas, not psum the integer row indices."""
+    NDEV = 8
+    VOCAB = 12
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", [1], dtype="int64")
+        emb = pt.layers.embedding(ids, size=[VOCAB, 2], is_sparse=True)
+        loss = pt.layers.mean(emb)
+        pt.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    from paddle_tpu.transpiler.collective import GradAllReduce
+    GradAllReduce().transpile(startup, main, nranks=NDEV)
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        w0 = None
+        for n in scope.var_names():
+            v = scope.find_var(n)
+            if hasattr(v, "shape") and tuple(v.shape) == (VOCAB, 2):
+                w0, wname = np.asarray(v).copy(), n
+        # each replica sees a different single id: rows 0..7
+        ids = np.arange(NDEV, dtype=np.int64).reshape(NDEV, 1)
+        cp = pt.CompiledProgram(main).with_collective(nranks=NDEV)
+        exe.run(cp, feed={"ids": ids}, fetch_list=[])
+        w1 = np.asarray(scope.find_var(wname))
+    delta = w1 - w0
+    # every replica contributes grad 1/(1*2) per element to ITS row, averaged
+    # over NDEV replicas; update = -lr * mean grad
+    expect_row = -1.0 / 2.0 / NDEV
+    for r in range(NDEV):
+        np.testing.assert_allclose(delta[r], expect_row, rtol=1e-5,
+                                   err_msg=f"row {r}")
+    np.testing.assert_allclose(delta[NDEV:], 0.0)
+
+
+def test_adamw_sparse_decays_only_touched_rows():
+    VOCAB = 9
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data("ids", [1], dtype="int64")
+        emb = pt.layers.embedding(ids, size=[VOCAB, 2], is_sparse=True)
+        loss = pt.layers.mean(emb)
+        pt.optimizer.AdamW(learning_rate=0.1, coeff=0.5).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for n in scope.var_names():
+            v = scope.find_var(n)
+            if hasattr(v, "shape") and tuple(v.shape) == (VOCAB, 2):
+                w0, wname = np.asarray(v).copy(), n
+        ids = np.array([[2], [2], [5]], dtype=np.int64)
+        exe.run(main, feed={"ids": ids}, fetch_list=[])
+        w1 = np.asarray(scope.find_var(wname))
+    delta = np.abs(w1 - w0)
+    assert delta[2].max() > 0 and delta[5].max() > 0
+    untouched = [r for r in range(VOCAB) if r not in (2, 5)]
+    np.testing.assert_allclose(delta[untouched], 0.0, atol=1e-8)
