@@ -10,7 +10,8 @@ for the capability map.
 from . import ops  # registers all op lowering rules
 from .framework import (Program, Block, Operator, Variable, Parameter,
                         program_guard, default_main_program,
-                        default_startup_program, unique_name, name_scope,
+                        default_startup_program, unique_name, unique_name_guard,
+                        name_scope,
                         Executor, Scope, global_scope, scope_guard,
                         append_backward, gradients, LayerHelper, ParamAttr)
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy

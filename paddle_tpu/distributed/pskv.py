@@ -1,0 +1,213 @@
+"""ctypes bindings for the native pskv parameter server (native/pskv/pskv.cc).
+
+The C++ library is compiled on demand with g++ (no pybind dependency —
+plain extern "C" + ctypes, per the environment's binding constraints) and
+cached next to the source; rebuilds when the source is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "pskv", "pskv.cc")
+_SO = os.path.join(_REPO_ROOT, "native", "pskv", "_pskv.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+OPT_SGD, OPT_ADAGRAD, OPT_ADAM = 0, 1, 2
+
+_OPT_BY_NAME = {"sgd": OPT_SGD, "adagrad": OPT_ADAGRAD, "adam": OPT_ADAM}
+
+
+def _build():
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        c = ctypes
+        lib.pskv_server_start.restype = c.c_void_p
+        lib.pskv_server_start.argtypes = [c.c_int, c.c_int, c.c_int]
+        lib.pskv_server_port.restype = c.c_int
+        lib.pskv_server_port.argtypes = [c.c_void_p]
+        lib.pskv_server_stopped.restype = c.c_int
+        lib.pskv_server_stopped.argtypes = [c.c_void_p]
+        lib.pskv_server_stop.argtypes = [c.c_void_p]
+        lib.pskv_connect.restype = c.c_int
+        lib.pskv_connect.argtypes = [c.c_char_p, c.c_int]
+        lib.pskv_close.argtypes = [c.c_int]
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.pskv_create_dense.restype = c.c_int
+        lib.pskv_create_dense.argtypes = [
+            c.c_int, c.c_char_p, c.c_uint64, c.c_int,
+            c.c_float, c.c_float, c.c_float, c.c_float]
+        lib.pskv_init_dense.restype = c.c_int
+        lib.pskv_init_dense.argtypes = [c.c_int, c.c_char_p, f32p, c.c_uint64]
+        lib.pskv_pull_dense.restype = c.c_int
+        lib.pskv_pull_dense.argtypes = [c.c_int, c.c_char_p, f32p, c.c_uint64]
+        lib.pskv_push_dense.restype = c.c_int
+        lib.pskv_push_dense.argtypes = [c.c_int, c.c_char_p, c.c_uint32,
+                                        f32p, c.c_uint64]
+        lib.pskv_create_sparse.restype = c.c_int
+        lib.pskv_create_sparse.argtypes = [
+            c.c_int, c.c_char_p, c.c_uint64, c.c_int,
+            c.c_float, c.c_float, c.c_float, c.c_float,
+            c.c_float, c.c_uint64]
+        lib.pskv_pull_sparse.restype = c.c_int
+        lib.pskv_pull_sparse.argtypes = [c.c_int, c.c_char_p, i64p,
+                                         c.c_uint64, f32p, c.c_uint64]
+        lib.pskv_push_sparse.restype = c.c_int
+        lib.pskv_push_sparse.argtypes = [c.c_int, c.c_char_p, c.c_uint32,
+                                         i64p, c.c_uint64, f32p, c.c_uint64]
+        lib.pskv_init_sparse.restype = c.c_int
+        lib.pskv_init_sparse.argtypes = [c.c_int, c.c_char_p, i64p,
+                                         c.c_uint64, f32p, c.c_uint64]
+        lib.pskv_barrier.restype = c.c_int
+        lib.pskv_barrier.argtypes = [c.c_int, c.c_uint32]
+        lib.pskv_set_lr.restype = c.c_int
+        lib.pskv_set_lr.argtypes = [c.c_int, c.c_char_p, c.c_float]
+        lib.pskv_shutdown.restype = c.c_int
+        lib.pskv_shutdown.argtypes = [c.c_int]
+        _lib = lib
+        return _lib
+
+
+class KVServer:
+    """In-process pserver (listen_and_serv analog). Runs its accept loop on
+    C++ threads; `port` is the bound port (pass port=0 for ephemeral)."""
+
+    def __init__(self, port: int = 0, trainers: int = 1, sync: bool = True):
+        self._lib = load_lib()
+        self._handle = self._lib.pskv_server_start(int(port), int(trainers),
+                                                   1 if sync else 0)
+        if not self._handle:
+            raise RuntimeError(f"pskv server failed to bind port {port}")
+        self.port = self._lib.pskv_server_port(self._handle)
+
+    def stopped(self) -> bool:
+        """True once a trainer sent the shutdown command."""
+        if not self._handle:
+            return True
+        return bool(self._lib.pskv_server_stopped(self._handle))
+
+    def stop(self):
+        if self._handle:
+            self._lib.pskv_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def _check(rc: int, what: str):
+    if rc != 0:
+        raise RuntimeError(f"pskv {what} failed (rc={rc})")
+
+
+class KVClient:
+    """Trainer-side connection to one pserver (RPCClient analog,
+    reference operators/distributed/rpc_client.h:33)."""
+
+    def __init__(self, host: str, port: int, trainer_id: int = 0):
+        self._lib = load_lib()
+        self._fd = self._lib.pskv_connect(host.encode(), int(port))
+        if self._fd < 0:
+            raise ConnectionError(f"cannot connect to pserver {host}:{port}")
+        self.trainer_id = int(trainer_id)
+
+    def close(self):
+        if self._fd >= 0:
+            self._lib.pskv_close(self._fd)
+            self._fd = -1
+
+    # -- dense ---------------------------------------------------------------
+    def create_dense(self, name: str, size: int, opt: str = "sgd",
+                     lr: float = 0.01, beta1: float = 0.9,
+                     beta2: float = 0.999, epsilon: float = 1e-8):
+        _check(self._lib.pskv_create_dense(
+            self._fd, name.encode(), int(size), _OPT_BY_NAME[opt],
+            lr, beta1, beta2, epsilon), "create_dense")
+
+    def init_dense(self, name: str, value: np.ndarray):
+        v = np.ascontiguousarray(value, np.float32).ravel()
+        _check(self._lib.pskv_init_dense(self._fd, name.encode(), v,
+                                         v.size), "init_dense")
+
+    def pull_dense(self, name: str, size: int) -> np.ndarray:
+        out = np.empty(int(size), np.float32)
+        _check(self._lib.pskv_pull_dense(self._fd, name.encode(), out,
+                                         out.size), "pull_dense")
+        return out
+
+    def push_dense(self, name: str, grad: np.ndarray):
+        g = np.ascontiguousarray(grad, np.float32).ravel()
+        _check(self._lib.pskv_push_dense(self._fd, name.encode(),
+                                         self.trainer_id, g, g.size),
+               "push_dense")
+
+    # -- sparse --------------------------------------------------------------
+    def create_sparse(self, name: str, dim: int, opt: str = "sgd",
+                      lr: float = 0.01, beta1: float = 0.9,
+                      beta2: float = 0.999, epsilon: float = 1e-8,
+                      init_scale: float = 0.0, seed: int = 0):
+        _check(self._lib.pskv_create_sparse(
+            self._fd, name.encode(), int(dim), _OPT_BY_NAME[opt],
+            lr, beta1, beta2, epsilon, init_scale, seed), "create_sparse")
+
+    def init_sparse(self, name: str, ids: np.ndarray, values: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        v = np.ascontiguousarray(values, np.float32).reshape(ids.size, -1)
+        _check(self._lib.pskv_init_sparse(self._fd, name.encode(), ids,
+                                          ids.size, v, v.shape[1]),
+               "init_sparse")
+
+    def pull_sparse(self, name: str, ids: np.ndarray, dim: int) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, int(dim)), np.float32)
+        _check(self._lib.pskv_pull_sparse(self._fd, name.encode(), ids,
+                                          ids.size, out, int(dim)),
+               "pull_sparse")
+        return out
+
+    def push_sparse(self, name: str, ids: np.ndarray, grads: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        g = np.ascontiguousarray(grads, np.float32)
+        dim = g.shape[-1]
+        g = g.reshape(ids.size, dim)
+        _check(self._lib.pskv_push_sparse(self._fd, name.encode(),
+                                          self.trainer_id, ids, ids.size,
+                                          np.ascontiguousarray(g), dim),
+               "push_sparse")
+
+    # -- control -------------------------------------------------------------
+    def barrier(self):
+        _check(self._lib.pskv_barrier(self._fd, self.trainer_id), "barrier")
+
+    def set_lr(self, name: str, lr: float):
+        _check(self._lib.pskv_set_lr(self._fd, name.encode(), float(lr)),
+               "set_lr")
+
+    def shutdown_server(self):
+        self._lib.pskv_shutdown(self._fd)

@@ -1,6 +1,7 @@
 from .core import (Program, Block, Operator, Variable, Parameter,
                    program_guard, default_main_program,
-                   default_startup_program, unique_name, name_scope,
+                   default_startup_program, unique_name, unique_name_guard,
+                   name_scope,
                    grad_var_name)
 from .executor import (Executor, Scope, global_scope, scope_guard,
                        as_jax_function)

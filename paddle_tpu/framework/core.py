@@ -25,7 +25,8 @@ import numpy as np
 __all__ = [
     "Variable", "Operator", "Block", "Program", "Parameter",
     "program_guard", "default_main_program", "default_startup_program",
-    "unique_name", "name_scope", "grad_var_name", "convert_np_dtype",
+    "unique_name", "unique_name_guard", "name_scope", "grad_var_name",
+    "convert_np_dtype",
 ]
 
 # ---------------------------------------------------------------------------
@@ -83,6 +84,26 @@ _generator = _UniqueNameGenerator()
 
 def unique_name(key: str = "tmp") -> str:
     return _generator(key)
+
+
+class unique_name_guard:
+    """Swap in a fresh (or given) name-counter state so separately built
+    programs get identical var names — required when several trainers build
+    the same model in one process (PS tables are keyed by var name).
+    Reference: fluid.unique_name.guard (python/paddle/fluid/unique_name.py).
+    """
+
+    def __init__(self, state: Optional[Dict[str, int]] = None):
+        self._state = {} if state is None else state
+
+    def __enter__(self):
+        self._old = _generator._ids
+        _generator._ids = self._state
+        return self
+
+    def __exit__(self, *exc):
+        _generator._ids = self._old
+        return False
 
 
 class name_scope:

@@ -155,6 +155,14 @@ class Executor:
         scope = scope or global_scope()
         feed = feed or {}
 
+        # parameter-server trainer program: jitted step bracketed by host
+        # push/pull through the native KV service (transpiler/
+        # distribute_transpiler.py)
+        ps_plan = getattr(program, "_ps_plan", None)
+        if ps_plan is not None and not getattr(self, "_ps_reentry", False):
+            return self._run_ps(program, feed, fetch_list, scope,
+                                return_numpy, ps_plan)
+
         # Collective-transpiled programs carry the replica count they were
         # rewritten for; running on a different mesh width silently mis-
         # scales gradients, so refuse.
@@ -284,8 +292,30 @@ class Executor:
                         f"#{idx} ({op_type}) — FLAGS_check_nan_inf")
 
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            from .selected_rows import to_dense
+            return [np.asarray(to_dense(f)) for f in fetches]
         return list(fetches)
+
+    def _run_ps(self, program, feed, fetch_list, scope, return_numpy, plan):
+        from .selected_rows import to_dense
+
+        plan.ensure_init(scope)
+        plan.before_step(scope, feed)
+        user = [f.name if isinstance(f, Variable) else f
+                for f in (fetch_list or [])]
+        extra = [n for n in plan.extra_fetches() if n not in set(user)]
+        self._ps_reentry = True
+        try:
+            raw = self.run(program, feed=feed, fetch_list=user + extra,
+                           scope=scope, return_numpy=False)
+        finally:
+            self._ps_reentry = False
+        fetched = dict(zip(user + extra, raw))
+        plan.after_step(scope, fetched)
+        outs = raw[:len(user)]
+        if return_numpy:
+            return [np.asarray(to_dense(o)) for o in outs]
+        return outs
 
     # -- compilation ---------------------------------------------------------
     def _compile(self, program: Program, feed_shapes, fetch_names,
@@ -340,8 +370,12 @@ class Executor:
                                 jnp.all(jnp.isfinite(v))
             from .selected_rows import to_dense
             new_mut = {n: env[n] for n in out_names}
-            # fetched SelectedRows densify at the boundary (as_numpy analog)
-            fetches = [to_dense(env[n]) for n in fetch_names]
+            # fetched SelectedRows densify at the boundary (as_numpy
+            # analog) — except names the PS runtime wants raw (rows+values
+            # go over the wire, not a dense vocab-sized buffer)
+            sparse_keep = getattr(program, "_sparse_fetch_names", set())
+            fetches = [env[n] if n in sparse_keep else to_dense(env[n])
+                       for n in fetch_names]
             new_key = jax.random.fold_in(rng_key, 0x5eed)
             return new_mut, fetches, new_key, finite_flags
 

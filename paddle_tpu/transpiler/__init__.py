@@ -1,5 +1,11 @@
 """Program transpilers (reference: python/paddle/fluid/transpiler/)."""
 
 from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, RoundRobin, HashName,
+    PServerSpec, start_pserver, run_pserver)
 
-__all__ = ["Collective", "GradAllReduce", "LocalSGD"]
+__all__ = ["Collective", "GradAllReduce", "LocalSGD",
+           "DistributeTranspiler", "DistributeTranspilerConfig",
+           "RoundRobin", "HashName", "PServerSpec", "start_pserver",
+           "run_pserver"]

@@ -1,0 +1,278 @@
+"""Parameter-server training over the native pskv KV service, loopback.
+
+Mirrors the reference's test_dist_base.py pattern (pserver + trainers on
+localhost, trainer losses must match local-run losses) with threads instead
+of subprocesses: the KV server runs on C++ threads in-process and each
+trainer drives its own Executor/Scope.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.transpiler import (DistributeTranspiler, start_pserver)
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _build(optimizer, sparse=False, seed=7):
+    main, startup = pt.Program(), pt.Program()
+    # fresh name-counter state: every trainer (and the local baseline) must
+    # produce IDENTICAL var names — PS tables are keyed by them
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        if sparse:
+            ids = pt.layers.data("ids", [1], dtype="int64")
+            x = pt.layers.embedding(ids, size=[50, 8], is_sparse=True)
+        else:
+            x = pt.layers.data("x", [8], dtype="float32")
+        h = pt.layers.fc(x, size=16, act="relu")
+        y = pt.layers.fc(h, size=1)
+        label = pt.layers.data("label", [1], dtype="float32")
+        loss = pt.layers.mean(pt.layers.square(y - label))
+        optimizer().minimize(loss)
+    main.random_seed = startup.random_seed = seed
+    return main, startup, loss
+
+
+def _feeds(steps, sparse, rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    out = []
+    for _ in range(steps):
+        if sparse:
+            ids = rng.randint(0, 50, (16, 1)).astype(np.int64)
+            label = (ids.astype(np.float32) / 50.0)
+            out.append({"ids": ids, "label": label})
+        else:
+            x = rng.randn(16, 8).astype(np.float32)
+            label = x.sum(1, keepdims=True).astype(np.float32)
+            out.append({"x": x, "label": label})
+    return out
+
+
+def _run_local(optimizer, feeds, sparse):
+    main, startup, loss = _build(optimizer, sparse)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for f in feeds:
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    return losses
+
+
+def _run_ps(optimizer, feeds_per_trainer, sparse, trainers, n_servers=2):
+    ports = [_free_port() for _ in range(n_servers)]
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+
+    # build one transpiled program per trainer (separate Program objects)
+    progs = []
+    for tid in range(trainers):
+        main, startup, loss = _build(optimizer, sparse)
+        t = DistributeTranspiler()
+        t.transpile(tid, program=main, pservers=endpoints,
+                    trainers=trainers, sync_mode=True,
+                    startup_program=startup)
+        progs.append((t.get_trainer_program(), startup, loss, t))
+
+    servers = [start_pserver(progs[0][3].get_pserver_program(
+        f"127.0.0.1:{p}")) for p in ports]
+
+    results = [None] * trainers
+    errors = []
+
+    def trainer(tid):
+        try:
+            main, startup, loss, _ = progs[tid]
+            exe = pt.Executor()
+            scope = pt.Scope()
+            losses = []
+            with pt.scope_guard(scope):
+                exe.run(startup)
+                for f in feeds_per_trainer[tid]:
+                    (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+                    losses.append(float(np.ravel(lv)[0]))
+            results[tid] = losses
+            main._ps_plan.shutdown()
+        except Exception as e:  # pragma: no cover
+            import traceback
+            errors.append(traceback.format_exc())
+            raise
+
+    threads = [threading.Thread(target=trainer, args=(tid,))
+               for tid in range(trainers)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    for srv in servers:
+        srv.stop()
+    assert not errors, errors[0]
+    assert all(r is not None for r in results), "trainer timed out"
+    return results
+
+
+OPTS = {
+    "sgd": lambda: pt.optimizer.SGD(learning_rate=0.05),
+    "adam": lambda: pt.optimizer.Adam(learning_rate=0.05),
+    "adagrad": lambda: pt.optimizer.Adagrad(learning_rate=0.1),
+}
+
+
+@pytest.mark.parametrize("opt_name", sorted(OPTS))
+def test_ps_dense_matches_local(opt_name):
+    """2 trainers, identical feeds: sync-PS mean grad == each trainer's
+    grad, so the trajectory must match a local run step for step."""
+    feeds = _feeds(5, sparse=False)
+    local = _run_local(OPTS[opt_name], feeds, sparse=False)
+    res = _run_ps(OPTS[opt_name], [feeds, feeds], sparse=False, trainers=2)
+    for tid in range(2):
+        np.testing.assert_allclose(res[tid], local, rtol=2e-3, atol=1e-4,
+                                   err_msg=f"trainer {tid} ({opt_name})")
+
+
+def test_ps_sparse_embedding_matches_local():
+    feeds = _feeds(5, sparse=True)
+    local = _run_local(OPTS["sgd"], feeds, sparse=True)
+    res = _run_ps(OPTS["sgd"], [feeds, feeds], sparse=True, trainers=2)
+    for tid in range(2):
+        np.testing.assert_allclose(res[tid], local, rtol=2e-3, atol=1e-4,
+                                   err_msg=f"trainer {tid}")
+
+
+def test_ps_two_trainers_different_data_converges():
+    """Different shards per trainer: losses must go down (convergence
+    smoke, the reference's delta-based dist test)."""
+    f0 = _feeds(12, sparse=False, rng_seed=1)
+    f1 = _feeds(12, sparse=False, rng_seed=2)
+    res = _run_ps(OPTS["sgd"], [f0, f1], sparse=False, trainers=2)
+    for tid in range(2):
+        first3 = np.mean(res[tid][:3])
+        last3 = np.mean(res[tid][-3:])
+        assert last3 < first3, (tid, res[tid])
+
+
+def test_ps_lr_schedule_pushed_to_server():
+    """LR decay computed on the trainer must reach the server tables."""
+    def opt():
+        return pt.optimizer.SGD(
+            learning_rate=pt.layers.exponential_decay(
+                learning_rate=0.1, decay_steps=1, decay_rate=0.5,
+                staircase=True))
+
+    feeds = _feeds(4, sparse=False)
+    local = _run_local(opt, feeds, sparse=False)
+    res = _run_ps(opt, [feeds], sparse=False, trainers=1, n_servers=1)
+    np.testing.assert_allclose(res[0], local, rtol=2e-3, atol=1e-4)
+
+
+def test_fleet_ps_api():
+    """fleet facade: server role runs the KV service, worker trains
+    (reference: test_dist_fleet_base.py flow)."""
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker, Role)
+    from paddle_tpu.incubate.fleet.parameter_server import fleet, PSFleet
+
+    port = _free_port()
+    eps = [f"127.0.0.1:{port}"]
+
+    def build_and_minimize(f):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4], dtype="float32")
+            y = pt.layers.fc(x, size=1)
+            label = pt.layers.data("label", [1], dtype="float32")
+            loss = pt.layers.mean(pt.layers.square(y - label))
+            opt = f.distributed_optimizer(
+                pt.optimizer.SGD(learning_rate=0.1))
+            opt.minimize(loss, startup_program=startup)
+        return main, startup, loss
+
+    # server side
+    fsrv = PSFleet()
+    fsrv.init(UserDefinedRoleMaker(current_id=0, role=Role.SERVER,
+                                   worker_num=1, server_endpoints=eps))
+    build_and_minimize(fsrv)
+    srv = fsrv.run_server(blocking=False)
+
+    # worker side
+    fwk = PSFleet()
+    fwk.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                  worker_num=1, server_endpoints=eps))
+    main, startup, loss = build_and_minimize(fwk)
+    fwk.init_worker()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(8):
+            x = rng.randn(8, 4).astype(np.float32)
+            lab = x.sum(1, keepdims=True)
+            (lv,) = exe.run(fwk.main_program, feed={"x": x, "label": lab},
+                            fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    fwk.stop_worker()
+    fsrv.stop_server()
+    assert losses[-1] < losses[0]
+
+
+def test_ps_async_mode_converges():
+    """Async PS (reference Communicator semantics): pushes apply
+    immediately, no aggregation barrier."""
+    port = _free_port()
+    main, startup, loss = _build(OPTS["sgd"], sparse=False)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, pservers=f"127.0.0.1:{port}", trainers=1,
+                sync_mode=False, startup_program=startup)
+    srv = start_pserver(t.get_pserver_program(f"127.0.0.1:{port}"))
+    exe = pt.Executor()
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for f in _feeds(10, sparse=False):
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    main._ps_plan.shutdown()
+    srv.stop()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_server_stop_with_open_connection_does_not_hang():
+    import time
+    from paddle_tpu.distributed.pskv import KVServer, KVClient
+    srv = KVServer(port=0, trainers=1, sync=True)
+    c = KVClient("127.0.0.1", srv.port)
+    c.create_dense("w", 2, opt="sgd", lr=0.1)
+    t0 = time.time()
+    srv.stop()  # connection still open: handler must be unblocked
+    assert time.time() - t0 < 5
+    c.close()
+
+
+def test_run_pserver_exits_on_shutdown_command():
+    from paddle_tpu.distributed.pskv import KVClient
+    from paddle_tpu.transpiler.distribute_transpiler import (run_pserver,
+                                                             PServerSpec)
+    port = _free_port()
+    spec = PServerSpec(endpoint=f"127.0.0.1:{port}", trainers=1,
+                      sync_mode=True)
+    th = threading.Thread(target=run_pserver, args=(spec,))
+    th.start()
+    c = KVClient("127.0.0.1", port)
+    c.shutdown_server()
+    c.close()
+    th.join(timeout=10)
+    assert not th.is_alive()
