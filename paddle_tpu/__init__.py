@@ -28,6 +28,8 @@ from . import dygraph
 from . import transpiler
 from . import incubate
 from . import distributed
+from . import dataset
+from .dataset import DatasetFactory
 from .framework.executor import as_jax_function
 
 __version__ = "0.1.0"

@@ -1,0 +1,251 @@
+"""Dataset API over the native datafeed library.
+
+Reference: python/paddle/fluid/dataset.py — `DatasetFactory` creating
+`QueueDataset` (streaming, data_feed.cc MultiSlotDataFeed) and
+`InMemoryDataset` (load + global shuffle, dataset.py:269). The parsing /
+channel / shuffle machinery is C++ (native/datafeed/datafeed.cc); batches
+surface as numpy per-slot (values, lod) pairs, padded to static shapes for
+XLA by `Executor.train_from_dataset`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "DatasetBase", "QueueDataset",
+           "InMemoryDataset"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "datafeed", "datafeed.cc")
+_SO = os.path.join(_REPO_ROOT, "native", "datafeed", "_datafeed.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                            "-pthread", "-o", _SO, _SRC],
+                           check=True, capture_output=True, text=True)
+        lib = ctypes.CDLL(_SO)
+        c = ctypes
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.df_create.restype = c.c_void_p
+        lib.df_create.argtypes = [c.c_uint64, c.c_int, c.c_int]
+        lib.df_destroy.argtypes = [c.c_void_p]
+        lib.df_add_slot.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.df_set_filelist.argtypes = [c.c_void_p, c.c_char_p]
+        lib.df_set_batch_size.argtypes = [c.c_void_p, c.c_uint64]
+        lib.df_set_thread_num.argtypes = [c.c_void_p, c.c_int]
+        lib.df_set_stripe.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+        lib.df_start.argtypes = [c.c_void_p]
+        lib.df_load_into_memory.argtypes = [c.c_void_p]
+        lib.df_memory_size.restype = c.c_uint64
+        lib.df_memory_size.argtypes = [c.c_void_p]
+        lib.df_shuffle.argtypes = [c.c_void_p, c.c_uint64]
+        lib.df_rewind.argtypes = [c.c_void_p]
+        lib.df_next_batch.restype = c.c_uint64
+        lib.df_next_batch.argtypes = [c.c_void_p]
+        lib.df_slot_value_count.restype = c.c_uint64
+        lib.df_slot_value_count.argtypes = [c.c_void_p, c.c_uint64]
+        lib.df_copy_slot_ids.argtypes = [c.c_void_p, c.c_uint64, i64p]
+        lib.df_copy_slot_floats.argtypes = [c.c_void_p, c.c_uint64, f32p]
+        lib.df_copy_slot_lod.argtypes = [c.c_void_p, c.c_uint64, u64p]
+        _lib = lib
+        return _lib
+
+
+class DatasetFactory:
+    """reference: dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist: List[str] = []
+        self._use_vars = []           # Variables, in slot order
+        self._drop_last = False
+        self._handle = None
+        self._pipe_command = None     # accepted for API parity
+
+    # -- reference-parity config setters -------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+        if self._handle is not None:
+            self._lib.df_set_batch_size(self._handle, self._batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = int(thread_num)
+        if self._handle is not None:
+            self._lib.df_set_thread_num(self._handle, self._thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+        if self._handle is not None:
+            self._lib.df_set_filelist(self._handle,
+                                      ",".join(self._filelist).encode())
+
+    def set_use_var(self, var_list):
+        """Declares the slots, in file order; a var with an integer dtype is
+        an id slot (sparse), a float var is a float slot."""
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd: str):
+        self._pipe_command = cmd
+
+    def set_hdfs_config(self, *a, **kw):
+        pass
+
+    def desc(self) -> str:
+        return "\n".join(
+            f"slot {v.name} {'float' if 'float' in v.dtype else 'id'}"
+            for v in self._use_vars)
+
+    # -- native handle -------------------------------------------------------
+    def _ensure_handle(self):
+        if self._handle is not None:
+            return
+        if not self._use_vars:
+            raise RuntimeError("dataset.set_use_var(...) must be called")
+        lib = _load_lib()
+        self._lib = lib
+        self._handle = lib.df_create(self._batch_size, self._thread_num,
+                                     1 if self._drop_last else 0)
+        for v in self._use_vars:
+            is_float = 1 if "float" in v.dtype else 0
+            lib.df_add_slot(self._handle, v.name.encode(), is_float)
+        lib.df_set_filelist(self._handle,
+                            ",".join(self._filelist).encode())
+
+    def _release(self):
+        if self._handle is not None:
+            self._lib.df_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
+
+    # -- batch iteration (used by Executor.train_from_dataset) --------------
+    def _start_epoch(self):
+        raise NotImplementedError
+
+    def _next_batch(self) -> Optional[Dict[str, Tuple[np.ndarray,
+                                                      np.ndarray]]]:
+        """Returns {slot name: (values, lod)} or None at epoch end; `lod` is
+        the (batch+1,) offsets vector — the LoD ragged representation."""
+        lib, h = self._lib, self._handle
+        n = lib.df_next_batch(h)
+        if n == 0:
+            return None
+        out = {}
+        for s, v in enumerate(self._use_vars):
+            cnt = lib.df_slot_value_count(h, s)
+            lod = np.empty(n + 1, np.uint64)
+            lib.df_copy_slot_lod(h, s, lod)
+            if "float" in v.dtype:
+                vals = np.empty(cnt, np.float32)
+                if cnt:
+                    lib.df_copy_slot_floats(h, s, vals)
+            else:
+                vals = np.empty(cnt, np.int64)
+                if cnt:
+                    lib.df_copy_slot_ids(h, s, vals)
+            out[v.name] = (vals, lod.astype(np.int64))
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming mode: parser threads feed a bounded channel
+    (reference dataset.py:575 QueueDataset)."""
+
+    def _start_epoch(self):
+        self._ensure_handle()
+        self._lib.df_start(self._handle)
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffle "
+            "(reference raises likewise)")
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffle")
+
+
+class InMemoryDataset(DatasetBase):
+    """Load once, shuffle, iterate per epoch (reference dataset.py:269)."""
+
+    def __init__(self):
+        super().__init__()
+        self._loaded = False
+        self._shuffle_seed = 0
+
+    def load_into_memory(self):
+        self._ensure_handle()
+        self._lib.df_load_into_memory(self._handle)
+        self._loaded = True
+
+    def memory_size(self) -> int:
+        self._ensure_handle()
+        return int(self._lib.df_memory_size(self._handle))
+
+    def _check_loaded(self):
+        if not self._loaded or self._handle is None:
+            raise RuntimeError("call load_into_memory() before shuffling")
+
+    def local_shuffle(self):
+        self._check_loaded()
+        self._shuffle_seed += 1
+        self._lib.df_shuffle(self._handle, self._shuffle_seed)
+
+    def global_shuffle(self, fleet=None, seed: Optional[int] = None):
+        """Single-host: same as local_shuffle. With a fleet, every worker
+        must pass the SAME seed (or rely on matching call counts); all
+        workers then apply the identical permutation and each takes the
+        disjoint stripe idx %% worker_num == worker_index — together they
+        cover each record exactly once per epoch (the reference shuffles
+        across trainers through the PS channel,
+        dataset.py:269 global_shuffle)."""
+        self._check_loaded()
+        if seed is None:
+            self._shuffle_seed += 1
+            seed = self._shuffle_seed
+        self._lib.df_shuffle(self._handle, seed)
+        if fleet is not None:
+            self._lib.df_set_stripe(self._handle, fleet.worker_index(),
+                                    fleet.worker_num())
+
+    def release_memory(self):
+        self._release()
+        self._loaded = False
+
+    def _start_epoch(self):
+        if not self._loaded:
+            self.load_into_memory()
+        self._lib.df_rewind(self._handle)

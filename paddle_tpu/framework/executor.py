@@ -383,9 +383,87 @@ class Executor:
             return dist_plan.jit(fn, mutable, created, readonly, feed_shapes)
         return jax.jit(fn, donate_argnums=(0,) if self._donate else ())
 
+    # -- Trainer path: dataset-driven loops ----------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """Run one pass over `dataset` (reference executor.py:892 — the
+        Trainer/DeviceWorker path, executor.cc:142 RunFromDataset). The
+        reference's thread-per-core Hogwild workers become: C++ parser
+        threads keep the channel full (`thread` sets their count), while
+        the device step itself is the jitted program — one TPU chip
+        executes batches back to back with no Python in the parse path."""
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        if thread:
+            dataset.set_thread(thread)
+        fetch_names = [f.name if hasattr(f, "name") else f
+                       for f in (fetch_list or [])]
+        data_vars = {v.name: v for v in program.global_block.vars.values()
+                     if v.is_data}
+
+        dataset._start_epoch()
+        step = 0
+        last = None
+        while True:
+            batch = dataset._next_batch()
+            if batch is None:
+                break
+            feed = {}
+            for name, (vals, lod) in batch.items():
+                var = data_vars.get(name)
+                if var is None:
+                    continue
+                feed[name] = _slot_batch_to_array(var, vals, lod)
+            last = self.run(program, feed=feed, fetch_list=fetch_names,
+                            scope=scope)
+            step += 1
+            if debug and fetch_names and step % print_period == 0:
+                infos = fetch_info or fetch_names
+                msg = ", ".join(f"{i}={np.ravel(v)[0]:.6f}"
+                                for i, v in zip(infos, last))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """reference executor.py:815 — same loop, typically with a
+        clone(for_test=True) program."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     # -- utilities -----------------------------------------------------------
     def close(self):
         self._cache.clear()
+
+
+def _slot_batch_to_array(var: Variable, vals: np.ndarray,
+                         lod: np.ndarray) -> np.ndarray:
+    """Ragged slot -> static-shape batch for XLA. A var shaped (-1, d...)
+    takes d=prod(trailing dims) values per record: exact-length records
+    reshape for free; ragged records pad with 0 / truncate to d (the LoD
+    ragged batching of the reference becomes pad-to-static)."""
+    b = len(lod) - 1
+    per = 1
+    for d in (var.shape[1:] if var.shape and len(var.shape) > 1 else ()):
+        per *= d
+    counts = np.diff(lod)
+    if np.all(counts == per):
+        arr = vals.reshape((b,) + tuple(var.shape[1:]))
+    else:
+        arr = np.zeros((b, per), vals.dtype)
+        for i in range(b):
+            n = min(int(counts[i]), per)
+            arr[i, :n] = vals[lod[i]:lod[i] + n]
+        arr = arr.reshape((b,) + tuple(var.shape[1:]))
+    return arr.astype(var.dtype, copy=False)
 
 
 def as_jax_function(program: Program, fetch_list, is_test: bool = True,
