@@ -98,7 +98,10 @@ bool parse_line(const std::string& line, const std::vector<SlotDesc>& slots,
   rec->floats.assign(slots.size(), {});
   for (size_t s = 0; s < slots.size(); ++s) {
     long cnt = std::strtol(p, &end, 10);
-    if (end == p || cnt < 0) return false;
+    // a count can never exceed the remaining token count; a corrupt count
+    // must be a skipped line, not a bad_alloc that kills the process
+    if (end == p || cnt < 0 ||
+        static_cast<size_t>(cnt) > line.size()) return false;
     p = end;
     if (slots[s].is_float) {
       auto& v = rec->floats[s];
@@ -137,7 +140,6 @@ struct Feed {
   std::vector<std::string> files;
   size_t batch_size = 32;
   int thread_num = 1;
-  size_t channel_cap = 4096;
   bool drop_last = false;
 
   Channel chan{4096};
@@ -175,13 +177,15 @@ void parser_main(Feed* f) {
 
 void load_into_memory(Feed* f) {
   f->memory.clear();
-  std::mutex out_mu;
+  // per-file buckets merged in FILE order: thread completion order must not
+  // leak into the record order, or same-seed shuffles diverge across fleet
+  // workers and the disjoint-stripe guarantee breaks
+  std::vector<std::vector<Record>> per_file(f->files.size());
   std::vector<std::thread> ts;
   std::atomic<size_t> cursor{0};
   int n = std::max(1, f->thread_num);
   for (int t = 0; t < n; ++t) {
     ts.emplace_back([&, f] {
-      std::vector<Record> local;
       while (true) {
         size_t i = cursor.fetch_add(1);
         if (i >= f->files.size()) break;
@@ -190,14 +194,15 @@ void load_into_memory(Feed* f) {
         while (std::getline(in, line)) {
           if (line.empty()) continue;
           Record r;
-          if (parse_line(line, f->slots, &r)) local.emplace_back(std::move(r));
+          if (parse_line(line, f->slots, &r))
+            per_file[i].emplace_back(std::move(r));
         }
       }
-      std::lock_guard<std::mutex> l(out_mu);
-      for (auto& r : local) f->memory.emplace_back(std::move(r));
     });
   }
   for (auto& t : ts) t.join();
+  for (auto& bucket : per_file)
+    for (auto& r : bucket) f->memory.emplace_back(std::move(r));
   f->in_memory = true;
   f->mem_cursor = 0;
 }

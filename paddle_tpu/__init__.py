@@ -30,6 +30,7 @@ from . import incubate
 from . import distributed
 from . import dataset
 from .dataset import DatasetFactory
+from . import inference
 from .framework.executor import as_jax_function
 
 __version__ = "0.1.0"

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -34,13 +33,8 @@ def _load_lib() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            subprocess.run(["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                            "-pthread", "-o", _SO, _SRC],
-                           check=True, capture_output=True, text=True)
-        lib = ctypes.CDLL(_SO)
+        from .native_loader import compile_and_load
+        lib = compile_and_load(_SRC, _SO)
         c = ctypes
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
@@ -223,6 +217,7 @@ class InMemoryDataset(DatasetBase):
         self._check_loaded()
         self._shuffle_seed += 1
         self._lib.df_shuffle(self._handle, self._shuffle_seed)
+        self._lib.df_set_stripe(self._handle, 0, 1)  # full coverage again
 
     def global_shuffle(self, fleet=None, seed: Optional[int] = None):
         """Single-host: same as local_shuffle. With a fleet, every worker
@@ -240,6 +235,10 @@ class InMemoryDataset(DatasetBase):
         if fleet is not None:
             self._lib.df_set_stripe(self._handle, fleet.worker_index(),
                                     fleet.worker_num())
+        else:
+            # a stripe from an earlier fleet shuffle must not silently
+            # shrink later single-host epochs
+            self._lib.df_set_stripe(self._handle, 0, 1)
 
     def release_memory(self):
         self._release()

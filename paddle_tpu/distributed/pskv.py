@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Optional
 
@@ -28,21 +27,13 @@ OPT_SGD, OPT_ADAGRAD, OPT_ADAM = 0, 1, 2
 _OPT_BY_NAME = {"sgd": OPT_SGD, "adagrad": OPT_ADAGRAD, "adam": OPT_ADAM}
 
 
-def _build():
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", _SO, _SRC]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-
-
 def load_lib() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            _build()
-        lib = ctypes.CDLL(_SO)
+        from ..native_loader import compile_and_load
+        lib = compile_and_load(_SRC, _SO)
         c = ctypes
         lib.pskv_server_start.restype = c.c_void_p
         lib.pskv_server_start.argtypes = [c.c_int, c.c_int, c.c_int]

@@ -1,0 +1,173 @@
+"""Inference API: config + predictor + StableHLO export.
+
+Reference: paddle/fluid/inference/api/ — `AnalysisConfig` +
+`AnalysisPredictor` (analysis_predictor.cc): load a saved inference model,
+run analysis passes, execute with NaiveExecutor; ZeroCopyTensor for
+feed/fetch without extra copies.
+
+TPU redesign: "analysis passes + engine subgraphs" collapse into one XLA
+compile of the pruned inference program (the nGraph/TensorRT engine-op
+machinery, operators/ngraph/ngraph_engine.h:122, is what XLA is natively).
+Deployment artifact = serialized StableHLO via jax.export — portable to any
+XLA runtime (the save_inference_model program+params dir remains the
+framework-level format).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
+           "export_stablehlo", "load_stablehlo", "PredictorPool"]
+
+
+class Config:
+    """AnalysisConfig analog. GPU/MKLDNN/TensorRT toggles are accepted and
+    ignored (XLA owns optimization); model loading options are honored."""
+
+    def __init__(self, model_dir: Optional[str] = None):
+        self._model_dir = model_dir
+        self._device = "tpu"
+        self.switch_ir_optim_ = True
+
+    def set_model(self, model_dir: str):
+        self._model_dir = model_dir
+
+    def model_dir(self) -> str:
+        return self._model_dir
+
+    # accepted no-ops for API parity
+    def enable_use_gpu(self, *a, **kw):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass
+
+    def switch_ir_optim(self, flag: bool = True):
+        self.switch_ir_optim_ = flag
+
+    def enable_memory_optim(self):
+        pass
+
+
+AnalysisConfig = Config
+
+
+class Predictor:
+    """AnalysisPredictor analog: jit-compiles the loaded inference program
+    once per input-shape signature (Executor's compile cache)."""
+
+    def __init__(self, config: Config):
+        from ..framework.executor import Executor, Scope, scope_guard
+        if not config.model_dir():
+            raise ValueError("Config.set_model(model_dir) is required")
+        from .. import io
+        self._exe = Executor()
+        self._scope = Scope()
+        with scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_vars = \
+                io.load_inference_model(config.model_dir(), self._exe)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return [v.name if hasattr(v, "name") else v
+                for v in self._fetch_vars]
+
+    def run(self, inputs) -> List[np.ndarray]:
+        """inputs: dict name->array, or list of arrays in get_input_names
+        order (ZeroCopy style)."""
+        from ..framework.executor import scope_guard
+        if not isinstance(inputs, dict):
+            inputs = dict(zip(self._feed_names, inputs))
+        with scope_guard(self._scope):
+            return self._exe.run(self._program, feed=inputs,
+                                 fetch_list=self._fetch_vars)
+
+    # ZeroCopyTensor-flavored API
+    def set_input(self, name: str, value):
+        self._pending = getattr(self, "_pending", {})
+        self._pending[name] = value
+
+    def zero_copy_run(self) -> List[np.ndarray]:
+        out = self.run(getattr(self, "_pending", {}))
+        self._pending = {}
+        return out
+
+
+def create_predictor(config: Config) -> Predictor:
+    """create_paddle_predictor analog."""
+    return Predictor(config)
+
+
+class PredictorPool:
+    """reference inference/api: a pool of predictors sharing weights; here
+    predictors are cheap (compiled executables are cached per process), so
+    the pool just constructs N."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+# ---------------------------------------------------------------------------
+# StableHLO deployment artifact
+# ---------------------------------------------------------------------------
+
+def export_stablehlo(model_dir: str, out_path: str,
+                     batch_size: int = 1) -> str:
+    """Compile the saved inference model for a fixed batch size and write a
+    portable serialized StableHLO artifact (jax.export). Params are BAKED
+    into the artifact as constants — the deployment story of the
+    reference's engine subgraph serialization. Returns out_path."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from .. import io
+    from ..framework.executor import (Executor, Scope, scope_guard,
+                                      as_jax_function)
+
+    exe = Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        program, feed_names, fetch_vars = io.load_inference_model(
+            model_dir, exe)
+        params = {n: jnp.asarray(scope.find_var(n))
+                  for n in scope.var_names() if not n.startswith("@")}
+    fn = as_jax_function(program, fetch_vars, is_test=True)
+
+    blk = program.global_block
+    specs = []
+    for n in feed_names:
+        v = blk.var(n)
+        shape = tuple(batch_size if d == -1 else d for d in v.shape)
+        specs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype)))
+
+    def entry(*feeds):
+        return fn(params, dict(zip(feed_names, feeds)))
+
+    exported = jexport.export(jax.jit(entry))(*specs)
+    data = exported.serialize()
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path
+
+
+def load_stablehlo(path: str):
+    """Rehydrate an exported artifact; returns fn(*feeds) -> [outputs]."""
+    from jax import export as jexport
+    with open(path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    return exported.call

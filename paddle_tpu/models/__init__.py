@@ -1,1 +1,4 @@
 from . import lenet  # noqa: F401
+from . import book  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401

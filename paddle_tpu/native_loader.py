@@ -1,0 +1,41 @@
+"""Shared compile-on-demand loader for the native C++ libraries.
+
+Both native components (pskv parameter server, datafeed ingestion) are
+plain C++ with extern "C" APIs, built with g++ at first use and cached next
+to their source (the environment binds via ctypes; no pybind). One loader
+so build/diagnostic behavior can't drift between them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_build_lock = threading.Lock()
+
+
+def compile_and_load(src: str, so: str) -> ctypes.CDLL:
+    """Build `so` from `src` if missing or stale (source newer), then dlopen
+    it. A missing source next to a prebuilt .so is fine (deployment without
+    sources). Raises RuntimeError with the compiler's stderr on failure."""
+    with _build_lock:
+        needs = not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(so) < os.path.getmtime(src))
+        if needs:
+            if not os.path.exists(src):
+                raise FileNotFoundError(
+                    f"native library {so} missing and source {src} absent")
+            tmp = so + ".tmp"
+            proc = subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                 "-o", tmp, src],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build of {os.path.basename(src)} failed:\n"
+                    f"{proc.stderr}")
+            os.replace(tmp, so)  # atomic vs concurrent processes
+        return ctypes.CDLL(so)

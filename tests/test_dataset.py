@@ -225,3 +225,44 @@ def test_set_batch_size_after_load_takes_effect(tmp_path):
     ds._start_epoch()
     b = ds._next_batch()
     assert len(b["ids"][1]) - 1 == 5
+
+
+def test_stripe_resets_on_nonfleet_shuffle(tmp_path):
+    files, rows = _write_multislot(tmp_path, n_files=1, lines_per_file=10)
+
+    class _F:
+        def worker_index(self):
+            return 0
+        def worker_num(self):
+            return 2
+
+    ds = pt.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist(files)
+    ds.set_use_var(_make_vars())
+    ds.load_into_memory()
+    ds.global_shuffle(fleet=_F(), seed=1)  # installs a half stripe
+    ds.global_shuffle(seed=2)              # must reset to full coverage
+    ds._start_epoch()
+    total = 0
+    while True:
+        b = ds._next_batch()
+        if b is None:
+            break
+        total += len(b["ids"][1]) - 1
+    assert total == 10
+
+
+def test_corrupt_count_line_is_skipped(tmp_path):
+    p = os.path.join(str(tmp_path), "bad.txt")
+    with open(p, "w") as f:
+        f.write("99999999999 1 2 3 4 0.5 1 1.0\n")  # absurd count: skip
+        f.write("3 1 2 3 4 0.1 0.2 0.3 0.4 1 1.0\n")  # good line
+    ds = pt.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist([p])
+    ds.set_use_var(_make_vars())
+    ds._ensure_handle()
+    ds._start_epoch()
+    b = ds._next_batch()
+    assert b is not None and len(b["ids"][1]) - 1 == 1
