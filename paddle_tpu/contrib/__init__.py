@@ -1,1 +1,2 @@
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401

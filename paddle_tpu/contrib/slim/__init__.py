@@ -1,0 +1,9 @@
+"""Model compression toolkit (reference: contrib/slim/: quantization,
+prune, distillation; NAS is not ported — superseded approaches)."""
+
+from . import quantization  # noqa: F401
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401
+from .quantization import (QuantizationTransformPass,  # noqa: F401
+                           QuantizationFreezePass)
+from .prune import Pruner, apply_masks  # noqa: F401

@@ -1,0 +1,180 @@
+"""Quantization-aware training passes over the Program IR.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+— `QuantizationTransformPass` (:41) inserts fake_quantize/dequantize pairs
+on the weights and activations of quantizable ops in the IrGraph;
+`QuantizationFreezePass` bakes trained scales in for inference export.
+
+Differences from the reference, by design: the pass runs on the Program
+(our IR) BEFORE minimize()/append_backward — gradients of the fake-quant
+ops then come from their registered STE rules automatically, instead of
+the reference's hand-inserted grad-op rewiring.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...framework.core import Parameter, Program, unique_name
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass"]
+
+# op type -> (activation input slot, weight input slot, weight quant axis)
+_QUANTIZABLE = {
+    "conv2d": ("Input", "Filter", 0),
+    "conv2d_transpose": ("Input", "Filter", 0),
+    "mul": ("X", "Y", 1),
+    "matmul": ("X", "Y", 1),
+}
+
+
+class QuantizationTransformPass:
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 moving_rate: float = 0.9,
+                 quantizable_op_type: Optional[Sequence[str]] = None,
+                 skip_pattern: str = "skip_quant"):
+        if activation_quantize_type not in ("moving_average_abs_max",
+                                            "abs_max"):
+            raise ValueError(activation_quantize_type)
+        if weight_quantize_type not in ("channel_wise_abs_max", "abs_max"):
+            raise ValueError(weight_quantize_type)
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.act_type = activation_quantize_type
+        self.w_type = weight_quantize_type
+        self.moving_rate = moving_rate
+        self.op_types = list(quantizable_op_type or _QUANTIZABLE)
+        self.skip_pattern = skip_pattern
+
+    def apply(self, program: Program, startup: Program) -> None:
+        """In place. Call BEFORE optimizer.minimize() so backward picks up
+        the STE grads of the inserted fake ops."""
+        blk = program.global_block
+        if any(op.attrs.get("op_role") == "backward" for op in blk.ops):
+            raise RuntimeError(
+                "QuantizationTransformPass must run before "
+                "append_backward/minimize")
+        quantized: Dict[str, str] = {}  # original var -> quantized var
+        i = 0
+        while i < len(blk.ops):
+            op = blk.ops[i]
+            spec = _QUANTIZABLE.get(op.type)
+            if spec is None or op.type not in self.op_types or \
+                    self.skip_pattern in str(op.attrs.get("name", "")):
+                i += 1
+                continue
+            act_slot, w_slot, w_axis = spec
+            for slot, is_weight in ((act_slot, False), (w_slot, True)):
+                names = op.inputs.get(slot)
+                if not names:
+                    continue
+                src = names[0]
+                var = blk.var(src)
+                if is_weight and not isinstance(var, Parameter):
+                    continue  # e.g. matmul of two activations
+                key = (src, is_weight)
+                if key in quantized:
+                    op.inputs[slot] = [quantized[key]]
+                    continue
+                qname = unique_name(src + ".quantized")
+                blk.create_var(name=qname, shape=var.shape, dtype=var.dtype)
+                scale_name = unique_name(src + ".quant_scale")
+                ins = {"X": [src]}
+                if is_weight:
+                    if self.w_type == "channel_wise_abs_max":
+                        op_type = ("fake_channel_wise_quantize_dequantize"
+                                   "_abs_max")
+                        attrs = {"bit_length": self.wbits,
+                                 "quant_axis": w_axis}
+                        n_scale = var.shape[w_axis]
+                    else:
+                        op_type = "fake_quantize_dequantize_abs_max"
+                        attrs = {"bit_length": self.wbits}
+                        n_scale = 1
+                    blk.create_var(name=scale_name,
+                                   shape=(n_scale,), dtype="float32")
+                elif self.act_type == "moving_average_abs_max":
+                    op_type = ("fake_quantize_dequantize_moving_average"
+                               "_abs_max")
+                    attrs = {"bit_length": self.abits,
+                             "moving_rate": self.moving_rate}
+                    state = unique_name(src + ".quant_state")
+                    blk.create_var(name=state, shape=(1,), dtype="float32",
+                                   persistable=True, stop_gradient=True)
+                    sb = startup.global_block
+                    sb.create_var(name=state, shape=(1,), dtype="float32",
+                                  persistable=True, stop_gradient=True)
+                    sb.append_op("fill_constant", {}, {"Out": [state]},
+                                 {"shape": [1], "dtype": "float32",
+                                  "value": 0.0}, infer_shape=False)
+                    ins["InScale"] = [state]
+                else:
+                    op_type = "fake_quantize_dequantize_abs_max"
+                    attrs = {"bit_length": self.abits}
+                    blk.create_var(name=scale_name, shape=(1,),
+                                   dtype="float32")
+                outs = {"Out": [qname], "OutScale": [scale_name]}
+                if "InScale" in ins:
+                    # write the state var so the moving average persists
+                    outs["OutScale"] = [ins["InScale"][0]]
+                from ...framework.core import Operator
+                qop = Operator(blk, op_type, ins, outs, attrs)
+                blk.ops.insert(i, qop)
+                i += 1
+                op.inputs[slot] = [qname]
+                quantized[key] = qname
+            i += 1
+        program._bump_version()
+
+
+class QuantizationFreezePass:
+    """Bake trained quantization in for inference: weights in the scope are
+    snapped onto their int-b grid (values become exact multiples of
+    scale/qmax), weight fake-ops are removed (the weight IS quantized now),
+    and activation fake-ops flip to is_test (frozen moving scale). Returns
+    {weight name: scale array} for export metadata."""
+
+    def __init__(self, weight_bits: int = 8):
+        self.wbits = weight_bits
+
+    def apply(self, program: Program, scope) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+        blk = program.global_block
+        qmax = float(2 ** (self.wbits - 1) - 1)
+        scales: Dict[str, np.ndarray] = {}
+        keep = []
+        rewire: Dict[str, str] = {}
+        for op in blk.ops:
+            if op.type in ("fake_quantize_dequantize_abs_max",
+                           "fake_channel_wise_quantize_dequantize_abs_max"):
+                src = op.inputs["X"][0]
+                var = blk.var(src)
+                if isinstance(var, Parameter):
+                    w = np.asarray(scope.find_var(src), np.float32)
+                    axis = op.attrs.get("quant_axis", 0)
+                    if op.type.startswith("fake_channel"):
+                        red = tuple(i for i in range(w.ndim) if i != axis)
+                        scale = np.max(np.abs(w), axis=red, keepdims=True)
+                    else:
+                        scale = np.max(np.abs(w))
+                    safe = np.where(scale > 0, scale, 1.0)
+                    q = np.clip(np.round(w * (qmax / safe)), -qmax, qmax)
+                    scope.set_var(src, jnp.asarray(q * (safe / qmax)))
+                    scales[src] = np.ravel(scale)
+                    rewire[op.outputs["Out"][0]] = src
+                    continue  # drop the op
+            if op.type == ("fake_quantize_dequantize_moving_average"
+                           "_abs_max"):
+                op.attrs["is_test"] = True
+            keep.append(op)
+        for op in keep:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rewire.get(n, n) for n in names]
+        blk.ops = keep
+        program._quant_weight_scales = scales
+        program._bump_version()
+        return scales

@@ -529,6 +529,7 @@ class Program:
 _TEST_MODE_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
+    "fake_quantize_dequantize_moving_average_abs_max": ("is_test",),
 }
 
 

