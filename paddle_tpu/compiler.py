@@ -33,6 +33,17 @@ class BuildStrategy:
         self.gradient_scale_strategy = 0
         self.num_trainers = 1
         self.trainer_id = 0
+        # reference build_strategy.h:130-139 — multi-ring and two-level
+        # (intra-node, inter-node) allreduce. Effective in explicit-SPMD
+        # mode: with_collective(...) consults these (or takes
+        # hierarchical_inter_nranks directly) and reshapes the mesh
+        # (dp -> dp_inter x dp_intra), lowering reductions over both axes.
+        # In GSPMD mode (with_data_parallel) XLA already routes collectives
+        # over ICI/DCN optimally and the knobs are no-ops, like most
+        # reference fusion knobs here.
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 1
 
 
 class ExecutionStrategy:
@@ -77,15 +88,22 @@ class CompiledProgram:
         return self
 
     def with_collective(self, nranks: Optional[int] = None,
-                        axis_name: str = "dp"):
+                        axis_name: str = "dp",
+                        hierarchical_inter_nranks: int = 1,
+                        build_strategy: Optional[BuildStrategy] = None):
         """Explicit-SPMD mode: run the block under shard_map so program-level
         c_* collective ops (layers/collective.py) perform the communication —
         the analog of multi-process collective training
         (transpiler/collective.py + distributed.launch). The program must
         carry its own gradient c_allreduce ops (fleet.CollectiveOptimizer
         inserts them)."""
+        if build_strategy is not None and \
+                build_strategy.use_hierarchical_allreduce and \
+                hierarchical_inter_nranks == 1:
+            hierarchical_inter_nranks = \
+                build_strategy.hierarchical_allreduce_inter_nranks
         self._dp = True
-        self._collective = (nranks, axis_name)
+        self._collective = (nranks, axis_name, hierarchical_inter_nranks)
         return self
 
     def _plan(self):
@@ -93,9 +111,10 @@ class CompiledProgram:
             return None
         if self._plan_obj is None and getattr(self, "_collective", None):
             from .parallel.plan import CollectiveSpmdPlan
-            nranks, axis_name = self._collective
+            nranks, axis_name, inter = self._collective
             self._plan_obj = CollectiveSpmdPlan(nranks=nranks,
-                                                axis_name=axis_name)
+                                                axis_name=axis_name,
+                                                inter_nranks=inter)
         if self._plan_obj is None:
             from .parallel.plan import ShardingPlan
             self._plan_obj = ShardingPlan(

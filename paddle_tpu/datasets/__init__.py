@@ -1,0 +1,18 @@
+"""Built-in dataset loaders (reference: python/paddle/dataset/).
+
+Each module exposes train()/test() reader creators with the reference's
+sample shapes. Real data loads from PADDLE_TPU_DATA_HOME (no in-process
+downloading — this environment has no egress; place files there, see each
+module's docstring). Every loader also has a deterministic synthetic
+fallback so pipelines/tests run hermetically: pass use_synthetic=True or
+set PADDLE_TPU_SYNTHETIC_DATA=1.
+"""
+
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import wmt16  # noqa: F401

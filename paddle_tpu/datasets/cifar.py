@@ -1,0 +1,68 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py). Samples:
+(image float32[3072] in [0,1], label int). Stage cifar-10-python.tar.gz /
+cifar-100-python.tar.gz under $PADDLE_TPU_DATA_HOME/cifar/."""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+_N_SYNTH = {"train": 256, "test": 64}
+
+
+def _synth(split, classes):
+    def reader():
+        rng = common.synthetic_rng(f"cifar{classes}", split)
+        for _ in range(_N_SYNTH[split]):
+            label = rng.randint(0, classes)
+            img = rng.rand(3072).astype(np.float32) * 0.1
+            img[label::classes] += 0.8
+            yield img, int(label)
+    return reader
+
+
+def _real(tar_name, member_match, classes):
+    path = common.require_file(
+        common.data_path("cifar", tar_name),
+        "Download CIFAR from https://www.cs.toronto.edu/~kriz/cifar.html.")
+
+    def reader():
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if member_match not in m.name:
+                    continue
+                d = pickle.load(tf.extractfile(m), encoding="latin1")
+                labels = d.get("labels", d.get("fine_labels"))
+                for img, lab in zip(d["data"], labels):
+                    yield img.astype(np.float32) / 255.0, int(lab)
+    return reader
+
+
+def train10(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("train", 10)
+    return _real("cifar-10-python.tar.gz", "data_batch", 10)
+
+
+def test10(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("test", 10)
+    return _real("cifar-10-python.tar.gz", "test_batch", 10)
+
+
+def train100(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("train", 100)
+    return _real("cifar-100-python.tar.gz", "train", 100)
+
+
+def test100(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("test", 100)
+    return _real("cifar-100-python.tar.gz", "test", 100)

@@ -1,0 +1,42 @@
+"""Dataset cache + synthetic-mode plumbing (reference:
+python/paddle/dataset/common.py DATA_HOME/download)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["DATA_HOME", "data_path", "synthetic_enabled", "require_file"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "dataset"))
+
+
+def data_path(*parts) -> str:
+    return os.path.join(DATA_HOME, *parts)
+
+
+def synthetic_enabled(flag) -> bool:
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("PADDLE_TPU_SYNTHETIC_DATA", "0") == "1"
+
+
+def require_file(path: str, hint: str) -> str:
+    """No egress in this environment: files must be staged by the user."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"dataset file {path!r} not found. {hint} Or run with "
+            "use_synthetic=True / PADDLE_TPU_SYNTHETIC_DATA=1 for "
+            "deterministic synthetic data.")
+    return path
+
+
+def synthetic_rng(name: str, split: str) -> np.random.RandomState:
+    import zlib
+    # stable across processes/runs (hash() is salted per process)
+    seed = zlib.crc32(f"{name}/{split}".encode()) & 0x7FFFFFFF
+    return np.random.RandomState(seed)

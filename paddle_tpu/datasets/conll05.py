@@ -1,0 +1,49 @@
+"""CoNLL-2005 SRL (reference: python/paddle/dataset/conll05.py). Samples:
+(word_ids, predicate_ids, mark_ids, label_ids) all equal-length lists.
+Real data is license-gated; stage conll05st-tests.tar.gz under
+$PADDLE_TPU_DATA_HOME/conll05/ — otherwise synthetic only."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["test", "word_dict_len", "label_dict_len", "predicate_dict_len"]
+
+_VOCAB, _LABELS, _PREDS = 300, 9, 50
+_N_SYNTH = 128
+
+
+def word_dict_len(use_synthetic=None):
+    return _VOCAB
+
+
+def label_dict_len(use_synthetic=None):
+    return _LABELS
+
+
+def predicate_dict_len(use_synthetic=None):
+    return _PREDS
+
+
+def test(use_synthetic=None):
+    if not common.synthetic_enabled(use_synthetic):
+        common.require_file(
+            common.data_path("conll05", "conll05st-tests.tar.gz"),
+            "CoNLL-2005 is license-gated; obtain it from the task page.")
+        raise NotImplementedError(
+            "real CoNLL-2005 parsing not implemented; use synthetic")
+
+    def reader():
+        rng = common.synthetic_rng("conll05", "test")
+        for _ in range(_N_SYNTH):
+            n = rng.randint(5, 20)
+            words = rng.randint(0, _VOCAB, n)
+            pred = rng.randint(0, _PREDS)
+            mark = np.zeros(n, np.int64)
+            mark[rng.randint(0, n)] = 1
+            labels = (words % _LABELS)
+            yield (words.tolist(), [int(pred)] * n, mark.tolist(),
+                   labels.tolist())
+    return reader

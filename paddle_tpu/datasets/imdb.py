@@ -1,0 +1,83 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py). Samples:
+(word-id list, label 0/1). Stage aclImdb_v1.tar.gz under
+$PADDLE_TPU_DATA_HOME/imdb/."""
+
+from __future__ import annotations
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["word_dict", "train", "test"]
+
+_SYNTH_VOCAB = 200
+_N_SYNTH = {"train": 256, "test": 64}
+
+
+def word_dict(use_synthetic=None, cutoff: int = 150):
+    if common.synthetic_enabled(use_synthetic):
+        return {f"w{i}": i for i in range(_SYNTH_VOCAB)}
+    path = common.require_file(
+        common.data_path("imdb", "aclImdb_v1.tar.gz"),
+        "Download aclImdb_v1.tar.gz from ai.stanford.edu/~amaas/data/"
+        "sentiment.")
+    freq = {}
+    pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+    with tarfile.open(path) as tf:
+        for m in tf.getmembers():
+            if not pat.match(m.name):
+                continue
+            doc = tf.extractfile(m).read().decode("latin1").lower()
+            for w in doc.translate(
+                    str.maketrans("", "", string.punctuation)).split():
+                freq[w] = freq.get(w, 0) + 1
+    words = [w for w, c in freq.items() if c >= cutoff]
+    words.sort()
+    return {w: i for i, w in enumerate(words)}
+
+
+def _synth_reader(split):
+    def reader():
+        rng = common.synthetic_rng("imdb", split)
+        for _ in range(_N_SYNTH[split]):
+            label = rng.randint(0, 2)
+            n = rng.randint(5, 40)
+            base = 0 if label == 0 else _SYNTH_VOCAB // 2
+            ids = (base + rng.randint(0, _SYNTH_VOCAB // 2, n)).tolist()
+            yield ids, int(label)
+    return reader
+
+
+def _real_reader(split, w_dict):
+    path = common.data_path("imdb", "aclImdb_v1.tar.gz")
+    pat = re.compile(rf"aclImdb/{split}/(pos|neg)/.*\.txt$")
+    unk = len(w_dict)
+
+    def reader():
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                mm = pat.match(m.name)
+                if not mm:
+                    continue
+                label = 0 if mm.group(1) == "neg" else 1
+                doc = tf.extractfile(m).read().decode("latin1").lower()
+                words = doc.translate(
+                    str.maketrans("", "", string.punctuation)).split()
+                yield [w_dict.get(w, unk) for w in words], label
+    return reader
+
+
+def train(w_dict=None, use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("train")
+    return _real_reader("train", w_dict or word_dict())
+
+
+def test(w_dict=None, use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("test")
+    return _real_reader("test", w_dict or word_dict())

@@ -1,0 +1,55 @@
+"""MNIST (reference: python/paddle/dataset/mnist.py). Samples:
+(image float32[784] scaled to [-1,1], label int). Stage the standard IDX
+files under $PADDLE_TPU_DATA_HOME/mnist/ (train-images-idx3-ubyte.gz,
+train-labels-idx1-ubyte.gz, t10k-...)."""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+_N_SYNTH = {"train": 512, "test": 128}
+
+
+def _reader(split, use_synthetic):
+    if common.synthetic_enabled(use_synthetic):
+        def synth():
+            rng = common.synthetic_rng("mnist", split)
+            for _ in range(_N_SYNTH[split]):
+                label = rng.randint(0, 10)
+                img = rng.rand(784).astype(np.float32) * 0.1 - 1.0
+                # class-dependent bump so models can actually learn
+                img[label * 78:(label + 1) * 78] += 1.5
+                yield img, int(label)
+        return synth
+
+    prefix = "train" if split == "train" else "t10k"
+    img_p = common.require_file(
+        common.data_path("mnist", f"{prefix}-images-idx3-ubyte.gz"),
+        "Download MNIST from http://yann.lecun.com/exdb/mnist/.")
+    lab_p = common.data_path("mnist", f"{prefix}-labels-idx1-ubyte.gz")
+
+    def real():
+        with gzip.open(img_p, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
+        with gzip.open(lab_p, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8)
+        for img, lab in zip(images, labels):
+            yield (img.astype(np.float32) / 127.5 - 1.0), int(lab)
+    return real
+
+
+def train(use_synthetic=None):
+    return _reader("train", use_synthetic)
+
+
+def test(use_synthetic=None):
+    return _reader("test", use_synthetic)

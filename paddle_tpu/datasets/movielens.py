@@ -1,0 +1,95 @@
+"""MovieLens-1M (reference: python/paddle/dataset/movielens.py). Samples
+match the recommender model's feed order: (user_id, gender_id, age_id,
+job_id, movie_id, category_id, title_ids[8], score). Stage ml-1m.zip
+under $PADDLE_TPU_DATA_HOME/movielens/."""
+
+from __future__ import annotations
+
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id"]
+
+_N_SYNTH = {"train": 512, "test": 128}
+_SYNTH_USERS, _SYNTH_MOVIES = 100, 200
+
+
+def max_user_id(use_synthetic=None):
+    return _SYNTH_USERS if common.synthetic_enabled(use_synthetic) else 6040
+
+
+def max_movie_id(use_synthetic=None):
+    return _SYNTH_MOVIES if common.synthetic_enabled(use_synthetic) else 3952
+
+
+def _synth(split):
+    def reader():
+        rng = common.synthetic_rng("movielens", split)
+        for _ in range(_N_SYNTH[split]):
+            u = rng.randint(0, _SYNTH_USERS)
+            m = rng.randint(0, _SYNTH_MOVIES)
+            yield (u, rng.randint(0, 2), rng.randint(0, 7),
+                   rng.randint(0, 21), m, rng.randint(0, 19),
+                   rng.randint(0, 100, 8).tolist(),
+                   float((u + m) % 5 + 1))
+    return reader
+
+
+_AGES = {1: 0, 18: 1, 25: 2, 35: 3, 45: 4, 50: 5, 56: 6}
+_CATS = ["Action", "Adventure", "Animation", "Children's", "Comedy",
+         "Crime", "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror",
+         "Musical", "Mystery", "Romance", "Sci-Fi", "Thriller", "War",
+         "Western", "unknown"]
+
+
+def _real(split):
+    path = common.require_file(
+        common.data_path("movielens", "ml-1m.zip"),
+        "Download ml-1m.zip from grouplens.org/datasets/movielens.")
+
+    def reader():
+        with zipfile.ZipFile(path) as z:
+            users = {}
+            for line in z.read("ml-1m/users.dat").decode(
+                    "latin1").splitlines():
+                uid, gender, age, job, _ = line.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1,
+                                   _AGES[int(age)], int(job))
+            movies = {}
+            for line in z.read("ml-1m/movies.dat").decode(
+                    "latin1").splitlines():
+                mid, title, cats = line.split("::")
+                cat = _CATS.index(cats.split("|")[0]) \
+                    if cats.split("|")[0] in _CATS else _CATS.index(
+                        "unknown")
+                # hashed title word ids, padded/truncated to 8
+                tw = [hash(w) % 5175 for w in title.lower().split()][:8]
+                tw += [0] * (8 - len(tw))
+                movies[int(mid)] = (cat, tw)
+            ratings = z.read("ml-1m/ratings.dat").decode(
+                "latin1").splitlines()
+            n = len(ratings)
+            cut = int(n * 0.9)
+            rows = ratings[:cut] if split == "train" else ratings[cut:]
+            for line in rows:
+                uid, mid, score, _ = line.split("::")
+                uid, mid = int(uid), int(mid)
+                if uid not in users or mid not in movies:
+                    continue
+                g, a, j = users[uid]
+                c, tw = movies[mid]
+                yield uid, g, a, j, mid, c, tw, float(score)
+    return reader
+
+
+def train(use_synthetic=None):
+    return _synth("train") if common.synthetic_enabled(use_synthetic) \
+        else _real("train")
+
+
+def test(use_synthetic=None):
+    return _synth("test") if common.synthetic_enabled(use_synthetic) \
+        else _real("test")

@@ -1,0 +1,49 @@
+"""UCI housing (reference: python/paddle/dataset/uci_housing.py). Samples:
+(features float32[13] normalized, price float32[1]). Stage housing.data
+under $PADDLE_TPU_DATA_HOME/uci_housing/."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+TRAIN_RATIO = 0.8
+
+
+def _load(use_synthetic):
+    if common.synthetic_enabled(use_synthetic):
+        rng = common.synthetic_rng("uci_housing", "all")
+        x = rng.randn(506, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        y = (x @ w + rng.randn(506) * 0.1).astype(np.float32)[:, None]
+        return x, y
+    path = common.require_file(
+        common.data_path("uci_housing", "housing.data"),
+        "Download housing.data from the UCI ML repository.")
+    data = np.loadtxt(path, dtype=np.float32)
+    x, y = data[:, :-1], data[:, -1:]
+    # feature normalization like the reference (max-min over train part)
+    mx, mn, avg = x.max(0), x.min(0), x.mean(0)
+    x = (x - avg) / np.maximum(mx - mn, 1e-6)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def train(use_synthetic=None):
+    def reader():
+        x, y = _load(use_synthetic)
+        n = int(len(x) * TRAIN_RATIO)
+        for i in range(n):
+            yield x[i], y[i]
+    return reader
+
+
+def test(use_synthetic=None):
+    def reader():
+        x, y = _load(use_synthetic)
+        n = int(len(x) * TRAIN_RATIO)
+        for i in range(n, len(x)):
+            yield x[i], y[i]
+    return reader

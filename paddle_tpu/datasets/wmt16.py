@@ -1,0 +1,86 @@
+"""WMT16 en-de (reference: python/paddle/dataset/wmt16.py). Samples:
+(src_ids, trg_ids_in, trg_ids_out) with <s>/<e>/<unk> conventions. Stage
+wmt16.tar.gz under $PADDLE_TPU_DATA_HOME/wmt16/."""
+
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_dict"]
+
+_SYNTH_VOCAB = 120
+_N_SYNTH = {"train": 256, "test": 64}
+BOS, EOS, UNK = 0, 1, 2
+
+
+def get_dict(lang: str, dict_size: int = _SYNTH_VOCAB,
+             use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        d = {"<s>": BOS, "<e>": EOS, "<unk>": UNK}
+        d.update({f"{lang}{i}": i + 3 for i in range(dict_size - 3)})
+        return d
+    path = common.require_file(
+        common.data_path("wmt16", "wmt16.tar.gz"),
+        "Download the preprocessed WMT16 archive (with wmt16/<lang>.dict "
+        "vocab files).")
+    with tarfile.open(path) as tf:
+        f = tf.extractfile(f"wmt16/{lang}.dict")
+        if f is None:
+            raise FileNotFoundError(
+                f"wmt16/{lang}.dict missing from {path}")
+        words = f.read().decode("utf-8").splitlines()[:dict_size]
+    return {w: i for i, w in enumerate(words)}
+
+
+def _synth(split, src_dict_size, trg_dict_size):
+    def reader():
+        rng = common.synthetic_rng("wmt16", split)
+        for _ in range(_N_SYNTH[split]):
+            n = rng.randint(3, 12)
+            src = rng.randint(3, src_dict_size, n)
+            # toy translation: id shift modulo vocab
+            trg = 3 + (src - 3 + 7) % (trg_dict_size - 3)
+            yield (src.tolist(),
+                   [BOS] + trg.tolist(),
+                   trg.tolist() + [EOS])
+    return reader
+
+
+def _real(split, src_dict_size, trg_dict_size, src_lang):
+    path = common.require_file(
+        common.data_path("wmt16", "wmt16.tar.gz"),
+        "Download the preprocessed WMT16 archive.")
+
+    def reader():
+        name = f"wmt16/{split}"
+        with tarfile.open(path) as tf:
+            f = tf.extractfile(name)
+            sd = get_dict(src_lang, src_dict_size)
+            td = get_dict("de" if src_lang == "en" else "en",
+                          trg_dict_size)
+            for line in f:
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [sd.get(w, UNK) for w in parts[0].split()]
+                trg = [td.get(w, UNK) for w in parts[1].split()]
+                yield src, [BOS] + trg, trg + [EOS]
+    return reader
+
+
+def train(src_dict_size=_SYNTH_VOCAB, trg_dict_size=_SYNTH_VOCAB,
+          src_lang="en", use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("train", src_dict_size, trg_dict_size)
+    return _real("train", src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size=_SYNTH_VOCAB, trg_dict_size=_SYNTH_VOCAB,
+         src_lang="en", use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("test", src_dict_size, trg_dict_size)
+    return _real("test", src_dict_size, trg_dict_size, src_lang)

@@ -17,3 +17,5 @@ __all__ = ["guard", "enabled", "to_variable", "no_grad", "VarBase",
            "Pool2D", "FC", "Linear", "BatchNorm", "Embedding", "LayerNorm",
            "GroupNorm", "PRelu", "GRUUnit", "Dropout", "save_dygraph",
            "load_dygraph"]
+from . import parallel
+from .parallel import DataParallel, ParallelEnv, prepare_context

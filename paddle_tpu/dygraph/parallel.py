@@ -1,0 +1,147 @@
+"""Dygraph data parallelism (reference: python/paddle/fluid/dygraph/
+parallel.py — DataParallel :84, prepare_context :30, Env).
+
+TPU redesign: eager mode runs op-by-op through JAX on one chip per
+process; multi-replica eager training uses one process per chip (the
+launch CLI sets PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM) with gradient
+averaging over jax.distributed collectives when a multi-process JAX
+runtime is initialized. Single-process (nranks == 1) is a no-op wrapper,
+exactly like the reference."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .layers import Layer
+
+__all__ = ["ParallelEnv", "Env", "prepare_context", "DataParallel"]
+
+
+class ParallelEnv:
+    """reference dygraph/parallel.py Env: identity from launcher env."""
+
+    def __init__(self):
+        self._nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = [e for e in eps.split(",") if e]
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                                "")
+
+    @property
+    def nranks(self) -> int:
+        return self._nranks
+
+    @property
+    def local_rank(self) -> int:
+        return self._local_rank
+
+    @property
+    def dev_id(self) -> int:
+        return self._local_rank
+
+    @property
+    def current_endpoint(self) -> str:
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return list(self._trainer_endpoints)
+
+
+Env = ParallelEnv  # reference alias
+
+
+class ParallelStrategy:
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy: Optional[ParallelStrategy] = None):
+    """reference dygraph/parallel.py prepare_context: builds the parallel
+    strategy (and, multi-process, initializes the JAX distributed runtime
+    so psum_on_host below can cross processes)."""
+    if strategy is None:
+        strategy = ParallelStrategy()
+        env = ParallelEnv()
+        strategy.nranks = env.nranks
+        strategy.local_rank = env.local_rank
+        strategy.trainer_endpoints = env.trainer_endpoints
+        strategy.current_endpoint = env.current_endpoint
+    if strategy.nranks > 1:
+        import jax
+        if jax.process_count() == 1:
+            try:
+                jax.distributed.initialize()
+            except Exception as e:
+                if jax.process_count() < strategy.nranks:
+                    # training would silently proceed with 1/nranks-scaled
+                    # local gradients — refuse instead
+                    raise RuntimeError(
+                        f"nranks={strategy.nranks} but the JAX distributed "
+                        f"runtime failed to initialize: {e}") from e
+    return strategy
+
+
+class DataParallel(Layer):
+    """Wraps a Layer; after backward(), apply_collective_grads() averages
+    the gradients across replicas (the reference's nccl allreduce on
+    VarBase grads)."""
+
+    def __init__(self, layers: Layer, strategy: Optional[ParallelStrategy]
+                 = None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers: bool = True):
+        return self._layers.parameters(include_sublayers)
+
+    def scale_loss(self, loss):
+        """Divide the loss by nranks so summed gradients average."""
+        if self._strategy.nranks <= 1:
+            return loss
+        return loss * (1.0 / self._strategy.nranks)
+
+    def apply_collective_grads(self):
+        """Sum gradients across replicas. Multi-process: one fused
+        all-reduce over the JAX distributed runtime; single process:
+        no-op (one replica owns the full batch)."""
+        if self._strategy.nranks <= 1:
+            return
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        params = [p for p in self.parameters() if p._grad is not None]
+        if not params:
+            return
+        # fuse into one flat buffer PER DTYPE (coalesce_grad_tensor_pass
+        # analog) — mixing dtypes in one concat would silently promote
+        # fp16 grads to fp32
+        by_dtype = {}
+        for p in params:
+            by_dtype.setdefault(jnp.asarray(p._grad).dtype, []).append(p)
+        for dtype, group in by_dtype.items():
+            grads = [jnp.asarray(p._grad).reshape(-1) for p in group]
+            flat = jnp.concatenate(grads)
+            summed = multihost_utils.process_allgather(flat).sum(0)
+            off = 0
+            for p, g in zip(group, grads):
+                n = g.shape[0]
+                p._grad = summed[off:off + n].reshape(
+                    jnp.asarray(p._grad).shape).astype(dtype)
+                off += n
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)

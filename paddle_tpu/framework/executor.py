@@ -170,8 +170,9 @@ class Executor:
         if transpiled_n is not None:
             spmd_axes = getattr(dist_plan, "spmd_axes", ()) \
                 if dist_plan else ()
-            mesh_n = (int(dist_plan.mesh.shape[spmd_axes[0]])
-                      if spmd_axes else 1)
+            mesh_n = 1
+            for a in spmd_axes:  # hierarchical mode: product of both axes
+                mesh_n *= int(dist_plan.mesh.shape[a])
             if mesh_n != transpiled_n:
                 raise ValueError(
                     f"program was collective-transpiled for "

@@ -54,6 +54,10 @@ def _active_axis(ctx, attrs):
         return None
     if axis in ctx.spmd_axes:
         return axis
+    if len(ctx.spmd_axes) > 1:
+        # hierarchical mode: the ring spans the whole (inter, intra)
+        # hierarchy; lax collectives take the axis tuple
+        return tuple(ctx.spmd_axes)
     if ctx.spmd_axes:
         return ctx.spmd_axes[0]
     return None

@@ -35,12 +35,12 @@ def _prior_box(ctx, ins, attrs):
     offset = attrs.get("offset", 0.5)
 
     widths, heights = [], []
-    for ms in min_sizes:
+    for si, ms in enumerate(min_sizes):
         for ar in ars:
             widths.append(ms * (ar ** 0.5))
             heights.append(ms / (ar ** 0.5))
         if max_sizes:
-            mx = max_sizes[min_sizes.index(ms)]
+            mx = max_sizes[si]  # positional: duplicate min_sizes are legal
             widths.append((ms * mx) ** 0.5)
             heights.append((ms * mx) ** 0.5)
     num_priors = len(widths)
@@ -287,8 +287,10 @@ def _roi_align(ctx, ins, attrs):
         # sample points: ratio x ratio per bin, bilinear
         iy = (jnp.arange(ph * ratio) + 0.5) * (bin_h / ratio)
         ix = (jnp.arange(pw * ratio) + 0.5) * (bin_w / ratio)
-        yy = y1 + iy                                    # [ph*r]
-        xx = x1 + ix                                    # [pw*r]
+        # clamp the SAMPLE coordinates (not just corner indices), or
+        # out-of-image ROIs get weights outside [0,1] and extrapolate
+        yy = jnp.clip(y1 + iy, 0.0, h - 1.0)            # [ph*r]
+        xx = jnp.clip(x1 + ix, 0.0, w - 1.0)            # [pw*r]
         y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
         x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
         y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)

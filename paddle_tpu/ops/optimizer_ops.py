@@ -269,3 +269,43 @@ def _ftrl(ctx, ins, attrs):
     p_new = pre / denom
     return {"ParamOut": [p_new.astype(p.dtype)], "SquaredAccumOut": [new_sq],
             "LinearAccumOut": [lin_new]}
+
+
+@register_op("dgc", not_differentiable=True, is_optimizer_op=True)
+def _dgc(ctx, ins, attrs):
+    """Deep Gradient Compression (reference: operators/dgc_op.cc +
+    DGCMomentumOptimizer optimizer.py:787): momentum correction (U), error
+    feedback (V), top-k sparsification. Out is a SelectedRows over the
+    FLATTENED gradient ([numel, 1], rows = element indices) so the
+    collective layer ships only the selected values — c_allreduce_sum
+    allgathers sparse (rows, values) across replicas, the DGC
+    communication pattern (details/sparse_all_reduce_op_handle.cc)."""
+    import jax
+
+    g, u, v = ins["Grad"][0], ins["U"][0], ins["V"][0]
+    mu = attrs.get("momentum", 0.9)
+    sparsity = attrs.get("sparsity", 0.999)
+    g32 = g.astype(jnp.float32).reshape(-1)
+    numel = g32.shape[0]
+    k = max(1, int(numel * (1.0 - sparsity)))
+    u_new = mu * u.reshape(-1) + g32
+    v_new = v.reshape(-1) + u_new
+    _, idx = jax.lax.top_k(jnp.abs(v_new), k)
+    vals = v_new[idx]
+    # error feedback: clear what was sent; momentum factor masking
+    v_out = v_new.at[idx].set(0.0)
+    u_out = u_new.at[idx].set(0.0)
+    sparse = SelectedRows(idx, vals[:, None], numel)
+    return {"Out": [sparse], "UOut": [u_out.reshape(u.shape)],
+            "VOut": [v_out.reshape(v.shape)]}
+
+
+@register_op("dgc_gather", not_differentiable=True, is_optimizer_op=True)
+def _dgc_gather(ctx, ins, attrs):
+    """Densify the (allreduced) sparse DGC gradient back to the parameter
+    shape for the update op."""
+    x = ins["X"][0]
+    shape = tuple(attrs["shape"])
+    if isinstance(x, SelectedRows):
+        x = x.to_dense()
+    return {"Out": [x.reshape(shape)]}

@@ -25,7 +25,8 @@ __all__ = [
     "Adadelta", "AdadeltaOptimizer", "Adamax", "AdamaxOptimizer", "RMSProp",
     "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
     "LarsMomentum", "LarsMomentumOptimizer", "ExponentialMovingAverage",
-    "ModelAverage", "PipelineOptimizer",
+    "ModelAverage", "PipelineOptimizer", "DGCMomentumOptimizer",
+    "GradientMergeOptimizer",
 ]
 
 
@@ -658,6 +659,175 @@ class ModelAverage:
         for p, v in self._backup.items():
             scope.set_var(p, v)
         self._backup = {}
+
+
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (reference: optimizer.py:787
+    DGCMomentumOptimizer + details/sparse_all_reduce_op_handle.cc).
+
+    Per step and per parameter: momentum correction (U = mu*U + g), error
+    feedback (V += U), top-(1-sparsity) selection of |V|, and an UPDATE
+    using only the selected values; the unsent remainder stays in V. The
+    selected values travel as a SelectedRows over the flattened gradient,
+    so under CompiledProgram.with_collective the c_allreduce_sum becomes a
+    sparse allgather — the DGC communication saving. Do NOT also apply the
+    GradAllReduce transpiler (DGC owns its communication).
+
+    Note the degenerate case: with sparsity 0 every element is selected
+    and momentum-factor masking clears U each step, so the trajectory
+    equals plain SGD — momentum only matters for the unsent residual, as
+    in the paper. rampup_begin_step is accepted for API parity (the
+    reference ramps sparsity up over early steps; here sparsity is fixed
+    per program build — rebuild with a different sparsity to ramp).
+    """
+
+    def __init__(self, learning_rate, momentum, sparsity=0.999,
+                 rampup_begin_step=0, nranks=1, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        if isinstance(sparsity, (list, tuple)):
+            sparsity = sparsity[-1]
+        self._sparsity = float(sparsity)
+        self._rampup = int(rampup_begin_step)
+        self._nranks = int(nranks)
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("dgc_u", p, startup)
+        self._add_accumulator("dgc_v", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        u = self._accumulators["dgc_u"][p.name]
+        v = self._accumulators["dgc_v"][p.name]
+        numel = 1
+        for d in p.shape:
+            numel *= int(d)
+        sparse = block.create_var(name=unique_name(f"{p.name}@DGC"),
+                                  shape=(numel, 1), dtype="float32",
+                                  type="selected_rows")
+        block.append_op(
+            "dgc", {"Grad": [g.name], "U": [u.name], "V": [v.name]},
+            {"Out": [sparse.name], "UOut": [u.name], "VOut": [v.name]},
+            {"momentum": self._momentum, "sparsity": self._sparsity},
+            infer_shape=False)
+        if self._nranks > 1:
+            block.append_op("scale", {"X": [sparse.name]},
+                            {"Out": [sparse.name]},
+                            {"scale": 1.0 / self._nranks},
+                            infer_shape=False)
+            block.append_op("c_allreduce_sum", {"X": [sparse.name]},
+                            {"Out": [sparse.name]}, {"ring_id": 0},
+                            infer_shape=False)
+        dense = block.create_var(name=unique_name(f"{p.name}@DGC_DENSE"),
+                                 shape=p.shape, dtype="float32")
+        block.append_op("dgc_gather", {"X": [sparse.name]},
+                        {"Out": [dense.name]},
+                        {"shape": list(p.shape)}, infer_shape=False)
+        # momentum is already folded into U/V; the update itself is SGD
+        return block.append_op(
+            "sgd",
+            {"Param": [p.name], "Grad": [dense.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name]}, infer_shape=False)
+
+
+class GradientMergeOptimizer:
+    """Accumulate gradients over k micro-steps, apply the inner optimizer
+    once per k (reference: the batch-merge pass ir/multi_batch_merge_pass.cc
+    and test_dist_mnist_batch_merge.py). Built on cond: the k-th step runs
+    the inner update ops in the true branch and resets the accumulators."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        self.inner = inner_optimizer
+        self.k = int(k_steps)
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from . import layers
+        from .framework.core import default_startup_program
+        startup = startup_program or default_startup_program()
+        main = loss.block.program
+        block = main.global_block
+        params_grads = self.inner.backward(
+            loss, parameter_list=parameter_list, no_grad_set=no_grad_set)
+        n_before = len(block.ops)
+
+        # step counter
+        step_name = unique_name("grad_merge_step")
+        block.create_var(name=step_name, shape=(1,), dtype="float32",
+                         persistable=True, stop_gradient=True)
+        sb = startup.global_block
+        sb.create_var(name=step_name, shape=(1,), dtype="float32",
+                      persistable=True, stop_gradient=True)
+        sb.append_op("fill_constant", {}, {"Out": [step_name]},
+                     {"shape": [1], "dtype": "float32", "value": 0.0},
+                     infer_shape=False)
+        block.append_op("increment", {"X": [step_name]},
+                        {"Out": [step_name]}, {"step": 1.0},
+                        infer_shape=False)
+        step = block.var(step_name)
+
+        # gradient accumulators
+        accs = []
+        for p, g in params_grads:
+            acc_name = unique_name(f"{p.name}@GRAD_MERGE")
+            block.create_var(name=acc_name, shape=p.shape, dtype=g.dtype,
+                             persistable=True, stop_gradient=True)
+            sb.create_var(name=acc_name, shape=p.shape, dtype=g.dtype,
+                          persistable=True, stop_gradient=True)
+            sb.append_op("fill_constant", {}, {"Out": [acc_name]},
+                         {"shape": list(p.shape), "dtype": g.dtype,
+                          "value": 0.0}, infer_shape=False)
+            block.append_op("sum", {"X": [acc_name, g.name]},
+                            {"Out": [acc_name]}, infer_shape=False)
+            accs.append(block.var(acc_name))
+
+        # inner optimizer state must exist OUTSIDE the cond branches
+        lr = self.inner._global_lr(main, startup)
+        for p, _ in params_grads:
+            self.inner._create_accumulators(p, startup)
+        state_vars = [v for by_param in self.inner._accumulators.values()
+                      for v in by_param.values()]
+
+        boundary = layers.equal(
+            layers.elementwise_mod(
+                step, layers.fill_constant([1], "float32", float(self.k))),
+            layers.fill_constant([1], "float32", 0.0))
+
+        ret_vars = [p for p, _ in params_grads] + state_vars + accs
+
+        def true_fn():
+            cur = main.current_block()
+            for (p, _), acc in zip(params_grads, accs):
+                eff = cur.create_var(
+                    name=unique_name(f"{p.name}@GRAD_EFF"),
+                    shape=p.shape, dtype=acc.dtype)
+                cur.append_op("scale", {"X": [acc.name]},
+                              {"Out": [eff.name]},
+                              {"scale": 1.0 / self.k if self.avg else 1.0},
+                              infer_shape=False)
+                self.inner._append_optimize_op(
+                    cur, p, cur.var(eff.name),
+                    self.inner._param_lr(cur, lr, p))
+                cur.append_op("scale", {"X": [acc.name]},
+                              {"Out": [acc.name]}, {"scale": 0.0},
+                              infer_shape=False)
+            return list(ret_vars)
+
+        def false_fn():
+            return list(ret_vars)
+
+        outs = layers.cond(boundary, true_fn, false_fn)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for var, out in zip(ret_vars, outs):
+            block.append_op("assign", {"X": [out.name]},
+                            {"Out": [var.name]}, infer_shape=False)
+        for op in block.ops[n_before:]:
+            op.attrs.setdefault("op_role", "optimize")
+        return [], params_grads
 
 
 from .parallel.pipeline import PipelineOptimizer  # noqa: E402

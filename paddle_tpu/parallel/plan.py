@@ -135,10 +135,30 @@ class CollectiveSpmdPlan(ShardingPlan):
     """
 
     def __init__(self, nranks: Optional[int] = None, axis_name: str = "dp",
-                 devices=None):
-        super().__init__(mesh_shape=None, axis_names=(axis_name,),
-                         places=nranks, devices=devices)
-        self.spmd_axes = (axis_name,)
+                 devices=None, inter_nranks: int = 1):
+        """inter_nranks > 1 = hierarchical allreduce (reference
+        build_strategy.h:133-139): the replica axis splits into
+        (axis_inter, axis_intra) mesh axes and collectives reduce over
+        both — numerically identical, and on a DCN-spanning mesh the
+        intra axis rides ICI while only the inter stage crosses DCN."""
+        inter = max(1, int(inter_nranks))
+        if inter > 1:
+            import jax
+            n = nranks if nranks is not None else len(devices or
+                                                      jax.devices())
+            if n % inter != 0:
+                raise ValueError(
+                    f"nranks {n} not divisible by "
+                    f"hierarchical inter_nranks {inter}")
+            super().__init__(
+                mesh_shape=(inter, n // inter),
+                axis_names=(f"{axis_name}_inter", f"{axis_name}_intra"),
+                places=n, devices=devices)
+            self.spmd_axes = self.axis_names
+        else:
+            super().__init__(mesh_shape=None, axis_names=(axis_name,),
+                             places=nranks, devices=devices)
+            self.spmd_axes = (axis_name,)
 
     def constrain(self, op, env) -> None:
         pass  # inside shard_map there are no global shardings to assert
@@ -148,8 +168,13 @@ class CollectiveSpmdPlan(ShardingPlan):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        axis = self.spmd_axes[0]
-        n = self.mesh.shape[axis]
+        # a single replica axis, or the (inter, intra) hierarchy — lax
+        # collectives accept the axis-name tuple directly
+        axis = self.spmd_axes[0] if len(self.spmd_axes) == 1 \
+            else tuple(self.spmd_axes)
+        n = 1
+        for a in self.spmd_axes:
+            n *= self.mesh.shape[a]
 
         def feed_spec(shape):
             return P(axis) if shape and shape[0] % n == 0 else P()
@@ -162,7 +187,10 @@ class CollectiveSpmdPlan(ShardingPlan):
         def spmd_fn(mut, ro, feed, key):
             # per-shard rng stream (dropout masks differ across replicas,
             # like per-trainer seeds in the reference)
-            local_key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            idx = jax.lax.axis_index(self.spmd_axes[0])
+            for a in self.spmd_axes[1:]:
+                idx = idx * self.mesh.shape[a] + jax.lax.axis_index(a)
+            local_key = jax.random.fold_in(key, idx)
             new_mut, fetches, _, flags = fn(mut, ro, feed, local_key)
             # fetch semantics match single-process training: scalar float
             # fetches (losses/metrics on the sharded batch) are averaged

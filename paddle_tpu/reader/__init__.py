@@ -1,0 +1,9 @@
+"""Reader composition toolkit (reference: python/paddle/reader/)."""
+
+from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa: F401
+                        firstn, xmap_readers, cache, batch,
+                        multiprocess_reader)
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "cache", "batch",
+           "multiprocess_reader"]

@@ -1,0 +1,122 @@
+"""Reader decorators + builtin dataset loaders (reference:
+python/paddle/reader/tests/decorator_test.py, python/paddle/dataset/tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import reader as rd
+from paddle_tpu import datasets
+
+
+def _r(n):
+    def reader():
+        yield from range(n)
+    return reader
+
+
+def test_batch_and_firstn():
+    b = rd.batch(_r(10), 3)
+    out = list(b())
+    assert out == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    assert list(rd.batch(_r(10), 3, drop_last=True)()) == out[:3]
+    assert list(rd.firstn(_r(100), 4)()) == [0, 1, 2, 3]
+
+
+def test_shuffle_is_permutation():
+    import random
+    random.seed(0)
+    out = list(rd.shuffle(_r(20), 7)())
+    assert sorted(out) == list(range(20))
+    assert out != list(range(20))
+
+
+def test_chain_compose_map():
+    assert list(rd.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+    comp = rd.compose(_r(3), _r(3))
+    assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+    m = rd.map_readers(lambda a, b: a + b, _r(3), _r(3))
+    assert list(m()) == [0, 2, 4]
+
+
+def test_buffered_and_cache():
+    assert list(rd.buffered(_r(50), 8)()) == list(range(50))
+    calls = [0]
+
+    def counting():
+        calls[0] += 1
+        yield from range(5)
+    c = rd.cache(lambda: counting())
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert calls[0] == 1
+
+
+def test_xmap_ordered_and_unordered():
+    sq = rd.xmap_readers(lambda x: x * x, _r(30), 4, 8, order=True)
+    assert list(sq()) == [i * i for i in range(30)]
+    unord = rd.xmap_readers(lambda x: x * x, _r(30), 4, 8, order=False)
+    assert sorted(unord()) == [i * i for i in range(30)]
+
+
+def test_multiprocess_reader():
+    out = sorted(rd.multiprocess_reader([_r(5), _r(5)])())
+    assert out == sorted(list(range(5)) * 2)
+
+
+@pytest.mark.parametrize("mod,reader_name,checks", [
+    ("mnist", "train", lambda s: s[0].shape == (784,) and 0 <= s[1] < 10),
+    ("cifar", "train10", lambda s: s[0].shape == (3072,) and 0 <= s[1] < 10),
+    ("uci_housing", "train",
+     lambda s: s[0].shape == (13,) and s[1].shape == (1,)),
+    ("imdb", "train",
+     lambda s: isinstance(s[0], list) and s[1] in (0, 1)),
+    ("movielens", "train", lambda s: len(s) == 8 and len(s[6]) == 8),
+    ("conll05", "test",
+     lambda s: len(s) == 4 and len(s[0]) == len(s[3])),
+    ("wmt16", "train",
+     lambda s: s[1][0] == 0 and s[2][-1] == 1
+     and len(s[1]) == len(s[2])),
+])
+def test_synthetic_datasets(mod, reader_name, checks):
+    m = getattr(datasets, mod)
+    r = getattr(m, reader_name)(use_synthetic=True)
+    samples = list(r())
+    assert len(samples) > 50
+    assert all(checks(s) for s in samples[:10])
+    # deterministic across calls
+    s0 = next(iter(r()))
+    s1 = next(iter(getattr(m, reader_name)(use_synthetic=True)()))
+    np.testing.assert_array_equal(np.asarray(s0[0]), np.asarray(s1[0]))
+
+
+def test_real_dataset_missing_file_message():
+    with pytest.raises(FileNotFoundError, match="synthetic"):
+        datasets.mnist.train(use_synthetic=False)()
+
+
+def test_mnist_trains_lenet_synthetic():
+    """End-to-end: builtin reader -> batch decorator -> train loop."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        img = pt.layers.data("img", [784])
+        label = pt.layers.data("label", [1], dtype="int64")
+        h = pt.layers.fc(img, 64, act="relu")
+        logits = pt.layers.fc(h, 10)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.Adam(5e-3).minimize(loss)
+    train_r = rd.batch(datasets.mnist.train(use_synthetic=True), 64)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            for b in train_r():
+                imgs = np.stack([s[0] for s in b])
+                labs = np.array([[s[1]] for s in b], np.int64)
+                (lv,) = exe.run(main, feed={"img": imgs, "label": labs},
+                                fetch_list=[loss])
+                losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])

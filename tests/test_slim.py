@@ -64,12 +64,16 @@ def test_qat_trains_and_freezes(act_type, w_type):
         infer = main.clone(for_test=True)
         scales = QuantizationFreezePass().apply(infer, scope)
         assert len(scales) == 2
-        for wname in scales:
+        for wname, scale in scales.items():
             w = np.asarray(scope.find_var(wname))
-            # quantized weights take at most 255 distinct values per channel
-            assert len(np.unique(w)) <= 255 * (w.shape[0] if
-                                               "filter" not in wname else 1) \
-                or len(np.unique(w)) <= w.size
+            # every weight must sit exactly on its channel's int8 grid
+            sc = scale.reshape((-1,) + (1,) * (w.ndim - 1)) \
+                if scale.size > 1 and w.shape[0] == scale.size \
+                else scale.reshape((1,) * (w.ndim - 1) + (-1,)) \
+                if scale.size > 1 else float(scale)
+            q = w * 127.0 / np.where(sc == 0, 1.0, sc)
+            np.testing.assert_allclose(q, np.round(q), atol=1e-3,
+                                       err_msg=wname)
         # frozen program still runs and is close to the QAT sim output
         x = _feed(rng, 4)
         (ref,) = exe.run(main.clone(for_test=True), feed=x,
