@@ -31,6 +31,8 @@ from . import distributed
 from . import dataset
 from .dataset import DatasetFactory
 from . import inference
+from . import nets
+from . import utils
 from . import reader
 from . import datasets
 from .framework.executor import as_jax_function

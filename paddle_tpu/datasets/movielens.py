@@ -65,8 +65,11 @@ def _real(split):
                 cat = _CATS.index(cats.split("|")[0]) \
                     if cats.split("|")[0] in _CATS else _CATS.index(
                         "unknown")
-                # hashed title word ids, padded/truncated to 8
-                tw = [hash(w) % 5175 for w in title.lower().split()][:8]
+                # stable-hashed title word ids (hash() is salted per
+                # process), padded/truncated to 8
+                import zlib
+                tw = [zlib.crc32(w.encode()) % 5175
+                      for w in title.lower().split()][:8]
                 tw += [0] * (8 - len(tw))
                 movies[int(mid)] = (cat, tw)
             ratings = z.read("ml-1m/ratings.dat").decode(

@@ -59,9 +59,9 @@ def _real(split, src_dict_size, trg_dict_size, src_lang):
         name = f"wmt16/{split}"
         with tarfile.open(path) as tf:
             f = tf.extractfile(name)
-            sd = get_dict(src_lang, src_dict_size)
+            sd = get_dict(src_lang, src_dict_size, use_synthetic=False)
             td = get_dict("de" if src_lang == "en" else "en",
-                          trg_dict_size)
+                          trg_dict_size, use_synthetic=False)
             for line in f:
                 parts = line.decode("utf-8").strip().split("\t")
                 if len(parts) != 2:

@@ -77,13 +77,14 @@ def prepare_context(strategy: Optional[ParallelStrategy] = None):
         if jax.process_count() == 1:
             try:
                 jax.distributed.initialize()
-            except Exception as e:
-                if jax.process_count() < strategy.nranks:
-                    # training would silently proceed with 1/nranks-scaled
-                    # local gradients — refuse instead
-                    raise RuntimeError(
-                        f"nranks={strategy.nranks} but the JAX distributed "
-                        f"runtime failed to initialize: {e}") from e
+            except Exception:
+                pass  # validated below
+        if jax.process_count() != strategy.nranks:
+            # a partial world would scale losses by nranks but reduce over
+            # fewer replicas — silently wrong gradients; refuse
+            raise RuntimeError(
+                f"nranks={strategy.nranks} but the JAX distributed runtime "
+                f"has {jax.process_count()} process(es)")
     return strategy
 
 

@@ -17,3 +17,4 @@ from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import collective  # noqa: F401
 from . import detection  # noqa: F401
+from . import distributions  # noqa: F401

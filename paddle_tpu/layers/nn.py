@@ -22,7 +22,8 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
            "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
            "square_error_cost", "huber_loss", "kldiv_loss", "smooth_l1",
            "accuracy", "topk", "one_hot", "lrn", "prelu", "mse_loss",
-           "label_smooth", "fused_attention"]
+           "label_smooth", "fused_attention", "warpctc",
+           "linear_chain_crf", "crf_decoding", "nce", "hsigmoid"]
 
 
 # ---------------------------------------------------------------------------
@@ -540,3 +541,90 @@ def one_hot(input, depth, name=None):
     helper.append_op("one_hot", {"X": [input.name]}, {"Out": [out.name]},
                      {"depth": depth})
     return out
+
+
+def warpctc(input, label, input_length, label_length, blank=0,
+            norm_by_times=False, name=None):
+    """CTC loss (reference: layers/nn.py warpctc; dense-tensor form with
+    explicit lengths instead of LoD)."""
+    helper = LayerHelper("warpctc", name=name)
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "warpctc",
+        {"Logits": [input.name], "Label": [label.name],
+         "LogitsLength": [input_length.name],
+         "LabelLength": [label_length.name]},
+        {"Loss": [loss.name]}, {"blank": blank,
+                                "norm_by_times": norm_by_times})
+    return loss
+
+
+def linear_chain_crf(input, label, length, param_attr=None, name=None):
+    """CRF negative log-likelihood (reference: layers/nn.py
+    linear_chain_crf). Creates the [(C+2), C] transition parameter; returns
+    the per-sequence NLL [b, 1]."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    c = input.shape[-1]
+    trans = helper.create_parameter(param_attr, [c + 2, c], "float32")
+    ll = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "linear_chain_crf",
+        {"Emission": [input.name], "Transition": [trans.name],
+         "Label": [label.name], "Length": [length.name]},
+        {"LogLikelihood": [ll.name]})
+    from .math import scale as _scale
+    return _scale(ll, scale=-1.0), trans
+
+
+def crf_decoding(input, transition, length, name=None):
+    """Viterbi decode with a trained transition param (reference:
+    layers/nn.py crf_decoding)."""
+    helper = LayerHelper("crf_decoding", name=name)
+    path = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "crf_decoding",
+        {"Emission": [input.name], "Transition": [transition.name],
+         "Length": [length.name]},
+        {"ViterbiPath": [path.name]})
+    return path
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10,
+        param_attr=None, bias_attr=None, name=None, seed=0,
+        sampler="uniform"):
+    """reference: layers/nn.py nce."""
+    helper = LayerHelper("nce", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, [num_total_classes, d],
+                                input.dtype)
+    ins = {"Input": [input.name], "Weight": [w.name],
+           "Label": [label.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_total_classes],
+                                    input.dtype, is_bias=True)
+        ins["Bias"] = [b.name]
+    cost = helper.create_variable_for_type_inference("float32")
+    negs = helper.create_variable_for_type_inference("int32")
+    helper.append_op("nce", ins,
+                     {"Cost": [cost.name], "Negatives": [negs.name]},
+                     {"num_neg_samples": num_neg_samples, "seed": seed,
+                      "sampler": sampler})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """reference: layers/nn.py hsigmoid (default complete binary tree)."""
+    helper = LayerHelper("hsigmoid", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, [num_classes - 1, d],
+                                input.dtype)
+    ins = {"X": [input.name], "W": [w.name], "Label": [label.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_classes - 1],
+                                    input.dtype, is_bias=True)
+        ins["Bias"] = [b.name]
+    cost = helper.create_variable_for_type_inference("float32")
+    helper.append_op("hierarchical_sigmoid", ins, {"Cost": [cost.name]},
+                     {"num_classes": num_classes})
+    return cost

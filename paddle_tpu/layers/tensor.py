@@ -13,7 +13,7 @@ __all__ = ["data", "fill_constant", "fill_constant_batch_size_like",
            "scatter", "assign", "shape", "arange", "argmax", "argmin",
            "argsort", "where", "pad", "pad2d", "uniform_random",
            "gaussian_random", "increment", "create_global_var",
-           "create_tensor", "flip", "roll", "tile"]
+           "create_tensor", "flip", "roll", "tile", "py_func"]
 
 
 def data(name, shape, dtype="float32", append_batch_size=True,
@@ -388,3 +388,30 @@ def create_global_var(shape, value, dtype, persistable=False,
                  {"shape": list(shape), "dtype": dtype,
                   "value": float(value)}, infer_shape=False)
     return var
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """Host-Python callback op (reference: layers/nn.py py_func). `out`
+    vars must be pre-created with shapes/dtypes (create_variable-style),
+    exactly like the reference. backward_func is accepted but the op is
+    non-differentiable in v1 (register a custom grad if needed)."""
+    from ..ops.tensor_ops import register_py_func
+    helper = LayerHelper("py_func", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for v in outs:
+        if v.shape is None or -1 in v.shape:
+            raise ValueError(
+                f"py_func out var {v.name!r} must have a fully concrete "
+                f"shape (got {v.shape}); the host callback's result shape "
+                "is fixed at compile time")
+    fid = register_py_func(func)
+    helper.append_op(
+        "py_func", {"X": [v.name for v in xs]},
+        {"Out": [v.name for v in outs]},
+        {"func_id": fid,
+         "out_shapes": [list(v.shape) for v in outs],
+         "out_dtypes": [v.dtype for v in outs]},
+        infer_shape=False)
+    return out

@@ -12,3 +12,4 @@ from . import attention_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import loss_ops  # noqa: F401

@@ -1,0 +1,301 @@
+"""Structured-prediction and sampled losses: CTC, linear-chain CRF, NCE,
+hierarchical sigmoid.
+
+Reference: operators/warpctc_op.cc (external warp-ctc library),
+operators/linear_chain_crf_op.cc + crf_decoding_op.cc,
+operators/nce_op.cc, operators/hierarchical_sigmoid_op.cc.
+
+TPU redesign: every dynamic-programming recursion (CTC forward, CRF
+forward/viterbi) is a lax.scan over the time axis in log space — compiled
+once, batched over the batch dim, no per-step host control flow. Ragged
+sequences arrive padded with explicit length tensors (the LoD analog).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _logsumexp(a, b):
+    # double-where: sanitize the dead branch's INPUTS too, or its log(0)
+    # poisons the vjp with NaNs (the standard where-gradient trap)
+    m = jnp.maximum(a, b)
+    dead = m <= _NEG_INF / 2
+    a_s = jnp.where(dead, 0.0, a)
+    b_s = jnp.where(dead, 0.0, b)
+    m_s = jnp.where(dead, 0.0, m)
+    out = m_s + jnp.log(jnp.exp(a_s - m_s) + jnp.exp(b_s - m_s))
+    return jnp.where(dead, _NEG_INF, out)
+
+
+# ---------------------------------------------------------------------------
+# CTC (warpctc analog)
+# ---------------------------------------------------------------------------
+
+@register_op("warpctc", no_grad_inputs={"Label", "LogitsLength",
+                                        "LabelLength"})
+def _warpctc(ctx, ins, attrs):
+    """CTC loss. Logits [b, T, C] (raw, softmax applied internally like
+    warp-ctc), Label [b, L] padded, LogitsLength [b], LabelLength [b].
+    blank index from attrs (default 0). Out: Loss [b, 1].
+
+    The classic alpha recursion over the extended sequence
+    (blank, l1, blank, l2, ... blank) of length S = 2L+1, as one lax.scan
+    over time; gradients come from jax.vjp through the scan."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0].astype(jnp.int32)
+    logit_len = ins["LogitsLength"][0].reshape(-1).astype(jnp.int32)
+    label_len = ins["LabelLength"][0].reshape(-1).astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    b, t_max, _ = logits.shape
+    l_max = labels.shape[1]
+    s_max = 2 * l_max + 1
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended label sequence per batch row: [blank, l1, blank, ...]
+    ext = jnp.full((b, s_max), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(s_max)
+    valid_s = pos < (2 * label_len[:, None] + 1)
+    # can we skip from s-2 (same-label / blank constraint)?
+    skip_ok = jnp.zeros((b, s_max), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    alpha0 = jnp.full((b, s_max), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0,
+                  jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2],
+                                      axis=1)[:, 0],
+                  _NEG_INF))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        acc = _logsumexp(alpha, prev1)
+        acc = jnp.where(skip_ok, _logsumexp(acc, prev2), acc)
+        emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+        new = jnp.where(valid_s, acc + emit, _NEG_INF)
+        # frozen past the sequence end
+        new = jnp.where((t < logit_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+    end1 = 2 * label_len          # last blank
+    end2 = 2 * label_len - 1      # last label
+    a1 = jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0]
+    a2 = jnp.where(label_len > 0,
+                   jnp.take_along_axis(alpha,
+                                       jnp.maximum(end2, 0)[:, None],
+                                       axis=1)[:, 0],
+                   _NEG_INF)
+    loss = -_logsumexp(a1, a2)
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(logit_len.astype(jnp.float32), 1.0)
+    return {"Loss": [loss[:, None]]}
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+def _crf_split_transition(trans):
+    """Paddle layout: Transition [(C+2), C]: row 0 = start weights,
+    row 1 = stop weights, rows 2.. = [C, C] transitions."""
+    return trans[0], trans[1], trans[2:]
+
+
+@register_op("linear_chain_crf", no_grad_inputs={"Label", "Length"})
+def _linear_chain_crf(ctx, ins, attrs):
+    """Emission [b, T, C], Transition [(C+2), C], Label [b, T],
+    Length [b]. Outputs LogLikelihood [b, 1] (reference outputs the
+    negative LL in .. sign convention: we output log-likelihood; the layer
+    negates for the loss, matching linear_chain_crf_op.cc semantics)."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    trans = ins["Transition"][0].astype(jnp.float32)
+    labels = ins["Label"][0].astype(jnp.int32)
+    lens = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    start_w, stop_w, tr = _crf_split_transition(trans)
+    b, t_max, c = em.shape
+
+    # path score
+    em_lab = jnp.take_along_axis(em, labels[:, :, None], axis=2)[:, :, 0]
+    mask = (jnp.arange(t_max)[None, :] < lens[:, None]).astype(jnp.float32)
+    em_score = (em_lab * mask).sum(1)
+    pair_sc = tr[labels[:, :-1], labels[:, 1:]]
+    pair_mask = mask[:, 1:]
+    trans_score = (pair_sc * pair_mask).sum(1)
+    first = labels[:, 0]
+    last = jnp.take_along_axis(labels, jnp.maximum(lens - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    path = em_score + trans_score + start_w[first] + stop_w[last]
+
+    # partition function (forward algorithm)
+    alpha0 = start_w[None, :] + em[:, 0, :]
+
+    def step(alpha, t):
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + tr[None, :, :], axis=1) + em[:, t, :]
+        keep = (t < lens)[:, None]
+        return jnp.where(keep, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+    logz = jax.scipy.special.logsumexp(alpha + stop_w[None, :], axis=1)
+    return {"LogLikelihood": [(path - logz)[:, None]]}
+
+
+@register_op("crf_decoding", not_differentiable=True)
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (reference crf_decoding_op.cc). Same inputs minus
+    Label; Out: ViterbiPath [b, T] (zeros past each length)."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    trans = ins["Transition"][0].astype(jnp.float32)
+    lens = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    start_w, stop_w, tr = _crf_split_transition(trans)
+    b, t_max, c = em.shape
+
+    delta0 = start_w[None, :] + em[:, 0, :]
+
+    def fwd(delta, t):
+        scores = delta[:, :, None] + tr[None, :, :]       # [b, c_prev, c]
+        best_prev = jnp.argmax(scores, axis=1)            # [b, c]
+        nxt = jnp.max(scores, axis=1) + em[:, t, :]
+        keep = (t < lens)[:, None]
+        delta_new = jnp.where(keep, nxt, delta)
+        return delta_new, jnp.where(keep, best_prev, -1)
+
+    delta, back = jax.lax.scan(fwd, delta0, jnp.arange(1, t_max))
+    # back: [t_max-1, b, c]; pick best final state at each row's length end
+    final = delta + stop_w[None, :]
+    last_state = jnp.argmax(final, axis=1)                # [b]
+
+    def bwd(state, t):
+        ptr = back[t]                                     # [b, c]
+        prev = jnp.take_along_axis(ptr, state[:, None], axis=1)[:, 0]
+        # before the row's end, pointers are -1 (frozen): keep state
+        prev = jnp.where(prev < 0, state, prev)
+        return prev, prev  # emit the stepped-back state (time t)
+
+    _, prevs_rev = jax.lax.scan(bwd, last_state,
+                                jnp.arange(t_max - 2, -1, -1))
+    # prevs_rev = [state_{T-2}, ..., state_0]; flip + append the end state
+    path = jnp.concatenate(
+        [jnp.flip(prevs_rev, 0), last_state[None, :]], axis=0).T
+    mask = jnp.arange(t_max)[None, :] < lens[:, None]
+    return {"ViterbiPath": [jnp.where(mask, path, 0).astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# NCE + hierarchical sigmoid (sampled losses for huge softmaxes)
+# ---------------------------------------------------------------------------
+
+def _nce_forward(x, w, bias, label, neg):
+    """Deterministic NCE cost given already-sampled negatives."""
+    num_neg = neg.shape[1]
+    c = w.shape[0]
+    logq = jnp.log(jnp.asarray(num_neg / c, jnp.float32))
+
+    def score(idx):
+        s = jnp.einsum("bd,b...d->b...", x.astype(jnp.float32),
+                       w[idx].astype(jnp.float32))
+        if bias is not None:
+            s = s + bias[idx]
+        return s
+
+    pos = score(label) - logq
+    negs = score(neg) - logq
+    loss = -jax.nn.log_sigmoid(pos) - jax.nn.log_sigmoid(-negs).sum(-1)
+    return loss[:, None]
+
+
+def _nce_grad_maker(op, block, no_grad_set):
+    from ..framework.core import grad_var_name
+    ins = {"Input": op.input("Input"), "Weight": op.input("Weight"),
+           "Label": op.input("Label"),
+           "Negatives": op.output("Negatives"),
+           "Cost@GRAD": [grad_var_name(op.output("Cost")[0])]}
+    outs = {"Input@GRAD": [grad_var_name(op.input("Input")[0])],
+            "Weight@GRAD": [grad_var_name(op.input("Weight")[0])]}
+    if op.input("Bias"):
+        ins["Bias"] = op.input("Bias")
+        outs["Bias@GRAD"] = [grad_var_name(op.input("Bias")[0])]
+    return [{"type": "nce_grad", "inputs": ins, "outputs": outs,
+             "attrs": dict(op.attrs)}]
+
+
+def _nce_grad_lower(ctx, ins, attrs):
+    """Recompute the NCE cost with the SAVED negatives (the dropout-Mask
+    pattern: sampling happened once in forward) and vjp through it."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    neg = ins["Negatives"][0]
+    og = ins["Cost@GRAD"][0]
+
+    if bias is None:
+        f = lambda xv, wv: _nce_forward(xv, wv, None, label, neg)
+        _, vjp = jax.vjp(f, x, w)
+        gx, gw = vjp(og.astype(jnp.float32))
+        return {"Input@GRAD": [gx], "Weight@GRAD": [gw]}
+    f = lambda xv, wv, bv: _nce_forward(xv, wv, bv, label, neg)
+    _, vjp = jax.vjp(f, x, w, bias)
+    gx, gw, gb = vjp(og.astype(jnp.float32))
+    return {"Input@GRAD": [gx], "Weight@GRAD": [gw], "Bias@GRAD": [gb]}
+
+
+@register_op("nce", no_grad_inputs={"Label"}, stateful=True,
+             non_diff_outputs={"Negatives"}, grad_maker=_nce_grad_maker,
+             grad_lower=_nce_grad_lower)
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (reference nce_op.cc), uniform noise
+    sampler. Input [b, d], Weight [C, d], Bias [C], Label [b, 1].
+    Outputs Cost [b, 1] and the sampled Negatives [b, k] (saved for the
+    gradient, like dropout's Mask)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    neg = jax.random.randint(ctx.rng(), (x.shape[0], num_neg), 0,
+                             w.shape[0])
+    return {"Cost": [_nce_forward(x, w, bias, label, neg)],
+            "Negatives": [neg]}
+
+
+@register_op("hierarchical_sigmoid", no_grad_inputs={"Label"})
+def _hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hierarchical_sigmoid_op.cc non-custom-tree path): classes
+    are leaves of a heap-shaped tree with num_classes-1 internal nodes; W
+    is [num_classes - 1, d], Bias [num_classes - 1]. Cost [b, 1]."""
+    x = ins["X"][0]
+    w = ins["W"][0]
+    bias = ins.get("Bias", [None])[0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    import math
+    num_classes = int(attrs["num_classes"])
+    depth = max(1, math.ceil(math.log2(num_classes)))
+
+    # heap indexing: leaf node id = label + num_classes - 1; walk to root
+    node = label + num_classes - 1
+    loss = jnp.zeros(x.shape[0], jnp.float32)
+    for _ in range(depth):
+        parent = (node - 1) // 2
+        is_right = (node % 2 == 0)  # right child has even heap index
+        active = node > 0
+        s = jnp.einsum("bd,bd->b", x, w[jnp.maximum(parent, 0)])
+        if bias is not None:
+            s = s + bias[jnp.maximum(parent, 0)]
+        # sigmoid code: left -> sigmoid(s), right -> sigmoid(-s)
+        step_loss = -jax.nn.log_sigmoid(jnp.where(is_right, -s, s))
+        loss = loss + jnp.where(active, step_loss, 0.0)
+        node = jnp.maximum(parent, 0)
+    return {"Cost": [loss[:, None]]}

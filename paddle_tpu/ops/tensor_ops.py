@@ -529,3 +529,40 @@ def _cumsum(ctx, ins, attrs):
 @register_op("cumprod")
 def _cumprod(ctx, ins, attrs):
     return {"Out": [jnp.cumprod(ins["X"][0], axis=attrs.get("dim", -1))]}
+
+
+# py_func: host-Python callback inside the graph
+# (reference: operators/py_func_op.cc + layers py_func). The callable table
+# lives host-side; the op lowers to jax.pure_callback, which XLA schedules
+# as a host call — same mechanics as the reference's GIL-grabbing op.
+_PY_FUNCS = {}
+
+
+def register_py_func(fn) -> int:
+    fid = len(_PY_FUNCS)
+    _PY_FUNCS[fid] = fn
+    return fid
+
+
+@register_op("py_func", not_differentiable=True)
+def _py_func(ctx, ins, attrs):
+    import numpy as _np
+
+    fn = _PY_FUNCS[attrs["func_id"]]
+    out_shapes = attrs["out_shapes"]
+    out_dtypes = attrs["out_dtypes"]
+    xs = ins.get("X", [])
+    results = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+               for s, d in zip(out_shapes, out_dtypes)]
+
+    def host_fn(*arrays):
+        out = fn(*arrays)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return [_np.asarray(o, dtype=d)
+                for o, d in zip(out, out_dtypes)]
+
+    outs = jax.pure_callback(host_fn, results, *xs)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return {"Out": list(outs)}

@@ -800,6 +800,7 @@ class GradientMergeOptimizer:
 
         def true_fn():
             cur = main.current_block()
+            effs = []
             for (p, _), acc in zip(params_grads, accs):
                 eff = cur.create_var(
                     name=unique_name(f"{p.name}@GRAD_EFF"),
@@ -808,9 +809,16 @@ class GradientMergeOptimizer:
                               {"Out": [eff.name]},
                               {"scale": 1.0 / self.k if self.avg else 1.0},
                               infer_shape=False)
+                effs.append(cur.var(eff.name))
+            # the inner optimizer's clip + weight decay act on the MERGED
+            # gradient, same order as apply_gradients
+            pgs = [(p, e) for (p, _), e in zip(params_grads, effs)]
+            if self.inner.grad_clip is not None:
+                pgs = self.inner.grad_clip(pgs)
+            pgs = self.inner._apply_regularization(pgs)
+            for (p, g), acc in zip(pgs, accs):
                 self.inner._append_optimize_op(
-                    cur, p, cur.var(eff.name),
-                    self.inner._param_lr(cur, lr, p))
+                    cur, p, g, self.inner._param_lr(cur, lr, p))
                 cur.append_op("scale", {"X": [acc.name]},
                               {"Out": [acc.name]}, {"scale": 0.0},
                               infer_shape=False)

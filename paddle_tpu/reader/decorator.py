@@ -18,6 +18,14 @@ __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "multiprocess_reader"]
 
 
+class _Raise:
+    """Exception carrier: producer threads must not silently truncate the
+    stream — the consumer re-raises."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def map_readers(func, *readers):
     """Apply func to the items of several readers zipped together."""
     def reader():
@@ -84,8 +92,9 @@ def buffered(reader, size: int):
             try:
                 for e in reader():
                     q.put(e)
-            finally:
                 q.put(_End)
+            except BaseException as exc:  # re-raised in the consumer
+                q.put(_Raise(exc))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -93,6 +102,8 @@ def buffered(reader, size: int):
             e = q.get()
             if e is _End:
                 break
+            if isinstance(e, _Raise):
+                raise e.exc
             yield e
     return buffered_reader
 
@@ -187,8 +198,9 @@ def multiprocess_reader(readers, use_pipe: bool = True,
             try:
                 for e in r():
                     q.put(e)
-            finally:
                 q.put(_End)
+            except BaseException as exc:
+                q.put(_Raise(exc))
 
         for r in readers:
             threading.Thread(target=pump, args=(r,), daemon=True).start()
@@ -198,6 +210,8 @@ def multiprocess_reader(readers, use_pipe: bool = True,
             if e is _End:
                 finished += 1
                 continue
+            if isinstance(e, _Raise):
+                raise e.exc
             yield e
     return reader
 

@@ -1,0 +1,20 @@
+"""DLPack interop (reference: framework/dlpack_tensor.cc + fluid.dlpack):
+zero-copy tensor exchange with torch/numpy/other frameworks."""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(array):
+    """jax.Array -> DLPack capsule (zero copy where layouts allow)."""
+    return array.__dlpack__()
+
+
+def from_dlpack(ext):
+    """DLPack capsule / any __dlpack__-bearing object -> jax.Array.
+    Prefer passing the producer OBJECT (not a raw capsule): the array API
+    standard routes device negotiation through __dlpack_device__."""
+    import jax.dlpack
+
+    return jax.dlpack.from_dlpack(ext)

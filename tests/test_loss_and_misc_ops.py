@@ -1,0 +1,381 @@
+"""CTC / CRF / NCE / hsigmoid losses, distributions, nets, py_func,
+dlpack (reference analogs: test_warpctc_op.py, test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_nce.py, test_hsigmoid_op.py,
+test_distributions.py, test_py_func_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def _ctc_ref(logp, labels, blank=0):
+    """Brute-force CTC -log p(labels | logp) via the alpha recursion in
+    prob space (small cases only)."""
+    T, C = logp.shape
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    S = len(ext)
+    p = np.exp(logp)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = p[0, blank]
+    if S > 1:
+        alpha[0, 1] = p[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * p[t, ext[s]]
+    tot = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0.0)
+    return -np.log(max(tot, 1e-300))
+
+
+def test_warpctc_matches_reference():
+    rng = np.random.RandomState(0)
+    T, C, L = 6, 5, 2
+    logits = rng.randn(2, T, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 3]], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [T, C])
+        lab = pt.layers.data("lab", [L], dtype="int64")
+        xl = pt.layers.data("xl", [1], dtype="int64")
+        ll = pt.layers.data("ll", [1], dtype="int64")
+        loss = pt.layers.warpctc(x, lab, xl, ll)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={
+            "x": logits, "lab": labels,
+            "xl": np.array([[T], [T]], np.int64),
+            "ll": np.array([[2], [2]], np.int64)}, fetch_list=[loss])
+    from scipy.special import log_softmax as _ls  # scipy is available
+    for i in range(2):
+        ref = _ctc_ref(_ls(logits[i], axis=-1), labels[i].tolist())
+        np.testing.assert_allclose(lv[i, 0], ref, rtol=1e-4)
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(0)
+    T, C, L = 8, 6, 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        feat = pt.layers.data("feat", [T, 4])
+        lab = pt.layers.data("lab", [L], dtype="int64")
+        xl = pt.layers.data("xl", [1], dtype="int64")
+        ll = pt.layers.data("ll", [1], dtype="int64")
+        logits = pt.layers.fc(feat, C, num_flatten_dims=2)
+        loss = pt.layers.mean(pt.layers.warpctc(logits, lab, xl, ll))
+        pt.optimizer.Adam(5e-2).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    feats = rng.randn(4, T, 4).astype(np.float32)
+    labs = rng.randint(1, C, (4, L)).astype(np.int64)
+    feed = {"feat": feats, "lab": labs,
+            "xl": np.full((4, 1), T, np.int64),
+            "ll": np.full((4, 1), L, np.int64)}
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(15):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def _crf_ref_nll(em, trans, labels, length):
+    """Enumerate all paths (tiny cases)."""
+    import itertools
+    start, stop, tr = trans[0], trans[1], trans[2:]
+    C = em.shape[1]
+    def score(path):
+        s = start[path[0]] + em[0, path[0]] + stop[path[-1]]
+        for t in range(1, len(path)):
+            s += tr[path[t - 1], path[t]] + em[t, path[t]]
+        return s
+    gold = score(labels[:length])
+    logz = np.logaddexp.reduce(
+        [score(p) for p in itertools.product(range(C), repeat=length)])
+    return -(gold - logz)
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    T, C = 3, 3
+    em = rng.randn(1, T, C).astype(np.float32)
+    trans = rng.randn(C + 2, C).astype(np.float32)
+    labels = np.array([[0, 2, 1]], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        e = pt.layers.data("e", [T, C])
+        lab = pt.layers.data("lab", [T], dtype="int64")
+        ln = pt.layers.data("ln", [1], dtype="int64")
+        nll, tvar = pt.layers.linear_chain_crf(e, lab, ln)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        scope.set_var(tvar.name, trans)
+        (out,) = exe.run(main, feed={
+            "e": em, "lab": labels, "ln": np.array([[T]], np.int64)},
+            fetch_list=[nll])
+    ref = _crf_ref_nll(em[0], trans, labels[0], T)
+    np.testing.assert_allclose(out[0, 0], ref, rtol=1e-4)
+
+
+def test_crf_decoding_recovers_planted_path():
+    rng = np.random.RandomState(2)
+    T, C = 6, 4
+    planted = rng.randint(0, C, (2, T))
+    em = np.full((2, T, C), -3.0, np.float32)
+    for b in range(2):
+        for t in range(T):
+            em[b, t, planted[b, t]] = 3.0
+    trans = np.zeros((C + 2, C), np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        e = pt.layers.data("e", [T, C])
+        ln = pt.layers.data("ln", [1], dtype="int64")
+        tvar = pt.layers.data("tr", [C + 2, C],
+                              append_batch_size=False)
+        path = pt.layers.crf_decoding(e, tvar, ln)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (out,) = exe.run(main, feed={
+            "e": em, "ln": np.array([[T], [4]], np.int64),
+            "tr": trans}, fetch_list=[path])
+    np.testing.assert_array_equal(out[0], planted[0])
+    np.testing.assert_array_equal(out[1, :4], planted[1, :4])
+    assert (out[1, 4:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# NCE / hsigmoid
+# ---------------------------------------------------------------------------
+
+def test_nce_trains():
+    rng = np.random.RandomState(0)
+    V, D = 50, 8
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [D])
+        lab = pt.layers.data("lab", [1], dtype="int64")
+        cost = pt.layers.mean(pt.layers.nce(x, lab, V, num_neg_samples=5))
+        pt.optimizer.Adam(5e-2).minimize(cost)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(20):
+            xv = rng.randn(32, D).astype(np.float32)
+            lv_ = (np.abs(xv.sum(1)).astype(np.int64) % V)[:, None]
+            (c,) = exe.run(main, feed={"x": xv, "lab": lv_},
+                           fetch_list=[cost])
+            losses.append(float(np.ravel(c)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_hsigmoid_trains():
+    rng = np.random.RandomState(0)
+    V, D = 16, 8
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [D])
+        lab = pt.layers.data("lab", [1], dtype="int64")
+        cost = pt.layers.mean(pt.layers.hsigmoid(x, lab, V))
+        pt.optimizer.Adam(5e-2).minimize(cost)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(20):
+            xv = rng.randn(32, D).astype(np.float32)
+            lv_ = rng.randint(0, V, (32, 1)).astype(np.int64)
+            # learnable: label determined by sign pattern
+            lv_ = (np.abs(xv[:, :4].sum(1) * 4).astype(np.int64)
+                   % V)[:, None]
+            (c,) = exe.run(main, feed={"x": xv, "lab": lv_},
+                           fetch_list=[cost])
+            losses.append(float(np.ravel(c)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------------
+# distributions / nets / py_func / dlpack
+# ---------------------------------------------------------------------------
+
+def test_distributions_normal_kl_and_sampling():
+    from paddle_tpu.layers.distributions import Normal, Categorical
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        a = Normal(0.0, 1.0)
+        b = Normal(1.0, 2.0)
+        kl = a.kl_divergence(b)
+        ent = a.entropy()
+        s = a.sample([2000])
+        logits = pt.layers.data("lg", [3])
+        cat = Categorical(logits)
+        cat_ent = pt.layers.mean(cat.entropy())
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (klv, entv, sv, cev) = exe.run(main, feed={
+            "lg": np.zeros((2, 3), np.float32)},
+            fetch_list=[kl, ent, s, cat_ent])
+    # closed forms
+    ref_kl = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(np.ravel(klv)[0], ref_kl, rtol=1e-5)
+    np.testing.assert_allclose(np.ravel(entv)[0],
+                               0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    assert abs(sv.mean()) < 0.15 and abs(sv.std() - 1.0) < 0.15
+    np.testing.assert_allclose(np.ravel(cev)[0], np.log(3.0), rtol=1e-5)
+
+
+def test_nets_helpers():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        img = pt.layers.data("img", [3, 16, 16])
+        conv_pool = pt.nets.simple_img_conv_pool(img, 8, 3, 2, 2,
+                                                 act="relu")
+        seq = pt.layers.data("seq", [5, 12])
+        att = pt.nets.scaled_dot_product_attention(seq, seq, seq,
+                                                   num_heads=3)
+        g = pt.nets.glu(pt.layers.data("gx", [8]))
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (cp, av, gv) = exe.run(main, feed={
+            "img": rng.randn(2, 3, 16, 16).astype(np.float32),
+            "seq": rng.randn(2, 5, 12).astype(np.float32),
+            "gx": rng.randn(2, 8).astype(np.float32)},
+            fetch_list=[conv_pool, att, g])
+    assert cp.shape[1] == 8 and av.shape == (2, 5, 12) and gv.shape == (2, 4)
+
+
+def test_py_func_callback():
+    def double_plus_one(x):
+        return np.asarray(x) * 2 + 1
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [3])
+        out = main.global_block.create_var(name="pyout", shape=(4, 3),
+                                           dtype="float32")
+        pt.layers.py_func(double_plus_one, x, out)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        xin = np.arange(12, dtype=np.float32).reshape(4, 3)
+        (ov,) = exe.run(main, feed={"x": xin}, fetch_list=["pyout"])
+    np.testing.assert_allclose(ov, xin * 2 + 1)
+
+
+def test_dlpack_roundtrip():
+    import jax.numpy as jnp
+    a = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    # object route (preferred): torch/numpy interop goes through __dlpack__
+    b = pt.utils.dlpack.from_dlpack(a)
+    np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+    # capsule is still producible for consumers that want one
+    cap = pt.utils.dlpack.to_dlpack(a)
+    assert "dltensor" in repr(cap)
+    # torch (cpu) interop both ways
+    import torch
+    t = torch.utils.dlpack.from_dlpack(
+        np.array(a))  # writable numpy copy: torch rejects readonly views
+    c = pt.utils.dlpack.from_dlpack(t)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(a))
+
+
+def test_buffered_reader_propagates_exceptions():
+    from paddle_tpu import reader as rd
+
+    def bad():
+        yield 1
+        raise IOError("disk gone")
+
+    r = rd.buffered(bad, 4)
+    it = r()
+    assert next(it) == 1
+    with pytest.raises(IOError, match="disk gone"):
+        list(it)
+
+
+def test_py_func_rejects_dynamic_shape():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [3])
+        out = main.global_block.create_var(name="o", shape=(-1, 3),
+                                           dtype="float32")
+        with pytest.raises(ValueError, match="concrete"):
+            pt.layers.py_func(lambda a: a, x, out)
+
+
+def test_gradient_merge_applies_inner_clip():
+    """Inner optimizer's global-norm clip must act on the merged grad."""
+    k = 2
+    rng = np.random.RandomState(0)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4], dtype="float32")
+        y = pt.layers.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, 1)
+        loss = pt.layers.mean(pt.layers.square(pred - y))
+        inner = pt.optimizer.SGD(
+            learning_rate=1.0,
+            grad_clip=pt.clip.GradientClipByGlobalNorm(1e-4))
+        pt.optimizer.GradientMergeOptimizer(inner, k_steps=k).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = np.asarray(scope.find_var(wname)).copy()
+        for _ in range(k):
+            xv = rng.randn(8, 4).astype(np.float32) * 100
+            exe.run(main, feed={"x": xv, "y": np.ones((8, 1), np.float32)},
+                    fetch_list=[loss])
+        w1 = np.asarray(scope.find_var(wname))
+    # huge inputs + lr 1.0 would blow up without the clip;
+    # with global-norm 1e-4 the update is bounded by lr * 1e-4
+    assert np.abs(w1 - w0).max() <= 2e-4, np.abs(w1 - w0).max()
+
+
+def test_sequence_conv_pool_window():
+    """filter_size=3 must mix neighboring timesteps (not a 1x projection)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4, 2])
+        out = pt.nets.sequence_conv_pool(x, 3, filter_size=3,
+                                         act=None, pool_type="max")
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        base = np.zeros((1, 4, 2), np.float32)
+        bump = base.copy()
+        bump[0, 2, 0] = 1.0  # only timestep 2 differs
+        (o1,) = exe.run(main, feed={"x": base}, fetch_list=[out])
+        (o2,) = exe.run(main, feed={"x": bump}, fetch_list=[out])
+    assert not np.allclose(o1, o2)
