@@ -18,7 +18,7 @@ def main():
     from paddle_tpu.models.bert import (BertConfig, bert_pretrain_program,
                                         flops_per_step)
 
-    cfg = BertConfig()  # BERT-base
+    cfg = BertConfig(attn_impl=os.environ.get("BENCH_ATTN", "einsum"))  # BERT-base
     seq = int(os.environ.get("BENCH_SEQ", 128))
     batch = int(os.environ.get("BENCH_BATCH", 128))
     steps = int(os.environ.get("BENCH_STEPS", 20))

@@ -29,7 +29,7 @@ WHITE_LIST: Set[str] = {
     "elementwise_div", "elementwise_max", "elementwise_min",
     "relu", "gelu", "tanh", "sigmoid", "swish", "silu", "leaky_relu",
     "softplus", "exp", "square", "abs", "scale",
-    "dropout", "softmax", "layer_norm",
+    "dropout", "softmax", "layer_norm", "batch_norm",
     "reshape2", "reshape", "transpose2", "transpose", "split", "concat",
     "stack", "slice", "squeeze2", "unsqueeze2", "flatten2", "expand",
     "pad", "gather",
@@ -39,10 +39,18 @@ WHITE_LIST: Set[str] = {
 # ops whose bf16 inputs are cast back to float32 (precision-sensitive)
 BLACK_LIST: Set[str] = {
     "mean", "reduce_sum", "reduce_mean", "sum", "cross_entropy",
-    "batch_norm", "cumsum", "squared_l2_norm", "clip_by_norm", "p_norm",
+    "cumsum", "squared_l2_norm", "clip_by_norm", "p_norm",
 }
 
 _FLOAT = ("float32",)
+
+# per-op slots that must STAY float32 even on white-listed ops: bf16 running
+# statistics would round away the (1-momentum)-scaled increments and the
+# stats would stall (batch_norm's fp32 internal math only protects the
+# per-batch stats, not the persistent accumulators)
+_KEEP_F32_IN = {"batch_norm": {"Mean", "Variance", "Scale", "Bias"}}
+_KEEP_F32_OUT = {"batch_norm": {"MeanOut", "VarianceOut", "SavedMean",
+                                "SavedVariance"}}
 
 
 class AutoMixedPrecisionLists:
@@ -93,7 +101,11 @@ def rewrite_bf16(program: Program,
             raise RuntimeError(
                 "rewrite_bf16 must run before append_backward/minimize")
         if op.type in amp_lists.white_list:
+            keep_in = _KEEP_F32_IN.get(op.type, set())
+            keep_out = _KEEP_F32_OUT.get(op.type, set())
             for slot, names in op.inputs.items():
+                if slot in keep_in:
+                    continue
                 for j, n in enumerate(names):
                     if _dtype(n) in _FLOAT:
                         names[j] = _insert_cast(n, "bfloat16", cast_to_bf16,
@@ -104,8 +116,9 @@ def rewrite_bf16(program: Program,
                     d = _dtype(n)
                     if d in _FLOAT or d == "bfloat16":
                         # loss stays f32 (xent lowering emits f32 loss)
-                        if op.type == "softmax_with_cross_entropy" and \
-                                slot == "Loss":
+                        if slot in keep_out or (
+                                op.type == "softmax_with_cross_entropy"
+                                and slot == "Loss"):
                             cur_dtype[n] = "float32"
                         else:
                             cur_dtype[n] = "bfloat16"

@@ -38,6 +38,10 @@ def _parse_args(argv=None):
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per host (TPU: 1; CPU emulation: N)")
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--server_num", type=int, default=0,
+                   help="parameter-server mode: pserver process count")
+    p.add_argument("--worker_num", type=int, default=0,
+                   help="parameter-server mode: trainer process count")
     p.add_argument("--dry_run", action="store_true",
                    help="print per-process env and exit (for tests)")
     p.add_argument("training_script", type=str)
@@ -65,8 +69,40 @@ def build_env(rank: int, args) -> dict:
     return env
 
 
+def build_ps_envs(args):
+    """Parameter-server mode env assembly (reference launch_ps):
+    server_num pservers + worker_num trainers on this host, wired through
+    the TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST convention that
+    PaddleCloudRoleMaker reads."""
+    server_eps = [f"127.0.0.1:{args.started_port + i}"
+                  for i in range(args.server_num)]
+    envs = []
+    for i, ep in enumerate(server_eps):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "PSERVER",
+            "POD_IP": "127.0.0.1",
+            "PADDLE_PORT": ep.rsplit(":", 1)[1],
+            "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+            "PADDLE_TRAINERS_NUM": str(args.worker_num),
+        })
+        envs.append((f"server.{i}", env))
+    for i in range(args.worker_num):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+            "PADDLE_TRAINERS_NUM": str(args.worker_num),
+        })
+        envs.append((f"worker.{i}", env))
+    return envs
+
+
 def launch(argv=None) -> int:
     args = _parse_args(argv)
+    if args.server_num or args.worker_num:
+        return _launch_ps(args)
     hosts = [h for h in args.hosts.split(",") if h]
     node_rank = hosts.index(args.node_ip) if args.node_ip in hosts else 0
     local_ranks = range(node_rank * args.nproc_per_node,
@@ -120,6 +156,51 @@ def launch(argv=None) -> int:
             time.sleep(0.2)
     finally:
         _terminate()
+    return rc
+
+
+def _launch_ps(args) -> int:
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for tag, env in build_ps_envs(args):
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        stdout = None
+        if args.log_dir:
+            stdout = open(os.path.join(args.log_dir, f"{tag}.log"), "w")
+        procs.append((tag, subprocess.Popen(
+            cmd, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None), stdout))
+
+    rc = 0
+    try:
+        # workers finishing cleanly ends the job; pservers are told to
+        # shut down by trainer 0 (plan.shutdown(stop_servers=True)) or
+        # terminated here once every worker exited
+        while True:
+            workers = [(t, p) for t, p, _f in procs
+                       if t.startswith("worker")]
+            if all(p.poll() is not None for _t, p in workers):
+                # any nonzero (including signal-negative) code is failure
+                rc = next((p.poll() for _t, p in workers if p.poll()), 0)
+                break
+            for t, p, _f in procs:
+                if t.startswith("worker") and p.poll() is not None \
+                        and p.poll() != 0:
+                    rc = p.poll()
+            if rc:
+                break
+            time.sleep(0.2)
+    finally:
+        for _t, p, _f in procs:
+            if p.poll() is None:
+                p.terminate()
+        for _t, p, _f in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
     return rc
 
 

@@ -16,11 +16,10 @@ __all__ = ["Distribution", "Uniform", "Normal", "Categorical",
            "MultivariateNormalDiag"]
 
 
-def _as_var(v, like=None, dtype="float32"):
+def _as_var(v, dtype="float32"):
     from ..framework.core import Variable
     if isinstance(v, Variable):
         return v
-    shape = [1] if like is None else list(like.shape[1:] or [1])
     return _t.fill_constant([1], dtype, float(v))
 
 

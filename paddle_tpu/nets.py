@@ -56,11 +56,11 @@ def glu(input, dim=-1):
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
                                  dropout_rate=0.0):
     """reference nets.py scaled_dot_product_attention — multi-head
-    attention over [b, s, d] tensors, expressed with the fused flash
-    attention op when head layout permits."""
+    attention over [b, s, d] tensors as batched matmuls (use
+    layers.fused_attention directly for the flash/ring kernel path)."""
     d_model = queries.shape[-1]
     if d_model % num_heads != 0:
-        raise ValueError("d_model must divide num_heads")
+        raise ValueError("num_heads must divide d_model")
     dk = d_model // num_heads
 
     def split_heads(x):
@@ -102,10 +102,7 @@ def sequence_conv_pool(input, num_filters, filter_size, lengths=None,
             if off == 0:
                 shifted.append(input)
                 continue
-            pad = layers.zeros(
-                [1, abs(off), input.shape[-1]], input.dtype)
-            pad = layers.expand_as(pad, input) if False else pad
-            # shift via slice + concat of a zero block (batch-broadcast)
+            # shift via slice + concat of a zero block
             if off < 0:
                 body = layers.slice(input, axes=[1], starts=[0],
                                     ends=[s_len + off])

@@ -180,23 +180,28 @@ def _batch_norm(ctx, ins, attrs):
     else:
         axes, shape = (0, 1, 2), (1, 1, 1, -1)
 
+    # stats in float32 even for bf16 activations (AMP-safe, like
+    # layer_norm below) — this is what lets batch_norm sit on the AMP
+    # white list so conv+bn chains stay bf16 end to end
+    x32 = x.astype(jnp.float32)
     if attrs.get("is_test", False) or attrs.get("use_global_stats", False):
         use_mean, use_var = mean, var
         mean_out, var_out = mean, var
         saved_mean = jnp.zeros_like(mean)
         saved_var = jnp.zeros_like(var)
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        use_mean = jnp.mean(x32, axis=axes)
+        use_var = jnp.var(x32, axis=axes)
         mean_out = momentum * mean + (1.0 - momentum) * use_mean
         var_out = momentum * var + (1.0 - momentum) * use_var
         saved_mean = use_mean
         saved_var = 1.0 / jnp.sqrt(use_var + eps)
 
     inv = 1.0 / jnp.sqrt(use_var + eps)
-    y = (x - use_mean.reshape(shape)) * (inv * scale).reshape(shape) \
+    y = (x32 - use_mean.reshape(shape)) * (inv * scale).reshape(shape) \
         + bias.reshape(shape)
-    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+    return {"Y": [y.astype(x.dtype)], "MeanOut": [mean_out],
+            "VarianceOut": [var_out],
             "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
 
 

@@ -1,0 +1,74 @@
+"""Subprocess entry for the multi-PROCESS parameter-server test (the
+reference's dist_mnist.py analog, driven by paddle_tpu.distributed.launch
+--server_num/--worker_num). Role comes from TRAINING_ROLE env; each worker
+writes its per-step losses to $DIST_PS_OUT/worker.<id>.json."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the axon sitecustomize force-sets jax_platforms; pin the backend the
+# test expects (CPU — three processes must not fight over one TPU, and
+# rbg PRNG values differ per backend)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.incubate.fleet.base.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.incubate.fleet.parameter_server import PSFleet
+
+
+def build(f):
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8], dtype="float32")
+        label = pt.layers.data("label", [1], dtype="float32")
+        h = pt.layers.fc(x, size=16, act="relu")
+        pred = pt.layers.fc(h, size=1)
+        loss = pt.layers.mean(pt.layers.square(pred - label))
+        opt = f.distributed_optimizer(pt.optimizer.SGD(learning_rate=0.05))
+        opt.minimize(loss, startup_program=startup)
+    main.random_seed = startup.random_seed = 9
+    return main, startup, loss
+
+
+def main():
+    fleet = PSFleet()
+    fleet.init(PaddleCloudRoleMaker())
+    _, startup, loss = build(fleet)
+
+    if fleet.is_server():
+        fleet.run_server()  # blocks until a trainer sends shutdown
+        return
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)  # same data on every worker: lockstep
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(6):
+            x = rng.randn(16, 8).astype(np.float32)
+            lab = x.sum(1, keepdims=True).astype(np.float32)
+            (lv,) = exe.run(fleet.main_program,
+                            feed={"x": x, "label": lab}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    out_dir = os.environ["DIST_PS_OUT"]
+    wid = fleet.worker_index()
+    with open(os.path.join(out_dir, f"worker.{wid}.json"), "w") as f:
+        json.dump(losses, f)
+    plan = fleet.main_program._ps_plan
+    # worker 0 shuts the servers down once everyone is done (barrier keeps
+    # it from killing servers mid-round)
+    for ep in plan.endpoints:
+        plan._client(ep).barrier()
+    plan.shutdown(stop_servers=(wid == 0))
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -276,3 +276,60 @@ def test_run_pserver_exits_on_shutdown_command():
     c.close()
     th.join(timeout=10)
     assert not th.is_alive()
+
+
+def test_multiprocess_ps_via_launch(tmp_path):
+    """REAL processes: 1 pserver + 2 trainers spawned by the launch CLI
+    (the reference's test_dist_base.py subprocess pattern). Worker losses
+    must agree with each other and with a local single-process run."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["DIST_PS_OUT"] = str(tmp_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    runner = os.path.join(os.path.dirname(__file__), "dist_ps_runner.py")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--server_num=1", "--worker_num=2",
+           f"--started_port={port}", f"--log_dir={tmp_path}", runner]
+    proc = subprocess.run(cmd, env=env, timeout=300, capture_output=True,
+                          text=True)
+    logs = ""
+    for f in tmp_path.iterdir():
+        if f.suffix == ".log":
+            logs += f"\n== {f.name} ==\n" + f.read_text()[-2000:]
+    assert proc.returncode == 0, logs
+    w0 = json.load(open(tmp_path / "worker.0.json"))
+    w1 = json.load(open(tmp_path / "worker.1.json"))
+    np.testing.assert_allclose(w0, w1, rtol=1e-4)
+
+    # local baseline with identical model/data
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker, Role)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8], dtype="float32")
+        label = pt.layers.data("label", [1], dtype="float32")
+        h = pt.layers.fc(x, size=16, act="relu")
+        pred = pt.layers.fc(h, size=1)
+        loss = pt.layers.mean(pt.layers.square(pred - label))
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    main.random_seed = startup.random_seed = 9
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    local = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(6):
+            xv = rng.randn(16, 8).astype(np.float32)
+            lab = xv.sum(1, keepdims=True).astype(np.float32)
+            (lv,) = exe.run(main, feed={"x": xv, "label": lab},
+                            fetch_list=[loss])
+            local.append(float(np.ravel(lv)[0]))
+    np.testing.assert_allclose(w0, local, rtol=2e-3, atol=1e-4)

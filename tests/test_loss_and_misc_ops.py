@@ -379,3 +379,25 @@ def test_sequence_conv_pool_window():
         (o1,) = exe.run(main, feed={"x": base}, fetch_list=[out])
         (o2,) = exe.run(main, feed={"x": bump}, fetch_list=[out])
     assert not np.allclose(o1, o2)
+
+
+def test_amp_batch_norm_running_stats_stay_fp32():
+    """White-listed batch_norm must keep its persistent running stats in
+    float32 — bf16 accumulators would round away (1-momentum)*delta."""
+    from paddle_tpu.contrib.mixed_precision import rewrite_bf16
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        img = pt.layers.data("img", [4, 8, 8])
+        h = pt.layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        h = pt.layers.batch_norm(h, act="relu")
+        rewrite_bf16(main)
+    blk = main.global_block
+    bn = [op for op in blk.ops if op.type == "batch_norm"][0]
+    for slot in ("Mean", "Variance", "Scale", "Bias"):
+        for n in bn.inputs.get(slot, []):
+            assert blk.var(n).dtype == "float32", (slot, n)
+    for slot in ("MeanOut", "VarianceOut"):
+        for n in bn.outputs.get(slot, []):
+            assert blk.var(n).dtype == "float32", (slot, n)
+    # the conv activation input IS cast to bf16
+    assert blk.var(bn.inputs["X"][0]).dtype == "bfloat16"
