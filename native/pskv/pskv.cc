@@ -31,6 +31,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <fstream>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -57,6 +58,8 @@ enum Cmd : uint8_t {
   kShutdown = 9,
   kSetLr = 10,
   kInitSparse = 11,
+  kSave = 12,
+  kLoad = 13,
 };
 
 enum Opt : uint8_t { kOptSgd = 0, kOptAdagrad = 1, kOptAdam = 2 };
@@ -555,6 +558,184 @@ void handle_conn(Server* srv, int fd) {
         write_response(fd, kOk, nullptr, 0);
         break;
       }
+      case kSave: {
+        // payload = path; serialize every table incl. optimizer state
+        // (reference: RequestCheckpoint in request_handler_impl.cc — the
+        // pserver snapshots its shard on a trainer's checkpoint_notify)
+        std::string path(f.payload.begin(), f.payload.end());
+        std::ofstream out(path, std::ios::binary);
+        if (!out) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
+        std::lock_guard<std::mutex> l(srv->tables_mu);
+        auto wr = [&](const void* p, size_t n) {
+          out.write(static_cast<const char*>(p), n);
+        };
+        auto wr_str = [&](const std::string& s2) {
+          uint32_t n = s2.size();
+          wr(&n, 4);
+          wr(s2.data(), n);
+        };
+        auto wr_vec = [&](const std::vector<float>& v) {
+          uint64_t n = v.size();
+          wr(&n, 8);
+          wr(v.data(), n * 4);
+        };
+        uint32_t nd = srv->dense.size();
+        wr(&nd, 4);
+        for (auto& kv : srv->dense) {
+          DenseTable* t = kv.second.get();
+          std::lock_guard<std::mutex> tl(t->mu);
+          wr_str(kv.first);
+          wr(&t->opt, sizeof(OptConfig));
+          wr(&t->beta1_pow, 8);
+          wr(&t->beta2_pow, 8);
+          wr_vec(t->value);
+          wr_vec(t->m1);
+          wr_vec(t->m2);
+        }
+        uint32_t ns = srv->sparse.size();
+        wr(&ns, 4);
+        for (auto& kv : srv->sparse) {
+          SparseTable* t = kv.second.get();
+          std::lock_guard<std::mutex> tl(t->mu);
+          wr_str(kv.first);
+          wr(&t->dim, 8);
+          wr(&t->opt, sizeof(OptConfig));
+          wr(&t->beta1_pow, 8);
+          wr(&t->beta2_pow, 8);
+          wr(&t->seed, 8);
+          wr(&t->init_scale, 4);
+          uint64_t nr = t->rows.size();
+          wr(&nr, 8);
+          for (auto& rkv : t->rows) {
+            int64_t id = rkv.first;
+            wr(&id, 8);
+            wr_vec(rkv.second.value);
+            wr_vec(rkv.second.m1);
+            wr_vec(rkv.second.m2);
+          }
+        }
+        out.flush();  // surface ENOSPC-at-flush before answering
+        write_response(fd, out.good() ? kOk : kErr, nullptr, 0);
+        break;
+      }
+      case kLoad: {
+        std::string path(f.payload.begin(), f.payload.end());
+        std::ifstream in(path, std::ios::binary);
+        if (!in) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
+        // every read is validated (gcount + sanity-bounded lengths): a
+        // truncated/corrupt file must answer kErr, never restore half a
+        // shard as success; tables are updated IN PLACE under their own
+        // mutexes so handlers holding table pointers never see a free
+        bool ok = true;
+        auto rd = [&](void* p, size_t n) {
+          if (!ok) return false;
+          in.read(static_cast<char*>(p), n);
+          ok = static_cast<size_t>(in.gcount()) == n;
+          return ok;
+        };
+        auto rd_str = [&](std::string* s2) {
+          uint32_t n = 0;
+          if (!rd(&n, 4) || n > (1u << 20)) { ok = false; return; }
+          s2->resize(n);
+          rd(&(*s2)[0], n);
+        };
+        auto rd_vec = [&](std::vector<float>* v) {
+          uint64_t n = 0;
+          if (!rd(&n, 8) || n > (1ull << 31)) { ok = false; return; }
+          v->resize(n);
+          rd(v->data(), n * 4);
+        };
+        std::lock_guard<std::mutex> l(srv->tables_mu);
+        uint32_t nd = 0;
+        if (!rd(&nd, 4) || nd > (1u << 20)) ok = false;
+        for (uint32_t i = 0; ok && i < nd; ++i) {
+          std::string name;
+          rd_str(&name);
+          OptConfig opt;
+          double b1 = 1.0, b2 = 1.0;
+          std::vector<float> value, m1, m2;
+          rd(&opt, sizeof(OptConfig));
+          rd(&b1, 8);
+          rd(&b2, 8);
+          rd_vec(&value);
+          rd_vec(&m1);
+          rd_vec(&m2);
+          if (!ok) break;
+          auto it = srv->dense.find(name);
+          DenseTable* t;
+          if (it == srv->dense.end()) {
+            auto nt = std::make_unique<DenseTable>();
+            t = nt.get();
+            srv->dense[name] = std::move(nt);
+          } else {
+            t = it->second.get();
+          }
+          std::lock_guard<std::mutex> tl(t->mu);
+          t->opt = opt;
+          t->beta1_pow = b1;
+          t->beta2_pow = b2;
+          t->value = std::move(value);
+          t->m1 = std::move(m1);
+          t->m2 = std::move(m2);
+          t->accum.assign(t->value.size(), 0.f);
+        }
+        uint32_t ns = 0;
+        if (ok && (!rd(&ns, 4) || ns > (1u << 20))) ok = false;
+        for (uint32_t i = 0; ok && i < ns; ++i) {
+          std::string name;
+          rd_str(&name);
+          uint64_t dim = 0;
+          OptConfig opt;
+          double b1 = 1.0, b2 = 1.0;
+          uint64_t seed = 0;
+          float init_scale = 0.f;
+          rd(&dim, 8);
+          rd(&opt, sizeof(OptConfig));
+          rd(&b1, 8);
+          rd(&b2, 8);
+          rd(&seed, 8);
+          rd(&init_scale, 4);
+          uint64_t nr = 0;
+          if (!rd(&nr, 8) || nr > (1ull << 31)) { ok = false; break; }
+          std::unordered_map<int64_t, SparseRow> rows;
+          for (uint64_t r = 0; ok && r < nr; ++r) {
+            int64_t id = 0;
+            rd(&id, 8);
+            SparseRow row;
+            rd_vec(&row.value);
+            rd_vec(&row.m1);
+            rd_vec(&row.m2);
+            if (ok) rows[id] = std::move(row);
+          }
+          if (!ok) break;
+          auto it = srv->sparse.find(name);
+          SparseTable* t;
+          if (it == srv->sparse.end()) {
+            auto nt = std::make_unique<SparseTable>();
+            t = nt.get();
+            srv->sparse[name] = std::move(nt);
+          } else {
+            t = it->second.get();
+          }
+          std::lock_guard<std::mutex> tl(t->mu);
+          t->dim = dim;
+          t->opt = opt;
+          t->beta1_pow = b1;
+          t->beta2_pow = b2;
+          t->seed = seed;
+          t->init_scale = init_scale;
+          t->rows = std::move(rows);
+          t->accum.clear();
+        }
+        write_response(fd, ok ? kOk : kErr, nullptr, 0);
+        break;
+      }
       case kBarrier: {
         std::unique_lock<std::mutex> l(srv->bar_mu);
         srv->bar_count++;
@@ -815,6 +996,14 @@ int pskv_init_sparse(int fd, const char* name, const int64_t* ids, uint64_t n,
                      const float* vals, uint64_t dim) {
   return send_cmd(fd, kInitSparse, name,
                   {{&n, 8}, {ids, n * 8}, {vals, n * dim * 4}}, nullptr, 0);
+}
+
+int pskv_save(int fd, const char* path) {
+  return send_cmd(fd, kSave, "", {{path, std::strlen(path)}}, nullptr, 0);
+}
+
+int pskv_load(int fd, const char* path) {
+  return send_cmd(fd, kLoad, "", {{path, std::strlen(path)}}, nullptr, 0);
 }
 
 int pskv_barrier(int fd, uint32_t trainer_id) {

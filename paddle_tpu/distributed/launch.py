@@ -160,6 +160,13 @@ def launch(argv=None) -> int:
 
 
 def _launch_ps(args) -> int:
+    if args.dry_run:
+        for tag, env in build_ps_envs(args):
+            role = env.get("TRAINING_ROLE")
+            print(f"{tag} role={role} "
+                  f"servers={env.get('PADDLE_PSERVERS_IP_PORT_LIST')} "
+                  f"trainers={env.get('PADDLE_TRAINERS_NUM')}")
+        return 0
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     procs = []

@@ -72,6 +72,10 @@ def load_lib() -> ctypes.CDLL:
         lib.pskv_init_sparse.restype = c.c_int
         lib.pskv_init_sparse.argtypes = [c.c_int, c.c_char_p, i64p,
                                          c.c_uint64, f32p, c.c_uint64]
+        lib.pskv_save.restype = c.c_int
+        lib.pskv_save.argtypes = [c.c_int, c.c_char_p]
+        lib.pskv_load.restype = c.c_int
+        lib.pskv_load.argtypes = [c.c_int, c.c_char_p]
         lib.pskv_barrier.restype = c.c_int
         lib.pskv_barrier.argtypes = [c.c_int, c.c_uint32]
         lib.pskv_set_lr.restype = c.c_int
@@ -191,6 +195,17 @@ class KVClient:
                                           self.trainer_id, ids, ids.size,
                                           np.ascontiguousarray(g), dim),
                "push_sparse")
+
+    # -- checkpoint (checkpoint_notify / RequestCheckpoint analog) -----------
+    def save_checkpoint(self, path: str):
+        """Server serializes its shard (tables + optimizer state) to
+        `path` on ITS filesystem."""
+        _check(self._lib.pskv_save(self._fd, path.encode()),
+               "save_checkpoint")
+
+    def load_checkpoint(self, path: str):
+        _check(self._lib.pskv_load(self._fd, path.encode()),
+               "load_checkpoint")
 
     # -- control -------------------------------------------------------------
     def barrier(self):

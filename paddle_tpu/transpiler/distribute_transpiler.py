@@ -360,6 +360,21 @@ class PSPlan:
             scope.set_var(s.name, jnp.asarray(
                 w, dtype=scope.find_var(s.name).dtype))
 
+    def checkpoint_notify(self, dirname: str):
+        """Ask every pserver to snapshot its shard (tables + optimizer
+        state) under dirname/shard-<i>.pskv on the server's filesystem —
+        the reference's checkpoint_notify_op -> RequestCheckpoint flow."""
+        import os
+        for i, ep in enumerate(self.endpoints):
+            self._client(ep).save_checkpoint(
+                os.path.join(dirname, f"shard-{i}.pskv"))
+
+    def restore_notify(self, dirname: str):
+        import os
+        for i, ep in enumerate(self.endpoints):
+            self._client(ep).load_checkpoint(
+                os.path.join(dirname, f"shard-{i}.pskv"))
+
     def shutdown(self, stop_servers: bool = False):
         for ep, c in list(self._clients.items()):
             if stop_servers:

@@ -401,3 +401,17 @@ def test_amp_batch_norm_running_stats_stay_fp32():
             assert blk.var(n).dtype == "float32", (slot, n)
     # the conv activation input IS cast to bf16
     assert blk.var(bn.inputs["X"][0]).dtype == "bfloat16"
+
+
+def test_api_freeze():
+    """The public API must match tools/API.spec (reference: the
+    check_api_approvals.sh freeze); regenerate the spec deliberately when
+    changing signatures."""
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, os.path.join(repo, "tools",
+                                                     "diff_api.py")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout[-4000:]

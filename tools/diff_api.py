@@ -1,0 +1,36 @@
+"""API-freeze check (reference: tools/diff_api.py + check_api_approvals.sh):
+compares the live public API against tools/API.spec; exits 1 and prints
+the diff when signatures changed. Regenerate deliberately with
+`python tools/print_signatures.py > tools/API.spec`."""
+
+from __future__ import annotations
+
+import difflib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.print_signatures import iter_api  # noqa: E402
+
+SPEC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "API.spec")
+
+
+def main() -> int:
+    current = sorted(iter_api())
+    with open(SPEC) as f:
+        frozen = sorted(line.rstrip("\n") for line in f if line.strip())
+    if current == frozen:
+        print(f"API unchanged ({len(current)} signatures)")
+        return 0
+    diff = difflib.unified_diff(frozen, current, "API.spec", "current",
+                                lineterm="")
+    print("\n".join(diff))
+    print("\nAPI surface changed — if intentional, regenerate: "
+          "python tools/print_signatures.py > tools/API.spec")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

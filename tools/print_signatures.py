@@ -1,0 +1,78 @@
+"""Dump the public API surface as stable one-line signatures.
+
+Reference: tools/print_signatures.py — the input to the API-approval
+freeze check (tools/check_api_approvals.sh / diff_api.py): any change to
+a public signature must be deliberate and reviewed.
+
+Usage: python tools/print_signatures.py > tools/API.spec
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+import types
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.layers.distributions",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.nets",
+    "paddle_tpu.io",
+    "paddle_tpu.metrics",
+    "paddle_tpu.clip",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.reader",
+    "paddle_tpu.dataset",
+    "paddle_tpu.inference",
+    "paddle_tpu.profiler",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.contrib.slim",
+    "paddle_tpu.contrib.mixed_precision",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def iter_api():
+    import importlib
+
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for n in sorted(names):
+            obj = getattr(mod, n, None)
+            if obj is None or isinstance(obj, types.ModuleType):
+                continue
+            if inspect.isclass(obj):
+                yield f"{mod_name}.{n}{_sig(obj.__init__)}"
+                for m_name, m in sorted(vars(obj).items()):
+                    if m_name.startswith("_") or not callable(m):
+                        continue
+                    yield f"{mod_name}.{n}.{m_name}{_sig(m)}"
+            elif callable(obj):
+                yield f"{mod_name}.{n}{_sig(obj)}"
+
+
+def main():
+    for line in iter_api():
+        print(line)
+
+
+if __name__ == "__main__":
+    main()
