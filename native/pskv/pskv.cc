@@ -568,7 +568,18 @@ void handle_conn(Server* srv, int fd) {
           write_response(fd, kErr, nullptr, 0);
           continue;
         }
-        std::lock_guard<std::mutex> l(srv->tables_mu);
+        // snapshot the table lists under the global lock, then serialize
+        // each table under ITS OWN lock — a long checkpoint must not
+        // stall every other request behind tables_mu
+        std::vector<std::pair<std::string, DenseTable*>> dense_list;
+        std::vector<std::pair<std::string, SparseTable*>> sparse_list;
+        {
+          std::lock_guard<std::mutex> l(srv->tables_mu);
+          for (auto& kv : srv->dense)
+            dense_list.emplace_back(kv.first, kv.second.get());
+          for (auto& kv : srv->sparse)
+            sparse_list.emplace_back(kv.first, kv.second.get());
+        }
         auto wr = [&](const void* p, size_t n) {
           out.write(static_cast<const char*>(p), n);
         };
@@ -582,10 +593,10 @@ void handle_conn(Server* srv, int fd) {
           wr(&n, 8);
           wr(v.data(), n * 4);
         };
-        uint32_t nd = srv->dense.size();
+        uint32_t nd = dense_list.size();
         wr(&nd, 4);
-        for (auto& kv : srv->dense) {
-          DenseTable* t = kv.second.get();
+        for (auto& kv : dense_list) {
+          DenseTable* t = kv.second;
           std::lock_guard<std::mutex> tl(t->mu);
           wr_str(kv.first);
           wr(&t->opt, sizeof(OptConfig));
@@ -595,10 +606,10 @@ void handle_conn(Server* srv, int fd) {
           wr_vec(t->m1);
           wr_vec(t->m2);
         }
-        uint32_t ns = srv->sparse.size();
+        uint32_t ns = sparse_list.size();
         wr(&ns, 4);
-        for (auto& kv : srv->sparse) {
-          SparseTable* t = kv.second.get();
+        for (auto& kv : sparse_list) {
+          SparseTable* t = kv.second;
           std::lock_guard<std::mutex> tl(t->mu);
           wr_str(kv.first);
           wr(&t->dim, 8);
@@ -628,10 +639,11 @@ void handle_conn(Server* srv, int fd) {
           write_response(fd, kErr, nullptr, 0);
           continue;
         }
-        // every read is validated (gcount + sanity-bounded lengths): a
-        // truncated/corrupt file must answer kErr, never restore half a
-        // shard as success; tables are updated IN PLACE under their own
-        // mutexes so handlers holding table pointers never see a free
+        // STAGE the whole file first, COMMIT only if every read
+        // validated — a truncated/corrupt checkpoint must leave the
+        // live tables completely untouched; commits update tables in
+        // place under their own mutexes so handlers holding pointers
+        // never see a free
         bool ok = true;
         auto rd = [&](void* p, size_t n) {
           if (!ok) return false;
@@ -651,59 +663,49 @@ void handle_conn(Server* srv, int fd) {
           v->resize(n);
           rd(v->data(), n * 4);
         };
-        std::lock_guard<std::mutex> l(srv->tables_mu);
+        struct DenseStage {
+          std::string name;
+          OptConfig opt;
+          double b1, b2;
+          std::vector<float> value, m1, m2;
+        };
+        struct SparseStage {
+          std::string name;
+          uint64_t dim;
+          OptConfig opt;
+          double b1, b2;
+          uint64_t seed;
+          float init_scale;
+          std::unordered_map<int64_t, SparseRow> rows;
+        };
+        std::vector<DenseStage> dstage;
+        std::vector<SparseStage> sstage;
         uint32_t nd = 0;
         if (!rd(&nd, 4) || nd > (1u << 20)) ok = false;
         for (uint32_t i = 0; ok && i < nd; ++i) {
-          std::string name;
-          rd_str(&name);
-          OptConfig opt;
-          double b1 = 1.0, b2 = 1.0;
-          std::vector<float> value, m1, m2;
-          rd(&opt, sizeof(OptConfig));
-          rd(&b1, 8);
-          rd(&b2, 8);
-          rd_vec(&value);
-          rd_vec(&m1);
-          rd_vec(&m2);
-          if (!ok) break;
-          auto it = srv->dense.find(name);
-          DenseTable* t;
-          if (it == srv->dense.end()) {
-            auto nt = std::make_unique<DenseTable>();
-            t = nt.get();
-            srv->dense[name] = std::move(nt);
-          } else {
-            t = it->second.get();
-          }
-          std::lock_guard<std::mutex> tl(t->mu);
-          t->opt = opt;
-          t->beta1_pow = b1;
-          t->beta2_pow = b2;
-          t->value = std::move(value);
-          t->m1 = std::move(m1);
-          t->m2 = std::move(m2);
-          t->accum.assign(t->value.size(), 0.f);
+          DenseStage d;
+          rd_str(&d.name);
+          rd(&d.opt, sizeof(OptConfig));
+          rd(&d.b1, 8);
+          rd(&d.b2, 8);
+          rd_vec(&d.value);
+          rd_vec(&d.m1);
+          rd_vec(&d.m2);
+          if (ok) dstage.emplace_back(std::move(d));
         }
         uint32_t ns = 0;
         if (ok && (!rd(&ns, 4) || ns > (1u << 20))) ok = false;
         for (uint32_t i = 0; ok && i < ns; ++i) {
-          std::string name;
-          rd_str(&name);
-          uint64_t dim = 0;
-          OptConfig opt;
-          double b1 = 1.0, b2 = 1.0;
-          uint64_t seed = 0;
-          float init_scale = 0.f;
-          rd(&dim, 8);
-          rd(&opt, sizeof(OptConfig));
-          rd(&b1, 8);
-          rd(&b2, 8);
-          rd(&seed, 8);
-          rd(&init_scale, 4);
+          SparseStage sp;
+          rd_str(&sp.name);
+          rd(&sp.dim, 8);
+          rd(&sp.opt, sizeof(OptConfig));
+          rd(&sp.b1, 8);
+          rd(&sp.b2, 8);
+          rd(&sp.seed, 8);
+          rd(&sp.init_scale, 4);
           uint64_t nr = 0;
           if (!rd(&nr, 8) || nr > (1ull << 31)) { ok = false; break; }
-          std::unordered_map<int64_t, SparseRow> rows;
           for (uint64_t r = 0; ok && r < nr; ++r) {
             int64_t id = 0;
             rd(&id, 8);
@@ -711,29 +713,55 @@ void handle_conn(Server* srv, int fd) {
             rd_vec(&row.value);
             rd_vec(&row.m1);
             rd_vec(&row.m2);
-            if (ok) rows[id] = std::move(row);
+            if (ok) sp.rows[id] = std::move(row);
           }
-          if (!ok) break;
-          auto it = srv->sparse.find(name);
-          SparseTable* t;
-          if (it == srv->sparse.end()) {
-            auto nt = std::make_unique<SparseTable>();
+          if (ok) sstage.emplace_back(std::move(sp));
+        }
+        if (!ok) {
+          write_response(fd, kErr, nullptr, 0);
+          break;
+        }
+        std::lock_guard<std::mutex> l(srv->tables_mu);
+        for (auto& d : dstage) {
+          auto it = srv->dense.find(d.name);
+          DenseTable* t;
+          if (it == srv->dense.end()) {
+            auto nt = std::make_unique<DenseTable>();
             t = nt.get();
-            srv->sparse[name] = std::move(nt);
+            srv->dense[d.name] = std::move(nt);
           } else {
             t = it->second.get();
           }
           std::lock_guard<std::mutex> tl(t->mu);
-          t->dim = dim;
-          t->opt = opt;
-          t->beta1_pow = b1;
-          t->beta2_pow = b2;
-          t->seed = seed;
-          t->init_scale = init_scale;
-          t->rows = std::move(rows);
+          t->opt = d.opt;
+          t->beta1_pow = d.b1;
+          t->beta2_pow = d.b2;
+          t->value = std::move(d.value);
+          t->m1 = std::move(d.m1);
+          t->m2 = std::move(d.m2);
+          t->accum.assign(t->value.size(), 0.f);
+        }
+        for (auto& sp : sstage) {
+          auto it = srv->sparse.find(sp.name);
+          SparseTable* t;
+          if (it == srv->sparse.end()) {
+            auto nt = std::make_unique<SparseTable>();
+            t = nt.get();
+            srv->sparse[sp.name] = std::move(nt);
+          } else {
+            t = it->second.get();
+          }
+          std::lock_guard<std::mutex> tl(t->mu);
+          t->dim = sp.dim;
+          t->opt = sp.opt;
+          t->beta1_pow = sp.b1;
+          t->beta2_pow = sp.b2;
+          t->seed = sp.seed;
+          t->init_scale = sp.init_scale;
+          t->rows = std::move(sp.rows);
           t->accum.clear();
         }
-        write_response(fd, ok ? kOk : kErr, nullptr, 0);
+        write_response(fd, kOk, nullptr, 0);
         break;
       }
       case kBarrier: {

@@ -87,8 +87,10 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
-           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
-    """reference: layers/nn.py:2103."""
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    """reference: layers/nn.py:2103 (+ data_format NHWC, the TPU-preferred
+    layout; filter params stay OIHW either way)."""
     helper = LayerHelper("conv2d", name=name)
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
@@ -98,7 +100,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         padding = [padding, padding]
     if isinstance(dilation, int):
         dilation = [dilation, dilation]
-    c_in = int(input.shape[1])
+    c_axis = 3 if data_format == "NHWC" else 1
+    c_in = int(input.shape[c_axis])
     w_shape = [num_filters, c_in // groups] + list(filter_size)
     fan_in = (c_in // groups) * filter_size[0] * filter_size[1]
     std = (2.0 / fan_in) ** 0.5
@@ -110,11 +113,11 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                      {"Output": [out.name]},
                      {"strides": stride, "paddings": padding,
                       "dilations": dilation, "groups": groups,
-                      "data_format": "NCHW"})
+                      "data_format": data_format})
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
                                     is_bias=True)
-        out = helper.append_bias_op(out, b, dim_start=1)
+        out = helper.append_bias_op(out, b, dim_start=c_axis)
     return helper.append_activation(out, act)
 
 
@@ -149,7 +152,7 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, ceil_mode=False,
-           exclusive=True, name=None):
+           exclusive=True, name=None, data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     if isinstance(pool_size, int):
         pool_size = [pool_size, pool_size]
@@ -162,7 +165,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                      {"pooling_type": pool_type, "ksize": pool_size,
                       "strides": pool_stride, "paddings": pool_padding,
                       "global_pooling": global_pooling,
-                      "ceil_mode": ceil_mode, "exclusive": exclusive})
+                      "ceil_mode": ceil_mode, "exclusive": exclusive,
+                      "data_format": data_format})
     return out
 
 

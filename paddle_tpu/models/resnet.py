@@ -11,38 +11,49 @@ from __future__ import annotations
 from .. import layers
 
 
-def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+# data_format threads through every block: NHWC is the layout the TPU conv
+# engine wants (no relayout copies); NCHW stays the fluid-compatible default
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                  data_format="NCHW"):
     conv = layers.conv2d(input, ch_out, filter_size, stride=stride,
-                         padding=padding, bias_attr=False)
-    return layers.batch_norm(conv, act=act)
+                         padding=padding, bias_attr=False,
+                         data_format=data_format)
+    return layers.batch_norm(conv, act=act,
+                             data_layout=data_format)
 
 
-def shortcut(input, ch_in, ch_out, stride):
+def shortcut(input, ch_in, ch_out, stride, data_format="NCHW"):
     if ch_in != ch_out or stride != 1:
-        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None)
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             data_format=data_format)
     return input
 
 
-def basicblock(input, ch_in, ch_out, stride):
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
-    short = shortcut(input, ch_in, ch_out, stride)
+def basicblock(input, ch_in, ch_out, stride, data_format="NCHW"):
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None,
+                          data_format=data_format)
+    short = shortcut(input, ch_in, ch_out, stride, data_format)
     return layers.relu(short + conv2)
 
 
-def bottleneck(input, ch_in, ch_out, stride):
-    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
-    short = shortcut(input, ch_in, ch_out * 4, stride)
+def bottleneck(input, ch_in, ch_out, stride, data_format="NCHW"):
+    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1,
+                          data_format=data_format)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          data_format=data_format)
+    short = shortcut(input, ch_in, ch_out * 4, stride, data_format)
     return layers.relu(short + conv3)
 
 
-def _layer_stack(block, input, ch_in, ch_out, count, stride):
-    x = block(input, ch_in, ch_out, stride)
+def _layer_stack(block, input, ch_in, ch_out, count, stride,
+                 data_format="NCHW"):
+    x = block(input, ch_in, ch_out, stride, data_format)
     ch_in = ch_out * (4 if block is bottleneck else 1)
     for _ in range(1, count):
-        x = block(x, ch_in, ch_out, 1)
+        x = block(x, ch_in, ch_out, 1, data_format)
     return x
 
 
@@ -62,21 +73,24 @@ def resnet_cifar10(input, depth: int = 20, class_num: int = 10):
 _RESNET_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 
 
-def resnet(input, depth: int = 50, class_num: int = 1000):
-    """ImageNet-style ResNet-50/101/152 (bottleneck blocks, 224x224)."""
+def resnet(input, depth: int = 50, class_num: int = 1000,
+           data_format: str = "NCHW"):
+    """ImageNet-style ResNet-50/101/152 (bottleneck blocks, 224x224).
+    data_format NHWC expects input shaped [n, h, w, 3]."""
     c = _RESNET_CFG[depth]
-    x = conv_bn_layer(input, 64, 7, 2, 3)
-    x = layers.pool2d(x, 3, "max", 2, pool_padding=1)
-    x = _layer_stack(bottleneck, x, 64, 64, c[0], 1)
-    x = _layer_stack(bottleneck, x, 256, 128, c[1], 2)
-    x = _layer_stack(bottleneck, x, 512, 256, c[2], 2)
-    x = _layer_stack(bottleneck, x, 1024, 512, c[3], 2)
-    x = layers.pool2d(x, 7, "avg", 1)
+    x = conv_bn_layer(input, 64, 7, 2, 3, data_format=data_format)
+    x = layers.pool2d(x, 3, "max", 2, pool_padding=1,
+                      data_format=data_format)
+    x = _layer_stack(bottleneck, x, 64, 64, c[0], 1, data_format)
+    x = _layer_stack(bottleneck, x, 256, 128, c[1], 2, data_format)
+    x = _layer_stack(bottleneck, x, 512, 256, c[2], 2, data_format)
+    x = _layer_stack(bottleneck, x, 1024, 512, c[3], 2, data_format)
+    x = layers.pool2d(x, 7, "avg", 1, data_format=data_format)
     return layers.fc(x, class_num)
 
 
-def resnet50(input, class_num: int = 1000):
-    return resnet(input, 50, class_num)
+def resnet50(input, class_num: int = 1000, data_format: str = "NCHW"):
+    return resnet(input, 50, class_num, data_format)
 
 
 def image_classification_program(arch: str = "resnet_cifar10",

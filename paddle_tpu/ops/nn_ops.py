@@ -32,17 +32,27 @@ def _conv_padding(paddings, algo, ksize, dilations):
 
 @register_op("conv2d")
 def _conv2d(ctx, ins, attrs):
+    """Filter params are ALWAYS stored OIHW (layout-independent
+    checkpoints); with data_format NHWC — the layout the TPU's conv
+    engine prefers, no relayout copies around each conv — the filter
+    transposes to HWIO at trace time (free: folded into the conv)."""
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = tuple(attrs.get("strides", [1, 1]))
     dil = tuple(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
+    fmt = attrs.get("data_format", "NCHW")
     pad = _conv_padding(attrs.get("paddings", [0, 0]),
                         attrs.get("padding_algorithm", "EXPLICIT"),
                         w.shape[2:], dil)
+    if fmt == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        w = jnp.transpose(w, (2, 3, 1, 0))
+    else:
+        dn = ("NCHW", "OIHW", "NCHW")
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad, rhs_dilation=dil,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=dn,
         preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
     return {"Output": [out.astype(x.dtype)]}
 
@@ -51,7 +61,8 @@ def _conv2d(ctx, ins, attrs):
 def _depthwise_conv2d(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     attrs = dict(attrs)
-    attrs["groups"] = x.shape[1]
+    attrs["groups"] = x.shape[
+        3 if attrs.get("data_format", "NCHW") == "NHWC" else 1]
     return _conv2d(ctx, {"Input": [x], "Filter": [w]}, attrs)
 
 
@@ -101,27 +112,40 @@ def _conv3d(ctx, ins, attrs):
 def _pool2d(ctx, ins, attrs):
     x = ins["X"][0]
     ptype = attrs.get("pooling_type", "max")
+    fmt = attrs.get("data_format", "NCHW")
+    sp_axes = (1, 2) if fmt == "NHWC" else (2, 3)
     if attrs.get("global_pooling", False) or attrs.get("adaptive", False) \
             and tuple(attrs.get("ksize")) == (1, 1):
         if ptype == "max":
-            out = jnp.max(x, axis=(2, 3), keepdims=True)
+            out = jnp.max(x, axis=sp_axes, keepdims=True)
         else:
-            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+            out = jnp.mean(x, axis=sp_axes, keepdims=True)
         return {"Out": [out]}
     ksize = tuple(attrs["ksize"])
     strides = tuple(attrs.get("strides", ksize))
     p = attrs.get("paddings", [0, 0])
-    pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+
+    def _mk4(hpair, wpair):
+        if fmt == "NHWC":
+            return [(0, 0), hpair, wpair, (0, 0)]
+        return [(0, 0), (0, 0), hpair, wpair]
+
+    pads = _mk4((p[0], p[0]), (p[1], p[1]))
+    sp_dims = (x.shape[1], x.shape[2]) if fmt == "NHWC" \
+        else (x.shape[2], x.shape[3])
     if attrs.get("ceil_mode", False):
         extra = []
         for i, (dim, k, s, pp) in enumerate(
-                zip(x.shape[2:], ksize, strides, p)):
+                zip(sp_dims, ksize, strides, p)):
             rem = (dim + 2 * pp - k) % s
             extra.append((s - rem) % s if rem else 0)
-        pads = [(0, 0), (0, 0), (p[0], p[0] + extra[0]),
-                (p[1], p[1] + extra[1])]
-    window = (1, 1) + ksize
-    strides4 = (1, 1) + strides
+        pads = _mk4((p[0], p[0] + extra[0]), (p[1], p[1] + extra[1]))
+    if fmt == "NHWC":
+        window = (1,) + ksize + (1,)
+        strides4 = (1,) + strides + (1,)
+    else:
+        window = (1, 1) + ksize
+        strides4 = (1, 1) + strides
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min

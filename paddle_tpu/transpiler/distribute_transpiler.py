@@ -265,6 +265,7 @@ class PSPlan:
         self._inited = False
         self._lock = threading.Lock()
         self._last_lr: Dict[str, float] = {}
+        self._communicator = None
 
     # names the executor must additionally fetch each step
     def extra_fetches(self) -> List[str]:
@@ -328,30 +329,58 @@ class PSPlan:
             scope.set_var(s.name, w.at[jnp.asarray(ids)].set(
                 jnp.asarray(rows, dtype=w.dtype)))
 
+    def start_communicator(self, scope, **kw):
+        """Async mode: route gradient pushes through a background
+        Communicator (reference communicator.h) so the step never blocks
+        on the network; a recv thread refreshes dense params."""
+        from ..distributed.communicator import Communicator
+        self.ensure_init(scope)
+        self._communicator = Communicator(self, scope, **kw)
+        self._communicator.start()
+        return self._communicator
+
+    def _marshal_grad(self, spec, g):
+        """One representation for both send paths: sparse specs yield an
+        (int64 rows, float32 vals) pair — densified grads fall back to
+        full-table rows — dense specs a float32 ndarray."""
+        from ..framework.selected_rows import SelectedRows
+        if spec.sparse:
+            if isinstance(g, SelectedRows):
+                return (np.asarray(g.rows, np.int64),
+                        np.asarray(g.values, np.float32))
+            return (np.arange(spec.shape[0]),
+                    np.asarray(g, np.float32).reshape(spec.shape))
+        return np.asarray(g, np.float32)
+
+    def _sync_lr(self, spec, fetched):
+        lr = float(np.ravel(np.asarray(fetched[spec.lr_var]))[0])
+        if self._last_lr.get(spec.name) != lr:
+            self._client(spec.endpoint).set_lr(spec.name, lr)
+            self._last_lr[spec.name] = lr
+
     def after_step(self, scope, fetched: Dict[str, object]):
         """Push grads (optimizer runs server-side), pull updated dense
         params. Sync mode's push blocks until all trainers contributed —
         the send_barrier/fetch_barrier of the reference collapsed into the
-        aggregation round."""
+        aggregation round. With a Communicator, pushes are queued and this
+        returns immediately."""
         import jax.numpy as jnp
-        from ..framework.selected_rows import SelectedRows
+        if self._communicator is not None:
+            grads = {}
+            for s in self.specs:
+                self._sync_lr(s, fetched)
+                grads[s.grad_name] = self._marshal_grad(
+                    s, fetched[s.grad_name])
+            self._communicator.push(grads)
+            return
         for s in self.specs:
+            self._sync_lr(s, fetched)
+            g = self._marshal_grad(s, fetched[s.grad_name])
             c = self._client(s.endpoint)
-            lr = float(np.ravel(np.asarray(fetched[s.lr_var]))[0])
-            if self._last_lr.get(s.name) != lr:
-                c.set_lr(s.name, lr)
-                self._last_lr[s.name] = lr
-            g = fetched[s.grad_name]
             if s.sparse:
-                if isinstance(g, SelectedRows):
-                    rows = np.asarray(g.rows, np.int64)
-                    vals = np.asarray(g.values, np.float32)
-                else:  # densified fallback
-                    rows = np.arange(s.shape[0])
-                    vals = np.asarray(g, np.float32)
-                c.push_sparse(s.name, rows, vals)
+                c.push_sparse(s.name, g[0], g[1])
             else:
-                c.push_dense(s.name, np.asarray(g, np.float32))
+                c.push_dense(s.name, g)
         for s in self.specs:
             if s.sparse:
                 continue
@@ -369,13 +398,28 @@ class PSPlan:
             self._client(ep).save_checkpoint(
                 os.path.join(dirname, f"shard-{i}.pskv"))
 
-    def restore_notify(self, dirname: str):
+    def restore_notify(self, dirname: str, scope=None):
+        """Restore every pserver shard; with `scope`, also refresh the
+        trainer's dense params from the restored tables (otherwise the
+        local params silently stay at their startup values until the
+        first after_step pull)."""
         import os
         for i, ep in enumerate(self.endpoints):
             self._client(ep).load_checkpoint(
                 os.path.join(dirname, f"shard-{i}.pskv"))
+        if scope is not None:
+            import jax.numpy as jnp
+            for s in self.specs:
+                if s.sparse:
+                    continue
+                w = self._client(s.endpoint).pull_dense(
+                    s.name, s.size).reshape(s.shape)
+                scope.set_var(s.name, jnp.asarray(w))
 
     def shutdown(self, stop_servers: bool = False):
+        if self._communicator is not None:
+            self._communicator.stop()
+            self._communicator = None
         for ep, c in list(self._clients.items()):
             if stop_servers:
                 try:

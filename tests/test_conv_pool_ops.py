@@ -206,3 +206,34 @@ class TestGlobalPool(OpTest):
 
     def test_grad(self):
         self.check_grad(["X_in"], "Out_out")
+
+
+def test_conv_pool_bn_nhwc_matches_nchw():
+    """data_format=NHWC must be numerically identical to NCHW (params are
+    stored OIHW in both layouts)."""
+    import paddle_tpu as pt
+    rng = np.random.RandomState(0)
+    x_nchw = rng.randn(2, 3, 10, 10).astype(np.float32)
+
+    def run(fmt):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            shape = [3, 10, 10] if fmt == "NCHW" else [10, 10, 3]
+            img = pt.layers.data("img", shape, dtype="float32")
+            h = pt.layers.conv2d(img, 4, 3, padding=1, bias_attr=False,
+                                 data_format=fmt)
+            h = pt.layers.batch_norm(h, act="relu", data_layout=fmt)
+            h = pt.layers.pool2d(h, 2, "max", 2, data_format=fmt)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            w = rng2 = np.random.RandomState(7).randn(4, 3, 3, 3).astype(
+                np.float32)
+            scope.set_var("conv2d_0.w_0", w)
+            feed = x_nchw if fmt == "NCHW" else x_nchw.transpose(0, 2, 3, 1)
+            (out,) = exe.run(main, feed={"img": feed}, fetch_list=[h])
+        return out if fmt == "NCHW" else out.transpose(0, 3, 1, 2)
+
+    np.testing.assert_allclose(run("NHWC"), run("NCHW"), rtol=1e-4,
+                               atol=1e-5)
