@@ -17,7 +17,7 @@ __all__ = ["einsum", "elementwise_add", "elementwise_sub", "elementwise_mul",
            "reduce_all", "reduce_any", "clip", "clip_by_norm", "mean",
            "l2_normalize", "equal", "not_equal", "less_than", "less_equal",
            "greater_than", "greater_equal", "logical_and", "logical_or",
-           "logical_not", "logical_xor", "isfinite", "cumsum"]
+           "logical_not", "logical_xor", "isfinite", "cumsum", "tril", "triu"]
 
 
 def _to_variable(x, ref: Variable):
@@ -274,4 +274,22 @@ def isfinite(x, name=None):
     out = helper.create_variable_for_type_inference("bool",
                                                     stop_gradient=True)
     helper.append_op("isfinite", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def tril(x, diagonal=0, name=None):
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("tril", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", {"X": [x.name]}, {"Out": [out.name]},
+                     {"diagonal": diagonal, "lower": True})
+    return out
+
+
+def triu(x, diagonal=0, name=None):
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("triu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", {"X": [x.name]}, {"Out": [out.name]},
+                     {"diagonal": diagonal, "lower": False})
     return out

@@ -23,7 +23,9 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
            "square_error_cost", "huber_loss", "kldiv_loss", "smooth_l1",
            "accuracy", "topk", "one_hot", "lrn", "prelu", "mse_loss",
            "label_smooth", "fused_attention", "warpctc",
-           "linear_chain_crf", "crf_decoding", "nce", "hsigmoid"]
+           "linear_chain_crf", "crf_decoding", "nce", "hsigmoid",
+           "log_loss", "cos_sim", "resize_bilinear", "resize_nearest",
+           "add_position_encoding"]
 
 
 # ---------------------------------------------------------------------------
@@ -632,3 +634,64 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     helper.append_op("hierarchical_sigmoid", ins, {"Cost": [cost.name]},
                      {"num_classes": num_classes})
     return cost
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """reference: layers/nn.py log_loss — binary cross-entropy on
+    probabilities."""
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_loss",
+                     {"Predicted": [input.name], "Labels": [label.name]},
+                     {"Loss": [out.name]}, {"epsilon": epsilon})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    """reference: layers/nn.py cos_sim."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", {"X": [X.name], "Y": [Y.name]},
+                     {"Out": [out.name], "XNorm": [xn.name],
+                      "YNorm": [yn.name]})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,
+                    name=None):
+    """reference: layers/nn.py resize_bilinear."""
+    helper = LayerHelper("resize_bilinear", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("bilinear_interp", {"X": [input.name]},
+                     {"Out": [out.name]}, attrs)
+    return out
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True,
+                   name=None):
+    helper = LayerHelper("resize_nearest", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("nearest_interp", {"X": [input.name]},
+                     {"Out": [out.name]}, attrs)
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """reference: layers/nn.py add_position_encoding (sinusoidal PE)."""
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("add_position_encoding", {"X": [input.name]},
+                     {"Out": [out.name]}, {"alpha": alpha, "beta": beta})
+    return out

@@ -2,3 +2,5 @@ from . import lenet  # noqa: F401
 from . import book  # noqa: F401
 from . import resnet  # noqa: F401
 from . import vgg  # noqa: F401
+from . import deepfm  # noqa: F401
+from . import transformer  # noqa: F401

@@ -13,3 +13,4 @@ from . import collective_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
+from . import misc_ops  # noqa: F401

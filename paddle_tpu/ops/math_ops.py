@@ -303,3 +303,13 @@ def _isfinite(ctx, ins, attrs):
     """reference: operators/isfinite_op.cc — nan/inf sanitizer primitive."""
     x = ins["X"][0]
     return {"Out": [jnp.all(jnp.isfinite(x)).reshape((1,))]}
+
+
+@register_op("tril_triu")
+def _tril_triu(ctx, ins, attrs):
+    """reference: operators/tril_triu_op.cc."""
+    x = ins["X"][0]
+    k = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": [jnp.tril(x, k)]}
+    return {"Out": [jnp.triu(x, k)]}

@@ -1,0 +1,140 @@
+"""Long-tail ops vs numpy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework.registry import get_op_def, LowerContext
+import jax.numpy as jnp
+
+
+def _run(op_type, ins, attrs, outs):
+    r = get_op_def(op_type).lower(
+        LowerContext(), {k: [jnp.asarray(v) for v in vs]
+                         for k, vs in ins.items()}, attrs)
+    return [np.asarray(r[o][0]) for o in outs]
+
+
+def test_pixel_shuffle_space_to_depth_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8, 4, 4).astype(np.float32)
+    up, = _run("pixel_shuffle", {"X": [x]}, {"upscale_factor": 2}, ["Out"])
+    assert up.shape == (2, 2, 8, 8)
+    x2 = rng.randn(2, 2, 8, 8).astype(np.float32)
+    dn, = _run("space_to_depth", {"X": [x2]}, {"blocksize": 2}, ["Out"])
+    assert dn.shape == (2, 8, 4, 4)
+
+
+def test_bilinear_interp_resize():
+    import jax
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out, = _run("bilinear_interp", {"X": [x]},
+                {"out_h": 8, "out_w": 8}, ["Out"])
+    assert out.shape == (1, 1, 8, 8)
+    ref = np.asarray(jax.image.resize(jnp.asarray(x), (1, 1, 8, 8),
+                                      "bilinear"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    assert (np.diff(out[0, 0, 0]) >= -1e-5).all()
+
+
+def test_unfold_asymmetric_padding():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    y, = _run("unfold", {"X": [x]},
+              {"kernel_sizes": [2, 2], "strides": [2, 2],
+               "paddings": [0, 1, 0, 1], "dilations": [1, 1]},
+              ["Y"])  # pad left/ right of width by 1 -> out_w = 2
+    assert y.shape == (1, 4, 2)
+
+
+def test_shuffle_channel_permutation():
+    x = np.arange(2 * 6 * 1 * 1, dtype=np.float32).reshape(2, 6, 1, 1)
+    out, = _run("shuffle_channel", {"X": [x]}, {"group": 2}, ["Out"])
+    np.testing.assert_array_equal(out[0, :, 0, 0], [0, 3, 1, 4, 2, 5])
+
+
+def test_unfold_shapes_and_values():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    y, = _run("unfold", {"X": [x]},
+              {"kernel_sizes": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0], "dilations": [1, 1]}, ["Y"])
+    assert y.shape == (1, 4, 4)
+    np.testing.assert_array_equal(y[0, :, 0], [0, 1, 4, 5])
+
+
+def test_norm_and_cos_sim():
+    x = np.array([[3.0, 4.0]], np.float32)
+    out, n = _run("norm", {"X": [x]}, {"axis": -1}, ["Out", "Norm"])
+    np.testing.assert_allclose(n[0, 0], 5.0, rtol=1e-5)
+    np.testing.assert_allclose(out, [[0.6, 0.8]], rtol=1e-5)
+    y = np.array([[4.0, 3.0]], np.float32)
+    sim, _, _ = _run("cos_sim", {"X": [x], "Y": [y]}, {},
+                     ["Out", "XNorm", "YNorm"])
+    np.testing.assert_allclose(sim[0, 0], 24.0 / 25.0, rtol=1e-5)
+
+
+def test_linalg_helpers():
+    a = np.arange(9, dtype=np.float32).reshape(3, 3)
+    tr, = _run("trace", {"Input": [a]}, {}, ["Out"])
+    assert tr == 12.0
+    d, = _run("dist", {"X": [a], "Y": [np.zeros_like(a)]}, {"p": 2.0},
+              ["Out"])
+    np.testing.assert_allclose(d[0], np.sqrt((a ** 2).sum()), rtol=1e-5)
+    k, = _run("kron", {"X": [np.eye(2, dtype=np.float32)],
+                       "Y": [np.ones((2, 2), np.float32)]}, {}, ["Out"])
+    assert k.shape == (4, 4) and k[0, 0] == 1 and k[0, 2] == 0
+    btp, = _run("bilinear_tensor_product",
+                {"X": [np.ones((2, 3), np.float32)],
+                 "Y": [np.ones((2, 4), np.float32)],
+                 "Weight": [np.ones((5, 3, 4), np.float32)]}, {}, ["Out"])
+    np.testing.assert_allclose(btp, 12.0)
+
+
+def test_ranking_losses():
+    lab = np.array([[1.0]], np.float32)
+    rl, = _run("rank_loss", {"Label": [lab], "Left": [np.array([[2.0]],
+               np.float32)], "Right": [np.array([[0.0]], np.float32)]},
+               {}, ["Out"])
+    np.testing.assert_allclose(rl[0, 0], np.log1p(np.exp(2.0)) - 2.0,
+                               rtol=1e-5)
+    hl, = _run("hinge_loss", {"Logits": [np.array([[0.5]], np.float32)],
+                              "Labels": [lab]}, {}, ["Loss"])
+    np.testing.assert_allclose(hl[0, 0], 0.5, rtol=1e-5)
+    ll, = _run("log_loss", {"Predicted": [np.array([[0.8]], np.float32)],
+                            "Labels": [lab]}, {"epsilon": 0.0}, ["Loss"])
+    np.testing.assert_allclose(ll[0, 0], -np.log(0.8), rtol=1e-5)
+    x = np.array([[1.0, 3.0, 2.0]], np.float32)
+    bpr, = _run("bpr_loss", {"X": [x],
+                             "Label": [np.array([[1]], np.int64)]},
+                {}, ["Y"])
+    assert bpr.shape == (1, 1) and bpr[0, 0] > 0
+
+
+def test_shard_index():
+    x = np.array([[1], [7], [13]], np.int64)
+    out, = _run("shard_index", {"X": [x]},
+                {"index_num": 20, "nshards": 2, "shard_id": 0,
+                 "ignore_value": -1}, ["Out"])
+    np.testing.assert_array_equal(out, [[1], [7], [-1]])
+
+
+def test_gather_tree():
+    # t=3, b=1, beam=2
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    out, = _run("gather_tree", {"Ids": [ids], "Parents": [parents]}, {},
+                ["Out"])
+    # beam 0 at t2 came from parent 1 at t1 (id 4), which came from 0 (1)
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_add_position_encoding_and_temporal_shift():
+    x = np.zeros((1, 4, 8), np.float32)
+    out, = _run("add_position_encoding", {"X": [x]},
+                {"alpha": 1.0, "beta": 1.0}, ["Out"])
+    np.testing.assert_allclose(out[0, 0, 0], 0.0, atol=1e-6)  # sin(0)
+    np.testing.assert_allclose(out[0, 0, 4], 1.0, atol=1e-6)  # cos(0)
+    ts_in = np.arange(4 * 4 * 1 * 1, dtype=np.float32).reshape(4, 4, 1, 1)
+    ts, = _run("temporal_shift", {"X": [ts_in]},
+               {"seg_num": 2, "shift_ratio": 0.25}, ["Out"])
+    assert ts.shape == ts_in.shape
