@@ -32,6 +32,9 @@ from . import dataset
 from .dataset import DatasetFactory
 from . import inference
 from . import nets
+from .data_feeder import DataFeeder
+from .reader.py_reader import PyReader
+from .framework import debugger
 from . import utils
 from . import reader
 from . import datasets

@@ -1,0 +1,86 @@
+"""Program introspection: readable text dumps + graphviz export.
+
+Reference: python/paddle/fluid/debugger.py (program pretty-printer) and
+ir/graph_viz_pass.cc (the GraphvizPass behind
+BuildStrategy.debug_graphviz_path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .core import Parameter, Program
+
+__all__ = ["program_to_code", "draw_program_graphviz"]
+
+
+def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
+    """Readable text form of every block (reference debugger.py
+    pprint_program_codes)."""
+    lines = []
+    for blk in program.blocks:
+        lines.append(f"// block {blk.idx} (parent {blk.parent_idx})")
+        for v in blk.vars.values():
+            kind = "param" if isinstance(v, Parameter) else (
+                "data" if v.is_data else
+                ("persist" if v.persistable else "var"))
+            extra = " [selected_rows]" if v.type == "selected_rows" else ""
+            lines.append(f"  {kind} {v.name}: {v.dtype}{list(v.shape or [])}"
+                         f"{extra}")
+        for i, op in enumerate(blk.ops):
+            ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items() if v)
+            outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items() if v)
+            attrs = {k: v for k, v in op.attrs.items()
+                     if k not in ("op_role",)}
+            role = op.attrs.get("op_role", "forward")
+            lines.append(f"  [{i}] {op.type}({ins}) -> {outs}"
+                         f"  // {role} {attrs if attrs else ''}".rstrip())
+    return "\n".join(lines)
+
+
+def draw_program_graphviz(program: Program,
+                          path: Optional[str] = None) -> str:
+    """Graphviz dot source for block 0's dataflow (the graph_viz_pass
+    analog). Ops are boxes, vars are ellipses (params shaded); returns the
+    dot text and optionally writes it to `path` for
+    `dot -Tpdf program.dot -o program.pdf`."""
+    blk = program.global_block
+    out = ["digraph Program {", "  rankdir=TB;",
+           '  node [fontsize=10, fontname="Courier"];']
+    seen_vars = set()
+
+    def var_node(name: str) -> str:
+        nid = f"var_{name}".replace("@", "_").replace("/", "_").replace(
+            ".", "_")
+        if name not in seen_vars:
+            seen_vars.add(name)
+            style = ""
+            try:
+                v = blk.var(name)
+                if isinstance(v, Parameter):
+                    style = ', style=filled, fillcolor="lightblue"'
+                elif v.persistable:
+                    style = ', style=filled, fillcolor="lightgrey"'
+            except KeyError:
+                pass
+            out.append(f'  {nid} [label="{name}", shape=ellipse{style}];')
+        return nid
+
+    for i, op in enumerate(blk.ops):
+        op_id = f"op_{i}"
+        role = op.attrs.get("op_role", "forward")
+        color = {"forward": "white", "backward": "lightyellow",
+                 "optimize": "lightpink"}.get(role, "white")
+        out.append(f'  {op_id} [label="{i}: {op.type}", shape=box, '
+                   f'style=filled, fillcolor="{color}"];')
+        for n in op.input_names():
+            out.append(f"  {var_node(n)} -> {op_id};")
+        for n in op.output_names():
+            if n:
+                out.append(f"  {op_id} -> {var_node(n)};")
+    out.append("}")
+    dot = "\n".join(out)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

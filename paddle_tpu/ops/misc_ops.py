@@ -106,10 +106,10 @@ def _unfold(ctx, ins, attrs):
 @register_op("im2sequence")
 def _im2sequence(ctx, ins, attrs):
     """reference im2sequence_op.cc: sliding patches flattened to a
-    sequence [n, out_h*out_w, c*kh*kw]; paddings order is the op's
-    [up, down, left, right]."""
+    sequence [n, out_h*out_w, c*kh*kw]; paddings order matches unfold's
+    [up, left, down, right]."""
     p = list(attrs.get("paddings", [0, 0, 0, 0]))
-    pad_pairs = [(p[0], p[1]), (p[2], p[3])]
+    pad_pairs = [(p[0], p[2]), (p[1], p[3])]
     y = _patches(ins["X"][0], attrs["kernels"],
                  attrs.get("strides", [1, 1]), pad_pairs, [1, 1])
     return {"Out": [jnp.swapaxes(y, 1, 2)]}
@@ -124,9 +124,12 @@ def _add_position_encoding(ctx, ins, attrs):
     beta = attrs.get("beta", 1.0)
     b, s, d = x.shape
     pos = jnp.arange(s, dtype=jnp.float32)[:, None]
-    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
-    angle = pos / jnp.power(10000.0, 2 * i / d)
-    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    half_sin = (d + 1) // 2            # odd d: sin half gets the extra col
+    i_sin = jnp.arange(half_sin, dtype=jnp.float32)[None, :]
+    i_cos = jnp.arange(d - half_sin, dtype=jnp.float32)[None, :]
+    pe = jnp.concatenate(
+        [jnp.sin(pos / jnp.power(10000.0, 2 * i_sin / d)),
+         jnp.cos(pos / jnp.power(10000.0, 2 * i_cos / d))], axis=1)
     return {"Out": [alpha * x + beta * pe[None, :, :].astype(x.dtype)]}
 
 
@@ -262,9 +265,12 @@ def _bpr_loss(ctx, ins, attrs):
     """Bayesian personalized ranking (reference bpr_loss_op.cc)."""
     x = ins["X"][0]                       # [b, c] scores
     label = ins["Label"][0].reshape(-1)   # positive item per row
+    c = x.shape[1]
     pos = jnp.take_along_axis(x, label[:, None], axis=1)
-    diff = pos - x
-    loss = -jnp.mean(jax.nn.log_sigmoid(diff), axis=1, keepdims=True)
+    lsm = jax.nn.log_sigmoid(pos - x)
+    # exclude the positive column itself; average over the c-1 negatives
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    loss = -jnp.sum(lsm * mask, axis=1, keepdims=True) / float(c - 1)
     return {"Y": [loss]}
 
 

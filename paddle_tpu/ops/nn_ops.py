@@ -488,17 +488,55 @@ def _accuracy(ctx, ins, attrs):
 # resize / interpolate
 # ---------------------------------------------------------------------------
 
+def _interp_out_hw(x, attrs):
+    oh = attrs.get("out_h", 0)
+    ow = attrs.get("out_w", 0)
+    if (not oh or not ow) and attrs.get("scale", 0.0):
+        oh = int(x.shape[2] * attrs["scale"])
+        ow = int(x.shape[3] * attrs["scale"])
+    if not oh or not ow:
+        raise ValueError("interp op needs out_h/out_w or scale")
+    return oh, ow
+
+
+def _interp_coords(in_dim, out_dim, align_corners):
+    if align_corners and out_dim > 1:
+        return jnp.linspace(0.0, in_dim - 1.0, out_dim)
+    # half-pixel centers (the reference's align_corners=False mapping)
+    return jnp.clip((jnp.arange(out_dim) + 0.5) * (in_dim / out_dim) - 0.5,
+                    0, in_dim - 1)
+
+
 @register_op("nearest_interp")
 def _nearest_interp(ctx, ins, attrs):
     x = ins["X"][0]
-    oh, ow = attrs["out_h"], attrs["out_w"]
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), "nearest")
-    return {"Out": [out]}
+    oh, ow = _interp_out_hw(x, attrs)
+    ac = attrs.get("align_corners", True)
+    ih = jnp.round(_interp_coords(x.shape[2], oh, ac)).astype(jnp.int32) \
+        if ac else (jnp.arange(oh) * (x.shape[2] / oh)).astype(jnp.int32)
+    iw = jnp.round(_interp_coords(x.shape[3], ow, ac)).astype(jnp.int32) \
+        if ac else (jnp.arange(ow) * (x.shape[3] / ow)).astype(jnp.int32)
+    return {"Out": [x[:, :, ih][:, :, :, iw]]}
 
 
 @register_op("bilinear_interp")
 def _bilinear_interp(ctx, ins, attrs):
     x = ins["X"][0]
-    oh, ow = attrs["out_h"], attrs["out_w"]
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), "bilinear")
-    return {"Out": [out]}
+    oh, ow = _interp_out_hw(x, attrs)
+    ac = attrs.get("align_corners", True)
+    h, w = x.shape[2], x.shape[3]
+    ys = _interp_coords(h, oh, ac)
+    xs = _interp_coords(w, ow, ac)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    ly = (ys - y0)[None, None, :, None]
+    lx = (xs - x0)[None, None, None, :]
+    v00 = x[:, :, y0][:, :, :, x0]
+    v01 = x[:, :, y0][:, :, :, x1]
+    v10 = x[:, :, y1][:, :, :, x0]
+    v11 = x[:, :, y1][:, :, :, x1]
+    out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+           + v10 * ly * (1 - lx) + v11 * ly * lx)
+    return {"Out": [out.astype(x.dtype)]}

@@ -3,7 +3,8 @@
 from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa: F401
                         firstn, xmap_readers, cache, batch,
                         multiprocess_reader)
+from .py_reader import PyReader  # noqa: F401
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch",
-           "multiprocess_reader"]
+           "multiprocess_reader", "PyReader"]

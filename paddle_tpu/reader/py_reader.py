@@ -1,0 +1,121 @@
+"""PyReader: python-generator-fed input pipeline.
+
+Reference: python/paddle/fluid/reader.py:47 PyReader — a generator feeds a
+LoDTensorBlockingQueue consumed by an in-graph read op. TPU redesign: the
+executor feeds whole batches into one jitted step, so PyReader here is the
+ITERABLE form (the reference's iterable=True mode): it wraps the decorated
+generator with a background prefetch queue (the buffered_reader /
+double-buffering analog) and yields ready feed dicts.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..data_feeder import DataFeeder
+
+__all__ = ["PyReader"]
+
+
+class PyReader:
+    def __init__(self, feed_list: Sequence, capacity: int = 4,
+                 iterable: bool = True, return_list: bool = False):
+        if not iterable:
+            raise NotImplementedError(
+                "non-iterable PyReader (in-graph read op) does not exist in "
+                "the one-jitted-step execution model; iterate feed dicts")
+        self._feeder = DataFeeder(feed_list)
+        self._names = [v.name for v in self._feeder.feed_vars]
+        self._capacity = capacity
+        self._return_list = return_list
+        self._source = None
+        self._mode = None
+
+    # -- decoration (reference API) ------------------------------------------
+    def decorate_sample_list_generator(self, reader, places=None):
+        """reader() yields lists of samples (one minibatch per item)."""
+        self._source = reader
+        self._mode = "sample_list"
+
+    def decorate_batch_generator(self, reader, places=None):
+        """reader() yields ready feed batches: dicts, or tuples of arrays
+        in feed_list order."""
+        self._source = reader
+        self._mode = "batch"
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        from .decorator import batch as _batch
+        self._source = _batch(sample_generator, batch_size,
+                              drop_last=drop_last)
+        self._mode = "sample_list"
+
+    # -- iteration -----------------------------------------------------------
+    def _to_feed(self, item) -> Dict[str, np.ndarray]:
+        if self._mode == "sample_list":
+            return self._feeder.feed(item)
+        if isinstance(item, dict):
+            return item
+        return dict(zip(self._names, item))
+
+    def __iter__(self):
+        if self._source is None:
+            raise RuntimeError("call decorate_*_generator first")
+
+        class _End:
+            pass
+
+        class _Raise:
+            def __init__(self, exc):
+                self.exc = exc
+
+        q: _queue.Queue = _queue.Queue(self._capacity)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # bounded put that aborts when the consumer stopped iterating
+            # (early break/exception) — a blocked q.put would pin the
+            # thread, the queue, and the source generator forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def fill():
+            try:
+                for item in self._source():
+                    if not _put(self._to_feed(item)):
+                        return
+                _put(_End)
+            except BaseException as e:
+                _put(_Raise(e))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _End:
+                    return
+                if isinstance(item, _Raise):
+                    raise item.exc
+                if self._return_list:
+                    yield [item[n] for n in self._names]
+                else:
+                    yield item
+        finally:
+            stop.set()
+
+    # reference parity no-ops (queue lifecycle is per-iteration here)
+    def start(self):
+        pass
+
+    def reset(self):
+        pass

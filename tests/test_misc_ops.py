@@ -28,13 +28,18 @@ def test_pixel_shuffle_space_to_depth_roundtrip():
 def test_bilinear_interp_resize():
     import jax
     x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # half-pixel mode matches jax.image.resize's bilinear exactly
     out, = _run("bilinear_interp", {"X": [x]},
-                {"out_h": 8, "out_w": 8}, ["Out"])
+                {"out_h": 8, "out_w": 8, "align_corners": False}, ["Out"])
     assert out.shape == (1, 1, 8, 8)
     ref = np.asarray(jax.image.resize(jnp.asarray(x), (1, 1, 8, 8),
                                       "bilinear"))
     np.testing.assert_allclose(out, ref, rtol=1e-5)
-    assert (np.diff(out[0, 0, 0]) >= -1e-5).all()
+    # align_corners=True pins the exact corner values
+    ac, = _run("bilinear_interp", {"X": [x]},
+               {"out_h": 8, "out_w": 8, "align_corners": True}, ["Out"])
+    np.testing.assert_allclose(ac[0, 0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(ac[0, 0, -1, -1], 15.0, atol=1e-5)
 
 
 def test_unfold_asymmetric_padding():
@@ -138,3 +143,34 @@ def test_add_position_encoding_and_temporal_shift():
     ts, = _run("temporal_shift", {"X": [ts_in]},
                {"seg_num": 2, "shift_ratio": 0.25}, ["Out"])
     assert ts.shape == ts_in.shape
+
+
+def test_add_position_encoding_odd_dim():
+    x = np.zeros((1, 3, 7), np.float32)
+    out, = _run("add_position_encoding", {"X": [x]},
+                {"alpha": 1.0, "beta": 1.0}, ["Out"])
+    assert out.shape == (1, 3, 7)
+    np.testing.assert_allclose(out[0, 0, 4], 1.0, atol=1e-6)  # cos(0)
+
+
+def test_bpr_loss_excludes_positive():
+    # two classes, score equal: only the single negative contributes
+    x = np.array([[2.0, 2.0]], np.float32)
+    loss, = _run("bpr_loss", {"X": [x],
+                              "Label": [np.array([[0]], np.int64)]},
+                 {}, ["Y"])
+    np.testing.assert_allclose(loss[0, 0], np.log(2.0), rtol=1e-5)
+
+
+def test_resize_scale_and_align_corners():
+    import paddle_tpu as pt
+    x = np.arange(20, dtype=np.float32).reshape(1, 1, 4, 5)
+    out, = _run("bilinear_interp", {"X": [x]},
+                {"scale": 2.0, "align_corners": True}, ["Out"])
+    assert out.shape == (1, 1, 8, 10)
+    np.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, -1, -1], 19.0, atol=1e-5)
+    nn, = _run("nearest_interp", {"X": [x]},
+               {"out_h": 2, "out_w": 2, "align_corners": True}, ["Out"])
+    # align_corners nearest samples rows [0, 3], cols [0, 4]
+    np.testing.assert_array_equal(nn[0, 0], [[0, 4], [15, 19]])
