@@ -28,6 +28,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -257,6 +258,9 @@ struct Server {
   int listen_fd = -1;
   uint32_t trainers = 1;
   bool sync = true;
+  // sync aggregation timeout: a crashed trainer must not hang the other
+  // trainers' pushes forever (failure detection; 0 = wait indefinitely)
+  int64_t sync_timeout_ms = 0;
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::vector<std::thread> conns;
@@ -376,6 +380,7 @@ void handle_conn(Server* srv, int fd) {
           for (size_t i = 0; i < n; ++i) t->accum[i] += grad[i];
           t->count++;
           uint64_t my_round = t->round_id;
+          bool timed_out = false;
           if (t->count == srv->trainers) {
             // mean of trainer grads -> same trajectory as local training
             apply_dense(t, t->accum.data(), 1.0f / srv->trainers);
@@ -383,10 +388,22 @@ void handle_conn(Server* srv, int fd) {
             t->count = 0;
             t->round_id++;
             t->cv.notify_all();
+          } else if (srv->sync_timeout_ms > 0) {
+            timed_out = !t->cv.wait_for(
+                l, std::chrono::milliseconds(srv->sync_timeout_ms), [&] {
+                  return t->round_id != my_round || srv->stop.load();
+                });
           } else {
             t->cv.wait(l, [&] {
               return t->round_id != my_round || srv->stop.load();
             });
+          }
+          if (timed_out) {
+            // undo this trainer's contribution so a retry can't double it
+            for (size_t i = 0; i < n; ++i) t->accum[i] -= grad[i];
+            t->count--;
+            write_response(fd, kErr, nullptr, 0);
+            continue;
           }
         }
         write_response(fd, kOk, nullptr, 0);
@@ -501,6 +518,7 @@ void handle_conn(Server* srv, int fd) {
           }
           t->count++;
           uint64_t my_round = t->round_id;
+          bool timed_out = false;
           if (t->count == srv->trainers) {
             if (t->opt.type == kOptAdam) {
               t->beta1_pow *= t->opt.h0;
@@ -519,10 +537,33 @@ void handle_conn(Server* srv, int fd) {
             t->count = 0;
             t->round_id++;
             t->cv.notify_all();
+          } else if (srv->sync_timeout_ms > 0) {
+            timed_out = !t->cv.wait_for(
+                l, std::chrono::milliseconds(srv->sync_timeout_ms), [&] {
+                  return t->round_id != my_round || srv->stop.load();
+                });
           } else {
             t->cv.wait(l, [&] {
               return t->round_id != my_round || srv->stop.load();
             });
+          }
+          if (timed_out) {
+            for (uint64_t i = 0; i < n; ++i) {
+              auto it2 = t->accum.find(ids[i]);
+              if (it2 == t->accum.end()) continue;
+              for (uint64_t d = 0; d < t->dim; ++d)
+                it2->second[d] -= grads[i * t->dim + d];
+              // an entry this push created (now all zero) must vanish, or
+              // the next complete round would lazily create/advance rows
+              // that were never successfully trained
+              bool all_zero = true;
+              for (uint64_t d = 0; d < t->dim && all_zero; ++d)
+                all_zero = it2->second[d] == 0.0f;
+              if (all_zero) t->accum.erase(it2);
+            }
+            t->count--;
+            write_response(fd, kErr, nullptr, 0);
+            continue;
           }
         }
         write_response(fd, kOk, nullptr, 0);
@@ -844,10 +885,12 @@ extern "C" {
 
 // returns opaque server handle, or 0 on failure; port==0 picks a free port
 // (retrieve with pskv_server_port)
-void* pskv_server_start(int port, int trainers, int sync) {
+void* pskv_server_start(int port, int trainers, int sync,
+                        int64_t sync_timeout_ms) {
   auto* srv = new Server();
   srv->trainers = static_cast<uint32_t>(trainers);
   srv->sync = sync != 0;
+  srv->sync_timeout_ms = sync_timeout_ms;
   srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (srv->listen_fd < 0) {
     delete srv;

@@ -39,7 +39,10 @@ class DataFeeder:
                 flat = a.reshape(-1)
                 if flat.size == width:
                     arrs.append(flat)
-                elif flat.size < width:  # pad ragged sequences
+                elif flat.size < width and np.issubdtype(
+                        np.dtype(var.dtype), np.integer):
+                    # ragged ID sequences pad with 0; short FLOAT data is
+                    # a shape bug, not raggedness — fall through to raise
                     pad = np.zeros(width, flat.dtype)
                     pad[:flat.size] = flat
                     arrs.append(pad)

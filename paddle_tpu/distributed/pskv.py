@@ -36,7 +36,8 @@ def load_lib() -> ctypes.CDLL:
         lib = compile_and_load(_SRC, _SO)
         c = ctypes
         lib.pskv_server_start.restype = c.c_void_p
-        lib.pskv_server_start.argtypes = [c.c_int, c.c_int, c.c_int]
+        lib.pskv_server_start.argtypes = [c.c_int, c.c_int, c.c_int,
+                                          c.c_int64]
         lib.pskv_server_port.restype = c.c_int
         lib.pskv_server_port.argtypes = [c.c_void_p]
         lib.pskv_server_stopped.restype = c.c_int
@@ -90,10 +91,17 @@ class KVServer:
     """In-process pserver (listen_and_serv analog). Runs its accept loop on
     C++ threads; `port` is the bound port (pass port=0 for ephemeral)."""
 
-    def __init__(self, port: int = 0, trainers: int = 1, sync: bool = True):
+    def __init__(self, port: int = 0, trainers: int = 1, sync: bool = True,
+                 sync_timeout_ms: int = 0):
+        """sync_timeout_ms > 0: a sync aggregation round that waits longer
+        than this for missing trainers fails the waiting pushes with an
+        error instead of hanging forever (failure detection for crashed
+        trainers; their contribution is rolled back so a retry round stays
+        correct)."""
         self._lib = load_lib()
         self._handle = self._lib.pskv_server_start(int(port), int(trainers),
-                                                   1 if sync else 0)
+                                                   1 if sync else 0,
+                                                   int(sync_timeout_ms))
         if not self._handle:
             raise RuntimeError(f"pskv server failed to bind port {port}")
         self.port = self._lib.pskv_server_port(self._handle)

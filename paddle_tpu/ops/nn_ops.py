@@ -499,11 +499,20 @@ def _interp_out_hw(x, attrs):
     return oh, ow
 
 
-def _interp_coords(in_dim, out_dim, align_corners):
-    if align_corners and out_dim > 1:
+def _interp_coords(in_dim, out_dim, align_corners, align_mode=1):
+    """Source coordinates per output index, matching the reference
+    interpolate_op: align_corners=True -> ratio (in-1)/(out-1) (index 0 for
+    out_dim==1); else align_mode 1 (the reference default) -> src =
+    ratio*dst; align_mode 0 -> half-pixel centers."""
+    if align_corners:
+        if out_dim <= 1:
+            return jnp.zeros((out_dim,))
         return jnp.linspace(0.0, in_dim - 1.0, out_dim)
-    # half-pixel centers (the reference's align_corners=False mapping)
-    return jnp.clip((jnp.arange(out_dim) + 0.5) * (in_dim / out_dim) - 0.5,
+    if align_mode == 0:  # half-pixel
+        return jnp.clip(
+            (jnp.arange(out_dim) + 0.5) * (in_dim / out_dim) - 0.5,
+            0, in_dim - 1)
+    return jnp.clip(jnp.arange(out_dim) * (in_dim / out_dim),
                     0, in_dim - 1)
 
 
@@ -512,10 +521,9 @@ def _nearest_interp(ctx, ins, attrs):
     x = ins["X"][0]
     oh, ow = _interp_out_hw(x, attrs)
     ac = attrs.get("align_corners", True)
-    ih = jnp.round(_interp_coords(x.shape[2], oh, ac)).astype(jnp.int32) \
-        if ac else (jnp.arange(oh) * (x.shape[2] / oh)).astype(jnp.int32)
-    iw = jnp.round(_interp_coords(x.shape[3], ow, ac)).astype(jnp.int32) \
-        if ac else (jnp.arange(ow) * (x.shape[3] / ow)).astype(jnp.int32)
+    am = attrs.get("align_mode", 1)
+    ih = jnp.round(_interp_coords(x.shape[2], oh, ac, am)).astype(jnp.int32)
+    iw = jnp.round(_interp_coords(x.shape[3], ow, ac, am)).astype(jnp.int32)
     return {"Out": [x[:, :, ih][:, :, :, iw]]}
 
 
@@ -524,9 +532,10 @@ def _bilinear_interp(ctx, ins, attrs):
     x = ins["X"][0]
     oh, ow = _interp_out_hw(x, attrs)
     ac = attrs.get("align_corners", True)
+    am = attrs.get("align_mode", 1)
     h, w = x.shape[2], x.shape[3]
-    ys = _interp_coords(h, oh, ac)
-    xs = _interp_coords(w, ow, ac)
+    ys = _interp_coords(h, oh, ac, am)
+    xs = _interp_coords(w, ow, ac, am)
     y0 = jnp.floor(ys).astype(jnp.int32)
     x0 = jnp.floor(xs).astype(jnp.int32)
     y1 = jnp.minimum(y0 + 1, h - 1)

@@ -224,12 +224,15 @@ class DistributeTranspiler:
 # pserver process entry
 # ---------------------------------------------------------------------------
 
-def start_pserver(spec: PServerSpec):
+def start_pserver(spec: PServerSpec, sync_timeout_ms: int = 0):
     """Start the native KV server for `spec` in-process; returns the server
-    handle (tests / notebook use). Tables are created lazily by trainer 0."""
+    handle (tests / notebook use). Tables are created lazily by trainer 0.
+    sync_timeout_ms: see KVServer — crashed-trainer detection for sync
+    aggregation rounds."""
     from ..distributed.pskv import KVServer
     port = int(spec.endpoint.rsplit(":", 1)[1])
-    return KVServer(port=port, trainers=spec.trainers, sync=spec.sync_mode)
+    return KVServer(port=port, trainers=spec.trainers, sync=spec.sync_mode,
+                    sync_timeout_ms=sync_timeout_ms)
 
 
 def run_pserver(spec: PServerSpec):

@@ -494,3 +494,31 @@ def test_restore_notify_refreshes_scope(tmp_path):
                                    trained, rtol=1e-6)
     plan.shutdown()
     srv.stop()
+
+
+def test_sync_round_timeout_detects_dead_trainer():
+    """A crashed trainer must not hang the sync aggregation round: the
+    waiting trainer's push fails after sync_timeout_ms and its
+    contribution is rolled back (retry-safe)."""
+    import time
+    from paddle_tpu.distributed.pskv import KVServer, KVClient
+    srv = KVServer(port=0, trainers=2, sync=True, sync_timeout_ms=500)
+    c0 = KVClient("127.0.0.1", srv.port, trainer_id=0)
+    c0.create_dense("w", 4, opt="sgd", lr=1.0)
+    c0.init_dense("w", np.zeros(4, np.float32))
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="push_dense"):
+        c0.push_dense("w", np.ones(4, np.float32))  # trainer 1 never comes
+    assert 0.3 < time.time() - t0 < 5
+    # rolled back: a following COMPLETE round applies exactly the mean
+    import threading
+    c1 = KVClient("127.0.0.1", srv.port, trainer_id=1)
+    th = threading.Thread(
+        target=lambda: c1.push_dense("w", 3 * np.ones(4, np.float32)))
+    th.start()
+    c0.push_dense("w", np.ones(4, np.float32))
+    th.join()
+    w = c0.pull_dense("w", 4)
+    np.testing.assert_allclose(w, -2.0, rtol=1e-6)  # -lr * mean(1,3)
+    c0.close(); c1.close()
+    srv.stop()

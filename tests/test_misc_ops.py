@@ -30,7 +30,8 @@ def test_bilinear_interp_resize():
     x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
     # half-pixel mode matches jax.image.resize's bilinear exactly
     out, = _run("bilinear_interp", {"X": [x]},
-                {"out_h": 8, "out_w": 8, "align_corners": False}, ["Out"])
+                {"out_h": 8, "out_w": 8, "align_corners": False,
+                 "align_mode": 0}, ["Out"])
     assert out.shape == (1, 1, 8, 8)
     ref = np.asarray(jax.image.resize(jnp.asarray(x), (1, 1, 8, 8),
                                       "bilinear"))
@@ -174,3 +175,16 @@ def test_resize_scale_and_align_corners():
                {"out_h": 2, "out_w": 2, "align_corners": True}, ["Out"])
     # align_corners nearest samples rows [0, 3], cols [0, 4]
     np.testing.assert_array_equal(nn[0, 0], [[0, 4], [15, 19]])
+
+
+def test_interp_out_dim_one_and_align_mode():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # align_corners out_dim 1 -> pixel 0 (reference ratio=0 convention)
+    out, = _run("bilinear_interp", {"X": [x]},
+                {"out_h": 1, "out_w": 1, "align_corners": True}, ["Out"])
+    np.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-6)
+    # reference default align_mode=1: src = ratio*dst -> output[0,0]=x[0,0]
+    m1, = _run("bilinear_interp", {"X": [x]},
+               {"out_h": 8, "out_w": 8, "align_corners": False}, ["Out"])
+    np.testing.assert_allclose(m1[0, 0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(m1[0, 0, 2, 2], x[0, 0, 1, 1], atol=1e-6)
