@@ -510,9 +510,15 @@ void handle_conn(Server* srv, int fd) {
             apply_sparse_row(t, &row, kv.second.data(), 1.0f, lr_t);
           }
         } else {
+          // ids whose accum entry THIS push creates — exact rollback set
+          std::vector<int64_t> inserted;
           for (uint64_t i = 0; i < n; ++i) {
-            auto& g = t->accum[ids[i]];
-            if (g.empty()) g.assign(t->dim, 0.f);
+            auto emplaced = t->accum.try_emplace(ids[i]);
+            auto& g = emplaced.first->second;
+            if (emplaced.second) {
+              g.assign(t->dim, 0.f);
+              inserted.push_back(ids[i]);
+            }
             for (uint64_t d = 0; d < t->dim; ++d)
               g[d] += grads[i * t->dim + d];
           }
@@ -553,13 +559,17 @@ void handle_conn(Server* srv, int fd) {
               if (it2 == t->accum.end()) continue;
               for (uint64_t d = 0; d < t->dim; ++d)
                 it2->second[d] -= grads[i * t->dim + d];
-              // an entry this push created (now all zero) must vanish, or
-              // the next complete round would lazily create/advance rows
-              // that were never successfully trained
-              bool all_zero = true;
-              for (uint64_t d = 0; d < t->dim && all_zero; ++d)
-                all_zero = it2->second[d] == 0.0f;
-              if (all_zero) t->accum.erase(it2);
+            }
+            // erase exactly the entries this push created (another
+            // trainer's legitimately-zero entry must survive)
+            for (int64_t id : inserted) {
+              auto it2 = t->accum.find(id);
+              if (it2 != t->accum.end()) {
+                bool mine_only = true;
+                for (uint64_t d = 0; d < t->dim && mine_only; ++d)
+                  mine_only = it2->second[d] == 0.0f;
+                if (mine_only) t->accum.erase(it2);
+              }
             }
             t->count--;
             write_response(fd, kErr, nullptr, 0);

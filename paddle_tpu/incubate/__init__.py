@@ -1,1 +1,3 @@
 """Incubating APIs (reference: python/paddle/fluid/incubate/)."""
+
+from . import data_generator  # noqa: F401

@@ -522,8 +522,11 @@ def _nearest_interp(ctx, ins, attrs):
     oh, ow = _interp_out_hw(x, attrs)
     ac = attrs.get("align_corners", True)
     am = attrs.get("align_mode", 1)
-    ih = jnp.round(_interp_coords(x.shape[2], oh, ac, am)).astype(jnp.int32)
-    iw = jnp.round(_interp_coords(x.shape[3], ow, ac, am)).astype(jnp.int32)
+    # reference nearest kernel: round only with align_corners; else floor
+    # (static_cast<int>(ratio * dst))
+    snap = jnp.round if ac else jnp.floor
+    ih = snap(_interp_coords(x.shape[2], oh, ac, am)).astype(jnp.int32)
+    iw = snap(_interp_coords(x.shape[3], ow, ac, am)).astype(jnp.int32)
     return {"Out": [x[:, :, ih][:, :, :, iw]]}
 
 

@@ -188,3 +188,12 @@ def test_interp_out_dim_one_and_align_mode():
                {"out_h": 8, "out_w": 8, "align_corners": False}, ["Out"])
     np.testing.assert_allclose(m1[0, 0, 0, 0], 0.0, atol=1e-6)
     np.testing.assert_allclose(m1[0, 0, 2, 2], x[0, 0, 1, 1], atol=1e-6)
+
+
+def test_nearest_interp_floor_semantics():
+    # align_corners=False floors (reference static_cast<int>): 4 -> 3 gives
+    # rows [0, 1, 2], not round's [0, 1, 3]
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 4, 1)
+    out, = _run("nearest_interp", {"X": [x]},
+                {"out_h": 3, "out_w": 1, "align_corners": False}, ["Out"])
+    np.testing.assert_array_equal(out[0, 0, :, 0], [0, 1, 2])

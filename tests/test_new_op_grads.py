@@ -1,0 +1,149 @@
+"""Numeric-gradient checks for the newer differentiable ops (the OpTest
+pattern of SURVEY §4.1 extended to the latest op batches): CTC, CRF,
+bilinear interp, unfold, bilinear_tensor_product, ranking losses,
+hierarchical sigmoid."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestWarpCTCGrad(OpTest):
+    op_type = "warpctc"
+
+    def setUp(self):
+        rng = np.random.RandomState(0)
+        T, C, L, B = 5, 4, 2, 2
+        self.inputs = {
+            "Logits": rng.randn(B, T, C).astype(np.float32) * 0.5,
+            "Label": np.array([[1, 2], [3, 1]], np.int64),
+            "LogitsLength": np.full((B, 1), T, np.int64),
+            "LabelLength": np.full((B, 1), L, np.int64),
+        }
+        self.attrs = {"blank": 0}
+        self.outputs = {"Loss": np.zeros((B, 1), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["Logits_in"], ["Loss_out"],
+                        max_relative_error=5e-3)
+
+
+class TestLinearChainCRFGrad(OpTest):
+    op_type = "linear_chain_crf"
+
+    def setUp(self):
+        rng = np.random.RandomState(1)
+        B, T, C = 2, 4, 3
+        self.inputs = {
+            "Emission": rng.randn(B, T, C).astype(np.float32) * 0.5,
+            "Transition": rng.randn(C + 2, C).astype(np.float32) * 0.3,
+            "Label": rng.randint(0, C, (B, T)).astype(np.int64),
+            "Length": np.array([[T], [T - 1]], np.int64),
+        }
+        self.outputs = {"LogLikelihood": np.zeros((B, 1), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["Emission_in", "Transition_in"],
+                        ["LogLikelihood_out"], max_relative_error=5e-3)
+
+
+class TestBilinearInterpGrad(OpTest):
+    op_type = "bilinear_interp"
+
+    def setUp(self):
+        rng = np.random.RandomState(2)
+        self.inputs = {"X": rng.randn(2, 2, 4, 4).astype(np.float32)}
+        self.attrs = {"out_h": 7, "out_w": 5, "align_corners": True}
+        self.outputs = {"Out": np.zeros((2, 2, 7, 5), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X_in"], ["Out_out"], max_relative_error=5e-3)
+
+
+class TestUnfoldGrad(OpTest):
+    op_type = "unfold"
+
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        self.inputs = {"X": rng.randn(1, 2, 4, 4).astype(np.float32)}
+        self.attrs = {"kernel_sizes": [2, 2], "strides": [1, 1],
+                      "paddings": [1, 1], "dilations": [1, 1]}
+        self.outputs = {"Y": np.zeros((1, 8, 25), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X_in"], ["Y_out"], max_relative_error=5e-3)
+
+
+class TestBilinearTensorProductGrad(OpTest):
+    op_type = "bilinear_tensor_product"
+
+    def setUp(self):
+        rng = np.random.RandomState(4)
+        self.inputs = {
+            "X": rng.randn(3, 4).astype(np.float32),
+            "Y": rng.randn(3, 5).astype(np.float32),
+            "Weight": rng.randn(2, 4, 5).astype(np.float32) * 0.3,
+            "Bias": rng.randn(2).astype(np.float32),
+        }
+        self.outputs = {"Out": np.zeros((3, 2), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in", "Weight_in", "Bias_in"],
+                        ["Out_out"], max_relative_error=5e-3)
+
+
+class TestRankLossGrad(OpTest):
+    op_type = "rank_loss"
+
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        self.inputs = {
+            "Label": (rng.rand(4, 1) > 0.5).astype(np.float32),
+            "Left": rng.randn(4, 1).astype(np.float32),
+            "Right": rng.randn(4, 1).astype(np.float32),
+        }
+        self.outputs = {"Out": np.zeros((4, 1), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["Left_in", "Right_in"], ["Out_out"],
+                        no_grad_set={"Label_in"},
+                        max_relative_error=5e-3)
+
+
+class TestHSigmoidGrad(OpTest):
+    op_type = "hierarchical_sigmoid"
+
+    def setUp(self):
+        rng = np.random.RandomState(6)
+        V, D, B = 8, 5, 3
+        self.inputs = {
+            "X": rng.randn(B, D).astype(np.float32) * 0.5,
+            "W": rng.randn(V - 1, D).astype(np.float32) * 0.5,
+            "Bias": rng.randn(V - 1).astype(np.float32) * 0.2,
+            "Label": rng.randint(0, V, (B, 1)).astype(np.int64),
+        }
+        self.attrs = {"num_classes": V}
+        self.outputs = {"Cost": np.zeros((B, 1), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X_in", "W_in", "Bias_in"], ["Cost_out"],
+                        max_relative_error=5e-3)
+
+
+class TestKronGrad(OpTest):
+    op_type = "kron"
+
+    def setUp(self):
+        rng = np.random.RandomState(7)
+        self.inputs = {"X": rng.randn(2, 3).astype(np.float32),
+                       "Y": rng.randn(2, 2).astype(np.float32)}
+        self.outputs = {"Out": np.zeros((4, 6), np.float32)}
+
+    def test_grad(self):
+        self.check_grad(["X_in", "Y_in"], ["Out_out"],
+                        max_relative_error=5e-3)
+
+
+if __name__ == "__main__":
+    import unittest
+    unittest.main()
