@@ -25,7 +25,7 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
            "label_smooth", "fused_attention", "warpctc",
            "linear_chain_crf", "crf_decoding", "nce", "hsigmoid",
            "log_loss", "cos_sim", "resize_bilinear", "resize_nearest",
-           "add_position_encoding"]
+           "add_position_encoding", "conv3d", "pool3d", "spectral_norm"]
 
 
 # ---------------------------------------------------------------------------
@@ -694,4 +694,78 @@ def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("add_position_encoding", {"X": [input.name]},
                      {"Out": [out.name]}, {"alpha": alpha, "beta": beta})
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    """reference: layers/nn.py conv3d (NCDHW)."""
+    helper = LayerHelper("conv3d", name=name)
+    def _3(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+    filter_size = _3(filter_size)
+    c_in = int(input.shape[1])
+    w_shape = [num_filters, c_in // groups] + filter_size
+    fan_in = (c_in // groups) * int(np.prod(filter_size))
+    w = helper.create_parameter(param_attr, w_shape, input.dtype,
+                                default_initializer=Normal(
+                                    0.0, (2.0 / fan_in) ** 0.5))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d", {"Input": [input.name], "Filter": [w.name]},
+                     {"Output": [out.name]},
+                     {"strides": _3(stride), "paddings": _3(padding),
+                      "dilations": _3(dilation), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, dim_start=1)
+    return helper.append_activation(out, act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    def _3(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool3d", {"X": [input.name]}, {"Out": [out.name]},
+                     {"pooling_type": pool_type, "ksize": _3(pool_size),
+                      "strides": _3(pool_stride),
+                      "paddings": _3(pool_padding),
+                      "global_pooling": global_pooling,
+                      "ceil_mode": ceil_mode, "exclusive": exclusive})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: layers/nn.py spectral_norm — creates the persistent U/V
+    power-iteration state and returns the normalized weight."""
+    helper = LayerHelper("spectral_norm", name=name)
+    h = int(weight.shape[dim])
+    ww = 1
+    for i, d in enumerate(weight.shape):
+        if i != dim:
+            ww *= int(d)
+    def _state(suffix, size):
+        # the batch_norm running-stat pattern: non-trainable persistent
+        # state created directly on the block + initialized in startup
+        nm = unique_name(f"{weight.name}.{suffix}")
+        p = helper.block.create_parameter(name=nm, shape=[size],
+                                          dtype=weight.dtype,
+                                          trainable=False)
+        sb = helper.startup_program.global_block
+        sb.create_var(name=nm, shape=[size], dtype=weight.dtype,
+                      persistable=True, stop_gradient=True)
+        Normal(0.0, 1.0)(p, sb)
+        return p
+
+    u = _state("sn_u", h)
+    v = _state("sn_v", ww)
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(
+        "spectral_norm",
+        {"Weight": [weight.name], "U": [u.name], "V": [v.name]},
+        {"Out": [out.name], "UOut": [u.name], "VOut": [v.name]},
+        {"dim": dim, "power_iters": power_iters, "eps": eps})
     return out

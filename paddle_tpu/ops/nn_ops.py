@@ -552,3 +552,91 @@ def _bilinear_interp(ctx, ins, attrs):
     out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
            + v10 * ly * (1 - lx) + v11 * ly * lx)
     return {"Out": [out.astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# 3-D convolution family (reference: conv3d in conv_op.cc, pool3d in
+# pool_op.cc) — video/volumetric models; NCDHW layout
+# ---------------------------------------------------------------------------
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """Grad-of-conv formulation like conv2d_transpose above: input-dilated
+    conv with a flipped, IO-swapped kernel (Paddle output-shape
+    semantics: out = (in-1)*stride - 2*pad + dilation*(k-1) + 1)."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s3 = tuple(attrs.get("strides", [1, 1, 1]))
+    p = attrs.get("paddings", [0, 0, 0])
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    if attrs.get("groups", 1) != 1:
+        raise NotImplementedError("grouped conv3d_transpose TBD")
+    wf = jnp.flip(w, axis=(2, 3, 4)).transpose(1, 0, 2, 3, 4)  # -> OIDHW
+    pad = []
+    for i in range(3):
+        e = dil[i] * (w.shape[2 + i] - 1)
+        pad.append((e - p[i], e - p[i]))
+    out = jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1, 1, 1), padding=pad, lhs_dilation=s3,
+        rhs_dilation=dil, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [fn(x, axis=(2, 3, 4), keepdims=True)]}
+    ksize = tuple(attrs["ksize"])
+    strides = tuple(attrs.get("strides", ksize))
+    p = attrs.get("paddings", [0, 0, 0])
+    extra = [0, 0, 0]
+    if attrs.get("ceil_mode", False):
+        for i, (dim, k, st, pp) in enumerate(
+                zip(x.shape[2:], ksize, strides, p)):
+            rem = (dim + 2 * pp - k) % st
+            extra[i] = (st - rem) % st if rem else 0
+    pads = [(0, 0), (0, 0), (p[0], p[0] + extra[0]),
+            (p[1], p[1] + extra[1]), (p[2], p[2] + extra[2])]
+    window = (1, 1) + ksize
+    strides5 = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides5,
+                                    pads)
+    else:
+        ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides5,
+                                     pads)
+        if attrs.get("exclusive", True):
+            cnt = jax.lax.reduce_window(jnp.ones(x.shape, x.dtype), 0.0,
+                                        jax.lax.add, window, strides5, pads)
+            out = ssum / cnt
+        else:
+            out = ssum / float(np.prod(ksize))
+    return {"Out": [out]}
+
+
+@register_op("spectral_norm", non_diff_outputs={"UOut", "VOut"})
+def _spectral_norm(ctx, ins, attrs):
+    """reference spectral_norm_op.cc: weight / sigma_max, sigma estimated
+    by power iteration with persistent U/V state (updated in place)."""
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = attrs.get("dim", 0)
+    power_iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+
+    def normalize(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    for _ in range(max(power_iters, 0)):
+        v = normalize(mat.T @ u)
+        u = normalize(mat @ v)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ (mat @ v)
+    return {"Out": [w / sigma], "UOut": [u], "VOut": [v]}

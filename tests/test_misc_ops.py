@@ -197,3 +197,95 @@ def test_nearest_interp_floor_semantics():
     out, = _run("nearest_interp", {"X": [x]},
                 {"out_h": 3, "out_w": 1, "align_corners": False}, ["Out"])
     np.testing.assert_array_equal(out[0, 0, :, 0], [0, 1, 2])
+
+
+def test_conv3d_pool3d_train():
+    """3-D conv family trains (video-model path, pairs with
+    temporal_shift)."""
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        vid = pt.layers.data("vid", [2, 4, 8, 8])  # c, d, h, w
+        label = pt.layers.data("label", [1], dtype="int64")
+        h = pt.layers.conv3d(vid, 4, 3, padding=1, act="relu")
+        h = pt.layers.pool3d(h, 2, "max", 2)
+        logits = pt.layers.fc(h, 3)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.Adam(1e-2).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(8):
+            f = {"vid": rng.randn(4, 2, 4, 8, 8).astype(np.float32),
+                 "label": rng.randint(0, 3, (4, 1)).astype(np.int64)}
+            (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_spectral_norm_unit_sigma():
+    """After normalization the largest singular value is ~1, and the
+    U/V power-iteration state persists across runs."""
+    import paddle_tpu as pt
+    rng = np.random.RandomState(0)
+    w = (rng.randn(6, 5) * 3).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        wv = pt.layers.data("w", [6, 5], append_batch_size=False)
+        out = pt.layers.spectral_norm(wv, power_iters=5)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):  # state refines across runs
+            (o,) = exe.run(main, feed={"w": w}, fetch_list=[out])
+    sigma = np.linalg.svd(o, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_conv3d_transpose_shape_and_grad():
+    """Paddle shape semantics: out = (in-1)*s - 2p + k."""
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [2, 4, 4, 4])
+        from paddle_tpu.framework.layer_helper import LayerHelper
+        helper = LayerHelper("c3t")
+        w = helper.create_parameter(None, [2, 3, 3, 3, 3], "float32")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("conv3d_transpose",
+                         {"Input": [x.name], "Filter": [w.name]},
+                         {"Output": [out.name]},
+                         {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                          "dilations": [1, 1, 1]})
+        loss = pt.layers.mean(pt.layers.square(
+            main.global_block.var(out.name)))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    assert tuple(main.global_block.var(out.name).shape) == (-1, 3, 6, 6, 6)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={
+            "x": np.random.RandomState(0).randn(2, 2, 4, 4, 4).astype(
+                np.float32)}, fetch_list=[loss])
+    assert np.isfinite(lv).all()
+
+
+def test_conv3d_asymmetric_padding_preserved():
+    """The original conv3d lowering's 6-element padding support must
+    survive (regression for the duplicate-registration bug)."""
+    from paddle_tpu.framework.registry import get_op_def, LowerContext
+    import jax.numpy as jnp
+    x = np.zeros((1, 1, 2, 2, 2), np.float32)
+    w = np.ones((1, 1, 1, 1, 1), np.float32)
+    r = get_op_def("conv3d").lower(
+        LowerContext(), {"Input": [jnp.asarray(x)],
+                         "Filter": [jnp.asarray(w)]},
+        {"strides": [1, 1, 1], "paddings": [0, 1, 0, 0, 0, 0],
+         "dilations": [1, 1, 1]})
+    assert r["Output"][0].shape == (1, 1, 3, 2, 2)
