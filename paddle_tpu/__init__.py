@@ -36,6 +36,10 @@ from .data_feeder import DataFeeder
 from .reader.py_reader import PyReader
 from .framework import debugger
 from . import utils
+from . import install_check
+from . import average
+from . import lod_tensor
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import reader
 from . import datasets
 from .framework.executor import as_jax_function

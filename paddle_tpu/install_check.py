@@ -1,0 +1,45 @@
+"""Installation self-check (reference: python/paddle/fluid/install_check.py
+run_check — builds a tiny model, runs a train step, prints success)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Train a 2-layer net for a few steps on the default device; raises on
+    any failure, prints a success banner otherwise."""
+    import jax
+
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8], dtype="float32")
+        y = pt.layers.data("y", [1], dtype="float32")
+        h = pt.layers.fc(x, 16, act="relu")
+        pred = pt.layers.fc(h, 1)
+        loss = pt.layers.mean(pt.layers.square(pred - y))
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            xv = rng.randn(16, 8).astype(np.float32)
+            (lv,) = exe.run(main, feed={"x": xv,
+                                        "y": xv.sum(1, keepdims=True)},
+                            fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    if not (np.isfinite(losses).all() and losses[-1] < losses[0]):
+        raise RuntimeError(
+            f"paddle_tpu self-check failed: losses {losses} (non-finite "
+            "or not decreasing)")
+    dev = jax.devices()[0]
+    print(f"Your paddle_tpu works well on {dev.platform.upper()} "
+          f"({dev.device_kind}).")
+    print("paddle_tpu is installed successfully!")

@@ -1,0 +1,75 @@
+"""LoD-tensor helpers (reference: python/paddle/fluid/lod_tensor.py
+create_lod_tensor / create_random_int_lodtensor).
+
+The TPU representation of a ragged batch is (values, lod-offsets) — the
+same pair the native datafeed emits — plus padded/static-shape views for
+the jitted step. These helpers build and convert between the forms.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor",
+           "lod_to_padded", "padded_to_lod"]
+
+
+def create_lod_tensor(data, recursive_seq_lens: Sequence[Sequence[int]],
+                      place=None) -> Tuple[np.ndarray, np.ndarray]:
+    """data: list-of-lists or flat ndarray; returns (values, offsets) with
+    offsets[0]=0, offsets[i+1]-offsets[i] = length of sequence i (one LoD
+    level, the common case; reference supports nesting)."""
+    lens = list(recursive_seq_lens[-1])
+    if isinstance(data, np.ndarray):
+        values = np.asarray(data)
+    else:
+        # keep per-element feature dims: each sequence contributes
+        # len(seq) ROWS, not len(seq)*prod(feature) scalars
+        rows = [np.asarray(seq) for seq in data]
+        values = np.concatenate(
+            [r.reshape(r.shape[0], *r.shape[1:]) for r in rows]) \
+            if rows else np.empty((0,))
+    offsets = np.zeros(len(lens) + 1, np.int64)
+    offsets[1:] = np.cumsum(lens)
+    if offsets[-1] != (values.shape[0]):
+        raise ValueError(
+            f"sum of seq lens {offsets[-1]} != data rows {values.shape[0]}")
+    return values, offsets
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    lens = list(recursive_seq_lens[-1])
+    total = int(sum(lens))
+    values = np.random.randint(low, high + 1,
+                               (total,) + tuple(base_shape)).astype(np.int64)
+    offsets = np.zeros(len(lens) + 1, np.int64)
+    offsets[1:] = np.cumsum(lens)
+    return values, offsets
+
+
+def lod_to_padded(values: np.ndarray, offsets: np.ndarray, maxlen=None,
+                  pad_value=0):
+    """(values, offsets) -> (padded [b, maxlen, ...], lengths [b])."""
+    lens = np.diff(offsets)
+    b = len(lens)
+    if maxlen is not None:
+        t = int(maxlen)
+    else:
+        t = int(lens.max()) if b else 0
+    out = np.full((b, t) + values.shape[1:], pad_value, values.dtype)
+    for i in range(b):
+        n = min(int(lens[i]), t)
+        out[i, :n] = values[offsets[i]:offsets[i] + n]
+    return out, lens.astype(np.int64)
+
+
+def padded_to_lod(padded: np.ndarray, lengths: np.ndarray):
+    """(padded, lengths) -> (values, offsets)."""
+    parts = [padded[i, :int(n)] for i, n in enumerate(lengths)]
+    values = np.concatenate(parts) if parts else padded[:0, 0]
+    offsets = np.zeros(len(lengths) + 1, np.int64)
+    offsets[1:] = np.cumsum(lengths)
+    return values, offsets

@@ -202,3 +202,38 @@ def test_data_feeder_rejects_oversize():
     feeder = pt.DataFeeder(feed_list=[img], program=main)
     with pytest.raises(ValueError, match="shape mismatch"):
         feeder.feed([(np.ones(8, np.float32),)])
+
+
+def test_install_check_and_average_and_lod_helpers(capsys):
+    pt.install_check.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+    wa = pt.average.WeightedAverage()
+    wa.add(2.0, weight=1)
+    wa.add(np.array([4.0]), weight=3)
+    assert wa.eval() == pytest.approx(3.5)
+
+    vals, off = pt.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]], None)
+    np.testing.assert_array_equal(off, [0, 3, 5])
+    padded, lens = pt.lod_tensor.lod_to_padded(vals, off)
+    np.testing.assert_array_equal(padded, [[1, 2, 3], [4, 5, 0]])
+    v2, o2 = pt.lod_tensor.padded_to_lod(padded, lens)
+    np.testing.assert_array_equal(v2, vals)
+    np.testing.assert_array_equal(o2, off)
+
+
+def test_lod_helpers_edge_cases():
+    # multi-dim sequence elements keep their feature dims
+    vals, off = pt.create_lod_tensor(
+        [[[1, 2], [3, 4]], [[5, 6]]], [[2, 1]], None)
+    assert vals.shape == (3, 2)
+    np.testing.assert_array_equal(off, [0, 2, 3])
+    # empty batch (offsets [0] = zero sequences) doesn't crash
+    padded, lens = pt.lod_tensor.lod_to_padded(np.empty((0,)),
+                                               np.array([0]))
+    assert padded.shape[0] == 0 and lens.shape == (0,)
+    # scalar-only average guard
+    wa = pt.average.WeightedAverage()
+    with pytest.raises(ValueError, match="scalar"):
+        wa.add(np.array([1.0, 2.0]))
