@@ -18,3 +18,4 @@ from . import rnn  # noqa: F401
 from . import collective  # noqa: F401
 from . import detection  # noqa: F401
 from . import distributions  # noqa: F401
+from . import decode  # noqa: F401

@@ -1,0 +1,111 @@
+"""Autoregressive decoding helpers: greedy and beam search.
+
+Reference: the LoD-based beam_search/beam_search_decode ops
+(operators/beam_search_op.cc, beam_search_decode_op.cc) driven by a
+while_op loop. TPU redesign: decoding is a host-side loop over a jitted
+single-step function (each step is one XLA call with static shapes —
+beams are a fixed dimension folded into the batch), finished with the
+gather_tree backtrace op. No dynamic LoD structures anywhere.
+
+`step_fn(tokens) -> logits` receives the full padded token prefix
+[b*beam, t] and returns next-token logits [b*beam, V] — the natural form
+for the transformer_nmt decoder run teacher-forced on the prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["greedy_decode", "beam_search_decode"]
+
+
+def greedy_decode(step_logits: Callable[[np.ndarray], np.ndarray],
+                  batch_size: int, bos_id: int, eos_id: int,
+                  max_len: int) -> np.ndarray:
+    """Greedy argmax decoding; returns [b, max_len] token ids (eos-padded
+    after each row finishes)."""
+    tokens = np.full((batch_size, max_len + 1), eos_id, np.int64)
+    tokens[:, 0] = bos_id
+    done = np.zeros(batch_size, bool)
+    for t in range(max_len):
+        logits = np.asarray(step_logits(tokens[:, : t + 1]))
+        nxt = np.argmax(logits, axis=-1).astype(np.int64)
+        nxt = np.where(done, eos_id, nxt)
+        tokens[:, t + 1] = nxt
+        done |= nxt == eos_id
+        if done.all():
+            break
+    return tokens[:, 1:]
+
+
+def beam_search_decode(step_logits: Callable[[np.ndarray], np.ndarray],
+                       batch_size: int, beam_size: int, bos_id: int,
+                       eos_id: int, max_len: int,
+                       length_penalty: float = 0.0
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Standard beam search. step_logits sees [b*beam, t] prefixes and
+    returns [b*beam, V] next-token logits. Returns (sequences [b, beam,
+    max_len], scores [b, beam]) best-first, reconstructed with the
+    gather_tree backtrace (ids/parents stacked per step like the
+    reference's beam-search decode pass)."""
+    def log_softmax(x, axis=-1):
+        m = x.max(axis=axis, keepdims=True)
+        z = x - m
+        return z - np.log(np.exp(z).sum(axis=axis, keepdims=True))
+
+    b, k = batch_size, beam_size
+    tokens = np.full((b * k, max_len + 1), eos_id, np.int64)
+    tokens[:, 0] = bos_id
+    scores = np.full((b, k), -1e9, np.float32)
+    scores[:, 0] = 0.0                      # only beam 0 is live at t=0
+    finished = np.zeros((b, k), bool)
+    ids_hist, parents_hist = [], []
+
+    for t in range(max_len):
+        logits = np.asarray(step_logits(tokens[:, : t + 1]))
+        logp = log_softmax(logits.astype(np.float64), axis=-1)
+        v = logp.shape[-1]
+        logp = logp.reshape(b, k, v)
+        # finished beams only extend with eos at no cost
+        pad_mask = np.full((v,), -1e9)
+        pad_mask[eos_id] = 0.0
+        logp = np.where(finished[:, :, None], pad_mask[None, None, :], logp)
+        total = scores[:, :, None] + logp      # [b, k, v]
+        flat = total.reshape(b, k * v)
+        top = np.argsort(-flat, axis=-1)[:, :k]
+        scores = np.take_along_axis(flat, top, axis=-1).astype(np.float32)
+        parents = (top // v).astype(np.int64)          # [b, k]
+        ids = (top % v).astype(np.int64)               # [b, k]
+        ids_hist.append(ids)
+        parents_hist.append(parents)
+        # reorder token prefixes by parent beam
+        tokens = tokens.reshape(b, k, -1)
+        tokens = np.take_along_axis(tokens, parents[:, :, None], axis=1)
+        tokens = tokens.reshape(b * k, -1)
+        tokens[:, t + 1] = ids.reshape(-1)
+        finished = np.take_along_axis(finished, parents, axis=1) | (
+            ids == eos_id)
+        if finished.all():
+            break
+
+    # backtrace with the gather_tree op (jit-compiled once)
+    import jax.numpy as jnp
+    from ..framework.registry import get_op_def, LowerContext
+    ids_arr = jnp.asarray(np.stack(ids_hist))          # [T, b, k]
+    par_arr = jnp.asarray(np.stack(parents_hist))
+    seqs = np.asarray(get_op_def("gather_tree").lower(
+        LowerContext(), {"Ids": [ids_arr], "Parents": [par_arr]},
+        {})["Out"][0])                                 # [T, b, k]
+    seqs = np.transpose(seqs, (1, 2, 0))               # [b, k, T]
+    if seqs.shape[-1] < max_len:
+        pad = np.full((b, k, max_len - seqs.shape[-1]), eos_id, np.int64)
+        seqs = np.concatenate([seqs, pad], axis=-1)
+    if length_penalty > 0:
+        lens = (seqs != eos_id).sum(-1).clip(min=1)
+        scores = scores / (lens.astype(np.float32) ** length_penalty)
+        order = np.argsort(-scores, axis=-1)
+        seqs = np.take_along_axis(seqs, order[:, :, None], axis=1)
+        scores = np.take_along_axis(scores, order, axis=-1)
+    return seqs, scores

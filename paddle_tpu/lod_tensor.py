@@ -28,9 +28,7 @@ def create_lod_tensor(data, recursive_seq_lens: Sequence[Sequence[int]],
         # keep per-element feature dims: each sequence contributes
         # len(seq) ROWS, not len(seq)*prod(feature) scalars
         rows = [np.asarray(seq) for seq in data]
-        values = np.concatenate(
-            [r.reshape(r.shape[0], *r.shape[1:]) for r in rows]) \
-            if rows else np.empty((0,))
+        values = np.concatenate(rows) if rows else np.empty((0,))
     offsets = np.zeros(len(lens) + 1, np.int64)
     offsets[1:] = np.cumsum(lens)
     if offsets[-1] != (values.shape[0]):
@@ -63,13 +61,16 @@ def lod_to_padded(values: np.ndarray, offsets: np.ndarray, maxlen=None,
     for i in range(b):
         n = min(int(lens[i]), t)
         out[i, :n] = values[offsets[i]:offsets[i] + n]
-    return out, lens.astype(np.int64)
+    # truncated rows must report truncated lengths or the (padded, lens)
+    # pair is internally inconsistent
+    return out, np.minimum(lens, t).astype(np.int64)
 
 
 def padded_to_lod(padded: np.ndarray, lengths: np.ndarray):
     """(padded, lengths) -> (values, offsets)."""
     parts = [padded[i, :int(n)] for i, n in enumerate(lengths)]
-    values = np.concatenate(parts) if parts else padded[:0, 0]
+    values = np.concatenate(parts) if parts else \
+        np.empty((0,) + padded.shape[2:], padded.dtype)
     offsets = np.zeros(len(lengths) + 1, np.int64)
     offsets[1:] = np.cumsum(lengths)
     return values, offsets

@@ -237,3 +237,15 @@ def test_lod_helpers_edge_cases():
     wa = pt.average.WeightedAverage()
     with pytest.raises(ValueError, match="scalar"):
         wa.add(np.array([1.0, 2.0]))
+
+
+def test_lod_truncation_and_empty_roundtrip():
+    vals, off = pt.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]], None)
+    padded, lens = pt.lod_tensor.lod_to_padded(vals, off, maxlen=2)
+    np.testing.assert_array_equal(lens, [2, 2])  # truncated lengths
+    v2, o2 = pt.lod_tensor.padded_to_lod(padded, lens)
+    assert o2[-1] == v2.shape[0]
+    # empty round-trip both directions
+    p0, l0 = pt.lod_tensor.lod_to_padded(np.empty((0,)), np.array([0]))
+    v0, o0 = pt.lod_tensor.padded_to_lod(p0, l0)
+    assert v0.shape[0] == 0 and o0.tolist() == [0]
