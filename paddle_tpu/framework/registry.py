@@ -99,6 +99,42 @@ def has_op_def(op_type: str) -> bool:
     return op_type in _REGISTRY
 
 
+_CALLBACKS_OK = None
+
+
+def backend_supports_callbacks() -> bool:
+    """Whether the active backend implements host callbacks
+    (jax.debug.print / pure_callback / io_callback). The experimental
+    axon tunnel does not; probed empirically once so ANY registration
+    path is detected (config string, plugin entry point, ...)."""
+    global _CALLBACKS_OK
+    if _CALLBACKS_OK is None:
+        import jax
+
+        # fast path: the axon tunnel advertises itself in the platform
+        # list when configured the usual way
+        if "axon" in str(jax.config.jax_platforms or ""):
+            _CALLBACKS_OK = False
+            return _CALLBACKS_OK
+        # empirical probe in a SUBPROCESS: probing in-process would leave
+        # a sticky stream error on callback-less clients that poisons the
+        # next real execution
+        import subprocess
+        import sys
+        code = ("import jax\n"
+                "def f(x):\n"
+                "    jax.debug.print('')\n"
+                "    return x + 1\n"
+                "jax.jit(f)(0.0).block_until_ready()\n")
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, timeout=120)
+            _CALLBACKS_OK = r.returncode == 0
+        except Exception:
+            _CALLBACKS_OK = False
+    return _CALLBACKS_OK
+
+
 # ---------------------------------------------------------------------------
 # Lowering context
 # ---------------------------------------------------------------------------

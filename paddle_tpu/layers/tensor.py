@@ -13,7 +13,7 @@ __all__ = ["data", "fill_constant", "fill_constant_batch_size_like",
            "scatter", "assign", "shape", "arange", "argmax", "argmin",
            "argsort", "where", "pad", "pad2d", "uniform_random",
            "gaussian_random", "increment", "create_global_var",
-           "create_tensor", "flip", "roll", "tile", "py_func"]
+           "create_tensor", "flip", "roll", "tile", "py_func", "Print"]
 
 
 def data(name, shape, dtype="float32", append_batch_size=True,
@@ -395,7 +395,9 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
     """Host-Python callback op (reference: layers/nn.py py_func). `out`
     vars must be pre-created with shapes/dtypes (create_variable-style),
     exactly like the reference. backward_func is accepted but the op is
-    non-differentiable in v1 (register a custom grad if needed)."""
+    non-differentiable in v1 (register a custom grad if needed).
+    NOTE: requires a backend with host callbacks (CPU / standard TPU
+    PJRT); the experimental axon tunnel does not support them."""
     from ..ops.tensor_ops import register_py_func
     helper = LayerHelper("py_func", name=name)
     xs = x if isinstance(x, (list, tuple)) else [x]
@@ -414,4 +416,25 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
          "out_shapes": [list(v.shape) for v in outs],
          "out_dtypes": [v.dtype for v in outs]},
         infer_shape=False)
+    return out
+
+
+def Print(input, first_n=-1, message="", summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both", name=None, print_stats=True):
+    """reference: layers/control_flow.py Print — identity on the data
+    flow with a host-side debug print (jax.debug.print). Divergences
+    from the reference, stated plainly: prints fire on EVERY execution
+    (first_n is accepted but cannot be honored — there is no per-op
+    host counter inside a jitted block); print_stats=True prints
+    shape/mean/min/max plus the first `summarize` values, False prints
+    raw values only; LoD/phase arguments are accepted no-ops. Degrades
+    to pure identity on backends without host callbacks."""
+    helper = LayerHelper("print", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", {"X": [input.name]}, {"Out": [out.name]},
+                     {"message": message or input.name,
+                      "summarize": summarize,
+                      "print_tensor_stats": bool(print_stats)})
     return out

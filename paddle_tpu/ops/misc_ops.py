@@ -345,3 +345,47 @@ def _sampling_id(ctx, ins, attrs):
     key = ctx.rng()
     return {"Out": [jax.random.categorical(
         key, jnp.log(jnp.maximum(x, 1e-20))).astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# infra ops: Print (debug passthrough via host callback), isnan/isinf
+# (reference print_op.cc, isfinite_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("print")
+def _print(ctx, ins, attrs):
+    """Debug print: passes X through unchanged and emits a host-side print
+    of stats/values (reference print_op.cc) via jax.debug.print. On
+    backends without host callbacks (axon tunnel) it degrades to identity
+    with a one-time warning."""
+    from ..framework.registry import backend_supports_callbacks
+    x = ins["X"][0]
+    if ctx.abstract or not backend_supports_callbacks():
+        if not ctx.abstract:
+            import warnings
+            warnings.warn("print op: backend lacks host callbacks; "
+                          "passing through silently")
+        return {"Out": [x]}
+    msg = attrs.get("message", "")
+    summarize = int(attrs.get("summarize", 20))
+    if x.size == 0:
+        jax.debug.print(msg + " shape={s} (empty)", s=str(x.shape))
+    elif attrs.get("print_tensor_stats", True):
+        jax.debug.print(
+            msg + " shape={s} mean={m} min={mn} max={mx} first={f}",
+            s=str(x.shape), m=jnp.mean(x.astype(jnp.float32)),
+            mn=jnp.min(x), mx=jnp.max(x),
+            f=x.reshape(-1)[:summarize])
+    else:
+        jax.debug.print(msg + " {v}", v=x.reshape(-1)[:summarize])
+    return {"Out": [x]}
+
+
+@register_op("isnan", not_differentiable=True)
+def _isnan(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isnan(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("isinf", not_differentiable=True)
+def _isinf(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isinf(ins["X"][0])).reshape((1,))]}

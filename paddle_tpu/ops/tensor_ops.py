@@ -548,6 +548,12 @@ def register_py_func(fn) -> int:
 def _py_func(ctx, ins, attrs):
     import numpy as _np
 
+    from ..framework.registry import backend_supports_callbacks
+    if not ctx.abstract and not backend_supports_callbacks():
+        raise RuntimeError(
+            "py_func requires a backend with host callbacks "
+            "(pure_callback); the active backend (e.g. the axon tunnel) "
+            "does not support them — run on CPU or a standard TPU PJRT")
     fn = _PY_FUNCS[attrs["func_id"]]
     out_shapes = attrs["out_shapes"]
     out_dtypes = attrs["out_dtypes"]

@@ -289,3 +289,26 @@ def test_conv3d_asymmetric_padding_preserved():
         {"strides": [1, 1, 1], "paddings": [0, 1, 0, 0, 0, 0],
          "dilations": [1, 1, 1]})
     assert r["Output"][0].shape == (1, 1, 3, 2, 2)
+
+
+def test_print_op_identity_and_isnan():
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [3])
+        y = pt.layers.Print(x, message="dbg")
+        loss = pt.layers.mean(y)
+        pt.optimizer.SGD(0.1).minimize(loss)  # Print must be transparent
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                        fetch_list=[loss])
+    np.testing.assert_allclose(np.ravel(lv)[0], 1.0, rtol=1e-6)
+
+    bad = np.array([[1.0, np.nan]], np.float32)
+    out, = _run("isnan", {"X": [bad]}, {}, ["Out"])
+    assert bool(out[0])
+    out, = _run("isinf", {"X": [bad]}, {}, ["Out"])
+    assert not bool(out[0])
