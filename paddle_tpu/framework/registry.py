@@ -121,7 +121,12 @@ def backend_supports_callbacks() -> bool:
         # next real execution
         import subprocess
         import sys
-        code = ("import jax\n"
+        # pin the PARENT's effective platform: the child would otherwise
+        # pick up ambient site defaults (e.g. an axon sitecustomize) and
+        # probe a different backend than the one actually in use
+        plats = jax.config.jax_platforms or jax.devices()[0].platform
+        code = (f"import jax\n"
+                f"jax.config.update('jax_platforms', {plats!r})\n"
                 "def f(x):\n"
                 "    jax.debug.print('')\n"
                 "    return x + 1\n"
