@@ -13,7 +13,8 @@ __all__ = ["data", "fill_constant", "fill_constant_batch_size_like",
            "scatter", "assign", "shape", "arange", "argmax", "argmin",
            "argsort", "where", "pad", "pad2d", "uniform_random",
            "gaussian_random", "increment", "create_global_var",
-           "create_tensor", "flip", "roll", "tile", "py_func", "Print"]
+           "create_tensor", "flip", "roll", "tile", "py_func", "Print",
+           "create_parameter"]
 
 
 def data(name, shape, dtype="float32", append_batch_size=True,
@@ -438,3 +439,23 @@ def Print(input, first_n=-1, message="", summarize=20,
                       "summarize": summarize,
                       "print_tensor_stats": bool(print_stats)})
     return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: layers/tensor.py create_parameter — a free-standing
+    trainable parameter."""
+    import copy as _copy
+
+    from ..framework.layer_helper import LayerHelper, ParamAttr
+    helper = LayerHelper("create_parameter", name=None)
+    if attr is None:
+        attr = ParamAttr(name=name)
+    elif name and not attr.name:
+        # never mutate the caller's attr: a shared ParamAttr reused across
+        # calls would silently alias every parameter to the first name
+        attr = _copy.copy(attr)
+        attr.name = name
+    return helper.create_parameter(attr, list(shape), dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)

@@ -7,7 +7,8 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
-           "scaled_dot_product_attention", "sequence_conv_pool"]
+           "scaled_dot_product_attention", "sequence_conv_pool",
+           "switch_moe_ffn"]
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
@@ -118,3 +119,70 @@ def sequence_conv_pool(input, num_filters, filter_size, lengths=None,
         win = layers.concat(shifted, axis=2)
     conv = layers.fc(win, num_filters, num_flatten_dims=2, act=act)
     return layers.sequence_pool(conv, pool_type, lengths=lengths)
+
+
+def switch_moe_ffn(x, num_experts, d_model, d_ffn, capacity_factor=1.25,
+                   name_prefix=None):
+    """Switch-style top-1 mixture-of-experts FFN (beyond the 2019
+    reference — expert parallelism is table stakes for a TPU framework;
+    see SURVEY §2.6 last row).
+
+    Formulation is the Mesh-TensorFlow/GSPMD dispatch-combine einsum: the
+    expert dimension of the [e, d, f] weights shards over an 'ep' mesh
+    axis via CompiledProgram.with_sharding, and XLA inserts the
+    all-to-alls. Returns (output [b, s, d], aux_loss) where aux_loss is
+    the load-balancing loss (mean fraction * mean router prob, scaled by
+    num_experts).
+
+    Capacity: each expert processes at most
+    ceil(tokens/experts * capacity_factor) tokens per batch; overflow
+    tokens pass through the residual (their expert output is zeroed) —
+    the standard Switch behavior, static shapes throughout.
+    """
+    import math as _math
+
+    from .framework.core import unique_name
+    from .framework.layer_helper import ParamAttr
+
+    if name_prefix is None:
+        # stacked layers must not silently alias one weight set
+        name_prefix = unique_name("moe")
+
+    b_s_d = x.shape
+    seq = int(b_s_d[1])
+    e = int(num_experts)
+
+    router = layers.fc(x, e, num_flatten_dims=2, bias_attr=False,
+                       param_attr=ParamAttr(name=f"{name_prefix}/router.w"))
+    probs = layers.softmax(router, axis=-1)              # [b, s, e]
+    gate = layers.reduce_max(probs, dim=-1, keep_dim=True)   # [b, s, 1]
+    # top-1 via argmax one-hot: ties (e.g. all-zero padding tokens with
+    # uniform probs) must pick ONE expert, not flood every queue
+    top_idx = layers.argmax(probs, axis=-1)              # [b, s]
+    assign = layers.one_hot(top_idx, e)                  # [b, s, e]
+
+    # capacity masking: position of each token within its expert's queue
+    cap = int(_math.ceil(seq * capacity_factor / e))
+    pos = layers.cumsum(assign, axis=1)                 # [b, s, e]
+    keep = layers.cast(
+        layers.less_equal(pos, layers.fill_constant([1], "float32",
+                                                    float(cap))),
+        "float32") * assign                              # [b, s, e]
+
+    # dispatch: [b, s, e] x [b, s, d] -> [b, e, s, d] masked token copies
+    disp = layers.einsum("bse,bsd->besd", keep, x)
+
+    w1 = layers.create_parameter([e, d_model, d_ffn], "float32",
+                                 attr=ParamAttr(name=f"{name_prefix}/w1"))
+    w2 = layers.create_parameter([e, d_ffn, d_model], "float32",
+                                 attr=ParamAttr(name=f"{name_prefix}/w2"))
+    h = layers.relu(layers.einsum("besd,edf->besf", disp, w1))
+    y = layers.einsum("besf,efd->besd", h, w2)           # [b, e, s, d]
+    # combine weighted by the gate prob
+    out = layers.einsum("besd,bse->bsd", y, keep * probs)
+
+    # load-balancing aux loss (Switch eq. 4): e * sum_e f_e * P_e
+    frac = layers.reduce_mean(assign, dim=[0, 1])        # [e]
+    mean_prob = layers.reduce_mean(probs, dim=[0, 1])    # [e]
+    aux = layers.scale(layers.reduce_sum(frac * mean_prob), scale=float(e))
+    return out, aux
