@@ -305,18 +305,32 @@ void handle_conn(Server* srv, int fd) {
         if (!need(25)) continue;
         uint64_t size;
         std::memcpy(&size, f.payload.data(), 8);
+        // bound client-supplied size: value+accum must stay under the 1GiB
+        // frame ceiling a trainer could ever init/pull anyway (bad_alloc in
+        // a handler thread would std::terminate the server)
+        if (size == 0 || size > (1u << 28)) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
         OptConfig o = parse_opt(f.payload.data() + 8);
+        bool ok = true;
         {
           std::lock_guard<std::mutex> l(srv->tables_mu);
-          if (!srv->dense.count(f.name)) {
+          auto it = srv->dense.find(f.name);
+          if (it == srv->dense.end()) {
             auto t = std::make_unique<DenseTable>();
             t->value.assign(size, 0.f);
             t->accum.assign(size, 0.f);
             t->opt = o;
             srv->dense[f.name] = std::move(t);
+          } else if (it->second->value.size() != size) {
+            // a trainer rebuilt its model against a live server with a
+            // different shape — silently reusing the old table would train
+            // on garbage; surface the mismatch instead
+            ok = false;
           }
         }
-        write_response(fd, kOk, nullptr, 0);
+        write_response(fd, ok ? kOk : kErr, nullptr, 0);
         break;
       }
       case kInitDense: {
@@ -332,8 +346,13 @@ void handle_conn(Server* srv, int fd) {
         }
         std::lock_guard<std::mutex> l(t->mu);
         size_t n = f.payload.size() / 4;
-        if (n == t->value.size())
-          std::memcpy(t->value.data(), f.payload.data(), f.payload.size());
+        if (n != t->value.size()) {
+          // size-mismatched init must not reply kOk: the trainer would
+          // proceed to train against zero-initialized params
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
+        std::memcpy(t->value.data(), f.payload.data(), f.payload.size());
         write_response(fd, kOk, nullptr, 0);
         break;
       }
@@ -414,6 +433,13 @@ void handle_conn(Server* srv, int fd) {
         if (!need(37)) continue;
         uint64_t dim;
         std::memcpy(&dim, f.payload.data(), 8);
+        // bound dim so all later n*dim*4 arithmetic fits in 64 bits with
+        // room to spare (payloads are <=1GiB, so a larger dim could never
+        // carry even one row anyway)
+        if (dim == 0 || dim > (1u << 28)) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
         OptConfig o = parse_opt(f.payload.data() + 8);
         float init_scale;
         std::memcpy(&init_scale, f.payload.data() + 25, 4);
@@ -448,7 +474,20 @@ void handle_conn(Server* srv, int fd) {
         if (!need(8)) continue;
         uint64_t n;
         std::memcpy(&n, f.payload.data(), 8);
-        if (!need(8 + n * 8)) continue;
+        // bound n BEFORE any size arithmetic: for n >= 2^61 the u64
+        // multiply in 8 + n*8 wraps, a naive need(8 + n*8) check passes,
+        // and ids would be read far out of bounds. n <= (payload-8)/8
+        // implies 8 + n*8 <= payload with no overflow possible.
+        if (n > (f.payload.size() - 8) / 8) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
+        // response length is a u32 on the wire; dim<=2^28 and n<=2^27 keep
+        // n*dim*4 well-defined, but it can still exceed 4GiB-1
+        if (n * t->dim * 4 > 0xFFFFFFFFull) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
         const int64_t* ids =
             reinterpret_cast<const int64_t*>(f.payload.data() + 8);
         std::vector<float> out(n * t->dim);
@@ -481,6 +520,13 @@ void handle_conn(Server* srv, int fd) {
         if (!need(12)) continue;
         uint64_t n;
         std::memcpy(&n, f.payload.data() + 4, 8);
+        // same overflow-safe bounding as kPullSparse: first cap n by the
+        // ids region alone (no multiplication can wrap under that cap,
+        // since payload <= 1GiB and dim <= 2^28), then check the full size
+        if (n > (f.payload.size() - 12) / 8) {
+          write_response(fd, kErr, nullptr, 0);
+          continue;
+        }
         if (!need(12 + n * 8 + n * t->dim * 4)) continue;
         const int64_t* ids =
             reinterpret_cast<const int64_t*>(f.payload.data() + 12);

@@ -186,7 +186,12 @@ class Communicator:
                     self.last_error = e
                     _LOG.warning("communicator recv failed, retrying: %s",
                                  e)
-                    self._recv_clients.pop(s.endpoint, None)
+                    stale = self._recv_clients.pop(s.endpoint, None)
+                    if stale is not None:
+                        try:
+                            stale.close()  # else one fd leaks per failure
+                        except Exception:
+                            pass
                     break  # retry next interval with a fresh connection
                 cur = self._scope.find_var(s.name)
                 if cur is not None:

@@ -35,11 +35,14 @@ def _find_loss_op_idx(block: Block, loss: Variable) -> int:
     raise ValueError(f"loss var {loss.name!r} is not produced by any op")
 
 
-def _collect_path_ops(block: Block, loss_idx: int) -> List[int]:
-    """Indices of ops that (transitively) produce the loss."""
-    needed: Set[str] = set(block.ops[loss_idx].output_names())
+def _collect_path_ops(block: Block, last_idx: int,
+                      seed: Optional[Set[str]] = None) -> List[int]:
+    """Indices of ops at or before `last_idx` that (transitively) produce
+    the seed vars (default: the outputs of op `last_idx`)."""
+    needed: Set[str] = set(seed) if seed is not None \
+        else set(block.ops[last_idx].output_names())
     path = []
-    for i in reversed(range(loss_idx + 1)):
+    for i in reversed(range(last_idx + 1)):
         op = block.ops[i]
         if set(op.output_names()) & needed:
             path.append(i)
@@ -101,6 +104,27 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
                         no_grad_set: Set[str]) -> List[Operator]:
     opdef = get_op_def(op.type)
     if opdef.not_differentiable:
+        # Silently dropping a gradient the loss depends on trains wrong —
+        # worse than an error (the reference differentiates through these
+        # via sub-block grad recursion, backward.py:422). Raise unless the
+        # op is provably grad-free (indices, comparisons, samplers) or no
+        # differentiable input feeds it.
+        if not opdef.grad_free \
+                and any(accum.contribs.get(n) for n in op.output_names()):
+            diff_ins = [n for n in op.input_names()
+                        if _var_wants_grad(block, n, no_grad_set)
+                        and block.has_var(n)
+                        and str(block.var(n).dtype).startswith("float")]
+            if diff_ins:
+                raise RuntimeError(
+                    f"op {op.type!r} lies on the loss path (the loss "
+                    f"depends on outputs {sorted(n for n in op.output_names() if accum.contribs.get(n))}) "
+                    f"but has no gradient; inputs {diff_ins} would "
+                    f"silently receive no gradient. Mark them "
+                    f"stop_gradient=True if that is intended"
+                    + (" (for While loops, pass max_trip_count to make "
+                       "them differentiable)" if op.type == "while"
+                       else ""))
         return []
 
     if opdef.grad_maker is not None:
@@ -116,6 +140,14 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
                                  for n in names]
                 else:
                     ins[slot] = list(names)
+            # vars whose downstream grad this op CONSUMES entirely (a loop
+            # carry: the grad it emits is w.r.t. the value at loop ENTRY).
+            # Reset their contribution list so upstream producers see only
+            # the grad emitted here, not the already-consumed one — the
+            # reference handles the same re-assignment problem by renaming
+            # (backward.py _rename_grad_).
+            for n in d.get("reset_grads", ()):
+                accum.contribs[n] = []
             outs = {}
             for slot, names in d["outputs"].items():
                 fixed = []
@@ -232,23 +264,51 @@ def append_backward(loss: Variable,
 def gradients(targets: Sequence[Variable], inputs: Sequence[Variable],
               target_gradients=None,
               no_grad_set: Optional[Set[str]] = None) -> List[Variable]:
-    """Compute grads of sum(targets) w.r.t. inputs (fluid.gradients analog)."""
-    if len(targets) != 1:
-        raise NotImplementedError("gradients() supports one target for now")
-    loss = targets[0]
-    block = loss.block
+    """Compute grads of sum(targets) w.r.t. inputs.
+
+    Multiple targets and explicit seed gradients are supported, matching
+    fluid.gradients (reference: python/paddle/fluid/backward.py:973
+    calc_gradient): each target is seeded with its target_gradient (or
+    ones), seeds and flow-through contributions merge via the usual
+    duplicate-sum machinery, and a single reverse sweep over the union of
+    the targets' forward paths emits the grad ops.
+    """
+    targets = list(targets)
+    if not targets:
+        raise ValueError("gradients() needs at least one target")
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    target_gradients = list(target_gradients)
+    if len(target_gradients) != len(targets):
+        raise ValueError(
+            f"{len(targets)} targets but {len(target_gradients)} "
+            "target_gradients")
+    block = targets[0].block
     no_grad = set(no_grad_set or ())
 
-    loss_idx = _find_loss_op_idx(block, loss)
-    path = _collect_path_ops(block, loss_idx)
+    # union of the targets' producing paths, in forward order
+    idxs = [_find_loss_op_idx(block, t) for t in targets]
+    path = _collect_path_ops(block, max(idxs),
+                             seed={t.name for t in targets})
+
     accum = _GradAccum(block)
-    loss_grad = grad_var_name(loss.name)
-    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype)
-    block.append_op("fill_constant", {}, {"Out": [loss_grad]},
-                    {"shape": list(loss.shape or (1,)), "dtype": loss.dtype,
-                     "value": 1.0, "op_role": "backward"},
-                    infer_shape=False)
-    accum.contribs[loss.name] = [loss_grad]
+    for t, tg in zip(targets, target_gradients):
+        if tg is not None:
+            if tuple(tg.shape) != tuple(t.shape):
+                raise ValueError(
+                    f"target_gradient {tg.name!r} shape {tg.shape} != "
+                    f"target {t.name!r} shape {t.shape}")
+            accum.contribs.setdefault(t.name, []).append(tg.name)
+            continue
+        seed = grad_var_name(t.name) if t.name not in accum.contribs \
+            else f"{grad_var_name(t.name)}@SEED"
+        block.create_var(name=seed, shape=t.shape, dtype=t.dtype)
+        # ones_like handles -1 (batch) dims that fill_constant cannot
+        block.append_op("fill_any_like", {"X": [t.name]},
+                        {"Out": [seed]},
+                        {"value": 1.0, "dtype": t.dtype,
+                         "op_role": "backward"}, infer_shape=False)
+        accum.contribs.setdefault(t.name, []).append(seed)
 
     grad_ops: List[Operator] = []
     for i in reversed(path):

@@ -67,7 +67,11 @@ class LayerHelper:
                                         if not is_bias else f"{self.name}.b")
         init = attr.initializer or default_initializer or (
             Constant(0.0) if is_bias else Xavier())
-        param = self.block.create_parameter(
+        # parameters always live in the GLOBAL block, even when the layer
+        # is built inside a control-flow sub-block (reference framework.py:
+        # Parameter is global-block-bound) — sub-block vars are loop-local
+        # and would not be seeded from the scope
+        param = self.main_program.global_block.create_parameter(
             name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
             regularizer=attr.regularizer)
         param.optimize_attrs["learning_rate"] = attr.learning_rate

@@ -55,6 +55,11 @@ class OpDef:
     grad_lower: Optional[Callable] = None
     # if True, op has NO gradient (grads of its inputs are zeros / skipped)
     not_differentiable: bool = False
+    # for not_differentiable ops: True means a zero/absent gradient is
+    # mathematically intended (argmax, comparisons, samplers, box codecs);
+    # False means silently dropping the gradient would train wrong, so
+    # backward RAISES if the loss depends on this op's output
+    grad_free: bool = False
     # fn(op) -> set of forward-input slots whose grads are SelectedRows
     # (e.g. lookup_table with is_sparse=True); backward marks those grad
     # vars' Variable.type = "selected_rows"
@@ -89,8 +94,9 @@ _MACROS: Dict[str, Callable] = {}
 def register_macro_op(op_type: str, **opdef_kw):
     def deco(fn):
         _MACROS[op_type] = fn
-        _REGISTRY[op_type] = OpDef(type=op_type, lower=None,
-                                   not_differentiable=True, **opdef_kw)
+        opdef_kw.setdefault("not_differentiable",
+                            "grad_maker" not in opdef_kw)
+        _REGISTRY[op_type] = OpDef(type=op_type, lower=None, **opdef_kw)
         return fn
     return deco
 
@@ -153,11 +159,16 @@ class LowerContext:
     """
 
     def __init__(self, rng_key=None, is_test: bool = False,
-                 abstract: bool = False, mesh=None, spmd_axes=()):
+                 abstract: bool = False, mesh=None, spmd_axes=(),
+                 differentiable: bool = False):
         self._rng_key = rng_key
         self._counter = 0
         self.is_test = is_test
         self.abstract = abstract  # True during eval_shape inference
+        # True while tracing under jax.vjp (a macro grad op's replay):
+        # everything lowered must be reverse-differentiable, so while ops
+        # switch from lax.while_loop to their bounded masked-scan form
+        self.differentiable = differentiable
         self.mesh = mesh          # jax.sharding.Mesh when running sharded
         # mesh axis names live under an enclosing shard_map (explicit-SPMD
         # execution mode): collective ops (c_allreduce_* ...) lower to named

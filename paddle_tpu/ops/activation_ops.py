@@ -77,7 +77,7 @@ for _name, _fn in _ACTS.items():
 
 # non-differentiable rounding ops
 def _register_round(name, fn):
-    @register_op(name, not_differentiable=True)
+    @register_op(name, not_differentiable=True, grad_free=True)
     def _lower(ctx, ins, attrs, _fn=fn):
         return {"Out": [_fn(ins["X"][0])]}
 

@@ -1,21 +1,41 @@
-"""Control-flow macro ops: while / cond over sub-blocks.
+"""Control-flow macro ops: while / cond over sub-blocks + recurrent (scan).
 
 Reference: paddle/fluid/operators/controlflow/while_op.cc (runs a sub-block
-with a nested Executor per iteration) and conditional_block_op.cc. TPU
-redesign: the sub-block's ops are traced into a lax.while_loop body /
-lax.cond branches — compiler-friendly structured control flow instead of a
-host interpreter loop, so the whole loop lives inside the single XLA
-computation.
+with a nested Executor per iteration, WhileGradOp for the backward pass),
+conditional_block_op.cc, and recurrent_op.cc (static RNN with step scopes).
+TPU redesign: the sub-block's ops are traced into lax.while_loop /
+lax.cond / lax.scan bodies — compiler-friendly structured control flow
+instead of a host interpreter loop, so the whole loop lives inside the
+single XLA computation.
 
 Carried state = every var written in the sub-block that was defined outside
 it (same liveness rule the reference's while_op uses to decide what
 persists across step scopes). Shapes must be loop-invariant (XLA).
+
+Gradients (reference: backward.py:422 sub-block recursion + WhileGradOp):
+instead of emitting per-op grad descs inside the sub-block, each macro grad
+op re-lowers its sub-block into a *differentiable* functional form and
+calls jax.vjp on it:
+
+  * while_grad   — replays the loop as a bounded masked lax.scan over
+                   `max_trip_count` steps (lax.while_loop itself is not
+                   reverse-differentiable); iterations past the dynamic
+                   condition keep the carry frozen, so the replay computes
+                   exactly the while_loop's fixpoint.
+  * cond_block_grad — replays lax.cond (natively differentiable).
+  * recurrent_grad  — replays lax.scan (natively differentiable).
+
+RNG determinism: the forward stashes its base PRNG key (and the loop-entry
+value of every carried/read var) in the trace environment under reserved
+`@while@...`/`@cond@...`/`@rnn@...` names; the grad replay folds the same
+per-iteration keys, so dropout masks etc. reproduce bit-exactly.
 """
 
 import jax
 import jax.numpy as jnp
 
 from ..framework.registry import register_macro_op, lower_op, LowerContext
+from ..framework.core import GRAD_SUFFIX
 
 
 def _carry_names(sub_block, env):
@@ -30,12 +50,160 @@ def _carry_names(sub_block, env):
     return written
 
 
+def _block_outer_reads(program, sub_block):
+    """Names read (transitively, through nested macro sub-blocks) by the
+    sub-block's ops that resolve OUTSIDE the sub-block — the loop/branch
+    closure. Deterministic build-time analog of the reference while_op's
+    X input list."""
+    reads, local = [], set()
+    seen = set()
+
+    def walk(block):
+        for op in block.ops:
+            for n in op.input_names():
+                if n and n not in block.vars and n not in seen:
+                    seen.add(n)
+                    reads.append(n)
+            for key in ("sub_block", "sub_block_t", "sub_block_f"):
+                if key in op.attrs:
+                    walk(program.blocks[op.attrs[key]])
+
+    walk(sub_block)
+    return [n for n in reads if n not in sub_block.vars]
+
+
 def _run_block(sub_block, env, ctx):
     for op in sub_block.ops:
         lower_op(ctx, op, env)
 
 
-@register_macro_op("while")
+def _sub_ctx(ctx, key, differentiable=None):
+    c = LowerContext(is_test=ctx.is_test, abstract=ctx.abstract,
+                     mesh=ctx.mesh, spmd_axes=ctx.spmd_axes,
+                     differentiable=(ctx.differentiable
+                                     if differentiable is None
+                                     else differentiable))
+    c._rng_key = key
+    return c
+
+
+def _is_inexact(v):
+    return jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)
+
+
+def _macro_diff_inputs(op, block, no_grad_set, names):
+    """Filter closure/carry names down to those that want float grads."""
+    from ..framework.backward import _var_wants_grad
+    out = []
+    for n in names:
+        if n in out or not _var_wants_grad(block, n, no_grad_set):
+            continue
+        if block.has_var(n) and str(block.var(n).dtype).startswith("float"):
+            out.append(n)
+    return out
+
+
+def _vjp_into_env(op, env, f, primals, out_pairs):
+    """Common tail of every macro grad op: jax.vjp(f, *primals), seed with
+    the out-grads from env (zeros where the desc carries ""), then write
+    the input grads into env under the op's X@GRAD output names.
+
+    out_pairs: [(grad_var_name_or_empty, ...)] aligned with f's outputs.
+    """
+    primals_out, vjp_fn = jax.vjp(f, *primals)
+    cots = []
+    for gname, primal in zip(out_pairs, primals_out):
+        if gname and gname in env:
+            cots.append(jnp.asarray(env[gname], dtype=primal.dtype))
+        else:
+            cots.append(jnp.zeros_like(primal))
+    grads = vjp_fn(tuple(cots))
+    gnames = op.output("X" + GRAD_SUFFIX)
+    for n, g in zip(gnames, grads):
+        if n:
+            env[n] = g
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+def _while_grad_maker(op, block, no_grad_set):
+    program = block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    carry = list(op.output("Out"))
+    cond_name = op.input("Condition")[0]
+    if cond_name not in carry:
+        carry.append(cond_name)
+    closure = _block_outer_reads(program, sub)
+    diff = _macro_diff_inputs(op, block, no_grad_set,
+                              closure + carry)
+    if not diff:
+        # nothing differentiable feeds the loop: every float it touches is
+        # stop_gradient, so no stale contributions can exist either
+        return []
+    if "max_trip_count" not in op.attrs:
+        raise RuntimeError(
+            "cannot differentiate a While loop without a static trip bound "
+            "(XLA's reverse-mode AD needs a bounded scan form); pass "
+            f"max_trip_count=N to layers.While / layers.while_loop, or mark "
+            f"the loop's float inputs/carries ({diff}) stop_gradient=True")
+    return [{
+        "type": "while_grad",
+        "inputs": {"X": diff,
+                   "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                         for n in op.output("Out")]},
+        "outputs": {"X" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in diff]},
+        "attrs": {"sub_block": op.attrs["sub_block"],
+                  "max_trip_count": int(op.attrs["max_trip_count"]),
+                  "carry_hint": list(op.output("Out")),
+                  "cond_name": cond_name},
+        # the grads this op emits for carried vars are w.r.t. the value at
+        # loop ENTRY; the downstream (post-loop) contributions were fully
+        # consumed as cotangents here
+        "reset_grads": [n for n in carry],
+    }]
+
+
+def _run_while(ctx, sub, outer, carry, cond_name, base_key, trip_bound):
+    """Run the loop over `outer` bindings and return the final carry dict.
+
+    trip_bound=None -> lax.while_loop (fast path). trip_bound=T -> masked
+    length-T lax.scan computing the same fixpoint (the carry — including
+    the condition — freezes at the first False), which XLA CAN reverse-
+    differentiate. The scan form is used for grad replays and whenever
+    this loop is itself nested inside a differentiating trace.
+    """
+    init = {n: outer[n] for n in carry}
+    init["@iter@"] = jnp.zeros((), jnp.int32)
+
+    def body(c):
+        benv = dict(outer)
+        benv.update({k: v for k, v in c.items() if k != "@iter@"})
+        # per-iteration rng stream keyed on the loop counter
+        bctx = _sub_ctx(ctx, jax.random.fold_in(base_key, c["@iter@"]))
+        _run_block(sub, benv, bctx)
+        out = {n: benv[n] for n in carry}
+        out["@iter@"] = c["@iter@"] + 1
+        return out
+
+    if trip_bound is None:
+        def cond_fn(c):
+            return jnp.asarray(c[cond_name]).reshape(()).astype(jnp.bool_)
+        return jax.lax.while_loop(cond_fn, body, init)
+
+    def step(c, _):
+        active = jnp.asarray(c[cond_name]).reshape(()).astype(jnp.bool_)
+        new = body(c)
+        merged = {n: jnp.where(active, new[n], c[n]) for n in carry}
+        merged["@iter@"] = c["@iter@"] + 1
+        return merged, None
+
+    final, _ = jax.lax.scan(step, init, None, length=int(trip_bound))
+    return final
+
+
+@register_macro_op("while", grad_maker=_while_grad_maker)
 def _while(ctx, op, env):
     program = op.block.program
     sub = program.blocks[op.attrs["sub_block"]]
@@ -43,32 +211,104 @@ def _while(ctx, op, env):
     carry = _carry_names(sub, env)
     if cond_name not in carry:
         carry = carry + [cond_name]
-
-    init = {n: env[n] for n in carry}
-    init["@iter@"] = jnp.zeros((), jnp.int32)
     base_key = ctx.rng()
 
-    def cond_fn(c):
-        return jnp.asarray(c[cond_name]).reshape(()).astype(jnp.bool_)
+    # stash loop-entry state for the grad replay (while overwrites its
+    # carries in env, so the post-loop values are useless for AD)
+    tag = f"@while@{sub.idx}@"
+    env[tag + "key"] = base_key
+    for n in carry:
+        env[tag + "in@" + n] = env[n]
 
-    def body_fn(c):
-        body_env = dict(env)
-        body_env.update({k: v for k, v in c.items() if k != "@iter@"})
-        body_ctx = LowerContext(is_test=ctx.is_test, mesh=ctx.mesh,
-                                spmd_axes=ctx.spmd_axes)
-        # per-iteration rng stream keyed on the loop counter
-        body_ctx._rng_key = jax.random.fold_in(base_key, c["@iter@"])
-        _run_block(sub, body_env, body_ctx)
-        out = {n: body_env[n] for n in carry}
-        out["@iter@"] = c["@iter@"] + 1
-        return out
+    trip_bound = None
+    if ctx.differentiable:
+        # we are inside an enclosing grad replay: lax.while_loop would be
+        # un-reversible, so lower the bounded scan form instead
+        if "max_trip_count" not in op.attrs:
+            raise RuntimeError(
+                "a While loop without max_trip_count is nested inside a "
+                "differentiated control-flow construct; pass "
+                "max_trip_count=N so its gradient can be computed")
+        trip_bound = int(op.attrs["max_trip_count"])
 
-    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    final = _run_while(ctx, sub, env, carry, cond_name, base_key,
+                       trip_bound)
     for n in carry:
         env[n] = final[n]
 
 
-@register_macro_op("cond_block")
+@register_macro_op("while_grad")
+def _while_grad(ctx, op, env):
+    program = op.block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    T = int(op.attrs["max_trip_count"])
+    cond_name = op.attrs["cond_name"]
+    tag = f"@while@{sub.idx}@"
+    base_key = env[tag + "key"]
+
+    # same carry computation as the forward lowering (env membership for
+    # these names is unchanged by appended grad vars)
+    carry = _carry_names(sub, env)
+    if cond_name not in carry:
+        carry = carry + [cond_name]
+    entry = {n: env[tag + "in@" + n] for n in carry}
+
+    diff = op.input("X")
+    primals = [entry[n] if n in entry else env[n] for n in diff]
+
+    # name -> grad-var for the forward's declared outputs
+    out_names = op.attrs["carry_hint"]
+    gmap = dict(zip(out_names, op.input("Out" + GRAD_SUFFIX)))
+
+    gctx = _sub_ctx(ctx, None, differentiable=True)
+
+    def f(*vals):
+        outer = dict(env)
+        outer.update(entry)
+        outer.update(dict(zip(diff, vals)))
+        fin = _run_while(gctx, sub, outer, carry, cond_name, base_key, T)
+        return tuple(fin[n] for n in carry if _is_inexact(entry[n]))
+
+    out_pairs = [gmap.get(n, "") for n in carry if _is_inexact(entry[n])]
+    _vjp_into_env(op, env, f, primals, out_pairs)
+
+
+# ---------------------------------------------------------------------------
+# cond_block
+# ---------------------------------------------------------------------------
+
+def _cond_grad_maker(op, block, no_grad_set):
+    program = block.program
+    tb = program.blocks[op.attrs["sub_block_t"]]
+    fb = program.blocks[op.attrs["sub_block_f"]]
+    closure = _block_outer_reads(program, tb) + \
+        _block_outer_reads(program, fb)
+    # branch RETURN names that resolve outside their block are reads too:
+    # a Switch pass-through branch has no ops at all, it just returns the
+    # outer var — missing it here would leave the stale downstream grad
+    # flowing around this op as if it did not exist
+    for rets, blk in ((op.attrs["true_rets"], tb),
+                      (op.attrs["false_rets"], fb)):
+        closure += [n for n in rets if n not in blk.vars]
+    closure += list(op.input("X"))
+    diff = _macro_diff_inputs(op, block, no_grad_set, closure)
+    if not diff:
+        return []
+    return [{
+        "type": "cond_block_grad",
+        "inputs": {"X": diff,
+                   "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                         for n in op.output("Out")]},
+        "outputs": {"X" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in diff]},
+        "attrs": {k: op.attrs[k] for k in
+                  ("sub_block_t", "sub_block_f", "true_rets", "false_rets")}
+        | {"cond_var": op.input("Cond")[0],
+           "out_hint": list(op.output("Out"))},
+        "reset_grads": list(op.output("Out")),
+    }]
+
+
+@register_macro_op("cond_block", grad_maker=_cond_grad_maker)
 def _cond_block(ctx, op, env):
     """Two-branch conditional: attrs sub_block_t / sub_block_f; outputs Out
     are filled from attr-listed branch result names (true_rets/false_rets)."""
@@ -81,18 +321,201 @@ def _cond_block(ctx, op, env):
     f_rets = op.attrs["false_rets"]
     out_names = op.output("Out")
 
-    def make_branch(block, rets):
+    t_key = ctx.rng() if not ctx.abstract else None
+    f_key = ctx.rng() if not ctx.abstract else None
+    # stash branch-entry state: outputs may overwrite outer vars the
+    # untaken branch passes through (Switch), so the grad replay needs
+    # the pre-op values
+    tag = f"@cond@{tb.idx}@"
+    env[tag + "tkey"] = t_key
+    env[tag + "fkey"] = f_key
+    for n in set(_block_outer_reads(program, tb)
+                 + _block_outer_reads(program, fb) + list(out_names)):
+        if n in env:
+            env[tag + "in@" + n] = env[n]
+
+    def make_branch(block, rets, key):
         def branch(_):
             benv = dict(env)
-            bctx = LowerContext(rng_key=ctx.rng() if not ctx.abstract
-                                else None,
-                                is_test=ctx.is_test, mesh=ctx.mesh,
-                                spmd_axes=ctx.spmd_axes)
+            bctx = _sub_ctx(ctx, key)
             _run_block(block, benv, bctx)
             return [benv[r] for r in rets]
         return branch
 
-    outs = jax.lax.cond(pred, make_branch(tb, t_rets),
-                        make_branch(fb, f_rets), operand=None)
+    outs = jax.lax.cond(pred, make_branch(tb, t_rets, t_key),
+                        make_branch(fb, f_rets, f_key), operand=None)
     for n, v in zip(out_names, outs):
         env[n] = v
+
+
+@register_macro_op("cond_block_grad")
+def _cond_block_grad(ctx, op, env):
+    program = op.block.program
+    tb = program.blocks[op.attrs["sub_block_t"]]
+    fb = program.blocks[op.attrs["sub_block_f"]]
+    t_rets = op.attrs["true_rets"]
+    f_rets = op.attrs["false_rets"]
+    out_names = op.attrs["out_hint"]
+    tag = f"@cond@{tb.idx}@"
+    pred_name = op.attrs["cond_var"]
+
+    def entry(n):
+        return env.get(tag + "in@" + n, env.get(n))
+
+    pred = jnp.asarray(entry(pred_name)).reshape(()).astype(jnp.bool_)
+    diff = op.input("X")
+    primals = [entry(n) for n in diff]
+    gnames = op.input("Out" + GRAD_SUFFIX)
+
+    def f(*vals):
+        outer = dict(env)
+        for n in list(out_names) + list(diff):
+            if tag + "in@" + n in env:
+                outer[n] = env[tag + "in@" + n]
+        outer.update(dict(zip(diff, vals)))
+
+        def make_branch(block, rets, key):
+            def branch(_):
+                benv = dict(outer)
+                bctx = _sub_ctx(ctx, key, differentiable=True)
+                _run_block(block, benv, bctx)
+                return [benv[r] for r in rets]
+            return branch
+
+        outs = jax.lax.cond(
+            pred, make_branch(tb, t_rets, env[tag + "tkey"]),
+            make_branch(fb, f_rets, env[tag + "fkey"]), operand=None)
+        return tuple(o for o in outs if _is_inexact(o))
+
+    # align cotangent names with the float outputs f returns
+    kept = []
+    for n, g in zip(out_names, gnames):
+        v = env.get(n)
+        if v is None or _is_inexact(v):
+            kept.append(g)
+    _vjp_into_env(op, env, f, primals, kept)
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN / DynamicRNN): time-major lax.scan over a sub-block
+# ---------------------------------------------------------------------------
+#
+# attrs:
+#   sub_block     step body
+#   step_inputs   [[outer_seq_name, inner_step_name], ...]  (outer: [T,...])
+#   memories      [[boot_name, pre_name, post_name], ...]
+#   step_outputs  [[inner_name, outer_stacked_name], ...]   (outer: [T,...])
+#   lengths       optional name of a [B] int32 lengths var (DynamicRNN):
+#                 memories freeze and outputs zero once t >= length
+#
+# Reference: operators/recurrent_op.cc (step-scope interpreter loop);
+# layers/control_flow.py:294 StaticRNN, :1714 DynamicRNN.
+
+def _recurrent_grad_maker(op, block, no_grad_set):
+    program = block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    seq_outers = [o for o, _ in op.attrs["step_inputs"]]
+    boots = [b for b, _, _ in op.attrs["memories"]]
+    closure = _block_outer_reads(program, sub)
+    diff = _macro_diff_inputs(op, block, no_grad_set,
+                              seq_outers + boots + closure)
+    if not diff:
+        return []
+    return [{
+        "type": "recurrent_grad",
+        "inputs": {"X": diff,
+                   "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
+                                         for n in op.output("Out")]},
+        "outputs": {"X" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in diff]},
+        "attrs": {k: op.attrs[k] for k in
+                  ("sub_block", "step_inputs", "memories", "step_outputs")}
+        | ({"lengths": op.attrs["lengths"]} if "lengths" in op.attrs
+           else {}) | {"out_hint": list(op.output("Out"))},
+    }]
+
+
+def _scan_recurrent(ctx, env, attrs, program):
+    """Shared forward computation: returns {outer_stacked_name: value}."""
+    sub = program.blocks[attrs["sub_block"]]
+    step_inputs = attrs["step_inputs"]
+    memories = attrs["memories"]
+    step_outputs = attrs["step_outputs"]
+    lengths = env[attrs["lengths"]] if attrs.get("lengths") else None
+
+    xs = {inner: jnp.asarray(env[outer]) for outer, inner in step_inputs}
+    init = {pre: jnp.asarray(env[boot]) for boot, pre, _ in memories}
+    init["@t@"] = jnp.zeros((), jnp.int32)
+    base_key = env[f"@rnn@{sub.idx}@key"]
+
+    def step(c, xt):
+        benv = dict(env)
+        benv.update(xt)
+        benv.update({k: v for k, v in c.items() if k != "@t@"})
+        bctx = _sub_ctx(ctx, jax.random.fold_in(base_key, c["@t@"]))
+        _run_block(sub, benv, bctx)
+        if lengths is not None:
+            active = c["@t@"] < lengths  # [B]
+        new = {}
+        for boot, pre, post in memories:
+            v = benv[post]
+            if lengths is not None:
+                mask = active.reshape((-1,) + (1,) * (v.ndim - 1))
+                v = jnp.where(mask, v, c[pre])
+            new[pre] = v
+        new["@t@"] = c["@t@"] + 1
+        ys = {}
+        for inner, outer in step_outputs:
+            v = benv[inner]
+            if lengths is not None:
+                mask = active.reshape((-1,) + (1,) * (v.ndim - 1))
+                v = jnp.where(mask, v, jnp.zeros_like(v))
+            ys[inner] = v
+        return new, ys
+
+    _, stacked = jax.lax.scan(step, init, xs)
+    return {outer: stacked[inner] for inner, outer in step_outputs}
+
+
+@register_macro_op("recurrent", grad_maker=_recurrent_grad_maker)
+def _recurrent(ctx, op, env):
+    program = op.block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    tag = f"@rnn@{sub.idx}@"
+    env[tag + "key"] = ctx.rng()
+    # stash closure entry values: a later op may overwrite a read var
+    # before the grad op replays the scan
+    for n in _block_outer_reads(program, sub) + \
+            [o for o, _ in op.attrs["step_inputs"]] + \
+            [b for b, _, _ in op.attrs["memories"]]:
+        if n in env:
+            env.setdefault(tag + "in@" + n, env[n])
+    outs = _scan_recurrent(ctx, env, op.attrs, program)
+    for outer, v in outs.items():
+        env[outer] = v
+
+
+@register_macro_op("recurrent_grad")
+def _recurrent_grad(ctx, op, env):
+    program = op.block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    tag = f"@rnn@{sub.idx}@"
+    diff = op.input("X")
+    primals = [env.get(tag + "in@" + n, env.get(n)) for n in diff]
+    out_names = op.attrs["out_hint"]
+    gnames = op.input("Out" + GRAD_SUFFIX)
+
+    gctx = _sub_ctx(ctx, None, differentiable=True)
+
+    def f(*vals):
+        outer = dict(env)
+        for n in diff:
+            if tag + "in@" + n in env:
+                outer[n] = env[tag + "in@" + n]
+        outer.update(dict(zip(diff, vals)))
+        outs = _scan_recurrent(gctx, outer, op.attrs, program)
+        return tuple(outs[n] for n in out_names if _is_inexact(outs[n]))
+
+    # recompute which outputs are float to align cotangents
+    kept = [g for n, g in zip(out_names, gnames)
+            if n in env and _is_inexact(env[n])]
+    _vjp_into_env(op, env, f, primals, kept)

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from ..framework.registry import register_op
 
 
-@register_op("prior_box", not_differentiable=True)
+@register_op("prior_box", not_differentiable=True, grad_free=True)
 def _prior_box(ctx, ins, attrs):
     """SSD prior boxes (reference: detection/prior_box_op.cc). Input
     feature map [n,c,h,w] + image [n,c,H,W]; outputs Boxes/Variances
@@ -62,7 +62,7 @@ def _prior_box(ctx, ins, attrs):
             "Variances": [variances.astype(jnp.float32)]}
 
 
-@register_op("anchor_generator", not_differentiable=True)
+@register_op("anchor_generator", not_differentiable=True, grad_free=True)
 def _anchor_generator(ctx, ins, attrs):
     """RPN anchors (reference: detection/anchor_generator_op.cc). Outputs
     Anchors/Variances [h, w, num_anchors, 4] in input-image pixels."""
@@ -148,14 +148,14 @@ def _iou_matrix(a, b, normalized=True):
     return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
 
 
-@register_op("iou_similarity", not_differentiable=True)
+@register_op("iou_similarity", not_differentiable=True, grad_free=True)
 def _iou_similarity(ctx, ins, attrs):
     """reference: detection/iou_similarity_op.cc — X [n,4] vs Y [m,4]."""
     return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0],
                                 attrs.get("box_normalized", True))]}
 
 
-@register_op("yolo_box", not_differentiable=True)
+@register_op("yolo_box", not_differentiable=True, grad_free=True)
 def _yolo_box(ctx, ins, attrs):
     """Decode YOLOv3 head output (reference: detection/yolo_box_op.cc).
     X [n, an*(5+cls), h, w], ImgSize [n,2] -> Boxes [n, h*w*an, 4],
@@ -199,7 +199,7 @@ def _yolo_box(ctx, ins, attrs):
     return {"Boxes": [boxes], "Scores": [scores]}
 
 
-@register_op("multiclass_nms", not_differentiable=True)
+@register_op("multiclass_nms", not_differentiable=True, grad_free=True)
 def _multiclass_nms(ctx, ins, attrs):
     """Fixed-size NMS (reference: detection/multiclass_nms_op.cc returns a
     LoD tensor; here: Out [n, keep_top_k, 6] = (label, score, x1,y1,x2,y2)
@@ -313,7 +313,7 @@ def _roi_align(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register_op("box_clip", not_differentiable=True)
+@register_op("box_clip", not_differentiable=True, grad_free=True)
 def _box_clip(ctx, ins, attrs):
     """reference: detection/box_clip_op.cc — clip boxes to image."""
     boxes, im_info = ins["Input"][0], ins["ImInfo"][0]

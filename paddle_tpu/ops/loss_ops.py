@@ -151,7 +151,7 @@ def _linear_chain_crf(ctx, ins, attrs):
     return {"LogLikelihood": [(path - logz)[:, None]]}
 
 
-@register_op("crf_decoding", not_differentiable=True)
+@register_op("crf_decoding", not_differentiable=True, grad_free=True)
 def _crf_decoding(ctx, ins, attrs):
     """Viterbi decode (reference crf_decoding_op.cc). Same inputs minus
     Label; Out: ViterbiPath [b, T] (zeros past each length)."""

@@ -120,7 +120,7 @@ def _dot(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 def _register_reduce(name, fn, not_diff=False):
-    @register_op(name, not_differentiable=not_diff)
+    @register_op(name, not_differentiable=not_diff, grad_free=not_diff)
     def _lower(ctx, ins, attrs, _fn=fn):
         x = ins["X"][0]
         if attrs.get("reduce_all", False):
@@ -277,7 +277,7 @@ def _logsumexp(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 def _register_cmp(name, fn):
-    @register_op(name, not_differentiable=True)
+    @register_op(name, not_differentiable=True, grad_free=True)
     def _lower(ctx, ins, attrs, _fn=fn):
         return {"Out": [_fn(ins["X"][0], ins["Y"][0])]}
 
@@ -293,12 +293,12 @@ _register_cmp("logical_or", jnp.logical_or)
 _register_cmp("logical_xor", jnp.logical_xor)
 
 
-@register_op("logical_not", not_differentiable=True)
+@register_op("logical_not", not_differentiable=True, grad_free=True)
 def _logical_not(ctx, ins, attrs):
     return {"Out": [jnp.logical_not(ins["X"][0])]}
 
 
-@register_op("isfinite", not_differentiable=True)
+@register_op("isfinite", not_differentiable=True, grad_free=True)
 def _isfinite(ctx, ins, attrs):
     """reference: operators/isfinite_op.cc — nan/inf sanitizer primitive."""
     x = ins["X"][0]

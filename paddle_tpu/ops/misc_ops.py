@@ -137,7 +137,7 @@ def _add_position_encoding(ctx, ins, attrs):
 # linalg helpers
 # ---------------------------------------------------------------------------
 
-@register_op("linspace", not_differentiable=True)
+@register_op("linspace", not_differentiable=True, grad_free=True)
 def _linspace(ctx, ins, attrs):
     """`num` must be a static attr: a tensor Num would be a dynamic output
     shape, which XLA cannot express (reject at build, not mid-trace)."""
@@ -149,7 +149,7 @@ def _linspace(ctx, ins, attrs):
     return {"Out": [jnp.linspace(start, stop, int(attrs["num"]))]}
 
 
-@register_op("shard_index", not_differentiable=True)
+@register_op("shard_index", not_differentiable=True, grad_free=True)
 def _shard_index(ctx, ins, attrs):
     """reference shard_index_op.cc: map global ids to shard-local ids
     (ignore_value outside this shard)."""
@@ -207,12 +207,12 @@ def _trace(ctx, ins, attrs):
                               axis2=attrs.get("axis2", 1))]}
 
 
-@register_op("diag", not_differentiable=True)
+@register_op("diag", not_differentiable=True, grad_free=True)
 def _diag(ctx, ins, attrs):
     return {"Out": [jnp.diag(ins["Diagonal"][0])]}
 
 
-@register_op("meshgrid", not_differentiable=True)
+@register_op("meshgrid", not_differentiable=True, grad_free=True)
 def _meshgrid(ctx, ins, attrs):
     outs = jnp.meshgrid(*ins["X"], indexing="ij")
     return {"Out": list(outs)}
@@ -319,7 +319,7 @@ def _ts_sigmoid_loss(ctx, ins, attrs):
 # decode utilities
 # ---------------------------------------------------------------------------
 
-@register_op("gather_tree", not_differentiable=True)
+@register_op("gather_tree", not_differentiable=True, grad_free=True)
 def _gather_tree(ctx, ins, attrs):
     """Backtrace beam-search parent pointers (reference
     gather_tree_op.cc): Ids/Parents [t, b, beam] -> full sequences."""
@@ -337,7 +337,7 @@ def _gather_tree(ctx, ins, attrs):
     return {"Out": [jnp.flip(outs, axis=0)]}
 
 
-@register_op("sampling_id", not_differentiable=True, stateful=True)
+@register_op("sampling_id", not_differentiable=True, grad_free=True, stateful=True)
 def _sampling_id(ctx, ins, attrs):
     """Sample a column index per row from probabilities (reference
     sampling_id_op.cc)."""
@@ -381,11 +381,11 @@ def _print(ctx, ins, attrs):
     return {"Out": [x]}
 
 
-@register_op("isnan", not_differentiable=True)
+@register_op("isnan", not_differentiable=True, grad_free=True)
 def _isnan(ctx, ins, attrs):
     return {"Out": [jnp.any(jnp.isnan(ins["X"][0])).reshape((1,))]}
 
 
-@register_op("isinf", not_differentiable=True)
+@register_op("isinf", not_differentiable=True, grad_free=True)
 def _isinf(ctx, ins, attrs):
     return {"Out": [jnp.any(jnp.isinf(ins["X"][0])).reshape((1,))]}

@@ -471,7 +471,7 @@ def _kldiv_loss(ctx, ins, attrs):
 # metrics (reference: operators/metrics/)
 # ---------------------------------------------------------------------------
 
-@register_op("accuracy", not_differentiable=True)
+@register_op("accuracy", not_differentiable=True, grad_free=True)
 def _accuracy(ctx, ins, attrs):
     """reference: metrics/accuracy_op.cc — takes top-k Indices + Label."""
     idx = ins["Indices"][0]

@@ -82,7 +82,7 @@ def _fake_qdq_moving(ctx, ins, attrs):
     return {"Out": [out], "OutScale": [scale.reshape(())]}
 
 
-@register_op("quantize_abs_max", not_differentiable=True)
+@register_op("quantize_abs_max", not_differentiable=True, grad_free=True)
 def _quantize_abs_max(ctx, ins, attrs):
     """Real int8 quantization for the freeze/export path."""
     x = ins["X"][0]
@@ -93,7 +93,7 @@ def _quantize_abs_max(ctx, ins, attrs):
     return {"Out": [q.astype(jnp.int8)], "OutScale": [scale.reshape(())]}
 
 
-@register_op("dequantize_abs_max", not_differentiable=True)
+@register_op("dequantize_abs_max", not_differentiable=True, grad_free=True)
 def _dequantize_abs_max(ctx, ins, attrs):
     x = ins["X"][0]
     scale = ins["Scale"][0].reshape(())

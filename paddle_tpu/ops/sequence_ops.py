@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from ..framework.registry import register_op
 
 
-@register_op("sequence_mask", not_differentiable=True)
+@register_op("sequence_mask", not_differentiable=True, grad_free=True)
 def _sequence_mask(ctx, ins, attrs):
     """reference: sequence_ops/sequence_mask_op.cc"""
     x = ins["X"][0].reshape(-1)
@@ -147,7 +147,7 @@ def _sequence_unpad(ctx, ins, attrs):
     return {"Out": [x if m is None else x * m.astype(x.dtype)]}
 
 
-@register_op("sequence_enumerate", not_differentiable=True)
+@register_op("sequence_enumerate", not_differentiable=True, grad_free=True)
 def _sequence_enumerate(ctx, ins, attrs):
     x = ins["X"][0]  # [b, s] int ids
     win = attrs["win_size"]
@@ -161,7 +161,7 @@ def _sequence_enumerate(ctx, ins, attrs):
     return {"Out": [jnp.stack(cols, axis=-1)]}
 
 
-@register_op("sequence_erase", not_differentiable=True)
+@register_op("sequence_erase", not_differentiable=True, grad_free=True)
 def _sequence_erase(ctx, ins, attrs):
     """Dense analog: replace erased tokens with pad (0) instead of
     compacting (static shapes)."""

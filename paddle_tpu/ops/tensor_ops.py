@@ -312,7 +312,7 @@ def _lookup_table_v2(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register_op("one_hot", not_differentiable=True)
+@register_op("one_hot", not_differentiable=True, grad_free=True)
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0]
     if x.ndim > 1 and x.shape[-1] == 1:
@@ -332,7 +332,7 @@ def _where(ctx, ins, attrs):
     return {"Out": [jnp.where(c, x, y)]}
 
 
-@register_op("where_index", not_differentiable=True)
+@register_op("where_index", not_differentiable=True, grad_free=True)
 def _where_index(ctx, ins, attrs):
     # dynamic-shape op; returns padded indices (static-shape TPU variant)
     c = ins["Condition"][0]
@@ -344,14 +344,14 @@ def _where_index(ctx, ins, attrs):
 # fill / init / cast / assign
 # ---------------------------------------------------------------------------
 
-@register_op("fill_constant", not_differentiable=True)
+@register_op("fill_constant", not_differentiable=True, grad_free=True)
 def _fill_constant(ctx, ins, attrs):
     shape = tuple(attrs["shape"])
     dtype = attrs.get("dtype", "float32")
     return {"Out": [jnp.full(shape, attrs["value"], dtype=dtype)]}
 
 
-@register_op("fill_constant_batch_size_like", not_differentiable=True)
+@register_op("fill_constant_batch_size_like", not_differentiable=True, grad_free=True)
 def _fill_cbsl(ctx, ins, attrs):
     ref = ins["Input"][0]
     shape = list(attrs["shape"])
@@ -362,12 +362,12 @@ def _fill_cbsl(ctx, ins, attrs):
                              dtype=attrs.get("dtype", "float32"))]}
 
 
-@register_op("fill_zeros_like", not_differentiable=True)
+@register_op("fill_zeros_like", not_differentiable=True, grad_free=True)
 def _fill_zeros_like(ctx, ins, attrs):
     return {"Out": [jnp.zeros_like(ins["X"][0])]}
 
 
-@register_op("fill_any_like", not_differentiable=True)
+@register_op("fill_any_like", not_differentiable=True, grad_free=True)
 def _fill_any_like(ctx, ins, attrs):
     x = ins["X"][0]
     dtype = attrs.get("dtype") or x.dtype
@@ -379,7 +379,7 @@ def _assign(ctx, ins, attrs):
     return {"Out": [ins["X"][0]]}
 
 
-@register_op("assign_value", not_differentiable=True)
+@register_op("assign_value", not_differentiable=True, grad_free=True)
 def _assign_value(ctx, ins, attrs):
     vals = np.asarray(attrs["values"], dtype=attrs.get("dtype", "float32"))
     return {"Out": [jnp.asarray(vals.reshape(attrs["shape"]))]}
@@ -390,18 +390,18 @@ def _cast(ctx, ins, attrs):
     return {"Out": [ins["X"][0].astype(attrs["out_dtype"])]}
 
 
-@register_op("shape", not_differentiable=True)
+@register_op("shape", not_differentiable=True, grad_free=True)
 def _shape(ctx, ins, attrs):
     x = ins["Input"][0]
     return {"Out": [jnp.asarray(x.shape, dtype=jnp.int32)]}
 
 
-@register_op("size", not_differentiable=True)
+@register_op("size", not_differentiable=True, grad_free=True)
 def _size(ctx, ins, attrs):
     return {"Out": [jnp.asarray([ins["Input"][0].size], dtype=jnp.int64)]}
 
 
-@register_op("range", not_differentiable=True)
+@register_op("range", not_differentiable=True, grad_free=True)
 def _range(ctx, ins, attrs):
     s = ins["Start"][0].reshape(())
     e = ins["End"][0].reshape(())
@@ -428,7 +428,7 @@ def _rng_key(ctx, attrs):
     return ctx.rng()
 
 
-@register_op("uniform_random", not_differentiable=True, stateful=True)
+@register_op("uniform_random", not_differentiable=True, grad_free=True, stateful=True)
 def _uniform_random(ctx, ins, attrs):
     shape = tuple(attrs["shape"])
     dtype = attrs.get("dtype", "float32")
@@ -439,7 +439,7 @@ def _uniform_random(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register_op("gaussian_random", not_differentiable=True, stateful=True)
+@register_op("gaussian_random", not_differentiable=True, grad_free=True, stateful=True)
 def _gaussian_random(ctx, ins, attrs):
     shape = tuple(attrs["shape"])
     dtype = attrs.get("dtype", "float32")
@@ -448,7 +448,7 @@ def _gaussian_random(ctx, ins, attrs):
     return {"Out": [out.astype(dtype)]}
 
 
-@register_op("truncated_gaussian_random", not_differentiable=True,
+@register_op("truncated_gaussian_random", not_differentiable=True, grad_free=True,
              stateful=True)
 def _truncated_gaussian_random(ctx, ins, attrs):
     shape = tuple(attrs["shape"])
@@ -458,14 +458,14 @@ def _truncated_gaussian_random(ctx, ins, attrs):
     return {"Out": [out.astype(attrs.get("dtype", "float32"))]}
 
 
-@register_op("randint", not_differentiable=True, stateful=True)
+@register_op("randint", not_differentiable=True, grad_free=True, stateful=True)
 def _randint(ctx, ins, attrs):
     return {"Out": [jax.random.randint(
         _rng_key(ctx, attrs), tuple(attrs["shape"]), attrs.get("low", 0),
         attrs.get("high"), dtype=attrs.get("dtype", "int64"))]}
 
 
-@register_op("shuffle_batch", not_differentiable=True, stateful=True)
+@register_op("shuffle_batch", not_differentiable=True, grad_free=True, stateful=True)
 def _shuffle_batch(ctx, ins, attrs):
     x = ins["X"][0]
     perm = jax.random.permutation(_rng_key(ctx, attrs), x.shape[0])
@@ -483,7 +483,7 @@ def _top_k(ctx, ins, attrs):
     return {"Out": [v], "Indices": [i.astype(jnp.int64)]}
 
 
-@register_op("arg_max", not_differentiable=True)
+@register_op("arg_max", not_differentiable=True, grad_free=True)
 def _arg_max(ctx, ins, attrs):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
@@ -493,7 +493,7 @@ def _arg_max(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register_op("arg_min", not_differentiable=True)
+@register_op("arg_min", not_differentiable=True, grad_free=True)
 def _arg_min(ctx, ins, attrs):
     x = ins["X"][0]
     return {"Out": [jnp.argmin(x, axis=attrs.get("axis", -1))

@@ -137,9 +137,14 @@ class DistributeTranspiler:
         for i, op in enumerate(block.ops):
             if op.attrs.get("op_role") != "optimize":
                 continue
-            opt_idxs.append(i)
             if not op.input("Param"):
+                # grad-clip / regularization / accumulator ops appended by
+                # apply_gradients: keep them in the trainer program so the
+                # pushed grad already includes clipping and weight decay
+                # (the reference runs these in pserver optimize blocks;
+                # we fold them trainer-side instead).
                 continue
+            opt_idxs.append(i)
             pname = op.input("Param")[0]
             gname = op.input("Grad")[0]
             if op.type not in _SERVER_OPTS:

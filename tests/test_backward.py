@@ -118,5 +118,97 @@ class TestChainRule(unittest.TestCase):
         np.testing.assert_allclose(g, num, rtol=1e-3, atol=1e-5)
 
 
+class TestMultiTargetGradients(unittest.TestCase):
+    """fluid.gradients parity: multiple targets, target_gradients seeds
+    (reference backward.py:973 calc_gradient)."""
+
+    def test_two_targets_sum(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3], append_batch_size=False)
+            x.stop_gradient = False
+            a = pt.layers.scale(x, scale=2.0)       # da/dx = 2
+            b = pt.layers.square(x)                 # db/dx = 2x
+            ga, = pt.gradients([pt.layers.reduce_sum(a),
+                                pt.layers.reduce_sum(b)], [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            xs = np.array([1.0, -2.0, 3.0], "f")
+            g, = exe.run(main, feed={"x": xs}, fetch_list=[ga])
+        np.testing.assert_allclose(g, 2.0 + 2.0 * xs, rtol=1e-6)
+
+    def test_dependent_targets(self):
+        # t2 = 3*t1: d(t1+t2)/dx = (1 + 3) * dt1/dx
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [2], append_batch_size=False)
+            x.stop_gradient = False
+            t1 = pt.layers.reduce_sum(pt.layers.square(x))
+            t2 = pt.layers.scale(t1, scale=3.0)
+            g, = pt.gradients([t1, t2], [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            xs = np.array([1.5, -0.5], "f")
+            gv, = exe.run(main, feed={"x": xs}, fetch_list=[g])
+        np.testing.assert_allclose(gv, 4.0 * 2.0 * xs, rtol=1e-6)
+
+    def test_target_gradients_seed(self):
+        # vector target seeded with an explicit cotangent
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3], append_batch_size=False)
+            seed = pt.layers.data("s", [3], append_batch_size=False)
+            x.stop_gradient = False
+            y = pt.layers.square(x)                  # dy/dx = 2x (diag)
+            g, = pt.gradients([y], [x], target_gradients=[seed])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            xs = np.array([1.0, 2.0, 3.0], "f")
+            ss = np.array([0.5, -1.0, 2.0], "f")
+            gv, = exe.run(main, feed={"x": xs, "s": ss}, fetch_list=[g])
+        np.testing.assert_allclose(gv, 2.0 * xs * ss, rtol=1e-6)
+
+    def test_shape_mismatch_raises(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3], append_batch_size=False)
+            s = pt.layers.data("s", [2], append_batch_size=False)
+            x.stop_gradient = False
+            y = pt.layers.square(x)
+            with self.assertRaises(ValueError):
+                pt.gradients([y], [x], target_gradients=[s])
+
+
+class TestPruneSubBlocks(unittest.TestCase):
+    def test_prune_keeps_loop_closure_producers(self):
+        """An op whose output is read ONLY inside a While sub-block must
+        survive pruning to the loop's outputs."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            bias = pt.layers.scale(x, scale=0.5)     # read only in the loop
+            i = pt.layers.fill_constant([1], "int32", 0)
+            i.stop_gradient = True
+            n = pt.layers.fill_constant([1], "int32", 3)
+            tot = pt.layers.fill_constant([1], "float32", 0.0)
+            cv = pt.layers.less_than(i, n)
+            w = pt.layers.While(cv)
+            with w.block():
+                pt.layers.assign(
+                    pt.layers.elementwise_add(tot, bias), output=tot)
+                pt.layers.assign(pt.layers.elementwise_add(
+                    i, pt.layers.fill_constant([1], "int32", 1)), output=i)
+                pt.layers.assign(pt.layers.less_than(i, n), output=cv)
+        pruned = main._prune([tot.name])
+        kept_types = [op.type for op in pruned.global_block.ops]
+        self.assertIn("while", kept_types)
+        self.assertIn("scale", kept_types)  # the closure producer
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            t, = exe.run(pruned, feed={"x": np.array([2.0], "f")},
+                         fetch_list=[tot])
+        self.assertAlmostEqual(float(np.asarray(t)[0]), 3.0, places=5)
+
+
 if __name__ == "__main__":
     unittest.main()

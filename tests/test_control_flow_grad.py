@@ -1,0 +1,417 @@
+"""Gradients through control flow: While / cond, and the StaticRNN /
+DynamicRNN / IfElse user APIs.
+
+Reference: the while/recurrent grad machinery in
+python/paddle/fluid/backward.py:422 (sub-block recursion) and
+paddle/fluid/operators/controlflow/while_op.cc (WhileGradOp);
+StaticRNN/IfElse/DynamicRNN in python/paddle/fluid/layers/
+control_flow.py:294,1578,1714. TPU redesign: macro grad ops replay the
+sub-block through jax.vjp (bounded masked scan for while) — see
+paddle_tpu/ops/control_flow_ops.py.
+"""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.backward import gradients
+
+
+def _run(main, feed, fetch):
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=fetch)]
+
+
+class TestWhileGrad(unittest.TestCase):
+    def test_geometric_loop_exact_grad(self):
+        # y = x * 2^k (doubling until >= 100); x=1.5 -> 7 iters, dy/dx = 128
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            x.stop_gradient = False
+
+            def cond_fn(v):
+                return pt.layers.less_than(
+                    v, pt.layers.fill_constant([1], "float32", 100.0))
+
+            def body_fn(v):
+                return pt.layers.scale(v, scale=2.0)
+
+            out, = pt.layers.while_loop(cond_fn, body_fn, [x],
+                                        max_trip_count=16)
+            loss = pt.layers.reduce_sum(out)
+            gx, = gradients([loss], [x])
+        o, g = _run(main, {"x": np.array([1.5], np.float32)}, [out, gx])
+        self.assertAlmostEqual(float(o[0]), 192.0, places=4)
+        self.assertAlmostEqual(float(g[0]), 128.0, places=3)
+
+    def test_nonlinear_loop_numeric_grad(self):
+        def build_and_run(feed_x):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.layers.data("x", [3], append_batch_size=False)
+                x.stop_gradient = False
+                i = pt.layers.fill_constant([1], "int32", 0)
+                i.stop_gradient = True
+                n = pt.layers.fill_constant([1], "int32", 4)
+                state = pt.layers.assign(x)
+                state.stop_gradient = False
+                cv = pt.layers.less_than(i, n)
+                w = pt.layers.While(cv, max_trip_count=8)
+                with w.block():
+                    ns = pt.layers.tanh(pt.layers.scale(state, scale=1.3))
+                    pt.layers.assign(ns, output=state)
+                    pt.layers.assign(
+                        pt.layers.elementwise_add(
+                            i, pt.layers.fill_constant([1], "int32", 1)),
+                        output=i)
+                    pt.layers.assign(pt.layers.less_than(i, n), output=cv)
+                loss = pt.layers.reduce_sum(pt.layers.square(state))
+                gx, = gradients([loss], [x])
+            return _run(main, {"x": feed_x}, [loss, gx])
+
+        x0 = np.array([0.3, -0.7, 1.1], np.float32)
+        _, g = build_and_run(x0)
+        eps = 1e-3
+        for k in range(3):
+            xp, xm = x0.copy(), x0.copy()
+            xp[k] += eps
+            xm[k] -= eps
+            lp, _ = build_and_run(xp)
+            lm, _ = build_and_run(xm)
+            num = (float(lp) - float(lm)) / (2 * eps)
+            self.assertAlmostEqual(float(g[k]), num, delta=5e-3)
+
+    def test_while_without_bound_raises(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            x.stop_gradient = False
+
+            def cond_fn(v):
+                return pt.layers.less_than(
+                    v, pt.layers.fill_constant([1], "float32", 10.0))
+
+            def body_fn(v):
+                return pt.layers.scale(v, scale=2.0)
+
+            out, = pt.layers.while_loop(cond_fn, body_fn, [x])
+            loss = pt.layers.reduce_sum(out)
+            with self.assertRaisesRegex(RuntimeError, "max_trip_count"):
+                gradients([loss], [x])
+
+    def test_nondiff_op_on_loss_path_raises(self):
+        # silently-dropped gradients are worse than an error
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4], append_batch_size=False)
+            x.stop_gradient = False
+            q = pt.layers.py_func(
+                func=lambda a: a, x=x,
+                out=main.current_block().create_var(
+                    name="pyout", shape=(4,), dtype="float32"))
+            loss = pt.layers.reduce_sum(q)
+            with self.assertRaisesRegex(RuntimeError, "no gradient"):
+                gradients([loss], [x])
+
+
+class TestNestedAndEdgeCases(unittest.TestCase):
+    def test_switch_overwrite_zeroes_upstream_grad(self):
+        """A Switch case that overwrites an outer var must kill the
+        upstream gradient when taken (and pass it when not)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            x.stop_gradient = False
+            w = pt.layers.scale(x, scale=3.0)
+            c = pt.layers.data("c", [1], append_batch_size=False)
+            zero = pt.layers.fill_constant([1], "float32", 0.0)
+            pred = pt.layers.greater_than(c, zero)
+            with pt.layers.Switch() as sw:
+                with sw.case(pred):
+                    pt.layers.assign(
+                        pt.layers.fill_constant([1], "float32", 7.0),
+                        output=w)
+            loss = pt.layers.reduce_sum(w)
+            gx, = gradients([loss], [x])
+        feed = {"x": np.array([2.0], "f")}
+        l1, g1 = _run(main, {**feed, "c": np.array([1.0], "f")}, [loss, gx])
+        l2, g2 = _run(main, {**feed, "c": np.array([-1.0], "f")}, [loss, gx])
+        self.assertAlmostEqual(float(l1[0]), 7.0)
+        self.assertAlmostEqual(float(g1[0]), 0.0)
+        self.assertAlmostEqual(float(l2[0]), 6.0)
+        self.assertAlmostEqual(float(g2[0]), 3.0)
+
+    def test_nested_differentiable_whiles(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            x.stop_gradient = False
+
+            def outer_body(v):
+                def inner_cond(u):
+                    return pt.layers.less_than(
+                        u, pt.layers.fill_constant([1], "float32", 10.0))
+
+                def inner_body(u):
+                    return pt.layers.scale(u, scale=2.0)
+
+                u_out, = pt.layers.while_loop(inner_cond, inner_body, [v],
+                                              max_trip_count=6)
+                return pt.layers.scale(u_out, scale=1.5)
+
+            def outer_cond(v):
+                return pt.layers.less_than(
+                    v, pt.layers.fill_constant([1], "float32", 50.0))
+
+            out, = pt.layers.while_loop(outer_cond, outer_body, [x],
+                                        max_trip_count=4)
+            loss = pt.layers.reduce_sum(out)
+            gx, = gradients([loss], [x])
+        # x=1 -> inner doubles to 16, then x1.5 chain: 24, 36, 54 (stop)
+        o, g = _run(main, {"x": np.array([1.0], "f")}, [out, gx])
+        self.assertAlmostEqual(float(o[0]), 54.0, places=3)
+        self.assertAlmostEqual(float(g[0]), 54.0, places=2)
+
+    def test_boundless_while_with_stopgrad_carries_ok(self):
+        """A boundless While whose floats are all stop_gradient must not
+        block gradients elsewhere in the program."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [1], append_batch_size=False)
+            x.stop_gradient = False
+            i = pt.layers.fill_constant([1], "int32", 0)
+            i.stop_gradient = True
+            n = pt.layers.fill_constant([1], "int32", 3)
+            acc = pt.layers.fill_constant([1], "float32", 0.0)
+            acc.stop_gradient = True
+            cv = pt.layers.less_than(i, n)
+            w = pt.layers.While(cv)
+            with w.block():
+                pt.layers.assign(pt.layers.elementwise_add(
+                    acc, pt.layers.fill_constant([1], "float32", 1.0)),
+                    output=acc)
+                pt.layers.assign(pt.layers.elementwise_add(
+                    i, pt.layers.fill_constant([1], "int32", 1)), output=i)
+                pt.layers.assign(pt.layers.less_than(i, n), output=cv)
+            loss = pt.layers.reduce_sum(
+                pt.layers.elementwise_add(pt.layers.square(x), acc))
+            gx, = gradients([loss], [x])  # must not raise
+        l, g = _run(main, {"x": np.array([3.0], "f")}, [loss, gx])
+        self.assertAlmostEqual(float(l[0]), 12.0, places=4)
+        self.assertAlmostEqual(float(g[0]), 6.0, places=4)
+
+    def test_ifelse_rank1_outputs(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4, 3], append_batch_size=False)
+            m = pt.layers.data("m", [4, 1], dtype="bool",
+                               append_batch_size=False)
+            ie = pt.layers.IfElse(m)
+            with ie.true_block():
+                ie.output(pt.layers.reduce_sum(ie.input(x), dim=[1]))
+            with ie.false_block():
+                ie.output(pt.layers.reduce_max(ie.input(x), dim=[1]))
+            merged, = ie()
+        xs = np.arange(12, dtype=np.float32).reshape(4, 3)
+        mask = np.array([[True], [False], [True], [False]])
+        mo, = _run(main, {"x": xs, "m": mask}, [merged])
+        self.assertEqual(mo.shape, (4,))
+        np.testing.assert_allclose(
+            mo, np.where(mask[:, 0], xs.sum(1), xs.max(1)))
+
+
+class TestCondGrad(unittest.TestCase):
+    def test_grad_flows_through_taken_branch(self):
+        for pred_val, want in ((1.0, 3.0), (-1.0, -2.0)):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.layers.data("x", [2], append_batch_size=False)
+                x.stop_gradient = False
+                p = pt.layers.data("p", [1], append_batch_size=False)
+                zero = pt.layers.fill_constant([1], "float32", 0.0)
+                pred = pt.layers.greater_than(p, zero)
+                out = pt.layers.cond(
+                    pred,
+                    lambda: pt.layers.scale(x, scale=3.0),
+                    lambda: pt.layers.scale(x, scale=-2.0))
+                loss = pt.layers.reduce_sum(out)
+                gx, = gradients([loss], [x])
+            _, g = _run(main, {"x": np.array([1., 2.], np.float32),
+                               "p": np.array([pred_val], np.float32)},
+                        [loss, gx])
+            np.testing.assert_allclose(g, [want, want], rtol=1e-6)
+
+
+class TestStaticRNN(unittest.TestCase):
+    def test_matches_unrolled(self):
+        """StaticRNN loss + input grad must equal the hand-unrolled chain."""
+        T, B, D, H = 3, 2, 4, 5
+        rng = np.random.RandomState(7)
+        xs = rng.randn(T, B, D).astype(np.float32)
+        w0 = rng.randn(D, H).astype(np.float32) * 0.3
+
+        def build(unrolled):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.layers.data("x", [T, B, D], append_batch_size=False)
+                x.stop_gradient = False
+                boot = pt.layers.fill_constant([B, H], "float32", 0.0)
+                wattr = pt.ParamAttr(
+                    name="srnn_w",
+                    initializer=pt.initializer.NumpyArrayInitializer(w0))
+                if not unrolled:
+                    rnn = pt.layers.StaticRNN()
+                    with rnn.step():
+                        inp = rnn.step_input(x)
+                        prev = rnn.memory(init=boot)
+                        h = pt.layers.fc(input=inp, size=H, param_attr=wattr,
+                                         bias_attr=False)
+                        nxt = pt.layers.tanh(
+                            pt.layers.elementwise_add(h, prev))
+                        rnn.update_memory(prev, nxt)
+                        rnn.step_output(nxt)
+                    out = rnn()
+                    loss = pt.layers.reduce_mean(out)
+                else:
+                    prev = boot
+                    steps = []
+                    for t in range(T):
+                        xt = pt.layers.slice(x, axes=[0], starts=[t],
+                                             ends=[t + 1])
+                        xt = pt.layers.reshape(xt, [B, D])
+                        h = pt.layers.fc(input=xt, size=H, param_attr=wattr,
+                                         bias_attr=False)
+                        prev = pt.layers.tanh(
+                            pt.layers.elementwise_add(h, prev))
+                        steps.append(pt.layers.reshape(prev, [1, B, H]))
+                    out = pt.layers.concat(steps, axis=0)
+                    loss = pt.layers.reduce_mean(out)
+                gx, = gradients([loss], [x])
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                l, o, g = exe.run(main, feed={"x": xs},
+                                  fetch_list=[loss, out, gx])
+            return np.asarray(l), np.asarray(o), np.asarray(g)
+
+        l_rnn, o_rnn, g_rnn = build(unrolled=False)
+        l_ref, o_ref, g_ref = build(unrolled=True)
+        np.testing.assert_allclose(o_rnn, o_ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(l_rnn, l_ref, rtol=1e-5)
+        np.testing.assert_allclose(g_rnn, g_ref, rtol=1e-4, atol=1e-6)
+
+    def test_trains(self):
+        T, B, D, H = 4, 3, 5, 7
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [T, B, D], append_batch_size=False)
+            boot = pt.layers.fill_constant([B, H], "float32", 0.0)
+            rnn = pt.layers.StaticRNN()
+            with rnn.step():
+                wd = rnn.step_input(x)
+                prev = rnn.memory(init=boot)
+                h = pt.layers.fc(input=[wd, prev], size=H, bias_attr=False,
+                                 act="tanh")
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            out = rnn()
+            loss = pt.layers.reduce_mean(pt.layers.square(out))
+            pt.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xs = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+            losses = [float(np.asarray(
+                exe.run(main, feed={"x": xs}, fetch_list=[loss])[0]))
+                for _ in range(8)]
+        self.assertLess(losses[-1], losses[0])
+
+    def test_memory_with_batch_ref(self):
+        T, B, D, H = 3, 4, 2, 6
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [T, B, D], append_batch_size=False)
+            rnn = pt.layers.StaticRNN()
+            with rnn.step():
+                wd = rnn.step_input(x)
+                prev = rnn.memory(shape=[H], batch_ref=wd, init_value=0.0)
+                h = pt.layers.fc(input=[wd, prev], size=H, bias_attr=False)
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            out = rnn()
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xs = np.ones((T, B, D), np.float32)
+            o, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        self.assertEqual(np.asarray(o).shape, (T, B, H))
+
+
+class TestDynamicRNN(unittest.TestCase):
+    def test_lengths_mask_and_grads(self):
+        B, T, D, H = 3, 5, 4, 6
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [B, T, D], append_batch_size=False)
+            lens = pt.layers.data("lens", [B], dtype="int32",
+                                  append_batch_size=False)
+            x.stop_gradient = False
+            drnn = pt.layers.DynamicRNN()
+            with drnn.block():
+                wd = drnn.step_input(x, lens)
+                prev = drnn.memory(shape=[H], value=0.0)
+                h = pt.layers.fc(input=[wd, prev], size=H, bias_attr=False,
+                                 act="tanh")
+                drnn.update_memory(prev, h)
+                drnn.output(h)
+            out = drnn()
+            loss = pt.layers.reduce_sum(out)
+            gx, = gradients([loss], [x])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xs = np.random.RandomState(1).randn(B, T, D).astype(np.float32)
+            ls = np.array([5, 2, 3], np.int32)
+            o, g = exe.run(main, feed={"x": xs, "lens": ls},
+                           fetch_list=[out, gx])
+        o, g = np.asarray(o), np.asarray(g)
+        self.assertEqual(o.shape, (B, T, H))
+        # steps past each row's length are zero-padded...
+        self.assertTrue(np.all(o[1, 2:] == 0))
+        self.assertTrue(np.all(o[2, 3:] == 0))
+        self.assertTrue(np.any(o[0, 4] != 0))
+        # ...and contribute no gradient to the padded input positions
+        self.assertTrue(np.all(g[1, 2:] == 0))
+        self.assertTrue(np.any(g[1, :2] != 0))
+
+
+class TestIfElse(unittest.TestCase):
+    def test_rowwise_merge_and_grads(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4, 3], append_batch_size=False)
+            x.stop_gradient = False
+            m = pt.layers.data("m", [4, 1], dtype="bool",
+                               append_batch_size=False)
+            ie = pt.layers.IfElse(m)
+            with ie.true_block():
+                ie.output(pt.layers.scale(ie.input(x), scale=2.0))
+            with ie.false_block():
+                ie.output(pt.layers.scale(ie.input(x), scale=-1.0))
+            merged, = ie()
+            loss = pt.layers.reduce_sum(merged)
+            gx, = gradients([loss], [x])
+        xs = np.arange(12, dtype=np.float32).reshape(4, 3)
+        mask = np.array([[True], [False], [True], [False]])
+        mo, go = _run(main, {"x": xs, "m": mask}, [merged, gx])
+        np.testing.assert_allclose(mo, np.where(mask, xs * 2, -xs))
+        np.testing.assert_allclose(
+            go, np.where(mask, 2.0, -1.0) * np.ones_like(xs))
+
+
+if __name__ == "__main__":
+    unittest.main()
