@@ -3,6 +3,12 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is measured MFU / 0.45 (the BASELINE.md north-star target).
 Peak flops default to v5e bf16 (197 TFLOP/s); override with PEAK_TFLOPS.
+
+BENCH_MODEL=resnet50 switches to the ResNet-50 train benchmark
+(tools/bench_resnet50.py): same keys, plus "vs_jax_probe" giving the
+ratio to the measured raw-JAX ceiling on this chip (~30% MFU — see
+BASELINE.md's roofline section; 45% is not attainable for conv nets
+here, so vs_baseline < 1 is expected for this mode).
 """
 
 import json
@@ -14,6 +20,11 @@ import numpy as np
 
 
 def main():
+    if os.environ.get("BENCH_MODEL", "bert") == "resnet50":
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        import bench_resnet50
+        return bench_resnet50.main()
     import paddle_tpu as pt
     from paddle_tpu.models.bert import (BertConfig, bert_pretrain_program,
                                         flops_per_step)
