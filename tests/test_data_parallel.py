@@ -37,46 +37,43 @@ def _data(step):
     return {"x": x, "y": y}
 
 
+def _trajectory(seed, compile_fn=None, steps=5):
+    """5-step training-loss trajectory; compile_fn optionally wraps the
+    program in a parallel CompiledProgram."""
+    main, startup, loss = _build(seed=seed)
+    target = compile_fn(main, loss) if compile_fn is not None else main
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        return [float(exe.run(target, feed=_data(s),
+                              fetch_list=[loss])[0][0])
+                for s in range(steps)]
+
+
 class TestDataParallel(unittest.TestCase):
     def test_dp_loss_matches_single_device(self):
         import jax
         self.assertGreaterEqual(len(jax.devices()), 8)
-
-        main, startup, loss = _build()
-        exe = pt.Executor()
-        with pt.scope_guard(pt.Scope()):
-            exe.run(startup)
-            single = [float(exe.run(main, feed=_data(s),
-                                    fetch_list=[loss])[0][0])
-                      for s in range(5)]
-
-        main2, startup2, loss2 = _build()
-        compiled = pt.CompiledProgram(main2).with_data_parallel(
-            loss_name=loss2.name)
-        exe2 = pt.Executor()
-        with pt.scope_guard(pt.Scope()):
-            exe2.run(startup2)
-            par = [float(exe2.run(compiled, feed=_data(s),
-                                  fetch_list=[loss2])[0][0])
-                   for s in range(5)]
-
+        single = _trajectory(5)
+        par = _trajectory(5, lambda m, l: pt.CompiledProgram(m)
+                          .with_data_parallel(loss_name=l.name))
         np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-5)
 
-    def test_tensor_parallel_sharding_compiles(self):
-        main, startup, loss = _build(seed=6)
-        # shard the first fc weight column-wise over a 2x4 dp x mp mesh
-        w_name = main.all_parameters()[0].name
-        compiled = pt.CompiledProgram(main).with_sharding(
-            {w_name: (None, "mp")}, mesh_shape=(2, 4),
-            axis_names=("dp", "mp"))
-        exe = pt.Executor()
-        with pt.scope_guard(pt.Scope()):
-            exe.run(startup)
-            l0 = float(exe.run(compiled, feed=_data(0),
-                               fetch_list=[loss])[0][0])
-            l1 = float(exe.run(compiled, feed=_data(1),
-                               fetch_list=[loss])[0][0])
-        self.assertTrue(np.isfinite(l0) and np.isfinite(l1))
+    def test_tensor_parallel_matches_single_device(self):
+        """dp x mp sharded training must reproduce the unsharded loss
+        trajectory (not merely stay finite) — the same equality bar the
+        EP test holds (test_parallel_extras.py)."""
+        single = _trajectory(6)
+
+        def shard(m, l):
+            # first fc weight column-wise over a 2x4 dp x mp mesh
+            w_name = m.all_parameters()[0].name
+            return pt.CompiledProgram(m).with_sharding(
+                {w_name: (None, "mp")}, mesh_shape=(2, 4),
+                axis_names=("dp", "mp"))
+
+        sharded = _trajectory(6, shard)
+        np.testing.assert_allclose(single, sharded, rtol=2e-4, atol=1e-5)
 
 
 if __name__ == "__main__":

@@ -31,7 +31,9 @@ def conv(x, w, stride):
     else:
         dn = ("NCHW", "HWIO", "NCHW")
     kh = w.shape[0]
-    pad = ((kh // 2, kh // 2),) * 2 if kh > 1 else ((0, 0), (0, 0))
+    # even kernels need asymmetric padding to preserve the grid size
+    # (a symmetric kh//2 pad on a 4x4 kernel yields 113x113, not 112x112)
+    pad = (((kh - 1) // 2, kh // 2),) * 2 if kh > 1 else ((0, 0), (0, 0))
     return jax.lax.conv_general_dilated(
         x, w, (stride, stride), pad, dimension_numbers=dn)
 
