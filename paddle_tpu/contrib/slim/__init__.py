@@ -5,5 +5,5 @@ from . import quantization  # noqa: F401
 from . import prune  # noqa: F401
 from . import distillation  # noqa: F401
 from .quantization import (QuantizationTransformPass,  # noqa: F401
-                           QuantizationFreezePass)
+                           QuantizationFreezePass, PostTrainingQuantization)
 from .prune import Pruner, apply_masks  # noqa: F401

@@ -19,7 +19,8 @@ import numpy as np
 
 from ...framework.core import Parameter, Program, unique_name
 
-__all__ = ["QuantizationTransformPass", "QuantizationFreezePass"]
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "PostTrainingQuantization"]
 
 # op type -> (activation input slot, weight input slot, weight quant axis)
 _QUANTIZABLE = {
@@ -178,3 +179,183 @@ class QuantizationFreezePass:
         program._quant_weight_scales = scales
         program._bump_version()
         return scales
+
+
+class PostTrainingQuantization:
+    """Post-training int8 quantization: calibrate activation thresholds
+    over a calibration reader, snap weights onto the channel-wise int8
+    grid, and emit an inference program whose quantizable ops run through
+    real int8 quantize/dequantize round trips.
+
+    Reference: the int8 calibration flow under
+    python/paddle/fluid/contrib/ (int8_inference README + the
+    quantization passes); algo='abs_max' uses the max |x| seen during
+    calibration, algo='KL' picks the KL-divergence-minimizing threshold
+    (the TensorRT-style histogram method).
+    """
+
+    def __init__(self, executor, program: Program, feed_names,
+                 fetch_targets, scope=None, algo: str = "abs_max",
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_type: Optional[Sequence[str]] = None):
+        if algo not in ("abs_max", "KL"):
+            raise ValueError(f"algo must be abs_max or KL, got {algo!r}")
+        self.exe = executor
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_targets = list(fetch_targets)
+        self.scope = scope
+        self.algo = algo
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.op_types = list(quantizable_op_type or _QUANTIZABLE)
+
+    # -- calibration --------------------------------------------------------
+
+    def _quant_sites(self, blk):
+        """(op index, activation var) pairs needing an input scale, plus
+        the weight params to snap."""
+        acts, weights = [], []
+        for i, op in enumerate(blk.ops):
+            spec = _QUANTIZABLE.get(op.type)
+            if spec is None or op.type not in self.op_types:
+                continue
+            act_slot, w_slot, w_axis = spec
+            a = op.inputs.get(act_slot)
+            w = op.inputs.get(w_slot)
+            if not a or not w:
+                continue
+            if not isinstance(blk.var(w[0]), Parameter):
+                continue
+            acts.append((i, a[0]))
+            weights.append((w[0], w_axis))
+        return acts, weights
+
+    @staticmethod
+    def _kl_threshold(hist, edges, quant_bins=128):
+        """KL-minimizing saturation threshold over an |x| histogram."""
+        total = hist.sum()
+        if total == 0:
+            return float(edges[-1])
+        best_t, best_kl = float(edges[-1]), np.inf
+        n = len(hist)
+        for cut in range(quant_bins, n + 1, max(1, (n - quant_bins) // 32
+                                                or 1)):
+            sliced = hist[:cut].astype(np.float64)
+            # p carries the clipped tail mass in its last bin; q is built
+            # from the UNspiked slice (as in the TensorRT/MXNet method) —
+            # folding the tail into q too would make every cut score
+            # KL=0 at cut==quant_bins and select absurdly small
+            # thresholds for unclipped distributions
+            p = sliced.copy()
+            p[-1] += hist[cut:].sum()
+            if p.sum() == 0:
+                continue
+            factor = cut / quant_bins
+            q = np.zeros(cut)
+            for b in range(quant_bins):
+                lo, hi = int(b * factor), max(int((b + 1) * factor),
+                                              int(b * factor) + 1)
+                chunk = sliced[lo:hi]
+                nz = (chunk > 0).sum()
+                if nz:
+                    q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+            pn = p / p.sum()
+            qn = q / q.sum() if q.sum() > 0 else q
+            mask = pn > 0
+            kl = np.sum(pn[mask] * np.log(
+                pn[mask] / np.maximum(qn[mask], 1e-12)))
+            if kl < best_kl:
+                best_kl, best_t = kl, float(edges[cut - 1])
+        return best_t
+
+    def quantize(self, calibration_feeds) -> Program:
+        """calibration_feeds: iterable of feed dicts. Returns the
+        quantized inference program (weights in `scope` are snapped in
+        place)."""
+        from ...framework.executor import global_scope
+        scope = self.scope or global_scope()
+        infer = self.program.clone(for_test=True)
+        blk = infer.global_block
+        acts, weights = self._quant_sites(blk)
+        act_names = sorted({name for _, name in acts})
+
+        # 1. calibration. Two passes for KL: pass one fixes each var's
+        # histogram range at its global abs-max (accumulating histograms
+        # over batch-local, growing ranges would mix incompatible
+        # binnings and skew the thresholds).
+        calibration_feeds = list(calibration_feeds)
+        maxima = {n: 0.0 for n in act_names}
+        for feed in calibration_feeds:
+            vals = self.exe.run(infer, feed=feed, fetch_list=act_names,
+                                scope=scope)
+            for n, v in zip(act_names, vals):
+                v = np.abs(np.asarray(v, np.float32))
+                maxima[n] = max(maxima[n], float(v.max(initial=0.0)))
+
+        thresholds = {n: (maxima[n] if maxima[n] > 0 else 1.0)
+                      for n in act_names}
+        if self.algo == "KL":
+            n_bins = 2048
+            hists = {n: np.zeros(n_bins, np.int64) for n in act_names}
+            for feed in calibration_feeds:
+                vals = self.exe.run(infer, feed=feed,
+                                    fetch_list=act_names, scope=scope)
+                for n, v in zip(act_names, vals):
+                    v = np.abs(np.asarray(v, np.float32)).ravel()
+                    h, _ = np.histogram(
+                        v, bins=n_bins, range=(0.0, maxima[n] + 1e-9))
+                    hists[n] += h
+            for n in act_names:
+                if maxima[n] > 0:
+                    edges = np.linspace(0.0, maxima[n], n_bins + 1)[1:]
+                    thresholds[n] = self._kl_threshold(hists[n], edges)
+
+        # 2. snap weights to the channel-wise int8 grid
+        import jax.numpy as jnp
+        qmax_w = float(2 ** (self.wbits - 1) - 1)
+        wscales = {}
+        for wname, axis in weights:
+            w = np.asarray(scope.find_var(wname), np.float32)
+            red = tuple(i for i in range(w.ndim) if i != axis)
+            scale = np.max(np.abs(w), axis=red, keepdims=True)
+            safe = np.where(scale > 0, scale, 1.0)
+            q = np.clip(np.round(w * (qmax_w / safe)), -qmax_w, qmax_w)
+            scope.set_var(wname, jnp.asarray(q * (safe / qmax_w)))
+            wscales[wname] = np.ravel(scale)
+
+        # 3. rewrite: int8 quantize -> dequantize round trip on each
+        # quantizable op's activation input (fixed calibrated scale)
+        qmax_a = float(2 ** (self.abits - 1) - 1)
+        new_ops = []
+        done = {}
+        for i, op in enumerate(blk.ops):
+            site = [a for a in acts if a[0] == i]
+            if site:
+                _, src = site[0]
+                if src not in done:
+                    t = thresholds[src]
+                    qv = blk.create_var(
+                        name=unique_name(f"{src}.int8"), dtype="int8",
+                        shape=blk.var(src).shape)
+                    dv = blk.create_var(
+                        name=unique_name(f"{src}.dq"), dtype="float32",
+                        shape=blk.var(src).shape)
+                    q_op = type(op)(blk, "quantize", {"Input": [src]},
+                                    {"Output": [qv.name]},
+                                    {"Scale": qmax_a / t})
+                    d_op = type(op)(blk, "dequantize",
+                                    {"Input": [qv.name]},
+                                    {"Output": [dv.name]},
+                                    {"Scale": qmax_a / t})
+                    new_ops.extend([q_op, d_op])
+                    done[src] = dv.name
+                spec = _QUANTIZABLE[op.type]
+                names = op.inputs[spec[0]]
+                op.inputs[spec[0]] = [done.get(n, n) for n in names]
+            new_ops.append(op)
+        blk.ops = new_ops
+        infer._bump_version()
+        infer._quant_weight_scales = wscales
+        infer._quant_act_thresholds = dict(thresholds)
+        return infer

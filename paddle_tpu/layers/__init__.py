@@ -7,6 +7,8 @@ from .learning_rate_scheduler import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .nn_extra import *  # noqa: F401,F403
 
 from . import math  # noqa: F401
 from . import nn  # noqa: F401
@@ -17,5 +19,6 @@ from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import collective  # noqa: F401
 from . import detection  # noqa: F401
+from . import nn_extra  # noqa: F401
 from . import distributions  # noqa: F401
 from . import decode  # noqa: F401

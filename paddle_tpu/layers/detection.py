@@ -6,7 +6,13 @@ from ..framework.layer_helper import LayerHelper
 
 __all__ = ["prior_box", "anchor_generator", "box_coder", "iou_similarity",
            "yolo_box", "multiclass_nms", "roi_align", "box_clip",
-           "detection_output"]
+           "detection_output", "sigmoid_focal_loss", "yolov3_loss",
+           "density_prior_box", "polygon_box_transform",
+           "box_decoder_and_assign", "bipartite_match", "target_assign",
+           "mine_hard_examples", "rpn_target_assign", "roi_pool",
+           "generate_proposals", "distribute_fpn_proposals",
+           "collect_fpn_proposals", "retinanet_detection_output",
+           "ssd_loss"]
 
 
 def _op(name, op_type, ins, out_slots, attrs=None, persist=()):
@@ -117,3 +123,303 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
                           nms_threshold=nms_threshold,
                           background_label=background_label)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    """reference: layers/detection.py sigmoid_focal_loss."""
+    return _op("sigmoid_focal_loss", "sigmoid_focal_loss",
+               {"X": [x.name], "Label": [label.name],
+                "FgNum": [fg_num.name]}, ["Out"],
+               {"gamma": gamma, "alpha": alpha})
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """reference: layers/detection.py yolov3_loss. Returns Loss [n]."""
+    helper = LayerHelper(name or "yolov3_loss")
+    ins = {"X": [x.name], "GTBox": [gt_box.name],
+           "GTLabel": [gt_label.name]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score.name]
+    loss = helper.create_variable_for_type_inference("float32")
+    obj = helper.create_variable_for_type_inference("float32")
+    match = helper.create_variable_for_type_inference("int32")
+    helper.append_op("yolov3_loss", ins,
+                     {"Loss": [loss.name], "ObjectnessMask": [obj.name],
+                      "GTMatchMask": [match.name]},
+                     {"anchors": list(anchors),
+                      "anchor_mask": list(anchor_mask),
+                      "class_num": class_num,
+                      "ignore_thresh": ignore_thresh,
+                      "downsample_ratio": downsample_ratio,
+                      "use_label_smooth": use_label_smooth})
+    return loss
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=None, clip=False, steps=None, offset=0.5,
+                      flatten_to_2d=False, name=None):
+    steps = steps or [0.0, 0.0]
+    boxes, variances = _op(
+        "density_prior_box", "density_prior_box",
+        {"Input": [input.name], "Image": [image.name]},
+        ["Boxes", "Variances"],
+        {"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+         "fixed_ratios": list(fixed_ratios),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "clip": clip, "step_w": steps[0], "step_h": steps[1],
+         "offset": offset})
+    if flatten_to_2d:
+        from . import tensor as t_layers
+        boxes = t_layers.reshape(boxes, [-1, 4])
+        variances = t_layers.reshape(variances, [-1, 4])
+    return boxes, variances
+
+
+def polygon_box_transform(input, name=None):
+    return _op("polygon_box_transform", "polygon_box_transform",
+               {"Input": [input.name]}, ["Output"])
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    return _op("box_decoder_and_assign", "box_decoder_and_assign",
+               {"PriorBox": [prior_box.name],
+                "PriorBoxVar": [prior_box_var.name],
+                "TargetBox": [target_box.name],
+                "BoxScore": [box_score.name]},
+               ["DecodeBox", "OutputAssignBox"], {"box_clip": box_clip})
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper(name or "bipartite_match")
+    midx = helper.create_variable_for_type_inference("int32")
+    mdist = helper.create_variable_for_type_inference("float32")
+    helper.append_op("bipartite_match",
+                     {"DistMat": [dist_matrix.name]},
+                     {"ColToRowMatchIndices": [midx.name],
+                      "ColToRowMatchDist": [mdist.name]},
+                     {"match_type": match_type,
+                      "dist_threshold": dist_threshold})
+    return midx, mdist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper(name or "target_assign")
+    ins = {"X": [input.name], "MatchIndices": [matched_indices.name]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices.name]
+    out = helper.create_variable_for_type_inference("float32")
+    wt = helper.create_variable_for_type_inference("float32")
+    helper.append_op("target_assign", ins,
+                     {"Out": [out.name], "OutWeight": [wt.name]},
+                     {"mismatch_value": mismatch_value})
+    return out, wt
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       mining_type="max_negative", sample_size=0,
+                       name=None):
+    helper = LayerHelper(name or "mine_hard_examples")
+    ins = {"ClsLoss": [cls_loss.name],
+           "MatchIndices": [match_indices.name],
+           "MatchDist": [match_dist.name]}
+    if loc_loss is not None:
+        ins["LocLoss"] = [loc_loss.name]
+    neg = helper.create_variable_for_type_inference("int32")
+    cnt = helper.create_variable_for_type_inference("int32")
+    upd = helper.create_variable_for_type_inference("int32")
+    helper.append_op("mine_hard_examples", ins,
+                     {"NegIndices": [neg.name], "NegCount": [cnt.name],
+                      "UpdatedMatchIndices": [upd.name]},
+                     {"neg_pos_ratio": neg_pos_ratio,
+                      "neg_dist_threshold": neg_dist_threshold,
+                      "mining_type": mining_type,
+                      "sample_size": sample_size})
+    return neg, upd
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, im_info, is_crowd=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      name=None):
+    """Fixed-shape redesign (see ops/detection_ops.py). Returns, in the
+    reference's order: (loc_index, score_index, target_bbox,
+    target_label, bbox_inside_weight) — the index tensors are fixed-size
+    [n, A] padded with -1; targets/labels/weights are per-anchor."""
+    helper = LayerHelper(name or "rpn_target_assign")
+    ins = {"Anchor": [anchor_box.name], "GtBoxes": [gt_boxes.name],
+           "ImInfo": [im_info.name]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd.name]
+    lbl = helper.create_variable_for_type_inference("int32")
+    tgt = helper.create_variable_for_type_inference("float32")
+    inw = helper.create_variable_for_type_inference("float32")
+    loc = helper.create_variable_for_type_inference("int32")
+    sc = helper.create_variable_for_type_inference("int32")
+    helper.append_op("rpn_target_assign", ins,
+                     {"TargetLabel": [lbl.name], "TargetBBox": [tgt.name],
+                      "BBoxInsideWeight": [inw.name],
+                      "LocationIndex": [loc.name],
+                      "ScoreIndex": [sc.name]},
+                     {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                      "rpn_straddle_thresh": rpn_straddle_thresh,
+                      "rpn_fg_fraction": rpn_fg_fraction,
+                      "rpn_positive_overlap": rpn_positive_overlap,
+                      "rpn_negative_overlap": rpn_negative_overlap,
+                      "use_random": use_random})
+    return loc, sc, tgt, lbl, inw
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    helper = LayerHelper(name or "roi_pool")
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num.name]
+    out = helper.create_variable_for_type_inference("float32")
+    argmax = helper.create_variable_for_type_inference("int64")
+    helper.append_op("roi_pool", ins,
+                     {"Out": [out.name], "Argmax": [argmax.name]},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """Fixed-size redesign: RpnRois [n, post_nms_top_n, 4] zero-padded,
+    RpnRoiProbs [n, post_nms_top_n, 1], RpnRoisNum [n] valid counts."""
+    return _op("generate_proposals", "generate_proposals",
+               {"Scores": [scores.name], "BboxDeltas": [bbox_deltas.name],
+                "ImInfo": [im_info.name], "Anchors": [anchors.name],
+                "Variances": [variances.name]},
+               ["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+               {"pre_nms_topN": pre_nms_top_n,
+                "post_nms_topN": post_nms_top_n,
+                "nms_thresh": nms_thresh, "min_size": min_size,
+                "eta": eta})
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    helper = LayerHelper(name or "distribute_fpn_proposals")
+    num_level = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference("float32")
+            for _ in range(num_level)]
+    counts = helper.create_variable_for_type_inference("int32")
+    restore = helper.create_variable_for_type_inference("int32")
+    ins = {"FpnRois": [fpn_rois.name]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num.name]
+    helper.append_op("distribute_fpn_proposals",
+                     ins,
+                     {"MultiFpnRois": [o.name for o in outs],
+                      "MultiLevelCounts": [counts.name],
+                      "RestoreIndex": [restore.name]},
+                     {"min_level": min_level, "max_level": max_level,
+                      "refer_level": refer_level,
+                      "refer_scale": refer_scale})
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper(name or "collect_fpn_proposals")
+    out = helper.create_variable_for_type_inference("float32")
+    cnt = helper.create_variable_for_type_inference("int32")
+    helper.append_op("collect_fpn_proposals",
+                     {"MultiLevelRois": [r.name for r in multi_rois],
+                      "MultiLevelScores": [s.name for s in multi_scores]},
+                     {"FpnRois": [out.name], "RoisCount": [cnt.name]},
+                     {"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    helper = LayerHelper(name or "retinanet_detection_output")
+    out = helper.create_variable_for_type_inference("float32")
+    cnt = helper.create_variable_for_type_inference("int32")
+    helper.append_op("retinanet_detection_output",
+                     {"BBoxes": [b.name for b in bboxes],
+                      "Scores": [s.name for s in scores],
+                      "Anchors": [a.name for a in anchors],
+                      "ImInfo": [im_info.name]},
+                     {"Out": [out.name], "NmsRoisNum": [cnt.name]},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold})
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, mismatch_value=0, name=None):
+    """SSD multibox loss composed from the matching/assignment primitives
+    (reference: layers/detection.py ssd_loss). Dense redesign: gt_box
+    [n, b, 4], gt_label [n, b, 1] int; location [n, p, 4] encoded deltas,
+    confidence [n, p, cls]; prior_box [p, 4]. Returns [n, p, 1] loss.
+
+    Pipeline (as in the reference): iou -> bipartite match -> hard-negative
+    mining -> target assign (loc + conf) -> smooth_l1 + softmax xent.
+    """
+    from . import nn as nn_layers
+    from . import tensor as t_layers
+    from . import math as m_layers
+
+    n, b = gt_box.shape[0], gt_box.shape[1]
+    p = prior_box.shape[0]
+    # 1. per-image IoU between gts [b,4] and priors [p,4] -> match
+    iou = iou_similarity(t_layers.reshape(gt_box, [-1, 4]), prior_box)
+    iou3 = t_layers.reshape(iou, [n, b, p])
+    midx, mdist = bipartite_match(iou3, "per_prediction",
+                                  overlap_threshold)
+    # 2. mining loss proxy: background probability shortfall per prior
+    conf_sm = nn_layers.softmax(confidence)
+    bg_prob = t_layers.reshape(
+        t_layers.slice(conf_sm, axes=[2], starts=[background_label],
+                       ends=[background_label + 1]), [n, p])
+    mine_loss = m_layers.scale(bg_prob, scale=-1.0, bias=1.0)
+    neg_idx, upd_idx = mine_hard_examples(
+        mine_loss, midx, mdist, neg_pos_ratio=neg_pos_ratio,
+        neg_dist_threshold=neg_overlap)
+    # 3. targets. Location regression is trained against ENCODED deltas:
+    # box_coder(encode) gives per-(gt, prior) deltas [n*b, p, 4], and the
+    # 4-D target_assign gathers row (matched gt, prior) for each prior —
+    # matching the reference's encoded-bbox path. Without a variance var
+    # the encode uses unit variances.
+    enc = box_coder(prior_box, prior_box_var,
+                    t_layers.reshape(gt_box, [-1, 4]),
+                    code_type="encode_center_size")
+    enc4 = t_layers.reshape(enc, [n, b, p, 4])
+    loc_tgt, loc_w = target_assign(enc4, upd_idx, mismatch_value=0)
+    lbl_tgt, conf_w = target_assign(gt_label, upd_idx,
+                                    negative_indices=neg_idx,
+                                    mismatch_value=background_label)
+    # 4. losses (smooth_l1 sums all but dim 0, so flatten priors into the
+    # batch dim first — the reference ssd_loss does the same 2-D reshape)
+    loc_l = nn_layers.smooth_l1(
+        t_layers.reshape(location, [-1, 4]),
+        t_layers.reshape(loc_tgt, [-1, 4]),
+        inside_weight=t_layers.reshape(loc_w, [-1, 1]),
+        outside_weight=t_layers.reshape(loc_w, [-1, 1]))
+    loc_l = t_layers.reshape(loc_l, [n, p, 1])
+    conf_l = nn_layers.softmax_with_cross_entropy(
+        confidence, t_layers.cast(lbl_tgt, "int64"))
+    loss = m_layers.elementwise_add(
+        m_layers.scale(loc_l, scale=loc_loss_weight),
+        m_layers.scale(m_layers.elementwise_mul(conf_l, conf_w),
+                       scale=conf_loss_weight))
+    return loss

@@ -14,3 +14,6 @@ from . import quant_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import extra_ops  # noqa: F401
+from . import nn_extra_ops  # noqa: F401
+from . import lod_array_ops  # noqa: F401

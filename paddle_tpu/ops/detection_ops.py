@@ -324,3 +324,808 @@ def _box_clip(ctx, ins, attrs):
     x2 = jnp.clip(boxes[..., 2], 0, w)
     y2 = jnp.clip(boxes[..., 3], 0, h)
     return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register_op("sigmoid_focal_loss", no_grad_inputs={"Label", "FgNum"})
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """reference: detection/sigmoid_focal_loss_op.h — X [N, C] logits,
+    Label [N, 1] in {-1, 0, 1..C} (g==d+1 is positive for class d, -1 is
+    ignored), FgNum [1] foreground count normalizer."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    fg = ins["FgNum"][0].reshape(-1)[0].astype(x.dtype)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    num_classes = x.shape[1]
+    d = jnp.arange(num_classes)[None, :]
+    g = label[:, None]
+    c_pos = (g == d + 1).astype(x.dtype)
+    c_neg = ((g != -1) & (g != d + 1)).astype(x.dtype)
+    fg = jnp.maximum(fg, 1.0)
+    s_pos = alpha / fg
+    s_neg = (1.0 - alpha) / fg
+    p = jax.nn.sigmoid(x)
+    tiny = jnp.finfo(x.dtype).tiny
+    term_pos = jnp.power(1.0 - p, gamma) * jnp.log(jnp.maximum(p, tiny))
+    # numerically-stable log(1-p) = -x*(x>=0) - log(1+exp(x-2x*(x>=0)))
+    xpos = (x >= 0).astype(x.dtype)
+    term_neg = jnp.power(p, gamma) * (
+        -x * xpos - jnp.log1p(jnp.exp(x - 2.0 * x * xpos)))
+    out = -c_pos * term_pos * s_pos - c_neg * term_neg * s_neg
+    return {"Out": [out]}
+
+
+@register_op("yolov3_loss",
+             no_grad_inputs={"GTBox", "GTLabel", "GTScore"},
+             non_diff_outputs={"ObjectnessMask", "GTMatchMask"})
+def _yolov3_loss(ctx, ins, attrs):
+    """reference: detection/yolov3_loss_op.h. X [n, mask*(5+cls), h, w],
+    GTBox [n, b, 4] (cx,cy,w,h normalized), GTLabel [n, b], optional
+    GTScore [n, b] (mixup). Loss [n]; ObjectnessMask [n, mask, h, w]
+    (score>0 positive, 0 negative, -1 ignored); GTMatchMask [n, b].
+
+    Matching (best-anchor argmax, ignore-thresh IoU) is combinatorial and
+    treated as constant by the gradient, exactly like the reference's
+    hand-written grad kernel; the loss terms themselves are pure jnp so
+    jax.vjp reproduces the reference gradients."""
+    x = ins["X"][0]
+    gt_box = ins["GTBox"][0]
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)
+    gt_score = ins.get("GTScore", [None])[0]
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    b = gt_box.shape[1]
+    input_size = downsample * h
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), x.dtype)
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        delta = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - delta, delta
+
+    x5 = x.reshape(n, mask_num, 5 + class_num, h, w)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    # predicted boxes (normalized cx,cy,w,h) for the ignore-thresh pass
+    gi = jnp.arange(w)[None, None, None, :]
+    gj = jnp.arange(h)[None, None, :, None]
+    aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                     x.dtype)[None, :, None, None]
+    ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                     x.dtype)[None, :, None, None]
+    px = (gi + jax.nn.sigmoid(x5[:, :, 0])) / w
+    py = (gj + jax.nn.sigmoid(x5[:, :, 1])) / h
+    pw = jnp.exp(x5[:, :, 2]) * aw / input_size
+    ph = jnp.exp(x5[:, :, 3]) * ah / input_size
+
+    gt_valid = (gt_box[..., 2] > 1e-6) & (gt_box[..., 3] > 1e-6)  # [n,b]
+
+    def centered_iou(cx1, cy1, w1, h1, cx2, cy2, w2, h2):
+        ov_w = jnp.minimum(cx1 + w1 / 2, cx2 + w2 / 2) - \
+            jnp.maximum(cx1 - w1 / 2, cx2 - w2 / 2)
+        ov_h = jnp.minimum(cy1 + h1 / 2, cy2 + h2 / 2) - \
+            jnp.maximum(cy1 - h1 / 2, cy2 - h2 / 2)
+        inter = jnp.where((ov_w < 0) | (ov_h < 0), 0.0, ov_w * ov_h)
+        return inter / (w1 * h1 + w2 * h2 - inter + 1e-10)
+
+    # best IoU of each prediction against any valid gt: [n,mask,h,w]
+    iou_all = centered_iou(
+        px[..., None], py[..., None], pw[..., None], ph[..., None],
+        gt_box[:, None, None, None, :, 0], gt_box[:, None, None, None, :, 1],
+        gt_box[:, None, None, None, :, 2], gt_box[:, None, None, None, :, 3])
+    iou_all = jnp.where(gt_valid[:, None, None, None, :], iou_all, 0.0)
+    best_iou = iou_all.max(axis=-1)
+
+    # gt -> best anchor (shape-only IoU over ALL anchors)
+    all_aw = jnp.asarray(anchors[0::2], x.dtype) / input_size
+    all_ah = jnp.asarray(anchors[1::2], x.dtype) / input_size
+    shape_iou = centered_iou(
+        0.0, 0.0, gt_box[..., 2, None], gt_box[..., 3, None],
+        0.0, 0.0, all_aw[None, None, :], all_ah[None, None, :])  # [n,b,an]
+    best_n = jnp.argmax(shape_iou, axis=-1)                      # [n,b]
+    mask_lookup = -jnp.ones((an_num,), jnp.int32)
+    for mi, m in enumerate(anchor_mask):
+        mask_lookup = mask_lookup.at[m].set(mi)
+    match_mask = jnp.where(gt_valid, mask_lookup[best_n], -1)    # [n,b]
+
+    gi_t = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj_t = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # objectness mask: score at matched cells, -1 at ignored, 0 else
+    matched = match_mask >= 0
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+    # unmatched gts are routed to a disposable padding column (w) so their
+    # scatter can never clobber a real cell (duplicate-index .set ordering
+    # is unspecified; a stale-read re-write at (0,0,0) could drop a true
+    # positive's score)
+    col = jnp.where(matched, gi_t, w)
+
+    def scatter_img(om, mm, gj_, gi_, up):
+        padded = jnp.pad(om, ((0, 0), (0, 0), (0, 1)))
+        return padded.at[mm, gj_, gi_].set(up)[:, :, :w]
+
+    obj_mask = jax.vmap(scatter_img)(obj_mask, match_mask.clip(0), gj_t,
+                                     col, gt_score)
+
+    # location + class loss gathered at matched cells
+    def per_gt(img_x5, box, lbl, score, mm, gj_, gi_, valid):
+        mi = mm.clip(0)
+        feats = img_x5[mi, :, gj_, gi_]            # [5+cls]
+        best = jnp.clip(jnp.asarray(anchor_mask)[mi], 0, an_num - 1)
+        anw = jnp.asarray(anchors[0::2], x.dtype)[best]
+        anh = jnp.asarray(anchors[1::2], x.dtype)[best]
+        tx = box[0] * w - gi_
+        ty = box[1] * h - gj_
+        tw = jnp.log(jnp.maximum(box[2] * input_size / anw, 1e-9))
+        th = jnp.log(jnp.maximum(box[3] * input_size / anh, 1e-9))
+        scale = (2.0 - box[2] * box[3]) * score
+        loc = bce(feats[0], tx) * scale + bce(feats[1], ty) * scale + \
+            jnp.abs(feats[2] - tw) * scale + jnp.abs(feats[3] - th) * scale
+        cls_t = jnp.where(jnp.arange(class_num) == lbl, label_pos, label_neg)
+        cls_l = (bce(feats[5:], cls_t) * score).sum()
+        return jnp.where(valid & (mm >= 0), loc + cls_l, 0.0)
+
+    per_gt_loss = jax.vmap(jax.vmap(per_gt, in_axes=(None, 0, 0, 0, 0, 0,
+                                                     0, 0)))(
+        x5, gt_box, gt_label, gt_score, match_mask, gj_t, gi_t, gt_valid)
+    loss = per_gt_loss.sum(axis=1)
+
+    # objectness loss over all cells
+    obj_logit = x5[:, :, 4]
+    pos = obj_mask > 1e-5
+    neg = (~pos) & (obj_mask > -0.5)
+    obj_l = jnp.where(pos, bce(obj_logit, 1.0) * obj_mask, 0.0) + \
+        jnp.where(neg, bce(obj_logit, 0.0), 0.0)
+    loss = loss + obj_l.sum(axis=(1, 2, 3))
+    return {"Loss": [loss],
+            "ObjectnessMask": [obj_mask],
+            "GTMatchMask": [match_mask.astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# priors / transforms
+# ---------------------------------------------------------------------------
+
+@register_op("density_prior_box", not_differentiable=True, grad_free=True)
+def _density_prior_box(ctx, ins, attrs):
+    """reference: detection/density_prior_box_op.h — dense grid of priors
+    per (fixed_size, density) pair x fixed_ratios."""
+    feat, image = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    densities = [int(d) for d in attrs["densities"]]
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", True)
+    step_average = int((step_w + step_h) * 0.5)
+
+    cx = (jnp.arange(w) + offset) * step_w      # [w]
+    cy = (jnp.arange(h) + offset) * step_h      # [h]
+    cx, cy = jnp.meshgrid(cx, cy)               # [h, w]
+
+    boxes = []
+    for fs, density in zip(fixed_sizes, densities):
+        shift = step_average // density
+        for r in fixed_ratios:
+            bw = fs * (r ** 0.5)
+            bh = fs / (r ** 0.5)
+            d0x = cx - step_average / 2.0 + shift / 2.0
+            d0y = cy - step_average / 2.0 + shift / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    ccx = d0x + dj * shift
+                    ccy = d0y + di * shift
+                    boxes.append(jnp.stack([
+                        jnp.maximum((ccx - bw / 2.0) / img_w, 0.0),
+                        jnp.maximum((ccy - bh / 2.0) / img_h, 0.0),
+                        jnp.minimum((ccx + bw / 2.0) / img_w, 1.0),
+                        jnp.minimum((ccy + bh / 2.0) / img_h, 1.0),
+                    ], axis=-1))
+    out = jnp.stack(boxes, axis=2)              # [h, w, np, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]))
+    variances = jnp.broadcast_to(var, out.shape)
+    return {"Boxes": [out.astype(jnp.float32)],
+            "Variances": [variances.astype(jnp.float32)]}
+
+
+@register_op("polygon_box_transform", not_differentiable=True,
+             grad_free=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """reference: detection/polygon_box_transform_op.cc (EAST text
+    detection geometry map: offsets -> absolute quad coords)."""
+    x = ins["Input"][0]
+    n, g, h, w = x.shape
+    id_w = jnp.arange(w)[None, None, None, :].astype(x.dtype)
+    id_h = jnp.arange(h)[None, None, :, None].astype(x.dtype)
+    even = (jnp.arange(g) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(even, id_w * 4 - x, id_h * 4 - x)]}
+
+
+@register_op("box_decoder_and_assign",
+             no_grad_inputs={"PriorBox", "PriorBoxVar", "BoxScore"})
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """reference: detection/box_decoder_and_assign_op.h — per-class decode
+    of [r, cls*4] deltas + pick the best non-background class's box."""
+    prior = ins["PriorBox"][0]
+    # the reference kernel reads only prior_box_var_data[0..3] — one
+    # shared variance vector, not per-prior (box_decoder_and_assign_op.h)
+    pvar = ins["PriorBoxVar"][0].reshape(-1)[:4]
+    target = ins["TargetBox"][0]
+    score = ins["BoxScore"][0]
+    clip = attrs.get("box_clip", 4.135)
+    r = target.shape[0]
+    cls = score.shape[1]
+    t = target.reshape(r, cls, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1
+    phh = prior[:, 3] - prior[:, 1] + 1
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + phh / 2
+    dw = jnp.minimum(pvar[2] * t[..., 2], clip)
+    dh = jnp.minimum(pvar[3] * t[..., 3], clip)
+    cx = pvar[0] * t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = pvar[1] * t[..., 1] * phh[:, None] + pcy[:, None]
+    ww = jnp.exp(dw) * pw[:, None]
+    hh = jnp.exp(dh) * phh[:, None]
+    decode = jnp.stack([cx - ww / 2, cy - hh / 2,
+                        cx + ww / 2 - 1, cy + hh / 2 - 1], -1)  # [r,cls,4]
+    # best non-background class (class 0 is background)
+    sc = score.at[:, 0].set(-jnp.inf) if cls > 0 else score
+    best = jnp.argmax(sc, axis=1)
+    assign = jnp.take_along_axis(decode, best[:, None, None].repeat(4, -1),
+                                 axis=1)[:, 0]
+    has_fg = (best > 0)
+    assign = jnp.where(has_fg[:, None], assign, prior[:, :4])
+    return {"DecodeBox": [decode.reshape(r, cls * 4)],
+            "OutputAssignBox": [assign]}
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment
+# ---------------------------------------------------------------------------
+
+@register_op("bipartite_match", not_differentiable=True, grad_free=True)
+def _bipartite_match(ctx, ins, attrs):
+    """reference: detection/bipartite_match_op.cc — greedy global
+    bipartite matching on DistMat [n, row, col] (batched dense form of
+    the reference's LoD segments). Outputs ColToRowMatchIndices [n, col]
+    (-1 = unmatched) and ColToRowMatchDist [n, col]."""
+    dist = ins["DistMat"][0]
+    if dist.ndim == 2:
+        dist = dist[None]
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = attrs.get("dist_threshold", 0.5)
+    n, row, col = dist.shape
+    iters = min(row, col)
+
+    def one(dmat):
+        def body(k, state):
+            midx, mdist, row_free = state
+            masked = jnp.where(row_free[:, None] & (midx == -1)[None, :]
+                               & (dmat > 1e-6), dmat, -1.0)
+            flat = jnp.argmax(masked)
+            i, j = flat // col, flat % col
+            ok = masked[i, j] > 0
+            midx = jnp.where(ok, midx.at[j].set(i.astype(jnp.int32)), midx)
+            mdist = jnp.where(ok, mdist.at[j].set(dmat[i, j]), mdist)
+            row_free = jnp.where(ok, row_free.at[i].set(False), row_free)
+            return midx, mdist, row_free
+
+        midx = -jnp.ones((col,), jnp.int32)
+        mdist = jnp.zeros((col,), dmat.dtype)
+        row_free = jnp.ones((row,), jnp.bool_)
+        midx, mdist, row_free = jax.lax.fori_loop(
+            0, iters, body, (midx, mdist, row_free))
+        if match_type == "per_prediction":
+            # unmatched cols take their argmax row if >= threshold
+            best = jnp.argmax(dmat, axis=0)
+            bestv = dmat.max(axis=0)
+            extra = (midx == -1) & (bestv >= thresh) & (bestv > 1e-6)
+            midx = jnp.where(extra, best.astype(jnp.int32), midx)
+            mdist = jnp.where(extra, bestv, mdist)
+        return midx, mdist
+
+    midx, mdist = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [midx], "ColToRowMatchDist": [mdist]}
+
+
+@register_op("target_assign",
+             no_grad_inputs={"MatchIndices", "NegIndices"})
+def _target_assign(ctx, ins, attrs):
+    """reference: detection/target_assign_op.h. Dense redesign of the
+    LoD form: X [n, b, K] per-image entity targets — or [n, b, P, K]
+    per-entity-PER-PRIOR targets (the reference's P>1 case, used for
+    encoded loc deltas where column m reads X[id, m, :]). MatchIndices
+    [n, m] (-1 = mismatch), optional NegIndices [n, q] padded with -1.
+    Out [n, m, K]; OutWeight [n, m, 1]."""
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)
+    neg = ins.get("NegIndices", [None])[0]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    n, m = match.shape
+    k = x.shape[-1]
+    matched = match >= 0
+    if x.ndim == 4:
+        # X [n, b, P, K]: out[i, j] = X[i, match[i,j], j % P]
+        p = x.shape[2]
+        cols = jnp.arange(m) % p
+
+        def gather_img(xi, mi):
+            return xi[mi.clip(0), cols]         # [m, K]
+
+        gathered = jax.vmap(gather_img)(x, match)
+    else:
+        gathered = jnp.take_along_axis(
+            x, match.clip(0)[:, :, None].repeat(k, -1), axis=1)
+    out = jnp.where(matched[:, :, None], gathered,
+                    jnp.full((1, 1, k), float(mismatch_value), x.dtype))
+    wt = matched.astype(jnp.float32)[:, :, None]
+    if neg is not None:
+        neg = neg.astype(jnp.int32)
+        # scatter weight 1 at negative indices (reference NegTargetAssign)
+        def one(w_img, neg_img):
+            valid = neg_img >= 0
+            return w_img.at[neg_img.clip(0), 0].add(
+                jnp.where(valid, 1.0, 0.0))
+        wt = jax.vmap(one)(wt, neg)
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+@register_op("mine_hard_examples", not_differentiable=True, grad_free=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """reference: detection/mine_hard_examples_op.cc (SSD OHEM). Fixed-
+    size redesign: NegIndices [n, p] padded with -1 (the reference emits
+    a LoD tensor), NegCount [n]; UpdatedMatchIndices [n, p]."""
+    cls_loss = ins["ClsLoss"][0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)
+    mdist = ins["MatchDist"][0]
+    loc_loss = ins.get("LocLoss", [None])[0]
+    mining = attrs.get("mining_type", "max_negative")
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_thresh = attrs.get("neg_dist_threshold", 0.5)
+    sample_size = int(attrs.get("sample_size", 0))
+    n, p = match.shape
+    loss = cls_loss
+    if mining == "hard_example" and loc_loss is not None:
+        loss = cls_loss + loc_loss
+    if mining == "max_negative":
+        eligible = (match == -1) & (mdist < neg_thresh)
+    else:
+        # hard_example mining ranks EVERY prior (positives included);
+        # unselected positives are demoted below (reference
+        # IsEligibleMining returns true for kHardExample)
+        eligible = jnp.ones_like(match, jnp.bool_)
+    cand = jnp.where(eligible, loss.reshape(n, p), -jnp.inf)
+    order = jnp.argsort(-cand, axis=1)                  # desc by loss
+    rank = jnp.argsort(order, axis=1)
+    n_elig = eligible.sum(axis=1)
+    if mining == "max_negative":
+        num_pos = (match != -1).sum(axis=1)
+        neg_sel = jnp.minimum((num_pos * ratio).astype(jnp.int32), n_elig)
+    else:
+        neg_sel = jnp.minimum(sample_size, n_elig)
+    selected = eligible & (rank < neg_sel[:, None])
+    # NegIndices: selected prior positions first (ascending), -1 padding
+    pos_idx = jnp.where(selected, jnp.arange(p)[None, :], p)
+    neg_sorted = jnp.sort(pos_idx, axis=1)
+    updated = match
+    if mining == "hard_example":
+        # positives not selected as hard examples get dropped, and
+        # NegIndices only lists the selected NEGATIVES
+        updated = jnp.where((match > -1) & ~selected, -1, match)
+        sel_neg = selected & (match == -1)
+        pos_idx = jnp.where(sel_neg, jnp.arange(p)[None, :], p)
+        neg_sorted = jnp.sort(pos_idx, axis=1)
+        neg_sel = sel_neg.sum(axis=1)
+    neg_indices = jnp.where(neg_sorted < p, neg_sorted, -1)
+    return {"NegIndices": [neg_indices.astype(jnp.int32)],
+            "NegCount": [neg_sel.astype(jnp.int32)],
+            "UpdatedMatchIndices": [updated]}
+
+
+@register_op("rpn_target_assign", not_differentiable=True, grad_free=True,
+             stateful=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """reference: detection/rpn_target_assign_op.cc. Fixed-size redesign:
+    per-anchor outputs instead of gathered variable-length index lists —
+    TargetLabel [n, A] (1 fg / 0 bg / -1 ignore after subsampling),
+    TargetBBox [n, A, 4] encoded regression targets, BBoxInsideWeight
+    [n, A, 4] (1 on fg rows), ScoreIndex/LocationIndex [n, A] padded
+    position lists (-1 padding) for API parity."""
+    anchor = ins["Anchor"][0]                    # [A, 4]
+    gt_boxes = ins["GtBoxes"][0]                 # [n, g, 4] dense
+    is_crowd = ins.get("IsCrowd", [None])[0]     # [n, g]
+    im_info = ins["ImInfo"][0]                   # [n, 3]
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    pos_ov = attrs.get("rpn_positive_overlap", 0.7)
+    neg_ov = attrs.get("rpn_negative_overlap", 0.3)
+    use_random = bool(attrs.get("use_random", True))
+    a = anchor.shape[0]
+    n = gt_boxes.shape[0]
+    key = ctx.rng()
+
+    def one(img_gt, img_crowd, info, k):
+        im_h, im_w = info[0], info[1]
+        if straddle >= 0:
+            inside = ((anchor[:, 0] >= -straddle) &
+                      (anchor[:, 1] >= -straddle) &
+                      (anchor[:, 2] < im_w + straddle) &
+                      (anchor[:, 3] < im_h + straddle))
+        else:
+            inside = jnp.ones((a,), jnp.bool_)
+        gt_valid = (img_gt[:, 2] > img_gt[:, 0]) & \
+            (img_gt[:, 3] > img_gt[:, 1])
+        if img_crowd is not None:
+            gt_valid &= (img_crowd == 0)
+        iou = _iou_matrix(anchor, img_gt)                     # [A, g]
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        iou = jnp.where(inside[:, None], iou, 0.0)
+        a2g_max = iou.max(axis=1)
+        a2g_arg = jnp.argmax(iou, axis=1)
+        g2a_max = iou.max(axis=0)
+        is_best = (jnp.abs(iou - g2a_max[None, :]) < 1e-5) & \
+            (g2a_max[None, :] > 0)
+        fg_mask = inside & ((a2g_max >= pos_ov) | is_best.any(axis=1))
+        bg_mask = inside & ~fg_mask & (a2g_max < neg_ov)
+
+        # subsample: random priority among candidates via rng keys
+        fg_target = int(batch_per_im * fg_frac)
+        pri = jax.random.uniform(k, (a,)) if use_random \
+            else -jnp.arange(a, dtype=jnp.float32)
+        fg_pri = jnp.where(fg_mask, pri, -jnp.inf)
+        fg_rank = jnp.argsort(jnp.argsort(-fg_pri))
+        fg_keep = fg_mask & (fg_rank < fg_target)
+        n_fg = jnp.minimum(fg_mask.sum(), fg_target)
+        bg_target = batch_per_im - n_fg
+        bg_pri = jnp.where(bg_mask, pri, -jnp.inf)
+        bg_rank = jnp.argsort(jnp.argsort(-bg_pri))
+        bg_keep = bg_mask & (bg_rank < bg_target)
+
+        labels = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        # encoded regression targets vs matched gt (variance-free)
+        mgt = img_gt[a2g_arg]
+        aw = anchor[:, 2] - anchor[:, 0] + 1
+        ah = anchor[:, 3] - anchor[:, 1] + 1
+        acx = anchor[:, 0] + aw / 2
+        acy = anchor[:, 1] + ah / 2
+        gw = mgt[:, 2] - mgt[:, 0] + 1
+        gh = mgt[:, 3] - mgt[:, 1] + 1
+        gcx = mgt[:, 0] + gw / 2
+        gcy = mgt[:, 1] + gh / 2
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+        tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+        inw = jnp.where(fg_keep[:, None],
+                        jnp.ones((a, 4), anchor.dtype), 0.0)
+        # padded position lists (fg first for LocationIndex; fg+bg for
+        # ScoreIndex), -1 padding
+        loc_pos = jnp.where(fg_keep, jnp.arange(a), a)
+        loc_idx = jnp.where(jnp.sort(loc_pos) < a, jnp.sort(loc_pos), -1)
+        sc_pos = jnp.where(fg_keep | bg_keep, jnp.arange(a), a)
+        sc_idx = jnp.where(jnp.sort(sc_pos) < a, jnp.sort(sc_pos), -1)
+        return (labels.astype(jnp.int32), tgt, inw,
+                loc_idx.astype(jnp.int32), sc_idx.astype(jnp.int32))
+
+    keys = jax.random.split(key, n)
+    if is_crowd is None:
+        labels, tgt, inw, loc, sc = jax.vmap(
+            lambda g, i, k: one(g, None, i, k))(gt_boxes, im_info, keys)
+    else:
+        labels, tgt, inw, loc, sc = jax.vmap(one)(
+            gt_boxes, is_crowd, im_info, keys)
+    return {"TargetLabel": [labels], "TargetBBox": [tgt],
+            "BBoxInsideWeight": [inw], "LocationIndex": [loc],
+            "ScoreIndex": [sc]}
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling / proposal generation (Faster R-CNN family)
+# ---------------------------------------------------------------------------
+
+@register_op("roi_pool", no_grad_inputs={"ROIs", "RoisNum"},
+             non_diff_outputs={"Argmax"})
+def _roi_pool(ctx, ins, attrs):
+    """reference: operators/roi_pool_op.h — max pooling over RoI bins
+    (integer-rounded bin edges, unlike roi_align's bilinear samples).
+    X [n,c,h,w], ROIs [r,4], optional RoisNum [n]. Out [r,c,ph,pw]."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    rois_num = ins.get("RoisNum", [None])[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    if rois_num is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(n, dtype=jnp.int32),
+                               rois_num.astype(jnp.int32),
+                               total_repeat_length=rois.shape[0])
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(roi, bi):
+        rx1 = jnp.round(roi[0] * scale)
+        ry1 = jnp.round(roi[1] * scale)
+        rx2 = jnp.round(roi[2] * scale)
+        ry2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(ry2 - ry1 + 1, 1.0)
+        rw = jnp.maximum(rx2 - rx1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = x[bi]                                       # [c,h,w]
+
+        def one_bin(p_h, p_w):
+            hstart = jnp.clip(jnp.floor(p_h * bin_h) + ry1, 0, h)
+            hend = jnp.clip(jnp.ceil((p_h + 1) * bin_h) + ry1, 0, h)
+            wstart = jnp.clip(jnp.floor(p_w * bin_w) + rx1, 0, w)
+            wend = jnp.clip(jnp.ceil((p_w + 1) * bin_w) + rx1, 0, w)
+            in_h = (ys >= hstart) & (ys < hend)
+            in_w = (xs >= wstart) & (xs < wend)
+            m = in_h[:, None] & in_w[None, :]
+            empty = ~(m.any())
+            masked = jnp.where(m[None], img, -jnp.inf)
+            mx = masked.reshape(c, -1).max(axis=1)
+            am = masked.reshape(c, -1).argmax(axis=1)
+            return jnp.where(empty, 0.0, mx), \
+                jnp.where(empty, -1, am).astype(jnp.int64)
+
+        ph_i = jnp.arange(ph, dtype=jnp.float32)
+        pw_i = jnp.arange(pw, dtype=jnp.float32)
+        vals, args = jax.vmap(lambda a_: jax.vmap(
+            lambda b_: one_bin(a_, b_))(pw_i))(ph_i)      # [ph,pw,c]
+        return vals.transpose(2, 0, 1), args.transpose(2, 0, 1)
+
+    out, argmax = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out], "Argmax": [argmax]}
+
+
+def _nms_keep(boxes, scores, valid, nms_thresh, normalized=True):
+    """Greedy NMS keep-mask over pre-sorted (desc score) boxes [k, 4]."""
+    k = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes, normalized)
+
+    def body(i, keep):
+        sup = (iou[i] > nms_thresh) & (jnp.arange(k) > i) & keep[i]
+        return keep & ~sup
+
+    return jax.lax.fori_loop(0, k, body, valid)
+
+
+@register_op("generate_proposals", not_differentiable=True, grad_free=True)
+def _generate_proposals(ctx, ins, attrs):
+    """reference: detection/generate_proposals_op.cc. Decode RPN deltas
+    at every anchor, clip to image, filter small boxes, keep pre_nms_topN
+    by score, NMS, keep post_nms_topN. Fixed-size redesign: RpnRois
+    [n, post_nms_topN, 4] zero-padded + RpnRoisNum [n] (the reference
+    emits LoD). Scores [n, a, 1], BboxDeltas [n, a*4... ] are taken in
+    the flattened-anchor layout [n, A, 1] / [n, A, 4] with Anchors
+    [A, 4], Variances [A, 4]."""
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    im_info = ins["ImInfo"][0]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = attrs.get("nms_thresh", 0.5)
+    min_size = attrs.get("min_size", 0.1)
+    eta = attrs.get("eta", 1.0)  # adaptive NMS unsupported; eta>=1 exact
+    a = anchors.shape[0]
+    n = scores.shape[0]
+    sc = scores.reshape(n, a)
+    dl = deltas.reshape(n, a, 4)
+    k = min(pre_n, a)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+
+    def one(sc_i, dl_i, info):
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        cx = variances[:, 0] * dl_i[:, 0] * aw + acx
+        cy = variances[:, 1] * dl_i[:, 1] * ah + acy
+        # the reference clips dw/dh at log(1000/16)
+        bw = jnp.exp(jnp.minimum(variances[:, 2] * dl_i[:, 2],
+                                 jnp.log(1000.0 / 16))) * aw
+        bh = jnp.exp(jnp.minimum(variances[:, 3] * dl_i[:, 3],
+                                 jnp.log(1000.0 / 16))) * ah
+        x1 = jnp.clip(cx - bw / 2, 0, im_w - 1)
+        y1 = jnp.clip(cy - bh / 2, 0, im_h - 1)
+        x2 = jnp.clip(cx + bw / 2 - 1, 0, im_w - 1)
+        y2 = jnp.clip(cy + bh / 2 - 1, 0, im_h - 1)
+        ms = min_size * im_scale
+        keep_size = ((x2 - x1 + 1) >= ms) & ((y2 - y1 + 1) >= ms)
+        s = jnp.where(keep_size, sc_i, -jnp.inf)
+        top_s, idx = jax.lax.top_k(s, k)
+        boxes = jnp.stack([x1, y1, x2, y2], -1)[idx]
+        valid = jnp.isfinite(top_s)
+        keep = _nms_keep(boxes, top_s, valid, nms_thresh,
+                         normalized=False)
+        kept_s = jnp.where(keep, top_s, -jnp.inf)
+        fin_s, fin_i = jax.lax.top_k(kept_s, min(post_n, k))
+        out = boxes[fin_i]
+        ok = jnp.isfinite(fin_s)
+        out = jnp.where(ok[:, None], out, 0.0)
+        probs = jnp.where(ok, fin_s, 0.0)
+        if post_n > k:
+            out = jnp.pad(out, ((0, post_n - k), (0, 0)))
+            probs = jnp.pad(probs, (0, post_n - k))
+            ok = jnp.pad(ok, (0, post_n - k))
+        return out, probs, ok.sum().astype(jnp.int32)
+
+    rois, probs, counts = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs[..., None]],
+            "RpnRoisNum": [counts]}
+
+
+@register_op("distribute_fpn_proposals", not_differentiable=True,
+             grad_free=True)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """reference: detection/distribute_fpn_proposals_op.h. Fixed-size
+    redesign: every level output is [r, 4] with that level's rois packed
+    first (zero padding) + MultiLevelCounts [levels]; RestoreIndex maps
+    each original roi to its row in the fixed concat of levels."""
+    rois = ins["FpnRois"][0]
+    min_level = int(attrs["min_level"])
+    max_level = int(attrs["max_level"])
+    refer_level = int(attrs["refer_level"])
+    refer_scale = int(attrs["refer_scale"])
+    num_level = max_level - min_level + 1
+    r = rois.shape[0]
+    # optional valid counts (our fixed-size generate_proposals zero-pads):
+    # padding rows must not be classified as tiny min_level rois.
+    # RoisNum [n] covers the batched layout where FpnRois is the
+    # reshape of [n, r/n, 4] — each image owns an equal r/n stride.
+    rois_num = ins.get("RoisNum", [None])[0]
+    if rois_num is not None:
+        counts = rois_num.reshape(-1)
+        stride = r // counts.shape[0]
+        valid = (jnp.arange(r) % stride) < counts[jnp.arange(r) // stride]
+    else:
+        valid = jnp.ones((r,), bool)
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    area = (w + 1) * (h + 1)
+    roi_scale = jnp.sqrt(area)
+    lvl = jnp.floor(jnp.log2(roi_scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl = jnp.where(valid, lvl, -1)
+
+    outs, counts, restore = [], [], jnp.zeros((r,), jnp.int32)
+    for li in range(num_level):
+        mask = lvl == (min_level + li)
+        order = jnp.argsort(~mask, stable=True)      # level rois first
+        packed = jnp.where((jnp.arange(r) < mask.sum())[:, None],
+                           rois[order], 0.0)
+        outs.append(packed)
+        counts.append(mask.sum())
+        rank = jnp.argsort(order)                    # row within level out
+        restore = jnp.where(mask, li * r + rank, restore)
+    return {"MultiFpnRois": outs,
+            "MultiLevelCounts": [jnp.stack(counts).astype(jnp.int32)],
+            "RestoreIndex": [restore[:, None]]}
+
+
+@register_op("collect_fpn_proposals", not_differentiable=True,
+             grad_free=True)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """reference: detection/collect_fpn_proposals_op.h — merge per-level
+    (rois, scores), keep post_nms_topN by score. Fixed-size: FpnRois
+    [topN, 4] zero-padded + RoisCount [1]."""
+    rois_list = ins["MultiLevelRois"]
+    score_list = ins["MultiLevelScores"]
+    top_n = int(attrs.get("post_nms_topN", 100))
+    # scores <= 0 mark PADDING rows (our fixed-size per-level outputs pad
+    # with zeros); real proposals are expected to carry positive
+    # objectness probabilities, as in the reference
+    all_rois = jnp.concatenate([x.reshape(-1, 4) for x in rois_list], 0)
+    all_sc = jnp.concatenate([s.reshape(-1) for s in score_list], 0)
+    k = min(top_n, all_sc.shape[0])
+    top_s, idx = jax.lax.top_k(all_sc, k)
+    out = all_rois[idx]
+    ok = top_s > 0
+    out = jnp.where(ok[:, None], out, 0.0)
+    if top_n > k:
+        out = jnp.pad(out, ((0, top_n - k), (0, 0)))
+        ok = jnp.pad(ok, (0, top_n - k))
+    return {"FpnRois": [out], "RoisCount": [ok.sum().astype(jnp.int32)[None]]}
+
+
+@register_op("retinanet_detection_output", not_differentiable=True,
+             grad_free=True)
+def _retinanet_detection_output(ctx, ins, attrs):
+    """reference: detection/retinanet_detection_output_op.cc — decode
+    per-FPN-level (bbox deltas, sigmoid scores, anchors), keep per-level
+    nms_top_k candidates above score_threshold, then class-wise NMS and
+    keep_top_k. Fixed-size: Out [n, keep_top_k, 6] padded with -1."""
+    bboxes_l = ins["BBoxes"]            # each [n, Al, 4] deltas
+    scores_l = ins["Scores"]            # each [n, Al, cls] (sigmoid probs)
+    anchors_l = ins["Anchors"]          # each [Al, 4]
+    im_info = ins["ImInfo"][0]
+    score_thresh = attrs.get("score_threshold", 0.05)
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    n = bboxes_l[0].shape[0]
+    cls = scores_l[0].shape[-1]
+
+    def decode_level(deltas, anchors, info):
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(deltas[:, 2], jnp.log(1000 / 16.))) * aw
+        bh = jnp.exp(jnp.minimum(deltas[:, 3], jnp.log(1000 / 16.))) * ah
+        x1 = jnp.clip(cx - bw / 2, 0, info[1] - 1)
+        y1 = jnp.clip(cy - bh / 2, 0, info[0] - 1)
+        x2 = jnp.clip(cx + bw / 2 - 1, 0, info[1] - 1)
+        y2 = jnp.clip(cy + bh / 2 - 1, 0, info[0] - 1)
+        return jnp.stack([x1, y1, x2, y2], -1)
+
+    # per level (vectorized over the batch): decode + per-image top-k
+    cand_boxes, cand_scores, cand_labels = [], [], []
+    for deltas, sc, anch in zip(bboxes_l, scores_l, anchors_l):
+        boxes = jax.vmap(lambda d, i: decode_level(d, anch, i))(
+            deltas, im_info)                       # [n, Al, 4]
+        flat = sc.reshape(n, -1)                   # [n, Al*cls]
+        kk = min(nms_top_k, flat.shape[1])
+        top_s, idx = jax.lax.top_k(flat, kk)
+        ai = idx // cls
+        ci = idx % cls
+        keep = top_s > score_thresh
+        cand_boxes.append(jnp.take_along_axis(
+            boxes, ai[:, :, None].repeat(4, -1), axis=1))
+        cand_scores.append(jnp.where(keep, top_s, 0.0))
+        cand_labels.append(ci)
+    bx = jnp.concatenate(cand_boxes, 1)            # [n, L*kk, 4]
+    sc = jnp.concatenate(cand_scores, 1)
+    lb = jnp.concatenate(cand_labels, 1)
+
+    def one_image(bx_i, sc_i, lb_i):
+        # class-wise NMS: offset boxes per class so one NMS pass works
+        # (standard batched-NMS trick)
+        offset = lb_i.astype(bx_i.dtype)[:, None] * (jnp.max(bx_i) + 1.0)
+        order = jnp.argsort(-sc_i)
+        bx_s, sc_s, lb_s = bx_i[order], sc_i[order], lb_i[order]
+        keep = _nms_keep(bx_s + offset[order], sc_s, sc_s > 0,
+                         nms_thresh, normalized=False)
+        kept_s = jnp.where(keep, sc_s, 0.0)
+        kk = min(keep_top_k, kept_s.shape[0])
+        fin_s, fin_i = jax.lax.top_k(kept_s, kk)
+        out = jnp.concatenate([
+            lb_s[fin_i][:, None].astype(bx_i.dtype) + 1.0,  # 1-based
+            fin_s[:, None], bx_s[fin_i]], axis=1)
+        out = jnp.where((fin_s > 0)[:, None], out, -1.0)
+        if keep_top_k > kk:
+            out = jnp.pad(out, ((0, keep_top_k - kk), (0, 0)),
+                          constant_values=-1.0)
+        return out, (fin_s > 0).sum().astype(jnp.int32)
+
+    outs, counts = jax.vmap(one_image)(bx, sc, lb)
+    return {"Out": [outs], "NmsRoisNum": [counts]}

@@ -1,0 +1,439 @@
+"""Long-tail op sweep (reference: paddle/fluid/operators/*_op.cc names
+not covered by the themed modules). Mostly small dense kernels; a few
+fixed-size redesigns of LoD-emitting ops (unique, edit_distance, ctc).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+
+# ---------------------------------------------------------------------------
+# simple tensor / math (reference: eye_op.cc, fill_op.cc, minus_op.cc, ...)
+# ---------------------------------------------------------------------------
+
+
+@register_op("eye", not_differentiable=True, grad_free=True)
+def _eye(ctx, ins, attrs):
+    n = int(attrs["num_rows"])
+    m = int(attrs.get("num_columns", -1))
+    m = n if m < 0 else m
+    return {"Out": [jnp.eye(n, m, dtype=attrs.get("dtype", "float32"))]}
+
+
+@register_op("fill", not_differentiable=True, grad_free=True)
+def _fill(ctx, ins, attrs):
+    """reference: fill_op.cc — fill Out with a literal value list."""
+    vals = np.asarray(attrs["value"], dtype=attrs.get("dtype", "float32"))
+    return {"Out": [jnp.asarray(vals.reshape(attrs["shape"]))]}
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.abs(ins["X"][0]).sum()[None]]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    """reference: squared_l2_distance_op.h — per-row ||x-y||^2; also
+    emits the sub result for the grad."""
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    out = (sub * sub).reshape(x.shape[0], -1).sum(axis=1, keepdims=True)
+    return {"Out": [out], "sub_result": [sub]}
+
+
+@register_op("label_smooth", no_grad_inputs={"PriorDist"})
+def _label_smooth(ctx, ins, attrs):
+    """reference: label_smooth_op.h — (1-eps)*y + eps*prior (or eps/K)."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    prior = ins.get("PriorDist", [None])[0]
+    if prior is None:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    else:
+        out = (1.0 - eps) * x + eps * prior.reshape(
+            (1,) * (x.ndim - 1) + (-1,))
+    return {"Out": [out]}
+
+
+@register_op("selu")
+def _selu(ctx, ins, attrs):
+    scale = attrs.get("scale", 1.0507009873554804934193349852946)
+    alpha = attrs.get("alpha", 1.6732632423543772848170429916717)
+    x = ins["X"][0]
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))]}
+
+
+@register_op("crop", no_grad_inputs={"Y", "Offsets"})
+def _crop(ctx, ins, attrs):
+    """reference: crop_op.cc — crop X to `shape` starting at `offsets`."""
+    x = ins["X"][0]
+    shape = attrs.get("shape") or list(ins["Y"][0].shape)
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    idx = tuple(slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    axes = [int(a) for a in attrs.get("axis", [0])]
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(axes))]}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    return {"Out": [x.reshape(lead, -1)]}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = [int(a) for a in attrs.get("axes", [])]
+    if not axes:
+        return {"Out": [jnp.squeeze(x)]}
+    axes = tuple(a % x.ndim for a in axes)
+    return {"Out": [jnp.squeeze(x, axis=tuple(a for a in axes
+                                              if x.shape[a] == 1))]}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(int(a) for a in attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+@register_op("pad_constant_like", no_grad_inputs={"X"})
+def _pad_constant_like(ctx, ins, attrs):
+    """reference: pad_constant_like_op.cc — pad Y up to X's shape."""
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("multiplex", no_grad_inputs={"Ids"})
+def _multiplex(ctx, ins, attrs):
+    """reference: multiplex_op.cc — Out[i] = X[Ids[i]][i]."""
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(ins["X"], axis=0)             # [k, n, d]
+    return {"Out": [xs[ids, jnp.arange(xs.shape[1])]]}
+
+
+@register_op("is_empty", not_differentiable=True, grad_free=True)
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.asarray([x.size == 0])]}
+
+
+@register_op("mean_iou", not_differentiable=True, grad_free=True)
+def _mean_iou(ctx, ins, attrs):
+    """reference: mean_iou_op.h — segmentation mean IoU over classes."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    k = int(attrs["num_classes"])
+    inter = jnp.zeros((k,), jnp.int64).at[
+        jnp.where(pred == label, pred, k)].add(1, mode="drop")
+    pred_cnt = jnp.zeros((k,), jnp.int64).at[pred].add(1, mode="drop")
+    lab_cnt = jnp.zeros((k,), jnp.int64).at[label].add(1, mode="drop")
+    union = pred_cnt + lab_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+    return {"OutMeanIou": [miou.astype(jnp.float32)[None]],
+            "OutWrong": [(pred_cnt - inter).astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """reference: conv_shift_op.cc — circular correlation (NTM shift):
+    X [b, d], Y [b, m] (m odd) -> Out[b, i] = sum_j X[b, (i+j-m/2) % d]
+    * Y[b, j]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    b, d = x.shape
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(d)[:, None] + jnp.arange(m)[None, :] - half) % d
+    gathered = x[:, idx]                          # [b, d, m]
+    return {"Out": [(gathered * y[:, None, :]).sum(-1)]}
+
+
+@register_op("uniform_random_batch_size_like", not_differentiable=True,
+             grad_free=True, stateful=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    return {"Out": [jax.random.uniform(
+        ctx.rng(), tuple(shape), jnp.float32,
+        attrs.get("min", -1.0), attrs.get("max", 1.0))]}
+
+
+@register_op("gaussian_random_batch_size_like", not_differentiable=True,
+             grad_free=True, stateful=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    return {"Out": [attrs.get("mean", 0.0) + attrs.get("std", 1.0)
+                    * jax.random.normal(ctx.rng(), tuple(shape))]}
+
+
+@register_op("hash", not_differentiable=True, grad_free=True)
+def _hash(ctx, ins, attrs):
+    """reference: hash_op.cc (xxhash of int ids into num_hash buckets).
+    TPU redesign: a splittable integer mix (finalizer of splitmix64) —
+    deterministic, vectorized, same API (mod_by bucketing)."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1 << 31))
+
+    def mix(v, salt):
+        v = (v ^ (v >> 16)) * jnp.uint32(0x7feb352d)
+        v = (v ^ (v >> 15)) * jnp.uint32(0x846ca68b + salt)
+        return v ^ (v >> 16)
+
+    rows = x.reshape(x.shape[0], -1)
+    outs = []
+    for i in range(num_hash):
+        h = jnp.uint32(2166136261 + i)
+        for c in range(rows.shape[1]):
+            h = mix(h ^ rows[:, c], i)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {"Out": [jnp.stack(outs, axis=1)[:, :, None]]}
+
+
+@register_op("unique", not_differentiable=True, grad_free=True)
+def _unique(ctx, ins, attrs):
+    """reference: unique_op.cc. Fixed-size redesign: Out is X's size with
+    first-occurrence order packed first and the remainder padded with the
+    first element; Index maps X -> position in Out; Count gives the
+    number of distinct values."""
+    x = ins["X"][0].reshape(-1)
+    uniq, idx = jnp.unique(x, return_inverse=True, size=x.shape[0],
+                           fill_value=x[0] if x.shape[0] else 0)
+    return {"Out": [uniq],
+            "Index": [idx.astype(jnp.int32)],
+            "Count": [(jnp.unique(x, size=x.shape[0],
+                                  fill_value=x[0] if x.shape[0] else 0,
+                                  return_counts=True)[1] > 0
+                       ).sum().astype(jnp.int32)[None]]}
+
+
+@register_op("unique_with_counts", not_differentiable=True, grad_free=True)
+def _unique_with_counts(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    fill = x[0] if x.shape[0] else 0
+    uniq, idx, counts = jnp.unique(x, return_inverse=True,
+                                   return_counts=True, size=x.shape[0],
+                                   fill_value=fill)
+    return {"Out": [uniq], "Index": [idx.astype(jnp.int32)],
+            "Count": [counts.astype(jnp.int32)]}
+
+
+@register_op("edit_distance", not_differentiable=True, grad_free=True)
+def _edit_distance(ctx, ins, attrs):
+    """reference: edit_distance_op.h (Levenshtein). Dense redesign:
+    Hyps [n, Th] + HypsLength [n], Refs [n, Tr] + RefsLength [n]."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    hyp_len = ins["HypsLength"][0].reshape(-1).astype(jnp.int32) \
+        if "HypsLength" in ins else \
+        jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32)
+    ref_len = ins["RefsLength"][0].reshape(-1).astype(jnp.int32) \
+        if "RefsLength" in ins else \
+        jnp.full((ref.shape[0],), ref.shape[1], jnp.int32)
+    normalized = bool(attrs.get("normalized", False))
+    th, tr = hyp.shape[1], ref.shape[1]
+
+    def one(h, hl, r, rl):
+        # dp over rows of the (th+1) x (tr+1) matrix via scan
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def step(prev, i):
+            def col(carry, j):
+                left = carry          # dp[i][j-1]
+                up = prev[j]          # dp[i-1][j]
+                diag = prev[j - 1]    # dp[i-1][j-1]
+                cost = jnp.where(h[i - 1] == r[j - 1], 0.0, 1.0)
+                v = jnp.minimum(jnp.minimum(left + 1, up + 1), diag + cost)
+                v = jnp.where(j == 0, i * 1.0, v)
+                return v, v
+
+            _, row = jax.lax.scan(col, i * 1.0, jnp.arange(tr + 1))
+            # past-the-end hyp rows keep the previous row (len clamp)
+            row = jnp.where(i <= hl, row, prev)
+            return row, None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(1, th + 1))
+        # clamp ref dimension at rl
+        d = final[jnp.clip(rl, 0, tr)]
+        d = jnp.where(hl == 0, rl * 1.0, d)
+        d = jnp.where(rl == 0, hl * 1.0, d)
+        if normalized:
+            d = d / jnp.maximum(rl, 1)
+        return d
+
+    out = jax.vmap(one)(hyp, hyp_len, ref, ref_len)
+    return {"Out": [out[:, None]],
+            "SequenceNum": [jnp.asarray([hyp.shape[0]], jnp.int64)]}
+
+
+@register_op("coalesce_tensor", not_differentiable=True, grad_free=True)
+def _coalesce_tensor(ctx, ins, attrs):
+    """reference: coalesce_tensor_op.cc — fuse a var list into one flat
+    buffer (for fused allreduce/optimizers). XLA owns layout, so this is
+    a concat view + pass-through outputs."""
+    xs = ins["Input"]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    return {"Output": list(xs), "FusedOutput": [flat]}
+
+
+@register_op("delete_var", not_differentiable=True, grad_free=True)
+def _delete_var(ctx, ins, attrs):
+    """reference: controlflow/ — frees vars; XLA liveness subsumes it."""
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities (reference: merge_selected_rows_op.cc, ...)
+# ---------------------------------------------------------------------------
+
+@register_op("merge_selected_rows", not_differentiable=True, grad_free=True)
+def _merge_selected_rows(ctx, ins, attrs):
+    """Sum duplicate rows of a SelectedRows value (rows stay padded/fixed;
+    duplicates merge into the first occurrence, repeats zeroed)."""
+    from ..framework.selected_rows import SelectedRows
+    x = ins["X"][0]
+    if not isinstance(x, SelectedRows):
+        return {"Out": [x]}
+    rows = x.rows
+    uniq, inv = jnp.unique(rows, return_inverse=True, size=rows.shape[0],
+                           fill_value=-1)
+    summed = jnp.zeros_like(x.values).at[inv].add(x.values)
+    return {"Out": [SelectedRows(uniq, summed, x.height)]}
+
+
+@register_op("get_tensor_from_selected_rows", not_differentiable=True,
+             grad_free=True)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    from ..framework.selected_rows import SelectedRows
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        return {"Out": [x.values]}
+    return {"Out": [x]}
+
+
+@register_op("split_selected_rows", not_differentiable=True, grad_free=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """reference: split_selected_rows_op.cc — shard rows by height
+    sections (PS param split). Fixed-size: each shard keeps the full row
+    list with out-of-section rows marked -1 / zeroed."""
+    from ..framework.selected_rows import SelectedRows
+    x = ins["X"][0]
+    sections = [int(s) for s in attrs["height_sections"]]
+    outs = []
+    start = 0
+    for sec in sections:
+        if isinstance(x, SelectedRows):
+            in_sec = (x.rows >= start) & (x.rows < start + sec)
+            rows = jnp.where(in_sec, x.rows - start, -1)
+            vals = jnp.where(in_sec[:, None], x.values, 0.0)
+            outs.append(SelectedRows(rows, vals, sec))
+        else:
+            outs.append(x[start:start + sec])
+        start += sec
+    return {"Out": outs}
+
+
+@register_op("average_accumulates", not_differentiable=True,
+             is_optimizer_op=True)
+def _average_accumulates(ctx, ins, attrs):
+    """reference: average_accumulates_op.h — the ModelAverage op's
+    running parameter-sum accumulators."""
+    param = ins["param"][0]
+    sum1 = ins["in_sum_1"][0]
+    sum2 = ins["in_sum_2"][0]
+    sum3 = ins["in_sum_3"][0]
+    num_acc = ins["in_num_accumulates"][0]
+    old_num = ins["in_old_num_accumulates"][0]
+    num_upd = ins["in_num_updates"][0]
+    avg_window = attrs.get("average_window", 0.0)
+    max_avg = int(attrs.get("max_average_window", 10000))
+    min_avg = int(attrs.get("min_average_window", 10000))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum1 = sum1 + param
+    window = jnp.minimum(jnp.maximum(avg_window * num_upd, min_avg),
+                         max_avg).astype(num_acc.dtype)
+    roll = num_acc > window
+    sum2 = jnp.where(roll, sum2 + sum1, sum2)
+    sum3_new = jnp.where(old_num + num_acc > max_avg, sum2, sum3)
+    old_num2 = jnp.where(roll, num_acc, old_num)
+    sum1 = jnp.where(roll, jnp.zeros_like(sum1), sum1)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": [sum1], "out_sum_2": [sum2],
+            "out_sum_3": [sum3_new],
+            "out_num_accumulates": [num_acc],
+            "out_old_num_accumulates": [old_num2],
+            "out_num_updates": [num_upd]}
+
+
+@register_op("dgc_clip_by_norm", not_differentiable=True, grad_free=True)
+def _dgc_clip_by_norm(ctx, ins, attrs):
+    """reference: dgc_clip_by_norm_op.cc — clip_by_norm gated on the
+    current step vs the DGC rampup begin step."""
+    x = ins["X"][0]
+    step = ins["current_step"][0].reshape(())
+    rampup = attrs.get("rampup_begin_step", 0.0)
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt((x * x).sum())
+    clipped = x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return {"Out": [jnp.where(step < rampup, x, clipped)]}
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization trio (reference: quantize_op.cc, dequantize_op.cc,
+# requantize_op.cc — scale-based symmetric int8)
+# ---------------------------------------------------------------------------
+
+@register_op("quantize", not_differentiable=True, grad_free=True)
+def _quantize(ctx, ins, attrs):
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    x = ins["Input"][0]
+    q = jnp.clip(jnp.round(x * scale + shift), -128, 127)
+    return {"Output": [q.astype(jnp.int8)]}
+
+
+@register_op("dequantize", not_differentiable=True, grad_free=True)
+def _dequantize(ctx, ins, attrs):
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    x = ins["Input"][0].astype(jnp.float32)
+    return {"Output": [(x - shift) / scale]}
+
+
+@register_op("requantize", not_differentiable=True, grad_free=True)
+def _requantize(ctx, ins, attrs):
+    s_in = attrs.get("Scale_in", 1.0)
+    s_out = attrs.get("Scale_out", 1.0)
+    x = ins["Input"][0].astype(jnp.float32)
+    return {"Output": [jnp.clip(jnp.round(x * s_out / s_in),
+                                -128, 127).astype(jnp.int8)]}

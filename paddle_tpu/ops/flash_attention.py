@@ -492,13 +492,18 @@ def attention(q, k, v, bias=None, causal: bool = False,
         bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1)
     shapes_ok = (q.shape[-1] % 8 == 0 and q.shape[1] % 8 == 0
                  and k.shape[1] % 128 == 0)
+    # at short sequence the single-tile kernel cannot beat XLA's fused
+    # softmax (measured: s=128 BERT step 158.8ms flash vs 119.2ms einsum;
+    # crossover at s>=512 — BASELINE.md). Auto mode dispatches by shape,
+    # the way cuDNN picks algos; impl='flash' still forces the kernel.
+    long_enough = k.shape[1] >= 512
     if impl == "flash" and not bias_ok:
         raise ValueError(
             "flash attention requires a per-key bias of shape (b, sk) or "
             f"(b, 1, 1, sk); got {bias.shape}. Use impl='xla' for general "
             "biases.")
     if impl == "flash" or (flag_ok and on_tpu and bias_ok and shapes_ok
-                           and impl != "xla"):
+                           and long_enough and impl != "xla"):
         interpret = not on_tpu
         return flash_attention(q, k, v, bias, causal, float(sm_scale),
                                interpret)

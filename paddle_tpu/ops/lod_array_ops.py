@@ -1,0 +1,281 @@
+"""LoD / tensor-array family + in-graph decoding ops.
+
+Reference: paddle/fluid/operators/controlflow/ (lod_tensor_to_array,
+array_to_lod_tensor, split/merge_lod_tensor, shrink_rnn_memory...),
+lod_rank_table_op.cc, beam_search_op.cc, ctc_align_op.cc.
+
+LoD redesign recap (lod_tensor.py): ragged batches are dense padded
+tensors + a per-row lengths vector. A TENSOR ARRAY value is a python
+tuple of arrays in the trace environment (XLA sees it as its unstacked
+elements); a RANK TABLE value is an (indices, lengths) pair sorted by
+length descending, exactly the information the reference's LoDRankTable
+holds.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("lod_reset", no_grad_inputs={"Y"})
+def _lod_reset(ctx, ins, attrs):
+    """reference: lod_reset_op.cc — re-label the sequence segmentation.
+    Values are unchanged; the new lengths ride alongside (Y or attr)."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("lod_rank_table", not_differentiable=True, grad_free=True)
+def _lod_rank_table(ctx, ins, attrs):
+    """X + XLength [n] -> rank table (indices sorted by length desc,
+    stable), stored as a (indices, sorted_lengths) tuple."""
+    lengths = ins["XLength"][0].reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(-lengths, stable=True)
+    return {"Out": [(order.astype(jnp.int32), lengths[order])]}
+
+
+@register_op("max_sequence_len", not_differentiable=True, grad_free=True)
+def _max_sequence_len(ctx, ins, attrs):
+    table = ins["RankTable"][0]
+    return {"Out": [table[1][0].astype(jnp.int64)[None]]}
+
+
+@register_op("lod_tensor_to_array", not_differentiable=True,
+             grad_free=True)
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """X [b, T, ...] + RankTable -> array of T per-step slices in rank
+    order (the DynamicRNN input layout): step t holds rows whose length
+    > t, here fixed-size [b, ...] (frozen rows padded)."""
+    x = ins["X"][0]
+    order = ins["RankTable"][0][0]
+    xr = x[order]                           # rank-sorted rows
+    steps = tuple(xr[:, t] for t in range(x.shape[1]))
+    return {"Out": [steps]}
+
+
+@register_op("array_to_lod_tensor", not_differentiable=True,
+             grad_free=True)
+def _array_to_lod_tensor(ctx, ins, attrs):
+    """Inverse of lod_tensor_to_array: stack steps, undo rank order."""
+    steps = ins["X"][0]
+    order = ins["RankTable"][0][0]
+    stacked = jnp.stack(steps, axis=1)      # [b, T, ...]
+    inv = jnp.argsort(order)
+    return {"Out": [stacked[inv]]}
+
+
+@register_op("lod_array_length", not_differentiable=True, grad_free=True)
+def _lod_array_length(ctx, ins, attrs):
+    arr = ins["X"][0]
+    return {"Out": [jnp.asarray([len(arr)], jnp.int64)]}
+
+
+@register_op("split_lod_tensor", no_grad_inputs={"Mask"})
+def _split_lod_tensor(ctx, ins, attrs):
+    """reference: controlflow/split_lod_tensor_op.cc — route rows by a
+    bool mask. Fixed-size: both outputs keep the full shape with
+    non-selected rows zeroed (the IfElse scatter/gather redesign)."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"OutTrue": [jnp.where(m, x, jnp.zeros_like(x))],
+            "OutFalse": [jnp.where(m, jnp.zeros_like(x), x)]}
+
+
+@register_op("merge_lod_tensor", no_grad_inputs={"Mask"})
+def _merge_lod_tensor(ctx, ins, attrs):
+    """Row-wise inverse of split_lod_tensor."""
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    t, f = ins["InTrue"][0], ins["InFalse"][0]
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": [jnp.where(m, t, f)]}
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    arr = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    if attrs.get("use_stack", False):
+        out = jnp.stack(arr, axis=axis)
+    else:
+        out = jnp.concatenate(arr, axis=axis)
+    return {"Out": [out],
+            "OutIndex": [jnp.asarray([a.shape[axis] for a in arr],
+                                     jnp.int32)]}
+
+
+@register_op("reorder_lod_tensor_by_rank", no_grad_inputs={"RankTable"})
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    x = ins["X"][0]
+    order = ins["RankTable"][0][0]
+    return {"Out": [x[order]]}
+
+
+@register_op("shrink_rnn_memory", no_grad_inputs={"RankTable", "I"})
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """reference: controlflow/shrink_rnn_memory_op.cc — at step I, only
+    sequences with length > I stay active. Fixed-size: inactive rows are
+    zeroed instead of dropped (batch dim must stay static for XLA)."""
+    x = ins["X"][0]
+    step = ins["I"][0].reshape(()).astype(jnp.int32)
+    lengths = ins["RankTable"][0][1]            # rank-sorted lengths
+    active = (lengths > step).reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": [jnp.where(active, x, jnp.zeros_like(x))]}
+
+
+@register_op("rnn_memory_helper")
+def _rnn_memory_helper(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+# ---------------------------------------------------------------------------
+# in-graph beam search (reference: beam_search_op.cc, the on-device
+# variant of layers/decode.py's host loop)
+# ---------------------------------------------------------------------------
+
+@register_op("beam_search", not_differentiable=True, grad_free=True)
+def _beam_search(ctx, ins, attrs):
+    """One beam-search step. Dense redesign of the LoD formulation:
+    pre_ids [b, bw], pre_scores [b, bw], scores [b, bw, V] (log-probs).
+    Outputs selected_ids/selected_scores [b, bw] + parent_idx [b, bw].
+    Finished beams (pre_id == end_id) keep their score and propagate."""
+    pre_ids = ins["pre_ids"][0].astype(jnp.int32)
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    end_id = int(attrs.get("end_id", 0))
+    b, bw, v = scores.shape
+    beam_size = int(attrs.get("beam_size", bw))
+
+    finished = pre_ids == end_id
+    # finished beams: only the end_id continuation, carrying the score
+    cont = pre_scores[:, :, None] + scores
+    neg = jnp.full_like(cont, -1e20)
+    only_end = neg.at[:, :, end_id].set(pre_scores)
+    total = jnp.where(finished[:, :, None], only_end, cont)
+
+    flat = total.reshape(b, bw * v)
+    top_s, top_i = jax.lax.top_k(flat, beam_size)
+    parent = (top_i // v).astype(jnp.int32)
+    ids = (top_i % v).astype(jnp.int32)
+    return {"selected_ids": [ids.astype(jnp.int64)],
+            "selected_scores": [top_s],
+            "parent_idx": [parent]}
+
+
+@register_op("beam_search_decode", not_differentiable=True, grad_free=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrace stacked per-step (ids, parents) into full sequences
+    (reference: beam_search_decode_op.cc). Ids/ParentIdx [T, b, bw] ->
+    SentenceIds [T, b, bw]. Delegates to the gather_tree lowering —
+    gather the token at the CURRENT beam, then hop to its parent."""
+    from ..framework.registry import get_op_def
+    ids = ins["Ids"][0].astype(jnp.int64)
+    parents = ins["ParentIdx"][0].astype(jnp.int64)
+    scores = ins.get("Scores", [None])[0]
+    out = get_op_def("gather_tree").lower(
+        ctx, {"Ids": [ids], "Parents": [parents]}, {})["Out"][0]
+    res = {"SentenceIds": [out.astype(jnp.int64)]}
+    if scores is not None:
+        res["SentenceScores"] = [scores]
+    return res
+
+
+@register_op("ctc_align", not_differentiable=True, grad_free=True)
+def _ctc_align(ctx, ins, attrs):
+    """reference: ctc_align_op.h — collapse repeats then drop blanks.
+    Dense redesign: Input [b, T] + InputLength [b] -> Output [b, T]
+    padded with `padding_value` + OutputLength [b]."""
+    x = ins["Input"][0].astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    pad = int(attrs.get("padding_value", 0))
+    b, t = x.shape
+    lengths = ins["InputLength"][0].reshape(-1).astype(jnp.int32) \
+        if "InputLength" in ins else jnp.full((b,), t, jnp.int32)
+
+    in_range = jnp.arange(t)[None, :] < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32),
+                            x[:, :-1]], axis=1)
+    keep = (x != blank) & in_range
+    if merge:
+        keep &= (x != prev)
+    # stable-compact kept tokens to the front
+    pos = jnp.where(keep, jnp.arange(t)[None, :], t)
+    order = jnp.argsort(pos, axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    n_keep = keep.sum(axis=1)
+    out = jnp.where(jnp.arange(t)[None, :] < n_keep[:, None],
+                    compacted, pad)
+    return {"Output": [out.astype(jnp.int64)],
+            "OutputLength": [n_keep.astype(jnp.int32)[:, None]]}
+
+
+@register_op("chunk_eval", not_differentiable=True, grad_free=True)
+def _chunk_eval(ctx, ins, attrs):
+    """reference: chunk_eval_op.h — chunking precision/recall/F1.
+    Dense redesign: Inference/Label [b, T] + SeqLength [b]; IOB scheme:
+    tag = type * num_tag + {0: B, 1: I}; excluded_chunk_types in attrs."""
+    inf = ins["Inference"][0].reshape(
+        ins["Inference"][0].shape[0], -1).astype(jnp.int32)
+    lab = ins["Label"][0].reshape(inf.shape).astype(jnp.int32)
+    b, t = inf.shape
+    lengths = ins["SeqLength"][0].reshape(-1).astype(jnp.int32) \
+        if "SeqLength" in ins else jnp.full((b,), t, jnp.int32)
+    num_types = int(attrs.get("num_chunk_types", 1))
+    scheme = attrs.get("chunk_scheme", "IOB")
+    if scheme != "IOB":
+        raise NotImplementedError("chunk_eval supports the IOB scheme")
+    other = num_types * 2  # the O tag
+
+    def starts(seq, valid):
+        ty = seq // 2
+        is_b = (seq % 2 == 0) & (seq < other)
+        prev = jnp.concatenate([jnp.full((b, 1), other, jnp.int32),
+                                seq[:, :-1]], axis=1)
+        prev_ty = prev // 2
+        prev_in_chunk = prev < other
+        is_i = (seq % 2 == 1) & (seq < other)
+        # chunk starts at B, or at I following O / different type
+        start = is_b | (is_i & (~prev_in_chunk | (prev_ty != ty)))
+        return start & valid, ty
+
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    inf_in = (inf < other) & valid
+    lab_in = (lab < other) & valid
+    inf_st, inf_ty = starts(inf, valid)
+    lab_st, lab_ty = starts(lab, valid)
+
+    # a chunk matches if start positions align, types equal, and the
+    # full extent agrees; approximate extent check: every position in
+    # the chunk has identical (in_chunk, type) in both sequences
+    same = (inf_in == lab_in) & ((inf_ty == lab_ty) | ~lab_in)
+    # suffix-AND until chunk end: scan right-to-left within chunks
+    def chunk_ok(st, in_mask):
+        # position belongs to same chunk until next start/exit
+        ok = same & in_mask
+        # cumulative check: a chunk is correct iff min over its span
+        # compute via segmented min using starts as boundaries
+        seg_id = jnp.cumsum(st.astype(jnp.int32), axis=1)
+        # for each segment, all ok?
+        max_seg = t + 1
+        def per_row(ok_r, seg_r, in_r):
+            acc = jnp.ones((max_seg,), bool).at[0].set(True)
+            acc = acc.at[seg_r].min(ok_r | ~in_r)
+            return acc[seg_r] & in_r
+        return jax.vmap(per_row)(ok, seg_id, in_mask)
+
+    lab_chunk_ok = chunk_ok(lab_st, lab_in)
+    correct = (lab_st & jnp.take_along_axis(
+        lab_chunk_ok, jnp.arange(t)[None, :], axis=1) &
+        inf_st & (inf_ty == lab_ty)).sum()
+    num_inf = inf_st.sum()
+    num_lab = lab_st.sum()
+    p = correct / jnp.maximum(num_inf, 1)
+    r = correct / jnp.maximum(num_lab, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-10)
+    i64 = lambda v: v.astype(jnp.int64)[None]
+    f32 = lambda v: v.astype(jnp.float32)[None]
+    return {"Precision": [f32(p)], "Recall": [f32(r)], "F1-Score": [f32(f1)],
+            "NumInferChunks": [i64(num_inf)],
+            "NumLabelChunks": [i64(num_lab)],
+            "NumCorrectChunks": [i64(correct)]}

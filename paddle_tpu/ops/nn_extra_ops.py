@@ -1,0 +1,668 @@
+"""NN long-tail ops: spatial transformers, RoI variants, CTR/rank ops,
+LSTM variants (reference: paddle/fluid/operators/*_op.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op, get_op_def
+
+# ---------------------------------------------------------------------------
+# channel/spatial transforms
+# ---------------------------------------------------------------------------
+
+
+@register_op("affine_channel", no_grad_inputs={"Scale", "Bias"})
+def _affine_channel(ctx, ins, attrs):
+    """reference: affine_channel_op.cc — x * scale[c] + bias[c]."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(-1)
+    bias = ins["Bias"][0].reshape(-1)
+    layout = attrs.get("data_layout", "NCHW")
+    shape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register_op("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    """reference: affine_grid_op.cc — theta [n,2,3] -> sampling grid
+    [n,h,w,2] in normalized [-1,1] coords (align_corners semantics)."""
+    theta = ins["Theta"][0]
+    hw = attrs.get("output_shape")
+    if not hw:
+        # the reference also accepts a runtime OutputShape tensor; XLA
+        # needs static shapes, so the attr form is required here
+        raise ValueError("affine_grid needs the static output_shape "
+                         "attr ([n, c, h, w])")
+    n, h, w = theta.shape[0], int(hw[2]), int(hw[3])
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                 # [h, w]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)     # [h, w, 3]
+    grid = jnp.einsum("hwk,nak->nhwa", base, theta)
+    return {"Output": [grid]}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    """reference: grid_sampler_op.cc — bilinear sample X [n,c,h,w] at
+    Grid [n,gh,gw,2] (normalized [-1,1], align_corners)."""
+    x, grid = ins["X"][0], ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0     # [n, gh, gw]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    lx = gx - x0
+    ly = gy - y0
+
+    def sample(img, yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        ok = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
+              & (xx <= w - 1)).astype(img.dtype)
+        return img[:, yi, xi] * ok[None]
+
+    def one(img, y0_, x0_, ly_, lx_):
+        v00 = sample(img, y0_, x0_)
+        v01 = sample(img, y0_, x0_ + 1)
+        v10 = sample(img, y0_ + 1, x0_)
+        v11 = sample(img, y0_ + 1, x0_ + 1)
+        return (v00 * (1 - ly_) * (1 - lx_) + v01 * (1 - ly_) * lx_
+                + v10 * ly_ * (1 - lx_) + v11 * ly_ * lx_)
+
+    out = jax.vmap(one)(x, y0, x0, ly, lx)
+    return {"Output": [out]}
+
+
+@register_op("random_crop", not_differentiable=True, grad_free=True,
+             stateful=True)
+def _random_crop(ctx, ins, attrs):
+    """reference: random_crop_op.h — crop trailing dims to `shape` at a
+    random offset."""
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    lead = x.ndim - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        key, sk = jax.random.split(key)
+        hi = x.shape[lead + i] - s
+        starts.append(jax.random.randint(sk, (), 0, hi + 1))
+    start_idx = [jnp.zeros((), jnp.int32)] * lead + \
+        [s.astype(jnp.int32) for s in starts]
+    out = jax.lax.dynamic_slice(x, start_idx,
+                                list(x.shape[:lead]) + shape)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling variants
+# ---------------------------------------------------------------------------
+
+def _maxpool_with_index(x, ksize, strides, paddings):
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=-jnp.inf)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    # window gather: [n, c, oh, ow, kh*kw]
+    iy = (jnp.arange(oh) * sh)[:, None] + jnp.arange(kh)[None, :]
+    ix = (jnp.arange(ow) * sw)[:, None] + jnp.arange(kw)[None, :]
+    win = xp[:, :, iy[:, None, :, None], ix[None, :, None, :]]
+    win = win.reshape(n, c, oh, ow, kh * kw)
+    arg = jnp.argmax(win, axis=-1)
+    val = jnp.max(win, axis=-1)
+    # flat index into the UNPADDED input (reference mask semantics)
+    ky = arg // kw
+    kx = arg % kw
+    gy = (jnp.arange(oh) * sh)[None, None, :, None] + ky - ph
+    gx = (jnp.arange(ow) * sw)[None, None, None, :] + kx - pw
+    flat = jnp.clip(gy, 0, h - 1) * w + jnp.clip(gx, 0, w - 1)
+    return val, flat.astype(jnp.int32)
+
+
+@register_op("max_pool2d_with_index", non_diff_outputs={"Mask"})
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """reference: pool_with_index_op.cc (registers max_pool2d_with_index)."""
+    x = ins["X"][0]
+    val, mask = _maxpool_with_index(
+        x, [int(k) for k in attrs["ksize"]],
+        [int(s) for s in attrs.get("strides", [1, 1])],
+        [int(p) for p in attrs.get("paddings", [0, 0])])
+    return {"Out": [val], "Mask": [mask]}
+
+
+@register_op("unpool", no_grad_inputs={"Indices"})
+def _unpool(ctx, ins, attrs):
+    """reference: unpool_op.cc — max-unpooling: scatter X back to the
+    positions recorded in Indices."""
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    oh, ow = [int(s) for s in attrs["unpooled_size"]] \
+        if "unpooled_size" in attrs else (h * 2, w * 2)
+    flat_idx = idx.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].add(v)))(out, flat_idx, vals)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """reference: spp_op.cc — spatial pyramid pooling: levels 0..L-1 pool
+    into 2^l x 2^l bins, concat flattened."""
+    x = ins["X"][0]
+    levels = int(attrs.get("pyramid_height", 2))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        pad_val = -jnp.inf if ptype == "max" else 0.0
+        xp = jnp.pad(x, ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                         (pw, kw * bins - w - pw)),
+                     constant_values=pad_val)
+        win = xp.reshape(n, c, bins, kh, bins, kw)
+        if ptype == "max":
+            v = win.max(axis=(3, 5))
+        else:
+            v = win.mean(axis=(3, 5))
+        outs.append(v.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("psroi_pool", no_grad_inputs={"ROIs", "RoisNum"})
+def _psroi_pool(ctx, ins, attrs):
+    """reference: psroi_pool_op.h — position-sensitive RoI average pool:
+    X [n, C*ph*pw, h, w], each output bin (i,j) pools its OWN channel
+    group. RoisNum [n] maps each RoI to its image (as in roi_align);
+    without it all RoIs pool from image 0."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    rois_num = ins.get("RoisNum", [None])[0]
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    oc = int(attrs["output_channels"])
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+    if rois_num is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(n, dtype=jnp.int32),
+                               rois_num.astype(jnp.int32),
+                               total_repeat_length=rois.shape[0])
+
+    def one_roi(roi, bi):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1) * scale
+        y2 = (jnp.round(roi[3]) + 1) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+
+        def one_bin(i, j, ch):
+            hstart = jnp.floor(y1 + i * bh)
+            hend = jnp.ceil(y1 + (i + 1) * bh)
+            wstart = jnp.floor(x1 + j * bw)
+            wend = jnp.ceil(x1 + (j + 1) * bw)
+            in_h = (ys >= jnp.clip(hstart, 0, h)) & \
+                (ys < jnp.clip(hend, 0, h))
+            in_w = (xs >= jnp.clip(wstart, 0, w)) & \
+                (xs < jnp.clip(wend, 0, w))
+            m = (in_h[:, None] & in_w[None, :]).astype(x.dtype)
+            cnt = jnp.maximum(m.sum(), 1.0)
+            plane = x[bi, (ch * ph + i) * pw + j]
+            return (plane * m).sum() / cnt
+
+        ii, jj, cc = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                                  jnp.arange(oc), indexing="ij")
+        vals = jax.vmap(one_bin)(ii.reshape(-1), jj.reshape(-1),
+                                 cc.reshape(-1))
+        return vals.reshape(ph, pw, oc).transpose(2, 0, 1)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# CTR / ranking / distillation
+# ---------------------------------------------------------------------------
+
+@register_op("cvm")
+def _cvm(ctx, ins, attrs):
+    """reference: cvm_op.h — click-through feature transform. X [n, d]
+    whose first two columns are (show, click)."""
+    x = ins["X"][0]
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if use_cvm:
+        c0 = jnp.log(x[:, 0] + 1)
+        c1 = jnp.log(x[:, 1] + 1) - c0
+        return {"Y": [jnp.concatenate([c0[:, None], c1[:, None],
+                                       x[:, 2:]], axis=1)]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register_op("data_norm", non_diff_outputs={"Means", "Scales"},
+             no_grad_inputs={"BatchSize", "BatchSum", "BatchSquareSum"})
+def _data_norm(ctx, ins, attrs):
+    """reference: data_norm_op.cc — normalize by externally-accumulated
+    batch statistics (CTR models)."""
+    x = ins["X"][0]
+    bs = ins["BatchSize"][0].reshape(-1)
+    bsum = ins["BatchSum"][0].reshape(-1)
+    bsq = ins["BatchSquareSum"][0].reshape(-1)
+    means = bsum / bs
+    scales = jnp.sqrt(bs / bsq)
+    return {"Y": [(x - means[None, :]) * scales[None, :]],
+            "Means": [means], "Scales": [scales]}
+
+
+@register_op("fsp")
+def _fsp(ctx, ins, attrs):
+    """reference: fsp_op.cc — FSP (flow of solution procedure) matrix for
+    distillation: Out[n, c1, c2] = mean_hw X[n,c1,hw] * Y[n,c2,hw]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    xf = x.reshape(n, c1, h * w)
+    yf = y.reshape(n, c2, h * w)
+    return {"Out": [jnp.einsum("nch,ndh->ncd", xf, yf) / (h * w)]}
+
+
+@register_op("similarity_focus", not_differentiable=True, grad_free=True)
+def _similarity_focus(ctx, ins, attrs):
+    """reference: similarity_focus_op.h — build a focus mask: for the
+    chosen axis/index slices, mark the (row, col) of per-channel maxima."""
+    x = ins["X"][0]                 # [n, c, a, b]
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    n, c, a, b = x.shape
+    if axis != 1:
+        raise NotImplementedError("similarity_focus supports axis=1")
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        plane = x[:, idx]          # [n, a, b]
+        row_max = plane.max(axis=2, keepdims=True)
+        col_max = plane.max(axis=1, keepdims=True)
+        m = ((plane == row_max) | (plane == col_max)).astype(x.dtype)
+        mask = jnp.maximum(mask, m[:, None, :, :])
+    return {"Out": [mask]}
+
+
+@register_op("positive_negative_pair", not_differentiable=True,
+             grad_free=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """reference: positive_negative_pair_op.h — ranking metric: within
+    each query, count score-ordered pairs that agree/disagree with label
+    order."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1).astype(bool)
+    valid = same_q & upper & (label[:, None] != label[None, :])
+    s_diff = score[:, None] - score[None, :]
+    l_diff = label[:, None] - label[None, :]
+    pos = (valid & (s_diff * l_diff > 0)).sum()
+    neg = (valid & (s_diff * l_diff < 0)).sum()
+    neu = (valid & (s_diff == 0)).sum()
+    pos = pos + 0.5 * neu
+    neg = neg + 0.5 * neu
+    return {"PositivePair": [pos.astype(jnp.float32)[None]],
+            "NegativePair": [neg.astype(jnp.float32)[None]],
+            "NeutralPair": [neu.astype(jnp.float32)[None]]}
+
+
+@register_op("filter_by_instag", not_differentiable=True, grad_free=True)
+def _filter_by_instag(ctx, ins, attrs):
+    """reference: filter_by_instag_op.h. Fixed-size redesign: rows whose
+    tag set intersects the filter keep their values, others are zeroed;
+    LossWeight marks kept rows."""
+    x = ins["Ins"][0]                       # [n, d]
+    tags = ins["Ins_tag"][0].reshape(x.shape[0], -1)
+    filt = ins["Filter_tag"][0].reshape(-1)
+    keep = (tags[:, :, None] == filt[None, None, :]).any(axis=(1, 2))
+    out = jnp.where(keep[:, None], x, 0.0)
+    return {"Out": [out],
+            "LossWeight": [keep.astype(jnp.float32)[:, None]],
+            "IndexMap": [jnp.stack([jnp.arange(x.shape[0])] * 2,
+                                   axis=1).astype(jnp.int64)]}
+
+
+@register_op("match_matrix_tensor")
+def _match_matrix_tensor(ctx, ins, attrs):
+    """reference: match_matrix_tensor_op.cc — text matching: for each
+    channel t, Out = X W_t Y^T. Dense redesign: X [n, lx, d],
+    Y [n, ly, d], W [d, t, d] -> Out [n, t, lx, ly]."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]
+    tmp = jnp.einsum("nld,dte->nlte", x, w)
+    out = jnp.einsum("nlte,nme->ntlm", tmp, y)
+    return {"Out": [out], "Tmp": [tmp]}
+
+
+# ---------------------------------------------------------------------------
+# losses with state / samplers
+# ---------------------------------------------------------------------------
+
+@register_op("center_loss", no_grad_inputs={"Label", "Centers",
+                                            "CenterUpdateRate"},
+             non_diff_outputs={"SampleCenterDiff", "CentersOut"})
+def _center_loss(ctx, ins, attrs):
+    """reference: center_loss_op.h — intra-class compactness loss with
+    running class centers."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    centers = ins["Centers"][0]
+    alpha = ins["CenterUpdateRate"][0].reshape(())
+    need_update = bool(attrs.get("need_update", True))
+    diff = x - centers[label]
+    loss = 0.5 * (diff * diff).sum(axis=1, keepdims=True)
+    new_centers = centers
+    if need_update:
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        upd = upd / (1.0 + cnt)[:, None]
+        new_centers = centers + alpha * upd
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [new_centers]}
+
+
+@register_op("sample_logits", stateful=True,
+             no_grad_inputs={"Labels", "CustomizedSamples",
+                             "CustomizedProbabilities"},
+             non_diff_outputs={"Samples", "Probabilities",
+                               "SampledLabels", "LogitsDim", "LabelsDim"})
+def _sample_logits(ctx, ins, attrs):
+    """reference: sample_logits_op.h — sampled-softmax candidate
+    sampling: keep the true classes + num_samples log-uniform negatives,
+    with log-Q correction (remove_accidental_hits)."""
+    logits = ins["Logits"][0]               # [n, K]
+    labels = ins["Labels"][0].astype(jnp.int32)  # [n, T]
+    n, k = logits.shape
+    t = labels.shape[1]
+    s = int(attrs.get("num_samples", 16))
+    use_custom = bool(attrs.get("use_customized_samples", False))
+    if use_custom:
+        samples = ins["CustomizedSamples"][0].astype(jnp.int32)
+        probs = ins["CustomizedProbabilities"][0]
+    else:
+        # log-uniform (Zipf) negative sampler, shared across the batch
+        u = jax.random.uniform(ctx.rng(), (n, s))
+        neg = (jnp.exp(u * jnp.log(k + 1.0)) - 1.0).astype(jnp.int32)
+        neg = jnp.clip(neg, 0, k - 1)
+        samples = jnp.concatenate([labels, neg], axis=1)  # [n, T+S]
+        q = (jnp.log((samples + 2.0) / (samples + 1.0))
+             / jnp.log(k + 1.0))
+        probs = q
+    gathered = jnp.take_along_axis(logits, samples, axis=1)
+    # subtract log-Q (sampled softmax correction)
+    sampled_logits = gathered - jnp.log(probs + 1e-20)
+    if bool(attrs.get("remove_accidental_hits", True)):
+        # negatives equal to a true label get -inf-ish logits
+        neg_part = samples[:, t:]
+        hit = (neg_part[:, :, None] == labels[:, None, :]).any(-1)
+        penalty = jnp.where(hit, -1e20, 0.0)
+        sampled_logits = sampled_logits.at[:, t:].add(penalty)
+    sampled_labels = jnp.tile(jnp.arange(t, dtype=jnp.int64)[None, :],
+                              (n, 1))
+    return {"Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [probs.astype(logits.dtype)],
+            "SampledLogits": [sampled_logits],
+            "SampledLabels": [sampled_labels]}
+
+
+def _sample_logits_grad_lower(ctx, ins, attrs):
+    """d(SampledLogits)/d(Logits) is a gather, so the grad is the
+    scatter-add of the cotangent back through the sampled indices (the
+    -log(Q) shift and the accidental-hit penalty are additive constants)."""
+    logits = ins["Logits"][0]
+    samples = ins["__out__Samples"][0].astype(jnp.int32)
+    g = ins["SampledLogits@GRAD"][0]
+    dx = jnp.zeros_like(logits)
+    dx = jax.vmap(lambda d, s, gg: d.at[s].add(gg))(dx, samples, g)
+    return {"Logits@GRAD": [dx]}
+
+
+get_op_def("sample_logits").grad_lower = _sample_logits_grad_lower
+
+
+# ---------------------------------------------------------------------------
+# LSTM variants (reference: lstm_unit_op.h, lstmp_op.h, lstm_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """reference: lstm_unit_op.h — X [b, 4D] (i,f,o,g gates), C_prev
+    [b, D] -> C, H."""
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    d = c_prev.shape[1]
+    fb = attrs.get("forget_bias", 0.0)
+    i = jax.nn.sigmoid(x[:, 0 * d:1 * d])
+    f = jax.nn.sigmoid(x[:, 1 * d:2 * d] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * d:3 * d])
+    g = jnp.tanh(x[:, 3 * d:4 * d])
+    c = f * c_prev + i * g
+    return {"C": [c], "H": [o * jnp.tanh(c)]}
+
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+        "relu": jax.nn.relu, "identity": lambda v: v}
+
+
+@register_op("lstmp", no_grad_inputs={"C0", "H0"},
+             non_diff_outputs={"BatchGate", "BatchCellPreAct",
+                               "BatchHidden", "Cell"})
+def _lstmp(ctx, ins, attrs):
+    """reference: lstmp_op.h — LSTM with a recurrent projection layer.
+    Dense redesign: Input [b, T, 4D] (pre-computed x·W contributions),
+    Weight [P, 4D] recurrent weights on the projected state, ProjWeight
+    [D, P]. Projection h_proj = act(h · ProjWeight) feeds back."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    pw = ins["ProjWeight"][0]
+    bias = ins.get("Bias", [None])[0]
+    d = w.shape[1] // 4
+    p = pw.shape[1]
+    b, T = x.shape[0], x.shape[1]
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACT[attrs.get("proj_activation", "tanh")]
+    c0 = ins.get("C0", [None])[0]
+    if c0 is None:
+        c0 = jnp.zeros((b, d), x.dtype)
+    h0 = ins.get("H0", [None])[0]
+    if h0 is None:
+        h0 = jnp.zeros((b, p), x.dtype)
+
+    xs = x.transpose(1, 0, 2)           # [T, b, 4D]
+
+    def step(carry, xt):
+        hp, c = carry
+        gates = xt + hp @ w
+        if bias is not None:
+            gates = gates + bias.reshape(1, -1)[:, :4 * d]
+        i = gate_act(gates[:, 0 * d:1 * d])
+        f = gate_act(gates[:, 1 * d:2 * d])
+        o = gate_act(gates[:, 2 * d:3 * d])
+        g = cand_act(gates[:, 3 * d:4 * d])
+        c_new = f * c + i * g
+        h = o * cell_act(c_new)
+        hp_new = proj_act(h @ pw)
+        return (hp_new, c_new), (hp_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), xs)
+    return {"Projection": [hs.transpose(1, 0, 2)],
+            "Cell": [cs.transpose(1, 0, 2)]}
+
+
+def _alias_op(new_name, existing, **kw):
+    """Register `new_name` with the lowering of an existing op (the
+    reference registers e.g. 'lstm' for what our themed module calls
+    dynamic_lstm; both names are real fluid op types)."""
+    base = get_op_def(existing)
+    register_op(new_name, no_grad_inputs=base.no_grad_inputs,
+                non_diff_outputs=base.non_diff_outputs,
+                stateful=base.stateful,
+                not_differentiable=base.not_differentiable,
+                grad_free=base.grad_free, **kw)(base.lower)
+
+
+_alias_op("lstm", "dynamic_lstm")
+_alias_op("gru", "dynamic_gru")
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """reference: row_conv_op.cc — lookahead (future-context) row
+    convolution. Dense redesign: X [b, T, d], Filter [future_context, d];
+    Out[b, t] = sum_w Filter[w] * X[b, t+w] (zero past the end)."""
+    x, filt = ins["X"][0], ins["Filter"][0]
+    fc_len = filt.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, fc_len - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for wi in range(fc_len):
+        out = out + pad[:, wi:wi + x.shape[1]] * filt[wi][None, None, :]
+    return {"Out": [out]}
+
+
+@register_op("fc")
+def _fc(ctx, ins, attrs):
+    """reference: fc_op.cc — fused matmul+bias (the fc fuse pass target).
+    Input [n, ...], W [d, size]."""
+    x, w = ins["Input"][0], ins["W"][0]
+    rank = int(attrs.get("in_num_col_dims", 1))
+    lead = 1
+    for d in x.shape[:rank]:
+        lead *= d
+    out = x.reshape(lead, -1) @ w
+    if "Bias" in ins:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out.reshape(tuple(x.shape[:rank]) + (w.shape[1],))]}
+
+
+@register_op("sync_batch_norm",
+             no_grad_inputs={"Mean", "Variance"},
+             non_diff_outputs={"MeanOut", "VarianceOut", "SavedMean",
+                               "SavedVariance"})
+def _sync_batch_norm(ctx, ins, attrs):
+    """reference: sync_batch_norm_op.cu — batch norm whose batch
+    statistics are reduced ACROSS data-parallel replicas (NCCL allreduce
+    there; lax.pmean over the mesh's data axes here). Outside an SPMD
+    region it degrades to plain batch_norm — under GSPMD the mean/var
+    reductions are global anyway, which IS sync-BN semantics."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(-1)
+    bias = ins["Bias"][0].reshape(-1)
+    mean_in = ins["Mean"][0].reshape(-1)
+    var_in = ins["Variance"][0].reshape(-1)
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    axes = (0, 2, 3) if (layout == "NCHW" and x.ndim == 4) else \
+        tuple(i for i in range(x.ndim - 1)) if layout != "NCHW" else (0,)
+    shape = (1, -1) + (1,) * (x.ndim - 2) if layout == "NCHW" \
+        else (1,) * (x.ndim - 1) + (-1,)
+
+    if is_test:
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axes)
+        var = ((xf - mean.reshape(shape)) ** 2).mean(axes)
+        # cross-replica reduction when running under explicit SPMD
+        for ax in ctx.spmd_axes:
+            if ax in ("dp", "data"):
+                mean = jax.lax.pmean(mean, ax)
+                var = jax.lax.pmean(var, ax)
+        mean_out = momentum * mean_in + (1 - momentum) * mean
+        var_out = momentum * var_in + (1 - momentum) * var
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean.reshape(shape).astype(x.dtype)) * \
+        (inv * scale).reshape(shape).astype(x.dtype) + \
+        bias.reshape(shape).astype(x.dtype)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [mean], "SavedVariance": [inv]}
+
+
+@register_op("deformable_conv", no_grad_inputs={"Mask"})
+def _deformable_conv(ctx, ins, attrs):
+    """reference: deformable_conv_op.cc (v2: with modulation Mask).
+    X [n, c, h, w], Offset [n, 2*dg*kh*kw, oh, ow], Mask
+    [n, dg*kh*kw, oh, ow], Filter [oc, c, kh, kw]. Bilinear-sample the
+    input at offset kernel taps, then contract with the filter —
+    the im2col+GEMM structure XLA maps onto the MXU."""
+    x = ins["Input"][0]
+    offset = ins["Offset"][0]
+    mask = ins.get("Mask", [None])[0]
+    filt = ins["Filter"][0]
+    stride = [int(s) for s in attrs.get("strides", [1, 1])]
+    padding = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dilation = [int(d) for d in attrs.get("dilations", [1, 1])]
+    dg = int(attrs.get("deformable_groups", 1))
+    n, c, h, w = x.shape
+    oc, _, kh, kw = filt.shape
+    oh = (h + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+    ow = (w + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+    if dg != 1:
+        raise NotImplementedError("deformable_conv: deformable_groups=1")
+
+    base_y = (jnp.arange(oh) * stride[0] - padding[0])
+    base_x = (jnp.arange(ow) * stride[1] - padding[1])
+
+    def sample(img, yy, xx):
+        # img [c, h, w]; yy/xx [oh, ow] float; zero outside
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        ly = yy - y0
+        lx = xx - x0
+
+        def tap(yi, xi):
+            ok = ((yi >= 0) & (yi < h) & (xi >= 0)
+                  & (xi < w)).astype(img.dtype)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            return img[:, yc, xc] * ok[None]
+
+        return (tap(y0, x0) * (1 - ly) * (1 - lx)
+                + tap(y0, x0 + 1) * (1 - ly) * lx
+                + tap(y0 + 1, x0) * ly * (1 - lx)
+                + tap(y0 + 1, x0 + 1) * ly * lx)  # [c, oh, ow]
+
+    def one_image(img, off, mk):
+        cols = []
+        for ki in range(kh):
+            for kj in range(kw):
+                k_idx = ki * kw + kj
+                dy = off[2 * k_idx]
+                dx = off[2 * k_idx + 1]
+                yy = base_y[:, None] + ki * dilation[0] + dy
+                xx = base_x[None, :] + kj * dilation[1] + dx
+                v = sample(img, yy, xx)             # [c, oh, ow]
+                if mk is not None:
+                    v = v * mk[k_idx][None]
+                cols.append(v)
+        col = jnp.stack(cols, axis=1)               # [c, kh*kw, oh, ow]
+        return jnp.einsum("ckhw,fck->fhw",
+                          col, filt.reshape(oc, c, kh * kw))
+
+    masks = mask if mask is not None else [None] * n
+    if mask is None:
+        out = jax.vmap(lambda i, o: one_image(i, o, None))(x, offset)
+    else:
+        out = jax.vmap(one_image)(x, offset, mask)
+    return {"Output": [out]}

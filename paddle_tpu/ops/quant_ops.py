@@ -99,3 +99,31 @@ def _dequantize_abs_max(ctx, ins, attrs):
     scale = ins["Scale"][0].reshape(())
     qmax = _qmax(attrs.get("bit_length", 8))
     return {"Out": [x.astype(jnp.float32) * (scale / qmax)]}
+
+
+@register_op("fake_dequantize_max_abs", not_differentiable=True,
+             grad_free=True)
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    """reference: fake_dequantize_op.cc — Out = X * Scale / max_range."""
+    x = ins["X"][0].astype(jnp.float32)
+    scale = ins["Scale"][0].reshape(())
+    return {"Out": [x * scale / float(attrs.get("max_range", 127.0))]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             not_differentiable=True, grad_free=True)
+def _fake_channel_wise_dequantize_max_abs(ctx, ins, attrs):
+    """Per-output-channel variant: Scales is a list of scale tensors
+    multiplied in order, each divided by its quant_bits range."""
+    x = ins["X"][0].astype(jnp.float32)
+    scales = ins["Scales"]
+    bits = [int(b) for b in attrs.get("quant_bits", [8])]
+    # a short quant_bits attr must not silently drop scale tensors
+    bits += [8] * (len(scales) - len(bits))
+    out = x
+    for s, b in zip(scales, bits):
+        rng = float((1 << (b - 1)) - 1)
+        s = s.reshape((-1,) + (1,) * (x.ndim - 1)) if s.size > 1 else \
+            s.reshape(())
+        out = out * s / rng
+    return {"Out": [out]}

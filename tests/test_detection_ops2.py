@@ -1,0 +1,458 @@
+"""New detection ops (round 2): losses, matching/assignment, proposals,
+RoI pooling, FPN routing (reference: paddle/fluid/operators/detection/).
+
+Where the reference emits variable-length LoD outputs, these ops return
+fixed-size padded tensors + counts (TPU static shapes); tests check the
+packed prefix against numpy references.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _run(build, feed, n_fetch=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        fetches = build()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        vals = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(v) for v in vals]
+
+
+def test_sigmoid_focal_loss_matches_numpy():
+    rng = np.random.RandomState(0)
+    N, C = 12, 5
+    x = rng.randn(N, C).astype("f")
+    lbl = rng.randint(-1, C + 1, (N, 1)).astype("i4")
+    fg = np.array([4], "i4")
+
+    def build():
+        xv = pt.layers.data("x", [N, C], append_batch_size=False)
+        lv = pt.layers.data("l", [N, 1], dtype="int32",
+                            append_batch_size=False)
+        fv = pt.layers.data("f", [1], dtype="int32",
+                            append_batch_size=False)
+        return [pt.layers.sigmoid_focal_loss(xv, lv, fv, gamma=2.0,
+                                             alpha=0.25)]
+
+    out, = _run(build, {"x": x, "l": lbl, "f": fg})
+
+    # numpy reference (reference kernel formula)
+    g = lbl[:, 0]
+    ref = np.zeros((N, C))
+    for i in range(N):
+        for d in range(C):
+            c_pos = float(g[i] == d + 1)
+            c_neg = float((g[i] != -1) and (g[i] != d + 1))
+            fgn = max(fg[0], 1)
+            p = 1 / (1 + np.exp(-x[i, d]))
+            term_pos = (1 - p) ** 2 * np.log(max(p, 1e-38))
+            xx = x[i, d]
+            term_neg = p ** 2 * (-xx * (xx >= 0)
+                                 - np.log(1 + np.exp(xx - 2 * xx * (xx >= 0))))
+            ref[i, d] = -c_pos * term_pos * 0.25 / fgn \
+                - c_neg * term_neg * 0.75 / fgn
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_sigmoid_focal_loss_grad_flows():
+    rng = np.random.RandomState(1)
+    N, C = 6, 3
+    x = rng.randn(N, C).astype("f")
+    lbl = rng.randint(1, C + 1, (N, 1)).astype("i4")
+
+    def build():
+        xv = pt.layers.data("x", [N, C], append_batch_size=False)
+        xv.stop_gradient = False
+        lv = pt.layers.data("l", [N, 1], dtype="int32",
+                            append_batch_size=False)
+        fv = pt.layers.fill_constant([1], "int32", 3)
+        loss = pt.layers.reduce_sum(
+            pt.layers.sigmoid_focal_loss(xv, lv, fv))
+        g, = pt.gradients([loss], [xv])
+        return [loss, g]
+
+    loss, g = _run(build, {"x": x, "l": lbl})
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[[0.9, 0.2, 0.0],
+                      [0.8, 0.7, 0.3],
+                      [0.1, 0.6, 0.5]]], "f")
+
+    def build():
+        d = pt.layers.data("d", [1, 3, 3], append_batch_size=False)
+        midx, mdist = pt.layers.bipartite_match(d)
+        return [midx, mdist]
+
+    midx, mdist = _run(build, {"d": dist})
+    # greedy global: (0,0)=0.9 -> (1,1)=0.7 -> (2,2)=0.5
+    np.testing.assert_array_equal(midx[0], [0, 1, 2])
+    np.testing.assert_allclose(mdist[0], [0.9, 0.7, 0.5], rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    # col 2 unmatched by bipartite step (its best row already taken),
+    # per_prediction argmax attaches it if >= threshold
+    dist = np.array([[[0.9, 0.0, 0.8],
+                      [0.0, 0.7, 0.0]]], "f")
+
+    def build():
+        d = pt.layers.data("d", [1, 2, 3], append_batch_size=False)
+        midx, mdist = pt.layers.bipartite_match(d, "per_prediction", 0.5)
+        return [midx, mdist]
+
+    midx, mdist = _run(build, {"d": dist})
+    np.testing.assert_array_equal(midx[0], [0, 1, 0])
+    np.testing.assert_allclose(mdist[0], [0.9, 0.7, 0.8], rtol=1e-6)
+
+
+def test_target_assign():
+    N, B, M, K = 2, 3, 4, 2
+    rng = np.random.RandomState(2)
+    x = rng.randn(N, B, K).astype("f")
+    match = np.array([[0, -1, 2, 1], [-1, -1, 0, 0]], "i4")
+
+    def build():
+        xv = pt.layers.data("x", [N, B, K], append_batch_size=False)
+        mv = pt.layers.data("m", [N, M], dtype="int32",
+                            append_batch_size=False)
+        out, wt = pt.layers.target_assign(xv, mv, mismatch_value=7)
+        return [out, wt]
+
+    out, wt = _run(build, {"x": x, "m": match})
+    for n in range(N):
+        for m in range(M):
+            if match[n, m] >= 0:
+                np.testing.assert_allclose(out[n, m], x[n, match[n, m]],
+                                           rtol=1e-6)
+                assert wt[n, m, 0] == 1.0
+            else:
+                np.testing.assert_allclose(out[n, m], 7.0)
+                assert wt[n, m, 0] == 0.0
+
+
+def test_mine_hard_examples_max_negative():
+    match = np.array([[0, -1, -1, -1, 1, -1]], "i4")   # 2 pos, 4 neg cand
+    mdist = np.array([[0.9, 0.1, 0.2, 0.1, 0.8, 0.3]], "f")
+    cls_loss = np.array([[0.0, 0.5, 0.9, 0.1, 0.0, 0.7]], "f")
+
+    def build():
+        cl = pt.layers.data("cl", [1, 6], append_batch_size=False)
+        mi = pt.layers.data("mi", [1, 6], dtype="int32",
+                            append_batch_size=False)
+        md = pt.layers.data("md", [1, 6], append_batch_size=False)
+        neg, upd = pt.layers.mine_hard_examples(
+            cl, mi, md, neg_pos_ratio=1.0, neg_dist_threshold=0.5)
+        return [neg, upd]
+
+    neg, upd = _run(build, {"cl": cls_loss, "mi": match, "md": mdist})
+    # neg_sel = min(2 pos * 1.0, 4) = 2; hardest negatives: idx 2 (0.9),
+    # idx 5 (0.7); NegIndices ascending with -1 padding
+    assert list(neg[0][:2]) == [2, 5]
+    assert all(v == -1 for v in neg[0][2:])
+    np.testing.assert_array_equal(upd, match)
+
+
+def test_mine_hard_examples_hard_example():
+    """hard_example ranks ALL priors; unselected positives are demoted
+    and NegIndices lists only the selected negatives."""
+    match = np.array([[0, -1, 1, -1]], "i4")
+    mdist = np.array([[0.9, 0.1, 0.8, 0.2]], "f")
+    cls_loss = np.array([[0.9, 0.8, 0.1, 0.2]], "f")  # pos0 + neg1 hardest
+
+    def build():
+        cl = pt.layers.data("cl", [1, 4], append_batch_size=False)
+        mi = pt.layers.data("mi", [1, 4], dtype="int32",
+                            append_batch_size=False)
+        md = pt.layers.data("md", [1, 4], append_batch_size=False)
+        neg, upd = pt.layers.mine_hard_examples(
+            cl, mi, md, mining_type="hard_example", sample_size=2)
+        return [neg, upd]
+
+    neg, upd = _run(build, {"cl": cls_loss, "mi": match, "md": mdist})
+    # top-2 by loss: prior 0 (pos, kept) and prior 1 (neg, selected);
+    # positive prior 2 was NOT selected -> demoted to -1
+    np.testing.assert_array_equal(upd[0], [0, -1, -1, -1])
+    assert list(neg[0][:1]) == [1]
+    assert all(v == -1 for v in neg[0][1:])
+
+
+def test_roi_pool_matches_numpy():
+    x = np.arange(1 * 1 * 6 * 6, dtype="f").reshape(1, 1, 6, 6)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "f")
+
+    def build():
+        xv = pt.layers.data("x", [1, 1, 6, 6], append_batch_size=False)
+        rv = pt.layers.data("r", [1, 4], append_batch_size=False)
+        return [pt.layers.roi_pool(xv, rv, 2, 2, 1.0)]
+
+    out, = _run(build, {"x": x, "r": rois})
+    # roi 0..3 inclusive -> 4x4 region, 2x2 bins of 2x2 -> max each
+    img = x[0, 0, :4, :4]
+    ref = np.array([[img[:2, :2].max(), img[:2, 2:].max()],
+                    [img[2:, :2].max(), img[2:, 2:].max()]])
+    np.testing.assert_allclose(out[0, 0], ref)
+
+
+def test_density_prior_box_shapes_and_range():
+    def build():
+        feat = pt.layers.data("f", [8, 4, 4], append_batch_size=False)
+        feat2 = pt.layers.reshape(feat, [1, 8, 4, 4])
+        img = pt.layers.data("im", [3, 32, 32], append_batch_size=False)
+        img2 = pt.layers.reshape(img, [1, 3, 32, 32])
+        b, v = pt.layers.density_prior_box(
+            feat2, img2, densities=[2, 1], fixed_sizes=[8.0, 16.0],
+            fixed_ratios=[1.0], clip=True)
+        return [b, v]
+
+    b, v = _run(build, {"f": np.zeros((8, 4, 4), "f"),
+                        "im": np.zeros((3, 32, 32), "f")})
+    # priors per cell = 1 ratio * (2^2 + 1^2) = 5
+    assert b.shape == (4, 4, 5, 4)
+    assert v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    # boxes must be well-formed
+    assert (b[..., 2] >= b[..., 0]).all()
+
+
+def test_polygon_box_transform():
+    x = np.random.RandomState(3).randn(1, 4, 3, 3).astype("f")
+
+    def build():
+        xv = pt.layers.data("x", [1, 4, 3, 3], append_batch_size=False)
+        return [pt.layers.polygon_box_transform(xv)]
+
+    out, = _run(build, {"x": x})
+    iw = np.arange(3)[None, None, None, :]
+    ih = np.arange(3)[None, None, :, None]
+    even = (np.arange(4) % 2 == 0)[None, :, None, None]
+    ref = np.where(even, iw * 4 - x, ih * 4 - x)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_generate_proposals_basic():
+    # two anchors; one decodes to a large high-score box, one tiny
+    anchors = np.array([[0, 0, 15, 15], [5, 5, 6, 6]], "f")
+    variances = np.ones((2, 4), "f")
+    scores = np.array([[[0.9], [0.8]]], "f")
+    deltas = np.zeros((1, 2, 4), "f")
+    im_info = np.array([[32, 32, 1.0]], "f")
+
+    def build():
+        s = pt.layers.data("s", [1, 2, 1], append_batch_size=False)
+        d = pt.layers.data("d", [1, 2, 4], append_batch_size=False)
+        ii = pt.layers.data("ii", [1, 3], append_batch_size=False)
+        a = pt.layers.data("a", [2, 4], append_batch_size=False)
+        v = pt.layers.data("v", [2, 4], append_batch_size=False)
+        rois, probs, num = pt.layers.generate_proposals(
+            s, d, ii, a, v, pre_nms_top_n=2, post_nms_top_n=2,
+            nms_thresh=0.5, min_size=4.0)
+        return [rois, probs, num]
+
+    rois, probs, num = _run(build, {"s": scores, "d": deltas,
+                                    "ii": im_info, "a": anchors,
+                                    "v": variances})
+    # the 2x2 anchor is filtered by min_size; one proposal survives
+    assert int(num[0]) == 1
+    np.testing.assert_allclose(rois[0, 0], [0, 0, 15, 15], atol=1e-4)
+    np.testing.assert_allclose(probs[0, 0, 0], 0.9, rtol=1e-5)
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([[0, 0, 10, 10],       # small -> min level
+                     [0, 0, 300, 300],     # large -> max level
+                     [0, 0, 12, 12]], "f")
+
+    def build():
+        r = pt.layers.data("r", [3, 4], append_batch_size=False)
+        outs, restore = pt.layers.distribute_fpn_proposals(
+            r, min_level=2, max_level=3, refer_level=2, refer_scale=14)
+        return outs + [restore]
+
+    lvl2, lvl3, restore = _run(build, {"r": rois})
+    np.testing.assert_allclose(lvl2[:2], rois[[0, 2]])
+    np.testing.assert_allclose(lvl2[2], 0.0)
+    np.testing.assert_allclose(lvl3[0], rois[1])
+    # restore maps original rois into the [2*3] fixed concat
+    assert list(restore[:, 0]) == [0, 3, 1]
+
+    # collect: top-2 across levels by score
+    def build2():
+        r1 = pt.layers.data("r1", [2, 4], append_batch_size=False)
+        r2 = pt.layers.data("r2", [2, 4], append_batch_size=False)
+        s1 = pt.layers.data("s1", [2, 1], append_batch_size=False)
+        s2 = pt.layers.data("s2", [2, 1], append_batch_size=False)
+        out = pt.layers.collect_fpn_proposals(
+            [r1, r2], [s1, s2], 2, 3, post_nms_top_n=2)
+        return [out]
+
+    out, = _run(build2, {
+        "r1": np.array([[1, 1, 2, 2], [3, 3, 4, 4]], "f"),
+        "r2": np.array([[5, 5, 6, 6], [7, 7, 8, 8]], "f"),
+        "s1": np.array([[0.1], [0.9]], "f"),
+        "s2": np.array([[0.8], [0.2]], "f")})
+    np.testing.assert_allclose(out, [[3, 3, 4, 4], [5, 5, 6, 6]])
+
+
+def test_rpn_target_assign_shapes_and_invariants():
+    rng = np.random.RandomState(4)
+    A = 16
+    anchors = np.zeros((A, 4), "f")
+    grid = np.arange(4) * 8.0
+    k = 0
+    for yy in grid:
+        for xx in grid:
+            anchors[k] = [xx, yy, xx + 7, yy + 7]
+            k += 1
+    gt = np.array([[[0, 0, 7, 7], [16, 16, 27, 27]]], "f")
+    im_info = np.array([[32, 32, 1.0]], "f")
+
+    def build():
+        a = pt.layers.data("a", [A, 4], append_batch_size=False)
+        g = pt.layers.data("g", [1, 2, 4], append_batch_size=False)
+        ii = pt.layers.data("ii", [1, 3], append_batch_size=False)
+        bbox_pred = cls_logits = None
+        loc, sc, tgt, lbl, inw = pt.layers.rpn_target_assign(
+            bbox_pred, cls_logits, a, None, g, ii,
+            rpn_batch_size_per_im=8, rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3, use_random=False)
+        return [lbl, tgt, inw, loc, sc]
+
+    lbl, tgt, inw, loc, sc = _run(build, {"a": anchors, "g": gt,
+                                          "ii": im_info})
+    assert lbl.shape == (1, A)
+    # anchors exactly covering the gts must be labeled fg
+    assert lbl[0, 0] == 1          # anchor [0,0,7,7] == gt 0
+    # fg rows carry inside weight 1 and a finite target
+    fg = lbl[0] == 1
+    assert inw[0][fg].min() == 1.0
+    assert np.isfinite(tgt[0][fg]).all()
+    # bg rows have zero weights
+    assert (inw[0][lbl[0] == 0] == 0).all()
+    # sampled counts respect the batch size
+    assert (lbl[0] != -1).sum() <= 8
+
+
+def test_yolov3_loss_positive_and_trains():
+    rng = np.random.RandomState(5)
+    n, h, w = 2, 4, 4
+    class_num = 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    C = len(mask) * (5 + class_num)
+    x = (rng.randn(n, C, h, w) * 0.1).astype("f")
+    gt_box = np.array([[[0.3, 0.3, 0.2, 0.2], [0, 0, 0, 0]],
+                       [[0.6, 0.6, 0.4, 0.3], [0.2, 0.2, 0.1, 0.1]]], "f")
+    gt_label = np.array([[1, 0], [2, 0]], "i4")
+
+    def build():
+        xv = pt.layers.data("x", [n, C, h, w], append_batch_size=False)
+        xv.stop_gradient = False
+        g = pt.layers.data("g", [n, 2, 4], append_batch_size=False)
+        l = pt.layers.data("l", [n, 2], dtype="int32",
+                           append_batch_size=False)
+        loss = pt.layers.yolov3_loss(xv, g, l, anchors, mask, class_num,
+                                     ignore_thresh=0.7,
+                                     downsample_ratio=8)
+        total = pt.layers.reduce_sum(loss)
+        gx, = pt.gradients([total], [xv])
+        return [loss, gx]
+
+    loss, gx = _run(build, {"x": x, "g": gt_box, "l": gt_label})
+    assert loss.shape == (n,)
+    assert (loss > 0).all()
+    assert np.isfinite(gx).all() and np.abs(gx).sum() > 0
+
+
+def test_retinanet_detection_output_basic():
+    # one level, two anchors, two classes; zero deltas decode to anchors
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], "f")
+    bboxes = np.zeros((1, 2, 4), "f")
+    scores = np.array([[[0.9, 0.1], [0.05, 0.8]]], "f")
+    im_info = np.array([[64, 64, 1.0]], "f")
+
+    def build():
+        b = pt.layers.data("b", [1, 2, 4], append_batch_size=False)
+        s = pt.layers.data("s", [1, 2, 2], append_batch_size=False)
+        a = pt.layers.data("a", [2, 4], append_batch_size=False)
+        ii = pt.layers.data("ii", [1, 3], append_batch_size=False)
+        out = pt.layers.retinanet_detection_output(
+            [b], [s], [a], ii, score_threshold=0.2, nms_top_k=4,
+            keep_top_k=3, nms_threshold=0.3)
+        return [out]
+
+    out, = _run(build, {"b": bboxes, "s": scores, "a": anchors,
+                        "ii": im_info})
+    assert out.shape == (1, 3, 6)
+    # two detections: class 1 @ anchor0 (0.9), class 2 @ anchor1 (0.8)
+    kept = out[0][out[0][:, 0] > 0]
+    assert len(kept) == 2
+    assert {int(k[0]) for k in kept} == {1, 2}
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.8], rtol=1e-5)
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], "f")
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]], "f")
+    target = np.zeros((1, 8), "f")      # 2 classes x 4
+    score = np.array([[0.1, 0.9]], "f")
+
+    def build():
+        p = pt.layers.data("p", [1, 4], append_batch_size=False)
+        v = pt.layers.data("v", [1, 4], append_batch_size=False)
+        t = pt.layers.data("t", [1, 8], append_batch_size=False)
+        s = pt.layers.data("s", [1, 2], append_batch_size=False)
+        dec, assign = pt.layers.box_decoder_and_assign(p, v, t, s, 4.135)
+        return [dec, assign]
+
+    dec, assign = _run(build, {"p": prior, "v": pvar, "t": target,
+                               "s": score})
+    # zero deltas decode to the prior itself (center-size round trip)
+    np.testing.assert_allclose(dec.reshape(1, 2, 4)[0, 1],
+                               [0, 0, 9, 9], atol=1e-5)
+    np.testing.assert_allclose(assign[0], [0, 0, 9, 9], atol=1e-5)
+
+
+def test_ssd_loss_composes_and_trains():
+    rng = np.random.RandomState(6)
+    n, b, p, cls = 2, 2, 6, 4
+    prior = np.abs(rng.rand(p, 4)).astype("f")
+    prior[:, 2:] += prior[:, :2]        # well-formed boxes
+    gt_box = np.abs(rng.rand(n, b, 4)).astype("f")
+    gt_box[..., 2:] += gt_box[..., :2]
+    gt_label = rng.randint(1, cls, (n, b, 1)).astype("i4")
+
+    def build():
+        loc = pt.layers.data("loc", [n, p, 4], append_batch_size=False)
+        conf = pt.layers.data("conf", [n, p, cls],
+                              append_batch_size=False)
+        loc.stop_gradient = False
+        conf.stop_gradient = False
+        g = pt.layers.data("g", [n, b, 4], append_batch_size=False)
+        l = pt.layers.data("l", [n, b, 1], dtype="int32",
+                           append_batch_size=False)
+        pb = pt.layers.data("pb", [p, 4], append_batch_size=False)
+        loss = pt.layers.ssd_loss(loc, conf, g, l, pb)
+        total = pt.layers.reduce_sum(loss)
+        g1, g2 = pt.gradients([total], [loc, conf])
+        return [loss, g1, g2]
+
+    loss, g1, g2 = _run(build, {
+        "loc": rng.randn(n, p, 4).astype("f"),
+        "conf": rng.randn(n, p, cls).astype("f"),
+        "g": gt_box, "l": gt_label, "pb": prior})
+    assert loss.shape == (n, p, 1)
+    assert np.isfinite(loss).all()
+    assert np.isfinite(g1).all() and np.isfinite(g2).all()
+    assert np.abs(g2).sum() > 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -1,0 +1,597 @@
+"""Long-tail op sweep tests (ops/extra_ops.py, nn_extra_ops.py,
+lod_array_ops.py) — numpy references + gradient checks for the
+differentiable ones.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework.registry import LowerContext, get_op_def
+
+import jax
+import jax.numpy as jnp
+
+
+def lower(op_type, ins, attrs=None, ctx=None):
+    """Direct op-lowering harness (OpTest-style for ops without layers)."""
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    ctx = ctx or LowerContext(rng_key=jax.random.PRNGKey(0))
+    jins = {k: [v if isinstance(v, (tuple, list, SelectedRows))
+                else jnp.asarray(v) for v in vs]
+            for k, vs in ins.items()}
+    return get_op_def(op_type).lower(ctx, jins, attrs or {})
+
+
+def num_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op_type, ins, attrs, wrt_slot, out_slot, rtol=1e-2,
+               atol=1e-3):
+    """Numeric-vs-analytic gradient of sum(out) w.r.t. ins[wrt_slot][0]."""
+    x0 = np.asarray(ins[wrt_slot][0], np.float32)
+
+    def run(xv):
+        jins = dict(ins)
+        jins = {k: [jnp.asarray(v) for v in vs] for k, vs in jins.items()}
+        jins[wrt_slot] = [jnp.asarray(xv)]
+        ctx = LowerContext(rng_key=jax.random.PRNGKey(0))
+        return get_op_def(op_type).lower(ctx, jins, attrs)[out_slot][0]
+
+    ana = jax.grad(lambda xv: jnp.sum(run(xv)))(jnp.asarray(x0))
+    num = num_grad(lambda xv: float(np.sum(np.asarray(run(xv)))), x0)
+    np.testing.assert_allclose(np.asarray(ana), num, rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# simple tensor / math
+# ---------------------------------------------------------------------------
+
+def test_eye_fill_minus_l1():
+    assert np.allclose(np.asarray(lower("eye", {}, {"num_rows": 3})["Out"][0]),
+                       np.eye(3))
+    o = lower("fill", {}, {"value": [1, 2, 3, 4], "shape": [2, 2],
+                           "dtype": "float32"})["Out"][0]
+    assert np.allclose(np.asarray(o), [[1, 2], [3, 4]])
+    x = np.array([3., 5.], "f")
+    y = np.array([1., 7.], "f")
+    assert np.allclose(np.asarray(lower("minus", {"X": [x], "Y": [y]})["Out"][0]),
+                       x - y)
+    assert np.isclose(float(np.asarray(
+        lower("l1_norm", {"X": [np.array([-1., 2.], "f")]})["Out"][0])), 3.0)
+
+
+def test_squared_l2_distance_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype("f")
+    y = rng.randn(3, 4).astype("f")
+    out = np.asarray(lower("squared_l2_distance",
+                           {"X": [x], "Y": [y]})["Out"][0])
+    np.testing.assert_allclose(out[:, 0], ((x - y) ** 2).sum(1), rtol=1e-5)
+    check_grad("squared_l2_distance", {"X": [x], "Y": [y]}, {}, "X", "Out")
+
+
+def test_label_smooth_selu_crop_reverse():
+    x = np.eye(3, dtype="f")
+    o = np.asarray(lower("label_smooth", {"X": [x]},
+                         {"epsilon": 0.1})["Out"][0])
+    np.testing.assert_allclose(o, 0.9 * x + 0.1 / 3, rtol=1e-6)
+    xs = np.array([-1.0, 0.5], "f")
+    o = np.asarray(lower("selu", {"X": [xs]})["Out"][0])
+    np.testing.assert_allclose(
+        o, 1.0507 * np.where(xs > 0, xs, 1.67326 * np.expm1(xs)),
+        rtol=1e-4)
+    x = np.arange(16, dtype="f").reshape(4, 4)
+    o = np.asarray(lower("crop", {"X": [x]},
+                         {"shape": [2, 2], "offsets": [1, 1]})["Out"][0])
+    np.testing.assert_allclose(o, x[1:3, 1:3])
+    o = np.asarray(lower("reverse", {"X": [x]}, {"axis": [1]})["Out"][0])
+    np.testing.assert_allclose(o, x[:, ::-1])
+
+
+def test_flatten_squeeze_unsqueeze_pad_like():
+    x = np.zeros((2, 3, 4), "f")
+    assert lower("flatten", {"X": [x]}, {"axis": 2})["Out"][0].shape == \
+        (6, 4)
+    x = np.zeros((2, 1, 3), "f")
+    assert lower("squeeze", {"X": [x]}, {"axes": [1]})["Out"][0].shape == \
+        (2, 3)
+    assert lower("unsqueeze", {"X": [x]},
+                 {"axes": [0]})["Out"][0].shape == (1, 2, 1, 3)
+    big = np.zeros((4, 5), "f")
+    small = np.ones((2, 3), "f")
+    o = np.asarray(lower("pad_constant_like",
+                         {"X": [big], "Y": [small]},
+                         {"pad_value": 9.0})["Out"][0])
+    assert o.shape == (4, 5) and o[0, 0] == 1 and o[3, 4] == 9
+
+
+def test_multiplex():
+    x1 = np.full((3, 2), 1.0, "f")
+    x2 = np.full((3, 2), 2.0, "f")
+    ids = np.array([[1], [0], [1]], "i4")
+    o = np.asarray(lower("multiplex", {"X": [x1, x2],
+                                       "Ids": [ids]})["Out"][0])
+    np.testing.assert_allclose(o[:, 0], [2, 1, 2])
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], "i4")
+    lab = np.array([0, 1, 2, 2], "i4")
+    o = lower("mean_iou", {"Predictions": [pred], "Labels": [lab]},
+              {"num_classes": 3})
+    # IoU: c0 1/1, c1 1/2, c2 1/2 -> mean 2/3
+    assert np.isclose(float(np.asarray(o["OutMeanIou"][0])), 2 / 3,
+                      atol=1e-6)
+
+
+def test_conv_shift():
+    x = np.array([[1., 2., 3., 4.]], "f")
+    y = np.array([[0., 1., 0.]], "f")   # identity shift
+    o = np.asarray(lower("conv_shift", {"X": [x], "Y": [y]})["Out"][0])
+    np.testing.assert_allclose(o, x, rtol=1e-6)
+
+
+def test_unique_and_counts():
+    x = np.array([3, 1, 3, 2, 1], "i4")
+    o = lower("unique_with_counts", {"X": [x]})
+    uniq = np.asarray(o["Out"][0])
+    idx = np.asarray(o["Index"][0])
+    cnt = np.asarray(o["Count"][0])
+    np.testing.assert_array_equal(uniq[:3], [1, 2, 3])
+    np.testing.assert_array_equal(uniq[idx], x)  # inverse mapping
+    assert cnt[:3].tolist() == [2, 1, 2]
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0]], "i4")
+    ref = np.array([[1, 3, 3, 4]], "i4")
+    o = lower("edit_distance",
+              {"Hyps": [hyp], "HypsLength": [np.array([3], "i4")],
+               "Refs": [ref], "RefsLength": [np.array([4], "i4")]})
+    # "123" vs "1334": sub 2->3, insert 3 or 4... distance 2
+    assert float(np.asarray(o["Out"][0])[0, 0]) == 2.0
+
+
+def test_hash_deterministic():
+    x = np.array([[1], [2], [1]], "i8")
+    o1 = np.asarray(lower("hash", {"X": [x]},
+                          {"num_hash": 2, "mod_by": 1000})["Out"][0])
+    o2 = np.asarray(lower("hash", {"X": [x]},
+                          {"num_hash": 2, "mod_by": 1000})["Out"][0])
+    np.testing.assert_array_equal(o1, o2)
+    assert (o1 < 1000).all()
+    np.testing.assert_array_equal(o1[0], o1[2])  # same key same hash
+    assert not np.array_equal(o1[0], o1[1])
+
+
+# ---------------------------------------------------------------------------
+# NN extra
+# ---------------------------------------------------------------------------
+
+def test_affine_channel_and_grad():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4, 4).astype("f")
+    s = rng.rand(3).astype("f") + 0.5
+    b = rng.randn(3).astype("f")
+    o = np.asarray(lower("affine_channel",
+                         {"X": [x], "Scale": [s], "Bias": [b]})["Out"][0])
+    np.testing.assert_allclose(
+        o, x * s[None, :, None, None] + b[None, :, None, None], rtol=1e-5)
+    check_grad("affine_channel", {"X": [x], "Scale": [s], "Bias": [b]},
+               {}, "X", "Out")
+
+
+def test_affine_grid_identity_and_sampler():
+    # identity theta -> grid == mesh; sampling reproduces the image
+    theta = np.tile(np.array([[[1., 0., 0.], [0., 1., 0.]]], "f"),
+                    (1, 1, 1))
+    grid = np.asarray(lower("affine_grid", {"Theta": [theta]},
+                            {"output_shape": [1, 1, 5, 5]})["Output"][0])
+    assert grid.shape == (1, 5, 5, 2)
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, -1, -1], [1, 1], atol=1e-6)
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 5, 5).astype("f")
+    o = np.asarray(lower("grid_sampler",
+                         {"X": [x], "Grid": [grid]})["Output"][0])
+    np.testing.assert_allclose(o, x, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sampler_grad():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 1, 4, 4).astype("f")
+    grid = (rng.rand(1, 3, 3, 2).astype("f") - 0.5) * 1.6
+    check_grad("grid_sampler", {"X": [x], "Grid": [grid]}, {}, "X",
+               "Output")
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 4, 4).astype("f")
+    o = lower("max_pool2d_with_index", {"X": [x]},
+              {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]})
+    val, mask = np.asarray(o["Out"][0]), np.asarray(o["Mask"][0])
+    assert val.shape == (1, 2, 2, 2)
+    # each value is the max of its window
+    np.testing.assert_allclose(
+        val[0, 0, 0, 0], x[0, 0, :2, :2].max(), rtol=1e-6)
+    up = np.asarray(lower("unpool", {"X": [jnp.asarray(val)],
+                                     "Indices": [jnp.asarray(mask)]},
+                          {"unpooled_size": [4, 4]})["Out"][0])
+    # unpooled tensor has the max values at their original positions
+    assert up.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(up.sum(), val.sum(), rtol=1e-5)
+    pos = np.unravel_index(mask[0, 0, 0, 0], (4, 4))
+    assert up[0, 0, pos[0], pos[1]] == val[0, 0, 0, 0]
+
+
+def test_spp_shapes():
+    x = np.random.RandomState(5).randn(2, 3, 8, 8).astype("f")
+    o = np.asarray(lower("spp", {"X": [x]},
+                         {"pyramid_height": 2,
+                          "pooling_type": "max"})["Out"][0])
+    # level0: 1x1, level1: 2x2 -> c*(1+4) = 15
+    assert o.shape == (2, 15)
+    np.testing.assert_allclose(o[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_cvm():
+    x = np.array([[1.0, 2.0, 5.0], [3.0, 0.0, 7.0]], "f")
+    o = np.asarray(lower("cvm", {"X": [x]}, {"use_cvm": True})["Y"][0])
+    np.testing.assert_allclose(o[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        o[:, 1], np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1), rtol=1e-5)
+    o2 = np.asarray(lower("cvm", {"X": [x]}, {"use_cvm": False})["Y"][0])
+    np.testing.assert_allclose(o2, x[:, 2:])
+
+
+def test_data_norm():
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 3).astype("f") * 2 + 1
+    bs = np.full((3,), 10.0, "f")
+    bsum = np.full((3,), 20.0, "f")   # mean 2
+    bsq = np.full((3,), 40.0, "f")    # scale sqrt(10/40)=0.5
+    o = lower("data_norm", {"X": [x], "BatchSize": [bs],
+                            "BatchSum": [bsum], "BatchSquareSum": [bsq]})
+    np.testing.assert_allclose(np.asarray(o["Y"][0]), (x - 2.0) * 0.5,
+                               rtol=1e-5)
+
+
+def test_fsp():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 4, 4).astype("f")
+    y = rng.randn(2, 5, 4, 4).astype("f")
+    o = np.asarray(lower("fsp", {"X": [x], "Y": [y]})["Out"][0])
+    ref = np.einsum("nchw,ndhw->ncd", x, y) / 16
+    np.testing.assert_allclose(o, ref, rtol=1e-4)
+
+
+def test_center_loss():
+    x = np.array([[1.0, 0.0], [0.0, 1.0]], "f")
+    label = np.array([0, 1], "i4")
+    centers = np.zeros((3, 2), "f")
+    rate = np.array([0.5], "f")
+    o = lower("center_loss", {"X": [x], "Label": [label],
+                              "Centers": [centers],
+                              "CenterUpdateRate": [rate]},
+              {"need_update": True})
+    np.testing.assert_allclose(np.asarray(o["Loss"][0])[:, 0], [0.5, 0.5])
+    c = np.asarray(o["CentersOut"][0])
+    np.testing.assert_allclose(c[0], [0.25, 0.0], rtol=1e-5)
+
+
+def test_positive_negative_pair():
+    score = np.array([0.9, 0.2, 0.5], "f")
+    label = np.array([1.0, 0.0, 2.0], "f")
+    qid = np.array([0, 0, 0], "i4")
+    o = lower("positive_negative_pair",
+              {"Score": [score], "Label": [label], "QueryID": [qid]})
+    # pairs: (0,1): s 0.9>0.2, l 1>0 pos; (0,2): s 0.9>0.5, l 1<2 neg;
+    # (1,2): s 0.2<0.5, l 0<2 pos
+    assert float(np.asarray(o["PositivePair"][0])) == 2.0
+    assert float(np.asarray(o["NegativePair"][0])) == 1.0
+
+
+def test_row_conv_and_grad():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 5, 3).astype("f")
+    filt = rng.randn(2, 3).astype("f")
+    o = np.asarray(lower("row_conv", {"X": [x], "Filter": [filt]})["Out"][0])
+    ref = np.zeros_like(x)
+    for t in range(5):
+        for w in range(2):
+            if t + w < 5:
+                ref[:, t] += x[:, t + w] * filt[w]
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+    check_grad("row_conv", {"X": [x], "Filter": [filt]}, {}, "X", "Out")
+
+
+def test_fc_op():
+    rng = np.random.RandomState(9)
+    x = rng.randn(3, 4).astype("f")
+    w = rng.randn(4, 5).astype("f")
+    b = rng.randn(5).astype("f")
+    o = np.asarray(lower("fc", {"Input": [x], "W": [w],
+                                "Bias": [b]})["Out"][0])
+    np.testing.assert_allclose(o, x @ w + b, rtol=1e-4)
+
+
+def test_lstm_unit():
+    rng = np.random.RandomState(10)
+    b, d = 2, 3
+    x = rng.randn(b, 4 * d).astype("f")
+    c_prev = rng.randn(b, d).astype("f")
+    o = lower("lstm_unit", {"X": [x], "C_prev": [c_prev]},
+              {"forget_bias": 1.0})
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    i, f = sig(x[:, :d]), sig(x[:, d:2 * d] + 1.0)
+    og, g = sig(x[:, 2 * d:3 * d]), np.tanh(x[:, 3 * d:])
+    c = f * c_prev + i * g
+    np.testing.assert_allclose(np.asarray(o["C"][0]), c, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(o["H"][0]), og * np.tanh(c),
+                               rtol=1e-4)
+
+
+def test_lstmp_shapes_and_projection():
+    rng = np.random.RandomState(11)
+    b, t, d, p = 2, 4, 3, 2
+    x = rng.randn(b, t, 4 * d).astype("f") * 0.1
+    w = rng.randn(p, 4 * d).astype("f") * 0.1
+    pw = rng.randn(d, p).astype("f") * 0.1
+    o = lower("lstmp", {"Input": [x], "Weight": [w], "ProjWeight": [pw]})
+    assert o["Projection"][0].shape == (b, t, p)
+    assert o["Cell"][0].shape == (b, t, d)
+
+
+def test_sync_batch_norm_plain():
+    rng = np.random.RandomState(12)
+    x = rng.randn(4, 3, 2, 2).astype("f")
+    o = lower("sync_batch_norm",
+              {"X": [x], "Scale": [np.ones(3, "f")],
+               "Bias": [np.zeros(3, "f")],
+               "Mean": [np.zeros(3, "f")],
+               "Variance": [np.ones(3, "f")]},
+              {"epsilon": 1e-5, "momentum": 0.9})
+    y = np.asarray(o["Y"][0])
+    # normalized output: per-channel ~zero mean, unit var
+    np.testing.assert_allclose(y.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(axis=(0, 2, 3)), 1, atol=1e-2)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(13)
+    x = rng.randn(1, 2, 5, 5).astype("f")
+    filt = rng.randn(3, 2, 3, 3).astype("f")
+    off = np.zeros((1, 2 * 9, 5, 5), "f")
+    o = np.asarray(lower("deformable_conv",
+                         {"Input": [x], "Offset": [off],
+                          "Filter": [filt]},
+                         {"strides": [1, 1], "paddings": [1, 1],
+                          "dilations": [1, 1]})["Output"][0])
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(filt), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(o, np.asarray(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_sample_logits_and_grad():
+    rng = np.random.RandomState(14)
+    n, k, t, s = 3, 20, 1, 5
+    logits = rng.randn(n, k).astype("f")
+    labels = rng.randint(0, k, (n, t)).astype("i8")
+    ctx = LowerContext(rng_key=jax.random.PRNGKey(7))
+    o = get_op_def("sample_logits").lower(
+        ctx, {"Logits": [jnp.asarray(logits)],
+              "Labels": [jnp.asarray(labels)]},
+        {"num_samples": s, "remove_accidental_hits": True})
+    samples = np.asarray(o["Samples"][0])
+    sl = np.asarray(o["SampledLogits"][0])
+    assert samples.shape == (n, t + s)
+    np.testing.assert_array_equal(samples[:, :t], labels)
+    assert np.isfinite(sl[:, :t]).all()
+    # grad: scatter of cotangent through sample indices
+    g = np.ones_like(sl)
+    gl = get_op_def("sample_logits").grad_lower(
+        ctx, {"Logits": [jnp.asarray(logits)],
+              "__out__Samples": [jnp.asarray(samples)],
+              "SampledLogits@GRAD": [jnp.asarray(g)]},
+        {})["Logits@GRAD"][0]
+    gl = np.asarray(gl)
+    assert gl.shape == logits.shape
+    # each row's grads sum to t+s (every sampled position contributes 1)
+    np.testing.assert_allclose(gl.sum(1), t + s, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows / quant / accumulators
+# ---------------------------------------------------------------------------
+
+def test_dgc_clip_by_norm():
+    x = np.array([3.0, 4.0], "f")  # norm 5
+    o = np.asarray(lower("dgc_clip_by_norm",
+                         {"X": [x], "current_step": [np.array([5.0], "f")]},
+                         {"rampup_begin_step": 0.0,
+                          "max_norm": 1.0})["Out"][0])
+    np.testing.assert_allclose(o, x / 5.0, rtol=1e-5)
+    o2 = np.asarray(lower("dgc_clip_by_norm",
+                          {"X": [x], "current_step": [np.array([5.0], "f")]},
+                          {"rampup_begin_step": 10.0,
+                           "max_norm": 1.0})["Out"][0])
+    np.testing.assert_allclose(o2, x)  # before rampup: no clip
+
+
+def test_quantize_roundtrip():
+    x = np.array([-1.0, 0.25, 0.5], "f")
+    q = np.asarray(lower("quantize", {"Input": [x]},
+                         {"Scale": 127.0})["Output"][0])
+    assert q.dtype == np.int8
+    d = np.asarray(lower("dequantize", {"Input": [q]},
+                         {"Scale": 127.0})["Output"][0])
+    np.testing.assert_allclose(d, x, atol=1 / 127)
+
+
+def test_merge_get_split_selected_rows():
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    rows = jnp.asarray([1, 3, 1], jnp.int32)
+    vals = jnp.asarray([[1.0], [2.0], [10.0]], jnp.float32)
+    sr = SelectedRows(rows, vals, 8)
+    merged = lower("merge_selected_rows", {"X": [sr]})["Out"][0]
+    got = {int(r): float(v) for r, v in zip(np.asarray(merged.rows),
+                                            np.asarray(merged.values)[:, 0])
+           if r >= 0}
+    assert got[1] == 11.0 and got[3] == 2.0
+    t = lower("get_tensor_from_selected_rows", {"X": [sr]})["Out"][0]
+    assert t.shape == (3, 1)
+    parts = lower("split_selected_rows", {"X": [sr]},
+                  {"height_sections": [4, 4]})["Out"]
+    assert len(parts) == 2
+    # row 1,1 in shard 0; row 3 in shard 0 too (height 4)
+    assert (np.asarray(parts[0].rows) >= -1).all()
+
+
+# ---------------------------------------------------------------------------
+# LoD / array / decode
+# ---------------------------------------------------------------------------
+
+def test_rank_table_array_roundtrip():
+    lengths = np.array([2, 4, 3], "i4")
+    x = np.random.RandomState(15).randn(3, 4, 2).astype("f")
+    table = lower("lod_rank_table",
+                  {"X": [x], "XLength": [lengths]})["Out"][0]
+    np.testing.assert_array_equal(np.asarray(table[0]), [1, 2, 0])
+    ml = lower("max_sequence_len", {"RankTable": [table]})["Out"][0]
+    assert int(np.asarray(ml)[0]) == 4
+    arr = lower("lod_tensor_to_array",
+                {"X": [x], "RankTable": [table]})["Out"][0]
+    assert len(arr) == 4 and arr[0].shape == (3, 2)
+    back = lower("array_to_lod_tensor",
+                 {"X": [arr], "RankTable": [table]})["Out"][0]
+    np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
+    n = lower("lod_array_length", {"X": [arr]})["Out"][0]
+    assert int(np.asarray(n)[0]) == 4
+
+
+def test_split_merge_lod_tensor():
+    x = np.arange(8, dtype="f").reshape(4, 2)
+    mask = np.array([[1], [0], [1], [0]], "?")
+    o = lower("split_lod_tensor", {"X": [x], "Mask": [mask]})
+    t, f = np.asarray(o["OutTrue"][0]), np.asarray(o["OutFalse"][0])
+    assert (t[1] == 0).all() and (f[0] == 0).all()
+    m = np.asarray(lower("merge_lod_tensor",
+                         {"InTrue": [t], "InFalse": [f],
+                          "Mask": [mask]})["Out"][0])
+    np.testing.assert_allclose(m, x)
+
+
+def test_shrink_rnn_memory():
+    x = np.ones((3, 2), "f")
+    table = (jnp.asarray([0, 1, 2], jnp.int32),
+             jnp.asarray([3, 2, 1], jnp.int32))
+    o = np.asarray(lower("shrink_rnn_memory",
+                         {"X": [x], "RankTable": [table],
+                          "I": [np.array([1], "i4")]})["Out"][0])
+    # lengths sorted desc [3,2,1]; step 1 -> rows with len>1 stay
+    assert (o[0] == 1).all() and (o[1] == 1).all() and (o[2] == 0).all()
+
+
+def test_beam_search_step_and_decode():
+    # b=1, bw=2, V=4
+    pre_ids = np.array([[3, 2]], "i8")
+    pre_scores = np.array([[-1.0, -2.0]], "f")
+    scores = np.log(np.array([[[0.1, 0.6, 0.2, 0.1],
+                               [0.7, 0.1, 0.1, 0.1]]], "f"))
+    o = lower("beam_search", {"pre_ids": [pre_ids],
+                              "pre_scores": [pre_scores],
+                              "scores": [scores]},
+              {"beam_size": 2, "end_id": 0})
+    ids = np.asarray(o["selected_ids"][0])
+    parents = np.asarray(o["parent_idx"][0])
+    sc = np.asarray(o["selected_scores"][0])
+    # best: beam0 + token1 (-1+log.6=-1.51); then beam1+tok0 (-2+log.7)
+    np.testing.assert_array_equal(ids[0], [1, 0])
+    np.testing.assert_array_equal(parents[0], [0, 1])
+    assert sc[0, 0] > sc[0, 1]
+
+    # decode: T=2 chain with a CROSSED final parent hop (the case a
+    # one-hop-early backtrace gets wrong): final beam 0's token is 1,
+    # whose parent at step 1 is beam 1, so its step-0 token is 6
+    all_ids = np.array([[[5, 6]], [[1, 0]]], "i8")       # [T, b, bw]
+    all_parents = np.array([[[0, 1]], [[1, 0]]], "i4")
+    d = lower("beam_search_decode", {"Ids": [all_ids],
+                                     "ParentIdx": [all_parents]})
+    sent = np.asarray(d["SentenceIds"][0])
+    assert sent.shape == (2, 1, 2)
+    np.testing.assert_array_equal(sent[:, 0, 0], [6, 1])
+    np.testing.assert_array_equal(sent[:, 0, 1], [5, 0])
+
+
+def test_ctc_align():
+    x = np.array([[1, 1, 0, 2, 2, 3]], "i4")
+    o = lower("ctc_align", {"Input": [x]},
+              {"blank": 0, "merge_repeated": True, "padding_value": 0})
+    out = np.asarray(o["Output"][0])
+    ln = np.asarray(o["OutputLength"][0])
+    np.testing.assert_array_equal(out[0, :3], [1, 2, 3])
+    assert int(ln[0, 0]) == 3
+
+
+def test_chunk_eval_iob():
+    # tags: type0: B=0 I=1; O=2. seq: B0 I0 O B0 -> 2 chunks
+    lab = np.array([[0, 1, 2, 0]], "i4")
+    inf_perfect = lab.copy()
+    o = lower("chunk_eval", {"Inference": [inf_perfect], "Label": [lab]},
+              {"num_chunk_types": 1})
+    assert float(np.asarray(o["F1-Score"][0])) == 1.0
+    assert int(np.asarray(o["NumLabelChunks"][0])) == 2
+    # miss one chunk
+    inf_miss = np.array([[0, 1, 2, 2]], "i4")
+    o2 = lower("chunk_eval", {"Inference": [inf_miss], "Label": [lab]},
+               {"num_chunk_types": 1})
+    assert int(np.asarray(o2["NumCorrectChunks"][0])) == 1
+    assert int(np.asarray(o2["NumInferChunks"][0])) == 1
+
+
+def test_psroi_pool():
+    # C=1 output channel, 2x2 bins -> input channels = 4
+    x = np.zeros((1, 4, 4, 4), "f")
+    for ch in range(4):
+        x[0, ch] = ch + 1          # each position-sensitive plane constant
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "f")
+    o = np.asarray(lower("psroi_pool", {"X": [x], "ROIs": [rois]},
+                         {"pooled_height": 2, "pooled_width": 2,
+                          "output_channels": 1,
+                          "spatial_scale": 1.0})["Out"][0])
+    # bin (i,j) pools plane i*2+j -> values 1,2,3,4
+    np.testing.assert_allclose(o[0, 0], [[1, 2], [3, 4]], rtol=1e-5)
+
+
+def test_average_accumulates_rolls():
+    p = np.ones((2,), "f")
+    z = np.zeros((2,), "f")
+    o = lower("average_accumulates",
+              {"param": [p], "in_sum_1": [z], "in_sum_2": [z],
+               "in_sum_3": [z],
+               "in_num_accumulates": [np.array([0], "i8")],
+               "in_old_num_accumulates": [np.array([0], "i8")],
+               "in_num_updates": [np.array([0], "i8")]},
+              {"average_window": 0.5, "max_average_window": 2,
+               "min_average_window": 1})
+    np.testing.assert_allclose(np.asarray(o["out_sum_1"][0]), p)
+    assert int(np.asarray(o["out_num_updates"][0])) == 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

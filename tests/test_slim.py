@@ -167,3 +167,59 @@ def test_distillation_soft_label():
             (lv,) = exe.run(s_main, feed=x, fetch_list=[loss])
             losses.append(float(np.ravel(lv)[0]))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+@pytest.mark.parametrize("algo", ["abs_max", "KL"])
+def test_post_training_quantization_roundtrip(algo):
+    """PTQ int8: calibrate on held-out batches, quantize, and require the
+    int8 predictor's accuracy within 10 points of fp32 (reference:
+    contrib int8_inference calibration flow)."""
+    from paddle_tpu.contrib.slim import PostTrainingQuantization
+
+    rng = np.random.RandomState(0)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        loss, logits = _conv_net()
+        pt.optimizer.Adam(5e-3).minimize(loss)
+
+    # an easily-separable synthetic task: the class is the brightest of
+    # three horizontal bands
+    def make_feed(b=32):
+        x = rng.randn(b, 1, 8, 8).astype(np.float32)
+        bands = np.stack([x[:, 0, 0:3].mean((1, 2)),
+                          x[:, 0, 3:6].mean((1, 2)),
+                          x[:, 0, 6:8].mean((1, 2))], axis=1)
+        y = bands.argmax(1)[:, None].astype(np.int64)
+        return {"img": x, "label": y}
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(60):
+            exe.run(main, feed=make_feed(), fetch_list=[loss])
+
+        # fp32 accuracy
+        infer = main.clone(for_test=True)
+        test_feed = make_feed(256)
+
+        def acc(prog):
+            lv, = exe.run(prog, feed={"img": test_feed["img"],
+                                      "label": test_feed["label"]},
+                          fetch_list=[logits])
+            return (np.asarray(lv).argmax(1)[:, None]
+                    == test_feed["label"]).mean()
+
+        fp32_acc = acc(infer)
+        assert fp32_acc > 0.5, fp32_acc  # the net actually learned
+
+        ptq = PostTrainingQuantization(
+            exe, main, ["img"], [logits], scope=scope, algo=algo)
+        qprog = ptq.quantize([make_feed() for _ in range(4)])
+        # the quantized program carries real int8 round trips
+        assert any(op.type == "quantize" for op in qprog.global_block.ops)
+        int8_acc = acc(qprog)
+        assert int8_acc >= fp32_acc - 0.10, (fp32_acc, int8_acc)
+        # calibration metadata is recorded for export
+        assert qprog._quant_act_thresholds
+        assert qprog._quant_weight_scales
