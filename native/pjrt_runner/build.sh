@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the standalone PJRT inference runner.
+#   native/pjrt_runner/build.sh [out_binary]
+set -e
+cd "$(dirname "$0")"
+OUT="${1:-pjrt_runner}"
+g++ -O2 -std=c++17 -I. pjrt_runner.cc -ldl -o "$OUT"
+echo "built $OUT"

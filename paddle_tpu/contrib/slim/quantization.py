@@ -343,7 +343,8 @@ class PostTrainingQuantization:
                         shape=blk.var(src).shape)
                     q_op = type(op)(blk, "quantize", {"Input": [src]},
                                     {"Output": [qv.name]},
-                                    {"Scale": qmax_a / t})
+                                    {"Scale": qmax_a / t,
+                                     "qmax": qmax_a})
                     d_op = type(op)(blk, "dequantize",
                                     {"Input": [qv.name]},
                                     {"Output": [dv.name]},

@@ -21,7 +21,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
-           "export_stablehlo", "load_stablehlo", "PredictorPool"]
+           "export_stablehlo", "load_stablehlo", "export_native",
+           "PredictorPool"]
 
 
 class Config:
@@ -126,15 +127,11 @@ class PredictorPool:
 # StableHLO deployment artifact
 # ---------------------------------------------------------------------------
 
-def export_stablehlo(model_dir: str, out_path: str,
-                     batch_size: int = 1) -> str:
-    """Compile the saved inference model for a fixed batch size and write a
-    portable serialized StableHLO artifact (jax.export). Params are BAKED
-    into the artifact as constants — the deployment story of the
-    reference's engine subgraph serialization. Returns out_path."""
+def _load_exportable(model_dir: str, batch_size: int):
+    """Shared export prologue: load the saved model, snapshot params, and
+    build (entry_fn, feed specs, feed names, output block)."""
     import jax
     import jax.numpy as jnp
-    from jax import export as jexport
     from .. import io
     from ..framework.executor import (Executor, Scope, scope_guard,
                                       as_jax_function)
@@ -152,12 +149,26 @@ def export_stablehlo(model_dir: str, out_path: str,
     specs = []
     for n in feed_names:
         v = blk.var(n)
-        shape = tuple(batch_size if d == -1 else d for d in v.shape)
+        shape = tuple(int(batch_size) if d == -1 else int(d)
+                      for d in v.shape)
         specs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype)))
 
     def entry(*feeds):
         return fn(params, dict(zip(feed_names, feeds)))
 
+    return entry, specs, feed_names, blk
+
+
+def export_stablehlo(model_dir: str, out_path: str,
+                     batch_size: int = 1) -> str:
+    """Compile the saved inference model for a fixed batch size and write a
+    portable serialized StableHLO artifact (jax.export). Params are BAKED
+    into the artifact as constants — the deployment story of the
+    reference's engine subgraph serialization. Returns out_path."""
+    import jax
+    from jax import export as jexport
+
+    entry, specs, _, _ = _load_exportable(model_dir, batch_size)
     exported = jexport.export(jax.jit(entry))(*specs)
     data = exported.serialize()
     with open(out_path, "wb") as f:
@@ -171,3 +182,39 @@ def load_stablehlo(path: str):
     with open(path, "rb") as f:
         exported = jexport.deserialize(f.read())
     return exported.call
+
+
+def export_native(model_dir: str, out_dir: str, batch_size: int = 1) -> str:
+    """Export for the C++ PJRT runner (native/pjrt_runner): writes
+    `model.mlir` (StableHLO, params baked as constants),
+    `compile_options.pb` (serialized xla CompileOptions) and
+    `manifest.json` (I/O names, shapes, dtypes). The runner dlopens any
+    PJRT C-API plugin (libtpu, CPU, the axon tunnel) and serves the
+    model without Python — the reference's C++ inference/train demo
+    story (reference: paddle/fluid/train/demo, inference/api).
+    Returns out_dir."""
+    import json
+    import os as _os
+    import jax
+    from jax._src import compiler as _compiler
+
+    entry, specs, feed_names, blk = _load_exportable(model_dir, batch_size)
+    inputs_meta = [{"name": n, "shape": [int(d) for d in sp.shape],
+                    "dtype": str(sp.dtype)}
+                   for n, sp in zip(feed_names, specs)]
+    lowered = jax.jit(entry).lower(*specs)
+    mlir_text = lowered.as_text(dialect="stablehlo")
+    outs_meta = [{"shape": [int(d) for d in o.shape],
+                  "dtype": str(o.dtype)}
+                 for o in jax.eval_shape(entry, *specs)]
+
+    _os.makedirs(out_dir, exist_ok=True)
+    with open(_os.path.join(out_dir, "model.mlir"), "w") as f:
+        f.write(mlir_text)
+    opts = _compiler.get_compile_options(num_replicas=1, num_partitions=1)
+    with open(_os.path.join(out_dir, "compile_options.pb"), "wb") as f:
+        f.write(opts.SerializeAsString())
+    with open(_os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump({"inputs": inputs_meta, "outputs": outs_meta}, f,
+                  indent=1)
+    return out_dir

@@ -310,22 +310,17 @@ def bpr_loss(input, label, name=None):
 def dice_loss(input, label, epsilon=1e-5):
     """Composed as in the reference layer (one-hot label overlap)."""
     from . import math as m
-    from . import tensor as t
     from . import nn as nn_
+    from . import tensor as t
     label_oh = nn_.one_hot(label, input.shape[-1])
     inter = m.reduce_sum(m.elementwise_mul(input, label_oh), dim=[-1])
     union = m.elementwise_add(m.reduce_sum(input, dim=[-1]),
                               m.reduce_sum(label_oh, dim=[-1]))
-    num = t.scale(inter, scale=2.0, bias=0.0)
-    den = t_scale_bias(union, epsilon)
+    num = m.scale(inter, scale=2.0)
+    den = m.scale(union, scale=1.0, bias=epsilon)
     return m.elementwise_sub(
         t.fill_constant_batch_size_like(num, [-1], "float32", 1.0),
         m.elementwise_div(num, den))
-
-
-def t_scale_bias(v, bias):
-    from . import math as m
-    return m.scale(v, scale=1.0, bias=bias)
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
@@ -414,8 +409,6 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     from . import tensor as t
     helper = LayerHelper(name or "data_norm")
     d = input.shape[-1]
-    bs = helper.create_parameter(None, [d],
-                                 default_initializer=None)
     # batch stat accumulators start at (counts=1e4, sum=0, sq=1e4) as in
     # the reference's summary-style init
     from ..initializer import Constant
@@ -669,14 +662,18 @@ def ctc_greedy_decoder(input, blank, input_length=None, name=None):
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):
-    from . import tensor as t
-    from . import math as m
+    """reference layers/nn.py autoincreased_step_counter: a persistable
+    counter incremented IN PLACE each run; first read returns `begin`."""
+    from ..framework.layer_helper import ParamAttr
+    from ..initializer import Constant
     helper = LayerHelper(counter_name or "step_counter")
     counter = helper.create_parameter(
-        None, [1], dtype="int64")
+        ParamAttr(name=f"{helper.name}.counter",
+                  initializer=Constant(float(begin - step)),
+                  trainable=False), [1], dtype="int64")
     counter.stop_gradient = True
-    inc = _simple("increment", {"X": [counter.name]}, {"step": float(step)},
-                  dtype="int64")
+    helper.append_op("increment", {"X": [counter.name]},
+                     {"Out": [counter.name]}, {"step": float(step)})
     return counter
 
 

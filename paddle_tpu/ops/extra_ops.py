@@ -73,9 +73,15 @@ def _selu(ctx, ins, attrs):
 
 @register_op("crop", no_grad_inputs={"Y", "Offsets"})
 def _crop(ctx, ins, attrs):
-    """reference: crop_op.cc — crop X to `shape` starting at `offsets`."""
+    """reference: crop_op.cc — crop X to `shape` starting at `offsets`
+    (attr list, or the runtime Offsets input tensor)."""
     x = ins["X"][0]
-    shape = attrs.get("shape") or list(ins["Y"][0].shape)
+    shape = [int(s) for s in (attrs.get("shape")
+                              or list(ins["Y"][0].shape))]
+    if "Offsets" in ins:
+        off = ins["Offsets"][0].reshape(-1).astype(jnp.int32)
+        starts = [off[i] for i in range(x.ndim)]
+        return {"Out": [jax.lax.dynamic_slice(x, starts, shape)]}
     offsets = attrs.get("offsets") or [0] * x.ndim
     idx = tuple(slice(int(o), int(o) + int(s))
                 for o, s in zip(offsets, shape))
@@ -417,8 +423,11 @@ def _dgc_clip_by_norm(ctx, ins, attrs):
 def _quantize(ctx, ins, attrs):
     scale = attrs.get("Scale", 1.0)
     shift = attrs.get("Shift", 0.0)
+    # qmax < 127 (sub-8-bit simulation) must SATURATE at its own grid
+    # edge, not at int8's
+    qmax = float(attrs.get("qmax", 127))
     x = ins["Input"][0]
-    q = jnp.clip(jnp.round(x * scale + shift), -128, 127)
+    q = jnp.clip(jnp.round(x * scale + shift), -qmax - 1, qmax)
     return {"Output": [q.astype(jnp.int8)]}
 
 

@@ -172,11 +172,15 @@ def _beam_search_decode(ctx, ins, attrs):
     ids = ins["Ids"][0].astype(jnp.int64)
     parents = ins["ParentIdx"][0].astype(jnp.int64)
     scores = ins.get("Scores", [None])[0]
-    out = get_op_def("gather_tree").lower(
-        ctx, {"Ids": [ids], "Parents": [parents]}, {})["Out"][0]
+    gt = get_op_def("gather_tree").lower
+    out = gt(ctx, {"Ids": [ids], "Parents": [parents]}, {})["Out"][0]
     res = {"SentenceIds": [out.astype(jnp.int64)]}
     if scores is not None:
-        res["SentenceScores"] = [scores]
+        # scores ride the SAME parent pointers as the ids — emitting them
+        # un-backtraced would misalign score[t] with the token actually
+        # on that beam's path
+        res["SentenceScores"] = [gt(
+            ctx, {"Ids": [scores], "Parents": [parents]}, {})["Out"][0]]
     return res
 
 
@@ -227,7 +231,9 @@ def _chunk_eval(ctx, ins, attrs):
         raise NotImplementedError("chunk_eval supports the IOB scheme")
     other = num_types * 2  # the O tag
 
-    def starts(seq, valid):
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+
+    def starts(seq):
         ty = seq // 2
         is_b = (seq % 2 == 0) & (seq < other)
         prev = jnp.concatenate([jnp.full((b, 1), other, jnp.int32),
@@ -239,35 +245,42 @@ def _chunk_eval(ctx, ins, attrs):
         start = is_b | (is_i & (~prev_in_chunk | (prev_ty != ty)))
         return start & valid, ty
 
-    valid = jnp.arange(t)[None, :] < lengths[:, None]
     inf_in = (inf < other) & valid
     lab_in = (lab < other) & valid
-    inf_st, inf_ty = starts(inf, valid)
-    lab_st, lab_ty = starts(lab, valid)
+    inf_st, inf_ty = starts(inf)
+    lab_st, lab_ty = starts(lab)
 
-    # a chunk matches if start positions align, types equal, and the
-    # full extent agrees; approximate extent check: every position in
-    # the chunk has identical (in_chunk, type) in both sequences
-    same = (inf_in == lab_in) & ((inf_ty == lab_ty) | ~lab_in)
-    # suffix-AND until chunk end: scan right-to-left within chunks
-    def chunk_ok(st, in_mask):
-        # position belongs to same chunk until next start/exit
-        ok = same & in_mask
-        # cumulative check: a chunk is correct iff min over its span
-        # compute via segmented min using starts as boundaries
-        seg_id = jnp.cumsum(st.astype(jnp.int32), axis=1)
-        # for each segment, all ok?
-        max_seg = t + 1
-        def per_row(ok_r, seg_r, in_r):
-            acc = jnp.ones((max_seg,), bool).at[0].set(True)
-            acc = acc.at[seg_r].min(ok_r | ~in_r)
-            return acc[seg_r] & in_r
-        return jax.vmap(per_row)(ok, seg_id, in_mask)
+    # A label chunk [s, e) is matched iff:
+    #   (1) inference starts a chunk of the same type exactly at s,
+    #   (2) every position in [s, e) is inside an inference chunk of
+    #       the same type with no inference chunk boundary inside,
+    #   (3) the inference chunk ENDS at e too (no extension past e).
+    agree = inf_in & lab_in & (inf_ty == lab_ty) & ~(inf_st & ~lab_st)
+    nxt_in = jnp.concatenate([inf_in[:, 1:],
+                              jnp.zeros((b, 1), bool)], axis=1)
+    nxt_st = jnp.concatenate([inf_st[:, 1:],
+                              jnp.zeros((b, 1), bool)], axis=1)
+    nxt_lab_in = jnp.concatenate([lab_in[:, 1:],
+                                  jnp.zeros((b, 1), bool)], axis=1)
+    nxt_lab_st = jnp.concatenate([lab_st[:, 1:],
+                                  jnp.zeros((b, 1), bool)], axis=1)
+    lab_end = lab_in & (~nxt_lab_in | nxt_lab_st)      # chunk's last pos
+    ext_bad = lab_end & nxt_in & ~nxt_st               # inf runs past e
+    ok_pos = jnp.where(lab_in, agree & ~ext_bad, True)
 
-    lab_chunk_ok = chunk_ok(lab_st, lab_in)
-    correct = (lab_st & jnp.take_along_axis(
-        lab_chunk_ok, jnp.arange(t)[None, :], axis=1) &
-        inf_st & (inf_ty == lab_ty)).sum()
+    seg_id = jnp.cumsum(lab_st.astype(jnp.int32), axis=1)  # 1-based
+    max_seg = t + 1
+
+    def per_row(ok_r, seg_r, in_r):
+        acc = jnp.ones((max_seg,), bool)
+        acc = acc.at[jnp.where(in_r, seg_r, max_seg - 1)].min(
+            jnp.where(in_r, ok_r, True), mode="drop")
+        return acc
+
+    chunk_ok = jax.vmap(per_row)(ok_pos, seg_id, lab_in)  # [b, max_seg]
+    start_ok = lab_st & inf_st & (inf_ty == lab_ty)
+    correct = (start_ok & jnp.take_along_axis(chunk_ok, seg_id,
+                                              axis=1)).sum()
     num_inf = inf_st.sum()
     num_lab = lab_st.sum()
     p = correct / jnp.maximum(num_inf, 1)

@@ -127,6 +127,36 @@ def test_sampled_softmax_trains():
     assert ls[-1] < ls[0]
 
 
+def test_dice_loss_builds_and_computes():
+    def build():
+        x = pt.layers.data("x", [3, 4], append_batch_size=False)
+        l = pt.layers.data("l", [3, 1], dtype="int64",
+                           append_batch_size=False)
+        sm = pt.layers.softmax(x)
+        return [pt.layers.dice_loss(sm, l)]
+
+    rng = np.random.RandomState(7)
+    out, = _run(build, {"x": rng.randn(3, 4).astype("f"),
+                        "l": rng.randint(0, 4, (3, 1)).astype("i8")})
+    assert out.shape[0] == 3
+    assert np.isfinite(out).all()
+    assert ((out >= 0) & (out <= 1)).all()
+
+
+def test_autoincreased_step_counter():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        counter = pt.layers.autoincreased_step_counter(begin=1, step=1)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        vals = [int(np.ravel(exe.run(main, feed={},
+                                     fetch_list=[counter])[0])[0])
+                for _ in range(3)]
+    # increments IN PLACE across runs; first read returns `begin`
+    assert vals == [1, 2, 3], vals
+
+
 def test_image_resize_and_grid():
     x = np.random.RandomState(5).rand(1, 2, 4, 4).astype("f")
 

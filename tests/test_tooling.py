@@ -1,0 +1,61 @@
+"""Profiling/benchmark tooling: timeline exporter + op microbench
+(reference: tools/timeline.py, operators/benchmark/op_tester.cc)."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_timeline_export_chrome_trace():
+    prof_dir = tempfile.mkdtemp()
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [64])
+        y = pt.layers.fc(x, 64, act="relu")
+        loss = pt.layers.reduce_mean(y)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        with pt.profiler.profiler(profile_path=prof_dir):
+            for _ in range(2):
+                exe.run(main,
+                        feed={"x": np.random.rand(8, 64).astype("f")},
+                        fetch_list=[loss])
+    out = os.path.join(prof_dir, "timeline.json")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import timeline
+    timeline.convert(prof_dir, out)
+    d = json.load(open(out))
+    ev = d["traceEvents"] if isinstance(d, dict) else d
+    assert len(ev) > 10
+
+
+def test_op_bench_single_op():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import op_bench
+    ms, nbytes = op_bench.bench_op("relu", {"X": (64, 64)}, steps=3)
+    assert ms > 0
+    assert nbytes == 64 * 64 * 4
+
+
+def test_op_bench_cli():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/op_bench.py"),
+         "softmax", "X:32x64"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert "softmax" in r.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -1,0 +1,64 @@
+"""Chrome-trace exporter for paddle_tpu profiler captures.
+
+Reference: tools/timeline.py renders the profiler proto + CUPTI device
+events as chrome://tracing JSON. Here the capture is a jax.profiler
+xplane directory (written by paddle_tpu.profiler.profiler()); this tool
+converts it with xprof's trace_viewer converter so the merged host+TPU
+timeline opens in chrome://tracing or Perfetto.
+
+Usage:
+  python tools/timeline.py --profile_path /tmp/paddle_tpu_prof \
+                           --timeline_path /tmp/timeline.json
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_xplane(profile_dir: str) -> str:
+    pats = [os.path.join(profile_dir, "plugins/profile/*/*.xplane.pb"),
+            os.path.join(profile_dir, "**/*.xplane.pb")]
+    for pat in pats:
+        hits = sorted(glob.glob(pat, recursive=True))
+        if hits:
+            return hits[-1]  # latest capture
+    raise FileNotFoundError(
+        f"no xplane.pb under {profile_dir}; run paddle_tpu.profiler."
+        "profiler() around the code to trace first")
+
+
+def convert(profile_dir: str, out_path: str) -> str:
+    xplane = find_xplane(profile_dir)
+    from xprof.convert import raw_to_tool_data
+
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [xplane], "trace_viewer", {})
+    if isinstance(data, bytes):
+        try:
+            data = gzip.decompress(data)
+        except OSError:
+            pass
+        data = data.decode("utf-8", errors="replace")
+    # normalize: chrome tracing accepts either the array or the object
+    # form; pretty-check it parses before writing
+    json.loads(data)
+    with open(out_path, "w") as f:
+        f.write(data)
+    return out_path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile_path", default="/tmp/paddle_tpu_prof")
+    ap.add_argument("--timeline_path", default="/tmp/timeline.json")
+    args = ap.parse_args(argv)
+    out = convert(args.profile_path, args.timeline_path)
+    print(f"wrote {out} — open in chrome://tracing or ui.perfetto.dev")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
