@@ -89,7 +89,6 @@ std::vector<TensorMeta> ParseSection(const std::string& js,
   std::vector<TensorMeta> out;
   size_t sec = js.find("\"" + section + "\"");
   if (sec == std::string::npos) return out;
-  size_t end = js.find("]", js.find("[", sec));  // first ']' is inside
   // find the section's closing bracket by bracket counting
   size_t open = js.find("[", sec);
   int depth = 0;
@@ -101,7 +100,6 @@ std::vector<TensorMeta> ParseSection(const std::string& js,
       break;
     }
   }
-  (void)end;
   std::string body = js.substr(open, close - open + 1);
   size_t pos = 0;
   while (true) {
@@ -265,6 +263,24 @@ int main(int argc, char** argv) {
   Check(g_api->PJRT_Client_Compile(&comp), "compile");
   PJRT_LoadedExecutable* exec = comp.executable;
   std::printf("compiled %zu-byte StableHLO\n", mlir.size());
+
+  // the executable's REAL output count must match the manifest — PJRT
+  // fills output_lists[0][i] for every executable output, so a stale
+  // manifest would otherwise overflow the buffer array
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.extension_start = nullptr;
+  ge.loaded_executable = exec;
+  Check(g_api->PJRT_LoadedExecutable_GetExecutable(&ge), "get executable");
+  PJRT_Executable_NumOutputs_Args no;
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.extension_start = nullptr;
+  no.executable = ge.executable;
+  Check(g_api->PJRT_Executable_NumOutputs(&no), "num outputs");
+  if (no.num_outputs != out_meta.size())
+    Die("manifest lists " + std::to_string(out_meta.size()) +
+        " outputs but the executable produces " +
+        std::to_string(no.num_outputs) + " — regenerate the artifact");
 
   // ---- stage inputs --------------------------------------------------------
   std::vector<std::string> raw(in_meta.size());

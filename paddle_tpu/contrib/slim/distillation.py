@@ -10,7 +10,7 @@ from ... import layers
 from ...framework.core import Operator, Parameter, Program
 
 __all__ = ["merge_teacher_program", "soft_label_loss", "l2_distill_loss",
-           "fsp_loss"]
+           "fsp_loss", "multi_teacher_soft_label_loss"]
 
 
 def merge_teacher_program(teacher: Program, student: Program,
@@ -76,3 +76,24 @@ def fsp_loss(s_in, s_out, t_in, t_out):
         return layers.scale(g, scale=1.0 / float(hw))
 
     return layers.mean(layers.square(_fsp(s_in, s_out) - _fsp(t_in, t_out)))
+
+
+def multi_teacher_soft_label_loss(student_logits, teacher_logits_list,
+                                  weights=None, temperature: float = 1.0):
+    """Weighted ensemble distillation over several teachers (reference:
+    slim's multi-teacher DistillationStrategy): mean of per-teacher
+    soft-label KLs, weighted by `weights` (uniform by default)."""
+    if not teacher_logits_list:
+        raise ValueError("need at least one teacher")
+    if weights is None:
+        weights = [1.0 / len(teacher_logits_list)] * len(teacher_logits_list)
+    if len(weights) != len(teacher_logits_list):
+        raise ValueError("one weight per teacher")
+    total = None
+    for w, t_logits in zip(weights, teacher_logits_list):
+        term = layers.scale(
+            soft_label_loss(student_logits, t_logits, temperature),
+            scale=float(w))
+        total = term if total is None else layers.elementwise_add(total,
+                                                                  term)
+    return total

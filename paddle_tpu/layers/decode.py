@@ -18,7 +18,8 @@ from typing import Callable, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["greedy_decode", "beam_search_decode"]
+__all__ = ["greedy_decode", "beam_search_decode",
+           "beam_search_decode_on_device"]
 
 
 def greedy_decode(step_logits: Callable[[np.ndarray], np.ndarray],
@@ -109,3 +110,89 @@ def beam_search_decode(step_logits: Callable[[np.ndarray], np.ndarray],
         seqs = np.take_along_axis(seqs, order[:, :, None], axis=1)
         scores = np.take_along_axis(scores, order, axis=-1)
     return seqs, scores
+
+
+def beam_search_decode_on_device(step_logits, batch_size: int,
+                                 beam_size: int, bos_id: int, eos_id: int,
+                                 max_len: int,
+                                 length_penalty: float = 0.0):
+    """ON-DEVICE beam search: the whole decode loop is ONE jitted XLA
+    computation (lax.fori_loop over steps + gather_tree backtrace) — no
+    per-step host round trip. Through the TPU tunnel each host-loop step
+    costs ~66ms RTT (BASELINE.md); this variant pays one dispatch total.
+
+    step_logits must be a JAX-traceable fn(tokens [b*k, max_len+1],
+    t: int32 scalar) -> [b*k, V] next-token logits for the prefix
+    tokens[:, :t+1] (static padded shape; use `t` for masking).
+    Returns (sequences [b, beam, max_len], scores [b, beam]) best-first,
+    matching the host-loop beam_search_decode.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, k = batch_size, beam_size
+    L = max_len
+    neg_inf = -1e9
+
+    def decode():
+        tokens0 = jnp.full((b * k, L + 1), eos_id, jnp.int32)
+        tokens0 = tokens0.at[:, 0].set(bos_id)
+        # only beam 0 live initially (identical prefixes must not
+        # multiply through top-k)
+        scores0 = jnp.where(jnp.arange(k)[None, :] == 0, 0.0, neg_inf)
+        scores0 = jnp.broadcast_to(scores0, (b, k))
+        ids_stack0 = jnp.zeros((L, b, k), jnp.int32)
+        par_stack0 = jnp.zeros((L, b, k), jnp.int32)
+        fin0 = jnp.zeros((b, k), jnp.bool_)
+
+        def body(t, carry):
+            tokens, scores, ids_stack, par_stack, finished = carry
+            logits = step_logits(tokens, t)          # [b*k, V]
+            v = logits.shape[-1]
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32)).reshape(b, k, v)
+            # finished beams only extend with eos at zero cost
+            only_eos = jnp.full((b, k, v), neg_inf).at[:, :, eos_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], only_eos, logp)
+            total = scores[:, :, None] + logp        # [b, k, v]
+            flat = total.reshape(b, k * v)
+            top_s, top_i = jax.lax.top_k(flat, k)    # [b, k]
+            parent = (top_i // v).astype(jnp.int32)
+            tok = (top_i % v).astype(jnp.int32)
+            # reorder token prefixes to the selected parents
+            tokens = tokens.reshape(b, k, L + 1)
+            tokens = jnp.take_along_axis(
+                tokens, parent[:, :, None], axis=1).reshape(b * k, L + 1)
+            tokens = tokens.at[:, t + 1].set(tok.reshape(-1))
+            finished = jnp.take_along_axis(finished, parent, axis=1) | \
+                (tok == eos_id)
+            ids_stack = ids_stack.at[t].set(tok)
+            par_stack = par_stack.at[t].set(parent)
+            return tokens, top_s, ids_stack, par_stack, finished
+
+        tokens, scores, ids_stack, par_stack, _ = jax.lax.fori_loop(
+            0, L, body, (tokens0, scores0, ids_stack0, par_stack0, fin0))
+
+        # gather_tree backtrace (same recurrence as the op)
+        def back(beams, ti):
+            out = jnp.take_along_axis(ids_stack[ti], beams, axis=-1)
+            nxt = jnp.take_along_axis(par_stack[ti], beams, axis=-1)
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        _, outs = jax.lax.scan(back, init, jnp.arange(L - 1, -1, -1))
+        seqs = jnp.flip(outs, axis=0).transpose(1, 2, 0)  # [b, k, L]
+
+        if length_penalty > 0.0:
+            # same formula as the host-loop variant above: plain
+            # len**p over non-eos tokens (clipped at 1)
+            lengths = jnp.maximum(
+                (seqs != eos_id).sum(-1), 1).astype(jnp.float32)
+            scores = scores / (lengths ** length_penalty)
+        order = jnp.argsort(-scores, axis=1)
+        seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        return seqs, scores
+
+    seqs, scores = jax.jit(decode)()
+    return np.asarray(seqs), np.asarray(scores)

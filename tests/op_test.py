@@ -98,6 +98,16 @@ class OpTest(unittest.TestCase):
     def check_grad(self, inputs_to_check, output_names,
                    max_relative_error=0.005, numeric_grad_delta=5e-3,
                    no_grad_set=None):
+        """Numeric-vs-analytic gradients through the EXECUTOR path.
+
+        This path is f32 by construction (the TPU pipeline), so delta
+        5e-3 / rel-err 5e-3 are set to bound f32 central-difference
+        truncation for O(1) inputs — tighter deltas would measure f32
+        rounding, not gradient error (the reference checks at f64,
+        op_test.py:46). The f64 rule-level checks (delta 1e-6, tol
+        1e-5) live in tests/test_grad_x64.py, which bypasses the
+        executor and runs the same lowering rules under jax x64.
+        """
         if isinstance(output_names, str):
             output_names = [output_names]
         main, startup, feed, out_map, loss = self._build(

@@ -223,3 +223,97 @@ def test_post_training_quantization_roundtrip(algo):
         # calibration metadata is recorded for export
         assert qprog._quant_act_thresholds
         assert qprog._quant_weight_scales
+
+
+def test_sensitive_pruner_allocates_by_sensitivity():
+    """SensitivePruner must prune the insensitive layer harder than the
+    sensitive one at the same global sparsity target."""
+    from paddle_tpu.contrib.slim.prune import SensitivePruner, apply_masks
+
+    rng = np.random.RandomState(0)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [16])
+        y = pt.layers.data("y", [1])
+        # h1 carries the signal (sensitive); h2 is a parallel junk path
+        h1 = pt.layers.fc(x, 16, param_attr=pt.ParamAttr(name="w_live"),
+                          bias_attr=False)
+        h2 = pt.layers.fc(x, 16, param_attr=pt.ParamAttr(name="w_junk"),
+                          bias_attr=False)
+        pred = pt.layers.fc(h1 + pt.layers.scale(h2, scale=1e-4), 1,
+                            bias_attr=False)
+        loss = pt.layers.mean(pt.layers.square(pred - y))
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    xs = rng.randn(64, 16).astype("f")
+    ys = (xs.sum(1, keepdims=True) * 0.1).astype("f")
+    with pt.scope_guard(scope):
+        exe.run(startup)
+
+        def eval_fn():
+            l, = exe.run(main, feed={"x": xs, "y": ys},
+                         fetch_list=[loss])
+            return float(np.ravel(l)[0])
+
+        sp = SensitivePruner()
+        masks, alloc = sp.prune(main, scope, ["w_live", "w_junk"],
+                                eval_fn, target_ratio=0.4)
+    # global sparsity near target and junk pruned at least as hard
+    total = sum(m.size for m in masks.values())
+    pruned = sum((~m).sum() for m in masks.values())
+    assert 0.2 <= pruned / total <= 0.75, pruned / total
+    assert alloc["w_junk"] >= alloc["w_live"]
+
+
+def test_multi_teacher_distillation_trains():
+    from paddle_tpu.contrib.slim.distillation import (
+        merge_teacher_program, multi_teacher_soft_label_loss)
+
+    rng = np.random.RandomState(1)
+
+    def teacher_prog(seed):
+        prog, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(prog, startup):
+            x = pt.layers.data("x", [8])
+            # explicit names: auto-named params would collide with the
+            # student's own fc params (same unique-name counters) and
+            # alias donated buffers in the scope
+            logits = pt.layers.fc(
+                x, 4, param_attr=pt.ParamAttr(name=f"tw{seed}"),
+                bias_attr=pt.ParamAttr(name=f"tb{seed}"))
+        return prog, startup, logits
+
+    t1, t1s, t1_logits = teacher_prog(1)
+    t2, t2s, t2_logits = teacher_prog(2)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8])
+        s_logits = pt.layers.fc(x, 4)
+        m1 = merge_teacher_program(t1, main, prefix="t1_")
+        m2 = merge_teacher_program(t2, main, prefix="t2_")
+        tv1 = main.global_block.var(m1[t1_logits.name])
+        tv2 = main.global_block.var(m2[t2_logits.name])
+        loss = multi_teacher_soft_label_loss(
+            s_logits, [tv1, tv2], temperature=2.0)
+        pt.optimizer.Adam(1e-2).minimize(loss)
+
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(t1s)
+        exe.run(t2s)
+        # teacher startup vars init under unprefixed names; copy them to
+        # the merged (prefixed) names
+        from paddle_tpu.framework.executor import global_scope
+        sc = global_scope()
+        for prog, prefix in ((t1, "t1_"), (t2, "t2_")):
+            for v in prog.all_parameters():
+                sc.set_var(prefix + v.name, sc.find_var(v.name))
+        feed = {"x": rng.randn(16, 8).astype("f")}
+        ls = [float(np.ravel(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0])[0])
+              for _ in range(15)]
+    assert np.isfinite(ls).all()
+    assert ls[-1] < ls[0]

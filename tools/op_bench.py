@@ -68,7 +68,12 @@ def bench_op(op_type, in_shapes, attrs=None, steps=30, dtype="float32"):
         ctx = LowerContext(rng_key=jax.random.PRNGKey(0))
         outs = opdef.lower(ctx, xs, attrs)
         first_out = next(iter(outs.values()))[0]
-        return jnp.real(jnp.ravel(first_out)[0]).astype(jnp.float32) * 0
+        # depend on the WHOLE output: a single-element slice would let
+        # XLA sink the slice through elementwise ops and dead-code the
+        # benchmarked computation (verified in compiled HLO). The 1e-30
+        # scale keeps a true data dependency (x*0 could legally fold)
+        # while keeping the chain value negligible.
+        return jnp.sum(jnp.real(first_out)).astype(jnp.float32) * 1e-30
 
     jrun = jax.jit(run)
     chain = jnp.zeros((), jnp.float32)
