@@ -117,10 +117,9 @@ class SensitivePruner:
         return ratios_for(hi)
 
     def prune(self, program, scope, params: Sequence[str], eval_fn,
-              target_ratio: float) -> Dict[str, np.ndarray]:
-        """Returns the masks; per-param ratios are recorded on the
-        returned dict as `.ratios` metadata via attribute-free return:
-        (masks, ratios) tuple."""
+              target_ratio: float):
+        """Returns (masks, per_param_ratios) — masks feed apply_masks();
+        the ratio dict records what the sensitivity allocation chose."""
         curves = self.pruner.sensitivity(program, scope, params, eval_fn,
                                          self.ratios)
         sizes = {n: int(np.asarray(scope.find_var(n)).size)

@@ -21,6 +21,10 @@ import numpy as np
 __all__ = ["greedy_decode", "beam_search_decode",
            "beam_search_decode_on_device"]
 
+# compiled on-device decoders, keyed by (step_fn, shape/config) — a
+# fresh jit per call would re-trace the whole L-step loop every time
+_ON_DEVICE_CACHE = {}
+
 
 def greedy_decode(step_logits: Callable[[np.ndarray], np.ndarray],
                   batch_size: int, bos_id: int, eos_id: int,
@@ -134,6 +138,13 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
     L = max_len
     neg_inf = -1e9
 
+    cache_key = (step_logits, b, k, bos_id, eos_id, L,
+                 float(length_penalty))
+    cached = _ON_DEVICE_CACHE.get(cache_key)
+    if cached is not None:
+        seqs, scores = cached()
+        return np.asarray(seqs), np.asarray(scores)
+
     def decode():
         tokens0 = jnp.full((b * k, L + 1), eos_id, jnp.int32)
         tokens0 = tokens0.at[:, 0].set(bos_id)
@@ -173,15 +184,13 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
         tokens, scores, ids_stack, par_stack, _ = jax.lax.fori_loop(
             0, L, body, (tokens0, scores0, ids_stack0, par_stack0, fin0))
 
-        # gather_tree backtrace (same recurrence as the op)
-        def back(beams, ti):
-            out = jnp.take_along_axis(ids_stack[ti], beams, axis=-1)
-            nxt = jnp.take_along_axis(par_stack[ti], beams, axis=-1)
-            return nxt, out
-
-        init = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
-        _, outs = jax.lax.scan(back, init, jnp.arange(L - 1, -1, -1))
-        seqs = jnp.flip(outs, axis=0).transpose(1, 2, 0)  # [b, k, L]
+        # backtrace with the registered gather_tree lowering (one
+        # implementation shared with the host-loop variant)
+        from ..framework.registry import get_op_def, LowerContext
+        seqs = get_op_def("gather_tree").lower(
+            LowerContext(), {"Ids": [ids_stack],
+                             "Parents": [par_stack]}, {})["Out"][0]
+        seqs = seqs.transpose(1, 2, 0)                    # [b, k, L]
 
         if length_penalty > 0.0:
             # same formula as the host-loop variant above: plain
@@ -194,5 +203,7 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
         scores = jnp.take_along_axis(scores, order, axis=1)
         return seqs, scores
 
-    seqs, scores = jax.jit(decode)()
+    jitted = jax.jit(decode)
+    _ON_DEVICE_CACHE[cache_key] = jitted
+    seqs, scores = jitted()
     return np.asarray(seqs), np.asarray(scores)

@@ -191,3 +191,61 @@ def label_semantic_roles(word_vocab: int, label_num: int, seq_len: int,
     loss = layers.reduce_sum(ce) / (layers.reduce_sum(mask) + 1e-9)
     return {"feed": ["word", "predicate", "mark", "target", "lens"],
             "loss": loss, "logits": logits}
+
+
+# ---------------------------------------------------------------------------
+# rnn_encoder_decoder (reference: tests/book/test_rnn_encoder_decoder.py —
+# the plain seq2seq book model whose encoder AND decoder are built with
+# the step-wise RNN DSL rather than fused rnn ops)
+# ---------------------------------------------------------------------------
+
+def rnn_encoder_decoder(src_vocab: int, tgt_vocab: int, src_len: int,
+                        tgt_len: int, emb_dim: int = 32, hidden: int = 64):
+    """Teacher-forced seq2seq where both sides are StaticRNN step blocks
+    (the reference builds these with fluid's StaticRNN/DynamicRNN DSL;
+    here each StaticRNN lowers to one differentiable lax.scan — see
+    ops/control_flow_ops.py `recurrent`)."""
+    src = layers.data("src", [src_len], dtype="int64")
+    tgt_in = layers.data("tgt_in", [tgt_len], dtype="int64")
+    tgt_out = layers.data("tgt_out", [tgt_len], dtype="int64")
+    tgt_lens = layers.data("tgt_lens", [1], dtype="int64")
+
+    src_emb = layers.embedding(src, size=[src_vocab, emb_dim])
+    src_tm = layers.transpose(src_emb, [1, 0, 2])      # [T, b, d]
+    b_like = layers.reduce_sum(src_emb, dim=[1, 2], keep_dim=False)
+    boot = layers.fill_constant_batch_size_like(
+        layers.unsqueeze(b_like, axes=[1]), [-1, hidden], "float32", 0.0)
+
+    enc_rnn = layers.StaticRNN()
+    with enc_rnn.step():
+        x_t = enc_rnn.step_input(src_tm)
+        prev = enc_rnn.memory(init=boot)
+        h = layers.fc(input=[x_t, prev], size=hidden, act="tanh")
+        enc_rnn.update_memory(prev, h)
+        enc_rnn.step_output(h)
+    enc_states = enc_rnn()                             # [T, b, h]
+    enc_final = layers.reshape(
+        layers.slice(enc_states, axes=[0], starts=[src_len - 1],
+                     ends=[src_len]), [-1, hidden])
+
+    tgt_emb = layers.embedding(tgt_in, size=[tgt_vocab, emb_dim])
+    tgt_tm = layers.transpose(tgt_emb, [1, 0, 2])
+    dec_rnn = layers.StaticRNN()
+    with dec_rnn.step():
+        y_t = dec_rnn.step_input(tgt_tm)
+        prev = dec_rnn.memory(init=enc_final)
+        h = layers.fc(input=[y_t, prev], size=hidden, act="tanh")
+        dec_rnn.update_memory(prev, h)
+        dec_rnn.step_output(h)
+    dec_states = dec_rnn()                             # [T, b, h]
+    dec_bm = layers.transpose(dec_states, [1, 0, 2])   # [b, T, h]
+    logits = layers.fc(dec_bm, tgt_vocab, num_flatten_dims=2)
+
+    ce = layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(tgt_out, axes=[2]))
+    tgt_mask = layers.sequence_mask(layers.squeeze(tgt_lens, axes=[1]),
+                                    maxlen=tgt_len)
+    ce = layers.squeeze(ce, axes=[2]) * tgt_mask
+    loss = layers.reduce_sum(ce) / (layers.reduce_sum(tgt_mask) + 1e-9)
+    return {"feed": ["src", "tgt_in", "tgt_out", "tgt_lens"],
+            "loss": loss, "logits": logits}

@@ -666,3 +666,138 @@ def _deformable_conv(ctx, ins, attrs):
     else:
         out = jax.vmap(one_image)(x, offset, mask)
     return {"Output": [out]}
+
+
+@register_op("tree_conv", no_grad_inputs={"EdgeSet"})
+def _tree_conv(ctx, ins, attrs):
+    """reference: tree_conv_op.h + math/tree2col — tree-based convolution
+    (TBCNN). EdgeSet [b, E, 2] int (1-based parent->child, 0-padded),
+    NodesVector [b, n, F], Filter [F, 3, out, filters]. Each node u
+    gathers its subtree patch to max_depth; patch member v contributes
+    feat_v weighted by (eta_t, eta_l, eta_r) from its (depth, sibling
+    index, sibling count). Dense redesign: adjacency matrix powers give
+    per-(u, v) depths — no host traversal."""
+    edges = ins["EdgeSet"][0].astype(jnp.int32)
+    feats = ins["NodesVector"][0]
+    filt = ins["Filter"][0]
+    max_depth = int(attrs.get("max_depth", 2))
+    b, e, _ = edges.shape
+    n = feats.shape[1]
+    f_dim, _, out_size, n_filters = filt.shape
+    w2 = filt.reshape(f_dim * 3, out_size * n_filters)
+    d = float(max_depth)
+
+    def one(eset, x):
+        u, v = eset[:, 0], eset[:, 1]
+        valid = (u > 0) & (v > 0)
+        # sibling rank (1-based, in edge order) and per-parent child count
+        same_parent = (u[None, :] == u[:, None]) & valid[None, :] \
+            & valid[:, None]
+        earlier = jnp.tril(jnp.ones((e, e), bool), k=-1)
+        index = (same_parent & earlier).sum(1) + 1          # [e]
+        pclen_e = same_parent.sum(1)
+        idx_node = jnp.zeros((n + 1,), jnp.int32).at[
+            jnp.where(valid, v, n)].set(index.astype(jnp.int32),
+                                        mode="drop")
+        pcl_node = jnp.ones((n + 1,), jnp.int32).at[
+            jnp.where(valid, v, n)].set(pclen_e.astype(jnp.int32),
+                                        mode="drop")
+        # adjacency (1-based ids); depth(u,v) via boolean matrix powers
+        adj = jnp.zeros((n + 1, n + 1), bool).at[
+            jnp.where(valid, u, n), jnp.where(valid, v, n)].set(
+            True, mode="drop")
+        depth = jnp.where(jnp.eye(n + 1, dtype=bool), 0, -1)
+        reach = jnp.eye(n + 1, dtype=bool)
+        for k in range(1, max_depth):
+            reach = (reach.astype(jnp.float32) @ adj.astype(
+                jnp.float32)) > 0
+            depth = jnp.where((depth < 0) & reach, k, depth)
+        in_patch = depth >= 0                              # [n+1, n+1]
+        dep = depth.astype(jnp.float32)
+        eta_t = jnp.where(in_patch, (d - dep) / d, 0.0)
+        is_root = jnp.eye(n + 1, dtype=bool)
+        idx_f = idx_node.astype(jnp.float32)[None, :]
+        pcl_f = pcl_node.astype(jnp.float32)[None, :]
+        temp = jnp.where(pcl_f == 1, 0.5,
+                         (idx_f - 1.0) / jnp.maximum(pcl_f - 1.0, 1.0))
+        temp = jnp.where(is_root, 0.5, temp)  # root: index=1, pclen=1
+        eta_l = (1.0 - eta_t) * temp
+        eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+        w3 = jnp.stack([eta_t, eta_l, eta_r], axis=-1)     # [n+1,n+1,3]
+        w3 = jnp.where(in_patch[:, :, None], w3, 0.0)
+        # nodes (1-based) -> features; node 0 is the padding id
+        xpad = jnp.concatenate([jnp.zeros((1,) + x.shape[1:], x.dtype),
+                                x], axis=0)                # [n+1, F]
+        patch = jnp.einsum("uvt,vf->uft", w3, xpad)        # [n+1, F, 3]
+        out = patch.reshape(n + 1, f_dim * 3) @ w2
+        # valid roots: nodes that appear in any edge (plus node 1)
+        seen = jnp.zeros((n + 1,), bool).at[
+            jnp.where(valid, u, 0)].set(True).at[
+            jnp.where(valid, v, 0)].set(True).at[1].set(True).at[0].set(
+            False)
+        out = jnp.where(seen[:, None], out, 0.0)
+        return out[1:].reshape(n, out_size, n_filters)
+
+    return {"Out": [jax.vmap(one)(edges, feats)]}
+
+
+@register_op("attention_lstm",
+             no_grad_inputs={"SeqLen"},
+             non_diff_outputs={"Cell"})
+def _attention_lstm(ctx, ins, attrs):
+    """reference: attention_lstm_op.cc — per step, a 1-unit attention fc
+    over the whole sequence (conditioned on the previous cell state)
+    pools the inputs, which feed a peephole-less LSTM. Dense redesign:
+    X [b, T, M] + SeqLen [b]; outputs Hidden/Cell [b, T, D]."""
+    x = ins["X"][0]
+    seq_len = ins["SeqLen"][0].reshape(-1).astype(jnp.int32) \
+        if "SeqLen" in ins else None
+    c0 = ins["C0"][0]
+    h0 = ins.get("H0", [None])[0]
+    atten_w = ins["AttentionWeight"][0].reshape(-1)     # [M+D]
+    atten_b = ins.get("AttentionBias", [None])[0]
+    atten_scalar = ins.get("AttentionScalar", [None])[0]
+    atten_scalar_b = ins.get("AttentionScalarBias", [None])[0]
+    lstm_w = ins["LSTMWeight"][0]                       # [D+M, 4D]
+    lstm_b = ins["LSTMBias"][0].reshape(-1)             # [4D]
+    b, t, m = x.shape
+    dd = c0.shape[1]
+    _ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+             "relu": jax.nn.relu, "identity": lambda v: v}
+    act_gate = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    act_cell = _ACTS[attrs.get("cell_activation", "tanh")]
+    act_cand = _ACTS[attrs.get("candidate_activation", "tanh")]
+    if h0 is None:
+        h0 = jnp.zeros((b, dd), x.dtype)
+    if seq_len is None:
+        seq_len = jnp.full((b,), t, jnp.int32)
+    mask = jnp.arange(t)[None, :] < seq_len[:, None]    # [b, T]
+
+    atted = jnp.einsum("btm,m->bt", x, atten_w[:m])
+    if atten_b is not None:
+        atted = atted + atten_b.reshape(())
+
+    def step(carry, ti):
+        h_prev, c_prev = carry
+        sc = jax.nn.relu(atted + (c_prev @ atten_w[m:])[:, None])
+        if atten_scalar is not None:
+            sc = sc * atten_scalar.reshape(())
+            if atten_scalar_b is not None:
+                sc = jax.nn.relu(sc + atten_scalar_b.reshape(()))
+        sc = jnp.where(mask, sc, -1e20)
+        a = jax.nn.softmax(sc, axis=1)                  # [b, T]
+        pooled = jnp.einsum("bt,btm->bm", a, x)
+        gates = pooled @ lstm_w[dd:] + h_prev @ lstm_w[:dd] \
+            + lstm_b[None, :]
+        g = act_gate(gates[:, :3 * dd])
+        cand = act_cand(gates[:, 3 * dd:])
+        c_new = g[:, :dd] * c_prev + g[:, dd:2 * dd] * cand
+        h_new = act_cell(c_new) * g[:, 2 * dd:3 * dd]
+        active = (ti < seq_len)[:, None]
+        c_new = jnp.where(active, c_new, c_prev)
+        h_new = jnp.where(active, h_new, h_prev)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(t))
+    return {"Hidden": [hs.transpose(1, 0, 2)],
+            "Cell": [cs.transpose(1, 0, 2)]}

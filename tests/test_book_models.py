@@ -401,3 +401,39 @@ def test_beam_search_decode_transformer():
     assert acc_greedy > 0.9, acc_greedy
     assert acc_beam >= acc_greedy - 1e-6, (acc_beam, acc_greedy)
     assert (np.diff(scores, axis=-1) <= 1e-5).all()  # best-first
+
+
+def test_rnn_encoder_decoder_trains_via_static_rnn():
+    """The book seq2seq whose encoder AND decoder are StaticRNN step
+    blocks (reference tests/book/test_rnn_encoder_decoder.py) — exercises
+    differentiable `recurrent` scan ops inside a full training graph."""
+    src_vocab, tgt_vocab, Ts, Tt = 40, 40, 6, 5
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        model = book.rnn_encoder_decoder(src_vocab, tgt_vocab, Ts, Tt)
+        pt.optimizer.Adam(5e-3).minimize(model["loss"])
+    # both RNNs must be recurrent macro ops in the IR
+    rec_ops = [op for op in main.global_block.ops
+               if op.type == "recurrent"]
+    assert len(rec_ops) == 2
+
+    rng = np.random.RandomState(0)
+    exe = pt.Executor()
+
+    def feed(b=16):
+        src = rng.randint(1, src_vocab, (b, Ts)).astype("i8")
+        # copy task: target repeats the source prefix
+        tgt = np.concatenate(
+            [src[:, :1] * 0 + 1, src[:, :Tt - 1]], axis=1).astype("i8")
+        tgt_out = src[:, :Tt].astype("i8")
+        lens = np.full((b, 1), Tt, "i8")
+        return {"src": src, "tgt_in": tgt, "tgt_out": tgt_out,
+                "tgt_lens": lens}
+
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        f = feed()
+        losses = [float(np.ravel(exe.run(main, feed=f,
+                                         fetch_list=[model["loss"]])[0])[0])
+                  for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])

@@ -595,3 +595,74 @@ def test_average_accumulates_rolls():
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_tree_conv_single_edge_tree():
+    """Two-node tree 1->2, max_depth 2: verify the TBCNN eta weights
+    against the reference formulas by hand."""
+    F, OUT, NF = 2, 3, 1
+    edges = np.array([[[1, 2]]], "i4")            # [b=1, E=1, 2]
+    feats = np.zeros((1, 2, F), "f")
+    feats[0, 0] = [1.0, 0.0]                      # node 1
+    feats[0, 1] = [0.0, 1.0]                      # node 2
+    filt = np.zeros((F, 3, OUT, NF), "f")
+    # filter picks out (feature, eta) pairs one at a time
+    filt[0, 0, 0, 0] = 1.0   # f0 * eta_t -> out0
+    filt[1, 0, 1, 0] = 1.0   # f1 * eta_t -> out1
+    filt[1, 1, 2, 0] = 1.0   # f1 * eta_l -> out2
+    o = np.asarray(lower("tree_conv",
+                         {"EdgeSet": [edges], "NodesVector": [feats],
+                          "Filter": [filt]},
+                         {"max_depth": 2})["Out"][0])
+    assert o.shape == (1, 2, OUT, NF)
+    d = 2.0
+    # root node 1's patch: itself (eta_t=1) + child node 2 at depth 1
+    # (eta_t=(2-1)/2=0.5; index=1, pclen=1 -> temp=0.5, eta_l=0.25)
+    np.testing.assert_allclose(o[0, 0, 0, 0], 1.0, rtol=1e-5)   # f0*1
+    np.testing.assert_allclose(o[0, 0, 1, 0], 0.5, rtol=1e-5)   # f1*0.5
+    np.testing.assert_allclose(o[0, 0, 2, 0], 0.25, rtol=1e-5)  # f1*0.25
+    # node 2's patch: only itself as root (eta_t=1, eta_l=0)
+    np.testing.assert_allclose(o[0, 1, 1, 0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(o[0, 1, 2, 0], 0.0, atol=1e-6)
+
+
+def test_attention_lstm_shapes_and_masking():
+    rng = np.random.RandomState(0)
+    B, T, M, D = 2, 5, 4, 3
+    x = rng.randn(B, T, M).astype("f") * 0.3
+    lens = np.array([5, 3], "i4")
+    o = lower("attention_lstm", {
+        "X": [x], "SeqLen": [lens],
+        "C0": [np.zeros((B, D), "f")],
+        "AttentionWeight": [rng.randn(M + D, 1).astype("f") * 0.3],
+        "LSTMWeight": [rng.randn(D + M, 4 * D).astype("f") * 0.3],
+        "LSTMBias": [np.zeros((1, 4 * D), "f")]})
+    h = np.asarray(o["Hidden"][0])
+    c = np.asarray(o["Cell"][0])
+    assert h.shape == (B, T, D) and c.shape == (B, T, D)
+    assert np.isfinite(h).all()
+    # past row 1's length the state freezes
+    np.testing.assert_allclose(h[1, 3], h[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(h[1, 4], h[1, 2], rtol=1e-6)
+    assert not np.allclose(h[0, 4], h[0, 2])
+
+
+def test_tree_conv_two_children_sibling_order():
+    """Edges [[1,2],[1,3]]: node 2 is the FIRST child (index 1 ->
+    temp 0, eta_l 0, eta_r 0.5), node 3 the second (temp 1 ->
+    eta_l 0.5, eta_r 0.25) — the reference tree2col sibling order."""
+    F, OUT, NF = 1, 2, 1
+    edges = np.array([[[1, 2], [1, 3]]], "i4")
+    feats = np.zeros((1, 3, F), "f")
+    feats[0, 1] = [1.0]                    # node 2 carries the signal
+    filt = np.zeros((F, 3, OUT, NF), "f")
+    filt[0, 1, 0, 0] = 1.0                 # eta_l -> out0
+    filt[0, 2, 1, 0] = 1.0                 # eta_r -> out1
+    o = np.asarray(lower("tree_conv",
+                         {"EdgeSet": [edges], "NodesVector": [feats],
+                          "Filter": [filt]},
+                         {"max_depth": 2})["Out"][0])
+    # root's patch sees node 2 with eta_t=0.5: eta_l=(1-.5)*0=0,
+    # eta_r=(1-.5)*(1-0)=0.5
+    np.testing.assert_allclose(o[0, 0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(o[0, 0, 1, 0], 0.5, rtol=1e-5)
