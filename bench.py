@@ -32,7 +32,7 @@ def main():
     cfg = BertConfig(attn_impl=os.environ.get("BENCH_ATTN", "einsum"))  # BERT-base
     seq = int(os.environ.get("BENCH_SEQ", 128))
     batch = int(os.environ.get("BENCH_BATCH", 128))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     peak = float(os.environ.get("PEAK_TFLOPS", 197.0)) * 1e12
 
     amp = os.environ.get("BENCH_AMP", "1") == "1"

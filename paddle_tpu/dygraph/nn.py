@@ -14,9 +14,11 @@ import numpy as np
 from .base import VarBase, to_variable, trace_op
 from .layers import Layer
 
-__all__ = ["Conv2D", "Conv2DTranspose", "Pool2D", "FC", "Linear",
+__all__ = ["Conv2D", "Conv2DTranspose", "Conv3D", "Conv3DTranspose",
+           "Pool2D", "FC", "Linear",
            "BatchNorm", "Embedding", "LayerNorm", "GroupNorm", "PRelu",
-           "GRUUnit", "Dropout",
+           "GRUUnit", "Dropout", "BilinearTensorProduct", "NCE",
+           "RowConv", "SequenceConv", "SpectralNorm", "TreeConv",
            "relu", "sigmoid", "tanh", "softmax", "dropout", "reshape",
            "concat", "reduce_mean", "reduce_sum", "mean", "cross_entropy",
            "softmax_with_cross_entropy", "accuracy", "pool2d", "log_softmax"]
@@ -404,3 +406,210 @@ def pool2d(x, pool_size=2, pool_type="max", pool_stride=2, pool_padding=0,
                      "strides": _pair(pool_stride),
                      "paddings": _pair(pool_padding),
                      "global_pooling": global_pooling})["Out"][0]
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct."""
+
+    def __init__(self, input1_dim: int, input2_dim: int, output_dim: int,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "bilinear_tensor_product", dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], dtype, param_attr)
+        self.bias = self.create_parameter([1, output_dim], dtype,
+                                          bias_attr, is_bias=True)
+
+    def forward(self, x: VarBase, y: VarBase) -> VarBase:
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _act(trace_op("bilinear_tensor_product", ins,
+                             {})["Out"][0], self._act)
+
+
+class Conv3D(Layer):
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "conv3d", dtype)
+        self._act = act
+
+        def _triple(v):
+            return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+        fs = _triple(filter_size)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        from ..initializer import Normal
+        std = float(np.sqrt(2.0 / (fs[0] * fs[1] * fs[2] * num_channels)))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fs, dtype, param_attr,
+            default_initializer=Normal(0.0, std))
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = trace_op("conv3d", {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        return _act(out, self._act)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, num_channels: int, num_filters: int, filter_size,
+                 stride=1, padding=0, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope or "conv3d_transpose", dtype)
+        self._act = act
+
+        def _triple(v):
+            return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+        fs = _triple(filter_size)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding)}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters] + fs, dtype, param_attr)
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = trace_op("conv3d_transpose",
+                       {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        return _act(out, self._act)
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py NCE (noise-contrastive estimation loss)."""
+
+    def __init__(self, num_total_classes: int, dim: int,
+                 num_neg_samples: int = 10, param_attr=None,
+                 bias_attr=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope or "nce", dtype)
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples}
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], dtype, param_attr)
+        self.bias = self.create_parameter([num_total_classes], dtype,
+                                          bias_attr, is_bias=True)
+
+    def forward(self, input: VarBase, label: VarBase) -> VarBase:
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return trace_op("nce", ins, self._attrs)["Cost"][0]
+
+
+class RowConv(Layer):
+    """reference dygraph/nn.py RowConv (lookahead row convolution)."""
+
+    def __init__(self, future_context_size: int, dim: int,
+                 param_attr=None, act=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope or "row_conv", dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [future_context_size, dim], dtype, param_attr)
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _act(trace_op("row_conv",
+                             {"X": [x], "Filter": [self.weight]},
+                             {})["Out"][0], self._act)
+
+
+class SequenceConv(Layer):
+    """reference dygraph/nn.py SequenceConv (context-window conv over
+    padded sequences; pass lengths to zero padded steps)."""
+
+    def __init__(self, dim: int, num_filters: int,
+                 filter_size: int = 3, filter_stride: int = 1,
+                 padding=None, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope or "sequence_conv", dtype)
+        self._act = act
+        self._attrs = {"context_length": filter_size,
+                       "context_start": -(filter_size // 2)}
+        self.weight = self.create_parameter(
+            [filter_size * dim, num_filters], dtype, param_attr)
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x: VarBase, lengths: Optional[VarBase] = None):
+        ins = {"X": [x], "Filter": [self.weight]}
+        if lengths is not None:
+            ins["XLength"] = [lengths]
+        out = trace_op("sequence_conv", ins, self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 2})["Out"][0]
+        return _act(out, self._act)
+
+
+class SpectralNorm(Layer):
+    """reference dygraph/nn.py SpectralNorm (power-iteration weight
+    normalization)."""
+
+    def __init__(self, weight_shape, dim: int = 0, power_iters: int = 1,
+                 eps: float = 1e-12, dtype="float32", name_scope=None):
+        super().__init__(name_scope or "spectral_norm", dtype)
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        # u/v are power-iteration STATE, not trainable weights
+        # (reference keeps them as persistable non-trainable vars)
+        self.weight_u = self.register_buffer(
+            "weight_u", VarBase(rng.randn(h).astype(dtype)))
+        self.weight_v = self.register_buffer(
+            "weight_v", VarBase(rng.randn(w).astype(dtype)))
+
+    def forward(self, weight: VarBase) -> VarBase:
+        outs = trace_op("spectral_norm",
+                        {"Weight": [weight], "U": [self.weight_u],
+                         "V": [self.weight_v]}, self._attrs)
+        # persist the power iteration so sigma converges across steps
+        if "UOut" in outs:
+            self.weight_u.value = outs["UOut"][0].value
+            self.weight_v.value = outs["VOut"][0].value
+        return outs["Out"][0]
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py TreeConv (TBCNN tree convolution)."""
+
+    def __init__(self, feature_size: int, output_size: int,
+                 num_filters: int = 1, max_depth: int = 2, act=None,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope or "tree_conv", dtype)
+        self._act = act
+        self._attrs = {"max_depth": max_depth}
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], dtype, param_attr)
+        self.bias = self.create_parameter(
+            [output_size, num_filters], dtype, bias_attr, is_bias=True)
+
+    def forward(self, nodes_vector: VarBase, edge_set: VarBase) -> VarBase:
+        out = trace_op("tree_conv",
+                       {"NodesVector": [nodes_vector],
+                        "EdgeSet": [edge_set],
+                        "Filter": [self.weight]}, self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 2})["Out"][0]
+        return _act(out, self._act)

@@ -7,7 +7,7 @@ __all__ = ["sequence_mask", "sequence_pool", "sequence_softmax",
            "sequence_reverse", "sequence_expand", "sequence_concat",
            "sequence_last_step", "sequence_first_step", "sequence_slice",
            "sequence_enumerate", "sequence_erase", "sequence_pad",
-           "sequence_unpad"]
+           "sequence_unpad", "sequence_conv"]
 
 
 def _op(helper_name, op_type, ins, outs_spec, attrs=None, dtypes=None):
@@ -103,3 +103,27 @@ def sequence_unpad(x, length, name=None):
     return _op("sequence_unpad", "sequence_unpad",
                {"X": [x.name], "Length": [length.name]}, ["Out"], {},
                {"Out": x.dtype})
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None, lengths=None):
+    """Context-window convolution over padded sequences (reference:
+    layers/nn.py sequence_conv over LoD input; here [b, T, d] + optional
+    lengths zeroing the padded steps)."""
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper(name or "sequence_conv")
+    d = input.shape[-1]
+    filt = helper.create_parameter(param_attr,
+                                   [filter_size * d, num_filters])
+    ins = {"X": [input.name], "Filter": [filt.name]}
+    if lengths is not None:
+        ins["XLength"] = [lengths.name]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_conv", ins, {"Out": [out.name]},
+                     {"context_length": filter_size,
+                      "context_start": -(filter_size // 2)})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], is_bias=True)
+        out = helper.append_bias_op(out, b, dim_start=2)
+    return helper.append_activation(out, act)

@@ -169,3 +169,37 @@ def _sequence_erase(ctx, ins, attrs):
     tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
     hit = jnp.isin(x, tokens)
     return {"Out": [jnp.where(hit, jnp.zeros((), x.dtype), x)]}
+
+
+@register_op("sequence_conv", no_grad_inputs={"XLength"})
+def _sequence_conv(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_conv_op.cc — context-window conv:
+    each step's feature is the concat of `context_length` neighbors
+    (starting at context_start) projected by Filter
+    [context_length * d, out]. Dense redesign: X [b, T, d] (+ XLength
+    for zeroing padded steps)."""
+    x = ins["X"][0]
+    filt = ins["Filter"][0]
+    clen = int(attrs.get("context_length", 3))
+    cstart = int(attrs.get("context_start", -(clen // 2)))
+    lengths = ins.get("XLength", [None])[0]
+    b, t, d = x.shape
+    if lengths is not None:
+        lengths = lengths.reshape(-1).astype(jnp.int32)
+        mask = (jnp.arange(t)[None, :] < lengths[:, None])
+        x = jnp.where(mask[:, :, None], x, 0.0)
+    cols = []
+    for k in range(clen):
+        off = cstart + k
+        if off < 0:
+            sl = jnp.pad(x, ((0, 0), (-off, 0), (0, 0)))[:, :t]
+        elif off > 0:
+            sl = jnp.pad(x[:, off:], ((0, 0), (0, off), (0, 0)))
+        else:
+            sl = x
+        cols.append(sl)
+    ctx_feat = jnp.concatenate(cols, axis=-1)       # [b, T, clen*d]
+    out = jnp.einsum("btc,co->bto", ctx_feat, filt)
+    if lengths is not None:
+        out = jnp.where(mask[:, :, None], out, 0.0)
+    return {"Out": [out]}
