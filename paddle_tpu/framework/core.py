@@ -18,6 +18,7 @@ from __future__ import annotations
 import copy
 import json
 import threading
+import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -406,6 +407,10 @@ class Program:
         self._seed: Optional[int] = None
         self.random_seed = 0
         self._pipeline = None  # PipelineMeta when PipelineOptimizer is used
+        # Identity for executor compile-cache keys. id(program) would alias a
+        # freed Program with a new one at the same address (stale-executable
+        # class of bug); a uuid cannot collide across object lifetimes.
+        self._uid = uuid.uuid4().hex
 
     # -- mutation tracking ---------------------------------------------------
     def _bump_version(self):

@@ -212,7 +212,7 @@ class Executor:
             reads = list(op.input_names())
             if any(k in op.attrs for k in macro_attrs):
                 # a macro op's outputs are also implicit reads: while carries
-                # state in, cond_block's untaken branch passes values through
+                # state in, conditional_block's untaken branch passes values through
                 reads += op.output_names()
             for n in reads:
                 if n not in written_so_far and n not in sub_local:
@@ -244,7 +244,8 @@ class Executor:
             return tuple(a.shape), str(a.dtype)
 
         feed_sig = tuple(sorted((k,) + _sig(v) for k, v in feed.items()))
-        cache_key = (id(program), program.version, feed_sig,
+        cache_key = (getattr(program, "_uid", id(program)), program.version,
+                     feed_sig,
                      tuple(fetch_names), tuple(mutable), tuple(readonly),
                      id(dist_plan) if dist_plan else None)
         compiled = self._cache.get(cache_key)

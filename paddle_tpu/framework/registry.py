@@ -91,12 +91,16 @@ def get_op_def(op_type: str) -> OpDef:
 _MACROS: Dict[str, Callable] = {}
 
 
-def register_macro_op(op_type: str, **opdef_kw):
+def register_macro_op(op_type: str, aliases: Sequence[str] = (), **opdef_kw):
+    """aliases: extra op-type names sharing this lowering — reference-IR
+    compatibility names (e.g. conditional_block_infer is the inference-time
+    registration of the same kernel, controlflow/conditional_block_infer_op.cc)."""
     def deco(fn):
-        _MACROS[op_type] = fn
         opdef_kw.setdefault("not_differentiable",
                             "grad_maker" not in opdef_kw)
-        _REGISTRY[op_type] = OpDef(type=op_type, lower=None, **opdef_kw)
+        for name in (op_type,) + tuple(aliases):
+            _MACROS[name] = fn
+            _REGISTRY[name] = OpDef(type=name, lower=None, **opdef_kw)
         return fn
     return deco
 

@@ -170,7 +170,7 @@ def cond(pred: Variable, true_fn, false_fn, name=None):
     from ..ops.control_flow_ops import _block_outer_reads
     reads = _block_outer_reads(program, tb)
     reads += [n for n in _block_outer_reads(program, fb) if n not in reads]
-    parent.append_op("cond_block",
+    parent.append_op("conditional_block",
                      {"Cond": [pred.name], "X": reads},
                      {"Out": [o.name for o in outs]},
                      {"sub_block_t": tb.idx, "sub_block_f": fb.idx,
@@ -226,7 +226,7 @@ class Switch:
         condition, sub, parent = self._inside
         from ..framework.core import _prog_state
         _prog_state.current_block_idx = parent.idx
-        # hoist case body as a cond_block writing the assigned outer vars
+        # hoist case body as a conditional_block writing the assigned outer vars
         writes = _outer_writes(sub)
         if condition is None:
             # default: execute only if no prior case matched — build the
@@ -242,7 +242,7 @@ class Switch:
             for op in sub.ops:
                 parent.ops.append(op)
             return
-        # guarded: cond_block whose false branch returns current values
+        # guarded: conditional_block whose false branch returns current values
         fb = default_main_program().create_block()
         t_rets = writes
         f_rets = writes  # false branch: pass through outer values
@@ -250,7 +250,7 @@ class Switch:
         program = default_main_program()
         reads = _block_outer_reads(program, sub)
         reads += [n for n in writes if n not in reads]
-        parent.append_op("cond_block",
+        parent.append_op("conditional_block",
                          {"Cond": [condition.name], "X": reads},
                          {"Out": writes},
                          {"sub_block_t": sub.idx, "sub_block_f": fb.idx,

@@ -33,6 +33,7 @@ per-iteration keys, so dropout masks etc. reproduce bit-exactly.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.registry import register_macro_op, lower_op, LowerContext
 from ..framework.core import GRAD_SUFFIX
@@ -295,7 +296,7 @@ def _cond_grad_maker(op, block, no_grad_set):
     if not diff:
         return []
     return [{
-        "type": "cond_block_grad",
+        "type": "conditional_block_grad",
         "inputs": {"X": diff,
                    "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX
                                          for n in op.output("Out")]},
@@ -308,7 +309,8 @@ def _cond_grad_maker(op, block, no_grad_set):
     }]
 
 
-@register_macro_op("cond_block", grad_maker=_cond_grad_maker)
+@register_macro_op("conditional_block", grad_maker=_cond_grad_maker,
+                   aliases=("cond_block", "conditional_block_infer"))
 def _cond_block(ctx, op, env):
     """Two-branch conditional: attrs sub_block_t / sub_block_f; outputs Out
     are filled from attr-listed branch result names (true_rets/false_rets)."""
@@ -348,7 +350,7 @@ def _cond_block(ctx, op, env):
         env[n] = v
 
 
-@register_macro_op("cond_block_grad")
+@register_macro_op("conditional_block_grad", aliases=("cond_block_grad",))
 def _cond_block_grad(ctx, op, env):
     program = op.block.program
     tb = program.blocks[op.attrs["sub_block_t"]]
@@ -519,3 +521,119 @@ def _recurrent_grad(ctx, op, env):
     kept = [g for n, g in zip(out_names, gnames)
             if n in env and _is_inexact(env[n])]
     _vjp_into_env(op, env, f, primals, kept)
+
+
+# ---------------------------------------------------------------------------
+# reference-IR boundary + tensor-array ops (controlflow/ in the reference)
+# ---------------------------------------------------------------------------
+
+@register_macro_op("feed", grad_free=True)
+def _feed(ctx, op, env):
+    """reference: controlflow/feed_op.cc — copy feed-holder column into the
+    target var. Our executor binds feeds by NAME before tracing, so when a
+    reference-shaped program carries explicit feed ops the target is
+    already in env; this lowering just validates that."""
+    out = op.output("Out")[0]
+    if out not in env:
+        raise RuntimeError(
+            f"feed op targets {out!r} but no feed was bound for it; pass "
+            f"feed={{{out!r}: value}} to Executor.run")
+
+
+@register_macro_op("fetch", grad_free=True)
+def _fetch(ctx, op, env):
+    """reference: controlflow/fetch_op.cc — expose a var for fetching.
+    Fetching here is by name via fetch_list; make the fetch-holder name an
+    alias of the value so either name works."""
+    out = op.output("Out")[0]
+    x = op.input("X")[0]
+    if x in env:
+        env[out] = env[x]
+
+
+@register_macro_op("get_places", grad_free=True)
+def _get_places(ctx, op, env):
+    """reference: controlflow/get_places_op.cc — enumerate devices. TPU
+    analog: the device ids of the active mesh (or the process-visible
+    device list outside a mesh) as an int32 vector."""
+    import jax
+
+    n = int(op.attrs.get("device_count", 0) or 0)
+    if n == 0:
+        n = (int(np.prod(list(ctx.mesh.shape.values())))
+             if ctx.mesh is not None else jax.device_count())
+    env[op.output("Out")[0]] = jnp.arange(n, dtype=jnp.int32)
+
+
+@register_macro_op("write_to_array", grad_free=True)
+def _write_to_array(ctx, op, env):
+    """reference: controlflow/tensor_array_read_write_op.cc WriteToArrayOp.
+    A tensor array is a python tuple in the trace env (lod_array_ops.py);
+    the subscript I must be trace-time static — inside loops, the recurrent
+    (scan) macro is the TPU-native form of array-building RNNs."""
+    arr = list(env.get(op.output("Out")[0], ()))
+    i = _static_index(op, op.input("I")[0], env, "write_to_array")
+    x = env[op.input("X")[0]]
+    if i == len(arr):
+        arr.append(x)
+    elif i < len(arr):
+        arr[i] = x
+    else:  # sparse write: pad the gap like the reference's resize
+        arr.extend([jnp.zeros_like(x)] * (i - len(arr)) + [x])
+    env[op.output("Out")[0]] = tuple(arr)
+
+
+@register_macro_op("read_from_array", grad_free=True)
+def _read_from_array(ctx, op, env):
+    """reference: controlflow/tensor_array_read_write_op.cc ReadFromArrayOp."""
+    arr = env[op.input("X")[0]]
+    i = _static_index(op, op.input("I")[0], env, "read_from_array")
+    env[op.output("Out")[0]] = arr[i]
+
+
+def _const_fold_int(block, name, upto_idx, memo=None):
+    """Build-time evaluation of an int scalar var: walk the block backwards
+    from position upto_idx to the last writer of `name` and fold
+    fill_constant / increment / assign chains. Returns None if the value is
+    genuinely data-dependent."""
+    if memo is None:
+        memo = {}
+    if name in memo:
+        return memo[name]
+    val = None
+    for i in range(upto_idx - 1, -1, -1):
+        producer = block.ops[i]
+        if name not in producer.output_names():
+            continue
+        t = producer.type
+        if t == "fill_constant":
+            val = int(producer.attrs["value"])
+        elif t == "increment":
+            src = _const_fold_int(block, producer.input("X")[0], i, memo)
+            if src is not None:
+                val = src + int(producer.attrs.get("step", 1))
+        elif t == "assign":
+            val = _const_fold_int(block, producer.input("X")[0], i, memo)
+        break
+    memo[name] = val
+    return val
+
+
+def _static_index(op, index_name, env, what):
+    # eager value (outside jit, or a numpy-fed scalar) resolves directly;
+    # under omnistaging every in-graph value is a tracer, so fall back to
+    # folding the producing fill_constant/increment/assign chain in the IR
+    v = env.get(index_name)
+    if v is not None and not isinstance(v, jax.core.Tracer):
+        try:
+            return int(np.asarray(v).reshape(()))
+        except Exception:
+            pass
+    idx = op.block.ops.index(op) if op in op.block.ops else len(op.block.ops)
+    folded = _const_fold_int(op.block, index_name, idx)
+    if folded is not None:
+        return folded
+    raise NotImplementedError(
+        f"{what} needs a build-time static index on TPU (static shapes); "
+        "build loops with layers.StaticRNN/DynamicRNN (lax.scan) instead "
+        "of dynamic array subscripts")

@@ -271,6 +271,40 @@ def _ftrl(ctx, ins, attrs):
             "LinearAccumOut": [lin_new]}
 
 
+def _soft_threshold(prox, lr, l1, l2):
+    """Proximal step shared by proximal_gd/proximal_adagrad (reference:
+    optimizers/proximal_gd_op.h): soft-threshold by lr*l1, shrink by
+    1/(1+lr*l2)."""
+    if l1 > 0:
+        return (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+@register_op("proximal_gd", not_differentiable=True, is_optimizer_op=True)
+def _proximal_gd(ctx, ins, attrs):
+    """reference: optimizers/proximal_gd_op.cc"""
+    p, g = ins["Param"][0], _dense_grad(ins)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+    return {"ParamOut": [_soft_threshold(prox, lr, l1, l2).astype(p.dtype)]}
+
+
+@register_op("proximal_adagrad", not_differentiable=True,
+             is_optimizer_op=True)
+def _proximal_adagrad(ctx, ins, attrs):
+    """reference: optimizers/proximal_adagrad_op.cc"""
+    p, g, m = ins["Param"][0], _dense_grad(ins), ins["Moment"][0]
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    g32 = g.astype(jnp.float32)
+    m_new = m + g32 * g32
+    prox = p.astype(jnp.float32) - lr * g32 / jnp.sqrt(m_new)
+    return {"ParamOut": [_soft_threshold(prox, lr, l1, l2).astype(p.dtype)],
+            "MomentOut": [m_new]}
+
+
 @register_op("dgc", not_differentiable=True, is_optimizer_op=True)
 def _dgc(ctx, ins, attrs):
     """Deep Gradient Compression (reference: operators/dgc_op.cc +

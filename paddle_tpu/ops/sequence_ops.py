@@ -9,6 +9,7 @@ sequence_mask is the bridge: lengths -> mask.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.registry import register_op
 
@@ -114,6 +115,53 @@ def _sequence_expand(ctx, ins, attrs):
     s = y.shape[1]
     return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], s)
                                      + x.shape[1:])]}
+
+
+@register_op("sequence_expand_as", no_grad_inputs={"Y"})
+def _sequence_expand_as(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_expand_as_op.cc — repeat each
+    per-sequence row of X to the length of the matching sequence in Y.
+    Dense analog: X [b, d...] -> [b, s, d...] with s = Y.shape[1]; padded
+    steps carry copies, which downstream masked ops ignore (identical to
+    sequence_expand here because the dense rep pads to a common s)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    s = y.shape[1]
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], s)
+                                     + x.shape[1:])]}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_reshape_op.cc — keep the flat
+    element stream, change the feature width to new_dim (each sequence's
+    step count scales by in_width/new_dim). Dense analog:
+    [b, s, d] -> [b, s*d/new_dim, new_dim]."""
+    x = ins["X"][0]
+    new_dim = int(attrs["new_dim"])
+    b, s, d = x.shape[0], x.shape[1], int(np.prod(x.shape[2:]) or 1)
+    if (s * d) % new_dim != 0:
+        raise ValueError(
+            f"sequence_reshape: seq_len*width ({s}*{d}) must be divisible "
+            f"by new_dim ({new_dim})")
+    return {"Out": [x.reshape(b, (s * d) // new_dim, new_dim)]}
+
+
+@register_op("sequence_scatter", no_grad_inputs={"Ids"})
+def _sequence_scatter(ctx, ins, attrs):
+    """reference: sequence_ops/sequence_scatter_op.cc — per-sequence
+    scatter-ADD: row i of X receives Updates[i] at columns Ids[i]. Dense
+    analog: Ids/Updates are [b, s] (+ optional IdsLength masking padded
+    slots)."""
+    x = ins["X"][0]                             # [b, cols]
+    ids = ins["Ids"][0].reshape(x.shape[0], -1).astype(jnp.int32)
+    upd = ins["Updates"][0].reshape(ids.shape).astype(x.dtype)
+    if "IdsLength" in ins:
+        ln = ins["IdsLength"][0].reshape(-1)
+        valid = jnp.arange(ids.shape[1])[None, :] < ln[:, None]
+        upd = jnp.where(valid, upd, jnp.zeros((), x.dtype))
+    rows = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], ids.shape)
+    return {"Out": [x.at[rows, ids].add(upd)]}
 
 
 @register_op("sequence_concat")

