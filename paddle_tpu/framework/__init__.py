@@ -7,3 +7,7 @@ from .executor import (Executor, Scope, global_scope, scope_guard,
                        as_jax_function)
 from .backward import append_backward, gradients
 from .layer_helper import LayerHelper, ParamAttr
+from .passes import (Pass, PassRegistry, register_pass, apply_pass,
+                     get_pass, Pattern, PatternPass, Match, find_matches,
+                     replace_ops)
+from . import builtin_passes  # registers the named built-in passes

@@ -184,6 +184,35 @@ class Executor:
 
         blk = program.global_block
 
+        # Host-boundary ops (save/load/send/recv/readers) run eagerly
+        # against the scope: the prefix before the first compute op now,
+        # the suffix after the jitted computation. A host op sandwiched
+        # between compute ops would need the op-by-op interpreter the
+        # whole-block-jit design removed — reference programs (save/load
+        # programs, transpiler-emitted trainer prologues/epilogues) only
+        # use the prefix/suffix forms.
+        from .registry import _HOST_OPS
+        host_pre, host_post = [], []
+        compute_seen = False
+        for op in blk.ops:
+            if op.type in _HOST_OPS:
+                (host_post if compute_seen else host_pre).append(op)
+            elif op.type not in ("feed", "fetch"):
+                compute_seen = True
+                if host_post:
+                    raise RuntimeError(
+                        f"host-boundary op(s) "
+                        f"{[o.type for o in host_post]} appear between "
+                        f"compute ops; split the program (the reference "
+                        f"emits separate save/load programs too)")
+        for op in host_pre:
+            _HOST_OPS[op.type](op, scope, feed)
+        if not compute_seen:
+            # host-only program (save/load programs): everything already
+            # ran via host_pre above
+            return [np.asarray(scope.find_var(f)) if return_numpy
+                    else scope.find_var(f) for f in fetch_names]
+
         def _expand(ops):
             """Flatten macro ops' sub-blocks for read/write classification
             (sub-block reads are reads of the enclosing op). The macro op is
@@ -207,7 +236,7 @@ class Executor:
             sub_local.update(b.vars)
         macro_attrs = ("sub_block", "sub_block_t", "sub_block_f")
         for op in _expand(blk.ops):
-            if op.type in ("feed", "fetch"):
+            if op.type in ("feed", "fetch") or op.type in _HOST_OPS:
                 continue
             reads = list(op.input_names())
             if any(k in op.attrs for k in macro_attrs):
@@ -285,6 +314,9 @@ class Executor:
             scope.set_var(n, v)
         scope.set_var("@RNG@", new_key)
 
+        for op in host_post:  # saves/sends see the post-step scope
+            _HOST_OPS[op.type](op, scope, feed)
+
         if finite_flags:
             for tag, ok in finite_flags.items():
                 if not bool(ok):
@@ -336,8 +368,11 @@ class Executor:
                 program, program._pipeline, feed_shapes, fetch_names,
                 mutable, created, readonly)
 
+        from .registry import _HOST_OPS
         blk = program.global_block
-        ops = [op for op in blk.ops if op.type not in ("feed", "fetch")]
+        ops = [op for op in blk.ops
+               if op.type not in ("feed", "fetch")
+               and op.type not in _HOST_OPS]
         out_names = list(mutable) + list(created)
 
         check_nan_inf = os.environ.get("FLAGS_check_nan_inf", "0") == "1"
@@ -485,6 +520,14 @@ def as_jax_function(program: Program, fetch_list, is_test: bool = True,
                    for f in fetch_list]
     if is_test:
         program = program.clone(for_test=True)
+    from .registry import _HOST_OPS
+    host = [op.type for op in program.global_block.ops
+            if op.type in _HOST_OPS]
+    if host:
+        raise ValueError(
+            f"as_jax_function: program contains host-boundary op(s) "
+            f"{host} (file IO / RPC / readers) that cannot lower into a "
+            f"pure jax function; run it through Executor.run instead")
     ops = [op for op in program.global_block.ops
            if op.type not in ("feed", "fetch")]
 

@@ -81,6 +81,24 @@ class LayerHelper:
         init(param, sb)
         return param
 
+    def create_global_state_var(self, prefix, shape, dtype="float32",
+                                fill_value=0) -> Variable:
+        """Persistable non-trainable accumulator (metric stat buffers,
+        reference metrics/auc_op.h persistable StatPos): lives in the main
+        program's global block, zero-seeded by the startup program, and
+        updated in place by ops that name it as both input and output."""
+        name = unique_name(prefix)
+        v = self.main_program.global_block.create_var(
+            name=name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True)
+        sb = self.startup_program.global_block
+        sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True,
+                      stop_gradient=True)
+        sb.append_op("fill_constant", {}, {"Out": [name]},
+                     {"shape": list(shape), "dtype": dtype,
+                      "value": fill_value})
+        return v
+
     def create_variable_for_type_inference(self, dtype="float32",
                                            stop_gradient=False) -> Variable:
         return self.block.create_var(name=unique_name(self.name + ".tmp"),

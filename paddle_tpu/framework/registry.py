@@ -105,6 +105,29 @@ def register_macro_op(op_type: str, aliases: Sequence[str] = (), **opdef_kw):
     return deco
 
 
+# Host-boundary ops: file IO (save/load), RPC (send/recv/listen_and_serv),
+# reader machinery — side effects that cannot live inside the jitted XLA
+# computation. The Executor runs them EAGERLY against the scope: ops before
+# the first compute op run pre-jit (loads, reads), ops after the last
+# compute op run post-jit (saves, barriers). fn(op, scope, feed) mutates
+# scope/feed in place. The reference's analog is ops whose kernels do IO
+# from inside the C++ interpreter loop (save_op.cc, send_op.cc) — with a
+# whole-block jit that interpreter loop no longer exists, so the boundary
+# moves to the executor.
+_HOST_OPS: Dict[str, Callable] = {}
+
+
+def register_host_op(op_type: str, aliases: Sequence[str] = (), **opdef_kw):
+    def deco(fn):
+        opdef_kw.setdefault("not_differentiable", True)
+        opdef_kw.setdefault("grad_free", True)
+        for name in (op_type,) + tuple(aliases):
+            _HOST_OPS[name] = fn
+            _REGISTRY[name] = OpDef(type=name, lower=None, **opdef_kw)
+        return fn
+    return deco
+
+
 def has_op_def(op_type: str) -> bool:
     return op_type in _REGISTRY
 

@@ -21,7 +21,8 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
            "exp", "log", "sqrt", "square", "abs", "pow", "cross_entropy",
            "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
            "square_error_cost", "huber_loss", "kldiv_loss", "smooth_l1",
-           "accuracy", "topk", "one_hot", "lrn", "prelu", "mse_loss",
+           "accuracy", "auc", "precision_recall", "topk", "one_hot", "lrn",
+           "prelu", "mse_loss",
            "label_smooth", "fused_attention", "warpctc",
            "linear_chain_crf", "crf_decoding", "nce", "hsigmoid",
            "log_loss", "cos_sim", "resize_bilinear", "resize_nearest",
@@ -539,6 +540,52 @@ def accuracy(input, label, k=1, name=None):
                      {"Accuracy": [acc.name], "Correct": [correct.name],
                       "Total": [total.name]})
     return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,
+        slide_steps=1, name=None):
+    """Streaming in-graph AUC (reference: layers/metric_op.py auc,
+    metrics/auc_op.h). Creates persistable StatPos/StatNeg accumulators
+    updated in place every step. Returns (auc_out, [stat_pos, stat_neg])."""
+    helper = LayerHelper("auc", name=name)
+    buckets = num_thresholds + 1
+    rows = slide_steps if slide_steps > 0 else 1
+    stat_pos = helper.create_global_state_var(
+        "auc_stat_pos", [rows, buckets], "int64")
+    stat_neg = helper.create_global_state_var(
+        "auc_stat_neg", [rows, buckets], "int64")
+    auc_out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        "auc",
+        {"Predict": [input.name], "Label": [label.name],
+         "StatPos": [stat_pos.name], "StatNeg": [stat_neg.name]},
+        {"AUC": [auc_out.name], "StatPosOut": [stat_pos.name],
+         "StatNegOut": [stat_neg.name]},
+        {"curve": curve, "num_thresholds": num_thresholds,
+         "slide_steps": slide_steps}, infer_shape=False)
+    return auc_out, [stat_pos, stat_neg]
+
+
+def precision_recall(max_probs, indices, labels, class_number, weights=None,
+                     name=None):
+    """Streaming per-class precision/recall/F1 (reference:
+    metrics/precision_recall_op.h). Returns (batch_metrics [6],
+    accum_metrics [6], accum_states [C, 4])."""
+    helper = LayerHelper("precision_recall", name=name)
+    states = helper.create_global_state_var(
+        "pr_states", [class_number, 4], "float32")
+    batch_m = helper.create_variable_for_type_inference("float32", True)
+    accum_m = helper.create_variable_for_type_inference("float32", True)
+    inputs = {"MaxProbs": [max_probs.name], "Indices": [indices.name],
+              "Labels": [labels.name], "StatesInfo": [states.name]}
+    if weights is not None:
+        inputs["Weights"] = [weights.name]
+    helper.append_op(
+        "precision_recall", inputs,
+        {"BatchMetrics": [batch_m.name], "AccumMetrics": [accum_m.name],
+         "AccumStatesInfo": [states.name]},
+        {"class_number": class_number}, infer_shape=False)
+    return batch_m, accum_m, states
 
 
 def one_hot(input, depth, name=None):

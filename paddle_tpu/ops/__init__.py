@@ -17,3 +17,8 @@ from . import misc_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
 from . import nn_extra_ops  # noqa: F401
 from . import lod_array_ops  # noqa: F401
+from . import fused_ops  # noqa: F401
+from . import metrics_ops  # noqa: F401
+from . import detection_extra_ops  # noqa: F401
+from . import io_dist_ops  # noqa: F401
+from . import reader_ops  # noqa: F401

@@ -188,3 +188,31 @@ def _c_sync_comm(ctx, ins, attrs):
 def _c_comm_init(ctx, ins, attrs):
     # ring registration is host-side (init_ring); in-graph it is a no-op
     return {}
+
+
+# Legacy distributed_ops/ spellings of the same collectives (reference:
+# distributed_ops/allreduce_op.cc, broadcast_op.cc — the pre-c_* ops used
+# by dygraph DataParallel in the reference). Same lowerings, legacy slots.
+
+@register_op("allreduce")
+def _allreduce_legacy(ctx, ins, attrs):
+    import jax
+    import jax.numpy as jnp
+    x = ins["X"][0]
+    axis = _active_axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    # reference enum (allreduce_op.cc): 0 sum, 1 prod, 2 max, 3 min
+    rt = int(attrs.get("reduce_type", 0))
+    if rt == 1:
+        g = jax.lax.all_gather(x, axis)
+        return {"Out": [jnp.prod(g, axis=0)]}
+    red = {0: "psum", 2: "pmax", 3: "pmin"}.get(rt, "psum")
+    return {"Out": [getattr(jax.lax, red)(x, axis)]}
+
+
+@register_op("broadcast")
+def _broadcast_legacy(ctx, ins, attrs):
+    return _c_broadcast(ctx, ins,
+                        {**attrs, "root": attrs.get("root_var",
+                                                    attrs.get("root", 0))})

@@ -1,0 +1,572 @@
+"""Detection tail: R-CNN label generation, perspective RoI transform,
+deformable PS-RoI pooling, var_conv_2d, and the streaming detection_map
+metric (reference: detection/generate_proposal_labels_op.cc,
+generate_mask_labels_op.cc, roi_perspective_transform_op.cc,
+deformable_psroi_pooling_op.cc, var_conv_2d_op.cc, detection_map_op.cc).
+
+Fixed-size TPU redesigns throughout (same stance as detection_ops.py):
+variable-length LoD outputs become padded dense tensors with validity
+masks; the detection_map accumulator state is bucketized by score (the
+auc-op state model) instead of unbounded LoD score lists.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .detection_ops import _iou_matrix
+
+
+# ---------------------------------------------------------------------------
+# generate_proposal_labels (Fast R-CNN sampling)
+# ---------------------------------------------------------------------------
+
+@register_op("generate_proposal_labels", not_differentiable=True,
+             grad_free=True, stateful=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """reference: detection/generate_proposal_labels_op.cc — sample
+    batch_size_per_im RoIs per image into fg (IoU>=fg_thresh, gt class
+    label) and bg (bg_thresh_lo<=IoU<bg_thresh_hi, label 0), emit
+    per-class box regression targets. Fixed-size: RpnRois [n, R, 4] dense
+    in, all outputs [n, B, ...] with B = batch_size_per_im; unsampled
+    slots have label -1 and zero weights."""
+    rois = ins["RpnRois"][0]                     # [n, R, 4]
+    gt_classes = ins["GtClasses"][0]             # [n, G]
+    gt_boxes = ins["GtBoxes"][0]                 # [n, G, 4]
+    is_crowd = ins.get("IsCrowd", [None])[0]     # [n, G]
+    im_info = ins["ImInfo"][0]                   # [n, 3]
+    B = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thresh = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    C = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    cls_agnostic = bool(attrs.get("is_cls_agnostic", False))
+    cascade = bool(attrs.get("is_cascade_rcnn", False))
+    n, r = rois.shape[0], rois.shape[1]
+    key = ctx.rng()
+
+    def one(img_rois, img_gt, img_cls, img_crowd, info, k):
+        scale = info[2]
+        gt_valid = (img_gt[:, 2] > img_gt[:, 0]) & \
+            (img_gt[:, 3] > img_gt[:, 1])
+        if img_crowd is not None:
+            gt_valid &= (img_crowd == 0)
+        if not cascade:
+            # gt boxes join the roi candidate pool (reference
+            # AppendRois): gt slots appended after the R rpn rois
+            img_rois = jnp.concatenate(
+                [img_rois, jnp.where(gt_valid[:, None], img_gt, 0.0)],
+                axis=0)
+        roi_valid = (img_rois[:, 2] > img_rois[:, 0]) & \
+            (img_rois[:, 3] > img_rois[:, 1])
+        iou = _iou_matrix(img_rois, img_gt)      # [R', G]
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        iou = jnp.where(roi_valid[:, None], iou, 0.0)
+        max_ov = iou.max(axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+
+        fg_mask = roi_valid & (max_ov >= fg_thresh)
+        bg_mask = roi_valid & (max_ov < bg_hi) & (max_ov >= bg_lo)
+        rr = img_rois.shape[0]
+        fg_target = int(B * fg_frac)
+        pri = jax.random.uniform(k, (rr,)) if use_random \
+            else -jnp.arange(rr, dtype=jnp.float32)
+        fg_pri = jnp.where(fg_mask, pri, -jnp.inf)
+        fg_rank = jnp.argsort(jnp.argsort(-fg_pri))
+        fg_keep = fg_mask & (fg_rank < fg_target)
+        n_fg = jnp.minimum(fg_mask.sum(), fg_target)
+        bg_target = B - n_fg
+        bg_pri = jnp.where(bg_mask, pri, -jnp.inf)
+        bg_rank = jnp.argsort(jnp.argsort(-bg_pri))
+        bg_keep = bg_mask & (bg_rank < bg_target)
+
+        # gather sampled rois to the front: fg first then bg (reference
+        # concatenates fg_inds + bg_inds), pad to B
+        order_key = jnp.where(fg_keep, fg_rank,
+                              jnp.where(bg_keep, fg_target + bg_rank,
+                                        jnp.inf))
+        sel = jnp.argsort(order_key)[:B]
+        picked = (order_key[sel] != jnp.inf)
+        sel_rois = jnp.where(picked[:, None], img_rois[sel], 0.0)
+        sel_fg = fg_keep[sel]
+        labels = jnp.where(
+            sel_fg, img_cls[argmax_gt[sel]].astype(jnp.int32),
+            jnp.where(picked, 0, -1))
+        if cls_agnostic:
+            labels = jnp.where(sel_fg, 1, labels)
+
+        # encoded regression targets vs matched gt
+        mgt = img_gt[argmax_gt[sel]]
+        bw = sel_rois[:, 2] - sel_rois[:, 0] + 1
+        bh = sel_rois[:, 3] - sel_rois[:, 1] + 1
+        bx = sel_rois[:, 0] + bw / 2
+        by = sel_rois[:, 1] + bh / 2
+        gw = mgt[:, 2] - mgt[:, 0] + 1
+        gh = mgt[:, 3] - mgt[:, 1] + 1
+        gx = mgt[:, 0] + gw / 2
+        gy = mgt[:, 1] + gh / 2
+        tgt = jnp.stack([(gx - bx) / jnp.maximum(bw, 1e-6) / weights[0],
+                         (gy - by) / jnp.maximum(bh, 1e-6) / weights[1],
+                         jnp.log(jnp.maximum(gw, 1e-6)
+                                 / jnp.maximum(bw, 1e-6)) / weights[2],
+                         jnp.log(jnp.maximum(gh, 1e-6)
+                                 / jnp.maximum(bh, 1e-6)) / weights[3]],
+                        axis=-1)
+        tgt = jnp.where(sel_fg[:, None], tgt, 0.0)
+        # per-class slots [B, 4C]: targets land in the label's slot
+        cls_slot = jnp.where(cls_agnostic, 1, labels).astype(jnp.int32)
+        onehot = jax.nn.one_hot(jnp.clip(cls_slot, 0, C - 1), C,
+                                dtype=tgt.dtype) * sel_fg[:, None]
+        bbox_targets = (onehot[:, :, None] * tgt[:, None, :]) \
+            .reshape(B, 4 * C)
+        inside_w = (onehot[:, :, None]
+                    * jnp.ones((B, 1, 4), tgt.dtype)).reshape(B, 4 * C)
+        outside_w = inside_w
+        return (sel_rois, labels, bbox_targets, inside_w, outside_w,
+                argmax_gt[sel].astype(jnp.int32), sel_fg)
+
+    keys = jax.random.split(key, n)
+    crowd = is_crowd if is_crowd is not None else \
+        jnp.zeros(gt_classes.shape, jnp.int32)
+    rois_o, labels, tgts, inw, outw, match, fgm = jax.vmap(one)(
+        rois, gt_boxes, gt_classes, crowd, im_info, keys)
+    return {"Rois": [rois_o], "LabelsInt32": [labels],
+            "BboxTargets": [tgts], "BboxInsideWeights": [inw],
+            "BboxOutsideWeights": [outw],
+            # extra (beyond-reference) outputs consumed by
+            # generate_mask_labels' dense redesign
+            "MatchedGtInt32": [match], "FgMask": [fgm]}
+
+
+# ---------------------------------------------------------------------------
+# generate_mask_labels (Mask R-CNN)
+# ---------------------------------------------------------------------------
+
+@register_op("generate_mask_labels", not_differentiable=True, grad_free=True)
+def _generate_mask_labels(ctx, ins, attrs):
+    """reference: detection/generate_mask_labels_op.cc. Dense redesign:
+    GtSegms arrives RASTERIZED as [n, G, Hm, Wm] binary masks in
+    normalized image coordinates (the reference takes 3-level-LoD polygon
+    lists and rasterizes in C++; polygon->mask belongs in the host data
+    pipeline on TPU, like modern detectron loaders). For each sampled fg
+    RoI the matched gt mask is cropped to the RoI box, resampled to
+    resolution^2, thresholded, and written into the label's class slot of
+    MaskInt32 [n, B, C*res*res]; non-fg rows are -1 (ignored by the mask
+    loss, as in the reference)."""
+    im_info = ins["ImInfo"][0]                   # [n, 3]
+    gt_segms = ins["GtSegms"][0]                 # [n, G, Hm, Wm] in [0,1]
+    rois = ins["Rois"][0]                        # [n, B, 4] image coords
+    labels = ins["LabelsInt32"][0]               # [n, B]
+    matched = ins["MatchedGtInt32"][0] if "MatchedGtInt32" in ins else None
+    C = int(attrs["num_classes"])
+    res = int(attrs["resolution"])
+    n, B = labels.shape
+    hm, wm = gt_segms.shape[2], gt_segms.shape[3]
+
+    def one(info, segms, img_rois, img_labels, img_match):
+        im_h = info[0]
+        im_w = info[1]
+
+        def per_roi(box, lab, gt_idx):
+            mask = segms[gt_idx]                 # [Hm, Wm]
+            x0, y0, x1, y1 = box[0], box[1], box[2], box[3]
+            # sample res x res points inside the roi, read the gt mask at
+            # the matching normalized position (bilinear)
+            xs = (x0 + (x1 - x0) * (jnp.arange(res) + 0.5) / res) / \
+                jnp.maximum(im_w, 1.0) * (wm - 1)
+            ys = (y0 + (y1 - y0) * (jnp.arange(res) + 0.5) / res) / \
+                jnp.maximum(im_h, 1.0) * (hm - 1)
+            gx, gy = jnp.meshgrid(xs, ys)
+            x0i = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, wm - 1)
+            y0i = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, hm - 1)
+            x1i = jnp.clip(x0i + 1, 0, wm - 1)
+            y1i = jnp.clip(y0i + 1, 0, hm - 1)
+            fx = gx - x0i
+            fy = gy - y0i
+            v = (mask[y0i, x0i] * (1 - fx) * (1 - fy)
+                 + mask[y0i, x1i] * fx * (1 - fy)
+                 + mask[y1i, x0i] * (1 - fx) * fy
+                 + mask[y1i, x1i] * fx * fy)
+            bin_mask = (v >= 0.5).astype(jnp.int32).reshape(-1)
+            slot = jnp.clip(lab, 0, C - 1)
+            full = jnp.full((C, res * res), 0, jnp.int32)
+            full = full.at[slot].set(bin_mask)
+            is_fg = lab > 0
+            return jnp.where(is_fg, full.reshape(-1), -1), \
+                is_fg.astype(jnp.int32)
+
+        gt_idx = img_match if img_match is not None \
+            else jnp.zeros((B,), jnp.int32)
+        masks, has = jax.vmap(per_roi)(img_rois, img_labels, gt_idx)
+        return img_rois, has, masks
+
+    if matched is None:
+        matched = jnp.zeros((n, B), jnp.int32)
+    mask_rois, has_mask, mask_int32 = jax.vmap(one)(
+        im_info, gt_segms, rois, labels, matched)
+    return {"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+            "MaskInt32": [mask_int32]}
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform
+# ---------------------------------------------------------------------------
+
+def _quad_homography(quad, h_out, w_out):
+    """Homography mapping output rect (w_out, h_out) corners to the quad's
+    4 points (x1..x4, y1..y4 order: lt, rt, rb, lb — reference
+    roi_perspective_transform_op.cc get_transform_matrix)."""
+    x = quad[0::2]
+    y = quad[1::2]
+    dst = jnp.stack([x, y], axis=1)              # [4, 2]
+    src = jnp.asarray([[0.0, 0.0], [w_out - 1.0, 0.0],
+                       [w_out - 1.0, h_out - 1.0], [0.0, h_out - 1.0]],
+                      quad.dtype)
+
+    def row_pair(s, d):
+        sx, sy = s[0], s[1]
+        dx, dy = d[0], d[1]
+        r1 = jnp.array([sx, sy, 1, 0, 0, 0, -dx * sx, -dx * sy])
+        r2 = jnp.array([0, 0, 0, sx, sy, 1, -dy * sx, -dy * sy])
+        return jnp.stack([r1, r2]), jnp.stack([dx, dy])
+
+    rows, rhs = jax.vmap(row_pair)(src, dst)
+    A = rows.reshape(8, 8)
+    b = rhs.reshape(8)
+    sol = jnp.linalg.solve(A + 1e-8 * jnp.eye(8, dtype=A.dtype), b)
+    return jnp.concatenate([sol, jnp.ones((1,), sol.dtype)])  # [9]
+
+
+@register_op("roi_perspective_transform",
+             no_grad_inputs={"ROIs", "RoisNum"},
+             non_diff_outputs={"Mask", "TransformMatrix", "Out2InIdx",
+                               "Out2InWeights"})
+def _roi_perspective_transform(ctx, ins, attrs):
+    """reference: detection/roi_perspective_transform_op.cc — warp each
+    quadrilateral RoI to a fixed rectangle by perspective transform +
+    bilinear sampling (OCR text rectification). Dense: ROIs [n, R, 8]
+    quads per image; Out [n, R, c, H', W']. Differentiable w.r.t. X via
+    jax autodiff (the reference hand-caches Out2InIdx/Out2InWeights for
+    its grad kernel; XLA recomputes instead, so those outputs are emitted
+    as zeros purely for slot parity)."""
+    x = ins["X"][0]                              # [n, c, h, w]
+    rois = ins["ROIs"][0]                        # [n, R, 8]
+    scale = attrs.get("spatial_scale", 1.0)
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    n, c, h, w = x.shape
+    R = rois.shape[1]
+
+    def one_img(img, img_rois):
+        def one_roi(quad):
+            q = quad * scale
+            T = _quad_homography(q, th, tw)
+            gy, gx = jnp.meshgrid(jnp.arange(th, dtype=x.dtype),
+                                  jnp.arange(tw, dtype=x.dtype),
+                                  indexing="ij")
+            denom = T[6] * gx + T[7] * gy + T[8]
+            sx = (T[0] * gx + T[1] * gy + T[2]) / denom
+            sy = (T[3] * gx + T[4] * gy + T[5]) / denom
+            in_bound = (sx >= -0.5) & (sx <= w - 0.5) & \
+                (sy >= -0.5) & (sy <= h - 0.5)
+            x0 = jnp.clip(jnp.floor(sx).astype(jnp.int32), 0, w - 1)
+            y0 = jnp.clip(jnp.floor(sy).astype(jnp.int32), 0, h - 1)
+            x1 = jnp.clip(x0 + 1, 0, w - 1)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            fx = jnp.clip(sx, 0, w - 1.0) - x0
+            fy = jnp.clip(sy, 0, h - 1.0) - y0
+            v = (img[:, y0, x0] * (1 - fx) * (1 - fy)
+                 + img[:, y0, x1] * fx * (1 - fy)
+                 + img[:, y1, x0] * (1 - fx) * fy
+                 + img[:, y1, x1] * fx * fy)    # [c, th, tw]
+            v = jnp.where(in_bound[None], v, 0.0)
+            return v, in_bound.astype(jnp.int32)[None], T
+
+        return jax.vmap(one_roi)(img_rois)
+
+    out, mask, mats = jax.vmap(one_img)(x, rois)
+    return {"Out": [out], "Mask": [mask], "TransformMatrix": [mats],
+            "Out2InIdx": [jnp.zeros((n, R, th * tw, 4), jnp.int32)],
+            "Out2InWeights": [jnp.zeros((n, R, th * tw, 4), x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# deformable_psroi_pooling
+# ---------------------------------------------------------------------------
+
+@register_op("deformable_psroi_pooling",
+             no_grad_inputs={"ROIs", "RoisNum"},
+             non_diff_outputs={"TopCount"})
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """reference: deformable_psroi_pooling_op.cc (R-FCN / Deformable
+    ConvNets). Input [n, C, H, W] with C = output_dim*ph*pw position-
+    sensitive score maps; ROIs dense [n, R, 4]; Trans [n*R or R, 2, ph,
+    pw] learned offsets (ignored when no_trans). Output [n, R,
+    output_dim, ph, pw]; TopCount = bilinear sample counts."""
+    x = ins["Input"][0]
+    rois = ins["ROIs"][0]
+    trans = ins.get("Trans", [None])[0]
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    scale = attrs.get("spatial_scale", 1.0)
+    out_dim = int(attrs["output_dim"])
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    spp = int(attrs.get("sample_per_part", 4))
+    trans_std = attrs.get("trans_std", 0.1)
+    group_h = int(attrs.get("group_size", [ph, pw])[0]) \
+        if isinstance(attrs.get("group_size"), (list, tuple)) else ph
+    group_w = group_h
+    part_h, part_w = ph, pw
+    n, C, H, W = x.shape
+    R = rois.shape[1]
+
+    def one_img(img, img_rois, img_trans):
+        def one_roi(roi, roi_trans):
+            # roi in image coords -> feature coords (reference rounds +
+            # 0.5 shifts)
+            rx0 = roi[0] * scale - 0.5
+            ry0 = roi[1] * scale - 0.5
+            rx1 = (roi[2] + 1.0) * scale - 0.5
+            ry1 = (roi[3] + 1.0) * scale - 0.5
+            rw = jnp.maximum(rx1 - rx0, 0.1)
+            rh = jnp.maximum(ry1 - ry0, 0.1)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            sub_w = bin_w / spp
+            sub_h = bin_h / spp
+
+            def one_bin(od, iy, ix):
+                # learned offset for this bin
+                if no_trans:
+                    dx = dy = 0.0
+                else:
+                    cls = 0  # offsets shared across output_dim channels
+                    dx = roi_trans[0, iy * part_h // ph,
+                                   ix * part_w // pw] * trans_std * rw
+                    dy = roi_trans[1, iy * part_h // ph,
+                                   ix * part_w // pw] * trans_std * rh
+                # position-sensitive channel for (od, iy, ix)
+                gy = iy * group_h // ph
+                gx = ix * group_w // pw
+                chan = (od * group_h + gy) * group_w + gx
+                sy = ry0 + iy * bin_h + dy + \
+                    (jnp.arange(spp, dtype=x.dtype) + 0.5) * sub_h
+                sx = rx0 + ix * bin_w + dx + \
+                    (jnp.arange(spp, dtype=x.dtype) + 0.5) * sub_w
+                yy, xx = jnp.meshgrid(sy, sx, indexing="ij")
+                valid = (xx >= -0.5) & (xx <= W - 0.5) & \
+                    (yy >= -0.5) & (yy <= H - 0.5)
+                xc = jnp.clip(xx, 0, W - 1.001)
+                yc = jnp.clip(yy, 0, H - 1.001)
+                x0 = jnp.floor(xc).astype(jnp.int32)
+                y0 = jnp.floor(yc).astype(jnp.int32)
+                fx = xc - x0
+                fy = yc - y0
+                fmap = img[chan]
+                v = (fmap[y0, x0] * (1 - fx) * (1 - fy)
+                     + fmap[y0, jnp.minimum(x0 + 1, W - 1)] * fx * (1 - fy)
+                     + fmap[jnp.minimum(y0 + 1, H - 1), x0] * (1 - fx) * fy
+                     + fmap[jnp.minimum(y0 + 1, H - 1),
+                            jnp.minimum(x0 + 1, W - 1)] * fx * fy)
+                v = jnp.where(valid, v, 0.0)
+                cnt = valid.sum()
+                return jnp.where(cnt > 0, v.sum() / cnt, 0.0), \
+                    cnt.astype(x.dtype)
+
+            ods, iys, ixs = jnp.meshgrid(
+                jnp.arange(out_dim), jnp.arange(ph), jnp.arange(pw),
+                indexing="ij")
+            vals, cnts = jax.vmap(one_bin)(
+                ods.reshape(-1), iys.reshape(-1), ixs.reshape(-1))
+            return vals.reshape(out_dim, ph, pw), \
+                cnts.reshape(out_dim, ph, pw)
+
+        return jax.vmap(one_roi)(img_rois,
+                                 img_trans if img_trans is not None
+                                 else jnp.zeros((R, 2, part_h, part_w),
+                                                x.dtype))
+
+    if trans is None:
+        trans_n = jnp.zeros((n, R, 2, part_h, part_w), x.dtype)
+    else:
+        trans_n = trans.reshape(n, R, 2, part_h, part_w)
+    out, cnt = jax.vmap(one_img)(x, rois, trans_n)
+    return {"Output": [out], "TopCount": [cnt]}
+
+
+# ---------------------------------------------------------------------------
+# var_conv_2d
+# ---------------------------------------------------------------------------
+
+@register_op("var_conv_2d", no_grad_inputs={"ROW", "COLUMN"},
+             non_diff_outputs={"Col"})
+def _var_conv_2d(ctx, ins, attrs):
+    """reference: var_conv_2d_op.cc — conv over per-sample variable-size
+    feature maps (match-pyramid text models; per-sample h/w ride in
+    ROW/COLUMN LoD). Dense redesign: X [b, c_in, H, W] padded, ROW [b]
+    valid heights, COLUMN [b] valid widths; invalid region is zeroed
+    before AND after the conv so results equal the reference's per-sample
+    crops. W [c_out, c_in*kh*kw]."""
+    x = ins["X"][0]
+    w = ins["W"][0]
+    rows = ins["ROW"][0].reshape(-1) if "ROW" in ins else None
+    cols = ins["COLUMN"][0].reshape(-1) if "COLUMN" in ins else None
+    cin = int(attrs["InputChannel"])
+    cout = int(attrs["OutputChannel"])
+    kh, kw = int(attrs["KernelH"]), int(attrs["KernelW"])
+    sh, sw = int(attrs.get("StrideH", 1)), int(attrs.get("StrideW", 1))
+    b, _, H, W_ = x.shape
+
+    def mask2d(h_valid, w_valid, hh, ww):
+        my = jnp.arange(hh)[:, None] < jnp.ceil(h_valid)
+        mx = jnp.arange(ww)[None, :] < jnp.ceil(w_valid)
+        return (my & mx)
+
+    if rows is not None and cols is not None:
+        m = jax.vmap(lambda r, c: mask2d(r, c, H, W_))(rows, cols)
+        x = x * m[:, None].astype(x.dtype)
+    filt = w.reshape(cout, cin, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, filt, (sh, sw), [(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = out.shape[2], out.shape[3]
+    if rows is not None and cols is not None:
+        om = jax.vmap(lambda r, c: mask2d(
+            jnp.ceil(r / sh), jnp.ceil(c / sw), oh, ow))(rows, cols)
+        out = out * om[:, None].astype(out.dtype)
+    col = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Out": [out], "Col": [col]}
+
+
+# ---------------------------------------------------------------------------
+# detection_map (SSD eval metric)
+# ---------------------------------------------------------------------------
+
+@register_op("detection_map", not_differentiable=True, grad_free=True,
+             is_optimizer_op=True)
+def _detection_map(ctx, ins, attrs):
+    """reference: detection_map_op.cc — streaming mean average precision.
+
+    Dense redesign of the accumulator: the reference keeps unbounded LoD
+    lists of (score, tp) pairs per class; XLA needs static state, so TP/FP
+    events are bucketized by score into K=1000 buckets per class (the
+    auc-op state model) — AP error from bucketing is < 1e-3 at K=1000.
+
+    DetectRes [n, D, 6] (label, score, x0, y0, x1, y1; score<=0 rows are
+    padding), Label [n, G, 6] (label, x0, y0, x1, y1, difficult).
+    State: PosCount [C], TruePos [C, K], FalsePos [C, K].
+    Outputs the same three accumulators + scalar MAP."""
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    C = int(attrs["class_num"])
+    K = 1000
+    overlap_t = attrs.get("overlap_threshold", 0.5)
+    eval_difficult = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+    has_state = ins.get("HasState", [None])[0]
+    pos_count = ins.get("PosCount", [None])[0]
+    true_pos = ins.get("TruePos", [None])[0]
+    false_pos = ins.get("FalsePos", [None])[0]
+    if pos_count is None:  # stateless single-batch use: int32 is ample
+        pos_count = jnp.zeros((C,), jnp.int32)
+        true_pos = jnp.zeros((C, K), jnp.int32)
+        false_pos = jnp.zeros((C, K), jnp.int32)
+    if has_state is not None:
+        live = (has_state.reshape(-1)[0] != 0)
+        pos_count = jnp.where(live, pos_count, 0)
+        true_pos = jnp.where(live, true_pos, 0)
+        false_pos = jnp.where(live, false_pos, 0)
+    n, D = det.shape[0], det.shape[1]
+    G = gt.shape[1]
+
+    det_label = det[:, :, 0].astype(jnp.int32)
+    det_score = det[:, :, 1]
+    det_box = det[:, :, 2:6]
+    det_valid = det_score > 0
+    gt_label = gt[:, :, 0].astype(jnp.int32)
+    gt_box = gt[:, :, 1:5]
+    gt_difficult = (gt[:, :, 5] != 0) if gt.shape[2] > 5 else \
+        jnp.zeros((n, G), jnp.bool_)
+    gt_valid = (gt_box[:, :, 2] > gt_box[:, :, 0]) & \
+        (gt_box[:, :, 3] > gt_box[:, :, 1])
+    # positives per class (difficult gt excluded unless evaluate_difficult)
+    counted = gt_valid & (eval_difficult | ~gt_difficult)
+
+    def count_one(lbls, mask):
+        return jnp.zeros((C,), pos_count.dtype).at[
+            jnp.clip(lbls, 0, C - 1)].add(mask.astype(pos_count.dtype))
+
+    pos_count = pos_count + jax.vmap(count_one)(gt_label, counted).sum(0)
+
+    def one_img(lab_d, score_d, box_d, valid_d, lab_g, box_g, valid_g,
+                diff_g):
+        iou = _iou_matrix(box_d, box_g, normalized=True)      # [D, G]
+        same_cls = (lab_d[:, None] == lab_g[None, :]) & valid_g[None, :]
+        iou = jnp.where(same_cls, iou, 0.0)
+
+        # greedy match in score order: scan over detections desc score
+        order = jnp.argsort(-score_d)
+
+        def step(taken, di):
+            ious = jnp.where(taken, 0.0, iou[di])
+            best = jnp.argmax(ious)
+            ok = (ious[best] >= overlap_t) & valid_d[di]
+            is_diff = diff_g[best] & ok
+            taken = taken.at[best].set(taken[best] | ok)
+            # tp if matched non-difficult (or eval_difficult); fp if
+            # unmatched; difficult matches are ignored entirely
+            tp = ok & (eval_difficult | ~diff_g[best])
+            fp = (~ok) & valid_d[di]
+            if not eval_difficult:
+                fp = fp & ~is_diff
+            return taken, (di, tp, fp)
+
+        _, (dis, tps, fps) = jax.lax.scan(step,
+                                          jnp.zeros((G,), jnp.bool_),
+                                          order)
+        tp_f = jnp.zeros((D,), jnp.bool_).at[dis].set(tps)
+        fp_f = jnp.zeros((D,), jnp.bool_).at[dis].set(fps)
+        bins = jnp.clip((score_d * (K - 1)).astype(jnp.int32), 0, K - 1)
+        cls = jnp.clip(lab_d, 0, C - 1)
+        tp_h = jnp.zeros((C, K), true_pos.dtype).at[cls, bins].add(
+            tp_f.astype(true_pos.dtype))
+        fp_h = jnp.zeros((C, K), false_pos.dtype).at[cls, bins].add(
+            fp_f.astype(false_pos.dtype))
+        return tp_h, fp_h
+
+    tp_b, fp_b = jax.vmap(one_img)(det_label, det_score, det_box,
+                                   det_valid, gt_label, gt_box, gt_valid,
+                                   gt_difficult)
+    true_pos = true_pos + tp_b.sum(0)
+    false_pos = false_pos + fp_b.sum(0)
+
+    # AP per class from the bucketized curve, descending score
+    tp_rev = jnp.cumsum(true_pos[:, ::-1], axis=1).astype(jnp.float32)
+    fp_rev = jnp.cumsum(false_pos[:, ::-1], axis=1).astype(jnp.float32)
+    npos = jnp.maximum(pos_count.astype(jnp.float32), 1e-6)
+    recall = tp_rev / npos[:, None]
+    precision = tp_rev / jnp.maximum(tp_rev + fp_rev, 1e-6)
+    has_events = (true_pos.sum(1) + false_pos.sum(1)) > 0
+    if ap_type == "11point":
+        pts = jnp.linspace(0.0, 1.0, 11)
+        # max precision at recall >= r for each of the 11 points
+        pmax = jnp.max(
+            jnp.where(recall[:, None, :] >= pts[None, :, None],
+                      precision[:, None, :], 0.0), axis=2)   # [C, 11]
+        ap = pmax.mean(axis=1)
+    else:
+        # integral: sum precision * delta_recall over buckets
+        d_tp = jnp.diff(tp_rev, axis=1, prepend=0.0)
+        ap = jnp.sum(precision * d_tp, axis=1) / npos
+    eligible = (pos_count > 0) & has_events
+    m_ap = jnp.where(eligible.sum() > 0,
+                     jnp.sum(jnp.where(eligible, ap, 0.0))
+                     / jnp.maximum(eligible.sum(), 1), 0.0)
+    return {"MAP": [m_ap.astype(jnp.float32)],
+            "AccumPosCount": [pos_count], "AccumTruePos": [true_pos],
+            "AccumFalsePos": [false_pos]}

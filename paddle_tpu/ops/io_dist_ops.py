@@ -1,0 +1,340 @@
+"""Host-boundary IO + distributed op types, and the pure distributed
+compute ops (reference: save_op.cc, load_op.cc, save_combine_op.cc,
+operators/distributed_ops/, lookup_sparse_table_op.cc).
+
+Design split (SURVEY §7 "PS/dist ops are a host boundary"):
+  * side-effect ops (save/load, send/recv, listen_and_serv, readers) are
+    HOST ops — the executor runs them eagerly against the scope, outside
+    the jitted step (registry.register_host_op). The RPC transport is the
+    native pskv KV service (native/pskv/pskv.cc), not gRPC.
+  * data-shuffling ops (merge_ids, split_ids, split_byref,
+    ref_by_trainer_id, fake_init, lookup_sparse_table) are pure and lower
+    into the XLA graph like any other op.
+
+Paddle programs emitted by the reference transpiler run unchanged: the
+trainer prologue's recv/prefetch ops pull from pskv endpoints, the
+epilogue's send ops push, and a pserver program whose block is
+[listen_and_serv] serves.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, register_host_op
+
+# endpoint -> live KVClient (reference: distributed/grpc_client.cc keeps a
+# channel map the same way)
+_CLIENTS = {}
+
+
+def _client(endpoint, trainer_id=0):
+    from ..distributed.pskv import KVClient
+    key = (endpoint, trainer_id)
+    if key not in _CLIENTS:
+        host, port = endpoint.rsplit(":", 1)
+        _CLIENTS[key] = KVClient(host, int(port), trainer_id=trainer_id)
+    return _CLIENTS[key]
+
+
+def _endpoints(op):
+    eps = op.attrs.get("epmap") or op.attrs.get("endpoints") or []
+    if isinstance(eps, str):
+        eps = [eps]
+    return eps
+
+
+# ---------------------------------------------------------------------------
+# save / load (reference: save_op.cc, load_op.cc — raw tensor files; here
+# one .npy per var / one .npz per combine, matching io.py's archive model)
+# ---------------------------------------------------------------------------
+
+@register_host_op("save")
+def _save(op, scope, feed):
+    path = op.attrs["file_path"]
+    if not op.attrs.get("overwrite", True) and os.path.exists(path):
+        raise RuntimeError(f"save: {path!r} exists and overwrite=False")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    name = op.input("X")[0]
+    v = scope.find_var(name)
+    if v is None:
+        raise RuntimeError(f"save: var {name!r} not in scope")
+    arr = np.asarray(v)
+    if op.attrs.get("save_as_fp16", False):
+        arr = arr.astype(np.float16)
+    np.save(path, arr, allow_pickle=False)
+
+
+@register_host_op("load")
+def _load(op, scope, feed):
+    path = op.attrs["file_path"]
+    if not os.path.exists(path) and os.path.exists(path + ".npy"):
+        path = path + ".npy"
+    arr = np.load(path, allow_pickle=False)
+    name = op.output("Out")[0]
+    var = op.block.vars.get(name)
+    if var is not None and var.dtype and str(arr.dtype) != var.dtype:
+        arr = arr.astype(var.dtype)  # fp16-saved params upcast on load
+    scope.set_var(name, jnp.asarray(arr))
+
+
+@register_host_op("save_combine")
+def _save_combine(op, scope, feed):
+    path = op.attrs["file_path"]
+    if not op.attrs.get("overwrite", True) and os.path.exists(path):
+        raise RuntimeError(f"save_combine: {path!r} exists")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = {}
+    for name in op.input("X"):
+        v = scope.find_var(name)
+        if v is None:
+            raise RuntimeError(f"save_combine: var {name!r} not in scope")
+        a = np.asarray(v)
+        arrays[name] = a.astype(np.float16) \
+            if op.attrs.get("save_as_fp16", False) else a
+    np.savez(path, **arrays)
+
+
+@register_host_op("load_combine")
+def _load_combine(op, scope, feed):
+    path = op.attrs["file_path"]
+    if not os.path.exists(path) and os.path.exists(path + ".npz"):
+        path = path + ".npz"
+    with np.load(path) as z:
+        for name in op.output("Out"):
+            arr = z[name]
+            var = op.block.vars.get(name)
+            if var is not None and var.dtype and \
+                    str(arr.dtype) != var.dtype:
+                arr = arr.astype(var.dtype)
+            scope.set_var(name, jnp.asarray(arr))
+
+
+# ---------------------------------------------------------------------------
+# trainer-side RPC ops over pskv
+# ---------------------------------------------------------------------------
+
+@register_host_op("send")
+def _send(op, scope, feed):
+    """reference: distributed_ops/send_op.cc — push grads to pservers.
+    Vars are pushed whole to each listed endpoint in round-robin over X
+    (the reference's sliced send is a grpc detail; pskv shards by var)."""
+    from ..framework.selected_rows import SelectedRows
+    eps = _endpoints(op)
+    tid = int(op.attrs.get("trainer_id", 0))
+    names = op.input("X")
+    for i, name in enumerate(names):
+        v = scope.find_var(name)
+        if v is None:
+            raise RuntimeError(f"send: var {name!r} not in scope")
+        c = _client(eps[i % len(eps)], tid)
+        if isinstance(v, SelectedRows):
+            c.push_sparse(name, np.asarray(v.rows, np.int64),
+                          np.asarray(v.values, np.float32))
+        else:
+            c.push_dense(name, np.asarray(v, np.float32).reshape(-1))
+
+
+@register_host_op("send_barrier")
+def _send_barrier(op, scope, feed):
+    for ep in _endpoints(op):
+        _client(ep, int(op.attrs.get("trainer_id", 0))).barrier()
+
+
+@register_host_op("fetch_barrier")
+def _fetch_barrier(op, scope, feed):
+    for ep in _endpoints(op):
+        _client(ep, int(op.attrs.get("trainer_id", 0))).barrier()
+
+
+@register_host_op("recv")
+def _recv(op, scope, feed):
+    """reference: distributed_ops/recv_op.cc — pull params from pservers."""
+    if int(op.attrs.get("do_not_run", 0)):
+        return
+    eps = _endpoints(op)
+    tid = int(op.attrs.get("trainer_id", 0))
+    for i, name in enumerate(op.output("Out")):
+        var = op.block.vars.get(name)
+        size = 1
+        for d in (var.shape if var is not None and var.shape else [1]):
+            size *= max(int(d), 1)
+        c = _client(eps[i % len(eps)], tid)
+        arr = c.pull_dense(name, size)
+        if var is not None and var.shape:
+            arr = arr.reshape([int(d) for d in var.shape])
+        scope.set_var(name, jnp.asarray(arr))
+
+
+@register_host_op("prefetch")
+def _prefetch(op, scope, feed):
+    """reference: distributed_ops/prefetch_op.cc — pull only the embedding
+    rows for this batch's ids from the remote sparse table."""
+    eps = _endpoints(op)
+    tid = int(op.attrs.get("trainer_id", 0))
+    table = op.attrs.get("table_names", op.input("X"))
+    if isinstance(table, str):
+        table = [table]
+    for i, (in_name, out_name) in enumerate(zip(op.input("X"),
+                                                op.output("Out"))):
+        ids_v = scope.find_var(in_name)
+        if ids_v is None and in_name in feed:
+            ids_v = feed[in_name]
+        ids = np.asarray(ids_v).reshape(-1).astype(np.int64)
+        var = op.block.vars.get(out_name)
+        dim = int(var.shape[-1]) if var is not None and var.shape else 1
+        c = _client(eps[i % len(eps)], tid)
+        vals = c.pull_sparse(table[i % len(table)], ids, dim)
+        scope.set_var(out_name, jnp.asarray(vals.reshape(len(ids), dim)))
+
+
+@register_host_op("checkpoint_notify")
+def _checkpoint_notify(op, scope, feed):
+    """reference: distributed_ops/checkpoint_notify_op.cc — ask pservers
+    to snapshot their shards."""
+    path = op.attrs.get("dir", op.attrs.get("dirname", "ps_checkpoint"))
+    for ep in _endpoints(op):
+        _client(ep, int(op.attrs.get("trainer_id", 0))).save_checkpoint(path)
+
+
+@register_host_op("listen_and_serv")
+def _listen_and_serv(op, scope, feed):
+    """reference: distributed_ops/listen_and_serv_op.cc — the pserver
+    loop. Starts the native pskv service, registers/initializes the
+    attr-listed dense tables from the scope, and blocks until a client
+    sends shutdown. The reference's per-request optimize sub-blocks become
+    pskv's server-side optimizers (native/pskv/pskv.cc kCmdPushDense)."""
+    from ..distributed.pskv import KVServer
+    endpoint = op.attrs.get("endpoint", "127.0.0.1:0")
+    port = int(endpoint.rsplit(":", 1)[1])
+    fanin = int(op.attrs.get("Fanin", op.attrs.get("fanin", 1)))
+    sync = bool(op.attrs.get("sync_mode", True))
+    server = KVServer(port=port, trainers=max(fanin, 1), sync=sync)
+    try:
+        import time
+        while not server.stopped():
+            time.sleep(0.05)
+    finally:
+        server.stop()
+
+
+@register_host_op("fl_listen_and_serv")
+def _fl_listen_and_serv(op, scope, feed):
+    """reference: distributed_ops/fl_listen_and_serv_op.cc — federated
+    variant: clients push whole-model deltas at their own cadence, no
+    barrier between trainers. pskv's async mode (sync=False) is exactly
+    that contract."""
+    from ..distributed.pskv import KVServer
+    endpoint = op.attrs.get("endpoint", "127.0.0.1:0")
+    port = int(endpoint.rsplit(":", 1)[1])
+    fanin = int(op.attrs.get("Fanin", op.attrs.get("fanin", 1)))
+    server = KVServer(port=port, trainers=max(fanin, 1), sync=False)
+    try:
+        import time
+        while not server.stopped():
+            time.sleep(0.05)
+    finally:
+        server.stop()
+
+
+@register_host_op("gen_nccl_id", aliases=("c_gen_nccl_id",))
+def _gen_nccl_id(op, scope, feed):
+    """reference: distributed_ops/gen_nccl_id_op.cc / collective/
+    c_gen_nccl_id_op.cc — NCCL rendezvous bootstrap. The JAX/PJRT runtime
+    owns collective bootstrap (jax.distributed.initialize), so this is a
+    recorded no-op kept for program compatibility."""
+    for name in op.output_names():
+        if name:
+            scope.set_var(name, jnp.zeros((1,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# pure distributed compute ops
+# ---------------------------------------------------------------------------
+
+@register_op("fake_init", not_differentiable=True, grad_free=True)
+def _fake_init(ctx, ins, attrs):
+    """reference: distributed_ops/fake_init_op.cc — placeholder init for
+    vars whose real values live on the pserver."""
+    shape = [int(d) for d in attrs.get("shape", [1])]
+    return {"Out": [jnp.zeros(shape, attrs.get("dtype", "float32"))]}
+
+
+@register_op("split_byref", not_differentiable=True, grad_free=True)
+def _split_byref(ctx, ins, attrs):
+    """reference: distributed_ops/split_byref_op.cc — row-split a tensor
+    into per-pserver sections."""
+    x = ins["X"][0]
+    sections = attrs.get("sections")
+    num = int(attrs.get("num", 0) or 0)
+    outs = []
+    off = 0
+    if sections:
+        for s in sections:
+            outs.append(x[off:off + int(s)])
+            off += int(s)
+    else:
+        outs = list(jnp.split(x, num, axis=0))
+    return {"Out": outs}
+
+
+@register_op("split_ids", not_differentiable=True, grad_free=True)
+def _split_ids(ctx, ins, attrs):
+    """reference: distributed_ops/split_ids_op.cc — route ids to N shards
+    by id % N. Fixed-size redesign: every shard output keeps the input
+    length with non-member slots = -1 (XLA static shapes; consumers mask
+    on >= 0)."""
+    ids = ins["Ids"][0].reshape(-1)
+    n = int(attrs.get("num", 0)) or len(attrs.get("endpoints", [])) or 1
+    outs = []
+    for shard in range(n):
+        outs.append(jnp.where(ids % n == shard, ids,
+                              -jnp.ones_like(ids)))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", no_grad_inputs={"Ids", "Rows"})
+def _merge_ids(ctx, ins, attrs):
+    """reference: distributed_ops/merge_ids_op.cc — reassemble per-shard
+    embedding lookups back into the original id order. Ids [m] original
+    order; Rows = per-shard id lists (padded, -1 invalid); X = per-shard
+    value matrices aligned with Rows."""
+    ids = ins["Ids"][0].reshape(-1)
+    rows = jnp.concatenate([r.reshape(-1) for r in ins["Rows"]])
+    vals = jnp.concatenate(ins["X"], axis=0)
+    # position of each id in the concatenated rows: one-hot match (ids
+    # counts are small in the PS path; avoids sort/searchsorted ordering
+    # hazards with -1 padding)
+    hit = (ids[:, None] == rows[None, :]) & (rows[None, :] >= 0)
+    idx = jnp.argmax(hit, axis=1)
+    return {"Out": [vals[idx]]}
+
+
+@register_op("ref_by_trainer_id", no_grad_inputs={"TrainerId"})
+def _ref_by_trainer_id(ctx, ins, attrs):
+    """reference: distributed_ops/ref_by_trainer_id_op.cc — pick this
+    trainer's slice from a duplicable input list (DC-ASGD)."""
+    tid = ins["TrainerId"][0].reshape(()).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)
+    return {"Out": [stacked[tid]]}
+
+
+@register_op("lookup_sparse_table", no_grad_inputs={"Ids"})
+def _lookup_sparse_table(ctx, ins, attrs):
+    """reference: lookup_sparse_table_op.cc — embedding lookup in a
+    (possibly auto-growing) sparse table. Dense redesign: W is the dense
+    [V, D] table (auto-growth is a pserver concern — the distributed path
+    uses pskv pull_sparse via the prefetch host op instead); out-of-range
+    or padding ids return zero rows."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    shape = ids.shape
+    flat = ids.reshape(-1)
+    pad = int(attrs.get("padding_idx", -1))
+    valid = (flat >= 0) & (flat < w.shape[0])
+    if pad >= 0:
+        valid &= (flat != pad)
+    out = w[jnp.clip(flat, 0, w.shape[0] - 1)]
+    out = jnp.where(valid[:, None], out, 0.0)
+    return {"Out": [out.reshape(shape + (w.shape[1],))]}

@@ -217,8 +217,12 @@ def _ctc_align(ctx, ins, attrs):
 @register_op("chunk_eval", not_differentiable=True, grad_free=True)
 def _chunk_eval(ctx, ins, attrs):
     """reference: chunk_eval_op.h — chunking precision/recall/F1.
-    Dense redesign: Inference/Label [b, T] + SeqLength [b]; IOB scheme:
-    tag = type * num_tag + {0: B, 1: I}; excluded_chunk_types in attrs."""
+    Dense redesign: Inference/Label [b, T] + SeqLength [b]. All four
+    reference schemes: tag = type * num_tag + tag_idx with
+      IOB   (num_tag=2): 0=B, 1=I
+      IOE   (num_tag=2): 0=I, 1=E
+      IOBES (num_tag=4): 0=B, 1=I, 2=E, 3=S
+      plain (num_tag=1): the tag IS the type."""
     inf = ins["Inference"][0].reshape(
         ins["Inference"][0].shape[0], -1).astype(jnp.int32)
     lab = ins["Label"][0].reshape(inf.shape).astype(jnp.int32)
@@ -227,23 +231,36 @@ def _chunk_eval(ctx, ins, attrs):
         if "SeqLength" in ins else jnp.full((b,), t, jnp.int32)
     num_types = int(attrs.get("num_chunk_types", 1))
     scheme = attrs.get("chunk_scheme", "IOB")
-    if scheme != "IOB":
-        raise NotImplementedError("chunk_eval supports the IOB scheme")
-    other = num_types * 2  # the O tag
+    num_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}.get(scheme)
+    if num_tag is None:
+        raise ValueError(f"chunk_eval: unknown chunk_scheme {scheme!r}")
+    other = num_types * num_tag  # the O tag
 
     valid = jnp.arange(t)[None, :] < lengths[:, None]
 
     def starts(seq):
-        ty = seq // 2
-        is_b = (seq % 2 == 0) & (seq < other)
+        ty = seq // num_tag
+        tag = seq % num_tag
+        in_chunk = seq < other
         prev = jnp.concatenate([jnp.full((b, 1), other, jnp.int32),
                                 seq[:, :-1]], axis=1)
-        prev_ty = prev // 2
-        prev_in_chunk = prev < other
-        is_i = (seq % 2 == 1) & (seq < other)
-        # chunk starts at B, or at I following O / different type
-        start = is_b | (is_i & (~prev_in_chunk | (prev_ty != ty)))
-        return start & valid, ty
+        prev_ty = prev // num_tag
+        prev_tag = prev % num_tag
+        prev_in = prev < other
+        if scheme == "IOB":
+            # starts at B, or at I following O / a different type
+            start = (tag == 0) | ((tag == 1)
+                                  & (~prev_in | (prev_ty != ty)))
+        elif scheme == "IOE":
+            # E ends a chunk: the NEXT in-chunk position starts a new one
+            prev_closed = prev_in & (prev_tag == 1)
+            start = ~prev_in | (prev_ty != ty) | prev_closed
+        elif scheme == "IOBES":
+            prev_cont = prev_in & (prev_ty == ty) & (prev_tag <= 1)
+            start = (tag == 0) | (tag == 3) | ~prev_cont
+        else:  # plain: every maximal same-type run
+            start = ~prev_in | (prev_ty != ty)
+        return (start & in_chunk) & valid, ty
 
     inf_in = (inf < other) & valid
     lab_in = (lab < other) & valid

@@ -1,0 +1,127 @@
+"""In-graph streaming metric ops (reference: operators/metrics/).
+
+accuracy lives in nn_extra_ops; this module adds the STATEFUL pair — auc
+and precision_recall — whose accumulator buffers are persistable scope
+vars updated in place each step, exactly the reference's
+StatPos/StatNeg/StatesInfo model (metrics/auc_op.h:40, the outputs alias
+the persistable stat inputs). On TPU the whole update is a couple of
+scatter-adds + cumsums inside the step's jitted computation — no host
+round-trip per batch.
+"""
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("auc", not_differentiable=True, grad_free=True,
+             is_optimizer_op=True)
+def _auc(ctx, ins, attrs):
+    """reference: metrics/auc_op.h — bucketized ROC (or PR) AUC.
+
+    Predict [n, 1 or 2] (last column = positive-class prob), Label [n, 1]
+    int; StatPos/StatNeg int64 accumulators:
+      slide_steps == 0: [1, num_thresholds+1] running totals;
+      slide_steps == k: [k, num_thresholds+1] ring of the last k batch
+      histograms (the reference keeps the same k blocks flattened).
+    """
+    pred = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = int(attrs.get("num_thresholds", 4095))
+    slide_steps = int(attrs.get("slide_steps", 0))
+    buckets = num_thresholds + 1
+
+    p = pred.reshape(pred.shape[0], -1)[:, -1].astype(jnp.float32)
+    bins = jnp.clip((p * num_thresholds).astype(jnp.int32), 0,
+                    num_thresholds)
+    is_pos = (label != 0).astype(stat_pos.dtype)
+    pos_hist = jnp.zeros((buckets,), stat_pos.dtype).at[bins].add(is_pos)
+    neg_hist = jnp.zeros((buckets,), stat_neg.dtype).at[bins].add(1 - is_pos)
+
+    if slide_steps == 0:
+        new_pos = stat_pos.reshape(-1) + pos_hist
+        new_neg = stat_neg.reshape(-1) + neg_hist
+        eff_pos, eff_neg = new_pos, new_neg
+        pos_out = new_pos.reshape(stat_pos.shape)
+        neg_out = new_neg.reshape(stat_neg.shape)
+    else:
+        ring_p = stat_pos.reshape(slide_steps, buckets)
+        ring_n = stat_neg.reshape(slide_steps, buckets)
+        ring_p = jnp.concatenate([ring_p[1:], pos_hist[None]], axis=0)
+        ring_n = jnp.concatenate([ring_n[1:], neg_hist[None]], axis=0)
+        eff_pos = ring_p.sum(axis=0)
+        eff_neg = ring_n.sum(axis=0)
+        pos_out = ring_p.reshape(stat_pos.shape)
+        neg_out = ring_n.reshape(stat_neg.shape)
+
+    # trapezoid sweep from the highest threshold down (auc_op.h calcAuc):
+    # cumulative TP/FP counts are reversed cumsums over the buckets
+    pos_rev = eff_pos[::-1].astype(jnp.float64 if eff_pos.dtype ==
+                                   jnp.int64 else jnp.float32)
+    neg_rev = eff_neg[::-1].astype(pos_rev.dtype)
+    pc = jnp.cumsum(pos_rev)
+    nc = jnp.cumsum(neg_rev)
+    pc_prev = pc - pos_rev
+    nc_prev = nc - neg_rev
+    area = jnp.sum(jnp.abs(nc - nc_prev) * (pc + pc_prev) / 2.0)
+    tot_pos, tot_neg = pc[-1], nc[-1]
+    auc = jnp.where((tot_pos > 0) & (tot_neg > 0),
+                    area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    # the reference emits double; f32 here (f64 only under jax x64)
+    return {"AUC": [auc],
+            "StatPosOut": [pos_out], "StatNegOut": [neg_out]}
+
+
+def _pr_metrics(states):
+    """[C, 4] TP/FP/TN/FN -> the 6 metrics (precision_recall_op.h
+    ComputeMetrics): macro P/R/F1 then micro P/R/F1."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+
+    def prec(t, f):
+        return jnp.where((t > 0) | (f > 0), t / jnp.maximum(t + f, 1e-30),
+                         1.0)
+
+    def f1(p, r):
+        return jnp.where((p + r) > 0, 2 * p * r / jnp.maximum(p + r, 1e-30),
+                         0.0)
+
+    macro_p = jnp.mean(prec(tp, fp))
+    macro_r = jnp.mean(prec(tp, fn))
+    micro_p = prec(tp.sum(), fp.sum())
+    micro_r = prec(tp.sum(), fn.sum())
+    return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                      micro_p, micro_r, f1(micro_p, micro_r)])
+
+
+@register_op("precision_recall", not_differentiable=True, grad_free=True,
+             is_optimizer_op=True)
+def _precision_recall(ctx, ins, attrs):
+    """reference: metrics/precision_recall_op.h — per-class TP/FP/TN/FN
+    accumulation + macro/micro precision, recall, F1. Indices [n, 1] =
+    predicted class, Labels [n, 1], optional Weights [n, 1], StatesInfo
+    [C, 4] persistable accumulator."""
+    ids = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    c = int(attrs["class_number"])
+    w = (ins["Weights"][0].reshape(-1).astype(jnp.float32)
+         if "Weights" in ins else jnp.ones(ids.shape, jnp.float32))
+    match = (ids == labels)
+    wm = w * match
+    wx = w * (~match)
+    tp = jnp.zeros((c,), jnp.float32).at[ids].add(wm)
+    fp = jnp.zeros((c,), jnp.float32).at[ids].add(wx)
+    fn = jnp.zeros((c,), jnp.float32).at[labels].add(wx)
+    # TN: every sample credits all classes, debited at its predicted class
+    # and (on mismatch) at its label class
+    tn = (jnp.sum(w) - jnp.zeros((c,), jnp.float32).at[ids].add(w)
+          - jnp.zeros((c,), jnp.float32).at[labels].add(wx))
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+
+    accum = batch_states
+    if "StatesInfo" in ins and ins["StatesInfo"]:
+        accum = accum + ins["StatesInfo"][0].astype(jnp.float32)
+    return {"BatchMetrics": [_pr_metrics(batch_states)],
+            "AccumMetrics": [_pr_metrics(accum)],
+            "AccumStatesInfo": [accum]}

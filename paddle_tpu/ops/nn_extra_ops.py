@@ -288,16 +288,18 @@ def _similarity_focus(ctx, ins, attrs):
     x = ins["X"][0]                 # [n, c, a, b]
     axis = int(attrs.get("axis", 1))
     indexes = [int(i) for i in attrs.get("indexes", [0])]
-    n, c, a, b = x.shape
-    if axis != 1:
-        raise NotImplementedError("similarity_focus supports axis=1")
+    if axis not in (1, 2, 3):
+        raise ValueError(f"similarity_focus: axis must be 1, 2 or 3, "
+                         f"got {axis}")
+    # the two non-batch dims a plane spans, given the sliced axis
+    plane_axes = {1: (2, 3), 2: (1, 3), 3: (1, 2)}[axis]
     mask = jnp.zeros_like(x)
     for idx in indexes:
-        plane = x[:, idx]          # [n, a, b]
+        plane = jnp.take(x, idx, axis=axis)   # [n, d1, d2]
         row_max = plane.max(axis=2, keepdims=True)
         col_max = plane.max(axis=1, keepdims=True)
         m = ((plane == row_max) | (plane == col_max)).astype(x.dtype)
-        mask = jnp.maximum(mask, m[:, None, :, :])
+        mask = jnp.maximum(mask, jnp.expand_dims(m, axis))
     return {"Out": [mask]}
 
 
@@ -618,8 +620,12 @@ def _deformable_conv(ctx, ins, attrs):
     oc, _, kh, kw = filt.shape
     oh = (h + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
     ow = (w + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
-    if dg != 1:
-        raise NotImplementedError("deformable_conv: deformable_groups=1")
+    if c % dg != 0:
+        raise ValueError(
+            f"deformable_conv: channels {c} not divisible by "
+            f"deformable_groups {dg}")
+    cg = c // dg  # channels per deformable group (each group has its own
+    # offset/mask planes: Offset[:, 2*g*kh*kw : 2*(g+1)*kh*kw])
 
     base_y = (jnp.arange(oh) * stride[0] - padding[0])
     base_x = (jnp.arange(ow) * stride[1] - padding[1])
@@ -648,14 +654,19 @@ def _deformable_conv(ctx, ins, attrs):
         for ki in range(kh):
             for kj in range(kw):
                 k_idx = ki * kw + kj
-                dy = off[2 * k_idx]
-                dx = off[2 * k_idx + 1]
-                yy = base_y[:, None] + ki * dilation[0] + dy
-                xx = base_x[None, :] + kj * dilation[1] + dx
-                v = sample(img, yy, xx)             # [c, oh, ow]
-                if mk is not None:
-                    v = v * mk[k_idx][None]
-                cols.append(v)
+                group_vals = []
+                for g in range(dg):
+                    gk = g * kh * kw + k_idx
+                    dy = off[2 * gk]
+                    dx = off[2 * gk + 1]
+                    yy = base_y[:, None] + ki * dilation[0] + dy
+                    xx = base_x[None, :] + kj * dilation[1] + dx
+                    v = sample(img[g * cg:(g + 1) * cg], yy, xx)
+                    if mk is not None:
+                        v = v * mk[gk][None]
+                    group_vals.append(v)            # [cg, oh, ow]
+                cols.append(jnp.concatenate(group_vals, axis=0)
+                            if dg > 1 else group_vals[0])
         col = jnp.stack(cols, axis=1)               # [c, kh*kw, oh, ow]
         return jnp.einsum("ckhw,fck->fhw",
                           col, filt.reshape(oc, c, kh * kw))

@@ -75,17 +75,27 @@ def _conv2d_transpose(ctx, ins, attrs):
     s = tuple(attrs.get("strides", [1, 1]))
     p = attrs.get("paddings", [0, 0])
     dil = tuple(attrs.get("dilations", [1, 1]))
-    groups = attrs.get("groups", 1)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose TBD")
+    groups = int(attrs.get("groups", 1))
     kh, kw = w.shape[2], w.shape[3]
-    wf = jnp.flip(w, axis=(2, 3)).transpose(1, 0, 2, 3)  # -> OIHW
+    wf = jnp.flip(w, axis=(2, 3))                        # [C_in, C_out/g,...]
+    if groups == 1:
+        wf = wf.transpose(1, 0, 2, 3)                    # -> OIHW
+    else:
+        # per-group IO swap: [g, C_in/g, C_out/g, kh, kw] -> concat over
+        # groups of [C_out/g, C_in/g, kh, kw] gives OIHW with
+        # O = C_out (group-major), I = C_in/g — the layout
+        # feature_group_count expects
+        cin = wf.shape[0]
+        wg = wf.reshape(groups, cin // groups, *wf.shape[1:])
+        wf = wg.transpose(0, 2, 1, 3, 4).reshape(
+            groups * wf.shape[1], cin // groups, kh, kw)
     eh = dil[0] * (kh - 1)
     ew = dil[1] * (kw - 1)
     pad = [(eh - p[0], eh - p[0]), (ew - p[1], ew - p[1])]
     out = jax.lax.conv_general_dilated(
         x, wf, window_strides=(1, 1), padding=pad, lhs_dilation=s,
-        rhs_dilation=dil, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return {"Output": [out]}
 
 
@@ -121,6 +131,16 @@ def _pool2d(ctx, ins, attrs):
         else:
             out = jnp.mean(x, axis=sp_axes, keepdims=True)
         return {"Out": [out]}
+    if attrs.get("adaptive", False):
+        if fmt == "NHWC":
+            xt = jnp.transpose(x, (0, 3, 1, 2))
+            out = _adaptive_pool2d(ctx, {"X": [xt]},
+                                   {"pooling_size": attrs["ksize"],
+                                    "pooling_type": ptype})["Out"][0]
+            return {"Out": [jnp.transpose(out, (0, 2, 3, 1))]}
+        return _adaptive_pool2d(ctx, {"X": [x]},
+                                {"pooling_size": attrs["ksize"],
+                                 "pooling_type": ptype})
     ksize = tuple(attrs["ksize"])
     strides = tuple(attrs.get("strides", ksize))
     p = attrs.get("paddings", [0, 0])
@@ -166,17 +186,45 @@ def _pool2d(ctx, ins, attrs):
 
 @register_op("adaptive_pool2d")
 def _adaptive_pool2d(ctx, ins, attrs):
+    """reference: pool_op.cc adaptive=True — output bin i covers input
+    range [floor(i*H/oh), ceil((i+1)*H/oh)). Divisible sizes reduce to a
+    reshape; otherwise avg pools through two small (static) membership
+    matmuls and max through per-bin slice maxima (bins are trace-time
+    constants, so XLA sees a fixed fused graph either way)."""
     x = ins["X"][0]
-    oh, ow = attrs["pooling_size"]
+    oh, ow = (int(d) for d in attrs["pooling_size"])
     n, c, h, w = x.shape
+    ptype = attrs.get("pooling_type", "avg")
     if h % oh == 0 and w % ow == 0:
         xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
-        if attrs.get("pooling_type", "avg") == "max":
+        if ptype == "max":
             out = jnp.max(xr, axis=(3, 5))
         else:
             out = jnp.mean(xr, axis=(3, 5))
         return {"Out": [out]}
-    raise NotImplementedError("adaptive pool with non-divisible sizes")
+
+    def bins(in_dim, out_dim):
+        lo = [(i * in_dim) // out_dim for i in range(out_dim)]
+        hi = [-(-((i + 1) * in_dim) // out_dim) for i in range(out_dim)]
+        return lo, hi
+
+    hlo, hhi = bins(h, oh)
+    wlo, whi = bins(w, ow)
+    if ptype == "max":
+        rows = [jnp.max(x[:, :, a:bq], axis=2) for a, bq in zip(hlo, hhi)]
+        xh = jnp.stack(rows, axis=2)                     # [n, c, oh, w]
+        cols = [jnp.max(xh[:, :, :, a:bq], axis=3)
+                for a, bq in zip(wlo, whi)]
+        return {"Out": [jnp.stack(cols, axis=3)]}
+    mh = np.zeros((oh, h), np.float32)
+    for i, (a, bq) in enumerate(zip(hlo, hhi)):
+        mh[i, a:bq] = 1.0 / (bq - a)
+    mw = np.zeros((ow, w), np.float32)
+    for i, (a, bq) in enumerate(zip(wlo, whi)):
+        mw[i, a:bq] = 1.0 / (bq - a)
+    out = jnp.einsum("oh,nchw,pw->ncop", jnp.asarray(mh, x.dtype), x,
+                     jnp.asarray(mw, x.dtype))
+    return {"Out": [out]}
 
 
 # ---------------------------------------------------------------------------
@@ -568,16 +616,24 @@ def _conv3d_transpose(ctx, ins, attrs):
     s3 = tuple(attrs.get("strides", [1, 1, 1]))
     p = attrs.get("paddings", [0, 0, 0])
     dil = tuple(attrs.get("dilations", [1, 1, 1]))
-    if attrs.get("groups", 1) != 1:
-        raise NotImplementedError("grouped conv3d_transpose TBD")
-    wf = jnp.flip(w, axis=(2, 3, 4)).transpose(1, 0, 2, 3, 4)  # -> OIDHW
+    groups = int(attrs.get("groups", 1))
+    wf = jnp.flip(w, axis=(2, 3, 4))
+    if groups == 1:
+        wf = wf.transpose(1, 0, 2, 3, 4)  # -> OIDHW
+    else:
+        # same per-group IO swap as conv2d_transpose
+        cin = wf.shape[0]
+        wg = wf.reshape(groups, cin // groups, *wf.shape[1:])
+        wf = wg.transpose(0, 2, 1, 3, 4, 5).reshape(
+            groups * wf.shape[1], cin // groups, *wf.shape[2:])
     pad = []
     for i in range(3):
         e = dil[i] * (w.shape[2 + i] - 1)
         pad.append((e - p[i], e - p[i]))
     out = jax.lax.conv_general_dilated(
         x, wf, window_strides=(1, 1, 1), padding=pad, lhs_dilation=s3,
-        rhs_dilation=dil, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
     return {"Output": [out]}
 
 

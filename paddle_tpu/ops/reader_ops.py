@@ -1,0 +1,209 @@
+"""In-program reader ops (reference: operators/reader/).
+
+The round-1 design made PyReader iterable-only (reader/py_reader.py) on
+the grounds that one-jitted-step execution has no interpreter loop for an
+in-graph read op to live in. The host-op boundary (registry.
+register_host_op) restores the reference's non-iterable form faithfully:
+`read` runs on the host immediately before the jitted step and injects the
+popped batch into the feed dict — the same position in the step the
+reference's ReadOp::RunImpl occupies (reader/read_op.cc), without any
+device-side machinery.
+
+Reader VALUES in the scope are _ReaderState objects (python-level, never
+traced), mirroring the reference's ReaderHolder scope vars.
+"""
+
+import gzip
+import queue as _queue
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.registry import register_host_op, lower_op, LowerContext
+
+
+class _ReaderState:
+    """Scope-resident reader: pop() -> {var_name: np.ndarray} or None."""
+
+    def __init__(self, source, out_names):
+        self._source = source          # iterator of feed dicts / tuples
+        self.out_names = list(out_names)
+
+    def pop(self):
+        try:
+            item = next(self._source)
+        except StopIteration:
+            return None
+        if isinstance(item, dict):
+            return item
+        return dict(zip(self.out_names, item))
+
+
+@register_host_op("create_py_reader")
+def _create_py_reader(op, scope, feed):
+    """reference: reader/create_py_reader_op.cc — turn the blocking queue
+    var (fed by PyReader.start()'s thread) into a reader var."""
+    qname = op.input("blocking_queue")[0] if op.inputs.get(
+        "blocking_queue") else op.attrs.get("queue_name")
+    q = scope.find_var(qname)
+    if q is None:
+        raise RuntimeError(
+            f"create_py_reader: queue var {qname!r} not in scope; call "
+            "PyReader.start() first")
+    out_names = op.attrs.get("out_names", [])
+
+    def drain():
+        while True:
+            item = q.get()
+            if item is None:     # sentinel from PyReader exhaustion
+                return
+            yield item
+
+    scope.set_var(op.output("Out")[0], _ReaderState(drain(), out_names))
+
+
+@register_host_op("create_double_buffer_reader")
+def _create_double_buffer_reader(op, scope, feed):
+    """reference: reader/create_double_buffer_reader_op.cc — prefetch one
+    batch ahead on a background thread (host->device overlap; the device
+    side overlaps anyway via JAX async dispatch)."""
+    import threading
+    inner = scope.find_var(op.input("UnderlyingReader")[0])
+    buf = _queue.Queue(maxsize=2)
+
+    def pump():
+        while True:
+            item = inner.pop()
+            buf.put(item)
+            if item is None:
+                return
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def gen():
+        while True:
+            item = buf.get()
+            if item is None:
+                return
+            yield item
+
+    scope.set_var(op.output("Out")[0],
+                  _ReaderState(gen(), inner.out_names))
+
+
+@register_host_op("create_custom_reader")
+def _create_custom_reader(op, scope, feed):
+    """reference: reader/create_custom_reader_op.cc — run a user sub-block
+    over every batch (source vars in, sink vars out). The sub-block's ops
+    lower EAGERLY here (jax eager mode) — a per-batch preprocessing
+    program, exactly the reference's nested-executor semantics."""
+    inner = scope.find_var(op.input("UnderlyingReader")[0])
+    program = op.block.program
+    sub = program.blocks[op.attrs["sub_block"]]
+    sources = list(op.attrs["source_var_names"])
+    sinks = list(op.attrs["sink_var_names"])
+
+    def gen():
+        import jax
+        while True:
+            item = inner.pop()
+            if item is None:
+                return
+            vals = (list(item.values()) if isinstance(item, dict)
+                    else list(item))
+            env = {n: jnp.asarray(v) for n, v in zip(sources, vals)}
+            ctx = LowerContext()
+            ctx._rng_key = jax.random.PRNGKey(0)
+            for sop in sub.ops:
+                lower_op(ctx, sop, env)
+            yield {n: np.asarray(env[n]) for n in sinks}
+
+    scope.set_var(op.output("Out")[0], _ReaderState(gen(), sinks))
+
+
+def _parse_ctr_lines(lines, file_format, slots):
+    """svm: 'label slot:feasign slot:feasign...';
+    csv: 'label,id,id,...' (ids assigned to slots round-robin)."""
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        if file_format == "svm":
+            parts = ln.split()
+            label = int(float(parts[0]))
+            per_slot = {s: [] for s in slots}
+            for tok in parts[1:]:
+                s, v = tok.split(":", 1)
+                if s in per_slot:
+                    per_slot[s].append(int(v))
+            yield label, [per_slot[s] for s in slots]
+        else:  # csv
+            parts = ln.split(",")
+            label = int(float(parts[0]))
+            ids = [int(float(p)) for p in parts[1:]]
+            yield label, [ids[i::len(slots)] for i in range(len(slots))]
+
+
+@register_host_op("create_ctr_reader")
+def _create_ctr_reader(op, scope, feed):
+    """reference: reader/create_ctr_reader_op.cc — parse CTR log files
+    (svm/csv, plain or gzip) into (label, per-slot sparse id) batches.
+    Dense form: each slot becomes [batch, max_ids] int64 padded with 0."""
+    files = list(op.attrs.get("file_list", []))
+    slots = [str(s) for s in op.attrs.get("sparse_slots",
+                                          op.attrs.get("slots", []))]
+    batch_size = int(op.attrs.get("batch_size", 32))
+    file_format = op.attrs.get("file_format", "csv")
+    file_type = op.attrs.get("file_type", "plain")
+    out_names = op.attrs.get("out_names", [])
+
+    def gen():
+        buf = []
+        for path in files:
+            opener = gzip.open if file_type == "gzip" else open
+            with opener(path, "rt") as f:
+                for rec in _parse_ctr_lines(f, file_format, slots):
+                    buf.append(rec)
+                    if len(buf) == batch_size:
+                        yield _ctr_batch(buf, slots)
+                        buf = []
+        if buf:
+            yield _ctr_batch(buf, slots)
+
+    def _ctr_batch(buf, slots):
+        labels = np.asarray([r[0] for r in buf], np.int64).reshape(-1, 1)
+        outs = [labels]
+        for si in range(len(slots)):
+            width = max(max((len(r[1][si]) for r in buf), default=1), 1)
+            m = np.zeros((len(buf), width), np.int64)
+            for bi, r in enumerate(buf):
+                ids = r[1][si]
+                m[bi, :len(ids)] = ids
+            outs.append(m)
+        return tuple(outs)
+
+    names = out_names or ["label"] + [f"slot_{s}" for s in slots]
+    scope.set_var(op.output("Out")[0], _ReaderState(gen(), names))
+
+
+@register_host_op("read")
+def _read(op, scope, feed):
+    """reference: reader/read_op.cc — pop one batch from the reader var
+    and bind it to the out vars; raises EOFError at exhaustion (the
+    reference throws EOFException for the train loop to catch)."""
+    reader = scope.find_var(op.input("Reader")[0])
+    if reader is None:
+        raise RuntimeError(
+            f"read: reader var {op.input('Reader')[0]!r} not in scope")
+    batch = reader.pop()
+    if batch is None:
+        raise EOFError("read op: reader exhausted (end of epoch)")
+    out_names = op.output("Out")
+    vals = (list(batch.values()) if isinstance(batch, dict)
+            else list(batch))
+    if len(vals) < len(out_names):
+        raise RuntimeError(
+            f"read: reader produced {len(vals)} slots for "
+            f"{len(out_names)} out vars")
+    for n, v in zip(out_names, vals):
+        feed[n] = np.asarray(v)

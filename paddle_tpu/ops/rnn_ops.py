@@ -19,40 +19,69 @@ import jax.numpy as jnp
 from ..framework.registry import register_op
 
 
-@register_op("dynamic_lstm", no_grad_inputs={"SequenceLength"},
-             non_diff_outputs={"LastH", "LastC"})
-def _dynamic_lstm(ctx, ins, attrs):
-    """Input: pre-projected gates [b, s, 4h] (x @ Wx done by an fc outside,
-    as in the reference's dynamic_lstm); Weight [h, 4h] recurrent; Bias
-    [1, 4h]. Gate order i, f, c, o. Outputs Hidden [b, s, h], Cell."""
-    x = ins["Input"][0]
-    w = ins["Weight"][0]
-    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+def ragged_flip(x, lengths):
+    """Reverse each row's valid prefix [0, len) along axis 1, keeping
+    padding in place — the per-sequence reversal a reverse-direction RNN
+    needs on right-padded batches (whole-axis flip would move real steps
+    past the t<len freeze mask)."""
+    if lengths is None:
+        return jnp.flip(x, axis=1)
+    s = x.shape[1]
+    ln = lengths.reshape(-1)
+    steps = jnp.arange(s)[None, :]
+    idx = jnp.where(steps < ln[:, None], ln[:, None] - 1 - steps, steps)
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+
+
+def lstm_scan(x, w, bias, h0, c0, lengths=None, use_peepholes=False,
+              gate_act="sigmoid", cell_act="tanh", cand_act="tanh",
+              is_reverse=False):
+    """Shared LSTM recurrence (one lax.scan): x [b, s, 4h] pre-projected
+    gates in order i, f, c, o; w [h, 4h] recurrent weights. With
+    use_peepholes, bias is [1, 7h] = [gate bias 4h | W_ic | W_fc | W_oc]
+    (the reference's packing, math/lstm_compute.h): i/f gates peek at the
+    PREVIOUS cell state, o at the NEW one. Used by dynamic_lstm and the
+    fused lstm family (fused/fusion_lstm_op.cc)."""
     b, s, four_h = x.shape
     h_size = four_h // 4
-    lengths = ins["SequenceLength"][0] if "SequenceLength" in ins else None
+    if bias is not None:
+        bias = bias.reshape(-1)
+        gate_bias = bias[:4 * h_size]
+        if use_peepholes:
+            w_ic = bias[4 * h_size:5 * h_size]
+            w_fc = bias[5 * h_size:6 * h_size]
+            w_oc = bias[6 * h_size:7 * h_size]
+    elif use_peepholes:
+        raise ValueError("peephole lstm requires the [1, 7h] Bias input")
+    if h0 is None:
+        h0 = jnp.zeros((b, h_size), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, h_size), x.dtype)
+    g_act, c_act, d_act = _ACTS[gate_act], _ACTS[cand_act], _ACTS[cell_act]
 
-    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((b, h_size), x.dtype)
-    c0 = ins["C0"][0] if "C0" in ins else jnp.zeros((b, h_size), x.dtype)
-
-    use_peepholes = attrs.get("use_peepholes", False)
-    if use_peepholes:
-        raise NotImplementedError("peephole lstm TBD")
-
+    if is_reverse:
+        x = ragged_flip(x, lengths)
     xs = jnp.swapaxes(x, 0, 1)  # [s, b, 4h]
 
     def step(carry, inp):
         h, c, t = carry
         gates = inp + h @ w
         if bias is not None:
-            gates = gates + bias
+            gates = gates + gate_bias
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i = jax.nn.sigmoid(i)
-        f = jax.nn.sigmoid(f)
-        g = jnp.tanh(g)
-        o = jax.nn.sigmoid(o)
+        if use_peepholes:
+            i = i + w_ic * c
+            f = f + w_fc * c
+        i = g_act(i)
+        f = g_act(f)
+        g = c_act(g)
         c_new = f * c + i * g
-        h_new = o * jnp.tanh(c_new)
+        if use_peepholes:
+            o = o + w_oc * c_new
+        o = g_act(o)
+        h_new = o * d_act(c_new)
         if lengths is not None:
             m = (t < lengths).astype(x.dtype)[:, None]
             c_new = m * c_new + (1 - m) * c
@@ -63,6 +92,32 @@ def _dynamic_lstm(ctx, ins, attrs):
         step, (h0, c0, jnp.zeros((), jnp.int32)), xs)
     hidden = jnp.swapaxes(hs, 0, 1)
     cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hidden = ragged_flip(hidden, lengths)
+        cell = ragged_flip(cell, lengths)
+    return hidden, cell, h_last, c_last
+
+
+@register_op("dynamic_lstm", no_grad_inputs={"SequenceLength"},
+             non_diff_outputs={"LastH", "LastC"})
+def _dynamic_lstm(ctx, ins, attrs):
+    """Input: pre-projected gates [b, s, 4h] (x @ Wx done by an fc outside,
+    as in the reference's dynamic_lstm); Weight [h, 4h] recurrent; Bias
+    [1, 4h], or [1, 7h] with use_peepholes (reference lstm_op.cc). Gate
+    order i, f, c, o. Outputs Hidden [b, s, h], Cell."""
+    x = ins["Input"][0]
+    lengths = ins["SequenceLength"][0] if "SequenceLength" in ins else None
+    hidden, cell, h_last, c_last = lstm_scan(
+        x, ins["Weight"][0],
+        ins["Bias"][0] if "Bias" in ins else None,
+        ins["H0"][0] if "H0" in ins else None,
+        ins["C0"][0] if "C0" in ins else None,
+        lengths=lengths,
+        use_peepholes=attrs.get("use_peepholes", False),
+        gate_act=attrs.get("gate_activation", "sigmoid"),
+        cell_act=attrs.get("cell_activation", "tanh"),
+        cand_act=attrs.get("candidate_activation", "tanh"),
+        is_reverse=attrs.get("is_reverse", False))
     return {"Hidden": [hidden], "Cell": [cell],
             "LastH": [h_last], "LastC": [c_last]}
 

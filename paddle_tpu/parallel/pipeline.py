@@ -240,7 +240,13 @@ def compile_pipeline_step(program, meta: PipelineMeta, feed_shapes,
     from jax.sharding import Mesh
     from ..framework.registry import LowerContext, lower_op
 
+    from ..framework.registry import _HOST_OPS
     blk = program.global_block
+    host = [op.type for op in blk.ops if op.type in _HOST_OPS]
+    if host:
+        raise ValueError(
+            f"pipeline programs cannot contain host-boundary op(s) {host} "
+            f"(file IO / RPC / readers); run those in a separate program")
     all_ops = [op for op in blk.ops if op.type not in ("feed", "fetch")]
     fwd_ops = [op for op in all_ops
                if op.attrs.get("op_role") not in ("backward", "optimize",

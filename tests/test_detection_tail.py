@@ -1,0 +1,276 @@
+"""Detection tail ops: generate_proposal_labels, generate_mask_labels,
+roi_perspective_transform, deformable_psroi_pooling, var_conv_2d,
+detection_map (reference tests: test_generate_proposal_labels_op.py,
+test_detection_map_op.py, test_var_conv_2d.py)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _run_op(op_type, ins, out_slots, attrs, fetch, seed=0):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        main.random_seed = seed
+        blk = main.global_block
+        feed = {}
+        in_map = {}
+        for slot, arr in ins.items():
+            nm = f"{op_type}_{slot}"
+            blk.create_var(name=nm, shape=arr.shape, dtype=str(arr.dtype))
+            feed[nm] = arr
+            in_map[slot] = [nm]
+        out_map = {o: [f"{op_type}_{o}"] for o in out_slots}
+        blk.append_op(op_type, in_map, out_map, attrs, infer_shape=False)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed,
+                      fetch_list=[f"{op_type}_{f}" for f in fetch])
+    return [np.asarray(r) for r in res]
+
+
+class TestGenerateProposalLabels(unittest.TestCase):
+    def test_sampling_and_targets(self):
+        rng = np.random.RandomState(0)
+        n, R, G, B, C = 1, 20, 3, 8, 5
+        gt = np.array([[[10, 10, 30, 30], [40, 40, 60, 60],
+                        [0, 0, 15, 15]]], np.float32)
+        gt_cls = np.array([[1, 2, 3]], np.int32)
+        # rois: some overlapping gt well, some background
+        rois = np.concatenate([
+            gt[0] + rng.uniform(-2, 2, (G, 4)).astype(np.float32),
+            rng.uniform(70, 95, (R - G, 4)).astype(np.float32)], 0)
+        rois[:, 2:] = np.maximum(rois[:, 2:], rois[:, :2] + 5)
+        im_info = np.array([[100, 100, 1.0]], np.float32)
+        out = _run_op(
+            "generate_proposal_labels",
+            {"RpnRois": rois[None], "GtClasses": gt_cls, "GtBoxes": gt,
+             "ImInfo": im_info},
+            ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+             "BboxOutsideWeights", "MatchedGtInt32", "FgMask"],
+            {"batch_size_per_im": B, "fg_fraction": 0.5, "fg_thresh": 0.5,
+             "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": C,
+             "use_random": False},
+            ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights"])
+        rois_o, labels, tgts, inw = out
+        self.assertEqual(rois_o.shape, (1, B, 4))
+        self.assertEqual(labels.shape, (1, B))
+        self.assertEqual(tgts.shape, (1, B, 4 * C))
+        # fg labels must be the matched gt classes; at least the 3
+        # gt-overlapping rois (plus appended gts) are fg candidates
+        fg = labels[0][labels[0] > 0]
+        self.assertTrue(len(fg) > 0)
+        self.assertTrue(set(fg).issubset({1, 2, 3}))
+        # fg rows put nonzero weights exactly in their class slot
+        for i in range(B):
+            lab = labels[0, i]
+            if lab > 0:
+                w = inw[0, i].reshape(C, 4)
+                self.assertTrue(np.all(w[lab] == 1.0))
+                self.assertEqual(w.sum(), 4.0)
+            elif lab == 0:
+                self.assertEqual(inw[0, i].sum(), 0.0)
+
+
+class TestGenerateMaskLabels(unittest.TestCase):
+    def test_mask_crops(self):
+        n, G, B, C, res = 1, 2, 4, 3, 4
+        hm = wm = 16
+        segms = np.zeros((n, G, hm, wm), np.float32)
+        segms[0, 0, :8, :8] = 1.0      # gt 0: top-left square
+        segms[0, 1, 8:, 8:] = 1.0      # gt 1: bottom-right square
+        im_info = np.array([[16, 16, 1.0]], np.float32)
+        rois = np.array([[[0, 0, 8, 8], [8, 8, 15, 15],
+                          [0, 0, 15, 15], [0, 0, 4, 4]]], np.float32)
+        labels = np.array([[1, 2, 0, -1]], np.int32)
+        matched = np.array([[0, 1, 0, 0]], np.int32)
+        out = _run_op(
+            "generate_mask_labels",
+            {"ImInfo": im_info, "GtSegms": segms, "Rois": rois,
+             "LabelsInt32": labels, "MatchedGtInt32": matched},
+            ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+            {"num_classes": C, "resolution": res},
+            ["RoiHasMaskInt32", "MaskInt32"])
+        has, masks = out
+        np.testing.assert_array_equal(has[0], [1, 1, 0, 0])
+        m0 = masks[0, 0].reshape(C, res, res)
+        # roi 0 covers gt 0's square: its class-1 slot is (mostly) ones
+        self.assertGreater(m0[1].mean(), 0.8)
+        self.assertEqual(m0[0].sum(), 0)
+        # non-fg rows are all -1
+        self.assertTrue(np.all(masks[0, 2] == -1))
+        self.assertTrue(np.all(masks[0, 3] == -1))
+
+
+class TestRoiPerspectiveTransform(unittest.TestCase):
+    def test_axis_aligned_quad_is_crop(self):
+        """A rectangle quad must reduce to a plain bilinear crop/resize."""
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 12, 12).astype("f")
+        # quad covering rows 2..9, cols 3..10 (lt, rt, rb, lb)
+        quad = np.array([[[3, 2, 10, 2, 10, 9, 3, 9]]], np.float32)
+        th = tw = 8
+        out, mask = _run_op(
+            "roi_perspective_transform",
+            {"X": x, "ROIs": quad},
+            ["Out", "Mask", "TransformMatrix", "Out2InIdx",
+             "Out2InWeights"],
+            {"spatial_scale": 1.0, "transformed_height": th,
+             "transformed_width": tw},
+            ["Out", "Mask"])
+        self.assertEqual(out.shape, (1, 1, 2, th, tw))
+        self.assertTrue(np.all(mask == 1))
+        # corners map exactly: out[0,0] == x[:, 2, 3], out[-1,-1] == x[:, 9, 10]
+        np.testing.assert_allclose(out[0, 0, :, 0, 0], x[0, :, 2, 3],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(out[0, 0, :, th - 1, tw - 1],
+                                   x[0, :, 9, 10], rtol=1e-4, atol=1e-4)
+
+
+class TestDeformablePSROIPooling(unittest.TestCase):
+    def test_no_trans_matches_psroi_average(self):
+        """With no_trans and one sample per part, each output bin reads its
+        position-sensitive channel at the bin center."""
+        out_dim, ph, pw = 2, 2, 2
+        C = out_dim * ph * pw
+        H = W = 8
+        x = np.zeros((1, C, H, W), np.float32)
+        for c in range(C):
+            x[0, c] = c + 1  # constant per channel
+        rois = np.array([[[0, 0, 7, 7]]], np.float32)
+        out, cnt = _run_op(
+            "deformable_psroi_pooling",
+            {"Input": x, "ROIs": rois},
+            ["Output", "TopCount"],
+            {"no_trans": True, "spatial_scale": 1.0, "output_dim": out_dim,
+             "pooled_height": ph, "pooled_width": pw, "sample_per_part": 2,
+             "trans_std": 0.0, "group_size": [ph, pw]},
+            ["Output", "TopCount"])
+        self.assertEqual(out.shape, (1, 1, out_dim, ph, pw))
+        # each bin averages a constant channel -> exactly that constant
+        for od in range(out_dim):
+            for iy in range(ph):
+                for ix in range(pw):
+                    chan = (od * ph + iy) * pw + ix
+                    self.assertAlmostEqual(
+                        float(out[0, 0, od, iy, ix]), chan + 1, places=4)
+
+
+class TestVarConv2d(unittest.TestCase):
+    def test_masked_conv(self):
+        rng = np.random.RandomState(2)
+        b, cin, cout, H, W = 2, 2, 3, 6, 6
+        kh = kw = 3
+        x = rng.randn(b, cin, H, W).astype("f")
+        w = rng.randn(cout, cin * kh * kw).astype("f")
+        rows = np.array([4, 6], np.int64)
+        cols = np.array([6, 3], np.int64)
+        out, = _run_op(
+            "var_conv_2d",
+            {"X": x, "ROW": rows, "COLUMN": cols, "W": w},
+            ["Out", "Col"],
+            {"InputChannel": cin, "OutputChannel": cout,
+             "KernelH": kh, "KernelW": kw, "StrideH": 1, "StrideW": 1},
+            ["Out"])
+        self.assertEqual(out.shape, (b, cout, H, W))
+        # outside the valid region the output is zero
+        self.assertTrue(np.all(out[0, :, 4:, :] == 0))
+        self.assertTrue(np.all(out[1, :, :, 3:] == 0))
+        # inside (away from the mask boundary) it equals a plain conv on
+        # the masked input
+        xm = x.copy()
+        xm[0, :, 4:, :] = 0
+        xm[1, :, :, 3:] = 0
+        filt = w.reshape(cout, cin, kh, kw)
+        xp = np.pad(xm, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        ref = np.zeros((b, cout, H, W), np.float32)
+        for bi in range(b):
+            for oc in range(cout):
+                for i in range(H):
+                    for j in range(W):
+                        ref[bi, oc, i, j] = np.sum(
+                            xp[bi, :, i:i + 3, j:j + 3] * filt[oc])
+        np.testing.assert_allclose(out[0, :, :3, :5], ref[0, :, :3, :5],
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestDetectionMap(unittest.TestCase):
+    def test_perfect_detections(self):
+        """Perfect detections at high score -> mAP == 1."""
+        det = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                         [2, 0.8, 0.5, 0.5, 0.9, 0.9],
+                         [0, 0.0, 0, 0, 0, 0]]], np.float32)
+        gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4, 0],
+                        [2, 0.5, 0.5, 0.9, 0.9, 0]]], np.float32)
+        m, = _run_op("detection_map", {"DetectRes": det, "Label": gt},
+                     ["MAP", "AccumPosCount", "AccumTruePos",
+                      "AccumFalsePos"],
+                     {"class_num": 3, "overlap_threshold": 0.5,
+                      "ap_type": "integral"},
+                     ["MAP"])
+        self.assertAlmostEqual(float(m.reshape(())), 1.0, places=4)
+
+    def test_false_positive_lowers_map(self):
+        det = np.array([[[1, 0.95, 0.6, 0.6, 0.9, 0.9],   # fp (wrong place)
+                         [1, 0.9, 0.1, 0.1, 0.4, 0.4],    # tp
+                         [0, 0.0, 0, 0, 0, 0]]], np.float32)
+        gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4, 0]]], np.float32)
+        m, = _run_op("detection_map", {"DetectRes": det, "Label": gt},
+                     ["MAP", "AccumPosCount", "AccumTruePos",
+                      "AccumFalsePos"],
+                     {"class_num": 2, "overlap_threshold": 0.5,
+                      "ap_type": "integral"},
+                     ["MAP"])
+        # one tp at rank 2 behind one fp: AP = 1/2
+        self.assertAlmostEqual(float(m.reshape(())), 0.5, places=3)
+
+    def test_accumulates_across_batches(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            blk = main.global_block
+            blk.create_var(name="dm_det", shape=[1, 2, 6], dtype="float32")
+            blk.create_var(name="dm_gt", shape=[1, 1, 6], dtype="float32")
+            for nm, shape, dt in (("dm_pc", [2], "int64"),
+                                  ("dm_tp", [2, 1000], "int64"),
+                                  ("dm_fp", [2, 1000], "int64")):
+                blk.create_var(name=nm, shape=shape, dtype=dt,
+                               persistable=True)
+                sb = startup.global_block
+                sb.create_var(name=nm, shape=shape, dtype=dt,
+                              persistable=True)
+                sb.append_op("fill_constant", {}, {"Out": [nm]},
+                             {"shape": shape, "dtype": dt, "value": 0})
+            blk.append_op(
+                "detection_map",
+                {"DetectRes": ["dm_det"], "Label": ["dm_gt"],
+                 "PosCount": ["dm_pc"], "TruePos": ["dm_tp"],
+                 "FalsePos": ["dm_fp"]},
+                {"MAP": ["dm_map"], "AccumPosCount": ["dm_pc"],
+                 "AccumTruePos": ["dm_tp"], "AccumFalsePos": ["dm_fp"]},
+                {"class_num": 2, "ap_type": "integral"},
+                infer_shape=False)
+        exe = pt.Executor()
+        gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4, 0]]], np.float32)
+        hit = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                         [0, 0.0, 0, 0, 0, 0]]], np.float32)
+        miss = np.array([[[1, 0.8, 0.6, 0.6, 0.9, 0.9],
+                          [0, 0.0, 0, 0, 0, 0]]], np.float32)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            m1, = exe.run(main, feed={"dm_det": hit, "dm_gt": gt},
+                          fetch_list=["dm_map"])
+            self.assertAlmostEqual(float(np.asarray(m1).reshape(())), 1.0,
+                                   places=4)
+            # second batch: a miss (fp + missed gt). accumulated:
+            # 2 gt, 1 tp@0.9, 1 fp@0.8 -> AP = 0.5
+            m2, = exe.run(main, feed={"dm_det": miss, "dm_gt": gt},
+                          fetch_list=["dm_map"])
+            self.assertAlmostEqual(float(np.asarray(m2).reshape(())), 0.5,
+                                   places=3)
+
+
+if __name__ == "__main__":
+    unittest.main()

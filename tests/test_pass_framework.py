@@ -1,0 +1,145 @@
+"""Generic IR pass framework: PassRegistry, pattern matcher, built-in
+fuse_elewise_add_act, registry-wrapped AMP/quant passes, and a
+USER-DEFINED pattern pass that needs no framework changes (the round-2
+VERDICT item 5 'done' criterion). Reference: ir/pass.h,
+graph_pattern_detector.h, fuse_elewise_add_act_pass.cc."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework import (Pattern, PatternPass, PassRegistry,
+                                  register_pass, apply_pass, find_matches,
+                                  replace_ops)
+
+
+def _simple_add_relu_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [4])
+        z = pt.layers.elementwise_add(x, y)
+        out = pt.layers.relu(z)
+    return main, startup, out
+
+
+class TestFuseElewiseAddAct(unittest.TestCase):
+    def test_fuse_preserves_semantics(self):
+        main, startup, out = _simple_add_relu_program()
+        types_before = [op.type for op in main.global_block.ops]
+        self.assertIn("elementwise_add", types_before)
+        self.assertIn("relu", types_before)
+
+        rng = np.random.RandomState(0)
+        xv = rng.randn(2, 4).astype("f")
+        yv = rng.randn(2, 4).astype("f")
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            ref, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])
+
+        apply_pass("fuse_elewise_add_act", main)
+        types_after = [op.type for op in main.global_block.ops]
+        self.assertIn("fused_elemwise_activation", types_after)
+        self.assertNotIn("elementwise_add", types_after)
+        self.assertNotIn("relu", types_after)
+
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_shared_intermediate_not_fused(self):
+        """If the add's output feeds a second consumer, fusing would drop
+        it — the matcher must refuse (reference pattern-detector's
+        intermediate-node rule)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4])
+            y = pt.layers.data("y", [4])
+            z = pt.layers.elementwise_add(x, y)
+            a = pt.layers.relu(z)
+            b = pt.layers.scale(z, scale=2.0)  # second consumer of z
+        apply_pass("fuse_elewise_add_act", main)
+        types = [op.type for op in main.global_block.ops]
+        self.assertIn("elementwise_add", types)
+
+
+class TestUserDefinedPass(unittest.TestCase):
+    def test_custom_pattern_pass(self):
+        """A user fuse pass — scale(scale(x)) -> one scale — written
+        entirely against the public API."""
+
+        @register_pass("test_fold_double_scale")
+        class FoldDoubleScale(PatternPass):
+            def build_pattern(self, p):
+                s1 = p.op("scale")
+                p.op("scale", inputs={"X": s1.out("Out")})
+
+            def rewrite(self, block, match):
+                s1, s2 = match.ops
+                k = (s1.attrs.get("scale", 1.0)
+                     * s2.attrs.get("scale", 1.0))
+                replace_ops(block, [s1, s2], [{
+                    "type": "scale",
+                    "inputs": {"X": s1.inputs["X"]},
+                    "outputs": {"Out": s2.outputs["Out"]},
+                    "attrs": {"scale": k, "bias": 0.0},
+                }])
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3])
+            out = pt.layers.scale(pt.layers.scale(x, scale=2.0), scale=5.0)
+        n_before = len(main.global_block.ops)
+        apply_pass("test_fold_double_scale", main)
+        self.assertEqual(len(main.global_block.ops), n_before - 1)
+
+        exe = pt.Executor()
+        xv = np.ones((1, 3), np.float32)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, 10.0 * xv)
+
+    def test_matcher_multi_match(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3])
+            a = pt.layers.relu(pt.layers.scale(x, scale=1.0))
+            b = pt.layers.relu(pt.layers.scale(a, scale=2.0))
+        p = Pattern()
+        s = p.op("scale")
+        p.op("relu", inputs={"X": s.out("Out")})
+        matches = find_matches(main.global_block, p)
+        self.assertEqual(len(matches), 2)
+
+    def test_registry_unknown_pass(self):
+        with self.assertRaises(KeyError):
+            apply_pass("no_such_pass", pt.Program())
+
+
+class TestRegistryWrappedPasses(unittest.TestCase):
+    def test_amp_via_registry(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4])
+            y = pt.layers.fc(x, 3)
+        apply_pass("amp_bf16_rewrite", main)
+        types = [op.type for op in main.global_block.ops]
+        self.assertIn("cast", types)
+
+    def test_quant_transform_via_registry(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4])
+            y = pt.layers.fc(x, 3)
+        apply_pass("quant_transform", main, startup=startup)
+        types = [op.type for op in main.global_block.ops]
+        self.assertTrue(any(t.startswith("fake_quantize") for t in types),
+                        types)
+
+
+if __name__ == "__main__":
+    unittest.main()
