@@ -72,6 +72,11 @@ class SAController(EvolutionaryController):
         self._constrain_func = constrain_func
         self._tokens = list(init_tokens)
         self._iter = 0
+        # a reused controller must not carry bests from a previous space
+        # (stale best_tokens could be out of range for the new table)
+        self._reward = -1.0
+        self._max_reward = -1.0
+        self._best_tokens = None
 
     def update(self, tokens, reward):
         """Accept `tokens` if reward improved, else with the annealing
@@ -240,9 +245,13 @@ class LightNASSearcher:
             startup, train, eval_fn = self._space.create_net(tokens)
             if self._target_flops is not None and \
                     flops(train) > self._target_flops:
-                reward = 0.0  # infeasible after max_iter tries
-            else:
-                reward = float(eval_fn(startup, train))
+                # infeasible even after the constrain-loop's retries: do
+                # NOT feed it to the controller — a 0.0 reward would beat
+                # the initial max_reward and leak budget-violating tokens
+                # out as best_tokens
+                self.history.append((list(tokens), None))
+                continue
+            reward = float(eval_fn(startup, train))
             self._controller.update(tokens, reward)
             self.history.append((list(tokens), reward))
         return self._controller.best_tokens, self._controller.max_reward
@@ -285,18 +294,26 @@ class ControllerServer:
             except OSError:
                 return
             with conn:
-                data = conn.recv(4096).decode()
-                parts = data.strip().split("\t")
-                if len(parts) != 3 or parts[0] != self._key:
-                    conn.sendall(b"err\tbad key")
-                    continue
-                tokens = [int(t) for t in parts[1].split(",") if t]
-                reward = float(parts[2])
-                with self._lock:
-                    if tokens:
-                        self._controller.update(tokens, reward)
-                    nxt = self._controller.next_tokens()
-                conn.sendall(",".join(str(t) for t in nxt).encode())
+                # one malformed client must not kill the serve loop (it
+                # would strand every other agent with no visible error)
+                try:
+                    data = conn.recv(4096).decode()
+                    parts = data.strip().split("\t")
+                    if len(parts) != 3 or parts[0] != self._key:
+                        conn.sendall(b"err\tbad key")
+                        continue
+                    tokens = [int(t) for t in parts[1].split(",") if t]
+                    reward = float(parts[2])
+                    with self._lock:
+                        if tokens:
+                            self._controller.update(tokens, reward)
+                        nxt = self._controller.next_tokens()
+                    conn.sendall(",".join(str(t) for t in nxt).encode())
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        conn.sendall(f"err\t{e}".encode())
+                    except OSError:
+                        pass
 
     def close(self):
         self._closed = True
