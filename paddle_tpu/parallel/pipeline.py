@@ -105,10 +105,14 @@ def gpipe_spmd(stage_fn, stacked_params, acts_mb, mesh, axis: str,
 
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
     rep = jax.tree.map(lambda _: P(), acts_mb)
+    # manual ONLY over the pp axis: any other mesh axes (dp/mp in the
+    # combined 3D mode) stay GSPMD-auto, so XLA partitions batch/hidden
+    # dims inside the per-device stage body
     return jax.shard_map(
         per_device, mesh=mesh,
         in_specs=(pspec, rep, None if key_data is None else P()),
-        out_specs=rep, check_vma=False)(stacked_params, acts_mb, key_data)
+        out_specs=rep, check_vma=False,
+        axis_names={axis})(stacked_params, acts_mb, key_data)
 
 
 # ---------------------------------------------------------------------------
@@ -116,11 +120,21 @@ def gpipe_spmd(stage_fn, stacked_params, acts_mb, mesh, axis: str,
 # ---------------------------------------------------------------------------
 
 class PipelineMeta:
-    def __init__(self, cut_vars, num_microbatches, axis, loss_name):
+    def __init__(self, cut_vars, num_microbatches, axis, loss_name,
+                 extra_axes=None, batch_axis=None, param_shardings=None):
         self.cut_vars = cut_vars
         self.num_microbatches = num_microbatches
         self.axis = axis
         self.loss_name = loss_name
+        # combined-mesh mode (3D dp x mp x pp): extra_axes is an ordered
+        # {name: size} placed BEFORE the pp axis in the mesh; batch_axis
+        # names the data-parallel axis feeds shard over; param_shardings
+        # maps param name -> PartitionSpec tuple over the extra axes
+        # (tensor parallelism). pp stays shard_map-manual; the extra axes
+        # are GSPMD-auto, so the two composes in one jit.
+        self.extra_axes = dict(extra_axes or {})
+        self.batch_axis = batch_axis
+        self.param_shardings = dict(param_shardings or {})
 
 
 class PipelineOptimizer:
@@ -131,11 +145,15 @@ class PipelineOptimizer:
 
     def __init__(self, optimizer, cut_list=None, num_microbatches: int = 4,
                  axis: str = "pp", place_list=None, concurrency_list=None,
-                 queue_size=None, start_cpu_core_id=None):
+                 queue_size=None, start_cpu_core_id=None,
+                 extra_axes=None, batch_axis=None, param_shardings=None):
         self._inner = optimizer
         self._cut_list = cut_list or []
         self._m = num_microbatches
         self._axis = axis
+        self._extra_axes = extra_axes
+        self._batch_axis = batch_axis
+        self._param_shardings = param_shardings
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -146,7 +164,10 @@ class PipelineOptimizer:
                      for v in self._cut_list]
         prog = loss.block.program
         prog._pipeline = PipelineMeta(cut_names, self._m, self._axis,
-                                      loss.name)
+                                      loss.name,
+                                      extra_axes=self._extra_axes,
+                                      batch_axis=self._batch_axis,
+                                      param_shardings=self._param_shardings)
         return result
 
 
@@ -301,6 +322,7 @@ def compile_pipeline_step(program, meta: PipelineMeta, feed_shapes,
         return jnp.broadcast_to(x[None], (M,) + x.shape)  # per-step scalars
 
     def step(mut_scope, ro_scope, feed_vals, rng_key):
+        from jax.sharding import NamedSharding, PartitionSpec as P
         scope = {}
         scope.update(ro_scope)
         scope.update(mut_scope)
@@ -309,6 +331,20 @@ def compile_pipeline_step(program, meta: PipelineMeta, feed_shapes,
         params_all = {n: scope[n] for n in train_params if n in scope}
         frozen = {n: scope[n] for n in persist
                   if n in scope and n not in params_all}
+
+        if plan is not None and meta.extra_axes:
+            mesh = plan["mesh"]
+            if meta.batch_axis:
+                # (M, mb, ...) microbatched feeds shard over dp on dim 1
+                feed_mb = {
+                    k: (jax.lax.with_sharding_constraint(
+                        v, NamedSharding(mesh, P(None, meta.batch_axis)))
+                        if v.ndim >= 2 else v)
+                    for k, v in feed_mb.items()}
+            for n, spec in meta.param_shardings.items():
+                if n in params_all:
+                    params_all[n] = jax.lax.with_sharding_constraint(
+                        params_all[n], NamedSharding(mesh, P(*spec)))
 
         def sequential_loss(params_all, key):
             env_base = dict(frozen)
@@ -413,8 +449,17 @@ def _plan_uniform_run(program, stages, smeta, meta, feeds):
     # epilogue reads must be reachable: final slots, prologue outputs,
     # feeds, or persistables (checked at trace time via env lookup)
     from jax.sharding import Mesh
-    devices = jax.devices()[:K]
-    mesh = Mesh(np.asarray(devices).reshape(K), (meta.axis,))
+    extra = meta.extra_axes or {}
+    n_extra = 1
+    for v in extra.values():
+        n_extra *= int(v)
+    need = n_extra * K
+    if len(jax.devices()) < need:
+        return None
+    devices = jax.devices()[:need]
+    shape = tuple(int(v) for v in extra.values()) + (K,)
+    names = tuple(extra.keys()) + (meta.axis,)
+    mesh = Mesh(np.asarray(devices).reshape(shape), names)
 
     return {
         "s": s, "e": e, "K": K, "mesh": mesh,
