@@ -1,8 +1,9 @@
 #!/bin/sh
-# Build the standalone PJRT inference runner.
-#   native/pjrt_runner/build.sh [out_binary]
+# Build the standalone PJRT inference runner + training loop.
+#   native/pjrt_runner/build.sh [out_dir]
 set -e
 cd "$(dirname "$0")"
-OUT="${1:-pjrt_runner}"
-g++ -O2 -std=c++17 -I. pjrt_runner.cc -ldl -o "$OUT"
-echo "built $OUT"
+OUT="${1:-.}"
+g++ -O2 -std=c++17 -I. pjrt_runner.cc -ldl -o "$OUT/pjrt_runner"
+g++ -O2 -std=c++17 -I. pjrt_trainer.cc -ldl -o "$OUT/pjrt_trainer"
+echo "built $OUT/pjrt_runner $OUT/pjrt_trainer"

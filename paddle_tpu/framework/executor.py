@@ -108,6 +108,66 @@ class scope_guard:
 # ---------------------------------------------------------------------------
 
 
+def classify_persistables(program, feed_names: set, fetch_names):
+    """Classify persistable vars for the whole-block jit: a var must come
+    IN from the scope only if some op reads it before any op writes it;
+    vars defined by earlier ops (e.g. params created by startup init ops)
+    are internal. Returns (mutable, created, readonly):
+      mutable  — updated in place: donated in, returned out
+      created  — produced by this program (startup init): out only
+      readonly — read-only constants from the scope
+    Shared by Executor.run and inference.export_train_step so the exported
+    artifact is the Executor's own step, argument-for-argument."""
+    from .registry import _HOST_OPS
+
+    blk = program.global_block
+
+    def _expand(ops):
+        # Flatten macro ops' sub-blocks for read/write classification
+        # (sub-block reads are reads of the enclosing op). The macro op
+        # is yielded BEFORE its sub-block ops: its implicit reads
+        # (carry-in / branch pass-through) happen before any write
+        # inside it.
+        for op in ops:
+            yield op
+            for key in ("sub_block", "sub_block_t", "sub_block_f"):
+                if key in op.attrs:
+                    yield from _expand(program.blocks[op.attrs[key]].ops)
+
+    written = set()
+    external_reads = set()
+    written_so_far = set(feed_names)
+    sub_local = set()
+    for b in program.blocks[1:]:
+        sub_local.update(b.vars)
+    macro_attrs = ("sub_block", "sub_block_t", "sub_block_f")
+    for op in _expand(blk.ops):
+        if op.type in ("feed", "fetch") or op.type in _HOST_OPS:
+            continue
+        reads = list(op.input_names())
+        if any(k in op.attrs for k in macro_attrs):
+            # a macro op's outputs are also implicit reads: while carries
+            # state in, conditional_block's untaken branch passes values
+            # through
+            reads += op.output_names()
+        for n in reads:
+            if n not in written_so_far and n not in sub_local:
+                external_reads.add(n)
+        outs = [n for n in op.output_names() if n not in sub_local]
+        written.update(outs)
+        written_so_far.update(op.output_names())
+    for n in fetch_names:
+        if n not in written_so_far:
+            external_reads.add(n)
+
+    persist = {v.name for v in blk.vars.values() if v.persistable}
+    mutable = sorted((persist & written & external_reads) - feed_names)
+    created = sorted((persist & written) - set(mutable) - feed_names)
+    readonly = sorted((persist & external_reads)
+                      - set(mutable) - feed_names)
+    return mutable, created, readonly
+
+
 def _as_feed_array(value, var: Optional[Variable]):
     import jax
     import jax.numpy as jnp
@@ -213,53 +273,8 @@ class Executor:
             return [np.asarray(scope.find_var(f)) if return_numpy
                     else scope.find_var(f) for f in fetch_names]
 
-        def _expand(ops):
-            """Flatten macro ops' sub-blocks for read/write classification
-            (sub-block reads are reads of the enclosing op). The macro op is
-            yielded BEFORE its sub-block ops: its implicit reads (carry-in /
-            branch pass-through) happen before any write inside it."""
-            for op in ops:
-                yield op
-                for key in ("sub_block", "sub_block_t", "sub_block_f"):
-                    if key in op.attrs:
-                        yield from _expand(
-                            program.blocks[op.attrs[key]].ops)
-
-        # Classify persistables: a var must come IN from the scope only if
-        # some op reads it before any op writes it; vars defined by earlier
-        # ops (e.g. params created by startup init ops) are internal.
-        written = set()
-        external_reads = set()
-        written_so_far = set(feed)
-        sub_local = set()
-        for b in program.blocks[1:]:
-            sub_local.update(b.vars)
-        macro_attrs = ("sub_block", "sub_block_t", "sub_block_f")
-        for op in _expand(blk.ops):
-            if op.type in ("feed", "fetch") or op.type in _HOST_OPS:
-                continue
-            reads = list(op.input_names())
-            if any(k in op.attrs for k in macro_attrs):
-                # a macro op's outputs are also implicit reads: while carries
-                # state in, conditional_block's untaken branch passes values through
-                reads += op.output_names()
-            for n in reads:
-                if n not in written_so_far and n not in sub_local:
-                    external_reads.add(n)
-            outs = [n for n in op.output_names() if n not in sub_local]
-            written.update(outs)
-            written_so_far.update(op.output_names())
-        for n in fetch_names:
-            if n not in written_so_far:
-                external_reads.add(n)
-
-        persist = {v.name for v in blk.vars.values() if v.persistable}
-        # persistables updated in place: donated in, returned out
-        mutable = sorted((persist & written & external_reads) - set(feed))
-        # persistables created by this program (startup init): out only
-        created = sorted((persist & written) - set(mutable) - set(feed))
-        readonly = sorted((persist & external_reads)
-                          - set(mutable) - set(feed))
+        mutable, created, readonly = classify_persistables(
+            program, set(feed), fetch_names)
 
         # ensure rng state
         if "@RNG@" not in scope:

@@ -22,6 +22,7 @@ import numpy as np
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
            "export_stablehlo", "load_stablehlo", "export_native",
+           "export_train_step",
            "PredictorPool"]
 
 
@@ -217,4 +218,179 @@ def export_native(model_dir: str, out_dir: str, batch_size: int = 1) -> str:
     with open(_os.path.join(out_dir, "manifest.json"), "w") as f:
         json.dump({"inputs": inputs_meta, "outputs": outs_meta}, f,
                   indent=1)
+    return out_dir
+
+
+def export_train_step(out_dir: str, main_program, startup_program,
+                      example_feed: Dict[str, "np.ndarray"],
+                      fetch_list: Sequence, seed: int = 0) -> str:
+    """Export the full TRAIN step (fwd + bwd + optimizer, params donated
+    in/out) for the native C++ trainer (native/pjrt_runner/
+    pjrt_trainer.cc) — the reference's C++ training demo story
+    (paddle/fluid/train/demo/demo_trainer.cc), TPU-style: the whole step
+    is ONE StableHLO computation; the C++ side is just the host loop
+    keeping carry buffers on-device between steps.
+
+    Writes to out_dir:
+      model.mlir            the lowered step (input_output_alias carries
+                            the param donation)
+      compile_options.pb
+      manifest.json         flat input/output tensor list + carry map
+                            (output j feeds input i next step) + loss
+                            output indices
+      in<i>.bin             initial value of EVERY input: trained params
+                            + readonly persistables + example feed
+                            batch + the PRNG key state
+
+    The exported computation is the Executor's OWN compiled step (same
+    trace, same donation), so a C++ loop over it reproduces
+    Executor.run() trajectories bit-for-bit on the same backend."""
+    import json
+    import jax
+    import jax.numpy as jnp
+    from jax._src import compiler as _compiler
+
+    from .. import io as _io  # noqa: F401  (parity with export_native)
+    from ..framework.core import Variable
+    from ..framework.executor import (Executor, Scope, scope_guard,
+                                      classify_persistables,
+                                      _as_feed_array)
+
+    if os.environ.get("FLAGS_check_nan_inf", "0") == "1":
+        raise RuntimeError(
+            "export_train_step with FLAGS_check_nan_inf=1 would emit the "
+            "sanitizer's finite-flag outputs into the artifact; unset the "
+            "flag for export")
+
+    exe = Executor()
+    scope = Scope()
+    fetch_names = [f.name if isinstance(f, Variable) else f
+                   for f in fetch_list]
+    with scope_guard(scope):
+        exe.run(startup_program)
+
+        # THE Executor.run classification (shared helper — including
+        # sub-block expansion and read-before-write analysis), so the
+        # exported step is the Executor's own, argument-for-argument
+        blk = main_program.global_block
+        mutable, created, readonly = classify_persistables(
+            main_program, set(example_feed), fetch_names)
+
+        feed_shapes = {k: tuple(np.asarray(v).shape)
+                       for k, v in example_feed.items()}
+        compiled = exe._compile(main_program, feed_shapes, fetch_names,
+                                mutable, created, readonly, None)
+
+        def from_scope(n):
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} not initialized by the "
+                    "startup program; cannot export its carry")
+            return jnp.asarray(v)
+
+        mut_in = {n: from_scope(n) for n in mutable}
+        ro_in = {n: from_scope(n) for n in readonly}
+        # dtype-cast feeds exactly as Executor.run does (f64 numpy feeds
+        # become the data var's f32, etc.)
+        feed_in = {k: _as_feed_array(v, blk.vars.get(k))
+                   for k, v in example_feed.items()}
+        # the PRNG state the Python trajectory would start its first main
+        # step with: the scope's @RNG@ as left by the startup run
+        key = scope.find_var("@RNG@")
+        if key is None:
+            key = jax.random.PRNGKey(main_program.random_seed
+                                     if main_program.random_seed
+                                     else seed)
+
+        args = (mut_in, ro_in, feed_in, key)
+        lowered = compiled.lower(*args)
+        mlir_text = lowered.as_text(dialect="stablehlo")
+
+        # capture the EXACT CompileOptions jax itself compiles this
+        # lowering with (spmd/env-override/logging fields included) so
+        # the C++ trainer's PJRT_Client_Compile reproduces the same
+        # executable — required for bit-identical trajectories
+        captured = {}
+        real_compile = _compiler.compile_or_get_cached
+
+        def spy(backend, computation, devices, compile_options, *a, **kw):
+            captured["opts"] = compile_options
+            return real_compile(backend, computation, devices,
+                                compile_options, *a, **kw)
+
+        _compiler.compile_or_get_cached = spy
+        try:
+            lowered.compile()
+        finally:
+            _compiler.compile_or_get_cached = real_compile
+
+        # flat positional views of inputs/outputs (jax flattens dicts in
+        # sorted-key order; record names so the C++ side can report them)
+        in_leaves, in_tree = jax.tree_util.tree_flatten(args)
+        name_tree = ({n: f"state:{n}" for n in mut_in},
+                     {n: f"const:{n}" for n in ro_in},
+                     {k: f"feed:{k}" for k in feed_in}, "rng")
+        in_names = jax.tree_util.tree_leaves(name_tree)
+        out_shape = jax.eval_shape(compiled, *args)
+        out_leaves, _ = jax.tree_util.tree_flatten(out_shape)
+        # new_mut carries BOTH mutable and created names (executor
+        # out_names = mutable + created); created outputs have no input
+        # to carry into, so they simply drop out of the carry map below
+        out_name_tree = ({n: f"state:{n}" for n in mutable}
+                         | {n: f"created:{n}" for n in created},
+                         list(fetch_names), "rng", {})
+        out_names = jax.tree_util.tree_leaves(out_name_tree)
+        if len(out_names) != len(out_leaves):
+            raise RuntimeError(
+                f"output arity mismatch: {len(out_leaves)} leaves vs "
+                f"{len(out_names)} names — the compiled step emitted "
+                "outputs this exporter does not model")
+
+        # the key-data layout of the ACTIVE prng impl (rbg: (4,) u32,
+        # threefry: (2,) u32) — used for both the in-bin and the output
+        # manifest entry so the carry pair always agrees
+        kd_shape = list(np.asarray(jax.random.key_data(key)).shape)
+
+        def canon(x):
+            # typed PRNG keys lower to their uint32 key data
+            if jnp.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key):
+                data = jax.random.key_data(x)
+                return np.asarray(data), list(data.shape), "uint32"
+            a = np.asarray(x)
+            return a, list(a.shape), str(a.dtype)
+
+        os.makedirs(out_dir, exist_ok=True)
+        inputs_meta = []
+        for i, (leaf, nm) in enumerate(zip(in_leaves, in_names)):
+            a, shape, dt = canon(leaf)
+            inputs_meta.append({"name": nm, "shape": shape, "dtype": dt})
+            a.tofile(os.path.join(out_dir, f"in{i}.bin"))
+        outputs_meta = []
+        for leaf, nm in zip(out_leaves, out_names):
+            if jnp.issubdtype(getattr(leaf, "dtype", None),
+                              jax.dtypes.prng_key):
+                shape, dt = list(leaf.shape) + kd_shape, "uint32"
+            else:
+                shape, dt = list(leaf.shape), str(leaf.dtype)
+            outputs_meta.append({"name": nm, "shape": shape, "dtype": dt})
+
+        # carry map: state + rng outputs feed the same-named inputs
+        in_pos = {nm: i for i, nm in enumerate(in_names)}
+        carry = [[j, in_pos[nm]] for j, nm in enumerate(out_names)
+                 if nm in in_pos and (nm.startswith("state:")
+                                      or nm == "rng")]
+        loss_idx = [j for j, nm in enumerate(out_names)
+                    if nm in fetch_names]
+
+        with open(os.path.join(out_dir, "model.mlir"), "w") as f:
+            f.write(mlir_text)
+        opts = captured.get("opts") or _compiler.get_compile_options(
+            num_replicas=1, num_partitions=1)
+        with open(os.path.join(out_dir, "compile_options.pb"), "wb") as f:
+            f.write(opts.SerializeAsString())
+        with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+            json.dump({"inputs": inputs_meta, "outputs": outputs_meta,
+                       "carry": carry, "loss_outputs": loss_idx}, f,
+                      indent=1)
     return out_dir

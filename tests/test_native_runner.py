@@ -63,7 +63,7 @@ def test_native_runner_matches_python():
     # build + run the C++ loop (no Python in the serving path)
     runner = os.path.join(work, "pjrt_runner")
     subprocess.run(["sh", os.path.join(REPO, "native/pjrt_runner/build.sh"),
-                    runner], check=True, capture_output=True)
+                    work], check=True, capture_output=True)
     env = dict(os.environ)
     env.setdefault("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
     env.setdefault("AXON_LOOPBACK_RELAY", "1")
