@@ -384,7 +384,16 @@ int main(int argc, char** argv) {
       th.dst_size = bytes;
       Check(g_api->PJRT_Buffer_ToHostBuffer(&th), "loss d2h");
       Await(th.event, "loss d2h done");
-      float v = *reinterpret_cast<const float*>(host.data());
+      double v;
+      const std::string& dt = out_meta[li].dtype;
+      if (dt == "float32") {
+        v = *reinterpret_cast<const float*>(host.data());
+      } else if (dt == "float64") {
+        v = *reinterpret_cast<const double*>(host.data());
+      } else {
+        Die("loss output dtype " + dt + " not supported by the trainer "
+            "(fetch a float32/float64 loss)");
+      }
       losses.push_back(v);
       std::printf("step %d loss %.9g\n", step, v);
     }

@@ -1,0 +1,91 @@
+"""Oxford 102 Flowers (reference: python/paddle/dataset/flowers.py).
+Samples: (flattened float32 CHW image, 0-based label). Stage 102flowers
+files (102flowers.tgz, imagelabels.mat, setid.mat) under
+$PADDLE_TPU_DATA_HOME/flowers/."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+_SYNTH_HW = 32
+_SYNTH_CLASSES = 10
+_N_SYNTH = {"train": 120, "test": 30, "valid": 30}
+# setid.mat split keys (reference flowers.py: trnid is the TEST split in
+# the official protocol — kept exactly as the reference maps them)
+_SPLIT_KEY = {"train": "trnid", "test": "tstid", "valid": "valid"}
+
+
+def _synth_reader(split, mapper):
+    def reader():
+        rng = common.synthetic_rng("flowers", split)
+        for _ in range(_N_SYNTH[split]):
+            label = rng.randint(0, _SYNTH_CLASSES)
+            img = rng.uniform(0, 1, (3, _SYNTH_HW, _SYNTH_HW)) \
+                .astype(np.float32)
+            # class signal in the channel means so models can learn
+            img[0] += label / _SYNTH_CLASSES
+            sample = (img.flatten(), int(label))
+            yield mapper(sample) if mapper else sample
+    return reader
+
+
+def _real_reader(split, mapper):
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "flowers real data needs Pillow for JPEG decode") from e
+    from scipy import io as scio
+
+    tgz = common.require_file(
+        common.data_path("flowers", "102flowers.tgz"),
+        "Stage 102flowers.tgz from the Oxford flowers dataset.")
+    labels_f = common.require_file(
+        common.data_path("flowers", "imagelabels.mat"),
+        "Stage imagelabels.mat.")
+    setid_f = common.require_file(
+        common.data_path("flowers", "setid.mat"),
+        "Stage setid.mat.")
+
+    def reader():
+        labels = scio.loadmat(labels_f)["labels"][0]
+        ids = scio.loadmat(setid_f)[_SPLIT_KEY[split]][0]
+        wanted = {f"jpg/image_{i:05d}.jpg": int(i) for i in ids}
+        with tarfile.open(tgz) as tf:
+            for m in tf.getmembers():
+                if m.name not in wanted:
+                    continue
+                i = wanted[m.name]
+                img = Image.open(io.BytesIO(tf.extractfile(m).read()))
+                img = img.convert("RGB").resize((_SYNTH_HW * 7,
+                                                 _SYNTH_HW * 7))
+                arr = np.asarray(img, np.float32).transpose(2, 0, 1) / 255
+                sample = (arr.flatten(), int(labels[i - 1]) - 1)
+                yield mapper(sample) if mapper else sample
+
+    return reader
+
+
+def train(mapper=None, use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("train", mapper)
+    return _real_reader("train", mapper)
+
+
+def test(mapper=None, use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("test", mapper)
+    return _real_reader("test", mapper)
+
+
+def valid(mapper=None, use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("valid", mapper)
+    return _real_reader("valid", mapper)

@@ -1,0 +1,100 @@
+"""PTB language-model corpus (reference: python/paddle/dataset/
+imikolov.py). Samples: NGRAM mode yields n-tuples of word ids; SEQ mode
+yields (src_seq, trg_seq) shifted id lists. Stage simple-examples.tgz
+under $PADDLE_TPU_DATA_HOME/imikolov/."""
+
+from __future__ import annotations
+
+import tarfile
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "NGRAM", "SEQ"]
+
+NGRAM = "ngram"
+SEQ = "seq"
+
+_TRAIN_F = "./simple-examples/data/ptb.train.txt"
+_TEST_F = "./simple-examples/data/ptb.valid.txt"
+_SYNTH_VOCAB = 120
+_N_SYNTH = {"train": 300, "test": 60}
+
+
+def _tar():
+    return common.require_file(
+        common.data_path("imikolov", "simple-examples.tgz"),
+        "Stage the Mikolov PTB archive simple-examples.tgz.")
+
+
+def build_dict(min_word_freq: int = 50, use_synthetic=None):
+    """word -> id, sorted by (-freq, word); '<unk>' is the last index
+    (reference imikolov.py build_dict)."""
+    if common.synthetic_enabled(use_synthetic):
+        d = {f"w{i:03d}": i for i in range(_SYNTH_VOCAB)}
+        d["<unk>"] = len(d)
+        return d
+    freq = {}
+    with tarfile.open(_tar()) as tf:
+        for fname in (_TRAIN_F, _TEST_F):
+            for line in tf.extractfile(fname):
+                # the reference counts one <s> and one <e> per line
+                # (word_count's [END] + l + [START]) so the boundary
+                # tokens land in the vocab with real ids
+                for w in (["<s>"] + line.decode("utf-8").strip().split()
+                          + ["<e>"]):
+                    freq[w] = freq.get(w, 0) + 1
+    freq.pop("<unk>", None)
+    pairs = sorted(((w, c) for w, c in freq.items()
+                    if c > min_word_freq), key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(pairs)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _synth_lines(split):
+    rng = common.synthetic_rng("imikolov", split)
+    for _ in range(_N_SYNTH[split]):
+        n = rng.randint(4, 20)
+        yield " ".join(f"w{rng.randint(0, _SYNTH_VOCAB):03d}"
+                       for _ in range(n))
+
+
+def _reader_creator(split, word_idx, n, data_type, use_synthetic):
+    fname = _TRAIN_F if split == "train" else _TEST_F
+
+    def lines():
+        if common.synthetic_enabled(use_synthetic):
+            yield from _synth_lines(split)
+            return
+        with tarfile.open(_tar()) as tf:
+            for raw in tf.extractfile(fname):
+                yield raw.decode("utf-8")
+
+    def reader():
+        unk = word_idx["<unk>"]
+        for line in lines():
+            if data_type == NGRAM:
+                assert n > -1, "Invalid gram length"
+                toks = ["<s>"] + line.strip().split() + ["<e>"]
+                if len(toks) >= n:
+                    ids = [word_idx.get(w, unk) for w in toks]
+                    for i in range(n, len(ids) + 1):
+                        yield tuple(ids[i - n:i])
+            elif data_type == SEQ:
+                toks = line.strip().split()
+                ids = [word_idx.get(w, unk) for w in toks]
+                src = [word_idx.get("<s>", unk)] + ids
+                trg = ids + [word_idx.get("<e>", unk)]
+                yield src, trg
+            else:
+                raise ValueError(f"unknown data_type {data_type!r}")
+
+    return reader
+
+
+def train(word_idx, n, data_type=NGRAM, use_synthetic=None):
+    return _reader_creator("train", word_idx, n, data_type, use_synthetic)
+
+
+def test(word_idx, n, data_type=NGRAM, use_synthetic=None):
+    return _reader_creator("test", word_idx, n, data_type, use_synthetic)

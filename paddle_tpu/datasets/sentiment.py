@@ -1,0 +1,94 @@
+"""NLTK movie-review sentiment corpus (reference: python/paddle/dataset/
+sentiment.py — the NLTK movie_reviews polarity data). Samples:
+(word-id list, label 0=negative/1=positive). Stage the extracted corpus
+(movie_reviews/{pos,neg}/*.txt) or the NLTK zip under
+$PADDLE_TPU_DATA_HOME/sentiment/."""
+
+from __future__ import annotations
+
+import os
+import zipfile
+
+from . import common
+
+__all__ = ["get_word_dict", "train", "test"]
+
+_SYNTH_VOCAB = 150
+_N_SYNTH = {"train": 200, "test": 50}
+NUM_TRAINING_INSTANCES = 1600  # reference's 80/20 split of 2000 docs
+
+
+def _docs():
+    """Yield (tokens, label) for the full corpus, deterministic order."""
+    root = common.data_path("sentiment", "movie_reviews")
+    zpath = common.data_path("sentiment", "movie_reviews.zip")
+    if os.path.isdir(root):
+        for li, pol in enumerate(("neg", "pos")):
+            d = os.path.join(root, pol)
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    yield f.read().lower().split(), li
+    elif os.path.exists(zpath):
+        with zipfile.ZipFile(zpath) as z:
+            names = sorted(n for n in z.namelist() if n.endswith(".txt"))
+            for n in names:
+                pol = 1 if "/pos/" in n else 0
+                yield z.read(n).decode("latin1").lower().split(), pol
+    else:
+        common.require_file(
+            zpath, "Stage the NLTK movie_reviews corpus (zip or "
+            "extracted movie_reviews/ directory).")
+
+
+def get_word_dict(use_synthetic=None):
+    """word -> id sorted by descending frequency (reference
+    sentiment.get_word_dict)."""
+    if common.synthetic_enabled(use_synthetic):
+        return {f"w{i}": i for i in range(_SYNTH_VOCAB)}
+    freq = {}
+    for toks, _ in _docs():
+        for w in toks:
+            freq[w] = freq.get(w, 0) + 1
+    ranked = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    return {w: i for i, (w, _) in enumerate(ranked)}
+
+
+def _synth_reader(split):
+    def reader():
+        rng = common.synthetic_rng("sentiment", split)
+        for _ in range(_N_SYNTH[split]):
+            label = rng.randint(0, 2)
+            n = rng.randint(5, 30)
+            base = 0 if label == 0 else _SYNTH_VOCAB // 2
+            ids = (base + rng.randint(0, _SYNTH_VOCAB // 2, n)).tolist()
+            yield ids, int(label)
+    return reader
+
+
+def _real_reader(split):
+    wd_cache = {}
+
+    def reader():
+        if "wd" not in wd_cache:  # one corpus scan, reused every epoch
+            wd_cache["wd"] = get_word_dict(use_synthetic=False)
+        wd = wd_cache["wd"]
+        # reference shuffles with a fixed seed then splits 80/20; here
+        # the split interleaves deterministically: every 5th doc is test
+        for i, (toks, label) in enumerate(_docs()):
+            is_test = (i % 5 == 4)
+            if (split == "test") != is_test:
+                continue
+            yield [wd[w] for w in toks if w in wd], label
+    return reader
+
+
+def train(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("train")
+    return _real_reader("train")
+
+
+def test(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("test")
+    return _real_reader("test")

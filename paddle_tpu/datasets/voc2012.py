@@ -1,0 +1,91 @@
+"""Pascal VOC2012 segmentation (reference: python/paddle/dataset/
+voc2012.py). Samples: (float32 CHW image / 255, int32 HW label mask).
+Stage VOCtrainval_11-May-2012.tar under $PADDLE_TPU_DATA_HOME/voc2012/."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+_SYNTH_HW = 24
+_N_CLASSES = 21
+_N_SYNTH = {"train": 60, "test": 20, "val": 20}
+_SET_FILE = ("VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt")
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def _synth_reader(split):
+    def reader():
+        rng = common.synthetic_rng("voc2012", split)
+        for _ in range(_N_SYNTH[split]):
+            img = rng.uniform(0, 1, (3, _SYNTH_HW, _SYNTH_HW)) \
+                .astype(np.float32)
+            # blocky synthetic masks (objects are contiguous regions)
+            mask = np.zeros((_SYNTH_HW, _SYNTH_HW), np.int32)
+            for _ in range(rng.randint(1, 4)):
+                c = rng.randint(1, _N_CLASSES)
+                y, x = rng.randint(0, _SYNTH_HW, 2)
+                h, w = rng.randint(4, 12, 2)
+                mask[y:y + h, x:x + w] = c
+            yield img, mask
+    return reader
+
+
+def _real_reader(split):
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "voc2012 real data needs Pillow for JPEG/PNG decode") from e
+
+    tar = common.require_file(
+        common.data_path("voc2012", "VOCtrainval_11-May-2012.tar"),
+        "Stage the VOC2012 trainval archive.")
+    # reference split mapping (voc2012.py): train reads the full
+    # 'trainval' list (2913 images); test reads 'train' (the official
+    # test list is not public); val reads 'val'
+    seg_file = _SET_FILE.format(
+        {"train": "trainval", "test": "train", "val": "val"}[split])
+
+    def reader():
+        with tarfile.open(tar) as tf:
+            names = {m.name: m for m in tf.getmembers()}
+            lines = tf.extractfile(names[seg_file]).read() \
+                .decode("utf-8").split()
+            for line in lines:
+                data = tf.extractfile(
+                    names[_DATA_FILE.format(line)]).read()
+                label = tf.extractfile(
+                    names[_LABEL_FILE.format(line)]).read()
+                img = np.asarray(Image.open(io.BytesIO(data))
+                                 .convert("RGB"), np.float32)
+                mask = np.asarray(Image.open(io.BytesIO(label)),
+                                  np.int32)
+                yield img.transpose(2, 0, 1) / 255.0, mask
+
+    return reader
+
+
+def train(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("train")
+    return _real_reader("train")
+
+
+def test(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("test")
+    return _real_reader("test")
+
+
+def val(use_synthetic=None):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_reader("val")
+    return _real_reader("val")

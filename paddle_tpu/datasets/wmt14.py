@@ -1,0 +1,136 @@
+"""WMT14 FR-EN translation (reference: python/paddle/dataset/wmt14.py,
+which uses the preprocessed wmt14 tarball with src.dict/trg.dict and
+tab-separated parallel files). Samples: (src_ids, trg_ids, trg_ids_next)
+with <s>=0, <e>=1, <unk>=2 in the first dict slots. Stage
+wmt14.tgz under $PADDLE_TPU_DATA_HOME/wmt14/."""
+
+from __future__ import annotations
+
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "get_dict", "START", "END", "UNK_IDX"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+_SYNTH_DICT = 80
+_N_SYNTH = {"train": 200, "test": 40}
+
+
+def _tar():
+    return common.require_file(
+        common.data_path("wmt14", "wmt14.tgz"),
+        "Stage the preprocessed WMT14 archive (src.dict/trg.dict + "
+        "train/test parallel files).")
+
+
+def _synth_dicts(dict_size):
+    n = min(dict_size, _SYNTH_DICT)
+    d = {START: 0, END: 1, UNK: 2}
+    for i in range(3, n):
+        d[f"tok{i:03d}"] = i
+    return d, dict(d)
+
+
+def _read_to_dict(dict_size):
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.decode("utf-8").strip()] = i
+        return out
+
+    with tarfile.open(_tar()) as f:
+        src_name = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
+        assert len(src_name) == 1 and len(trg_name) == 1
+        return (to_dict(f.extractfile(src_name[0]), dict_size),
+                to_dict(f.extractfile(trg_name[0]), dict_size))
+
+
+def get_dict(dict_size, reverse=False, use_synthetic=None):
+    """(src_dict, trg_dict); reverse=True returns id->word maps
+    (reference wmt14.get_dict)."""
+    if common.synthetic_enabled(use_synthetic):
+        src, trg = _synth_dicts(dict_size)
+    else:
+        src, trg = _read_to_dict(dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _synth_pairs(split):
+    rng = common.synthetic_rng("wmt14", split)
+    for _ in range(_N_SYNTH[split]):
+        n = rng.randint(3, 12)
+        src = " ".join(f"tok{rng.randint(3, _SYNTH_DICT):03d}"
+                       for _ in range(n))
+        trg = " ".join(f"tok{rng.randint(3, _SYNTH_DICT):03d}"
+                       for _ in range(max(2, n - 1)))
+        yield src, trg
+
+
+def _reader_creator(split, dict_size, use_synthetic):
+    def encode(src_dict, trg_dict, src_seq, trg_seq):
+        src_ids = [src_dict.get(w, UNK_IDX)
+                   for w in [START] + src_seq.split() + [END]]
+        trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_seq.split()]
+        if len(src_ids) > 80 or len(trg_ids) > 80:
+            return None
+        return (src_ids, [trg_dict[START]] + trg_ids,
+                trg_ids + [trg_dict[END]])
+
+    def reader():
+        if common.synthetic_enabled(use_synthetic):
+            src_dict, trg_dict = _synth_dicts(dict_size)
+            for src_seq, trg_seq in _synth_pairs(split):
+                s = encode(src_dict, trg_dict, src_seq, trg_seq)
+                if s is not None:
+                    yield s
+            return
+        # ONE tar open per epoch: dicts and parallel files read from
+        # the same member scan (the archive is multi-GB)
+        with tarfile.open(_tar()) as f:
+            members = f.getmembers()
+            src_name = [m for m in members
+                        if m.name.endswith("src.dict")][0]
+            trg_name = [m for m in members
+                        if m.name.endswith("trg.dict")][0]
+
+            def to_dict(fd, size):
+                out = {}
+                for i, line in enumerate(fd):
+                    if i >= size:
+                        break
+                    out[line.decode("utf-8").strip()] = i
+                return out
+
+            src_dict = to_dict(f.extractfile(src_name), dict_size)
+            trg_dict = to_dict(f.extractfile(trg_name), dict_size)
+            for m in members:
+                if f"/{split}/" not in m.name or not m.isfile():
+                    continue
+                for line in f.extractfile(m):
+                    parts = line.decode("utf-8").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    s = encode(src_dict, trg_dict, parts[0], parts[1])
+                    if s is not None:
+                        yield s
+
+    return reader
+
+
+def train(dict_size, use_synthetic=None):
+    return _reader_creator("train", dict_size, use_synthetic)
+
+
+def test(dict_size, use_synthetic=None):
+    return _reader_creator("test", dict_size, use_synthetic)

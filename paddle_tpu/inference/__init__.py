@@ -261,6 +261,15 @@ def export_train_step(out_dir: str, main_program, startup_program,
             "export_train_step with FLAGS_check_nan_inf=1 would emit the "
             "sanitizer's finite-flag outputs into the artifact; unset the "
             "flag for export")
+    from ..framework.registry import _HOST_OPS
+    host = [op.type for op in main_program.global_block.ops
+            if op.type in _HOST_OPS]
+    if host:
+        raise ValueError(
+            f"export_train_step: program contains host-boundary op(s) "
+            f"{host} (file IO / RPC / readers) that the Executor runs on "
+            "the host each step — they cannot be exported into the XLA "
+            "step; split them into a separate program")
 
     exe = Executor()
     scope = Scope()

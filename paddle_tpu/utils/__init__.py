@@ -1,1 +1,3 @@
 from . import dlpack  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import LocalFS, HDFSClient  # noqa: F401

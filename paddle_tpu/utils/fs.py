@@ -1,0 +1,191 @@
+"""Filesystem clients: LocalFS + HDFSClient (reference:
+paddle/fluid/framework/io/fs.cc shell/hdfs helpers and
+python incubate/fleet/utils/hdfs.py HDFSClient).
+
+Each class mirrors ITS reference counterpart's API (LocalFS the fs.cc
+local helpers, HDFSClient the hdfs.py client) — including hdfs.py's
+(hdfs_path, local_path) argument order on upload/download, which differs
+from LocalFS's (src, dest); they are not drop-in polymorphic. HDFSClient
+shells out to `hadoop fs` exactly like the reference's __run_hdfs_cmd
+(the C++ fs.cc does the same through popen); the command runner is
+injectable so environments without a hadoop install can still unit-test
+command construction and parsing."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["LocalFS", "HDFSClient", "split_files"]
+
+
+class LocalFS:
+    """Local filesystem through the shared FS interface (reference
+    fs.cc localfs_* helpers)."""
+
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        """([subdirs], [files]), names only (reference fs.py ls_dir)."""
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def mkdirs(self, path) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite: bool = False) -> None:
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(f"mv: {dst!r} exists")
+            self.delete(dst)
+        os.replace(src, dst)
+
+    def cat(self, path) -> str:
+        with open(path) as f:
+            return f.read()
+
+    def touch(self, path) -> None:
+        self.mkdirs(os.path.dirname(path) or ".")
+        with open(path, "a"):
+            pass
+
+    def upload(self, local_path, dest_path, overwrite=False) -> None:
+        if os.path.exists(dest_path) and not overwrite:
+            raise FileExistsError(f"upload: {dest_path!r} exists")
+        self.mkdirs(os.path.dirname(dest_path) or ".")
+        if os.path.isdir(local_path):
+            if os.path.exists(dest_path):
+                shutil.rmtree(dest_path)
+            shutil.copytree(local_path, dest_path)
+        else:
+            shutil.copy2(local_path, dest_path)
+
+    download = upload  # same machine: symmetrical copy
+
+
+class HDFSClient:
+    """`hadoop fs` CLI client (reference: incubate/fleet/utils/hdfs.py:35
+    HDFSClient; the C++ analog shells out in framework/io/fs.cc
+    hdfs_* helpers).
+
+    configs carries at least fs.default.name and hadoop.job.ugi; every
+    command is `<hadoop_home>/bin/hadoop fs -D k=v ... <cmd>`. `runner`
+    is injectable for tests (defaults to subprocess)."""
+
+    def __init__(self, hadoop_home: str, configs: Optional[Dict] = None,
+                 retry_times: int = 5, runner=None):
+        self._bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self._pre = [self._bin, "fs"]
+        for k, v in (configs or {}).items():
+            self._pre += ["-D", f"{k}={v}"]
+        self._retries = retry_times
+        self._runner = runner or self._subprocess_run
+
+    @staticmethod
+    def _subprocess_run(cmd: Sequence[str]) -> Tuple[int, str]:
+        p = subprocess.run(list(cmd), capture_output=True, text=True)
+        return p.returncode, p.stdout
+
+    def _run(self, args: Sequence[str],
+             retries: Optional[int] = None) -> Tuple[int, str]:
+        last = (1, "")
+        for _ in range(retries if retries is not None else self._retries):
+            last = self._runner(self._pre + list(args))
+            if last[0] == 0:
+                return last
+        return last
+
+    # -- queries --------------------------------------------------------
+    def is_exist(self, hdfs_path) -> bool:
+        rc, _ = self._run(["-test", "-e", hdfs_path], retries=1)
+        return rc == 0
+
+    def is_dir(self, hdfs_path) -> bool:
+        rc, _ = self._run(["-test", "-d", hdfs_path], retries=1)
+        return rc == 0
+
+    def is_file(self, hdfs_path) -> bool:
+        rc, _ = self._run(["-test", "-f", hdfs_path], retries=1)
+        return rc == 0
+
+    def cat(self, hdfs_path) -> str:
+        rc, out = self._run(["-cat", hdfs_path])
+        return out if rc == 0 else ""
+
+    def ls(self, hdfs_path) -> List[str]:
+        """Paths directly under hdfs_path (reference hdfs.py:296 parses
+        `-ls` output's last column)."""
+        rc, out = self._run(["-ls", hdfs_path])
+        if rc != 0:
+            return []
+        paths = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8 and not line.startswith("Found"):
+                paths.append(parts[-1])
+        return sorted(paths)
+
+    def lsr(self, hdfs_path) -> List[str]:
+        rc, out = self._run(["-lsr", hdfs_path])
+        if rc != 0:
+            return []
+        paths = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8 and parts[0][0] == "-":  # files only
+                paths.append(parts[-1])
+        return sorted(paths)
+
+    # -- mutations ------------------------------------------------------
+    def makedirs(self, hdfs_path) -> bool:
+        return self._run(["-mkdir", "-p", hdfs_path])[0] == 0
+
+    def delete(self, hdfs_path) -> bool:
+        if not self.is_exist(hdfs_path):
+            return True
+        flag = "-rmr" if self.is_dir(hdfs_path) else "-rm"
+        return self._run([flag, hdfs_path])[0] == 0
+
+    def rename(self, src, dst, overwrite: bool = False) -> bool:
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        return self._run(["-mv", src, dst])[0] == 0
+
+    def upload(self, hdfs_path, local_path, overwrite: bool = False) -> bool:
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        return self._run(["-put", local_path, hdfs_path])[0] == 0
+
+    def download(self, hdfs_path, local_path,
+                 overwrite: bool = False) -> bool:
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        return self._run(["-get", hdfs_path, local_path])[0] == 0
+
+
+def split_files(files: Sequence[str], trainer_id: int,
+                trainers: int) -> List[str]:
+    """This trainer's shard of a file list (reference hdfs.py:376
+    split_flies — round-robin by position)."""
+    return [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]

@@ -1,0 +1,194 @@
+"""Round-3 dataset loader tail (wmt14, imikolov, sentiment, flowers,
+voc2012 — reference python/paddle/dataset/) + LocalFS/HDFSClient utils
+(reference framework/io/fs.cc, incubate/fleet/utils/hdfs.py). All loaders
+run in deterministic synthetic mode (no egress)."""
+
+import os
+import unittest
+
+import numpy as np
+
+from paddle_tpu.datasets import (wmt14, imikolov, sentiment, flowers,
+                                 voc2012)
+from paddle_tpu.utils.fs import LocalFS, HDFSClient, split_files
+
+
+class TestImikolov(unittest.TestCase):
+    def test_ngram(self):
+        wd = imikolov.build_dict(use_synthetic=True)
+        self.assertEqual(wd["<unk>"], len(wd) - 1)
+        grams = list(imikolov.train(wd, 5, use_synthetic=True)())
+        self.assertGreater(len(grams), 50)
+        for g in grams[:20]:
+            self.assertEqual(len(g), 5)
+            self.assertTrue(all(0 <= i <= wd["<unk>"] for i in g))
+        # deterministic
+        again = list(imikolov.train(wd, 5, use_synthetic=True)())
+        self.assertEqual(grams, again)
+
+    def test_seq(self):
+        wd = imikolov.build_dict(use_synthetic=True)
+        pairs = list(imikolov.test(wd, -1, imikolov.SEQ,
+                                   use_synthetic=True)())
+        src, trg = pairs[0]
+        self.assertEqual(len(src), len(trg))  # <s>+ids vs ids+<e>
+        self.assertEqual(src[1:], trg[:-1])
+
+
+class TestWmt14(unittest.TestCase):
+    def test_samples(self):
+        src_d, trg_d = wmt14.get_dict(30, use_synthetic=True)
+        self.assertEqual(src_d[wmt14.START], 0)
+        self.assertEqual(src_d[wmt14.END], 1)
+        samples = list(wmt14.train(30, use_synthetic=True)())
+        self.assertGreater(len(samples), 100)
+        s, t, tn = samples[0]
+        self.assertEqual(s[0], 0)            # starts with <s>
+        self.assertEqual(s[-1], 1)           # ends with <e>
+        self.assertEqual(t[0], 0)            # trg starts with <s>
+        self.assertEqual(tn[-1], 1)          # next ends with <e>
+        self.assertEqual(t[1:], tn[:-1])     # shifted pair
+
+    def test_reverse_dict(self):
+        rsrc, _ = wmt14.get_dict(30, reverse=True, use_synthetic=True)
+        self.assertEqual(rsrc[0], wmt14.START)
+
+
+class TestSentiment(unittest.TestCase):
+    def test_word_dict_and_readers(self):
+        wd = sentiment.get_word_dict(use_synthetic=True)
+        tr = list(sentiment.train(use_synthetic=True)())
+        te = list(sentiment.test(use_synthetic=True)())
+        self.assertEqual(len(tr), 200)
+        self.assertEqual(len(te), 50)
+        labels = {lab for _, lab in tr}
+        self.assertEqual(labels, {0, 1})
+        for ids, _ in tr[:10]:
+            self.assertTrue(all(0 <= i < len(wd) for i in ids))
+
+
+class TestFlowers(unittest.TestCase):
+    def test_reader_and_mapper(self):
+        samples = list(flowers.train(use_synthetic=True)())
+        self.assertEqual(len(samples), 120)
+        img, label = samples[0]
+        self.assertEqual(img.shape, (3 * 32 * 32,))
+        self.assertEqual(img.dtype, np.float32)
+        self.assertIsInstance(label, int)
+
+        def mapper(sample):
+            im, lab = sample
+            return im * 2, lab
+
+        mapped = next(iter(flowers.test(mapper=mapper,
+                                        use_synthetic=True)()))
+        plain = next(iter(flowers.test(use_synthetic=True)()))
+        np.testing.assert_allclose(mapped[0], plain[0] * 2)
+
+
+class TestVoc2012(unittest.TestCase):
+    def test_masks(self):
+        samples = list(voc2012.val(use_synthetic=True)())
+        self.assertEqual(len(samples), 20)
+        img, mask = samples[0]
+        self.assertEqual(img.shape[0], 3)
+        self.assertEqual(mask.shape, img.shape[1:])
+        self.assertTrue(mask.min() >= 0 and mask.max() < 21)
+
+
+class TestLocalFS(unittest.TestCase):
+    def test_roundtrip(self):
+        import tempfile
+        fs = LocalFS()
+        root = tempfile.mkdtemp()
+        d = os.path.join(root, "a", "b")
+        fs.mkdirs(d)
+        self.assertTrue(fs.is_dir(d))
+        f = os.path.join(d, "x.txt")
+        with open(f, "w") as fh:
+            fh.write("hello")
+        self.assertTrue(fs.is_file(f))
+        self.assertEqual(fs.cat(f), "hello")
+        dirs, files = fs.ls_dir(d)
+        self.assertEqual((dirs, files), ([], ["x.txt"]))
+        g = os.path.join(d, "y.txt")
+        fs.mv(f, g)
+        self.assertFalse(fs.is_exist(f))
+        fs.upload(g, os.path.join(root, "copy.txt"))
+        self.assertTrue(fs.is_file(os.path.join(root, "copy.txt")))
+        fs.delete(d)
+        self.assertFalse(fs.is_exist(d))
+
+
+class TestHDFSClient(unittest.TestCase):
+    """Command construction + output parsing with an injected runner
+    (no hadoop install needed — the reference tests mock the same way)."""
+
+    def setUp(self):
+        self.calls = []
+        self.responses = {}
+
+        def runner(cmd):
+            self.calls.append(cmd)
+            for frag, resp in self.responses.items():
+                if frag in cmd:
+                    return resp
+            return 0, ""
+
+        self.c = HDFSClient(
+            "/opt/hadoop", {"fs.default.name": "hdfs://nn:9000",
+                            "hadoop.job.ugi": "u,p"},
+            runner=runner)
+
+    def test_command_prefix(self):
+        self.c.is_exist("/x")
+        cmd = self.calls[0]
+        self.assertEqual(cmd[:2], ["/opt/hadoop/bin/hadoop", "fs"])
+        self.assertIn("-D", cmd)
+        self.assertIn("fs.default.name=hdfs://nn:9000", cmd)
+        self.assertEqual(cmd[-3:], ["-test", "-e", "/x"])
+
+    def test_ls_parsing(self):
+        self.responses["-ls"] = (0, (
+            "Found 2 items\n"
+            "-rw-r--r-- 3 u g 10 2026-01-01 00:00 /d/a.txt\n"
+            "drwxr-xr-x - u g 0 2026-01-01 00:00 /d/sub\n"))
+        self.assertEqual(self.c.ls("/d"), ["/d/a.txt", "/d/sub"])
+
+    def test_lsr_files_only(self):
+        self.responses["-lsr"] = (0, (
+            "-rw-r--r-- 3 u g 10 2026-01-01 00:00 /d/a.txt\n"
+            "drwxr-xr-x - u g 0 2026-01-01 00:00 /d/sub\n"
+            "-rw-r--r-- 3 u g 10 2026-01-01 00:00 /d/sub/b.txt\n"))
+        self.assertEqual(self.c.lsr("/d"), ["/d/a.txt", "/d/sub/b.txt"])
+
+    def test_retries(self):
+        attempts = []
+
+        def flaky(cmd):
+            attempts.append(cmd)
+            return (0, "") if len(attempts) >= 3 else (1, "")
+
+        c = HDFSClient("/h", retry_times=5, runner=flaky)
+        self.assertTrue(c.makedirs("/p"))
+        self.assertEqual(len(attempts), 3)
+
+    def test_delete_picks_rm_flavor(self):
+        self.responses["-test"] = (0, "")  # exists, and is_dir succeeds
+        self.c.delete("/d")
+        flags = [c for c in self.calls if "-rmr" in c or "-rm" in c]
+        self.assertTrue(any("-rmr" in c for c in flags))
+
+
+class TestSplitFiles(unittest.TestCase):
+    def test_round_robin(self):
+        files = [f"f{i}" for i in range(7)]
+        a = split_files(files, 0, 2)
+        b = split_files(files, 1, 2)
+        self.assertEqual(sorted(a + b), files)
+        self.assertEqual(len(a), 4)
+        self.assertEqual(len(b), 3)
+
+
+if __name__ == "__main__":
+    unittest.main()
