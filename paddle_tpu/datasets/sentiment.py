@@ -15,7 +15,6 @@ __all__ = ["get_word_dict", "train", "test"]
 
 _SYNTH_VOCAB = 150
 _N_SYNTH = {"train": 200, "test": 50}
-NUM_TRAINING_INSTANCES = 1600  # reference's 80/20 split of 2000 docs
 
 
 def _docs():

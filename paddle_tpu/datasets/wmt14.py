@@ -36,21 +36,36 @@ def _synth_dicts(dict_size):
     return d, dict(d)
 
 
-def _read_to_dict(dict_size):
-    def to_dict(fd, size):
-        out = {}
-        for i, line in enumerate(fd):
-            if i >= size:
-                break
-            out[line.decode("utf-8").strip()] = i
-        return out
+def _to_dict(fd, size):
+    out = {}
+    for i, line in enumerate(fd):
+        if i >= size:
+            break
+        out[line.decode("utf-8").strip()] = i
+    return out
 
+
+def _dicts_from_tar(f, dict_size):
+    """src/trg dicts from an OPEN tarfile (shared by get_dict and the
+    per-epoch reader, which keeps one tar open for everything)."""
+    src_name = [m.name for m in f.getmembers()
+                if m.name.endswith("src.dict")]
+    trg_name = [m.name for m in f.getmembers()
+                if m.name.endswith("trg.dict")]
+    assert len(src_name) == 1 and len(trg_name) == 1
+    return (_to_dict(f.extractfile(src_name[0]), dict_size),
+            _to_dict(f.extractfile(trg_name[0]), dict_size))
+
+
+def _in_split(name, split):
+    """True when `split` is a path COMPONENT of the member name — matches
+    both 'train/part-0' (top-level) and 'wmt14/train/part-0'."""
+    return split in name.split("/")
+
+
+def _read_to_dict(dict_size):
     with tarfile.open(_tar()) as f:
-        src_name = [m.name for m in f if m.name.endswith("src.dict")]
-        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
-        assert len(src_name) == 1 and len(trg_name) == 1
-        return (to_dict(f.extractfile(src_name[0]), dict_size),
-                to_dict(f.extractfile(trg_name[0]), dict_size))
+        return _dicts_from_tar(f, dict_size)
 
 
 def get_dict(dict_size, reverse=False, use_synthetic=None):
@@ -98,24 +113,9 @@ def _reader_creator(split, dict_size, use_synthetic):
         # ONE tar open per epoch: dicts and parallel files read from
         # the same member scan (the archive is multi-GB)
         with tarfile.open(_tar()) as f:
-            members = f.getmembers()
-            src_name = [m for m in members
-                        if m.name.endswith("src.dict")][0]
-            trg_name = [m for m in members
-                        if m.name.endswith("trg.dict")][0]
-
-            def to_dict(fd, size):
-                out = {}
-                for i, line in enumerate(fd):
-                    if i >= size:
-                        break
-                    out[line.decode("utf-8").strip()] = i
-                return out
-
-            src_dict = to_dict(f.extractfile(src_name), dict_size)
-            trg_dict = to_dict(f.extractfile(trg_name), dict_size)
-            for m in members:
-                if f"/{split}/" not in m.name or not m.isfile():
+            src_dict, trg_dict = _dicts_from_tar(f, dict_size)
+            for m in f.getmembers():
+                if not _in_split(m.name, split) or not m.isfile():
                     continue
                 for line in f.extractfile(m):
                     parts = line.decode("utf-8").strip().split("\t")

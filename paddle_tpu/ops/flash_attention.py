@@ -223,6 +223,195 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 
 # ---------------------------------------------------------------------------
+# small-sequence single-pass kernels
+# ---------------------------------------------------------------------------
+#
+# At short seq (s <= 256) the tiled online-softmax kernel loses to XLA's
+# fused composition: one (128, 128) tile per (batch*head) program leaves
+# each program mostly overhead (measured r2: 34.8% vs 48% MFU on the
+# BERT flagship at s=128). The fix is WIDTH, not depth: scores fit VMEM
+# whole, so a single-pass kernel batches MANY (batch*head) rows per
+# program (dot_general with a batch dim) and amortizes the grid/DMA
+# overhead — the "unfused flash" regime from the flash-attention paper's
+# small-N appendix.
+
+def _small_batch(bn, s):
+    """Rows per program: largest power-of-two divisor of bn whose f32
+    score tile (B, s, s) stays within ~2MB of VMEM (the kernel's full
+    working set is ~4x the score tile; the scoped limit is 16MB)."""
+    budget = 2 * 1024 * 1024
+    b = 16
+    while b > 1 and (bn % b != 0 or b * s * s * 4 > budget):
+        b //= 2
+    return b
+
+
+def _small_scores(q_ref, k_ref, b_ref, sm_scale, causal):
+    """(B, sq, d) x (B, sk, d) -> masked f32 scores (B, sq, sk)."""
+    qq = q_ref[...].astype(jnp.float32)
+    kk = k_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(qq, kk, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if b_ref is not None:
+        s = s + b_ref[...].astype(jnp.float32)     # (B, 1, sk) broadcast
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(row >= col, s, _NEG_INF)
+    return s
+
+
+def _small_fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *,
+                      sm_scale, causal):
+    s = _small_scores(q_ref, k_ref, b_ref, sm_scale, causal)
+    m = jnp.max(s, axis=2, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=2, keepdims=True)
+    o = jax.lax.dot_general((p / l).astype(v_ref.dtype), v_ref[...],
+                            (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    o_ref[...] = o.astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
+
+
+def _small_bwd_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
+                      dq_ref, dk_ref, dv_ref, db_ref, *, sm_scale, causal):
+    s = _small_scores(q_ref, k_ref, b_ref, sm_scale, causal)
+    p = jnp.exp(s - lse_ref[..., :1])              # (B, sq, sk)
+    qq = q_ref[...].astype(jnp.float32)
+    kk = k_ref[...].astype(jnp.float32)
+    vv = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    dp = jax.lax.dot_general(do, vv, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dl_ref[..., :1])
+    dq_ref[...] = (jax.lax.dot_general(
+        ds.astype(kk.dtype), kk, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * sm_scale).astype(dq_ref.dtype)
+    dk_ref[...] = (jax.lax.dot_general(
+        ds.astype(qq.dtype), qq, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * sm_scale).astype(dk_ref.dtype)
+    dv_ref[...] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    if db_ref is not None:
+        db_ref[...] = jnp.sum(ds, axis=1, keepdims=True) \
+            .astype(db_ref.dtype)
+
+
+def _small_call(q, k, v, bias, causal, sm_scale, interpret):
+    """Single-pass path over the (b*n, s, d) layout: whole (sq, sk)
+    score tile per row, B rows per program (batched dot_general) to
+    amortize grid/DMA overhead. bias: (b*n, sk) per-key additive.
+    Returns (o (bn,sq,d), lse (bn,sq,LANES) lane-padded)."""
+    from jax.experimental import pallas as pl
+
+    bn, sq, d = q.shape
+    sk = k.shape[1]
+    B = _small_batch(bn, max(sq, sk))
+    kw = dict(sm_scale=sm_scale, causal=causal)
+    in_specs = [
+        pl.BlockSpec((B, sq, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sk, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sk, d), lambda i: (i, 0, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        args.append(bias[:, None, :])              # (bn, 1, sk)
+        in_specs.append(pl.BlockSpec((B, 1, sk), lambda i: (i, 0, 0)))
+        kern = functools.partial(_small_fwd_kernel, **kw)
+    else:
+        def kern(q_r, k_r, v_r, o_r, lse_r):
+            _small_fwd_kernel(q_r, k_r, v_r, None, o_r, lse_r, **kw)
+
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bn // B,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((B, sq, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((B, sq, _LANES), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, sq, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return o, lse
+
+
+def _small_bwd_call(q, k, v, bias, o, lse, do, causal, sm_scale,
+                    interpret):
+    """Single-pass backward over the (b*n, s, d) layout (recomputes
+    scores from q/k + lse — the save-p variant measured slower, see
+    BASELINE.md r3); db comes back (bn, sk)."""
+    from jax.experimental import pallas as pl
+
+    bn, sq, d = q.shape
+    sk = k.shape[1]
+    B = _small_batch(bn, max(sq, sk))
+    dl = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dl3 = jnp.broadcast_to(dl[:, :, None], (bn, sq, _LANES))
+    kw = dict(sm_scale=sm_scale, causal=causal)
+
+    in_specs = [
+        pl.BlockSpec((B, sq, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sk, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sk, d), lambda i: (i, 0, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        args.append(bias[:, None, :])
+        in_specs.append(pl.BlockSpec((B, 1, sk), lambda i: (i, 0, 0)))
+    args += [do, lse, dl3]
+    in_specs += [
+        pl.BlockSpec((B, sq, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sq, _LANES), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sq, _LANES), lambda i: (i, 0, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((B, sq, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sk, d), lambda i: (i, 0, 0)),
+        pl.BlockSpec((B, sk, d), lambda i: (i, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bn, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((bn, sk, d), k.dtype),
+        jax.ShapeDtypeStruct((bn, sk, d), v.dtype),
+    ]
+    if bias is not None:
+        out_specs.append(pl.BlockSpec((B, 1, sk), lambda i: (i, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bn, 1, sk), jnp.float32))
+        kern = functools.partial(_small_bwd_kernel, **kw)
+    else:
+        def kern(q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, dk_r, dv_r):
+            _small_bwd_kernel(q_r, k_r, v_r, None, do_r, lse_r, dl_r,
+                              dq_r, dk_r, dv_r, None, **kw)
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(bn // B,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    if bias is not None:
+        dq, dk, dv, db3 = outs
+        return dq, dk, dv, db3[:, 0, :]
+    dq, dk, dv = outs
+    return dq, dk, dv, None
+
+
+def _small_ok(sq, sk):
+    """Shapes the single-pass path handles: both dims fit one VMEM-sized
+    score tile and are lane/sublane aligned."""
+    return (sq <= 512 and sk <= 512 and sk % _LANES == 0
+            and sq % 8 == 0)
+
+
+# ---------------------------------------------------------------------------
 # pallas_call plumbing
 # ---------------------------------------------------------------------------
 
@@ -449,8 +638,9 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale, interpret):
     b, sq, n, d = q.shape
     sk = k.shape[1]
     bb = None if bias is None else _bias_to_bn(bias, b, n, sk)
-    o, lse = _flash_call(_to_bn(q), _to_bn(k), _to_bn(v), bb,
-                         causal, sm_scale, interpret)
+    call = _small_call if _small_ok(sq, sk) else _flash_call
+    o, lse = call(_to_bn(q), _to_bn(k), _to_bn(v), bb,
+                  causal, sm_scale, interpret)
     return _from_bn(o, b, n), (q, k, v, bias, o, lse)
 
 
@@ -459,7 +649,8 @@ def _flash_bwd(causal, sm_scale, interpret, res, g):
     b, sq, n, d = q.shape
     sk = k.shape[1]
     bb = None if bias is None else _bias_to_bn(bias, b, n, sk)
-    dq, dk, dv, db_bn = _flash_bwd_call(
+    bwd = _small_bwd_call if _small_ok(sq, sk) else _flash_bwd_call
+    dq, dk, dv, db_bn = bwd(
         _to_bn(q), _to_bn(k), _to_bn(v), bb, o_bn, lse, _to_bn(g),
         causal, sm_scale, interpret)
     db = None
@@ -492,11 +683,13 @@ def attention(q, k, v, bias=None, causal: bool = False,
         bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1)
     shapes_ok = (q.shape[-1] % 8 == 0 and q.shape[1] % 8 == 0
                  and k.shape[1] % 128 == 0)
-    # at short sequence the single-tile kernel cannot beat XLA's fused
-    # softmax (measured: s=128 BERT step 158.8ms flash vs 119.2ms einsum;
-    # crossover at s>=512 — BASELINE.md). Auto mode dispatches by shape,
-    # the way cuDNN picks algos; impl='flash' still forces the kernel.
-    long_enough = k.shape[1] >= 512
+    # dispatch by shape, the way cuDNN picks algos (BASELINE.md r3 grid):
+    # s=128 XLA's fused composition still wins (47.5% vs 42.8% MFU — the
+    # kernel pays the bn relayout XLA fuses away); from s=256 the batched
+    # single-pass kernel wins (42.7% vs 41.8%) and at s=512 it wins big
+    # (39.8% vs 31.2%, also beating the old tiled kernel's 37.0%).
+    # impl='flash' still forces the kernel at any length.
+    long_enough = k.shape[1] >= 256
     if impl == "flash" and not bias_ok:
         raise ValueError(
             "flash attention requires a per-key bias of shape (b, sk) or "

@@ -70,12 +70,13 @@ class LocalFS:
             pass
 
     def upload(self, local_path, dest_path, overwrite=False) -> None:
-        if os.path.exists(dest_path) and not overwrite:
-            raise FileExistsError(f"upload: {dest_path!r} exists")
+        if os.path.exists(dest_path):
+            if not overwrite:
+                raise FileExistsError(f"upload: {dest_path!r} exists")
+            # handles file-over-dir and dir-over-file replacement alike
+            self.delete(dest_path)
         self.mkdirs(os.path.dirname(dest_path) or ".")
         if os.path.isdir(local_path):
-            if os.path.exists(dest_path):
-                shutil.rmtree(dest_path)
             shutil.copytree(local_path, dest_path)
         else:
             shutil.copy2(local_path, dest_path)
