@@ -1,0 +1,188 @@
+"""Spike: fused 1x1-conv + train-mode BatchNorm stats (+ReLU) in Pallas
+vs the XLA composition — the untried lever named in BASELINE.md r2's
+ResNet-50 roofline note (VERDICT r3 item 2).
+
+A bottleneck's 1x1 conv in NHWC is a plain matmul over (N*H*W, Cin);
+the fused kernel computes the matmul, accumulates per-channel sum and
+sum-of-squares in VMEM scratch as an epilogue (saving the separate
+stats-reduction pass over y), then a second pass normalizes + relus.
+Training-mode BN cannot be single-pass: batch statistics are a GLOBAL
+reduction over all M rows, so every fusion strategy pays at least
+  x read + y write + y read + out write
+which is exactly what XLA's (conv -> fused stats reduce -> fused
+normalize) pipeline pays. The spike MEASURES whether hand-fusing the
+stats epilogue into the matmul beats XLA's schedule anyway.
+
+Run on the TPU:  python tools/spike_conv_bn.py
+Prints one line per shape: pallas_ms, xla_ms, ratio.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def fused_conv_bn_stats(x, w, tm=512, interpret=False):
+    """Pass 1: y = x @ w with per-channel sum/sumsq epilogue.
+    x: (M, K) bf16; w: (K, C) bf16. Returns y (M, C) bf16, sum (C,) f32,
+    sumsq (C,) f32."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = x.shape
+    C = w.shape[1]
+    assert M % tm == 0, f"M={M} must be a multiple of tm={tm}"
+    nm = M // tm
+
+    def kern(x_ref, w_ref, y_ref, s_ref, q_ref, s_scr, q_scr):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            s_scr[:] = jnp.zeros_like(s_scr)
+            q_scr[:] = jnp.zeros_like(q_scr)
+
+        y = jax.lax.dot_general(
+            x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        y_ref[...] = y.astype(y_ref.dtype)
+        s_scr[:] += jnp.sum(y, axis=0, keepdims=True)
+        q_scr[:] += jnp.sum(y * y, axis=0, keepdims=True)
+
+        @pl.when(i == nm - 1)
+        def _fin():
+            s_ref[...] = s_scr[:]
+            q_ref[...] = q_scr[:]
+
+    y, s, q = pl.pallas_call(
+        kern,
+        grid=(nm,),
+        in_specs=[
+            pl.BlockSpec((tm, K), lambda i: (i, 0)),
+            pl.BlockSpec((K, C), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, C), x.dtype),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, C), jnp.float32),
+            pltpu.VMEM((1, C), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x, w)
+    return y, s[0], q[0]
+
+
+def bn_apply_relu(y, s, q, gamma, beta, eps, tm=512, interpret=False):
+    """Pass 2: relu((y - mean) * rsqrt(var + eps) * gamma + beta)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    M, C = y.shape
+    assert M % tm == 0, f"M={M} must be a multiple of tm={tm}"
+    mean = s / M
+    var = q / M - mean * mean
+    scale = (gamma / jnp.sqrt(var + eps)).astype(jnp.float32)
+    shift = (beta - mean * scale).astype(jnp.float32)
+
+    def kern(y_ref, sc_ref, sh_ref, o_ref):
+        o_ref[...] = jnp.maximum(
+            y_ref[...].astype(jnp.float32) * sc_ref[...] + sh_ref[...],
+            0.0).astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kern,
+        grid=(M // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((tm, C), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((M, C), y.dtype)],
+        interpret=interpret,
+    )(y, scale[None], shift[None])[0]
+
+
+def fused_block(x, w, gamma, beta, eps=1e-5, interpret=False):
+    y, s, q = fused_conv_bn_stats(x, w, interpret=interpret)
+    return bn_apply_relu(y, s, q, gamma, beta, eps, interpret=interpret)
+
+
+def xla_block(x, w, gamma, beta, eps=1e-5):
+    import jax.numpy as jnp
+    y = (x @ w).astype(jnp.float32)
+    mean = jnp.mean(y, axis=0)
+    var = jnp.mean(y * y, axis=0) - mean * mean
+    out = (y - mean) * (gamma / jnp.sqrt(var + eps)) + beta
+    return jnp.maximum(out, 0.0).astype(x.dtype)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    shapes = [
+        # (N*H*W, Cin, Cout) of ResNet-50 bottleneck 1x1 convs, batch 128
+        (128 * 56 * 56, 64, 64),
+        (128 * 56 * 56, 64, 256),
+        (128 * 28 * 28, 512, 128),
+        (128 * 14 * 14, 1024, 256),
+        (128 * 7 * 7, 2048, 512),
+    ]
+    iters = 30
+    rng = np.random.RandomState(0)
+    rows = []
+    for (M, K, C) in shapes:
+        M = (M // 512) * 512
+        x = jnp.asarray(rng.randn(M, K) * 0.1, jnp.bfloat16)
+        w = jnp.asarray(rng.randn(K, C) * 0.05, jnp.bfloat16)
+        gamma = jnp.ones((C,), jnp.float32)
+        beta = jnp.zeros((C,), jnp.float32)
+
+        # correctness first
+        got = np.asarray(fused_block(x, w, gamma, beta), np.float32)
+        want = np.asarray(xla_block(x, w, gamma, beta), np.float32)
+        np.testing.assert_allclose(got, want, atol=0.15, rtol=0.15)
+
+        def timed(fn):
+            def run(x, w):
+                def body(c, _):
+                    o = fn(x + c, w, gamma, beta)
+                    return (o.astype(jnp.float32).sum() * 1e-24
+                            ).astype(x.dtype), None
+                c, _ = jax.lax.scan(body, jnp.zeros((), x.dtype), None,
+                                    length=iters)
+                return c
+            f = jax.jit(run)
+            float(f(x, w))  # warm/compile
+            t0 = time.perf_counter()
+            float(f(x, w))
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        t_pallas = timed(fused_block)
+        t_xla = timed(xla_block)
+        rows.append((M, K, C, t_pallas, t_xla))
+        print(f"M={M:>7} K={K:>4} C={C:>4}  pallas={t_pallas:7.3f}ms  "
+              f"xla={t_xla:7.3f}ms  ratio={t_pallas / t_xla:5.2f}x",
+              flush=True)
+    wins = sum(1 for r in rows if r[3] < r[4])
+    print(f"pallas wins {wins}/{len(rows)} shapes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
