@@ -1,1 +1,3 @@
 """Distributed launch utilities (reference: python/paddle/distributed/)."""
+from . import elastic  # noqa: F401
+from .elastic import ElasticController, ElasticAgent  # noqa: F401

@@ -12,7 +12,9 @@ __all__ = ["prior_box", "anchor_generator", "box_coder", "iou_similarity",
            "mine_hard_examples", "rpn_target_assign", "roi_pool",
            "generate_proposals", "distribute_fpn_proposals",
            "collect_fpn_proposals", "retinanet_detection_output",
-           "ssd_loss"]
+           "ssd_loss", "generate_proposal_labels", "generate_mask_labels",
+           "roi_perspective_transform", "deformable_psroi_pooling",
+           "detection_map"]
 
 
 def _op(name, op_type, ins, out_slots, attrs=None, persist=()):
@@ -423,3 +425,119 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         m_layers.scale(m_layers.elementwise_mul(conf_l, conf_w),
                        scale=conf_loss_weight))
     return loss
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=None, class_nums=None,
+                             use_random=True, is_cls_agnostic=False,
+                             is_cascade_rcnn=False, name=None):
+    """reference: layers/detection.py generate_proposal_labels (detection/
+    generate_proposal_labels_op.cc). Dense shapes: rpn_rois [n, R, 4],
+    gt_* [n, G, ...]; outputs are [n, batch_size_per_im, ...]."""
+    ins = {"RpnRois": [rpn_rois.name], "GtClasses": [gt_classes.name],
+           "GtBoxes": [gt_boxes.name], "ImInfo": [im_info.name]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd.name]
+    return _op("generate_proposal_labels", "generate_proposal_labels",
+               ins,
+               ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+                "BboxOutsideWeights", "MatchedGtInt32", "FgMask"],
+               {"batch_size_per_im": batch_size_per_im,
+                "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+                "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+                "bbox_reg_weights": bbox_reg_weights or [0.1, 0.1, 0.2, 0.2],
+                "class_nums": class_nums or 81,
+                "use_random": use_random,
+                "is_cls_agnostic": is_cls_agnostic,
+                "is_cascade_rcnn": is_cascade_rcnn})
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         matched_gt_int32=None, name=None):
+    """reference: layers/detection.py generate_mask_labels (detection/
+    generate_mask_labels_op.cc). gt_segms here are RASTERIZED dense masks
+    [n, G, Hm, Wm] (see ops/detection_extra_ops.py docstring)."""
+    ins = {"ImInfo": [im_info.name], "GtClasses": [gt_classes.name],
+           "GtSegms": [gt_segms.name], "Rois": [rois.name],
+           "LabelsInt32": [labels_int32.name]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd.name]
+    if matched_gt_int32 is not None:
+        ins["MatchedGtInt32"] = [matched_gt_int32.name]
+    return _op("generate_mask_labels", "generate_mask_labels", ins,
+               ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+               {"num_classes": num_classes, "resolution": resolution})
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """reference: layers/detection.py roi_perspective_transform
+    (detection/roi_perspective_transform_op.cc). rois: [n, R, 8] quads."""
+    return _op("roi_perspective_transform", "roi_perspective_transform",
+               {"X": [input.name], "ROIs": [rois.name]},
+               ["Out", "Mask", "TransformMatrix", "Out2InIdx",
+                "Out2InWeights"],
+               {"transformed_height": transformed_height,
+                "transformed_width": transformed_width,
+                "spatial_scale": spatial_scale})
+
+
+def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
+                             spatial_scale=1.0, output_dim=None,
+                             group_size=None, pooled_height=1,
+                             pooled_width=1, part_size=None,
+                             sample_per_part=1, trans_std=0.1, name=None):
+    """reference: layers/nn.py deformable_roi_pooling
+    (deformable_psroi_pooling_op.cc)."""
+    if output_dim is None:
+        raise ValueError(
+            "deformable_psroi_pooling requires output_dim (the number of "
+            "output channels; Input channels must equal "
+            "output_dim * pooled_height * pooled_width)")
+    ins = {"Input": [input.name], "ROIs": [rois.name]}
+    if trans is not None:
+        ins["Trans"] = [trans.name]
+    return _op("deformable_psroi_pooling", "deformable_psroi_pooling",
+               ins, ["Output", "TopCount"],
+               {"no_trans": no_trans or trans is None,
+                "spatial_scale": spatial_scale,
+                "output_dim": output_dim,
+                "group_size": group_size or [pooled_height, pooled_width],
+                "pooled_height": pooled_height,
+                "pooled_width": pooled_width,
+                "part_size": part_size or [pooled_height, pooled_width],
+                "sample_per_part": sample_per_part,
+                "trans_std": trans_std})
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """Streaming mAP metric with persistable bucketized accumulators
+    (reference: layers/metric_op.py via DetectionMAP, detection_map_op.cc).
+    detect_res [n, D, 6], label [n, G, 6]. Returns the scalar mAP var."""
+    helper = LayerHelper("detection_map", name=name)
+    C = int(class_num)
+    pos = helper.create_global_state_var("dmap_pos_count", [C], "int32")
+    tp = helper.create_global_state_var("dmap_true_pos", [C, 1000],
+                                        "int32")
+    fp = helper.create_global_state_var("dmap_false_pos", [C, 1000],
+                                        "int32")
+    m = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        "detection_map",
+        {"DetectRes": [detect_res.name], "Label": [label.name],
+         "PosCount": [pos.name], "TruePos": [tp.name],
+         "FalsePos": [fp.name]},
+        {"MAP": [m.name], "AccumPosCount": [pos.name],
+         "AccumTruePos": [tp.name], "AccumFalsePos": [fp.name]},
+        {"class_num": C, "background_label": background_label,
+         "overlap_threshold": overlap_threshold,
+         "evaluate_difficult": evaluate_difficult,
+         "ap_type": ap_version}, infer_shape=False)
+    return m

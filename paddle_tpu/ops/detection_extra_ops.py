@@ -319,7 +319,9 @@ def _deformable_psroi_pooling(ctx, ins, attrs):
     group_h = int(attrs.get("group_size", [ph, pw])[0]) \
         if isinstance(attrs.get("group_size"), (list, tuple)) else ph
     group_w = group_h
-    part_h, part_w = ph, pw
+    part = attrs.get("part_size")
+    part_h, part_w = (int(part[0]), int(part[1])) \
+        if isinstance(part, (list, tuple)) else (ph, pw)
     n, C, H, W = x.shape
     R = rois.shape[1]
 
@@ -468,6 +470,7 @@ def _detection_map(ctx, ins, attrs):
     overlap_t = attrs.get("overlap_threshold", 0.5)
     eval_difficult = bool(attrs.get("evaluate_difficult", True))
     ap_type = attrs.get("ap_type", "integral")
+    bg = int(attrs.get("background_label", 0))  # -1 = no background class
     has_state = ins.get("HasState", [None])[0]
     pos_count = ins.get("PosCount", [None])[0]
     true_pos = ins.get("TruePos", [None])[0]
@@ -488,12 +491,16 @@ def _detection_map(ctx, ins, attrs):
     det_score = det[:, :, 1]
     det_box = det[:, :, 2:6]
     det_valid = det_score > 0
+    if bg >= 0:  # reference excludes the background class entirely
+        det_valid &= (det_label != bg)
     gt_label = gt[:, :, 0].astype(jnp.int32)
     gt_box = gt[:, :, 1:5]
     gt_difficult = (gt[:, :, 5] != 0) if gt.shape[2] > 5 else \
         jnp.zeros((n, G), jnp.bool_)
     gt_valid = (gt_box[:, :, 2] > gt_box[:, :, 0]) & \
         (gt_box[:, :, 3] > gt_box[:, :, 1])
+    if bg >= 0:
+        gt_valid &= (gt_label != bg)
     # positives per class (difficult gt excluded unless evaluate_difficult)
     counted = gt_valid & (eval_difficult | ~gt_difficult)
 
@@ -564,6 +571,8 @@ def _detection_map(ctx, ins, attrs):
         d_tp = jnp.diff(tp_rev, axis=1, prepend=0.0)
         ap = jnp.sum(precision * d_tp, axis=1) / npos
     eligible = (pos_count > 0) & has_events
+    if bg >= 0:
+        eligible &= (jnp.arange(C) != bg)
     m_ap = jnp.where(eligible.sum() > 0,
                      jnp.sum(jnp.where(eligible, ap, 0.0))
                      / jnp.maximum(eligible.sum(), 1), 0.0)

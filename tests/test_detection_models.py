@@ -1,0 +1,210 @@
+"""Model-level detection integration (reference book-style tests for the
+detection stack): an SSD-style train loop whose loss decreases and whose
+streaming detection_map metric improves, and a Mask R-CNN-style head
+trained end-to-end through generate_proposal_labels +
+generate_mask_labels (reference: test_ssd_loss / test_mask_rcnn model
+zoo patterns)."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _toy_scene(rng, n, img_hw=32):
+    """One box per image in a 2x2 cell grid, class = cell index + 1."""
+    gt_box = np.zeros((n, 1, 4), np.float32)
+    gt_label = np.zeros((n, 1, 1), np.int64)
+    for i in range(n):
+        cell = rng.randint(0, 4)
+        cy, cx = divmod(cell, 2)
+        x0 = cx * 0.5 + 0.05 + rng.uniform(-0.02, 0.02)
+        y0 = cy * 0.5 + 0.05 + rng.uniform(-0.02, 0.02)
+        gt_box[i, 0] = [x0, y0, x0 + 0.4, y0 + 0.4]
+        gt_label[i, 0, 0] = cell + 1
+    return gt_box, gt_label
+
+
+class TestSSDTrainsWithDetectionMap(unittest.TestCase):
+    def test_loss_decreases_and_map_improves(self):
+        rng = np.random.RandomState(0)
+        n, hw, classes = 8, 32, 5
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            img = pt.layers.data("img", [3, hw, hw])
+            gt_box = pt.layers.data("gt_box", [1, 4])
+            gt_label = pt.layers.data("gt_label", [1, 1], dtype="int64")
+
+            feat = pt.layers.conv2d(img, 16, 3, padding=1, act="relu")
+            feat = pt.layers.pool2d(feat, 2, "max", 2)      # 16x16
+            feat = pt.layers.conv2d(feat, 32, 3, padding=1, act="relu")
+            feat = pt.layers.pool2d(feat, 2, "max", 2)      # 8x8
+            feat = pt.layers.conv2d(feat, 32, 3, padding=1, act="relu")
+            feat = pt.layers.pool2d(feat, 2, "max", 2)      # 4x4
+
+            boxes, vars_ = pt.layers.detection.prior_box(
+                feat, img, min_sizes=[12.0], aspect_ratios=[1.0],
+                flip=False, clip=True)
+            p = 4 * 4  # 4x4 grid, 1 prior each
+            prior = pt.layers.reshape(boxes, [p, 4])
+            prior_var = pt.layers.reshape(vars_, [p, 4])
+
+            loc = pt.layers.conv2d(feat, 4, 3, padding=1)
+            loc = pt.layers.reshape(
+                pt.layers.transpose(loc, [0, 2, 3, 1]), [-1, p, 4])
+            conf = pt.layers.conv2d(feat, classes, 3, padding=1)
+            conf = pt.layers.reshape(
+                pt.layers.transpose(conf, [0, 2, 3, 1]), [-1, p, classes])
+
+            loss_map = pt.layers.detection.ssd_loss(
+                loc, conf, gt_box, gt_label, prior, prior_var)
+            loss = pt.layers.mean(loss_map)
+
+            # inference head + streaming mAP on the SAME batch
+            det, _nms_num = pt.layers.detection.detection_output(
+                loc, pt.layers.transpose(
+                    pt.layers.softmax(conf), [0, 2, 1]),
+                prior, prior_var, nms_threshold=0.45, keep_top_k=4,
+                score_threshold=0.01)
+            lab6 = pt.layers.concat(
+                [pt.layers.cast(gt_label, "float32"), gt_box,
+                 pt.layers.fill_constant_batch_size_like(
+                     gt_box, [-1, 1, 1], "float32", 0.0)], axis=2)
+            m = pt.layers.detection.detection_map(det, lab6, classes)
+            pt.optimizer.Adam(5e-3).minimize(loss)
+
+        exe = pt.Executor()
+        gt_b, gt_l = _toy_scene(rng, n)
+        img_v = rng.rand(n, 3, hw, hw).astype(np.float32)
+        # paint the box cell so the image carries class signal
+        for i in range(n):
+            x0, y0, x1, y1 = (gt_b[i, 0] * hw).astype(int)
+            img_v[i, gt_l[i, 0, 0] % 3, y0:y1, x0:x1] += 2.0
+
+        losses, maps = [], []
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for step in range(30):
+                lv, mv = exe.run(main,
+                                 feed={"img": img_v, "gt_box": gt_b,
+                                       "gt_label": gt_l},
+                                 fetch_list=[loss, m])
+                losses.append(float(np.ravel(lv)[0]))
+                maps.append(float(np.ravel(mv)[0]))
+        self.assertLess(losses[-1], losses[0] * 0.8,
+                        f"ssd loss did not decrease: {losses[:3]}..."
+                        f"{losses[-3:]}")
+        # the streaming metric must be finite and in [0, 1]
+        self.assertTrue(all(0.0 <= v <= 1.0 for v in maps), maps[-5:])
+        # with the confidence head trained, late mAP >= early mAP
+        self.assertGreaterEqual(np.mean(maps[-5:]), np.mean(maps[:5]))
+
+
+class TestMaskRCNNLabelPipeline(unittest.TestCase):
+    def test_mask_head_trains(self):
+        """generate_proposal_labels -> roi_align -> conv mask head,
+        supervised by generate_mask_labels; loss must decrease."""
+        rng = np.random.RandomState(1)
+        n, R, G, C, res = 2, 8, 2, 3, 8
+        hw = 32
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            # static batch: the fixed-size label ops mix per-image and
+            # flattened-roi shapes, which symbolic-batch inference cannot
+            # relate (append_batch_size=False pins n)
+            feat = pt.layers.data("feat", [n, 8, hw, hw],
+                                  append_batch_size=False)
+            rois_in = pt.layers.data("rois", [n, R, 4],
+                                     append_batch_size=False)
+            gt_cls = pt.layers.data("gt_cls", [n, G], dtype="int32",
+                                    append_batch_size=False)
+            gt_box = pt.layers.data("gt_boxes", [n, G, 4],
+                                    append_batch_size=False)
+            im_info = pt.layers.data("im_info", [n, 3],
+                                     append_batch_size=False)
+            gt_segms = pt.layers.data("gt_segms", [n, G, hw, hw],
+                                      append_batch_size=False)
+
+            (rois, labels, _tgts, _inw, _outw, matched,
+             _fg) = pt.layers.detection.generate_proposal_labels(
+                rois_in, gt_cls, None, gt_box, im_info,
+                batch_size_per_im=4, fg_fraction=0.5, fg_thresh=0.5,
+                bg_thresh_hi=0.5, class_nums=C, use_random=False)
+
+            (_mask_rois, has_mask,
+             mask_int32) = pt.layers.detection.generate_mask_labels(
+                im_info, gt_cls, None, gt_segms, rois, labels, C, res,
+                matched_gt_int32=matched)
+
+            # mask head: roi_align on the feature map + convs. roi_align
+            # takes FLAT rois [r, 4] + per-image counts (the reference's
+            # LoD redesign)
+            b_total = n * 4
+            rois_flat = pt.layers.reshape(rois, [-1, 4])
+            rois_num = pt.layers.fill_constant([n], "int32", 4)
+            pooled = pt.layers.detection.roi_align(
+                feat, rois_flat, pooled_height=res, pooled_width=res,
+                spatial_scale=1.0, rois_num=rois_num)  # [nB, 8, res, res]
+            h = pt.layers.conv2d(pooled, 8, 3, padding=1, act="relu")
+            logits = pt.layers.conv2d(h, C, 1)  # [nB, C, res, res]
+            logits_flat = pt.layers.reshape(logits, [-1, C * res * res])
+
+            mask_t = pt.layers.reshape(mask_int32, [-1, C * res * res])
+            valid = pt.layers.cast(
+                pt.layers.greater_equal(
+                    mask_t, pt.layers.fill_constant([1], "int32", 0)),
+                "float32")
+            target = pt.layers.cast(
+                pt.layers.elementwise_max(
+                    mask_t, pt.layers.fill_constant([1], "int32", 0)),
+                "float32")
+            per = pt.layers.sigmoid_cross_entropy_with_logits(
+                logits_flat, target)
+            loss = pt.layers.reduce_sum(per * valid) / \
+                (pt.layers.reduce_sum(valid) + 1.0)
+            pt.optimizer.Adam(1e-2).minimize(loss)
+
+        # data: two gt squares per image with distinct classes
+        feat_v = rng.randn(n, 8, hw, hw).astype(np.float32) * 0.1
+        gt_boxes = np.zeros((n, G, 4), np.float32)
+        gt_classes = np.zeros((n, G), np.int32)
+        segms = np.zeros((n, G, hw, hw), np.float32)
+        rois_v = np.zeros((n, R, 4), np.float32)
+        for i in range(n):
+            for g in range(G):
+                x0 = 4 + 14 * g
+                gt_boxes[i, g] = [x0, 4, x0 + 10, 14]
+                gt_classes[i, g] = g + 1
+                segms[i, g, 4:14, x0:x0 + 10] = 1.0
+                feat_v[i, g, 4:14, x0:x0 + 10] += 1.0  # feature signal
+            for r in range(R):
+                g = r % G
+                jx, jy = rng.randint(-2, 3, 2)
+                x0 = 4 + 14 * g + jx
+                rois_v[i, r] = [x0, 4 + jy, x0 + 10, 14 + jy]
+        im_info_v = np.tile(np.array([[hw, hw, 1.0]], np.float32),
+                            (n, 1))
+
+        exe = pt.Executor()
+        feed = {"feat": feat_v, "rois": rois_v, "gt_cls": gt_classes,
+                "gt_boxes": gt_boxes, "im_info": im_info_v,
+                "gt_segms": segms}
+        losses = []
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for _ in range(25):
+                lv, hm = exe.run(main, feed=feed,
+                                 fetch_list=[loss, has_mask])
+                losses.append(float(np.ravel(lv)[0]))
+        self.assertTrue(np.asarray(hm).sum() > 0,
+                        "no fg rois got masks")
+        self.assertLess(losses[-1], losses[0] * 0.6,
+                        f"mask loss did not decrease: {losses[:3]}..."
+                        f"{losses[-3:]}")
+
+
+if __name__ == "__main__":
+    unittest.main()
