@@ -316,9 +316,11 @@ def _deformable_psroi_pooling(ctx, ins, attrs):
     pw = int(attrs["pooled_width"])
     spp = int(attrs.get("sample_per_part", 4))
     trans_std = attrs.get("trans_std", 0.1)
-    group_h = int(attrs.get("group_size", [ph, pw])[0]) \
-        if isinstance(attrs.get("group_size"), (list, tuple)) else ph
-    group_w = group_h
+    gs = attrs.get("group_size")
+    if isinstance(gs, (list, tuple)):
+        group_h, group_w = int(gs[0]), int(gs[1])
+    else:
+        group_h, group_w = ph, pw
     part = attrs.get("part_size")
     part_h, part_w = (int(part[0]), int(part[1])) \
         if isinstance(part, (list, tuple)) else (ph, pw)
