@@ -21,3 +21,5 @@ from . import imikolov  # noqa: F401
 from . import sentiment  # noqa: F401
 from . import flowers  # noqa: F401
 from . import voc2012  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import image  # noqa: F401

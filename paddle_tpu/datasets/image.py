@@ -1,0 +1,154 @@
+"""Image preprocessing utilities (reference: python/paddle/dataset/
+image.py — cv2-based helpers for the image pipelines). Implemented over
+numpy + Pillow (no cv2 in this environment); the API and semantics match
+the reference: HWC uint8/float arrays in, `simple_transform` produces the
+CHW float training layout."""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+import numpy as np
+
+__all__ = ["load_image", "load_image_bytes", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform",
+           "batch_images_from_tar"]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def load_image_bytes(data, is_color=True):
+    """Decode encoded image bytes -> HWC uint8 (or HW when not
+    is_color)."""
+    img = _pil().open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im, size):
+    """Scale so the SHORT edge equals `size` (reference image.py:197).
+    Preserves the input dtype: float images resize per-channel in
+    float32 (PIL 'F' mode) instead of being truncated to uint8."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_h, new_w = int(round(h * size / w)), size
+    else:
+        new_h, new_w = size, int(round(w * size / h))
+    Image = _pil()
+    if im.dtype == np.uint8:
+        return np.asarray(Image.fromarray(im).resize((new_w, new_h)))
+    im32 = im.astype(np.float32)
+    if im32.ndim == 2:
+        out = np.asarray(Image.fromarray(im32, mode="F")
+                         .resize((new_w, new_h)))
+    else:
+        out = np.stack(
+            [np.asarray(Image.fromarray(im32[:, :, c], mode="F")
+                        .resize((new_w, new_h)))
+             for c in range(im32.shape[2])], axis=2)
+    return out.astype(im.dtype)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def _crop(im, size, is_color, top, left):
+    h_end, w_end = top + size, left + size
+    if is_color and im.ndim == 3:
+        return im[top:h_end, left:w_end, :]
+    return im[top:h_end, left:w_end]
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    return _crop(im, size, is_color, (h - size) // 2, (w - size) // 2)
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    return _crop(im, size, is_color, rng.randint(0, h - size + 1),
+                 rng.randint(0, w - size + 1))
+
+
+def left_right_flip(im, is_color=True):
+    if is_color and im.ndim == 3:
+        return im[:, ::-1, :]
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> crop (random+flip when training, center otherwise)
+    -> CHW float32 -> optional mean subtraction (reference
+    image.py:327)."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color, rng=rng)
+        if rng.randint(0, 2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if is_color and im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if is_color and mean.ndim == 1:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pre-batch a tar of images into pickled (data, label) blocks
+    (reference image.py:80). Returns the meta-file path."""
+    import pickle
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id, names = [], [], 0, []
+    with tarfile.open(data_file) as f:
+        for m in f.getmembers():
+            if m.name not in img2label:
+                continue
+            data.append(f.extractfile(m).read())
+            labels.append(img2label[m.name])
+            if len(data) == num_per_batch:
+                output = {"label": labels, "data": data}
+                part = os.path.join(out_path, f"batch_{file_id}")
+                with open(part, "wb") as o:
+                    pickle.dump(output, o, protocol=2)
+                names.append(part)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        part = os.path.join(out_path, f"batch_{file_id}")
+        with open(part, "wb") as o:
+            pickle.dump({"label": labels, "data": data}, o, protocol=2)
+        names.append(part)
+    meta = os.path.join(out_path, "batch_data.meta")
+    with open(meta, "w") as o:
+        o.write("\n".join(names))
+    return meta

@@ -1,0 +1,105 @@
+"""MQ2007 learning-to-rank (reference: python/paddle/dataset/mq2007.py —
+TREC Million Query 2007, SVMrank format grouped by query). Readers yield
+per the `format`:
+  pointwise: (feature [46], relevance score)
+  pairwise : (high_feature, low_feature) for every ordered pair
+  listwise : (label list, feature list) per query
+Stage train.txt / vali.txt / test.txt (from any MQ2007 fold) directly
+under $PADDLE_TPU_DATA_HOME/mq2007/."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "vali"]
+
+_N_FEAT = 46
+_SYNTH_QUERIES = {"train": 40, "test": 10, "vali": 10}
+
+
+def _parse_lines(lines, fill_missing=-1.0):
+    """SVMrank lines -> {qid: [(rel, feat np.array)]}, document order
+    preserved (reference Query._parse_)."""
+    queries = {}
+    for line in lines:
+        line = line.split("#")[0].strip()
+        if not line:
+            continue
+        toks = line.split()
+        rel = int(toks[0])
+        qid = toks[1].split(":")[1]
+        feat = np.full((_N_FEAT,), fill_missing, np.float32)
+        for t in toks[2:]:
+            if ":" not in t:
+                continue
+            k, v = t.split(":", 1)
+            if k.isdigit() and 1 <= int(k) <= _N_FEAT:
+                feat[int(k) - 1] = float(v)
+        queries.setdefault(qid, []).append((rel, feat))
+    return queries
+
+
+def _synth_queries(split):
+    rng = common.synthetic_rng("mq2007", split)
+    out = {}
+    for q in range(_SYNTH_QUERIES[split]):
+        docs = []
+        w = rng.randn(_N_FEAT)
+        for _ in range(rng.randint(4, 10)):
+            f = rng.randn(_N_FEAT).astype(np.float32)
+            # relevance correlates with a hidden linear score
+            rel = int(np.clip(f @ w / 6.0 + 1.0, 0, 2))
+            docs.append((rel, f))
+        out[f"q{q}"] = docs
+    return out
+
+
+def _load(split, use_synthetic):
+    if common.synthetic_enabled(use_synthetic):
+        return _synth_queries(split)
+    fname = f"{split}.txt"
+    path = common.require_file(
+        common.data_path("mq2007", fname),
+        f"Stage {fname} from an MQ2007 fold (SVMrank format) directly "
+        "under the mq2007/ data dir.")
+    with open(path) as f:
+        return _parse_lines(f)
+
+
+def _reader_creator(split, fmt, use_synthetic):
+    def reader():
+        queries = _load(split, use_synthetic)
+        for qid in sorted(queries):
+            docs = queries[qid]
+            if fmt == "pointwise":
+                for rel, feat in docs:
+                    yield feat, float(rel)
+            elif fmt == "pairwise":
+                for i, (ri, fi) in enumerate(docs):
+                    for rj, fj in docs[i + 1:]:
+                        if ri > rj:
+                            yield fi, fj
+                        elif rj > ri:
+                            yield fj, fi
+            elif fmt == "listwise":
+                yield ([float(r) for r, _ in docs],
+                       [f for _, f in docs])
+            else:
+                raise ValueError(f"unknown format {fmt!r}")
+    return reader
+
+
+def train(format="pairwise", use_synthetic=None):
+    return _reader_creator("train", format, use_synthetic)
+
+
+def test(format="pairwise", use_synthetic=None):
+    return _reader_creator("test", format, use_synthetic)
+
+
+def vali(format="pairwise", use_synthetic=None):
+    return _reader_creator("vali", format, use_synthetic)

@@ -190,5 +190,53 @@ class TestSplitFiles(unittest.TestCase):
         self.assertEqual(len(b), 3)
 
 
+class TestMq2007(unittest.TestCase):
+    def test_formats(self):
+        from paddle_tpu.datasets import mq2007
+        pts = list(mq2007.train("pointwise", use_synthetic=True)())
+        self.assertGreater(len(pts), 100)
+        f, r = pts[0]
+        self.assertEqual(f.shape, (46,))
+        self.assertIn(r, (0.0, 1.0, 2.0))
+        pairs = list(mq2007.train("pairwise", use_synthetic=True)())
+        hi, lo = pairs[0]
+        self.assertEqual((hi.shape, lo.shape), ((46,), (46,)))
+        lists = list(mq2007.test("listwise", use_synthetic=True)())
+        self.assertEqual(len(lists), 10)
+        labels, feats = lists[0]
+        self.assertEqual(len(labels), len(feats))
+
+    def test_svmrank_parsing(self):
+        from paddle_tpu.datasets.mq2007 import _parse_lines
+        lines = ["2 qid:10 1:0.5 2:0.25 46:1.0 #docid = x",
+                 "0 qid:10 1:0.1 2:0.9",
+                 "1 qid:11 3:0.3"]
+        q = _parse_lines(lines)
+        self.assertEqual(sorted(q), ["10", "11"])
+        rel, feat = q["10"][0]
+        self.assertEqual(rel, 2)
+        self.assertAlmostEqual(feat[0], 0.5)
+        self.assertAlmostEqual(feat[45], 1.0)
+        self.assertAlmostEqual(q["11"][0][1][2], 0.3)
+
+
+class TestImageUtils(unittest.TestCase):
+    def test_transform_pipeline(self):
+        from paddle_tpu.datasets import image as img
+        rng = np.random.RandomState(7)
+        im = (rng.rand(40, 60, 3) * 255).astype(np.uint8)
+        r = img.resize_short(im, 32)
+        self.assertEqual(min(r.shape[:2]), 32)
+        c = img.center_crop(r, 24)
+        self.assertEqual(c.shape[:2], (24, 24))
+        f = img.left_right_flip(c)
+        np.testing.assert_array_equal(f[:, 0], c[:, -1])
+        out = img.simple_transform(im, 32, 24, is_train=True,
+                                   mean=[1.0, 2.0, 3.0],
+                                   rng=np.random.RandomState(0))
+        self.assertEqual(out.shape, (3, 24, 24))
+        self.assertEqual(out.dtype, np.float32)
+
+
 if __name__ == "__main__":
     unittest.main()

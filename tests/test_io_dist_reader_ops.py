@@ -322,5 +322,58 @@ class TestSendRecvLoopback(unittest.TestCase):
             server.stop()
 
 
+class TestFederatedListenAndServ(unittest.TestCase):
+    def test_fl_server_op_serves_async_pushes(self):
+        """fl_listen_and_serv (federated variant): the op runs a blocking
+        async KV server; clients push whole-model deltas at their own
+        cadence and pull the merged state (reference:
+        distributed_ops/fl_listen_and_serv_op.cc)."""
+        try:
+            from paddle_tpu.distributed.pskv import KVClient
+        except Exception as e:  # pragma: no cover
+            self.skipTest(f"pskv native lib unavailable: {e}")
+        import socket
+        import threading
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        prog = pt.Program()
+        blk = prog.global_block
+        blk.append_op("fl_listen_and_serv", {}, {},
+                      {"endpoint": f"127.0.0.1:{port}", "Fanin": 2},
+                      infer_shape=False)
+        exe = pt.Executor()
+        th = threading.Thread(
+            target=lambda: exe.run(prog, scope=pt.Scope()), daemon=True)
+        th.start()
+
+        # two federated clients pushing at their own pace
+        deadline = 50
+        c1 = None
+        for _ in range(deadline):
+            try:
+                c1 = KVClient("127.0.0.1", port, trainer_id=0)
+                c1.create_dense("flw", 3, opt="sgd", lr=1.0)
+                break
+            except Exception:
+                import time
+                time.sleep(0.1)
+        self.assertIsNotNone(c1, "fl server did not come up")
+        c1.init_dense("flw", np.zeros(3, np.float32))
+        c2 = KVClient("127.0.0.1", port, trainer_id=1)
+        c1.push_dense("flw", np.array([1.0, 0, 0], np.float32))
+        c2.push_dense("flw", np.array([0, 2.0, 0], np.float32))
+        got = c1.pull_dense("flw", 3)
+        np.testing.assert_allclose(got, [-1.0, -2.0, 0.0], atol=1e-6)
+        c1.shutdown_server()
+        th.join(timeout=10)
+        self.assertFalse(th.is_alive(), "fl_listen_and_serv did not exit")
+        c1.close()
+        c2.close()
+
+
 if __name__ == "__main__":
     unittest.main()
