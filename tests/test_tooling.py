@@ -59,3 +59,31 @@ def test_op_bench_cli():
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_profile_summary_aggregation():
+    """tools/profile_summary.summarize over a synthetic hlo_stats table
+    (the xprof schema): time-weighted averages and bound-by grouping."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import profile_summary as ps
+
+    cols = ["Rank", "HLO op category", "Total self time (us)",
+            "Model GFLOP/s", "Measured memory BW (GiB/s)", "Bound by"]
+    def row(cat, t, gf, bw, bound):
+        vals = [0, cat, t, gf, bw, bound]
+        return {"c": [{"v": v} for v in vals]}
+    stats = {"cols": [{"label": c} for c in cols],
+             "rows": [row("convolution fusion", 3000, 100000, 400, "Compute"),
+                      row("convolution fusion", 1000, 20000, 800, "HBM"),
+                      row("loop fusion", 1000, 500, 750, "HBM"),
+                      row("zero", 0, 0, 0, "HBM")]}
+    out = ps.summarize(stats, steps=2, top=5)
+    assert abs(out["total_ms_per_step"] - 2.5) < 1e-9
+    rows = {(r["category"], r["bound_by"]): r for r in out["rows"]}
+    conv = rows[("convolution fusion", "Compute")]
+    assert abs(conv["ms_per_step"] - 1.5) < 1e-9
+    assert abs(conv["pct"] - 60.0) < 1e-9
+    assert abs(conv["avg_tflops"] - 100.0) < 1e-9
+    hbm = rows[("convolution fusion", "HBM")]
+    assert abs(hbm["avg_hbm_gibs"] - 800.0) < 1e-9
+    assert ("zero", "HBM") not in rows  # zero-time rows dropped

@@ -1,0 +1,97 @@
+"""Per-op profile summary from a paddle_tpu profiler capture.
+
+The timeline tool (tools/timeline.py) renders the full chrome trace; this
+one answers the perf question directly: WHERE does the step's device time
+go, and is each bucket compute- or HBM-bound? It aggregates xprof's
+hlo_stats over the capture — the table behind BASELINE.md's r3 ResNet-50
+bandwidth-wall proof.
+
+Usage:
+  with paddle_tpu.profiler.profiler(profile_path=DIR):
+      ... a few executor steps ...
+  python tools/profile_summary.py --profile_path DIR [--steps N] [--top K]
+"""
+
+import argparse
+import collections
+import json
+import sys
+
+
+def load_hlo_stats(profile_dir: str):
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.timeline import find_xplane
+    from xprof.convert import raw_to_tool_data
+
+    xplane = find_xplane(profile_dir)
+    data, _ = raw_to_tool_data.xspace_to_tool_data([xplane], "hlo_stats",
+                                                   {})
+    if data is None:  # xprof signals failure as None, not an exception
+        raise RuntimeError(
+            f"hlo_stats conversion failed for {profile_dir!r} — the "
+            "capture may contain no device (TPU) activity")
+    if isinstance(data, bytes):
+        data = data.decode()
+    return json.loads(data)
+
+
+def summarize(stats, steps: int = 1, top: int = 12):
+    cols = [c["label"] if isinstance(c, dict) else c
+            for c in stats["cols"]]
+    idx = {c: i for i, c in enumerate(cols)}
+
+    def cell(r, name):
+        v = r["c"][idx[name]]
+        return v.get("v") if isinstance(v, dict) else v
+
+    agg = collections.Counter()
+    flops_w = collections.Counter()
+    bw_w = collections.Counter()
+    total = 0.0
+    for r in stats["rows"]:
+        t = float(cell(r, "Total self time (us)") or 0)
+        if t <= 0:
+            continue
+        key = (cell(r, "HLO op category"), cell(r, "Bound by"))
+        agg[key] += t
+        flops_w[key] += float(cell(r, "Model GFLOP/s") or 0) * t
+        bw_w[key] += float(cell(r, "Measured memory BW (GiB/s)") or 0) * t
+        total += t
+
+    rows = []
+    for (cat, bound), t in agg.most_common(top):
+        rows.append({
+            "category": cat, "bound_by": bound,
+            "ms_per_step": t / 1e3 / steps,
+            "pct": 100.0 * t / total,
+            "avg_tflops": flops_w[(cat, bound)] / t / 1000.0,
+            "avg_hbm_gibs": bw_w[(cat, bound)] / t,
+        })
+    return {"total_ms_per_step": total / 1e3 / steps, "rows": rows}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True)
+    ap.add_argument("--steps", type=int, default=1,
+                    help="profiled step count (divides the totals)")
+    ap.add_argument("--top", type=int, default=12)
+    args = ap.parse_args(argv)
+
+    out = summarize(load_hlo_stats(args.profile_path), args.steps,
+                    args.top)
+    print(f"total device self time: {out['total_ms_per_step']:.2f} "
+          f"ms/step")
+    print(f"{'ms/step':>9}  {'%':>5}  {'TFLOP/s':>8}  {'HBM GiB/s':>9}  "
+          f"{'bound':>8}  category")
+    for r in out["rows"]:
+        print(f"{r['ms_per_step']:9.3f}  {r['pct']:5.1f}  "
+              f"{r['avg_tflops']:8.1f}  {r['avg_hbm_gibs']:9.1f}  "
+              f"{str(r['bound_by']):>8}  {r['category']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
