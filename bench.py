@@ -1,7 +1,8 @@
 """Benchmark: BERT-base MLM pretrain step (fwd+bwd+adam) on one TPU chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured MFU / 0.45 (the BASELINE.md north-star target).
+vs_baseline is measured MFU / 0.45 (the BASELINE.md north-star
+target); current headline ~52% MFU (see BASELINE.md r3).
 Peak flops default to v5e bf16 (197 TFLOP/s); override with PEAK_TFLOPS.
 
 BENCH_MODEL=resnet50 switches to the ResNet-50 train benchmark

@@ -57,15 +57,18 @@ def _attention(x, mask_4d, mask_k, cfg: BertConfig, prefix: str,
     # b,s,n,d layout end to end: einsum contractions compile to single
     # dot_generals with no physical transposes (HBM copies), unlike the
     # reference's transpose+matmul attention (nets.py
-    # scaled_dot_product_attention)
-    qkv = pt.layers.fc(x, 3 * h, num_flatten_dims=2,
-                       param_attr=_attr(f"{prefix}/qkv.w", cfg),
-                       bias_attr=ParamAttr(name=f"{prefix}/qkv.b"))
-    qkv = pt.layers.reshape(qkv, [0, seq, 3, nh, hd])
-    q, k, v = pt.layers.split(qkv, 3, dim=2)
-    q = pt.layers.reshape(q, [0, seq, nh, hd])
-    k = pt.layers.reshape(k, [0, seq, nh, hd])
-    v = pt.layers.reshape(v, [0, seq, nh, hd])
+    # scaled_dot_product_attention). SEPARATE q/k/v projections, not a
+    # fused 3h one: the fused form forces XLA to relay the (b, s, 3h)
+    # output before the attention einsums AND to concatenate the weight
+    # grad — measured r3: 5.40 -> 3.45 ms per layer fwd+bwd (-36%,
+    # BASELINE.md), ~10% of the whole train step was those copies.
+    def proj(name):
+        p = pt.layers.fc(x, h, num_flatten_dims=2,
+                         param_attr=_attr(f"{prefix}/{name}.w", cfg),
+                         bias_attr=ParamAttr(name=f"{prefix}/{name}.b"))
+        return pt.layers.reshape(p, [0, seq, nh, hd])
+
+    q, k, v = proj("q"), proj("k"), proj("v")
     if cfg.attn_impl == "fused":
         ctx = pt.layers.fused_attention(
             q, k, v, bias_k=mask_k, sm_scale=1.0 / math.sqrt(hd),
@@ -203,8 +206,9 @@ def tp_shardings(cfg: BertConfig, prefix: str = "bert"):
     spec = {f"{prefix}/word_embedding": ("mp", None)}
     for i in range(cfg.layers):
         p = f"{prefix}/l{i}"
-        spec[f"{p}/qkv.w"] = (None, "mp")
-        spec[f"{p}/qkv.b"] = ("mp",)
+        for t in ("q", "k", "v"):
+            spec[f"{p}/{t}.w"] = (None, "mp")
+            spec[f"{p}/{t}.b"] = ("mp",)
         spec[f"{p}/out.w"] = ("mp", None)
         spec[f"{p}/ffn1.w"] = (None, "mp")
         spec[f"{p}/ffn1.b"] = ("mp",)

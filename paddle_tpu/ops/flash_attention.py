@@ -237,9 +237,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
 
 def _small_batch(bn, s):
     """Rows per program: largest power-of-two divisor of bn whose f32
-    score tile (B, s, s) stays within ~2MB of VMEM (the kernel's full
-    working set is ~4x the score tile; the scoped limit is 16MB)."""
-    budget = 2 * 1024 * 1024
+    score tile (B, s, s) stays within ~1.5MB of VMEM (the backward's
+    working set is ~8x the score tile — scores + p + dp + ds plus the
+    q/k/v/do tiles — against the 16MB scoped limit)."""
+    budget = 3 * 512 * 1024
     b = 16
     while b > 1 and (bn % b != 0 or b * s * s * 4 > budget):
         b //= 2
@@ -683,12 +684,12 @@ def attention(q, k, v, bias=None, causal: bool = False,
         bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1)
     shapes_ok = (q.shape[-1] % 8 == 0 and q.shape[1] % 8 == 0
                  and k.shape[1] % 128 == 0)
-    # dispatch by shape, the way cuDNN picks algos (BASELINE.md r3 grid):
-    # s=128 XLA's fused composition still wins (47.5% vs 42.8% MFU — the
-    # kernel pays the bn relayout XLA fuses away); from s=256 the batched
-    # single-pass kernel wins (42.7% vs 41.8%) and at s=512 it wins big
-    # (39.8% vs 31.2%, also beating the old tiled kernel's 37.0%).
-    # impl='flash' still forces the kernel at any length.
+    # dispatch by shape, the way cuDNN picks algos (BASELINE.md r3 grid,
+    # re-measured after the separate-q/k/v-projection change): s=128
+    # XLA's fused composition wins (52.0% vs 51.4% MFU); s=256 is a tie
+    # within run variance (einsum 44.3 vs kernel 43.9); at s=512 the
+    # batched single-pass kernel wins big (41.2% vs 32.0%, also beating
+    # the r2 tiled kernel's 37.0). impl='flash' still forces the kernel.
     long_enough = k.shape[1] >= 256
     if impl == "flash" and not bias_ok:
         raise ValueError(

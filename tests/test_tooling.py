@@ -56,16 +56,10 @@ def test_op_bench_cli():
     assert r.returncode == 0, r.stderr
     assert "softmax" in r.stdout
 
-
-if __name__ == "__main__":
-    sys.exit(pytest.main([__file__, "-x", "-q"]))
-
-
 def test_profile_summary_aggregation():
     """tools/profile_summary.summarize over a synthetic hlo_stats table
     (the xprof schema): time-weighted averages and bound-by grouping."""
-    sys.path.insert(0, os.path.join(REPO, "tools"))
-    import profile_summary as ps
+    import tools.profile_summary as ps
 
     cols = ["Rank", "HLO op category", "Total self time (us)",
             "Model GFLOP/s", "Measured memory BW (GiB/s)", "Bound by"]
@@ -87,3 +81,7 @@ def test_profile_summary_aggregation():
     hbm = rows[("convolution fusion", "HBM")]
     assert abs(hbm["avg_hbm_gibs"] - 800.0) < 1e-9
     assert ("zero", "HBM") not in rows  # zero-time rows dropped
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
