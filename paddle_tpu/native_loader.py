@@ -16,10 +16,40 @@ import threading
 _build_lock = threading.Lock()
 
 
+def _sanitize_flags():
+    """PADDLE_TPU_SANITIZE=address|thread|undefined|leak[,...] — the
+    reference's CMake SANITIZER_TYPE knob (CMakeLists.txt:77) for the
+    native components: race/memory-error detection builds of the C++
+    pserver and datafeed (SURVEY §5 sanitizers row). Sanitized builds
+    get a distinct .so suffix so they never shadow the release build."""
+    kinds = os.environ.get("PADDLE_TPU_SANITIZE", "").strip()
+    if not kinds:
+        return [], ""
+    # g++-supported set ('memory'/MSan is clang-only)
+    allowed = {"address", "thread", "undefined", "leak"}
+    picked = [k.strip() for k in kinds.split(",") if k.strip()]
+    bad = [k for k in picked if k not in allowed]
+    if bad:
+        raise ValueError(
+            f"PADDLE_TPU_SANITIZE: unknown sanitizer(s) {bad}; "
+            f"choose from {sorted(allowed)} (g++-supported)")
+    exclusive = {"address", "thread", "leak"} & set(picked)
+    if len(exclusive) > 1:
+        raise ValueError(
+            f"PADDLE_TPU_SANITIZE: {sorted(exclusive)} are mutually "
+            "exclusive — pick one (undefined combines with any)")
+    flags = [f"-fsanitize={k}" for k in picked] + [
+        "-g", "-fno-omit-frame-pointer"]
+    return flags, "." + "_".join(picked)
+
+
 def compile_and_load(src: str, so: str) -> ctypes.CDLL:
     """Build `so` from `src` if missing or stale (source newer), then dlopen
     it. A missing source next to a prebuilt .so is fine (deployment without
     sources). Raises RuntimeError with the compiler's stderr on failure."""
+    san_flags, san_suffix = _sanitize_flags()
+    if san_suffix:
+        so = so + san_suffix
     with _build_lock:
         needs = not os.path.exists(so) or (
             os.path.exists(src)
@@ -29,9 +59,10 @@ def compile_and_load(src: str, so: str) -> ctypes.CDLL:
                 raise FileNotFoundError(
                     f"native library {so} missing and source {src} absent")
             tmp = f"{so}.{os.getpid()}.tmp"  # unique per builder process
+            opt = ["-O1"] if san_flags else ["-O2"]  # -O1: usable stacks
             proc = subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                 "-o", tmp, src],
+                ["g++", *opt, "-std=c++17", "-shared", "-fPIC", "-pthread"]
+                + san_flags + ["-o", tmp, src],
                 capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
