@@ -517,27 +517,39 @@ def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
 
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.5, evaluate_difficult=True,
-                  ap_version="integral", name=None):
-    """Streaming mAP metric with persistable bucketized accumulators
-    (reference: layers/metric_op.py via DetectionMAP, detection_map_op.cc).
-    detect_res [n, D, 6], label [n, G, 6]. Returns the scalar mAP var."""
+                  ap_version="integral", has_state=True,
+                  return_states=False, name=None):
+    """Streaming mAP metric (reference: layers/metric_op.py via
+    DetectionMAP, detection_map_op.cc). detect_res [n, D, 6],
+    label [n, G, 6]. has_state=True accumulates in persistable bucketized
+    TP/FP state vars across steps; has_state=False computes the
+    current-batch mAP only. Returns the scalar mAP var, or
+    (map, [state vars]) with return_states=True."""
     helper = LayerHelper("detection_map", name=name)
     C = int(class_num)
-    pos = helper.create_global_state_var("dmap_pos_count", [C], "int32")
-    tp = helper.create_global_state_var("dmap_true_pos", [C, 1000],
-                                        "int32")
-    fp = helper.create_global_state_var("dmap_false_pos", [C, 1000],
-                                        "int32")
     m = helper.create_variable_for_type_inference("float32", True)
+    ins = {"DetectRes": [detect_res.name], "Label": [label.name]}
+    if has_state:
+        pos = helper.create_global_state_var("dmap_pos_count", [C],
+                                             "int32")
+        tp = helper.create_global_state_var("dmap_true_pos", [C, 1000],
+                                            "int32")
+        fp = helper.create_global_state_var("dmap_false_pos", [C, 1000],
+                                            "int32")
+        ins.update({"PosCount": [pos.name], "TruePos": [tp.name],
+                    "FalsePos": [fp.name]})
+    else:  # fresh zero state: out vars only, never read back
+        pos = helper.create_variable_for_type_inference("int32", True)
+        tp = helper.create_variable_for_type_inference("int32", True)
+        fp = helper.create_variable_for_type_inference("int32", True)
     helper.append_op(
-        "detection_map",
-        {"DetectRes": [detect_res.name], "Label": [label.name],
-         "PosCount": [pos.name], "TruePos": [tp.name],
-         "FalsePos": [fp.name]},
+        "detection_map", ins,
         {"MAP": [m.name], "AccumPosCount": [pos.name],
          "AccumTruePos": [tp.name], "AccumFalsePos": [fp.name]},
         {"class_num": C, "background_label": background_label,
          "overlap_threshold": overlap_threshold,
          "evaluate_difficult": evaluate_difficult,
          "ap_type": ap_version}, infer_shape=False)
+    if return_states:
+        return m, [pos, tp, fp]
     return m

@@ -8,7 +8,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MetricBase", "CompositeMetric", "Accuracy", "Precision",
-           "Recall", "ChunkEvaluator", "Auc"]
+           "Recall", "ChunkEvaluator", "Auc", "DetectionMAP"]
 
 
 class MetricBase:
@@ -165,3 +165,56 @@ class ChunkEvaluator(MetricBase):
         f1 = (2 * precision * recall / (precision + recall)
               if precision + recall else 0.0)
         return precision, recall, f1
+
+
+class DetectionMAP:
+    """Graph-builder mAP evaluator (reference: fluid/metrics.py:695
+    DetectionMAP) over the streaming detection_map op. Dense shapes:
+    input [n, D, 6] (label, score, box), gt_label [n, G, 1],
+    gt_box [n, G, 4], gt_difficult [n, G, 1] or None.
+
+    Appends TWO detection_map ops to the current program: a stateless one
+    (current-batch mAP) and the accumulating one (persistable bucketized
+    TP/FP state — ops/detection_extra_ops.py). get_map_var() returns
+    (cur_map, accum_map); reset(executor) zeroes the accumulators."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        from . import layers
+
+        if class_num is None:
+            raise ValueError("DetectionMAP requires class_num")
+        parts = [layers.cast(gt_label, "float32"),
+                 layers.cast(gt_box, "float32")]
+        if gt_difficult is not None:
+            parts.append(layers.cast(gt_difficult, "float32"))
+        label6 = layers.concat(parts, axis=2)
+
+        kw = dict(background_label=background_label,
+                  overlap_threshold=overlap_threshold,
+                  evaluate_difficult=evaluate_difficult,
+                  ap_version=ap_version)
+        # current-batch mAP: stateless (fresh zero state every step)
+        self.cur_map = layers.detection.detection_map(
+            input, label6, class_num, has_state=False, **kw)
+        # accumulated mAP: persistable bucketized state
+        self.accum_map, states = layers.detection.detection_map(
+            input, label6, class_num, return_states=True, **kw)
+        self._state_names = [v.name for v in states]
+
+    def get_map_var(self):
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None, scope=None):
+        """Zero the accumulators (reference resets via a fill program).
+        Pass `scope` when eval runs with an explicit Executor.run(scope=)
+        instead of scope_guard."""
+        import jax.numpy as jnp
+        from .framework.executor import global_scope
+        scope = scope or global_scope()
+        for n in self._state_names:
+            v = scope.find_var(n)
+            if v is not None:
+                scope.set_var(n, jnp.zeros_like(v))

@@ -208,3 +208,48 @@ class TestMaskRCNNLabelPipeline(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestDetectionMAPMetric(unittest.TestCase):
+    def test_cur_and_accum(self):
+        """metrics.DetectionMAP: current-batch vs accumulated mAP and
+        reset() (reference fluid/metrics.py:695)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            det = pt.layers.data("dm2_det", [2, 2, 6],
+                                 append_batch_size=False)
+            gl = pt.layers.data("dm2_gl", [2, 1, 1], dtype="int64",
+                                append_batch_size=False)
+            gb = pt.layers.data("dm2_gb", [2, 1, 4],
+                                append_batch_size=False)
+            m = pt.metrics.DetectionMAP(det, gl, gb, class_num=2)
+            cur, accum = m.get_map_var()
+        exe = pt.Executor()
+        gt_l = np.ones((2, 1, 1), np.int64)
+        gt_b = np.tile(np.array([0.1, 0.1, 0.4, 0.4], np.float32),
+                       (2, 1, 1)).reshape(2, 1, 4)
+        pad = np.zeros(6, np.float32)
+        hit = np.tile(np.stack([
+            np.array([1, 0.9, 0.1, 0.1, 0.4, 0.4], np.float32), pad]),
+            (2, 1, 1))
+        miss = np.tile(np.stack([
+            np.array([1, 0.8, 0.6, 0.6, 0.9, 0.9], np.float32), pad]),
+            (2, 1, 1))
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            c1, a1 = exe.run(main, feed={"dm2_det": hit, "dm2_gl": gt_l,
+                                         "dm2_gb": gt_b},
+                             fetch_list=[cur, accum])
+            self.assertAlmostEqual(float(np.ravel(c1)[0]), 1.0, places=3)
+            self.assertAlmostEqual(float(np.ravel(a1)[0]), 1.0, places=3)
+            c2, a2 = exe.run(main, feed={"dm2_det": miss, "dm2_gl": gt_l,
+                                         "dm2_gb": gt_b},
+                             fetch_list=[cur, accum])
+            # batch 2 alone: all misses -> cur 0; accumulated: half
+            self.assertAlmostEqual(float(np.ravel(c2)[0]), 0.0, places=3)
+            self.assertAlmostEqual(float(np.ravel(a2)[0]), 0.5, places=2)
+            m.reset(exe)
+            c3, a3 = exe.run(main, feed={"dm2_det": hit, "dm2_gl": gt_l,
+                                         "dm2_gb": gt_b},
+                             fetch_list=[cur, accum])
+            self.assertAlmostEqual(float(np.ravel(a3)[0]), 1.0, places=3)
