@@ -30,8 +30,9 @@ def main():
     from paddle_tpu.models.bert import (BertConfig, bert_pretrain_program,
                                         flops_per_step)
 
-    cfg = BertConfig(attn_impl=os.environ.get("BENCH_ATTN", "einsum"))  # BERT-base
     seq = int(os.environ.get("BENCH_SEQ", 128))
+    cfg = BertConfig(attn_impl=os.environ.get("BENCH_ATTN", "einsum"),
+                     max_pos=max(512, seq))  # BERT-base
     batch = int(os.environ.get("BENCH_BATCH", 128))
     steps = int(os.environ.get("BENCH_STEPS", 30))
     peak = float(os.environ.get("PEAK_TFLOPS", 197.0)) * 1e12
