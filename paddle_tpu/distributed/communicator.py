@@ -121,10 +121,25 @@ class Communicator:
                     q.clear()
             return batch
 
-    def _flush(self):
+    def _flush(self, retries: int = 5):
+        """Drain + send remaining batches; retried so an injected/
+        transient fault at shutdown does not silently lose the run's
+        final gradients."""
         batch = self._drain()
-        if batch:
-            self._send(batch)
+        last = None
+        while batch:
+            try:
+                self._send(batch)
+                batch = self._drain()
+                last = None
+            except Exception as e:
+                retries -= 1
+                if retries <= 0:
+                    raise
+                last = e
+                time.sleep(self._send_wait)
+        if last is not None:
+            raise last
 
     def _client(self, cache, endpoint):
         from .pskv import KVClient
@@ -135,6 +150,10 @@ class Communicator:
         return cache[endpoint]
 
     def _send(self, batch):
+        """Push the batch var by var, REMOVING each var after its push
+        lands — on a mid-batch failure the caller's retry then covers
+        only the unsent remainder (requeueing the whole dict would apply
+        the already-pushed gradients twice)."""
         plan = self._plan
         for s in plan.specs:
             g = batch.get(s.grad_name)
@@ -145,6 +164,7 @@ class Communicator:
                 c.push_sparse(s.name, g[0], g[1])
             else:
                 c.push_dense(s.name, np.asarray(g, np.float32))
+            del batch[s.grad_name]
         self.sent_batches += 1
 
     def _send_loop(self):
@@ -160,13 +180,16 @@ class Communicator:
             try:
                 self._send(batch)
             except Exception as e:
+                # requeue only the UNsent remainder (_send removed the
+                # delivered vars) so retries never double-apply
+                if batch:
+                    self.push(dict(batch))
                 if not self._running:
-                    return  # shutdown race: server already gone
-                # transient push failure: requeue and retry — a dead send
-                # thread would silently freeze training
+                    return  # shutdown: stop()'s retried _flush takes over
+                # transient push failure: retry — a dead send thread
+                # would silently freeze training
                 self.last_error = e
                 _LOG.warning("communicator send failed, retrying: %s", e)
-                self.push(dict(batch))
                 time.sleep(self._send_wait)
 
     def _recv_loop(self):

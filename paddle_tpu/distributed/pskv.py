@@ -129,6 +129,63 @@ def _check(rc: int, what: str):
         raise RuntimeError(f"pskv {what} failed (rc={rc})")
 
 
+class _FaultInjector:
+    """Chaos knob for the PS transport (the fault-injection framework the
+    reference lacks — SURVEY §5 names it a modern gap next to elastic
+    scaling). FLAGS_pskv_fault_inject="drop=0.3,delay_ms=50[,seed=7]"
+    makes every push/pull drop (raise ConnectionError) with the given
+    probability and/or adds latency — letting tests and users prove
+    their training loop survives flaky transport (sync rounds time out
+    and roll back; the async Communicator retries). `ops=push` (prefix
+    match) targets only pushes/pulls of that kind."""
+
+    # seeded streams are PROCESS-global so reconnecting clients continue
+    # the sequence instead of replaying it (a fresh RandomState(seed) per
+    # reconnect would turn "drop with probability p" into a deterministic
+    # livelock for any reconnect-on-error consumer)
+    _streams = {}
+
+    def __init__(self):
+        spec = os.environ.get("FLAGS_pskv_fault_inject", "")
+        self.drop = 0.0
+        self.delay_ms = 0.0
+        self.ops = ""        # prefix filter; "" = all operations
+        seed = None
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            if k == "drop":
+                self.drop = float(v)
+            elif k == "delay_ms":
+                self.delay_ms = float(v)
+            elif k == "seed":
+                seed = int(v)
+            elif k == "ops":
+                self.ops = v
+            else:
+                raise ValueError(
+                    f"FLAGS_pskv_fault_inject: unknown key {k!r} "
+                    "(use drop=, delay_ms=, seed=, ops=)")
+        if seed is None:
+            self._rng = np.random.RandomState()
+        else:
+            self._rng = _FaultInjector._streams.setdefault(
+                seed, np.random.RandomState(seed))
+
+    def maybe_fault(self, what: str):
+        if self.ops and not what.startswith(self.ops):
+            return
+        if self.delay_ms > 0:
+            import time
+            time.sleep(self.delay_ms / 1000.0)
+        if self.drop > 0 and self._rng.random_sample() < self.drop:
+            raise ConnectionError(
+                f"pskv fault injection: dropped {what} "
+                "(FLAGS_pskv_fault_inject)")
+
+
 class KVClient:
     """Trainer-side connection to one pserver (RPCClient analog,
     reference operators/distributed/rpc_client.h:33)."""
@@ -139,6 +196,7 @@ class KVClient:
         if self._fd < 0:
             raise ConnectionError(f"cannot connect to pserver {host}:{port}")
         self.trainer_id = int(trainer_id)
+        self._faults = _FaultInjector()  # env re-read per client
 
     def close(self):
         if self._fd >= 0:
@@ -159,12 +217,14 @@ class KVClient:
                                          v.size), "init_dense")
 
     def pull_dense(self, name: str, size: int) -> np.ndarray:
+        self._faults.maybe_fault("pull_dense")
         out = np.empty(int(size), np.float32)
         _check(self._lib.pskv_pull_dense(self._fd, name.encode(), out,
                                          out.size), "pull_dense")
         return out
 
     def push_dense(self, name: str, grad: np.ndarray):
+        self._faults.maybe_fault("push_dense")
         g = np.ascontiguousarray(grad, np.float32).ravel()
         _check(self._lib.pskv_push_dense(self._fd, name.encode(),
                                          self.trainer_id, g, g.size),
@@ -187,6 +247,7 @@ class KVClient:
                "init_sparse")
 
     def pull_sparse(self, name: str, ids: np.ndarray, dim: int) -> np.ndarray:
+        self._faults.maybe_fault("pull_sparse")
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         out = np.empty((ids.size, int(dim)), np.float32)
         _check(self._lib.pskv_pull_sparse(self._fd, name.encode(), ids,
@@ -195,6 +256,7 @@ class KVClient:
         return out
 
     def push_sparse(self, name: str, ids: np.ndarray, grads: np.ndarray):
+        self._faults.maybe_fault("push_sparse")
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         g = np.ascontiguousarray(grads, np.float32)
         dim = g.shape[-1]
