@@ -128,9 +128,23 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
                      power=1.0, cycle=False):
     step = _global_step()
     if cycle:
-        raise NotImplementedError("cycle=True polynomial decay TBD")
-    capped = _unary("clip", step, {"min": 0.0, "max": float(decay_steps)})
-    frac = _scale(capped, s=-1.0 / decay_steps, b=1.0)
+        # reference learning_rate_scheduler.py: the decay horizon grows
+        # to decay_steps * ceil(step / decay_steps), so lr saws back up
+        # at each multiple instead of flooring at end_learning_rate
+        # true division, not a pre-rounded reciprocal: f32
+        # step * (1/decay_steps) overshoots at exact multiples (e.g.
+        # 21 * (1/7) = 3.0000002 -> ceil 4) and breaks cycle boundaries
+        ratio = _unary("ceil", _binary("elementwise_div", step,
+                                       _fill(float(decay_steps))))
+        # step == 0 -> ceil == 0 would divide by zero; reference forces 1
+        ratio = _binary("elementwise_max", ratio, _fill(1.0))
+        horizon = _scale(ratio, s=float(decay_steps))
+        frac = _scale(_binary("elementwise_div", step, horizon), s=-1.0,
+                      b=1.0)
+    else:
+        capped = _unary("clip", step,
+                        {"min": 0.0, "max": float(decay_steps)})
+        frac = _scale(capped, s=-1.0 / decay_steps, b=1.0)
     p = _unary("pow", frac, {"factor": power})
     return _scale(p, s=learning_rate - end_learning_rate,
                   b=end_learning_rate)

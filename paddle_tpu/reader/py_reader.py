@@ -24,16 +24,93 @@ __all__ = ["PyReader"]
 class PyReader:
     def __init__(self, feed_list: Sequence, capacity: int = 4,
                  iterable: bool = True, return_list: bool = False):
-        if not iterable:
-            raise NotImplementedError(
-                "non-iterable PyReader (in-graph read op) does not exist in "
-                "the one-jitted-step execution model; iterate feed dicts")
         self._feeder = DataFeeder(feed_list)
         self._names = [v.name for v in self._feeder.feed_vars]
         self._capacity = capacity
         self._return_list = return_list
         self._source = None
         self._mode = None
+        self._iterable = iterable
+        if not iterable:
+            # NON-iterable (reference reader.py:47 default) form: append
+            # create_py_reader + read ops to the current program; the
+            # executor's host-op boundary pops a batch per step and
+            # raises EOFError at exhaustion (the core.EOFException
+            # analog). start() spins the decorated generator into the
+            # scope-resident queue.
+            from ..framework.core import default_main_program, unique_name
+
+            blk = default_main_program().global_block
+            self._queue_name = unique_name("py_reader.queue")
+            self._reader_name = unique_name("py_reader.reader")
+            blk.create_var(name=self._queue_name, dtype="float32")
+            blk.create_var(name=self._reader_name, dtype="float32")
+            blk.append_op("create_py_reader",
+                          {"blocking_queue": [self._queue_name]},
+                          {"Out": [self._reader_name]},
+                          {"out_names": list(self._names)},
+                          infer_shape=False)
+            blk.append_op("read", {"Reader": [self._reader_name]},
+                          {"Out": list(self._names)}, {},
+                          infer_shape=False)
+            self._thread = None
+
+    # -- non-iterable lifecycle (reference start()/reset()) ------------------
+    def start(self, scope=None):
+        """Begin one epoch: feed the decorated generator into the in-graph
+        reader's queue on a background thread. Only for iterable=False.
+        (The create_py_reader host op rebinds the reader from the queue on
+        every Executor.run — ops/reader_ops.py.)"""
+        if self._iterable:
+            return  # reference parity no-op: iterable mode feeds per-loop
+        if self._source is None:
+            raise RuntimeError("call decorate_*_generator first")
+        from ..framework.executor import global_scope
+        scope = scope or global_scope()
+        q: _queue.Queue = _queue.Queue(self._capacity)
+        scope.set_var(self._queue_name, q)
+        stop = threading.Event()
+        self._pump_stop = stop
+        self._pump_error = None
+
+        def _put(item) -> bool:
+            # timed put so an early-terminated epoch (break before
+            # EOFError) cannot pin this thread on a full queue forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def pump():
+            try:
+                for item in self._source():
+                    feed = self._to_feed(item)
+                    if not _put(tuple(feed[n] for n in self._names)):
+                        return
+            except Exception as e:  # surface via reset(), not a hang
+                self._pump_error = e
+            finally:
+                _put(None)  # ALWAYS deliver the end-of-epoch sentinel
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+    def reset(self, scope=None):
+        """Recover after the EOFError that ends an epoch (reference
+        reader.reset after catching EOFException). Re-raises any error
+        the feeding generator hit mid-epoch."""
+        if self._iterable:
+            return  # reference parity no-op
+        if self._thread is not None:
+            self._pump_stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._pump_error is not None:
+            err, self._pump_error = self._pump_error, None
+            raise err
 
     # -- decoration (reference API) ------------------------------------------
     def decorate_sample_list_generator(self, reader, places=None):
@@ -113,9 +190,5 @@ class PyReader:
         finally:
             stop.set()
 
-    # reference parity no-ops (queue lifecycle is per-iteration here)
-    def start(self):
-        pass
-
-    def reset(self):
-        pass
+    # (iterable mode: start/reset defined above are no-ops only when
+    # iterable=True — handled inside those methods)

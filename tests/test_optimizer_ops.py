@@ -178,6 +178,35 @@ class TestLRScheduler(unittest.TestCase):
         np.testing.assert_allclose(
             seen, [0.1, 0.01, 0.01, 0.001, 0.001, 0.001], rtol=1e-6)
 
+    def test_polynomial_decay_cycle(self):
+        """cycle=True: the decay horizon grows to the next multiple of
+        decay_steps, so lr saws back up (reference
+        learning_rate_scheduler.py polynomial_decay cycle branch)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [2])
+            y = pt.layers.data("y", [1])
+            pred = pt.layers.fc(x, 1, bias_attr=False)
+            loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+            lr = pt.layers.polynomial_decay(0.1, decay_steps=3,
+                                            end_learning_rate=0.01,
+                                            power=1.0, cycle=True)
+            pt.optimizer.SGD(lr).minimize(loss)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            seen = []
+            for _ in range(7):
+                v, = exe.run(main,
+                             feed={"x": np.ones((2, 2), "f"),
+                                   "y": np.ones((2, 1), "f")},
+                             fetch_list=[lr])
+                seen.append(float(v[0]))
+        # steps 1..7, horizon 3*ceil(step/3): lr = 0.09*(1-step/horizon)+0.01
+        expect = [0.09 * (1 - st / (3 * np.ceil(st / 3))) + 0.01
+                  for st in range(1, 8)]
+        np.testing.assert_allclose(seen, expect, rtol=1e-5)
+
     def test_noam_decay_shape(self):
         main, startup = pt.Program(), pt.Program()
         with pt.program_guard(main, startup):

@@ -249,3 +249,50 @@ def test_lod_truncation_and_empty_roundtrip():
     p0, l0 = pt.lod_tensor.lod_to_padded(np.empty((0,)), np.array([0]))
     v0, o0 = pt.lod_tensor.padded_to_lod(p0, l0)
     assert v0.shape[0] == 0 and o0.tolist() == [0]
+
+
+def test_py_reader_non_iterable_epochs():
+    """PyReader(iterable=False): in-graph create_py_reader + read ops via
+    the executor host-op boundary; start()/EOFError/reset() epoch cycle
+    (reference reader.py:47 default mode)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("pr_x", [3])
+        reader = pt.PyReader(feed_list=[x], capacity=2, iterable=False)
+        y = pt.layers.scale(x, scale=2.0)
+
+    batches = [np.full((2, 3), i, np.float32) for i in range(3)]
+    reader.decorate_batch_generator(lambda: iter([(b,) for b in batches]))
+
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for epoch in range(2):
+            reader.start()
+            got = []
+            while True:
+                try:
+                    out, = exe.run(main, fetch_list=[y])
+                except EOFError:
+                    reader.reset()
+                    break
+                got.append(np.asarray(out))
+            assert len(got) == 3, len(got)
+            for i, g in enumerate(got):
+                np.testing.assert_allclose(g, 2.0 * batches[i])
+
+
+def test_py_reader_non_iterable_start_requires_decoration():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("pr2_x", [3])
+        reader = pt.PyReader(feed_list=[x], iterable=False)
+    with pytest.raises(RuntimeError, match="decorate"):
+        reader.start()
+    # iterable mode keeps the reference's no-op start/reset
+    with pt.unique_name_guard(), pt.program_guard(pt.Program(),
+                                                  pt.Program()):
+        x2 = pt.layers.data("pr3_x", [3])
+        it_reader = pt.PyReader(feed_list=[x2], iterable=True)
+    it_reader.start()
+    it_reader.reset()
