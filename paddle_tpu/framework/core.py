@@ -502,6 +502,12 @@ class Program:
                 if any(k in op.attrs for k in sub_keys):
                     needed.update(sub_reads(op))
         blk.ops = list(reversed(keep))
+        # drop vars no surviving op references (reference prune.cc does the
+        # same) — keeps inference exports free of optimizer-state vars
+        referenced = set(needed)
+        for op in blk.ops:
+            referenced.update(op.output_names())
+        blk.vars = {n: v for n, v in blk.vars.items() if n in referenced}
         pruned._bump_version()
         return pruned
 

@@ -1,0 +1,91 @@
+"""Async checkpointing semantics (SURVEY §7 step 8; reference save_op.cc is
+synchronous — this is the TPU-side upgrade: snapshot on the training thread,
+file write off-thread, atomic rename)."""
+
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _toy():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [3])
+        y = pt.layers.data("y", [1])
+        pred = pt.layers.fc(x, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+class TestAsyncCheckpoint(unittest.TestCase):
+    def test_snapshot_is_step_consistent(self):
+        """Params mutated after save() returns must NOT leak into the file:
+        the device->host copy happens at call time, the write later."""
+        main, startup, loss = _toy()
+        exe = pt.Executor()
+        feed = {"x": np.ones((4, 3), "f"), "y": np.full((4, 1), 2.0, "f")}
+        with tempfile.TemporaryDirectory() as d:
+            with pt.scope_guard(pt.Scope()) as _:
+                scope = pt.global_scope()
+                exe.run(startup)
+                exe.run(main, feed=feed, fetch_list=[loss])
+                w_at_save = {
+                    n: np.asarray(scope.find_var(n)).copy()
+                    for n in scope.var_names() if not n.startswith("@")}
+                pt.io.save_persistables(exe, d, main, sync=False)
+                # training continues while the writer thread runs
+                for _ in range(5):
+                    exe.run(main, feed=feed, fetch_list=[loss])
+                pt.io.wait_for_saves()
+            with pt.scope_guard(pt.Scope()):
+                scope2 = pt.global_scope()
+                pt.io.load_persistables(exe, d, main)
+                for name in scope2.var_names():
+                    if name.startswith("@"):
+                        continue
+                    if name in w_at_save:
+                        np.testing.assert_array_equal(
+                            np.asarray(scope2.find_var(name)),
+                            w_at_save[name])
+
+    def test_atomic_rename_no_partial_file(self):
+        """A completed save leaves exactly the target file, no temp litter."""
+        main, startup, loss = _toy()
+        exe = pt.Executor()
+        with tempfile.TemporaryDirectory() as d:
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                pt.io.save_persistables(exe, d, main, sync=False)
+                pt.io.wait_for_saves()
+            files = os.listdir(d)
+            self.assertIn("params.npz", files)
+            self.assertFalse([f for f in files if f.startswith(".tmp_save_")])
+
+    def test_async_fluid_format(self):
+        main, startup, loss = _toy()
+        exe = pt.Executor()
+        with tempfile.TemporaryDirectory() as d:
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                scope = pt.global_scope()
+                names = [v.name for v in main.list_vars() if v.persistable]
+                before = {n: np.asarray(scope.find_var(n)).copy()
+                          for n in names}
+                pt.io.save_persistables(exe, d, main, format="fluid",
+                                        filename="all_params", sync=False)
+                pt.io.wait_for_saves()
+            with pt.scope_guard(pt.Scope()):
+                pt.io.load_persistables(exe, d, main, filename="all_params")
+                scope2 = pt.global_scope()
+                for n in names:
+                    np.testing.assert_array_equal(
+                        np.asarray(scope2.find_var(n)), before[n])
+
+
+if __name__ == "__main__":
+    unittest.main()

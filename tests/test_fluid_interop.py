@@ -1,0 +1,418 @@
+"""Fluid-format interop tests (reference: framework.proto, tensor_util.cc:383,
+lod_tensor.cc:219, save_combine_op.h, fluid io.py:933/1113).
+
+The hand-rolled codec in framework/fluid_interop.py is cross-checked against
+an INDEPENDENT decoder: a protobuf-runtime message class built here from a
+descriptor that restates the reference schema.  Golden fixtures for the
+tensor stream are struct-packed by hand in the tests, byte for byte.
+"""
+
+import os
+import struct
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework import fluid_interop as fi
+
+
+# --------------------------------------------------------------------------
+# Independent schema via the protobuf runtime (wire-compatible restatement:
+# enums as int32, nested messages flattened — identical bytes either way).
+# --------------------------------------------------------------------------
+
+def _build_check_schema():
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    T = descriptor_pb2.FieldDescriptorProto
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "fluid_check.proto"
+    fdp.package = "check"
+    fdp.syntax = "proto2"
+
+    def msg(name):
+        m = fdp.message_type.add()
+        m.name = name
+        return m
+
+    def field(m, name, number, ftype, repeated=False, type_name=None):
+        f = m.field.add()
+        f.name, f.number, f.type = name, number, ftype
+        f.label = T.LABEL_REPEATED if repeated else T.LABEL_OPTIONAL
+        if type_name:
+            f.type_name = ".check." + type_name
+
+    m = msg("Version")
+    field(m, "version", 1, T.TYPE_INT64)
+
+    m = msg("TensorDesc")
+    field(m, "data_type", 1, T.TYPE_INT32)
+    field(m, "dims", 2, T.TYPE_INT64, repeated=True)
+
+    m = msg("LoDTensorDesc")
+    field(m, "tensor", 1, T.TYPE_MESSAGE, type_name="TensorDesc")
+    field(m, "lod_level", 2, T.TYPE_INT32)
+
+    m = msg("VarTypeM")
+    field(m, "type", 1, T.TYPE_INT32)
+    field(m, "selected_rows", 2, T.TYPE_MESSAGE, type_name="TensorDesc")
+    field(m, "lod_tensor", 3, T.TYPE_MESSAGE, type_name="LoDTensorDesc")
+    field(m, "tensor_array", 4, T.TYPE_MESSAGE, type_name="LoDTensorDesc")
+
+    m = msg("VarDescM")
+    field(m, "name", 1, T.TYPE_STRING)
+    field(m, "type", 2, T.TYPE_MESSAGE, type_name="VarTypeM")
+    field(m, "persistable", 3, T.TYPE_BOOL)
+
+    m = msg("OpVar")
+    field(m, "parameter", 1, T.TYPE_STRING)
+    field(m, "arguments", 2, T.TYPE_STRING, repeated=True)
+
+    m = msg("OpAttr")
+    field(m, "name", 1, T.TYPE_STRING)
+    field(m, "type", 2, T.TYPE_INT32)
+    field(m, "i", 3, T.TYPE_INT32)
+    field(m, "f", 4, T.TYPE_FLOAT)
+    field(m, "s", 5, T.TYPE_STRING)
+    field(m, "ints", 6, T.TYPE_INT32, repeated=True)
+    field(m, "floats", 7, T.TYPE_FLOAT, repeated=True)
+    field(m, "strings", 8, T.TYPE_STRING, repeated=True)
+    field(m, "b", 10, T.TYPE_BOOL)
+    field(m, "bools", 11, T.TYPE_BOOL, repeated=True)
+    field(m, "block_idx", 12, T.TYPE_INT32)
+    field(m, "l", 13, T.TYPE_INT64)
+    field(m, "blocks_idx", 14, T.TYPE_INT32, repeated=True)
+    field(m, "longs", 15, T.TYPE_INT64, repeated=True)
+
+    m = msg("OpDescM")
+    field(m, "inputs", 1, T.TYPE_MESSAGE, repeated=True, type_name="OpVar")
+    field(m, "outputs", 2, T.TYPE_MESSAGE, repeated=True, type_name="OpVar")
+    field(m, "type", 3, T.TYPE_STRING)
+    field(m, "attrs", 4, T.TYPE_MESSAGE, repeated=True, type_name="OpAttr")
+    field(m, "is_target", 5, T.TYPE_BOOL)
+
+    m = msg("BlockDescM")
+    field(m, "idx", 1, T.TYPE_INT32)
+    field(m, "parent_idx", 2, T.TYPE_INT32)
+    field(m, "vars", 3, T.TYPE_MESSAGE, repeated=True, type_name="VarDescM")
+    field(m, "ops", 4, T.TYPE_MESSAGE, repeated=True, type_name="OpDescM")
+    field(m, "forward_block_idx", 5, T.TYPE_INT32)
+
+    m = msg("ProgramDescM")
+    field(m, "blocks", 1, T.TYPE_MESSAGE, repeated=True,
+          type_name="BlockDescM")
+    field(m, "version", 2, T.TYPE_MESSAGE, type_name="Version")
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    return message_factory.GetMessageClassesForFiles(
+        ["fluid_check.proto"], pool)
+
+
+_SCHEMA = _build_check_schema()
+ProgramDescM = _SCHEMA["check.ProgramDescM"]
+TensorDescM = _SCHEMA["check.TensorDesc"]
+
+
+def _toy_inference_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        h = pt.layers.fc(x, 8, act="relu")
+        out = pt.layers.fc(h, 3, act="softmax")
+    return main, startup, out
+
+
+class TestProgramDescWire(unittest.TestCase):
+    def test_export_parses_with_independent_decoder(self):
+        main, _startup, out = _toy_inference_program()
+        data = fi.program_to_fluid_bytes(main)
+        desc = ProgramDescM.FromString(data)
+        self.assertEqual(desc.version.version, 0)
+        self.assertEqual(len(desc.blocks), len(main.blocks))
+        blk = desc.blocks[0]
+        self.assertEqual(blk.idx, 0)
+        self.assertEqual(blk.parent_idx, -1)
+        self.assertEqual([o.type for o in blk.ops],
+                         [o.type for o in main.global_block.ops])
+        names = {v.name for v in blk.vars}
+        self.assertEqual(names, set(main.global_block.vars))
+        # spot-check a var's tensor desc: fp32 == 5 (framework.proto VarType)
+        by_name = {v.name: v for v in blk.vars}
+        w = next(n for n in names if n.endswith(".w_0"))
+        self.assertEqual(by_name[w].type.lod_tensor.tensor.data_type, 5)
+        self.assertTrue(by_name[w].persistable)
+        self.assertEqual(by_name[w].type.type, 7)  # LOD_TENSOR
+
+    def test_attr_types_on_wire(self):
+        main = pt.Program()
+        blk = main.global_block
+        from paddle_tpu.framework.core import Operator
+        blk.create_var(name="a", shape=[2], dtype="float32")
+        blk.ops.append(Operator(
+            blk, "fake_op", {}, {"Out": ["a"]},
+            {"i": 3, "f": 0.5, "s": "hi", "ints": [1, 2],
+             "floats": [1.5, 2.5], "strings": ["p", "q"],
+             "b": True, "bools": [True, False],
+             "l": 1 << 40, "longs": [1 << 40, -5],
+             "sub_block": 0, "neg": -7}))
+        desc = ProgramDescM.FromString(fi.program_to_fluid_bytes(main))
+        attrs = {a.name: a for a in desc.blocks[0].ops[0].attrs}
+        self.assertEqual(attrs["i"].type, fi.ATTR_INT)
+        self.assertEqual(attrs["i"].i, 3)
+        self.assertEqual(attrs["neg"].i, -7)
+        self.assertEqual(attrs["f"].type, fi.ATTR_FLOAT)
+        self.assertAlmostEqual(attrs["f"].f, 0.5)
+        self.assertEqual(attrs["s"].s, "hi")
+        self.assertEqual(list(attrs["ints"].ints), [1, 2])
+        self.assertEqual(list(attrs["floats"].floats), [1.5, 2.5])
+        self.assertEqual(list(attrs["strings"].strings), ["p", "q"])
+        self.assertEqual(attrs["b"].type, fi.ATTR_BOOLEAN)
+        self.assertTrue(attrs["b"].b)
+        self.assertEqual(list(attrs["bools"].bools), [True, False])
+        self.assertEqual(attrs["l"].type, fi.ATTR_LONG)
+        self.assertEqual(attrs["l"].l, 1 << 40)
+        self.assertEqual(list(attrs["longs"].longs), [1 << 40, -5])
+        self.assertEqual(attrs["sub_block"].type, fi.ATTR_BLOCK)
+        self.assertEqual(attrs["sub_block"].block_idx, 0)
+
+    def test_import_from_independent_encoder(self):
+        desc = ProgramDescM()
+        desc.version.version = 0
+        blk = desc.blocks.add()
+        blk.idx, blk.parent_idx = 0, -1
+        for name, dims, persistable in (("x", [-1, 4], False),
+                                        ("w", [4, 3], True),
+                                        ("y", [-1, 3], False)):
+            v = blk.vars.add()
+            v.name = name
+            v.persistable = persistable
+            v.type.type = 7
+            v.type.lod_tensor.tensor.data_type = 5
+            v.type.lod_tensor.tensor.dims.extend(dims)
+        op = blk.ops.add()
+        op.type = "mul"
+        iv = op.inputs.add()
+        iv.parameter = "X"
+        iv.arguments.append("x")
+        iv = op.inputs.add()
+        iv.parameter = "Y"
+        iv.arguments.append("w")
+        ov = op.outputs.add()
+        ov.parameter = "Out"
+        ov.arguments.append("y")
+        a = op.attrs.add()
+        a.name, a.type, a.i = "x_num_col_dims", fi.ATTR_INT, 1
+        a = op.attrs.add()
+        a.name, a.type, a.i = "y_num_col_dims", fi.ATTR_INT, 1
+
+        program = fi.program_from_fluid_bytes(desc.SerializeToString())
+        b0 = program.global_block
+        self.assertEqual([o.type for o in b0.ops], ["mul"])
+        self.assertEqual(b0.ops[0].attrs["x_num_col_dims"], 1)
+        self.assertEqual(b0.var("w").shape, (4, 3))
+        self.assertTrue(b0.var("w").persistable)
+        self.assertEqual(b0.var("x").dtype, "float32")
+
+    def test_packed_repeated_dims_accepted(self):
+        # proto3-style packed int64 dims must also decode (robustness)
+        from paddle_tpu.framework.fluid_interop import _enc_varint, _enc_len
+        packed = _enc_varint(4) + _enc_varint(3)
+        tdesc = b"\x08\x05" + _enc_len(2, packed)  # data_type=5, packed dims
+        m = fi._Msg(tdesc)
+        self.assertEqual(m.ints(2), [4, 3])
+
+
+class TestTensorStream(unittest.TestCase):
+    def test_golden_bytes_no_lod(self):
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        got = fi.lod_tensor_to_bytes(arr)
+        # hand-assembled per lod_tensor.cc:219 + tensor_util.cc:383
+        desc = b"\x08\x05" + b"\x10\x02" + b"\x10\x03"  # dtype fp32; dims 2,3
+        want = (struct.pack("<I", 0)            # LoDTensor version
+                + struct.pack("<Q", 0)          # 0 LoD levels
+                + struct.pack("<I", 0)          # Tensor version
+                + struct.pack("<i", len(desc)) + desc
+                + arr.tobytes())
+        self.assertEqual(got, want)
+        back, lod = fi.lod_tensor_from_bytes(want)
+        np.testing.assert_array_equal(back, arr)
+        self.assertEqual(lod, [])
+
+    def test_golden_bytes_with_lod(self):
+        arr = np.array([1, 2, 3], dtype=np.int64)
+        lod = [[0, 2, 3]]
+        got = fi.lod_tensor_to_bytes(arr, lod)
+        offs = np.array([0, 2, 3], dtype=np.uint64)
+        desc = b"\x08\x03" + b"\x10\x03"  # dtype int64(3); dims [3]
+        want = (struct.pack("<I", 0)
+                + struct.pack("<Q", 1)                    # 1 LoD level
+                + struct.pack("<Q", offs.nbytes) + offs.tobytes()
+                + struct.pack("<I", 0)
+                + struct.pack("<i", len(desc)) + desc
+                + arr.tobytes())
+        self.assertEqual(got, want)
+        back, back_lod = fi.lod_tensor_from_bytes(want)
+        np.testing.assert_array_equal(back, arr)
+        self.assertEqual(back_lod, [[0, 2, 3]])
+
+    def test_dtypes_roundtrip(self):
+        for dt in ("float32", "float64", "float16", "int32", "int64",
+                   "int16", "int8", "uint8", "bool"):
+            arr = (np.random.rand(3, 2) * 4).astype(dt)
+            back, _ = fi.lod_tensor_from_bytes(fi.lod_tensor_to_bytes(arr))
+            np.testing.assert_array_equal(back, arr)
+
+    def test_combine_roundtrip(self):
+        arrs = [np.random.rand(4, 2).astype(np.float32),
+                np.arange(5, dtype=np.int32),
+                np.random.rand(1).astype(np.float64)]
+        data = fi.save_combine_bytes(arrs)
+        back = fi.load_combine_bytes(data)
+        self.assertEqual(len(back), 3)
+        for a, b in zip(arrs, back):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestInferenceModelFluid(unittest.TestCase):
+    def _save_load_run(self, params_filename):
+        main, startup, out = _toy_inference_program()
+        exe = pt.Executor()
+        x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            ref, = exe.run(main, feed={"x": x}, fetch_list=[out])
+            with tempfile.TemporaryDirectory() as d:
+                pt.io.save_inference_model(
+                    d, ["x"], [out], exe, main_program=main,
+                    params_filename=params_filename, format="fluid")
+                self.assertTrue(os.path.exists(os.path.join(d, "__model__")))
+                self.assertFalse(os.path.exists(os.path.join(d, "__meta__")))
+                # the exported program parses with the independent decoder
+                with open(os.path.join(d, "__model__"), "rb") as f:
+                    desc = ProgramDescM.FromString(f.read())
+                optypes = [o.type for o in desc.blocks[0].ops]
+                self.assertEqual(optypes[0], "feed")
+                self.assertEqual(optypes[-1], "fetch")
+                with pt.scope_guard(pt.Scope()):
+                    prog, feeds, fetches = pt.io.load_inference_model(
+                        d, exe, params_filename=params_filename)
+                    self.assertEqual(feeds, ["x"])
+                    got, = exe.run(prog, feed={"x": x}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_roundtrip_separate_param_files(self):
+        self._save_load_run(params_filename=None)
+
+    def test_roundtrip_combined_params(self):
+        self._save_load_run(params_filename="params")
+
+    def test_load_reference_built_directory(self):
+        """A model dir assembled entirely with the independent encoder (as a
+        reference-produced artifact would be) loads and runs on our stack."""
+        rng = np.random.RandomState(7)
+        w = rng.rand(4, 3).astype(np.float32)
+        b = rng.rand(3).astype(np.float32)
+        x = rng.rand(6, 4).astype(np.float32)
+
+        desc = ProgramDescM()
+        desc.version.version = 0
+        blk = desc.blocks.add()
+        blk.idx, blk.parent_idx = 0, -1
+
+        def add_var(name, dims, vt=7, persistable=False):
+            v = blk.vars.add()
+            v.name, v.persistable = name, persistable
+            v.type.type = vt
+            if vt == 7:
+                v.type.lod_tensor.tensor.data_type = 5
+                v.type.lod_tensor.tensor.dims.extend(dims)
+
+        add_var("feed", [], vt=9, persistable=True)    # FEED_MINIBATCH
+        add_var("fetch", [], vt=10, persistable=True)  # FETCH_LIST
+        add_var("x", [-1, 4])
+        add_var("w0", [4, 3], persistable=True)
+        add_var("b0", [3], persistable=True)
+        add_var("xw", [-1, 3])
+        add_var("pre", [-1, 3])
+        add_var("prob", [-1, 3])
+
+        def add_op(tp, ins, outs, attrs=()):
+            op = blk.ops.add()
+            op.type = tp
+            for slot, args in ins:
+                v = op.inputs.add()
+                v.parameter = slot
+                v.arguments.extend(args)
+            for slot, args in outs:
+                v = op.outputs.add()
+                v.parameter = slot
+                v.arguments.extend(args)
+            for name, atype, val in attrs:
+                a = op.attrs.add()
+                a.name, a.type = name, atype
+                if atype == fi.ATTR_INT:
+                    a.i = val
+                elif atype == fi.ATTR_BOOLEAN:
+                    a.b = val
+
+        add_op("feed", [("X", ["feed"])], [("Out", ["x"])],
+               [("col", fi.ATTR_INT, 0)])
+        add_op("mul", [("X", ["x"]), ("Y", ["w0"])], [("Out", ["xw"])],
+               [("x_num_col_dims", fi.ATTR_INT, 1),
+                ("y_num_col_dims", fi.ATTR_INT, 1)])
+        add_op("elementwise_add", [("X", ["xw"]), ("Y", ["b0"])],
+               [("Out", ["pre"])], [("axis", fi.ATTR_INT, -1)])
+        add_op("softmax", [("X", ["pre"])], [("Out", ["prob"])])
+        add_op("fetch", [("X", ["prob"])], [("Out", ["fetch"])],
+               [("col", fi.ATTR_INT, 0)])
+
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "__model__"), "wb") as f:
+                f.write(desc.SerializeToString())
+            for name, arr in (("w0", w), ("b0", b)):
+                # independently hand-packed save_op stream
+                td = TensorDescM()
+                td.data_type = 5
+                td.dims.extend(arr.shape)
+                tdb = td.SerializeToString()
+                blob = (struct.pack("<I", 0) + struct.pack("<Q", 0)
+                        + struct.pack("<I", 0)
+                        + struct.pack("<i", len(tdb)) + tdb + arr.tobytes())
+                with open(os.path.join(d, name), "wb") as f:
+                    f.write(blob)
+
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+                self.assertEqual(feeds, ["x"])
+                got, = exe.run(prog, feed={"x": x}, fetch_list=fetches)
+
+        logits = x @ w + b
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        want = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_native_format_still_roundtrips(self):
+        main, startup, out = _toy_inference_program()
+        exe = pt.Executor()
+        x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            ref, = exe.run(main, feed={"x": x}, fetch_list=[out])
+            with tempfile.TemporaryDirectory() as d:
+                pt.io.save_inference_model(d, ["x"], [out], exe,
+                                           main_program=main)
+                with pt.scope_guard(pt.Scope()):
+                    prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+                    got, = exe.run(prog, feed={"x": x}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+if __name__ == "__main__":
+    unittest.main()
