@@ -677,8 +677,12 @@ void handle_conn(Server* srv, int fd) {
           for (auto& kv : srv->sparse)
             sparse_list.emplace_back(kv.first, kv.second.get());
         }
+        // copy-on-save: serialize each table to a memory buffer under its
+        // lock, stream buffers to disk with NO lock held — trainer pushes
+        // stall only for the memcpy, not the disk write
+        std::string buf;
         auto wr = [&](const void* p, size_t n) {
-          out.write(static_cast<const char*>(p), n);
+          buf.append(static_cast<const char*>(p), n);
         };
         auto wr_str = [&](const std::string& s2) {
           uint32_t n = s2.size();
@@ -690,41 +694,52 @@ void handle_conn(Server* srv, int fd) {
           wr(&n, 8);
           wr(v.data(), n * 4);
         };
+        auto flush_buf = [&]() {
+          out.write(buf.data(), buf.size());
+          buf.clear();
+        };
         uint32_t nd = dense_list.size();
         wr(&nd, 4);
         for (auto& kv : dense_list) {
           DenseTable* t = kv.second;
-          std::lock_guard<std::mutex> tl(t->mu);
-          wr_str(kv.first);
-          wr(&t->opt, sizeof(OptConfig));
-          wr(&t->beta1_pow, 8);
-          wr(&t->beta2_pow, 8);
-          wr_vec(t->value);
-          wr_vec(t->m1);
-          wr_vec(t->m2);
+          {
+            std::lock_guard<std::mutex> tl(t->mu);
+            wr_str(kv.first);
+            wr(&t->opt, sizeof(OptConfig));
+            wr(&t->beta1_pow, 8);
+            wr(&t->beta2_pow, 8);
+            wr_vec(t->value);
+            wr_vec(t->m1);
+            wr_vec(t->m2);
+          }
+          flush_buf();
         }
         uint32_t ns = sparse_list.size();
         wr(&ns, 4);
         for (auto& kv : sparse_list) {
           SparseTable* t = kv.second;
-          std::lock_guard<std::mutex> tl(t->mu);
-          wr_str(kv.first);
-          wr(&t->dim, 8);
-          wr(&t->opt, sizeof(OptConfig));
-          wr(&t->beta1_pow, 8);
-          wr(&t->beta2_pow, 8);
-          wr(&t->seed, 8);
-          wr(&t->init_scale, 4);
-          uint64_t nr = t->rows.size();
-          wr(&nr, 8);
-          for (auto& rkv : t->rows) {
-            int64_t id = rkv.first;
-            wr(&id, 8);
-            wr_vec(rkv.second.value);
-            wr_vec(rkv.second.m1);
-            wr_vec(rkv.second.m2);
+          {
+            std::lock_guard<std::mutex> tl(t->mu);
+            wr_str(kv.first);
+            wr(&t->dim, 8);
+            wr(&t->opt, sizeof(OptConfig));
+            wr(&t->beta1_pow, 8);
+            wr(&t->beta2_pow, 8);
+            wr(&t->seed, 8);
+            wr(&t->init_scale, 4);
+            uint64_t nr = t->rows.size();
+            wr(&nr, 8);
+            for (auto& rkv : t->rows) {
+              int64_t id = rkv.first;
+              wr(&id, 8);
+              wr_vec(rkv.second.value);
+              wr_vec(rkv.second.m1);
+              wr_vec(rkv.second.m2);
+            }
           }
+          flush_buf();
         }
+        flush_buf();  // table counts when a section is empty
         out.flush();  // surface ENOSPC-at-flush before answering
         write_response(fd, out.good() ? kOk : kErr, nullptr, 0);
         break;
