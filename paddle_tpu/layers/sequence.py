@@ -63,8 +63,8 @@ def sequence_reverse(x, lengths=None, name=None):
 
 def sequence_expand(x, y, ref_level=-1, name=None):
     return _op("sequence_expand", "sequence_expand",
-               {"X": [x.name], "Y": [y.name]}, ["Out"], {},
-               {"Out": x.dtype})
+               {"X": [x.name], "Y": [y.name]}, ["Out"],
+               {"ref_level": int(ref_level)}, {"Out": x.dtype})
 
 
 def sequence_concat(input, name=None):

@@ -26,14 +26,40 @@ def _sequence_mask(ctx, ins, attrs):
     return {"Y": [mask.astype(attrs.get("out_dtype", "float32"))]}
 
 
-def _len_mask(ins, x, dtype=None):
-    """[b, s, 1...] mask from optional Length input."""
+def _norm_len(ins, x):
+    """Normalized (lengths, masked_axis) from the optional Length input.
+
+    Length of shape x.shape[:k] masks axis k.  1-level: Length [b] masks
+    axis 1 of x [b, s, d].  Nested (2-level LoD, lod_tensor.py
+    lod_to_nested_padded): inner Length [b, s1] masks axis 2 of x
+    [b, s1, s2, d] — the op then works at the chosen LoD level with no
+    other change (reference ops take a lod_level attr instead).  The
+    fluid-style [b, 1] lengths column is squeezed to [b] (it would
+    otherwise read as a nested mask over the feature axis); any other
+    prefix mismatch is an error, not a silent misread."""
     if "Length" not in ins:
+        return None, 1
+    ln = ins["Length"][0]
+    if tuple(ln.shape) != tuple(x.shape[:ln.ndim]):
+        if ln.ndim == 2 and ln.shape[1] == 1 and ln.shape[0] == x.shape[0]:
+            ln = ln[:, 0]
+        else:
+            raise ValueError(
+                f"sequence op: Length shape {tuple(ln.shape)} must equal "
+                f"x.shape[:{ln.ndim}] = {tuple(x.shape[:ln.ndim])} (or be "
+                f"a [b, 1] column)")
+    return ln, ln.ndim
+
+
+def _len_mask(ins, x, dtype=None):
+    """mask over the sequence axis from the optional Length input; shape
+    x.shape[:axis+1] + (1,)*rest for broadcast."""
+    ln, axis = _norm_len(ins, x)
+    if ln is None:
         return None
-    ln = ins["Length"][0].reshape(-1)
-    s = x.shape[1]
-    m = (jnp.arange(s)[None, :] < ln[:, None])
-    extra = x.ndim - 2
+    s = x.shape[axis]
+    m = (jnp.arange(s)[(None,) * axis + (slice(None),)] < ln[..., None])
+    extra = x.ndim - axis - 1
     m = m.reshape(m.shape + (1,) * extra)
     return m
 
@@ -43,17 +69,17 @@ def _len_mask(ins, x, dtype=None):
 def _sequence_pool(ctx, ins, attrs):
     """reference: sequence_ops/sequence_pool_op.cc — types sum/average/
     sqrt/max/last/first over each sequence."""
-    x = ins["X"][0]  # [b, s, d...]
+    x = ins["X"][0]  # [b, s, d...] or nested [b, s1, s2, d...]
     ptype = attrs.get("pooltype", "AVERAGE").upper()
+    ln, axis = _norm_len(ins, x)
     m = _len_mask(ins, x)
-    ln = (ins["Length"][0].reshape(-1).astype(x.dtype)
-          if "Length" in ins else
-          jnp.full((x.shape[0],), x.shape[1], x.dtype))
-    extra = x.ndim - 2
-    ln_b = ln.reshape((-1,) + (1,) * extra)
+    ln = (ln.astype(x.dtype) if ln is not None else
+          jnp.full(x.shape[:1], x.shape[1], x.dtype))
+    extra = x.ndim - axis - 1
+    ln_b = ln.reshape(ln.shape + (1,) * extra)
     if ptype in ("SUM", "AVERAGE", "SQRT"):
         xm = x if m is None else x * m.astype(x.dtype)
-        tot = jnp.sum(xm, axis=1)
+        tot = jnp.sum(xm, axis=axis)
         if ptype == "SUM":
             out = tot
         elif ptype == "AVERAGE":
@@ -62,14 +88,16 @@ def _sequence_pool(ctx, ins, attrs):
             out = tot / jnp.sqrt(jnp.maximum(ln_b, 1))
     elif ptype == "MAX":
         xm = x if m is None else jnp.where(m, x, -jnp.inf)
-        out = jnp.max(xm, axis=1)
+        out = jnp.max(xm, axis=axis)
+        if m is not None:  # all-empty segments must not emit -inf
+            out = jnp.where(ln_b > 0, out, jnp.zeros_like(out))
     elif ptype == "LAST":
         idx = jnp.maximum(ln - 1, 0).astype(jnp.int32)
         out = jnp.take_along_axis(
-            x, idx.reshape((-1, 1) + (1,) * extra).astype(jnp.int32),
-            axis=1).squeeze(1)
+            x, idx.reshape(ln.shape + (1,) * (extra + 1)).astype(jnp.int32),
+            axis=axis).squeeze(axis)
     elif ptype == "FIRST":
-        out = x[:, 0]
+        out = jnp.take(x, 0, axis=axis)
     else:
         raise NotImplementedError(f"sequence_pool type {ptype}")
     return {"Out": [out]}
@@ -79,11 +107,12 @@ def _sequence_pool(ctx, ins, attrs):
 def _sequence_softmax(ctx, ins, attrs):
     """reference: sequence_ops/sequence_softmax_op.cc — softmax over each
     sequence's valid positions."""
-    x = ins["X"][0]  # [b, s]
+    x = ins["X"][0]  # [b, s] (or nested [b, s1, s2] with Length [b, s1])
+    _, axis = _norm_len(ins, x[..., None])
     m = _len_mask(ins, x[..., None])
     if m is not None:
         x = jnp.where(m.squeeze(-1), x, -1e30)
-    out = jax.nn.softmax(x.astype(jnp.float32), axis=1).astype(x.dtype)
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
     if m is not None:
         out = out * m.squeeze(-1).astype(x.dtype)
     return {"Out": [out]}
@@ -94,27 +123,43 @@ def _sequence_reverse(ctx, ins, attrs):
     """reference: sequence_ops/sequence_reverse_op.cc — reverse each
     sequence's valid prefix, keep padding in place."""
     x = ins["X"][0]
-    s = x.shape[1]
-    if "Length" not in ins:
+    ln, axis = _norm_len(ins, x)
+    if ln is None:
         return {"Y": [jnp.flip(x, axis=1)]}
-    ln = ins["Length"][0].reshape(-1)
-    steps = jnp.arange(s)[None, :]
-    idx = jnp.where(steps < ln[:, None], ln[:, None] - 1 - steps, steps)
+    s = x.shape[axis]
+    steps = jnp.arange(s)[(None,) * axis + (slice(None),)]
+    idx = jnp.where(steps < ln[..., None], ln[..., None] - 1 - steps, steps)
     out = jnp.take_along_axis(
-        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
-        axis=1)
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - axis - 1)).astype(
+            jnp.int32),
+        axis=axis)
     return {"Y": [out]}
 
 
 @register_op("sequence_expand", no_grad_inputs={"Y"})
 def _sequence_expand(ctx, ins, attrs):
-    """Dense analog: broadcast per-sequence vector [b, d] across steps to
-    [b, s, d] where s comes from the reference input Y [b, s, ...]."""
+    """Dense analog of LodExpand (reference lod_tensor.h:152,
+    sequence_ops/sequence_expand_op.cc with ref_lod/ref_level): broadcast
+    each element of X across the matching segment of Y.  X [b, d] with Y
+    [b, s, ...] -> [b, s, d]; nested X [b, s1, d] with Y [b, s1, s2, ...]
+    -> [b, s1, s2, d] — the inserted axis is the one ref_level selects in
+    the reference's LoD terms (here implied by X's rank, validated against
+    the attr when given)."""
     x = ins["X"][0]
     y = ins["Y"][0]
-    s = y.shape[1]
-    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], s)
-                                     + x.shape[1:])]}
+    axis = x.ndim - 1  # new sequence axis sits before the feature dim
+    ref_level = attrs.get("ref_level", -1)
+    if ref_level not in (-1, axis - 1):
+        raise ValueError(
+            f"sequence_expand: X rank {x.ndim} expands at level {axis - 1}, "
+            f"but ref_level={ref_level} was requested; reshape X to the "
+            f"level you want to expand at (dense nested layout)")
+    if y.ndim <= axis:
+        raise ValueError("sequence_expand: Y must be deeper than X")
+    s = y.shape[axis]
+    return {"Out": [jnp.broadcast_to(
+        jnp.expand_dims(x, axis),
+        x.shape[:axis] + (s,) + x.shape[axis:])]}
 
 
 @register_op("sequence_expand_as", no_grad_inputs={"Y"})

@@ -1,0 +1,228 @@
+"""Nested (multi-level) LoD: host conversions with reference golden values
+(lod_tensor.h:215 ConvertToLengthBasedLoD example, GetSubLoDAndAbsoluteOffset
+example for ToAbsOffset), the dense nested layout, sequence ops at a chosen
+level, and a doc→sentence→word book-style model."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import lod_tensor as lt
+
+
+class TestLodConversions(unittest.TestCase):
+    def test_offset_length_roundtrip_reference_example(self):
+        # lod_tensor.h:226: offset [[0,2,3],[0,3,5,9]] <-> length [[2,1],[3,2,4]]
+        length = [[2, 1], [3, 2, 4]]
+        offset = lt.convert_to_offset_based(length)
+        self.assertEqual([o.tolist() for o in offset],
+                         [[0, 2, 3], [0, 3, 5, 9]])
+        self.assertEqual(lt.convert_to_length_based(offset), length)
+
+    def test_to_abs_offsets_reference_example(self):
+        # lod_tensor.h:195 example lod: level 0 [0,3,4,8] over level 1
+        # [0,9,10,11,13,17,19,22,24]; absolute row offsets of level 0 are
+        # [0, 11, 13, 24] (rows under elements 0-2, 3, 4-7)
+        lod = [[0, 3, 4, 8], [0, 9, 10, 11, 13, 17, 19, 22, 24]]
+        abs_lod = lt.to_abs_offsets(lod)
+        self.assertEqual(abs_lod[0].tolist(), [0, 11, 13, 24])
+        self.assertEqual(abs_lod[1].tolist(), lod[1])
+
+    def test_create_two_level(self):
+        # 2 docs: doc0 = 2 sentences (3, 1 words), doc1 = 1 sentence (2)
+        vals, lod = pt.create_lod_tensor(
+            np.arange(6, dtype=np.int64), [[2, 1], [3, 1, 2]], None)
+        self.assertEqual(len(lod), 2)
+        self.assertEqual(lod[0].tolist(), [0, 2, 3])
+        self.assertEqual(lod[1].tolist(), [0, 3, 4, 6])
+
+    def test_create_single_level_back_compat(self):
+        vals, off = pt.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]], None)
+        self.assertIsInstance(off, np.ndarray)
+        self.assertEqual(off.tolist(), [0, 3, 5])
+
+    def test_validation_rejects_inconsistent(self):
+        with self.assertRaises(ValueError):
+            pt.create_lod_tensor(np.arange(6), [[2, 2], [3, 1, 2]], None)
+        with self.assertRaises(ValueError):
+            pt.create_lod_tensor(np.arange(5), [[2, 1], [3, 1, 2]], None)
+
+    def test_nested_padded_roundtrip(self):
+        rng = np.random.RandomState(3)
+        # 3 docs, sentences (2,1 | 3 | 1,2,1), word counts vary, feat dim 4
+        lens = [[2, 1, 3], [4, 2, 5, 1, 3, 2]]
+        lod = lt.convert_to_offset_based(lens)
+        n_rows = int(lt.to_abs_offsets(lod)[0][-1])
+        vals = rng.rand(n_rows, 4).astype(np.float32)
+        padded, outer, inner = lt.lod_to_nested_padded(vals, lod)
+        self.assertEqual(padded.shape, (3, 3, 5, 4))
+        self.assertEqual(outer.tolist(), [2, 1, 3])
+        self.assertEqual(inner[0].tolist(), [4, 2, 0])
+        v2, lod2 = lt.nested_padded_to_lod(padded, outer, inner)
+        np.testing.assert_array_equal(v2, vals)
+        self.assertEqual(lod2[0].tolist(), lod[0].tolist())
+        self.assertEqual(lod2[1].tolist(), lod[1].tolist())
+
+    def test_lod_to_padded_at_level(self):
+        # level 0 of a 2-level batch pads whole docs as flat word runs
+        vals = np.arange(6, dtype=np.int64)
+        lod = [[0, 2, 3], [0, 3, 4, 6]]
+        padded, lens = lt.lod_to_padded(vals, lod, level=0)
+        self.assertEqual(lens.tolist(), [4, 2])  # doc0 = 3+1 words, doc1 = 2
+        np.testing.assert_array_equal(padded[0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(padded[1][:2], [4, 5])
+
+
+class TestNestedSequenceOps(unittest.TestCase):
+    """Ops at LoD level 1 (inner): x [b, s1, s2, d] + Length [b, s1]."""
+
+    def setUp(self):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        self.inner = np.array([[4, 2, 0], [1, 3, 2]], np.int64)
+        self.outer = np.array([2, 3], np.int64)
+
+    def _run(self, build):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3, 4, 5])
+            il = pt.layers.data("il", [3], dtype="int64")
+            out = build(x, il)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            r, = exe.run(main, feed={"x": self.x, "il": self.inner},
+                         fetch_list=[out])
+        return np.asarray(r)
+
+    def test_inner_pool_average(self):
+        got = self._run(lambda x, il: pt.layers.sequence_pool(
+            x, "average", lengths=il))
+        want = np.zeros((2, 3, 5), np.float32)
+        for i in range(2):
+            for j in range(3):
+                n = self.inner[i, j]
+                if n:
+                    want[i, j] = self.x[i, j, :n].mean(0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_inner_pool_max_empty_segment_zero(self):
+        got = self._run(lambda x, il: pt.layers.sequence_pool(
+            x, "max", lengths=il))
+        self.assertTrue(np.all(np.isfinite(got)))
+        np.testing.assert_allclose(got[0, 2], np.zeros(5))
+        np.testing.assert_allclose(got[0, 0], self.x[0, 0, :4].max(0),
+                                   rtol=1e-5)
+
+    def test_inner_pool_last(self):
+        got = self._run(lambda x, il: pt.layers.sequence_pool(
+            x, "last", lengths=il))
+        np.testing.assert_allclose(got[1, 1], self.x[1, 1, 2], rtol=1e-5)
+
+    def test_inner_softmax(self):
+        x2 = self.x[..., 0]  # [b, s1, s2]
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [3, 4])
+            il = pt.layers.data("il", [3], dtype="int64")
+            out = pt.layers.sequence_softmax(x, lengths=il)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            r, = exe.run(main, feed={"x": x2, "il": self.inner},
+                         fetch_list=[out])
+        got = np.asarray(r)
+        n = self.inner[1, 1]  # = 3
+        e = np.exp(x2[1, 1, :n] - x2[1, 1, :n].max())
+        np.testing.assert_allclose(got[1, 1, :n], e / e.sum(), rtol=1e-4)
+        np.testing.assert_allclose(got[1, 1, n:], 0, atol=1e-6)
+
+    def test_inner_reverse(self):
+        got = self._run(lambda x, il: pt.layers.sequence_reverse(
+            x, lengths=il))
+        np.testing.assert_allclose(got[0, 0, :4], self.x[0, 0, :4][::-1],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got[0, 1, 2:], self.x[0, 1, 2:],
+                                   rtol=1e-6)  # padding stays put
+
+    def test_expand_doc_to_sentence_and_word(self):
+        """LodExpand dense analog at both levels (lod_tensor.h:152)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            doc = pt.layers.data("doc", [5])        # [b, d]
+            sent = pt.layers.data("sent", [3, 5])   # [b, s1, d]
+            words = pt.layers.data("w", [3, 4, 5])  # [b, s1, s2, d]
+            d2s = pt.layers.sequence_expand(doc, sent, ref_level=0)
+            s2w = pt.layers.sequence_expand(sent, words, ref_level=1)
+        exe = pt.Executor()
+        rng = np.random.RandomState(1)
+        dv = rng.rand(2, 5).astype(np.float32)
+        sv = rng.rand(2, 3, 5).astype(np.float32)
+        wv = rng.rand(2, 3, 4, 5).astype(np.float32)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            a, b = exe.run(main, feed={"doc": dv, "sent": sv, "w": wv},
+                           fetch_list=[d2s, s2w])
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.broadcast_to(dv[:, None], (2, 3, 5)))
+        np.testing.assert_allclose(
+            np.asarray(b), np.broadcast_to(sv[:, :, None], (2, 3, 4, 5)))
+
+    def test_expand_wrong_ref_level_raises(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            doc = pt.layers.data("doc", [5])
+            sent = pt.layers.data("sent", [3, 5])
+            with self.assertRaises(Exception):
+                pt.layers.sequence_expand(doc, sent, ref_level=1)
+
+
+class TestHierarchicalModel(unittest.TestCase):
+    def test_doc_classifier_trains(self):
+        """Book-style 2-level model: embed words, pool words->sentence,
+        pool sentences->doc, classify (the text_classification pattern over
+        nested LoD input, reference book ch.5 style)."""
+        S1, S2, V, D, C = 4, 6, 50, 16, 3
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            w = pt.layers.data("w", [S1, S2], dtype="int64")
+            il = pt.layers.data("il", [S1], dtype="int64")
+            ol = pt.layers.data("ol", [], dtype="int64")
+            label = pt.layers.data("y", [1], dtype="int64")
+            emb = pt.layers.embedding(w, size=[V, D])        # [b,S1,S2,D]
+            sent = pt.layers.sequence_pool(emb, "average", lengths=il)
+            doc = pt.layers.sequence_pool(sent, "sum", lengths=ol)
+            logits = pt.layers.fc(doc, C)
+            loss = pt.layers.mean(
+                pt.layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.Adam(5e-2).minimize(loss)
+
+        rng = np.random.RandomState(0)
+        B = 16
+        # synthetic rule: label = (first word of first sentence) % C
+        lens_outer = rng.randint(1, S1 + 1, B)
+        lens_inner = np.zeros((B, S1), np.int64)
+        words = np.zeros((B, S1, S2), np.int64)
+        for i in range(B):
+            for j in range(lens_outer[i]):
+                lens_inner[i, j] = rng.randint(1, S2 + 1)
+                words[i, j, :lens_inner[i, j]] = rng.randint(
+                    0, V, lens_inner[i, j])
+        y = (words[:, 0, 0] % C).astype(np.int64)[:, None]
+        feed = {"w": words, "il": lens_inner,
+                "ol": lens_outer.astype(np.int64), "y": y}
+
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(60):
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l)[0]))
+        self.assertLess(losses[-1], losses[0] * 0.3,
+                        f"no convergence: {losses[0]} -> {losses[-1]}")
+
+
+if __name__ == "__main__":
+    unittest.main()
