@@ -187,13 +187,26 @@ class Executor:
     """fluid.Executor analog. `place` is accepted for API compatibility but
     devices are managed by JAX; pass place=None for the default device."""
 
-    def __init__(self, place=None, donate: bool = True):
+    def __init__(self, place=None, donate: bool = True,
+                 cache_capacity: Optional[int] = None):
         """donate=False keeps input param buffers alive after run — needed
         when callers hold aliases to scope arrays (the dygraph optimizer
-        path), at the cost of double-buffered updates."""
+        path), at the cost of double-buffered updates.
+
+        cache_capacity bounds the compiled-executable cache (LRU): a
+        long-running varied-shape service must not leak executables.
+        Default from FLAGS_executor_cache_capacity (64). Pair with
+        reader/bucketing.py so a ragged stream converges to <= #buckets
+        entries instead of churning the cache."""
+        import os as _os
+        from collections import OrderedDict
         self.place = place
         self._donate = donate
-        self._cache: Dict[Any, Any] = {}
+        self._cache: "OrderedDict[Any, Any]" = OrderedDict()
+        self._cache_capacity = int(
+            cache_capacity if cache_capacity is not None
+            else _os.environ.get("FLAGS_executor_cache_capacity", "64"))
+        self.compile_count = 0  # distinct compilations (tests/telemetry)
         _ensure_prng_default()
 
     # -- public API ---------------------------------------------------------
@@ -297,7 +310,12 @@ class Executor:
             feed_shapes = {k: _sig(v)[0] for k, v in feed.items()}
             compiled = self._compile(program, feed_shapes, fetch_names,
                                      mutable, created, readonly, dist_plan)
+            self.compile_count += 1
             self._cache[cache_key] = compiled
+            while len(self._cache) > self._cache_capacity:
+                self._cache.popitem(last=False)  # evict LRU
+        else:
+            self._cache.move_to_end(cache_key)
 
         mut_in = {}
         for n in mutable:
@@ -321,6 +339,16 @@ class Executor:
             ro_in = dist_plan.place_scope(ro_in)
 
         key = scope.find_var("@RNG@")
+
+        if getattr(self, "capture_hlo", False):
+            # tools/comm_volume.py: optimized HLO with the SPMD partitioner's
+            # collectives, captured without disturbing the jit cache
+            try:
+                self.last_hlo = compiled.lower(
+                    mut_in, ro_in, feed_in, key).compile().as_text()
+            except Exception as e:  # pipeline/custom callables
+                self.last_hlo = None
+                self.last_hlo_error = str(e)
 
         new_mut, fetches, new_key, finite_flags = compiled(
             mut_in, ro_in, feed_in, key)

@@ -91,27 +91,29 @@ def _submit_write(path: str, write_fn, sync: bool) -> None:
     path = os.path.abspath(path)
 
     def _run(predecessor):
-        # writes to the same path complete in submission order, so the
-        # newest snapshot is always the one that survives
-        if predecessor is not None:
-            predecessor.join()
         try:
+            # writes to the same path complete in submission order, so the
+            # newest snapshot is always the one that survives
+            if predecessor is not None:
+                predecessor.join()
             _atomic_write(path, write_fn)
         except BaseException as exc:  # surfaced by wait_for_saves
             with _pending_lock:
                 _save_errors.append(exc)
 
     with _pending_lock:
-        # read-predecessor + register must be one critical section or two
-        # concurrent submitters could both chain off the same predecessor
+        # read-predecessor + register + start must be ONE critical section:
+        # a thread published as predecessor must already be started (join()
+        # on an unstarted thread raises), and two concurrent submitters
+        # must not chain off the same predecessor
         t = threading.Thread(target=_run,
                              args=(_last_writer_for_path.get(path),),
                              daemon=True)
         _last_writer_for_path[path] = t
         _pending_saves.append(t)
         _pending_saves[:] = [p for p in _pending_saves
-                             if not p.ident or p.is_alive() or p is t]
-    t.start()
+                             if p.is_alive() or p is t]
+        t.start()
 
 
 def wait_for_saves() -> None:

@@ -4,7 +4,10 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa:
                         firstn, xmap_readers, cache, batch,
                         multiprocess_reader)
 from .py_reader import PyReader  # noqa: F401
+from .bucketing import (pow2_boundaries, bucket_for, pad_to_bucket,  # noqa: F401
+                        bucketed)
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch",
-           "multiprocess_reader", "PyReader"]
+           "multiprocess_reader", "PyReader", "pow2_boundaries",
+           "bucket_for", "pad_to_bucket", "bucketed"]
