@@ -1,0 +1,74 @@
+"""Collective-traffic accounting harness (tools/comm_volume.py; the
+AllReduceOpHandle-accounting analog, reference
+details/all_reduce_op_handle.cc:83)."""
+
+import sys
+import os
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import comm_volume as cv  # noqa: E402
+
+
+class TestHloParsing(unittest.TestCase):
+    def test_parse_synthetic_hlo(self):
+        hlo = """
+  %ar = f32[1024,8]{1,0} all-reduce(f32[1024,8] %p0), replica_groups={}
+  %ag.1 = bf16[64]{0} all-gather(bf16[8] %x), dimensions={0}
+  %cp = f32[4,4]{1,0} collective-permute(f32[4,4] %y), source_target_pairs={{0,1}}
+  %ars = f32[16]{0} all-reduce-start(f32[16] %z)
+  %ard = f32[16]{0} all-reduce-done(f32[16] %ars)
+  %add = f32[16]{0} add(f32[16] %a, f32[16] %b)
+"""
+        stats, top = cv.parse_collectives(hlo)
+        self.assertEqual(stats["all-reduce"]["count"], 2)  # plain + start
+        self.assertEqual(stats["all-reduce"]["bytes"], 1024 * 8 * 4 + 16 * 4)
+        self.assertEqual(stats["all-gather"]["count"], 1)
+        self.assertEqual(stats["all-gather"]["bytes"], 64 * 2)
+        self.assertEqual(stats["collective-permute"]["count"], 1)
+        self.assertEqual(top[0][0], "all-reduce")
+
+    def test_wire_formula(self):
+        stats = {"all-reduce": {"count": 1, "bytes": 800}}
+        # ring: 2 * N * (k-1)/k with k=8
+        self.assertAlmostEqual(cv.wire_bytes_per_device(stats, 8),
+                               2 * 800 * 7 / 8)
+
+    def test_capture_real_dp_step(self):
+        """An actual dp-sharded step must show >= 1 all-reduce whose payload
+        covers every gradient byte (params are f32: 4 bytes each)."""
+        def build():
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.layers.data("x", [16])
+                y = pt.layers.data("y", [1])
+                h = pt.layers.fc(x, 32, act="relu")
+                p = pt.layers.fc(h, 1)
+                loss = pt.layers.mean(pt.layers.square_error_cost(p, y))
+                pt.optimizer.SGD(0.1).minimize(loss)
+            n_param = sum(int(np.prod(v.shape))
+                          for v in main.all_parameters())
+            feed = {"x": np.ones((16, 16), "f"),
+                    "y": np.zeros((16, 1), "f")}
+            return main, startup, loss, feed, n_param
+
+        main, startup, loss, feed, n_param = build()
+        target = pt.CompiledProgram(main).with_sharding(
+            {}, mesh_shape=(8,), axis_names=("dp",))
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            exe.capture_hlo = True
+            exe.run(target, feed=feed, fetch_list=[loss])
+        self.assertIsNotNone(exe.last_hlo)
+        stats, _ = cv.parse_collectives(exe.last_hlo)
+        self.assertIn("all-reduce", stats)
+        self.assertGreaterEqual(stats["all-reduce"]["bytes"], n_param * 4)
+
+
+if __name__ == "__main__":
+    unittest.main()
