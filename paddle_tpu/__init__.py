@@ -13,7 +13,9 @@ from .framework import (Program, Block, Operator, Variable, Parameter,
                         default_startup_program, unique_name, unique_name_guard,
                         name_scope,
                         Executor, Scope, global_scope, scope_guard,
-                        append_backward, gradients, LayerHelper, ParamAttr)
+                        append_backward, gradients, LayerHelper, ParamAttr,
+                        WeightNormParamAttr)
+from . import dygraph_grad_clip
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import layers
 from . import optimizer

@@ -8,8 +8,8 @@ reference's ClipByGlobalNorm over allreduced grads.
 
 from .framework.core import unique_name
 
-__all__ = ["GradientClipByValue", "GradientClipByNorm",
-           "GradientClipByGlobalNorm"]
+__all__ = ["ErrorClipByValue", "GradientClipByValue",
+           "GradientClipByNorm", "GradientClipByGlobalNorm"]
 
 
 class GradientClipByValue:
@@ -92,3 +92,30 @@ class GradientClipByGlobalNorm:
                           {"Out": [c.name]}, {"axis": -1}, infer_shape=False)
             out.append((p, c))
         return out
+
+
+class ErrorClipByValue:
+    """Clip the GRADIENT of a specific forward var (reference: clip.py
+    ErrorClipByValue, attached via var.error_clip and applied by the
+    backward pass as the grad for that var is produced).  Here the same
+    contract: `append_clip_op` rewrites the grad var in place; callers
+    (or backward callbacks) invoke it with the block + grad name."""
+
+    def __init__(self, max, min=None):
+        max = float(max)
+        if min is None:
+            min = -max
+        else:
+            min = float(min)
+        self.max = max
+        self.min = min
+
+    def __str__(self):
+        return f"ByValue, min={self.min}, max={self.max}"
+
+    def _append_clip_op(self, block, grad_name):
+        block.append_op("clip", {"X": [grad_name]}, {"Out": [grad_name]},
+                        {"min": self.min, "max": self.max},
+                        infer_shape=False)
+
+    append_clip_op = _append_clip_op

@@ -1,2 +1,17 @@
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from . import extend_optimizer  # noqa: F401
+from . import decoder  # noqa: F401
+from . import layers  # noqa: F401
+from . import reader  # noqa: F401
+from .layers import (BasicGRUUnit, basic_gru, BasicLSTMUnit,  # noqa: F401
+                     basic_lstm, fused_elemwise_activation,
+                     ctr_metric_bundle)
+from .reader import distributed_batch_reader  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+from .model_stat import summary  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from .extend_optimizer import (  # noqa: F401
+    extend_with_decoupled_weight_decay)
+from .decoder import (InitState, StateCell, TrainingDecoder,  # noqa: F401
+                      BeamSearchDecoder)

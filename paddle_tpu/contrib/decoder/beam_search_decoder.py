@@ -1,0 +1,458 @@
+"""Training/beam-search decoder API (reference:
+python/paddle/fluid/contrib/decoder/beam_search_decoder.py — InitState:43,
+StateCell:159, TrainingDecoder:384, BeamSearchDecoder:523).
+
+TPU redesign of the internals, same user-facing classes:
+
+* TrainingDecoder drives our `layers.DynamicRNN` (batch-major padded
+  sequences + lengths instead of LoD; differentiable), so `step_input`
+  takes an optional `lengths=` on the first call.
+* BeamSearchDecoder replaces the reference's while_op + LoD-shrinking
+  beams with a FIXED-LENGTH UNROLLED loop over dense [batch, beam]
+  hypotheses: every step is static-shape XLA, finished beams propagate
+  end_id inside the dense `beam_search` op (ops/lod_array_ops.py) instead
+  of shrinking the tensor, state rows reorder with `beam_state_gather`,
+  and the final backtrace is the `beam_search_decode` gather-tree op.
+  `early_stop` is therefore a no-op (finished beams freeze in place) and a
+  custom `block()` body is not supported — override `decode` or pass
+  `step_fn` instead (documented divergence; PARITY.md).
+"""
+
+from __future__ import annotations
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+
+class _DecoderType:
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState:
+    """Initial hidden state (reference: beam_search_decoder.py:43).
+
+    Either `init` (a Variable, e.g. the encoder's last state) or a
+    (`shape`, `value`, `dtype`) constant spec."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of InitState."
+            )
+        else:
+            from ... import layers
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """Named states + step inputs + a user updater (reference:
+    beam_search_decoder.py:159).  The updater is plain graph-building code
+    over `get_state`/`get_input`/`set_state` and runs unchanged under both
+    decoders."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._cur_states = {}
+        self._state_names = []
+        for state_name, state in states.items():
+            if not isinstance(state, InitState):
+                raise ValueError("state must be an InitState object.")
+            self._cur_states[state_name] = state
+            self._state_names.append(state_name)
+        self._inputs = dict(inputs)
+        self._cur_decoder_obj = None
+        self._in_decoder = False
+        self._switched_decoder = False
+        self._state_updater = None
+        self._out_state = out_state
+        if self._out_state not in self._cur_states:
+            raise ValueError("out_state must be one state in states")
+        # training mode: state name -> DynamicRNN memory var
+        self._memories = {}
+
+    # -- decoder handshake (same protocol as the reference) ---------------
+    def _enter_decoder(self, decoder_obj):
+        if self._in_decoder or self._cur_decoder_obj is not None:
+            raise ValueError("StateCell has already entered a decoder.")
+        self._in_decoder = True
+        self._cur_decoder_obj = decoder_obj
+        self._switched_decoder = False
+
+    def _leave_decoder(self, decoder_obj):
+        if not self._in_decoder:
+            raise ValueError("StateCell not in decoder, "
+                             "invalid leaving operation.")
+        if self._cur_decoder_obj is not decoder_obj:
+            raise ValueError("Inconsistent decoder object in StateCell.")
+        self._in_decoder = False
+        self._cur_decoder_obj = None
+        self._switched_decoder = False
+
+    def _switch_decoder(self):
+        if not self._in_decoder:
+            raise ValueError("StateCell must enter a decoder.")
+        if self._switched_decoder:
+            raise ValueError("StateCell already done switching.")
+        dec = self._cur_decoder_obj
+        if dec.type == _DecoderType.TRAINING:
+            for name in self._state_names:
+                state = self._cur_states[name]
+                if not isinstance(state, InitState):
+                    raise ValueError(
+                        f"Current type of state is {type(state)}, should be "
+                        "an InitState object.")
+                mem = dec.dynamic_rnn.memory(init=state.value)
+                self._memories[name] = mem
+                self._cur_states[name] = mem
+        elif dec.type == _DecoderType.BEAM_SEARCH:
+            for name in self._state_names:
+                state = self._cur_states[name]
+                if isinstance(state, InitState):
+                    self._cur_states[name] = dec._tile_state(state.value)
+        else:
+            raise ValueError("Unknown decoder type, only support "
+                             "[TRAINING, BEAM_SEARCH]")
+        self._switched_decoder = True
+
+    # -- public API --------------------------------------------------------
+    def get_state(self, state_name):
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        if state_name not in self._cur_states:
+            raise ValueError(
+                f"Unknown state {state_name}. Please make sure "
+                "_switch_decoder() invoked.")
+        return self._cur_states[state_name]
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or self._inputs[input_name] is None:
+            raise ValueError(f"Invalid input {input_name}.")
+        return self._inputs[input_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def state_updater(self, updater):
+        self._state_updater = updater
+
+        def _decorator(state_cell):
+            if state_cell is self:
+                raise TypeError("Updater should only accept a StateCell "
+                                "object as argument.")
+            updater(state_cell)
+        return _decorator
+
+    def compute_state(self, inputs):
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        for input_name, input_value in inputs.items():
+            if input_name not in self._inputs:
+                raise ValueError(
+                    f"Unknown input {input_name}. Please make sure "
+                    f"{input_name} in input place holder.")
+            self._inputs[input_name] = input_value
+        self._state_updater(self)
+
+    def update_states(self):
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        dec = self._cur_decoder_obj
+        if dec is not None and dec.type == _DecoderType.TRAINING:
+            for name, mem in self._memories.items():
+                dec.dynamic_rnn.update_memory(mem, self._cur_states[name])
+        # beam mode: the decoder loop gathers + carries states itself
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder:
+    """Teacher-forced decoder over our DynamicRNN (reference:
+    beam_search_decoder.py:384).
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            word = decoder.step_input(trg_embedding, lengths=trg_lens)
+            decoder.state_cell.compute_state(inputs={'x': word})
+            score = layers.fc(decoder.state_cell.get_state('h'),
+                              size=V, act='softmax')
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        out = decoder()     # [b, T, V]
+    """
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        from ... import layers
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self._dynamic_rnn = layers.DynamicRNN(name=name)
+        self._type = _DecoderType.TRAINING
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError("decoder.block() can only be invoked once")
+        self._status = TrainingDecoder.IN_DECODER
+        with self._dynamic_rnn.block():
+            yield
+        self._status = TrainingDecoder.AFTER_DECODER
+        self._state_cell._leave_decoder(self)
+
+    @property
+    def state_cell(self):
+        self._assert_in_decoder_block("state_cell")
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._dynamic_rnn
+
+    @property
+    def type(self):
+        return self._type
+
+    def step_input(self, x, lengths=None):
+        self._assert_in_decoder_block("step_input")
+        return self._dynamic_rnn.step_input(x, lengths=lengths)
+
+    def static_input(self, x):
+        """Whole-sequence input visible at every step: outer-block vars are
+        directly readable inside our control-flow sub-blocks, so this is
+        the identity (the reference must thread it through the rnn)."""
+        self._assert_in_decoder_block("static_input")
+        return x
+
+    def __call__(self, *args, **kwargs):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError("Output of training decoder can only be "
+                             "visited outside the block.")
+        return self._dynamic_rnn(*args, **kwargs)
+
+    def output(self, *outputs):
+        self._assert_in_decoder_block("output")
+        self._dynamic_rnn.output(*outputs)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError(f"{method} should be invoked inside block of "
+                             "TrainingDecoder object.")
+
+
+class BeamSearchDecoder:
+    """Dense fixed-length beam search (reference:
+    beam_search_decoder.py:523; usage identical):
+
+        decoder = BeamSearchDecoder(state_cell, init_ids, init_scores,
+                                    target_dict_dim=V, word_dim=D,
+                                    max_len=T, beam_size=K, end_id=1)
+        decoder.decode()
+        translation_ids, translation_scores = decoder()
+
+    translation_ids/scores are dense [batch, beam, max_len] (best beam
+    first), backtraced with the gather-tree op — not LoD tensors."""
+
+    BEFORE_BEAM_SEARCH_DECODER = 0
+    IN_BEAM_SEARCH_DECODER = 1
+    AFTER_BEAM_SEARCH_DECODER = 2
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50,
+                 sparse_emb=True, max_len=100, beam_size=1, end_id=1,
+                 name=None):
+        self._type = _DecoderType.BEAM_SEARCH
+        self._status = BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = topk_size
+        self._sparse_emb = sparse_emb
+        self._max_len = max_len
+        self._beam_size = beam_size
+        self._end_id = end_id
+        self._name = name or "beam_search_decoder"
+        self._step_ids = []
+        self._step_scores = []
+        self._step_parents = []
+        self._outputs = None
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def _tile_state(self, state):
+        """[b, ...] -> [b*beam, ...]: each row repeated beam times so the
+        user updater's rank-2 code runs unchanged on folded beams."""
+        from ... import layers
+        k = self._beam_size
+        tiled = layers.expand(layers.unsqueeze(state, [1]),
+                              [1, k] + [1] * (len(state.shape) - 1))
+        return layers.reshape(tiled, [-1] + list(state.shape[1:]))
+
+    def early_stop(self):
+        """No-op on the dense design: finished beams keep emitting end_id
+        inside the beam_search op, so the unrolled steps are idempotent
+        past completion (reference breaks its while_op instead)."""
+
+    def block(self):
+        raise NotImplementedError(
+            "BeamSearchDecoder.block(): the dense unrolled design has no "
+            "while-block; override decode() or pass step_fn=... to "
+            "decode() for custom per-step computation")
+
+    def decode(self, step_fn=None):
+        """Build the decode graph (reference: beam_search_decoder.py:653).
+
+        step_fn(state_cell, prev_ids_embedding, feed_dict) -> [b*beam, V]
+        probabilities; defaults to the reference's shared softmax fc over
+        the cell's out_state."""
+        from ... import layers
+        from ...framework.layer_helper import LayerHelper, ParamAttr
+
+        if self._status != BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER:
+            raise ValueError("decode() can only be invoked once.")
+        self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
+        k = self._beam_size
+        V = self._target_dict_dim
+
+        # [b, 1] inits -> dense [b, k]: only beam 0 is live at step 0
+        prev_ids = layers.expand(self._init_ids, [1, k])
+        neg = layers.fill_constant_batch_size_like(
+            self._init_scores, shape=[-1, k], dtype="float32", value=-1e9)
+        first = layers.concat(
+            [self._init_scores,
+             layers.fill_constant_batch_size_like(
+                 self._init_scores, shape=[-1, k - 1], dtype="float32",
+                 value=-1e9)], axis=1) if k > 1 else self._init_scores
+        prev_scores = first
+        del neg
+
+        # static inputs feed every step, tiled once onto the beam axis
+        feed_static = {}
+        for name, var in self._input_var_dict.items():
+            if name not in self._state_cell._inputs:
+                raise ValueError(
+                    f"Variable {name} not found in StateCell!")
+            feed_static[name] = self._tile_state(var)
+
+        self._state_cell._switch_decoder()  # tiles the states
+
+        emb_attr = ParamAttr(name=f"{self._name}.emb.w")
+        fc_w = ParamAttr(name=f"{self._name}.fc.w")
+        fc_b = ParamAttr(name=f"{self._name}.fc.b")
+
+        helper = LayerHelper(self._name)
+        for _t in range(self._max_len):
+            ids_flat = layers.reshape(prev_ids, [-1, 1])
+            prev_emb = layers.embedding(
+                ids_flat, size=[V, self._word_dim], dtype="float32",
+                is_sparse=self._sparse_emb, param_attr=emb_attr)
+            prev_emb = layers.reshape(prev_emb, [-1, self._word_dim])
+
+            feed_dict = dict(feed_static)
+            for name in self._state_cell._inputs:
+                if name not in feed_dict:
+                    feed_dict[name] = prev_emb
+
+            self._state_cell.compute_state(inputs=feed_dict)
+            out = self._state_cell.out_state()
+            probs = (step_fn(self._state_cell, prev_emb, feed_dict)
+                     if step_fn is not None else
+                     layers.fc(out, V, act="softmax", param_attr=fc_w,
+                               bias_attr=fc_b))
+            log_probs = layers.log(probs)
+            scores3 = layers.reshape(log_probs, [-1, k, V])
+
+            sel = {}
+            for slot in ("selected_ids", "selected_scores", "parent_idx"):
+                v = helper.create_variable_for_type_inference(
+                    "int64" if slot != "selected_scores" else "float32")
+                sel[slot] = v
+            helper.append_op(
+                "beam_search",
+                {"pre_ids": [prev_ids.name],
+                 "pre_scores": [prev_scores.name],
+                 "scores": [scores3.name]},
+                {s: [v.name] for s, v in sel.items()},
+                {"beam_size": k, "end_id": self._end_id})
+            sel_ids, sel_scores, parent = (sel["selected_ids"],
+                                           sel["selected_scores"],
+                                           sel["parent_idx"])
+
+            # carry the winners' states into the next step
+            for name in self._state_cell._state_names:
+                st = self._state_cell.get_state(name)
+                g = helper.create_variable_for_type_inference(st.dtype)
+                helper.append_op(
+                    "beam_state_gather",
+                    {"State": [st.name], "Parent": [parent.name]},
+                    {"Out": [g.name]}, {"beam_size": k})
+                self._state_cell.set_state(name, g)
+
+            self._step_ids.append(sel_ids)
+            self._step_scores.append(sel_scores)
+            self._step_parents.append(parent)
+            prev_ids, prev_scores = sel_ids, sel_scores
+
+        ids_tbk = layers.stack(self._step_ids, axis=0)        # [T, b, k]
+        scores_tbk = layers.stack(self._step_scores, axis=0)
+        parents_tbk = layers.stack(self._step_parents, axis=0)
+        outs = {}
+        for slot, dtype in (("SentenceIds", "int64"),
+                            ("SentenceScores", "float32")):
+            outs[slot] = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            "beam_search_decode",
+            {"Ids": [ids_tbk.name], "ParentIdx": [parents_tbk.name],
+             "Scores": [scores_tbk.name]},
+            {slot: [v.name] for slot, v in outs.items()}, {})
+        # [T, b, k] -> [b, k, T]
+        self._outputs = (
+            layers.transpose(outs["SentenceIds"], [1, 2, 0]),
+            layers.transpose(outs["SentenceScores"], [1, 2, 0]))
+
+        self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
+        self._state_cell._leave_decoder(self)
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        raise NotImplementedError(
+            "read_array/update_array belong to the reference's while-op "
+            "array plumbing; the dense unrolled decode() carries values "
+            "directly — override decode() for custom loops")
+
+    update_array = read_array
+
+    def __call__(self):
+        if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
+            raise ValueError("Output of BeamSearchDecoder object can "
+                             "only be visited outside the block.")
+        return self._outputs

@@ -1,0 +1,89 @@
+"""Decoupled weight decay as an optimizer mixin (reference:
+python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py:20,102).
+
+`extend_with_decoupled_weight_decay(Adam)` returns an AdamW-style class:
+at minimize time `param -= coeff * param` is applied BEFORE the base
+optimizer's update ops — decay decoupled from the gradient/moment
+statistics, in exactly the reference's program order (backward, scale+sub+
+assign, then apply_optimize)."""
+
+from __future__ import annotations
+
+__all__ = ["extend_with_decoupled_weight_decay", "DecoupledWeightDecay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin over an Optimizer subclass; use via
+    extend_with_decoupled_weight_decay."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        from ...framework.core import Variable
+        if not isinstance(coeff, (float, Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._params_name = set()
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = coeff
+        super().__init__(**kwargs)
+
+    def _scale_parameters(self, params_and_grads):
+        """-> [(param, grad, param * coeff)] for params elected to decay."""
+        if isinstance(self._coeff, float) and self._coeff == 0.0:
+            return []
+        from ... import layers
+        scaled = []
+        for param, grad in params_and_grads:
+            if grad is None:
+                continue
+            if self._apply_decay_param_fun is not None \
+                    and not self._apply_decay_param_fun(param.name):
+                continue
+            if param.name in self._params_name:
+                continue
+            scaled.append((param, grad,
+                           layers.scale(param, scale=self._coeff)
+                           if isinstance(self._coeff, float)
+                           else param * self._coeff))
+            self._params_name.add(param.name)
+        return scaled
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ... import layers
+        from ...framework.core import default_startup_program
+
+        params_grads = self.backward(loss, parameter_list=parameter_list,
+                                     no_grad_set=no_grad_set)
+        # decay first, then the base update — the reference's op order
+        # (extend_optimizer_with_weight_decay.py:73 minimize)
+        for param, _grad, scaled in self._scale_parameters(params_grads):
+            updated = layers.elementwise_sub(param, scaled)
+            layers.assign(updated, output=param)
+        optimize_ops = self.apply_gradients(
+            params_grads, loss.block.program,
+            startup_program or default_startup_program())
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(self._params_name)])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """-> a subclass of base_optimizer whose first __init__ argument is
+    weight_decay (reference: extend_optimizer_with_weight_decay.py:102)."""
+    from ...optimizer import Optimizer
+    if not issubclass(base_optimizer, Optimizer):
+        raise TypeError(
+            "The input(base_optimizer) should be a derived class of "
+            "Optimizer.")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(coeff=weight_decay,
+                             apply_decay_param_fun=apply_decay_param_fun,
+                             **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,9 @@
+"""contrib layers (reference: python/paddle/fluid/contrib/layers/)."""
+
+from .rnn_impl import (BasicGRUUnit, basic_gru, BasicLSTMUnit,  # noqa: F401
+                       basic_lstm)
+from .nn import fused_elemwise_activation  # noqa: F401
+from .metric_op import ctr_metric_bundle  # noqa: F401
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm",
+           "fused_elemwise_activation", "ctr_metric_bundle"]

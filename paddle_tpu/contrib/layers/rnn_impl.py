@@ -1,0 +1,197 @@
+"""Basic GRU/LSTM built from elementary ops (reference:
+python/paddle/fluid/contrib/layers/rnn_impl.py:19 — BasicGRUUnit,
+basic_gru, BasicLSTMUnit, basic_lstm).
+
+The units are dygraph Layers over the fused cell ops; basic_gru/basic_lstm
+are static-graph stacks over layers.DynamicRNN (batch-major padded input +
+per-row lengths instead of LoD), with optional bidirectional merge-concat —
+the same surface the reference's while-op version exposes."""
+
+from __future__ import annotations
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm"]
+
+from ...dygraph.layers import Layer
+from ...dygraph.base import trace_op
+
+
+class BasicGRUUnit(Layer):
+    """One GRU step (reference: rnn_impl.py:22)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__(name_scope or "basic_gru_unit", dtype)
+        self._hidden = hidden_size
+        self._gate_act = gate_activation or "sigmoid"
+        self._act = activation or "tanh"
+        self.weight = self.create_parameter(
+            [hidden_size, 3 * hidden_size], dtype, param_attr)
+        self.bias = self.create_parameter([1, 3 * hidden_size], dtype,
+                                          bias_attr, is_bias=True)
+
+    def forward(self, input, pre_hidden):
+        ins = {"Input": [input], "HiddenPrev": [pre_hidden],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        outs = trace_op("gru_unit", ins,
+                        {"activation": self._act,
+                         "gate_activation": self._gate_act})
+        return outs["Hidden"][0]
+
+
+class BasicLSTMUnit(Layer):
+    """One LSTM step: gates = act(W [x, h] + b) (reference:
+    rnn_impl.py BasicLSTMUnit)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__(name_scope or "basic_lstm_unit", dtype)
+        self._hidden = hidden_size
+        self._forget_bias = float(forget_bias)
+        self.weight = None  # lazily sized from the first input
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+
+    def forward(self, input, pre_hidden, pre_cell):
+        import jax.numpy as jnp
+        from ...dygraph.base import to_variable
+        h = self._hidden
+        if self.weight is None:
+            in_dim = int(input.shape[-1])
+            self.weight = self.create_parameter(
+                [in_dim + h, 4 * h], self._dtype, self._param_attr)
+            self.bias = self.create_parameter(
+                [4 * h], self._dtype, self._bias_attr, is_bias=True)
+        concat = jnp.concatenate([input.value, pre_hidden.value], axis=-1)
+        gates = concat @ self.weight.value + self.bias.value
+        i, j, f, o = jnp.split(gates, 4, axis=-1)
+        c = (pre_cell.value * jax_sigmoid(f + self._forget_bias)
+             + jax_sigmoid(i) * jnp.tanh(j))
+        hy = jnp.tanh(c) * jax_sigmoid(o)
+        return to_variable(hy), to_variable(c)
+
+
+def jax_sigmoid(x):
+    import jax
+    return jax.nn.sigmoid(x)
+
+
+def _stack_rnn(input, lengths, hidden_size, num_layers, bidirectional,
+               cell_fn, name):
+    """Shared static-graph stack: cell_fn(drnn, word, layer_tag) must
+    build one direction's recurrence and return the step output."""
+    from ... import layers
+
+    def one_direction(x, tag):
+        h = x
+        for layer in range(num_layers):
+            drnn = layers.DynamicRNN(name=f"{name}_{tag}_l{layer}")
+            with drnn.block():
+                word = drnn.step_input(h, lengths=lengths)
+                out = cell_fn(drnn, word, f"{tag}_l{layer}")
+                drnn.output(out)
+            h = drnn()
+        return h
+
+    fwd = one_direction(input, "fw")
+    if not bidirectional:
+        return fwd
+    from ... import layers as L
+    rev_in = L.sequence_reverse(input, lengths=lengths)
+    bwd = L.sequence_reverse(one_direction(rev_in, "bw"), lengths=lengths)
+    return L.concat([fwd, bwd], axis=2)
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """reference: rnn_impl.py:139 basic_gru. Input here is batch-major
+    [b, T, d] + sequence_length [b] (the dense-LoD convention); returns
+    (rnn_out [b, T, H or 2H], last_hidden [b, H or 2H])."""
+    from ... import layers
+
+    if not batch_first:
+        input = layers.transpose(input, [1, 0, 2])
+
+    def cell(drnn, word, tag):
+        helper_attr = param_attr
+        prev = drnn.memory(shape=[hidden_size], value=0.0, dtype=dtype)
+        h, _r, _g = layers.gru_unit(
+            layers.fc(word, 3 * hidden_size, param_attr=helper_attr,
+                      bias_attr=False),
+            prev, 3 * hidden_size, param_attr=param_attr,
+            bias_attr=bias_attr,
+            activation=activation or "tanh",
+            gate_activation=gate_activation or "sigmoid")
+        drnn.update_memory(prev, h)
+        return h
+
+    out = _stack_rnn(input, sequence_length, hidden_size, num_layers,
+                     bidirectional, cell, name)
+    last = layers.sequence_last_step(out, lengths=sequence_length)
+    return out, last
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """reference: rnn_impl.py:353 basic_lstm; returns (rnn_out,
+    last_hidden, last_cell)."""
+    from ... import layers
+
+    if not batch_first:
+        input = layers.transpose(input, [1, 0, 2])
+
+    def lstm_layer(x, tag):
+        drnn = layers.DynamicRNN(name=f"{name}_{tag}")
+        with drnn.block():
+            word = drnn.step_input(x, lengths=sequence_length)
+            prev_h = drnn.memory(shape=[hidden_size], value=0.0,
+                                 dtype=dtype)
+            prev_c = drnn.memory(shape=[hidden_size], value=0.0,
+                                 dtype=dtype)
+            gates = layers.fc([word, prev_h], 4 * hidden_size,
+                              param_attr=param_attr, bias_attr=bias_attr)
+            i = layers.sigmoid(layers.slice(
+                gates, [1], [0], [hidden_size]))
+            j = layers.tanh(layers.slice(
+                gates, [1], [hidden_size], [2 * hidden_size]))
+            f = layers.sigmoid(layers.scale(layers.slice(
+                gates, [1], [2 * hidden_size], [3 * hidden_size]),
+                bias=float(forget_bias)))
+            o = layers.sigmoid(layers.slice(
+                gates, [1], [3 * hidden_size], [4 * hidden_size]))
+            c = prev_c * f + i * j
+            h = layers.tanh(c) * o
+            drnn.update_memory(prev_h, h)
+            drnn.update_memory(prev_c, c)
+            drnn.output(h, c)
+        return drnn()
+
+    def one_direction(x, tag):
+        h, c = None, None
+        for layer in range(num_layers):
+            h, c = lstm_layer(x, f"{tag}_l{layer}")
+            x = h
+        return h, c
+
+    fwd_h, fwd_c = one_direction(input, "fw")
+    if bidirectional:
+        rev = layers.sequence_reverse(input, lengths=sequence_length)
+        bwd_h, bwd_c = one_direction(rev, "bw")
+        bwd_h = layers.sequence_reverse(bwd_h, lengths=sequence_length)
+        bwd_c = layers.sequence_reverse(bwd_c, lengths=sequence_length)
+        out_h = layers.concat([fwd_h, bwd_h], axis=2)
+        out_c = layers.concat([fwd_c, bwd_c], axis=2)
+    else:
+        out_h, out_c = fwd_h, fwd_c
+    last_h = layers.sequence_last_step(out_h, lengths=sequence_length)
+    last_c = layers.sequence_last_step(out_c, lengths=sequence_length)
+    return out_h, last_h, last_c

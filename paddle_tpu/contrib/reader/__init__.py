@@ -1,0 +1,5 @@
+"""contrib readers (reference: python/paddle/fluid/contrib/reader/)."""
+
+from .distributed_reader import distributed_batch_reader  # noqa: F401
+
+__all__ = ["distributed_batch_reader"]

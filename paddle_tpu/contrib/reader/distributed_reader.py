@@ -1,0 +1,26 @@
+"""Shard a batch reader across trainers (reference:
+python/paddle/fluid/contrib/reader/distributed_reader.py).
+
+Each trainer keeps every trainer_num-th batch, offset by trainer_id —
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM come from the launch environment
+(the same contract the transpiler/fleet launchers set)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainer_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if trainer_id >= trainer_num:
+        raise ValueError(
+            f"trainer_id {trainer_id} must be < trainers_num {trainer_num}")
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainer_num == trainer_id:
+                yield batch
+    return decorated

@@ -40,3 +40,52 @@ def synthetic_rng(name: str, split: str) -> np.random.RandomState:
     # stable across processes/runs (hash() is salted per process)
     seed = zlib.crc32(f"{name}/{split}".encode()) & 0x7FFFFFFF
     return np.random.RandomState(seed)
+
+
+def md5file(fname: str) -> str:
+    """reference: dataset/common.py md5file."""
+    import hashlib
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Shard a reader into pickle files of `line_count` samples each
+    (reference: dataset/common.py split)."""
+    import pickle
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f))
+    buf = []
+    index = 0
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            with open(suffix % index, "wb") as f:
+                dumper(buf, f)
+            index += 1
+            buf = []
+    if buf:
+        with open(suffix % index, "wb") as f:
+            dumper(buf, f)
+        index += 1
+    return index
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Read this trainer's shard files (reference: dataset/common.py
+    cluster_files_reader)."""
+    import glob
+    import pickle
+    loader = loader or (lambda f: pickle.load(f))
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for i, path in enumerate(flist):
+            if i % trainer_count == trainer_id:
+                with open(path, "rb") as f:
+                    for sample in loader(f):
+                        yield sample
+    return reader

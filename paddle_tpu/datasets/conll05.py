@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import numpy as np
 
+import os
+
 from . import common
 
 __all__ = ["test", "word_dict_len", "label_dict_len", "predicate_dict_len"]
@@ -47,3 +49,28 @@ def test(use_synthetic=None):
             yield (words.tolist(), [int(pred)] * n, mark.tolist(),
                    labels.tolist())
     return reader
+
+
+def get_dict(use_synthetic=None):
+    """(word_dict, verb_dict, label_dict) (reference: conll05.get_dict).
+    Synthetic fallback builds deterministic vocabularies of the module's
+    dict sizes."""
+    wd = {f"w{i}": i for i in range(word_dict_len(use_synthetic))}
+    vd = {f"v{i}": i for i in range(predicate_dict_len(use_synthetic))}
+    ld = {f"l{i}": i for i in range(label_dict_len(use_synthetic))}
+    return wd, vd, ld
+
+
+def get_embedding(use_synthetic=None):
+    """Pretrained word-embedding matrix (reference: conll05.get_embedding,
+    emb.gz download). Staged file wins; synthetic fallback is a
+    deterministic Gaussian [word_dict_len, 32]."""
+    import numpy as _np
+    path = common.data_path("conll05", "emb")
+    if os.path.exists(path):
+        return _np.loadtxt(path, dtype=_np.float32)
+    if not common.synthetic_enabled(use_synthetic):
+        common.require_file(
+            path, "stage conll05/emb or set PADDLE_TPU_SYNTHETIC_DATA=1")
+    rng = common.synthetic_rng("conll05", "emb")
+    return rng.randn(word_dict_len(True), 32).astype(_np.float32)

@@ -96,3 +96,109 @@ def train(use_synthetic=None):
 def test(use_synthetic=None):
     return _synth("test") if common.synthetic_enabled(use_synthetic) \
         else _real("test")
+
+
+# -- metadata API (reference: dataset/movielens.py movie_info/user_info/
+#    age_table/max_job_id/movie_categories/get_movie_title_dict) ----------
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """reference: dataset/movielens.py MovieInfo."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index, [c for c in self.categories],
+                [w.lower() for w in self.title.split()]]
+
+    def __str__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+    __repr__ = __str__
+
+
+class UserInfo:
+    """reference: dataset/movielens.py UserInfo."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __str__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+    __repr__ = __str__
+
+
+def max_job_id(use_synthetic=None):
+    """reference: movielens.py max_job_id (ml-1m has jobs 0..20)."""
+    return 20
+
+
+def movie_categories(use_synthetic=None):
+    """Category name -> id (reference movie_categories)."""
+    return {c: i for i, c in enumerate(_CATS)}
+
+
+def get_movie_title_dict(use_synthetic=None):
+    """Title word -> id over the loaded corpus (synthetic fallback uses a
+    fixed vocab)."""
+    if common.synthetic_enabled(use_synthetic):
+        return {f"w{i}": i for i in range(100)}
+    infos = movie_info(use_synthetic)
+    words = sorted({w.lower() for m in infos.values()
+                    for w in m.title.split()})
+    return {w: i for i, w in enumerate(words)}
+
+
+def movie_info(use_synthetic=None):
+    """movie id -> MovieInfo."""
+    if common.synthetic_enabled(use_synthetic):
+        rng = common.synthetic_rng("movielens", "movies")
+        cats = list(_CATS)
+        return {i: MovieInfo(i, [cats[rng.randint(len(cats))]],
+                             f"w{rng.randint(100)} "
+                             f"w{rng.randint(100)}")
+                for i in range(1, 50)}
+    path = common.require_file(
+        common.data_path("ml-1m", "movies.dat"),
+        "stage ml-1m (movies.dat) or set PADDLE_TPU_SYNTHETIC_DATA=1")
+    out = {}
+    with open(path, encoding="latin1") as f:
+        for line in f:
+            mid, title, cats = line.strip().split("::")
+            out[int(mid)] = MovieInfo(mid, cats.split("|"), title)
+    return out
+
+
+def user_info(use_synthetic=None):
+    """user id -> UserInfo."""
+    if common.synthetic_enabled(use_synthetic):
+        rng = common.synthetic_rng("movielens", "users")
+        return {i: UserInfo(i, "M" if rng.rand() < 0.5 else "F",
+                            age_table[rng.randint(len(age_table))],
+                            rng.randint(21))
+                for i in range(1, 50)}
+    path = common.require_file(
+        common.data_path("ml-1m", "users.dat"),
+        "stage ml-1m (users.dat) or set PADDLE_TPU_SYNTHETIC_DATA=1")
+    out = {}
+    with open(path, encoding="latin1") as f:
+        for line in f:
+            uid, gender, age, job, _zip = line.strip().split("::")
+            out[int(uid)] = UserInfo(uid, gender, age, job)
+    return out

@@ -103,3 +103,132 @@ def test(format="pairwise", use_synthetic=None):
 
 def vali(format="pairwise", use_synthetic=None):
     return _reader_creator("vali", format, use_synthetic)
+
+
+# -- record-level API (reference: dataset/mq2007.py Query/QueryList +
+#    gen_plain_txt/gen_point/gen_pair/gen_list/query_filter/load_from_text)
+
+class Query:
+    """One LETOR judged document (reference mq2007.Query): relevance,
+    query_id, and the 46 features."""
+
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None,
+                 description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = list(feature_vector or [])
+        self.description = description
+
+    def __str__(self):
+        feats = " ".join(f"{i + 1}:{f}" for i, f in
+                         enumerate(self.feature_vector))
+        return f"{self.relevance_score} qid:{self.query_id} {feats}"
+
+    __repr__ = __str__
+
+    def _parse_(self, text, fill_missing=-1.0):
+        comment = text.split("#", 1)[1].strip() if "#" in text else ""
+        parsed = _parse_lines([text], fill_missing)
+        (qid, docs), = parsed.items()
+        rel, feat = docs[0]
+        self.relevance_score = rel
+        self.query_id = int(qid)
+        self.feature_vector = feat.tolist()
+        self.description = comment
+        return self
+
+
+class QueryList:
+    """All documents of one query, iterable/indexable (reference
+    mq2007.QueryList)."""
+
+    def __init__(self, querylist=None):
+        self.query_list = list(querylist or [])
+
+    def __iter__(self):
+        return iter(self.query_list)
+
+    def __len__(self):
+        return len(self.query_list)
+
+    def __getitem__(self, i):
+        return self.query_list[i]
+
+    def _correct_ranking_(self):
+        self.query_list.sort(key=lambda q: -q.relevance_score)
+
+    def _add_query(self, query):
+        self.query_list.append(query)
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1):
+    """LETOR file -> list of QueryList, one per qid (reference
+    mq2007.load_from_text)."""
+    grouped = {}
+    order = []
+    with open(filepath) as f:
+        for line in f:
+            if not line.split("#")[0].strip():
+                continue
+            q = Query()._parse_(line, fill_missing)
+            if q.query_id not in grouped:
+                grouped[q.query_id] = QueryList()
+                order.append(q.query_id)
+            grouped[q.query_id]._add_query(q)
+    lists = [grouped[qid] for qid in order]
+    if shuffle:
+        common.synthetic_rng("mq2007", "shuffle").shuffle(lists)
+    return lists
+
+
+def gen_plain_txt(querylist):
+    """yield (qid, relevance, features) per doc (reference gen_plain_txt)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    for q in querylist:
+        yield q.query_id, q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_point(querylist):
+    """yield (relevance, features) per doc (reference gen_point)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    for q in querylist:
+        yield q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """yield (label, high_features, low_features) ordered pairs
+    (reference gen_pair)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    docs = list(querylist)
+    for i, qi in enumerate(docs):
+        for qj in docs[i + 1:]:
+            if qi.relevance_score > qj.relevance_score:
+                yield (1, np.array(qi.feature_vector),
+                       np.array(qj.feature_vector))
+            elif qj.relevance_score > qi.relevance_score:
+                yield (1, np.array(qj.feature_vector),
+                       np.array(qi.feature_vector))
+
+
+def gen_list(querylist):
+    """yield the whole query as (labels, feature rows) (reference
+    gen_list)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    labels = [q.relevance_score for q in querylist]
+    features = [np.array(q.feature_vector) for q in querylist]
+    yield labels, features
+
+
+def query_filter(querylists):
+    """Drop degenerate queries where every document has the same relevance
+    (reference query_filter)."""
+    out = []
+    for ql in querylists:
+        rels = {q.relevance_score for q in ql}
+        if len(rels) > 1:
+            out.append(ql)
+    return out

@@ -13,7 +13,7 @@ from . import common
 __all__ = ["train", "test", "get_dict"]
 
 _SYNTH_VOCAB = 120
-_N_SYNTH = {"train": 256, "test": 64}
+_N_SYNTH = {"train": 256, "test": 64, "val": 64}
 BOS, EOS, UNK = 0, 1, 2
 
 
@@ -84,3 +84,11 @@ def test(src_dict_size=_SYNTH_VOCAB, trg_dict_size=_SYNTH_VOCAB,
     if common.synthetic_enabled(use_synthetic):
         return _synth("test", src_dict_size, trg_dict_size)
     return _real("test", src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size=_SYNTH_VOCAB, trg_dict_size=_SYNTH_VOCAB,
+               src_lang="en", use_synthetic=None):
+    """reference: wmt16.validation — the dev split reader."""
+    if common.synthetic_enabled(use_synthetic):
+        return _synth("val", src_dict_size, trg_dict_size)
+    return _real("val", src_dict_size, trg_dict_size, src_lang)

@@ -1,3 +1,5 @@
 """Distributed launch utilities (reference: python/paddle/distributed/)."""
 from . import elastic  # noqa: F401
 from .elastic import ElasticController, ElasticAgent  # noqa: F401
+from . import communicator  # noqa: F401
+from .communicator import Communicator  # noqa: F401

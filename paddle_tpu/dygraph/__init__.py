@@ -19,3 +19,17 @@ __all__ = ["guard", "enabled", "to_variable", "no_grad", "VarBase",
            "load_dygraph"]
 from . import parallel
 from .parallel import DataParallel, ParallelEnv, prepare_context
+from . import learning_rate_scheduler  # noqa: E402,F401
+from .learning_rate_scheduler import (  # noqa: E402,F401
+    LearningRateDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, NoamDecay)
+
+
+class BackwardStrategy:
+    """reference: dygraph/backward_strategy.py — gradient-accumulation
+    policy flags. Our tape always sums gradients deterministically (the
+    jax.vjp contract), so sort_sum_gradient is accepted and already true
+    in effect."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False

@@ -1,0 +1,147 @@
+"""Dygraph learning-rate decay objects (reference:
+python/paddle/fluid/dygraph/learning_rate_scheduler.py).
+
+Each object is passed AS the optimizer's learning_rate; every minimize()
+call reads the current value and advances the step counter (the reference
+creates a variable per step — here the value feeds the jitted update
+program each step, optimizer.py _dygraph_minimize)."""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "NoamDecay"]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = int(begin)
+        self.step_size = int(step)
+        self.dtype = dtype
+
+    def __call__(self):
+        lr = self.step()
+        self.step_num += self.step_size
+        return float(lr)
+
+    def step(self):
+        raise NotImplementedError()
+
+
+class PiecewiseDecay(LearningRateDecay):
+    """boundaries/values piecewise-constant schedule (reference:
+    dygraph/learning_rate_scheduler.py PiecewiseDecay)."""
+
+    def __init__(self, boundaries, values, begin, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def step(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        t = self.step_num / self.decay_steps
+        if self.staircase:
+            t = math.floor(t)
+        return self.learning_rate * math.exp(-self.decay_rate * t)
+
+
+class ExponentialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        t = self.step_num / self.decay_steps
+        if self.staircase:
+            t = math.floor(t)
+        return self.learning_rate * (self.decay_rate ** t)
+
+
+class InverseTimeDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        t = self.step_num / self.decay_steps
+        if self.staircase:
+            t = math.floor(t)
+        return self.learning_rate / (1 + self.decay_rate * t)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def step(self):
+        step_num = self.step_num
+        decay_steps = self.decay_steps
+        if self.cycle:
+            div = math.ceil(step_num / decay_steps) if step_num else 1.0
+            decay_steps = decay_steps * max(div, 1.0)
+        else:
+            step_num = min(step_num, decay_steps)
+        frac = (1 - step_num / decay_steps) ** self.power
+        return ((self.learning_rate - self.end_learning_rate) * frac
+                + self.end_learning_rate)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def step(self):
+        cur_epoch = math.floor(self.step_num / self.step_each_epoch)
+        return self.learning_rate * 0.5 * (
+            math.cos(cur_epoch * math.pi / self.epochs) + 1)
+
+
+class NoamDecay(LearningRateDecay):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)."""
+
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def step(self):
+        step_num = max(self.step_num, 1)
+        a = step_num ** -0.5
+        b = (self.warmup_steps ** -1.5) * step_num
+        return (self.d_model ** -0.5) * min(a, b)

@@ -1,0 +1,71 @@
+"""Eager-mode gradient clipping (reference:
+python/paddle/fluid/dygraph_grad_clip.py).
+
+Each clip object is a callable over [(param, grad_array)] pairs operating
+directly on the eager grad arrays (jax.numpy on device — no graph ops)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["GradClipByValue", "GradClipByNorm", "GradClipByGlobalNorm"]
+
+
+class GradClipBase:
+    def __call__(self, para_and_grad):
+        return self._clip(para_and_grad)
+
+
+class GradClipByValue(GradClipBase):
+    """Clamp every gradient element into [min_value, max_value]."""
+
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            min_value, max_value = -abs(min_value), abs(min_value)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, jnp.clip(g, self.min_value, self.max_value)))
+        return out
+
+
+class GradClipByNorm(GradClipBase):
+    """Scale each gradient to l2-norm <= clip_norm."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    """Scale ALL gradients jointly to global l2-norm <= max_global_norm."""
+
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def _clip(self, para_and_grad):
+        grads = [g for _p, g in para_and_grad if g is not None]
+        if not grads:
+            return list(para_and_grad)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+        scale = jnp.minimum(
+            self.max_global_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return [(p, None if g is None else g * scale)
+                for p, g in para_and_grad]

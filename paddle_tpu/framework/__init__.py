@@ -6,7 +6,7 @@ from .core import (Program, Block, Operator, Variable, Parameter,
 from .executor import (Executor, Scope, global_scope, scope_guard,
                        as_jax_function)
 from .backward import append_backward, gradients
-from .layer_helper import LayerHelper, ParamAttr
+from .layer_helper import LayerHelper, ParamAttr, WeightNormParamAttr
 from .passes import (Pass, PassRegistry, register_pass, apply_pass,
                      get_pass, Pattern, PatternPass, Match, find_matches,
                      replace_ops)

@@ -198,6 +198,23 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
     return [Operator(block, op.type + "_grad", ins, outs, dict(op.attrs))]
 
 
+def _apply_error_clips(op, block, accum, grad_ops):
+    """error_clip (reference clip.py ErrorClipByValue via
+    _callback_lookup_): a forward var carrying .error_clip has its grad
+    clipped just before the grad op that consumes it."""
+    for out_name in op.output_names():
+        v = block.vars.get(out_name)
+        eclip = getattr(v, "error_clip", None)
+        if eclip is not None and accum.contribs.get(out_name):
+            gname = accum.finalize(out_name)
+            grad_ops.extend(accum.pending_ops)
+            accum.pending_ops.clear()
+            grad_ops.append(Operator(
+                block, "clip", {"X": [gname]}, {"Out": [gname]},
+                {"min": eclip.min, "max": eclip.max,
+                 "op_role": "backward"}))
+
+
 def append_backward(loss: Variable,
                     parameter_list: Optional[Sequence[str]] = None,
                     no_grad_set: Optional[Set[str]] = None,
@@ -232,6 +249,7 @@ def append_backward(loss: Variable,
     for i in reversed(path):
         op = block.ops[i]
         accum.pending_ops.clear()
+        _apply_error_clips(op, block, accum, grad_ops)
         new_ops = _make_grad_op_descs(op, block, accum, no_grad)
         # sum-merge ops created while finalizing out-grads must run first
         grad_ops.extend(accum.pending_ops)
@@ -314,6 +332,7 @@ def gradients(targets: Sequence[Variable], inputs: Sequence[Variable],
     for i in reversed(path):
         op = block.ops[i]
         accum.pending_ops.clear()
+        _apply_error_clips(op, block, accum, grad_ops)
         new_ops = _make_grad_op_descs(op, block, accum, no_grad)
         grad_ops.extend(accum.pending_ops)
         grad_ops.extend(new_ops)

@@ -107,6 +107,22 @@ class unique_name_guard:
         return False
 
 
+def _unique_name_switch(new_state: Optional[Dict[str, int]] = None):
+    """fluid.unique_name.switch analog: swap the counter state in place,
+    returning the old state."""
+    old = _generator._ids
+    _generator._ids = {} if new_state is None else new_state
+    return old
+
+
+# fluid.unique_name is a MODULE (generate/guard/switch); expose the same
+# surface as attributes of the function so `pt.unique_name.generate(...)`
+# ports unchanged
+unique_name.generate = unique_name
+unique_name.guard = unique_name_guard
+unique_name.switch = _unique_name_switch
+
+
 class name_scope:
     """Prefix generated names for readability (fluid.name_scope analog)."""
 

@@ -39,6 +39,23 @@ class ParamAttr:
         raise TypeError(f"bad param_attr {attr!r}")
 
 
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalized parameter (reference: param_attr.py
+    WeightNormParamAttr): the layer's weight is reparameterized as
+    w = g * v / ||v|| with direction v and magnitude g trained separately;
+    `dim` is the output dimension kept un-normalized (None = whole-tensor
+    norm). LayerHelper.create_parameter builds the reparam graph."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 gradient_clip=None):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+        self.gradient_clip = gradient_clip
+
+
 class LayerHelper:
     def __init__(self, layer_type: str, **kwargs):
         self.layer_type = layer_type
@@ -63,6 +80,9 @@ class LayerHelper:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
+        if isinstance(attr, WeightNormParamAttr):
+            return self._weight_norm_parameter(attr, shape, dtype, is_bias,
+                                               default_initializer)
         name = attr.name or unique_name(f"{self.name}.w"
                                         if not is_bias else f"{self.name}.b")
         init = attr.initializer or default_initializer or (
@@ -80,6 +100,46 @@ class LayerHelper:
                       stop_gradient=True)
         init(param, sb)
         return param
+
+    def _weight_norm_parameter(self, attr, shape, dtype, is_bias,
+                               default_initializer):
+        """w = g * v / ||v||: v (direction) and g (magnitude) are the
+        trainable params; the returned var is the recomputed weight
+        (reference helper.py _create_weight_normalize)."""
+        from ..initializer import Constant
+        base = attr.name or unique_name(
+            f"{self.name}.w" if not is_bias else f"{self.name}.b")
+        v = self.create_parameter(
+            ParamAttr(name=base + ".v", initializer=attr.initializer,
+                      learning_rate=attr.learning_rate,
+                      regularizer=attr.regularizer,
+                      trainable=attr.trainable),
+            shape, dtype, is_bias, default_initializer)
+        dim = attr.dim
+        if dim is not None:
+            gshape = [shape[i] if i == dim else 1 for i in
+                      range(len(shape))]
+            axes = [i for i in range(len(shape)) if i != dim]
+            reduce_attrs = {"dim": axes, "keep_dim": True}
+        else:
+            gshape = [1] * len(shape)
+            reduce_attrs = {"reduce_all": True, "keep_dim": True}
+        g = self.create_parameter(
+            ParamAttr(name=base + ".g", initializer=Constant(1.0),
+                      learning_rate=attr.learning_rate,
+                      trainable=attr.trainable),
+            gshape, dtype)
+
+        def op(op_type, ins, attrs=None):
+            out = self.create_variable_for_type_inference(dtype)
+            self.append_op(op_type, ins, {"Out": [out.name]}, attrs or {})
+            return out
+
+        sq = op("square", {"X": [v.name]})
+        ssum = op("reduce_sum", {"X": [sq.name]}, reduce_attrs)
+        norm = op("sqrt", {"X": [ssum.name]})
+        unit = op("elementwise_div", {"X": [v.name], "Y": [norm.name]})
+        return op("elementwise_mul", {"X": [unit.name], "Y": [g.name]})
 
     def create_global_state_var(self, prefix, shape, dtype="float32",
                                 fill_value=0) -> Variable:

@@ -76,3 +76,44 @@ class MultiSlotDataGenerator(DataGenerator):
     exists for API parity (slot declaration happens via
     dataset.set_use_var order)."""
     pass
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """reference: incubate/data_generator MultiSlotStringDataGenerator —
+    slot values stay strings (no float/int conversion), the fastest path
+    for string-keyed sparse features."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, list) and not isinstance(line, tuple):
+            raise ValueError(
+                "the output of process() must be in list or tuple type")
+        output = ""
+        for index, item in enumerate(line):
+            name, elements = item
+            if output:
+                output += " "
+            out_str = [str(len(elements))]
+            out_str.extend(str(x) for x in elements)
+            output += " ".join(out_str)
+        return output + "\n"
+
+
+class SyntheticData(DataGenerator):
+    """reference: incubate/data_generator/test_data_generator.py — fixed
+    synthetic numeric slots for pipeline smoke tests."""
+
+    def generate_sample(self, line):
+        def data_iter():
+            for _ in range(10000):
+                yield ("words", [1, 2, 3, 4]), ("label", [0])
+        return data_iter
+
+
+class SyntheticStringData(DataGenerator):
+    """String twin of SyntheticData."""
+
+    def generate_sample(self, line):
+        def data_iter():
+            for _ in range(10000):
+                yield ("words", ["a", "b", "c", "d"]), ("label", ["0"])
+        return data_iter

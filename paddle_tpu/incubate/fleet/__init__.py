@@ -1,2 +1,6 @@
 """Fleet: unified distributed-training API (reference:
 python/paddle/fluid/incubate/fleet/)."""
+
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401

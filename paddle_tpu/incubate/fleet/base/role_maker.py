@@ -120,3 +120,30 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         else:
             self._role = Role.WORKER
             self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """reference: role_maker.py UserDefinedCollectiveRoleMaker — every
+    member is a worker (collective mode has no pservers)."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._role = Role.WORKER
+        self._current_id = int(current_id)
+        self._worker_endpoints = list(worker_endpoints or [])
+        self._trainers_num = len(self._worker_endpoints)
+
+    def generate_role(self):
+        self._generate_called = True
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def worker_num(self):
+        return self._trainers_num
+
+    def worker_index(self):
+        return self._current_id

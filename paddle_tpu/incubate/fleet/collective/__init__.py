@@ -124,3 +124,26 @@ class CollectiveOptimizer(DistributedOptimizer):
         fleet._transpiled_program = main
         fleet.main_program = main
         return opt_ops, params_grads
+
+
+class DistFCConfig:
+    """reference: collective/__init__.py DistFCConfig — sharded-softmax FC
+    knobs for the collective optimizer (accepted; the GSPMD sharding plan
+    handles the actual partition)."""
+
+    def __init__(self):
+        pass
+
+
+class LambConfig:
+    """reference: collective/__init__.py LambConfig — selects the Lamb
+    optimizer inside DistributedStrategy (optimizer.Lamb is the engine)."""
+
+    def __init__(self):
+        pass
+
+
+class CollectiveOpBasedOptimizer(CollectiveOptimizer):
+    """reference: collective/__init__.py CollectiveOpBasedOptimizer — the
+    explicit c_allreduce op flavor; our CollectiveOptimizer already
+    transpiles to c_* ops, so this is the same engine by another name."""

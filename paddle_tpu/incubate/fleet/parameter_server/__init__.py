@@ -137,3 +137,9 @@ class TranspilerOptimizer(DistributedOptimizer):
 
 
 fleet = PSFleet()
+
+
+# reference name aliases (incubate/fleet/parameter_server/
+# distribute_transpiler/__init__.py): the PS fleet IS the distribute-
+# transpiler flavor here
+DistributedTranspiler = PSFleet

@@ -13,7 +13,8 @@ __all__ = ["Constant", "ConstantInitializer", "Uniform",
            "UniformInitializer", "Normal", "NormalInitializer",
            "TruncatedNormal", "TruncatedNormalInitializer", "Xavier",
            "XavierInitializer", "MSRA", "MSRAInitializer",
-           "NumpyArrayInitializer"]
+           "Bilinear", "BilinearInitializer", "NumpyArrayInitializer",
+           "force_init_on_cpu", "init_on_cpu"]
 
 
 class Initializer:
@@ -128,6 +129,48 @@ class NumpyArrayInitializer(Initializer):
                         infer_shape=False)
 
 
+class BilinearInitializer(Initializer):
+    """Bilinear-upsample kernel init for conv_transpose weights
+    (reference: initializer.py BilinearInitializer): weight [c_in, c_out,
+    kh, kw] gets the separable triangle kernel so the deconv starts as
+    bilinear interpolation."""
+
+    def __call__(self, var, block):
+        shape = list(var.shape)
+        if len(shape) != 4:
+            raise ValueError("BilinearInitializer expects a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        import numpy as _np
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        # separable triangle: w[i, j] = (1-|i/f - c|) * (1-|j/f - c|)
+        cy = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cx = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        ii = _np.arange(kh).reshape(-1, 1)
+        jj = _np.arange(kw).reshape(1, -1)
+        kern = ((1 - _np.abs(ii / fh - cy)) *
+                (1 - _np.abs(jj / fw - cx))).astype("float32")
+        weight = _np.zeros(shape, "float32")
+        weight[:, :] = kern
+        NumpyArrayInitializer(weight)(var, block)
+
+
+def force_init_on_cpu():
+    """reference: initializer.py force_init_on_cpu — placement is PJRT's
+    on this backend; always False."""
+    return False
+
+
+from contextlib import contextmanager as _ctxmgr
+
+
+@_ctxmgr
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu — a no-op scope here (XLA
+    owns placement; initialization runs where the startup program runs)."""
+    yield
+
+
+Bilinear = BilinearInitializer
 Constant = ConstantInitializer
 Uniform = UniformInitializer
 Normal = NormalInitializer

@@ -41,7 +41,10 @@ from .framework import fluid_interop
 
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "wait_for_saves"]
+           "load_inference_model", "wait_for_saves", "is_parameter",
+           "is_persistable", "get_parameter_value",
+           "get_parameter_value_by_name", "prepend_feed_ops",
+           "append_fetch_ops"]
 
 _PARAMS_FILE = "params.npz"
 _PROGRAM_FILE = "__model__"
@@ -372,3 +375,60 @@ def load_inference_model(dirname: str, executor=None, scope=None,
     blk = program.global_block
     fetch_vars = [blk.var(n) for n in fetch_names]
     return program, feed_names, fetch_vars
+
+
+def is_parameter(var) -> bool:
+    """reference: io.py is_parameter."""
+    from .framework.core import Parameter
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var) -> bool:
+    """reference: io.py is_persistable."""
+    return bool(getattr(var, "persistable", False))
+
+
+def get_parameter_value(para, executor=None, scope=None):
+    """reference: io.py get_parameter_value — fetch a parameter's current
+    value as numpy."""
+    scope = scope or global_scope()
+    val = scope.find_var(para.name)
+    if val is None:
+        raise RuntimeError(f"parameter {para.name!r} not found in scope")
+    return np.asarray(val)
+
+
+def get_parameter_value_by_name(name, executor=None, program=None,
+                                scope=None):
+    """reference: io.py get_parameter_value_by_name."""
+    from .framework.core import Parameter
+    program = program or __import__(
+        "paddle_tpu").default_main_program()
+    var = program.global_block.var(name)
+    if not isinstance(var, Parameter):
+        raise TypeError(f"var {name!r} is not a Parameter")
+    return get_parameter_value(var, executor, scope=scope)
+
+
+def prepend_feed_ops(inference_program, feed_target_names,
+                     feed_holder_name="feed"):
+    """reference: io.py prepend_feed_ops (used by save_inference_model's
+    fluid export — exposed for parity)."""
+    blk = inference_program.global_block
+    blk.create_var(name=feed_holder_name, type="feed_minibatch",
+                   persistable=True)
+    for i, name in enumerate(feed_target_names):
+        blk.insert_op(i, type="feed", inputs={"X": [feed_holder_name]},
+                      outputs={"Out": [name]}, attrs={"col": i})
+
+
+def append_fetch_ops(inference_program, fetch_target_names,
+                     fetch_holder_name="fetch"):
+    """reference: io.py append_fetch_ops."""
+    blk = inference_program.global_block
+    blk.create_var(name=fetch_holder_name, type="fetch_list",
+                   persistable=True)
+    for i, name in enumerate(fetch_target_names):
+        blk.append_op(type="fetch", inputs={"X": [name]},
+                      outputs={"Out": [fetch_holder_name]},
+                      attrs={"col": i})

@@ -18,7 +18,8 @@ import contextlib
 from ..framework.core import (Variable, default_main_program, unique_name)
 from ..framework.layer_helper import LayerHelper
 
-__all__ = ["While", "while_loop", "cond", "Switch", "StaticRNN",
+__all__ = ["reorder_lod_tensor_by_rank", "is_empty",
+           "While", "while_loop", "cond", "Switch", "StaticRNN",
            "DynamicRNN", "IfElse"]
 
 
@@ -581,3 +582,23 @@ class IfElse:
                 cond = t_layers.reshape(cond, shape)
             merged.append(t_layers.where(cond, tv, fv))
         return merged
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference: layers/control_flow.py reorder_lod_tensor_by_rank."""
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     {"X": [x.name], "RankTable": [rank_table.name]},
+                     {"Out": [out.name]}, {})
+    return out
+
+
+def is_empty(x, cond=None):
+    """reference: layers/control_flow.py is_empty."""
+    from ..framework.layer_helper import LayerHelper
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", {"X": [x.name]}, {"Out": [out.name]}, {})
+    return out

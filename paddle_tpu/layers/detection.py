@@ -4,7 +4,9 @@ from __future__ import annotations
 
 from ..framework.layer_helper import LayerHelper
 
-__all__ = ["prior_box", "anchor_generator", "box_coder", "iou_similarity",
+__all__ = ["deformable_roi_pooling", "retinanet_target_assign",
+           "multi_box_head",
+           "prior_box", "anchor_generator", "box_coder", "iou_similarity",
            "yolo_box", "multiclass_nms", "roi_align", "box_clip",
            "detection_output", "sigmoid_focal_loss", "yolov3_loss",
            "density_prior_box", "polygon_box_transform",
@@ -553,3 +555,111 @@ def detection_map(detect_res, label, class_num, background_label=0,
     if return_states:
         return m, [pos, tp, fp]
     return m
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=None,
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """reference: layers/nn.py deformable_roi_pooling — same kernel as
+    deformable_psroi_pooling; output_dim derives from the input channels
+    and the pooled grid."""
+    c = int(input.shape[1])
+    if position_sensitive:
+        output_dim = c // (pooled_height * pooled_width)
+    else:
+        output_dim = c
+    out = deformable_psroi_pooling(
+        input, rois, trans, no_trans=no_trans, spatial_scale=spatial_scale,
+        output_dim=output_dim, group_size=group_size,
+        pooled_height=pooled_height, pooled_width=pooled_width,
+        part_size=part_size, sample_per_part=sample_per_part,
+        trans_std=trans_std, name=name)
+    return out[0] if isinstance(out, list) else out
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """reference: layers/detection.py retinanet_target_assign. Dense
+    per-anchor outputs; -1 labels mark ignored anchors (see the op)."""
+    ins = {"BBoxPred": [bbox_pred.name], "ClsLogits": [cls_logits.name],
+           "Anchor": [anchor_box.name], "GtBoxes": [gt_boxes.name],
+           "GtLabels": [gt_labels.name], "ImInfo": [im_info.name]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd.name]
+    return _op("retinanet_target_assign", "retinanet_target_assign", ins,
+               ["PredScores", "PredBBox", "TargetLabel", "TargetBBox",
+                "BBoxInsideWeight", "ForegroundNumber"],
+               {"positive_overlap": positive_overlap,
+                "negative_overlap": negative_overlap,
+                "num_classes": num_classes})
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """reference: layers/detection.py multi_box_head — the SSD prediction
+    head: per feature map a 3x3 (kernel_size) conv yields loc [n, P, 4]
+    and conf [n, P, C] predictions, prior_box yields the anchors; all maps
+    concatenate. Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    from . import nn as nn_layers
+    from . import tensor as t_layers
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_layer - 2))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        if steps:
+            step_i = steps[i]
+            if not isinstance(step_i, (list, tuple)):
+                step_i = [step_i, step_i]  # fluid's scalar-per-layer form
+        else:
+            step_i = [step_w[i] if step_w else 0.0,
+                      step_h[i] if step_h else 0.0]
+        box, var = prior_box(
+            feat, image,
+            mins if isinstance(mins, (list, tuple)) else [mins],
+            None if maxs is None else (
+                maxs if isinstance(maxs, (list, tuple)) else [maxs]),
+            ar if isinstance(ar, (list, tuple)) else [ar],
+            list(variance), flip, clip, step_i, offset)
+        num_priors = int(box.shape[2]) if len(box.shape) >= 3 else \
+            int(box.shape[0] // (feat.shape[2] * feat.shape[3]))
+
+        loc = nn_layers.conv2d(feat, num_priors * 4, kernel_size,
+                               padding=pad, stride=stride)
+        # [n, P*4, h, w] -> [n, h, w, P*4] -> [n, h*w*P, 4]
+        loc = t_layers.transpose(loc, [0, 2, 3, 1])
+        loc = t_layers.reshape(loc, [0, -1, 4])
+        conf = nn_layers.conv2d(feat, num_priors * num_classes,
+                                kernel_size, padding=pad, stride=stride)
+        conf = t_layers.transpose(conf, [0, 2, 3, 1])
+        conf = t_layers.reshape(conf, [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_l.append(t_layers.reshape(box, [-1, 4]))
+        vars_l.append(t_layers.reshape(var, [-1, 4]))
+
+    mbox_locs = t_layers.concat(locs, axis=1)
+    mbox_confs = t_layers.concat(confs, axis=1)
+    boxes = t_layers.concat(boxes_l, axis=0)
+    variances = t_layers.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

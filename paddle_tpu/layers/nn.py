@@ -14,7 +14,8 @@ from ..framework.core import Variable, unique_name
 from ..framework.layer_helper import LayerHelper, ParamAttr
 from ..initializer import Constant, Normal, Xavier
 
-__all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
+__all__ = ["conv3d_transpose",
+           "fc", "embedding", "conv2d", "conv2d_transpose", "pool2d",
            "batch_norm", "layer_norm", "group_norm", "instance_norm",
            "dropout", "softmax", "log_softmax", "relu", "sigmoid", "tanh",
            "gelu", "leaky_relu", "elu", "softplus", "swish", "hard_sigmoid",
@@ -816,3 +817,33 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
         {"Out": [out.name], "UOut": [u.name], "VOut": [v.name]},
         {"dim": dim, "power_iters": power_iters, "eps": eps})
     return out
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     act=None, name=None):
+    """reference: layers/nn.py conv3d_transpose (conv3d_transpose op)."""
+    helper = LayerHelper("conv3d_transpose", name=name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    if isinstance(stride, int):
+        stride = [stride] * 3
+    if isinstance(padding, int):
+        padding = [padding] * 3
+    if isinstance(dilation, int):
+        dilation = [dilation] * 3
+    c_in = int(input.shape[1])
+    w_shape = [c_in, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(param_attr, w_shape, input.dtype,
+                                default_initializer=Xavier())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d_transpose",
+                     {"Input": [input.name], "Filter": [w.name]},
+                     {"Output": [out.name]},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, dim_start=1)
+    return helper.append_activation(out, act)

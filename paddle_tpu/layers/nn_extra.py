@@ -8,6 +8,8 @@ from __future__ import annotations
 from ..framework.layer_helper import LayerHelper
 
 __all__ = [
+    "sampling_id", "gru_unit", "tree_conv", "var_conv_2d",
+    "resize_trilinear", "beam_search",
     "affine_channel", "affine_grid", "grid_sampler", "row_conv",
     "multiplex", "crop", "pad_constant_like", "selu", "mean_iou",
     "relu6", "brelu", "hard_swish", "soft_relu", "stanh", "maxout",
@@ -680,3 +682,128 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 def rank(input):
     from . import tensor as t
     return t.fill_constant([1], "int32", len(input.shape))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    """reference: layers/nn.py sampling_id — sample a column index per row
+    of a probability matrix (int64 out; dtype kw kept for signature
+    parity)."""
+    return _simple("sampling_id", {"X": [x.name]}, {"seed": int(seed)},
+                   dtype="int64")
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """reference: layers/nn.py gru_unit — one GRU step.  `size` is
+    3 * hidden_dim (fluid convention); returns (hidden, reset_hidden_prev,
+    gate)."""
+    helper = LayerHelper("gru_unit")
+    d = size // 3
+    w = helper.create_parameter(param_attr, [d, d * 3], input.dtype)
+    ins = {"Input": [input.name], "HiddenPrev": [hidden.name],
+           "Weight": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [1, d * 3], input.dtype,
+                                    is_bias=True)
+        ins["Bias"] = [b.name]
+    outs = {}
+    rets = []
+    for slot in ("Hidden", "ResetHiddenPrev", "Gate"):
+        v = helper.create_variable_for_type_inference(input.dtype)
+        outs[slot] = [v.name]
+        rets.append(v)
+    helper.append_op("gru_unit", ins, outs,
+                     {"activation": activation,
+                      "gate_activation": gate_activation,
+                      "origin_mode": bool(origin_mode)})
+    return tuple(rets)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference: layers/nn.py tree_conv (tree_conv_op.h TBCNN)."""
+    helper = LayerHelper(name or "tree_conv")
+    f = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                [f, 3, output_size, num_filters],
+                                nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op("tree_conv",
+                     {"NodesVector": [nodes_vector.name],
+                      "EdgeSet": [edge_set.name], "Filter": [w.name]},
+                     {"Out": [out.name]}, {"max_depth": int(max_depth)})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    nodes_vector.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, dim_start=3)
+    return helper.append_activation(out, act)
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, name=None):
+    """reference: layers/nn.py var_conv_2d (var_conv_2d_op.cc); per-sample
+    valid heights/widths ride in row/col instead of LoD."""
+    helper = LayerHelper(name or "var_conv_2d")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    w = helper.create_parameter(
+        param_attr,
+        [output_channel, input_channel * filter_size[0] * filter_size[1]],
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    col_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("var_conv_2d",
+                     {"X": [input.name], "W": [w.name],
+                      "ROW": [row.name], "COLUMN": [col.name]},
+                     {"Out": [out.name], "Col": [col_out.name]},
+                     {"InputChannel": int(input_channel),
+                      "OutputChannel": int(output_channel),
+                      "KernelH": int(filter_size[0]),
+                      "KernelW": int(filter_size[1]),
+                      "StrideH": int(stride), "StrideW": int(stride)})
+    return helper.append_activation(out, act)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    """reference: layers/nn.py resize_trilinear (interpolate_op.cc)."""
+    if out_shape is None:
+        if scale is None:
+            raise ValueError("resize_trilinear needs out_shape or scale")
+        out_shape = [int(input.shape[2] * scale),
+                     int(input.shape[3] * scale),
+                     int(input.shape[4] * scale)]
+    return _simple("trilinear_interp", {"X": [input.name]},
+                   {"out_d": int(out_shape[0]), "out_h": int(out_shape[1]),
+                    "out_w": int(out_shape[2]),
+                    "align_corners": align_corners,
+                    "align_mode": align_mode}, dtype=input.dtype)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """reference: layers/nn.py beam_search (beam_search_op.cc). Dense
+    form: pre_ids/pre_scores [b, beam], scores [b, beam, V] (log-probs,
+    already accumulated when is_accumulated); `ids` accepted for signature
+    parity (the dense op selects straight from `scores`)."""
+    helper = LayerHelper(name or "beam_search")
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference("float32")
+    parent = helper.create_variable_for_type_inference("int64")
+    helper.append_op("beam_search",
+                     {"pre_ids": [pre_ids.name],
+                      "pre_scores": [pre_scores.name],
+                      "scores": [scores.name]},
+                     {"selected_ids": [sel_ids.name],
+                      "selected_scores": [sel_scores.name],
+                      "parent_idx": [parent.name]},
+                     {"beam_size": int(beam_size), "end_id": int(end_id),
+                      "level": int(level),
+                      "is_accumulated": bool(is_accumulated)})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores

@@ -7,7 +7,8 @@ __all__ = ["sequence_mask", "sequence_pool", "sequence_softmax",
            "sequence_reverse", "sequence_expand", "sequence_concat",
            "sequence_last_step", "sequence_first_step", "sequence_slice",
            "sequence_enumerate", "sequence_erase", "sequence_pad",
-           "sequence_unpad", "sequence_conv"]
+           "sequence_unpad", "sequence_conv", "sequence_expand_as",
+           "sequence_reshape", "sequence_scatter"]
 
 
 def _op(helper_name, op_type, ins, outs_spec, attrs=None, dtypes=None):
@@ -127,3 +128,25 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
         b = helper.create_parameter(bias_attr, [num_filters], is_bias=True)
         out = helper.append_bias_op(out, b, dim_start=2)
     return helper.append_activation(out, act)
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference: layers/nn.py sequence_expand_as."""
+    return _op("sequence_expand_as", "sequence_expand_as",
+               {"X": [x.name], "Y": [y.name]}, ["Out"], {},
+               {"Out": x.dtype})
+
+
+def sequence_reshape(input, new_dim):
+    """reference: layers/nn.py sequence_reshape."""
+    return _op("sequence_reshape", "sequence_reshape",
+               {"X": [input.name]}, ["Out"], {"new_dim": int(new_dim)},
+               {"Out": input.dtype})
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference: layers/nn.py sequence_scatter."""
+    return _op("sequence_scatter", "sequence_scatter",
+               {"X": [input.name], "Ids": [index.name],
+                "Updates": [updates.name]}, ["Out"], {},
+               {"Out": input.dtype})

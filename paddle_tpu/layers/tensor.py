@@ -6,7 +6,14 @@ Reference: python/paddle/fluid/layers/tensor.py and layers/io.py (data:…).
 from ..framework.core import Variable, unique_name, convert_np_dtype
 from ..framework.layer_helper import LayerHelper
 
-__all__ = ["data", "fill_constant", "fill_constant_batch_size_like",
+# the fluid API exports a `range` LAYER below; keep the builtin reachable
+_builtin_range = range
+
+__all__ = ["diag", "eye", "linspace", "range", "reverse", "sign",
+           "has_inf", "has_nan", "isfinite", "shard_index", "size",
+           "create_array", "array_write", "array_read", "array_length",
+           "tensor_array_to_tensor",
+           "data", "fill_constant", "fill_constant_batch_size_like",
            "zeros", "ones", "zeros_like", "ones_like", "cast", "concat",
            "split", "stack", "unstack", "reshape", "squeeze", "unsqueeze",
            "flatten", "transpose", "slice", "expand", "gather", "gather_nd",
@@ -104,7 +111,7 @@ def split(input, num_or_sections, dim=-1, name=None):
         n = len(num_or_sections)
         attrs = {"sections": list(num_or_sections), "axis": dim}
     outs = [helper.create_variable_for_type_inference(input.dtype)
-            for _ in range(n)]
+            for _ in _builtin_range(n)]
     helper.append_op("split", {"X": [input.name]},
                      {"Out": [o.name for o in outs]}, attrs)
     return outs
@@ -123,7 +130,7 @@ def unstack(x, axis=0, num=None, name=None):
     helper = LayerHelper("unstack", name=name)
     n = num if num is not None else int(x.shape[axis])
     outs = [helper.create_variable_for_type_inference(x.dtype)
-            for _ in range(n)]
+            for _ in _builtin_range(n)]
     helper.append_op("unstack", {"X": [x.name]},
                      {"Y": [o.name for o in outs]}, {"axis": axis})
     return outs
@@ -459,3 +466,154 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     return helper.create_parameter(attr, list(shape), dtype,
                                    is_bias=is_bias,
                                    default_initializer=default_initializer)
+
+
+def _simple_op(op_type, ins, attrs, out_dtype, helper_name=None):
+    helper = LayerHelper(helper_name or op_type)
+    out = helper.create_variable_for_type_inference(out_dtype)
+    helper.append_op(op_type, ins, {"Out": [out.name]}, attrs)
+    return out
+
+
+def diag(diagonal, name=None):
+    """reference: layers/tensor.py diag."""
+    return _simple_op("diag", {"Diagonal": [diagonal.name]}, {},
+                      diagonal.dtype)
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32",
+        name=None):
+    """reference: layers/tensor.py eye. batch_shape tiles leading dims."""
+    out = _simple_op("eye", {}, {"num_rows": int(num_rows),
+                                 "num_columns": int(num_columns
+                                                    if num_columns else -1),
+                                 "dtype": dtype}, dtype)
+    if batch_shape:
+        from . import tensor as _t
+        for _ in batch_shape:
+            out = _t.unsqueeze(out, [0])
+        out = _t.expand(out, list(batch_shape) + [1, 1])
+    return out
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    """reference: layers/tensor.py linspace; num must be static (XLA)."""
+    s = start if isinstance(start, Variable) else fill_constant(
+        [1], dtype, float(start))
+    e = stop if isinstance(stop, Variable) else fill_constant(
+        [1], dtype, float(stop))
+    return _simple_op("linspace", {"Start": [s.name], "Stop": [e.name]},
+                      {"num": int(num)}, dtype)
+
+
+def range(start, end, step, dtype="float32", name=None):
+    """reference: layers/tensor.py range. Bounds must be python numbers
+    (static shapes under XLA) — delegates to arange."""
+    if any(isinstance(v, Variable) for v in (start, end, step)):
+        raise ValueError("range on TPU needs static python bounds "
+                         "(a tensor bound would be a dynamic shape)")
+    return arange(start, end, step, dtype, name)
+
+
+def reverse(x, axis, name=None):
+    """reference: layers/tensor.py reverse."""
+    if isinstance(axis, int):
+        axis = [axis]
+    return _simple_op("reverse", {"X": [x.name]},
+                      {"axis": [int(a) for a in axis]}, x.dtype)
+
+
+def sign(x, name=None):
+    """reference: layers/nn.py sign."""
+    return _simple_op("sign", {"X": [x.name]}, {}, x.dtype)
+
+
+def has_inf(x, name=None):
+    """reference: layers/tensor.py has_inf — any(isinf(x)), shape [1]."""
+    return _simple_op("isinf", {"X": [x.name]}, {}, "bool")
+
+
+def has_nan(x, name=None):
+    """reference: layers/tensor.py has_nan."""
+    return _simple_op("isnan", {"X": [x.name]}, {}, "bool")
+
+
+def isfinite(x, name=None):
+    """reference: layers/tensor.py isfinite."""
+    return _simple_op("isfinite", {"X": [x.name]}, {}, "bool")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: layers/nn.py shard_index."""
+    return _simple_op("shard_index", {"X": [input.name]},
+                      {"index_num": int(index_num),
+                       "nshards": int(nshards),
+                       "shard_id": int(shard_id),
+                       "ignore_value": int(ignore_value)}, input.dtype)
+
+
+def size(input, name=None):
+    """reference: layers/nn.py size — total element count, int64 [1]."""
+    return _simple_op("size", {"Input": [input.name]}, {}, "int64", "size")
+
+
+# -- tensor-array surface (reference: layers/control_flow.py) --------------
+
+def create_array(dtype):
+    """reference: layers/control_flow.py create_array — a tensor-array var
+    (a python tuple of arrays in the trace env, lod_array_ops.py)."""
+    helper = LayerHelper("array")
+    return helper.main_program.current_block().create_var(
+        name=unique_name("array"), dtype=dtype, type="lod_tensor_array",
+        shape=None)
+
+
+def array_write(x, i, array=None):
+    """reference: control_flow.py array_write (write_to_array op; the index
+    must be build-time constant under the whole-block jit design)."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array", {"X": [x.name], "I": [i.name]},
+                     {"Out": [array.name]}, {}, infer_shape=False)
+    return array
+
+
+def array_read(array, i, shape=None):
+    """reference: control_flow.py array_read (read_from_array op). The
+    element shape is runtime-determined; pass `shape` when a downstream
+    build-time op needs it."""
+    helper = LayerHelper("array_read")
+    out = helper.main_program.current_block().create_var(
+        name=unique_name("array_read"), dtype=array.dtype,
+        shape=tuple(shape) if shape is not None else None)
+    helper.append_op("read_from_array", {"X": [array.name], "I": [i.name]},
+                     {"Out": [out.name]}, {}, infer_shape=False)
+    return out
+
+
+def array_length(array):
+    """reference: control_flow.py array_length."""
+    helper = LayerHelper("array_length")
+    out = helper.main_program.current_block().create_var(
+        name=unique_name("array_length"), dtype="int64", shape=(1,))
+    helper.append_op("lod_array_length", {"X": [array.name]},
+                     {"Out": [out.name]}, {}, infer_shape=False)
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False,
+                           shape=None):
+    """reference: layers/tensor.py tensor_array_to_tensor (shapes are
+    runtime-determined; pass `shape` for build-time consumers)."""
+    helper = LayerHelper("tensor_array_to_tensor")
+    blk = helper.main_program.current_block()
+    out = blk.create_var(name=unique_name("ta2t"), dtype=input.dtype,
+                         shape=tuple(shape) if shape is not None else None)
+    idx = blk.create_var(name=unique_name("ta2t_idx"), dtype="int32",
+                         shape=None)
+    helper.append_op("tensor_array_to_tensor", {"X": [input.name]},
+                     {"Out": [out.name], "OutIndex": [idx.name]},
+                     {"axis": int(axis), "use_stack": bool(use_stack)},
+                     infer_shape=False)
+    return out, idx

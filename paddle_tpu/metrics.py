@@ -8,7 +8,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MetricBase", "CompositeMetric", "Accuracy", "Precision",
-           "Recall", "ChunkEvaluator", "Auc", "DetectionMAP"]
+           "Recall", "ChunkEvaluator", "Auc", "EditDistance",
+           "DetectionMAP"]
 
 
 class MetricBase:
@@ -165,6 +166,40 @@ class ChunkEvaluator(MetricBase):
         f1 = (2 * precision * recall / (precision + recall)
               if precision + recall else 0.0)
         return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Accumulate edit distances over sequence pairs (reference:
+    fluid/metrics.py:492). update() takes a (batch, 1) distances array and
+    the pair count; eval() returns (avg_distance, wrong_instance_ratio)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances)
+        if not np.issubdtype(distances.dtype, np.number):
+            raise ValueError("'distances' must be a numeric ndarray")
+        if not isinstance(seq_num, (int, float, np.integer, np.floating)):
+            raise ValueError("'seq_num' must be a number")
+        self.seq_num += seq_num
+        self.instance_error += seq_num - int(np.sum(distances == 0))
+        self.total_distance += float(np.sum(distances))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError(
+                "There is no data in EditDistance Metric. Please check "
+                "layers.edit_distance output has been added to EditDistance.")
+        avg_distance = self.total_distance / self.seq_num
+        wrong_instance_ratio = self.instance_error / self.seq_num
+        return avg_distance, wrong_instance_ratio
 
 
 class DetectionMAP:

@@ -1129,3 +1129,68 @@ def _retinanet_detection_output(ctx, ins, attrs):
 
     outs, counts = jax.vmap(one_image)(bx, sc, lb)
     return {"Out": [outs], "NmsRoisNum": [counts]}
+
+
+@register_op("retinanet_target_assign", not_differentiable=True,
+             grad_free=True)
+def _retinanet_target_assign(ctx, ins, attrs):
+    """reference: detection/retinanet_target_assign_op.cc. Dense redesign
+    (same shape discipline as rpn_target_assign above): every anchor gets a
+    class label — the matched gt label (which MUST be 1-based, 0 being the
+    background code, the reference's convention) for IoU >=
+    positive_overlap or best-match, 0 for IoU < negative_overlap, -1
+    ignore in between (focal loss needs no subsampling);
+    TargetBBox/BBoxInsideWeight are per-anchor encoded targets;
+    ForegroundNumber [n, 1] counts fg anchors. PredScores/PredBBox pass
+    the predictions through unchanged (the reference gathers; dense keeps
+    all rows and the -1 labels mark ignores)."""
+    anchor = ins["Anchor"][0]                    # [A, 4]
+    gt_boxes = ins["GtBoxes"][0]                 # [n, g, 4]
+    gt_labels = ins["GtLabels"][0]               # [n, g]
+    is_crowd = ins.get("IsCrowd", [None])[0]
+    pos_ov = attrs.get("positive_overlap", 0.5)
+    neg_ov = attrs.get("negative_overlap", 0.4)
+    a = anchor.shape[0]
+
+    def one(img_gt, img_lab, img_crowd):
+        gt_valid = (img_gt[:, 2] > img_gt[:, 0]) & \
+            (img_gt[:, 3] > img_gt[:, 1])
+        if img_crowd is not None:
+            gt_valid &= (img_crowd.reshape(-1) == 0)
+        iou = _iou_matrix(anchor, img_gt)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        a2g_max = iou.max(axis=1)
+        a2g_arg = jnp.argmax(iou, axis=1)
+        g2a_max = iou.max(axis=0)
+        is_best = (jnp.abs(iou - g2a_max[None, :]) < 1e-5) & \
+            (g2a_max[None, :] > 0)
+        fg = (a2g_max >= pos_ov) | is_best.any(axis=1)
+        bg = ~fg & (a2g_max < neg_ov)
+        cls = img_lab.reshape(-1)[a2g_arg].astype(jnp.int32)
+        labels = jnp.where(fg, cls, jnp.where(bg, 0, -1))
+        mgt = img_gt[a2g_arg]
+        aw = anchor[:, 2] - anchor[:, 0] + 1
+        ah = anchor[:, 3] - anchor[:, 1] + 1
+        acx = anchor[:, 0] + aw / 2
+        acy = anchor[:, 1] + ah / 2
+        gw = mgt[:, 2] - mgt[:, 0] + 1
+        gh = mgt[:, 3] - mgt[:, 1] + 1
+        gcx = mgt[:, 0] + gw / 2
+        gcy = mgt[:, 1] + gh / 2
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+        tgt = jnp.where(fg[:, None], tgt, 0.0)
+        inw = jnp.where(fg[:, None], jnp.ones((a, 4), anchor.dtype), 0.0)
+        return (labels, tgt, inw,
+                fg.sum().astype(jnp.int32).reshape(1))
+
+    labels, tgt, inw, fg_num = jax.vmap(one)(
+        gt_boxes, gt_labels,
+        is_crowd if is_crowd is not None else
+        jnp.zeros(gt_boxes.shape[:2], jnp.int32))
+    return {"PredScores": [ins["ClsLogits"][0]],
+            "PredBBox": [ins["BBoxPred"][0]],
+            "TargetLabel": [labels],
+            "TargetBBox": [tgt],
+            "BBoxInsideWeight": [inw],
+            "ForegroundNumber": [fg_num]}

@@ -162,6 +162,29 @@ def _beam_search(ctx, ins, attrs):
             "parent_idx": [parent]}
 
 
+@register_op("beam_state_gather", no_grad_inputs={"Parent"})
+def _beam_state_gather(ctx, ins, attrs):
+    """Reorder per-beam state rows by the beam_search op's parent_idx:
+    Out[b, k, ...] = State[b, Parent[b, k], ...].  State may be flat
+    [b*bw, ...] with attr beam_size (the folded-batch layout user RNN code
+    computes in); the output keeps the input's layout."""
+    state = ins["State"][0]
+    parent = ins["Parent"][0].astype(jnp.int32)
+    b, bw = parent.shape
+    structured = state.ndim >= 2 and tuple(state.shape[:2]) == (b, bw)
+    if not structured:
+        if state.shape[0] != b * bw:
+            raise ValueError(
+                f"beam_state_gather: State leading dim {state.shape[0]} is "
+                f"neither [b, bw]={b, bw} nor b*bw={b * bw}")
+        state = state.reshape((b, bw) + state.shape[1:])
+    idx = parent.reshape((b, bw) + (1,) * (state.ndim - 2))
+    out = jnp.take_along_axis(state, idx, axis=1)
+    if not structured:
+        out = out.reshape((b * bw,) + out.shape[2:])
+    return {"Out": [out]}
+
+
 @register_op("beam_search_decode", not_differentiable=True, grad_free=True)
 def _beam_search_decode(ctx, ins, attrs):
     """Backtrace stacked per-step (ids, parents) into full sequences

@@ -125,3 +125,19 @@ def _precision_recall(ctx, ins, attrs):
     return {"BatchMetrics": [_pr_metrics(batch_states)],
             "AccumMetrics": [_pr_metrics(accum)],
             "AccumStatesInfo": [accum]}
+
+
+@register_op("ctr_metric_bundle", not_differentiable=True, grad_free=True)
+def _ctr_metric_bundle(ctx, ins, attrs):
+    """Streaming CTR stats (reference: contrib/layers/metric_op.py
+    ctr_metric_bundle composition): accumulate sum((p-y)^2), sum(|p-y|),
+    sum(p), and the q value sum(y==1 ? p : 1-p)... the reference q is
+    sum(label * log(p)+...)-free: q = sum(p where clicked) — we follow
+    its ops: local_q += sum(p * y)."""
+    p = ins["X"][0].reshape(-1).astype(jnp.float32)
+    y = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    err = p - y
+    return {"SqrErr": [ins["SqrErrIn"][0] + jnp.sum(err * err).reshape(1)],
+            "AbsErr": [ins["AbsErrIn"][0] + jnp.sum(jnp.abs(err)).reshape(1)],
+            "Prob": [ins["ProbIn"][0] + jnp.sum(p).reshape(1)],
+            "Q": [ins["QIn"][0] + jnp.sum(p * y).reshape(1)]}

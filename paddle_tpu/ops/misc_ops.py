@@ -389,3 +389,9 @@ def _isnan(ctx, ins, attrs):
 @register_op("isinf", not_differentiable=True, grad_free=True)
 def _isinf(ctx, ins, attrs):
     return {"Out": [jnp.any(jnp.isinf(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("sign")
+def _sign(ctx, ins, attrs):
+    """reference: sign_op.cc (grad is zero — jnp.sign's vjp handles it)."""
+    return {"Out": [jnp.sign(ins["X"][0])]}

@@ -696,3 +696,39 @@ def _spectral_norm(ctx, ins, attrs):
     v = jax.lax.stop_gradient(v)
     sigma = u @ (mat @ v)
     return {"Out": [w / sigma], "UOut": [u], "VOut": [v]}
+
+
+@register_op("trilinear_interp")
+def _trilinear_interp(ctx, ins, attrs):
+    """reference: interpolate_op.cc trilinear mode — [n, c, D, H, W] resize
+    via jax.image (matches align_corners=False half-pixel; align_corners
+    uses the linear endpoint grid)."""
+    import jax
+    x = ins["X"][0]
+    od = int(attrs["out_d"])
+    oh = int(attrs["out_h"])
+    ow = int(attrs["out_w"])
+    n, c = x.shape[0], x.shape[1]
+    method = "trilinear"
+    if attrs.get("align_corners", True):
+        # endpoint-aligned grid: gather with explicit coords per axis
+        def coords(src, dst):
+            if dst == 1:
+                return jnp.zeros((1,))
+            return jnp.linspace(0.0, src - 1.0, dst)
+        d, h, w = x.shape[2:]
+        zs, ys, xs = coords(d, od), coords(h, oh), coords(w, ow)
+
+        def axis_lerp(arr, cs, axis):
+            lo = jnp.floor(cs).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, arr.shape[axis] - 1)
+            t = (cs - lo).reshape([-1 if i == axis else 1
+                                   for i in range(arr.ndim)])
+            a = jnp.take(arr, lo, axis=axis)
+            b = jnp.take(arr, hi, axis=axis)
+            return a * (1 - t) + b * t
+
+        out = axis_lerp(axis_lerp(axis_lerp(x, zs, 2), ys, 3), xs, 4)
+        return {"Out": [out]}
+    out = jax.image.resize(x, (n, c, od, oh, ow), method=method)
+    return {"Out": [out]}

@@ -126,6 +126,10 @@ class Optimizer:
         sig = tuple((p.name, p.shape, str(p.dtype)) for p in params)
         state = self.__dict__.setdefault("_dy_state", {})
         entry = state.get(sig)
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+        decay = (self._learning_rate
+                 if isinstance(self._learning_rate, LearningRateDecay)
+                 else None)
         if entry is None:
             if isinstance(self._learning_rate, Variable):
                 raise TypeError("dygraph mode needs a numeric learning rate")
@@ -133,6 +137,10 @@ class Optimizer:
             main, startup = Program(), Program()
             self._accumulators = {}
             lr_backup = self._learning_rate
+            if decay is not None:
+                # placeholder constant; the decay value overwrites the lr
+                # scope var before every step (see below)
+                self._learning_rate = float(decay.step())
             with program_guard(main, startup):
                 pgs = []
                 for p in params:
@@ -146,6 +154,10 @@ class Optimizer:
                         dtype=str(p.dtype))
                     pgs.append((pv, gv))
                 self.apply_gradients(pgs, main, startup)
+            lr_name = (self._learning_rate.name
+                       if isinstance(self._learning_rate, Variable)
+                       else None)
+            self._dy_lr_name = lr_name
             self._learning_rate = lr_backup  # keep float for future builds
             scope = Scope()
             # no donation: eager code may hold aliases of p.value (detach,
@@ -158,6 +170,10 @@ class Optimizer:
         main, exe, scope = entry
         for p in params:
             scope.set_var(p.name, p.value)
+        if decay is not None and getattr(self, "_dy_lr_name", None):
+            import jax.numpy as jnp
+            scope.set_var(self._dy_lr_name,
+                          jnp.asarray([decay()], jnp.float32))
         feed = {p.name + "@GRAD": p._grad for p in params}
         with scope_guard(scope):
             exe.run(main, feed=feed)

@@ -78,3 +78,12 @@ class RecordEvent:
 
 
 record_event = RecordEvent
+
+
+def reset_profiler():
+    """reference: profiler.py reset_profiler — drop collected events so the
+    next start_profiler begins clean."""
+    import glob
+    import shutil
+    for d in glob.glob("/tmp/paddle_tpu_prof*"):
+        shutil.rmtree(d, ignore_errors=True)

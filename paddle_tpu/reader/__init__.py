@@ -2,12 +2,14 @@
 
 from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa: F401
                         firstn, xmap_readers, cache, batch,
-                        multiprocess_reader)
+                        multiprocess_reader, ComposeNotAligned,
+                        PipeReader, Fake)
 from .py_reader import PyReader  # noqa: F401
 from .bucketing import (pow2_boundaries, bucket_for, pad_to_bucket,  # noqa: F401
                         bucketed)
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch",
-           "multiprocess_reader", "PyReader", "pow2_boundaries",
+           "multiprocess_reader", "ComposeNotAligned", "PipeReader",
+           "Fake", "PyReader", "pow2_boundaries",
            "bucket_for", "pad_to_bucket", "bucketed"]

@@ -15,7 +15,8 @@ from typing import Callable, Iterable, List, Sequence
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch",
-           "multiprocess_reader"]
+           "multiprocess_reader", "ComposeNotAligned", "PipeReader",
+           "Fake"]
 
 
 class _Raise:
@@ -59,18 +60,32 @@ def chain(*readers):
     return reader
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different stream lengths
+    (reference: reader/decorator.py:145)."""
+
+
 def compose(*readers, check_alignment: bool = True):
     """Zip readers into tuples; sample fields are flattened like the
-    reference (a tuple sample contributes its elements)."""
+    reference (a tuple sample contributes its elements).  With
+    check_alignment, uneven streams raise ComposeNotAligned instead of
+    silently truncating."""
     def _flatten(x):
         if isinstance(x, tuple):
             return x
         return (x,)
 
+    _END = object()
+
     def reader():
         rs = [r() for r in readers]
         if check_alignment:
-            for items in zip(*rs):
+            for items in itertools.zip_longest(*rs, fillvalue=_END):
+                if any(i is _END for i in items):
+                    if not all(i is _END for i in items):
+                        raise ComposeNotAligned(
+                            "outputs of readers are not aligned")
+                    return
                 yield sum((_flatten(i) for i in items), ())
         else:
             for items in itertools.zip_longest(*rs):
@@ -228,3 +243,64 @@ def batch(reader, batch_size: int, drop_last: bool = False):
         if b and not drop_last:
             yield b
     return batch_reader
+
+
+class PipeReader:
+    """Stream lines from a shell command's stdout (reference:
+    reader/decorator.py:460 — `hadoop fs -cat`, `curl`, etc.).
+    file_type "plain" or "gzip"."""
+
+    def __init__(self, command, bufsize: int = 8192,
+                 file_type: str = "plain"):
+        if not isinstance(command, str):
+            raise TypeError("left_cmd must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError(f"file_type {file_type} is not allowed")
+        import subprocess
+        self.command = command
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines: bool = True, line_break: str = "\n"):
+        """Yield decoded lines (or raw chunks with cut_lines=False)."""
+        if self.file_type == "gzip":
+            import zlib
+            decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                decomp_buff = decomp.decompress(buff).decode()
+            else:
+                decomp_buff = buff.decode()
+            if cut_lines:
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop(-1)
+                yield from lines
+            else:
+                yield decomp_buff
+        if cut_lines and remained:
+            yield remained
+
+
+class Fake:
+    """Cache the first sample and replay it data_num times — a
+    fixed-input speed-testing reader (reference: decorator.py:531)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < data_num:
+                yield self.data
+                self.yield_num += 1
+            self.yield_num = 0
+        return fake_reader

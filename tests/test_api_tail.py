@@ -1,0 +1,282 @@
+"""API-surface tail (VERDICT r3 Missing #5): metrics.EditDistance,
+reader PipeReader/Fake/ComposeNotAligned, contrib memory_usage/model_stat/
+op_frequence/extend_optimizer/decoder."""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+class TestEditDistance(unittest.TestCase):
+    def test_accumulate(self):
+        m = pt.metrics.EditDistance("ed")
+        m.update(np.array([[0], [2], [0], [5]]), 4)
+        avg, wrong = m.eval()
+        self.assertAlmostEqual(avg, 7 / 4)
+        self.assertAlmostEqual(wrong, 2 / 4)
+        m.update(np.array([[1]]), 1)
+        avg, wrong = m.eval()
+        self.assertAlmostEqual(avg, 8 / 5)
+        self.assertAlmostEqual(wrong, 3 / 5)
+        m.reset()
+        with self.assertRaises(ValueError):
+            m.eval()
+
+    def test_type_checks(self):
+        m = pt.metrics.EditDistance("ed")
+        with self.assertRaises(ValueError):
+            m.update(np.array(["a"]), 1)
+        with self.assertRaises(ValueError):
+            m.update(np.array([[1.0]]), "x")
+
+
+class TestReaderTail(unittest.TestCase):
+    def test_pipe_reader_plain(self):
+        pr = pt.reader.PipeReader("printf a\\nbb\\nccc")
+        self.assertEqual(list(pr.get_line()), ["a", "bb", "ccc"])
+
+    def test_pipe_reader_type_checks(self):
+        with self.assertRaises(TypeError):
+            pt.reader.PipeReader(["ls"])
+        with self.assertRaises(TypeError):
+            pt.reader.PipeReader("ls", file_type="zip")
+
+    def test_fake(self):
+        def r():
+            for i in range(10):
+                yield i
+        fake = pt.reader.Fake()(r, 5)
+        self.assertEqual(list(fake()), [0] * 5)
+        self.assertEqual(list(fake()), [0] * 5)  # replays after reset
+
+    def test_compose_not_aligned(self):
+        def r3():
+            yield from [1, 2, 3]
+
+        def r2():
+            yield from [4, 5]
+
+        with self.assertRaises(pt.reader.ComposeNotAligned):
+            list(pt.reader.compose(r3, r2)())
+        # unaligned is fine when not checking
+        out = list(pt.reader.compose(r3, r2, check_alignment=False)())
+        self.assertEqual(len(out), 3)
+        # aligned passes the check
+        self.assertEqual(list(pt.reader.compose(r3, r3)()),
+                         [(1, 1), (2, 2), (3, 3)])
+
+
+def _conv_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = pt.layers.data("img", [3, 16, 16])
+        h = pt.layers.conv2d(img, 8, 3, act="relu")
+        h = pt.layers.pool2d(h, 2, "max", 2)
+        h = pt.layers.batch_norm(h)
+        out = pt.layers.fc(h, 10, act="softmax")
+    return main, startup, out
+
+
+class TestContribTools(unittest.TestCase):
+    def test_memory_usage(self):
+        main, _s, _o = _conv_program()
+        lo, hi, unit = pt.contrib.memory_usage(main, batch_size=32)
+        self.assertGreater(hi, lo)
+        self.assertGreater(lo, 0)
+        self.assertIn(unit, ("B", "KB", "MB"))
+        with self.assertRaises(TypeError):
+            pt.contrib.memory_usage("not a program", 32)
+        with self.assertRaises(ValueError):
+            pt.contrib.memory_usage(main, 0)
+
+    def test_op_freq_statistic(self):
+        main, _s, _o = _conv_program()
+        uni, adj = pt.contrib.op_freq_statistic(main)
+        self.assertIn("conv2d", uni)
+        self.assertTrue(any("->" in k for k in adj))
+        counts = list(uni.values())
+        self.assertEqual(counts, sorted(counts, reverse=True))
+
+    def test_model_stat_summary(self):
+        main, _s, _o = _conv_program()
+        rows, totals = pt.contrib.summary(main)
+        types = [r["type"] for r in rows]
+        self.assertIn("conv2d", types)
+        self.assertIn("mul", types)
+        self.assertGreater(totals["PARAMs"], 0)
+        self.assertGreater(totals["FLOPs"], 0)
+        conv = next(r for r in rows if r["type"] == "conv2d")
+        # 8 filters of 3x3x3 (bias rides a separate elementwise op here)
+        self.assertEqual(conv["PARAMs"], 8 * 3 * 3 * 3)
+
+
+class TestExtendOptimizer(unittest.TestCase):
+    def test_adamw_decays_vs_adam(self):
+        from paddle_tpu.contrib.extend_optimizer import (
+            extend_with_decoupled_weight_decay)
+        AdamW = extend_with_decoupled_weight_decay(pt.optimizer.Adam)
+
+        def train(optimizer, steps=5):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.layers.data("x", [4])
+                y = pt.layers.data("y", [1])
+                pred = pt.layers.fc(x, 1, bias_attr=False)
+                loss = pt.layers.mean(
+                    pt.layers.square_error_cost(pred, y))
+                optimizer.minimize(loss)
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                feed = {"x": np.zeros((4, 4), "f"),
+                        "y": np.zeros((4, 1), "f")}
+                for _ in range(steps):
+                    exe.run(main, feed=feed, fetch_list=[loss])
+                w = np.asarray(pt.global_scope().find_var("fc_0.w_0"))
+            return w
+
+        with pt.unique_name_guard():
+            w_adam = train(pt.optimizer.Adam(1e-3))
+        with pt.unique_name_guard():
+            w_adamw = train(AdamW(weight_decay=0.1, learning_rate=1e-3))
+        # zero-gradient data: Adam leaves weights, AdamW shrinks them
+        self.assertLess(np.abs(w_adamw).sum(), np.abs(w_adam).sum())
+
+    def test_apply_decay_param_fun(self):
+        from paddle_tpu.contrib.extend_optimizer import (
+            extend_with_decoupled_weight_decay)
+        SGDW = extend_with_decoupled_weight_decay(pt.optimizer.SGD)
+        with pt.unique_name_guard():
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.layers.data("x", [4])
+                h = pt.layers.fc(x, 4, bias_attr=False)
+                pred = pt.layers.fc(h, 1, bias_attr=False)
+                loss = pt.layers.mean(pred)
+                opt = SGDW(weight_decay=0.5, learning_rate=0.0,
+                           apply_decay_param_fun=lambda n: n == "fc_0.w_0")
+                opt.minimize(loss)
+            exe = pt.Executor()
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                w0_before = np.asarray(
+                    pt.global_scope().find_var("fc_0.w_0")).copy()
+                w1_before = np.asarray(
+                    pt.global_scope().find_var("fc_1.w_0")).copy()
+                exe.run(main, feed={"x": np.ones((2, 4), "f")},
+                        fetch_list=[loss])
+                w0 = np.asarray(pt.global_scope().find_var("fc_0.w_0"))
+                w1 = np.asarray(pt.global_scope().find_var("fc_1.w_0"))
+            np.testing.assert_allclose(w0, w0_before * 0.5, rtol=1e-5)
+            np.testing.assert_allclose(w1, w1_before, rtol=1e-6)
+
+    def test_rejects_non_optimizer(self):
+        from paddle_tpu.contrib.extend_optimizer import (
+            extend_with_decoupled_weight_decay)
+        with self.assertRaises(TypeError):
+            extend_with_decoupled_weight_decay(dict)
+
+
+class TestDecoder(unittest.TestCase):
+    V, D, H = 12, 8, 16
+
+    def _build_cell(self):
+        from paddle_tpu.contrib.decoder import InitState, StateCell
+        enc = pt.layers.data("enc", [self.H])
+        h_init = InitState(init=enc)
+        cell = StateCell(inputs={"x": None}, states={"h": h_init},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(cell_):
+            x = cell_.get_input("x")
+            prev = cell_.get_state("h")
+            # concat first: a single shared weight name must see ONE input
+            # width (same constraint as fluid's fc with named param_attr)
+            xin = pt.layers.concat([x, prev], axis=1)
+            h = pt.layers.fc(xin, self.H, act="tanh",
+                             param_attr=pt.ParamAttr(name="cell.fc.w"),
+                             bias_attr=pt.ParamAttr(name="cell.fc.b"))
+            cell_.set_state("h", h)
+        return cell, enc
+
+    def test_training_decoder_runs(self):
+        from paddle_tpu.contrib.decoder import TrainingDecoder
+        T = 5
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            cell, enc = self._build_cell()
+            trg = pt.layers.data("trg", [T], dtype="int64")
+            lens = pt.layers.data("lens", [], dtype="int64")
+            emb = pt.layers.embedding(trg, size=[self.V, self.D])
+            decoder = TrainingDecoder(cell)
+            with decoder.block():
+                word = decoder.step_input(emb, lengths=lens)
+                decoder.state_cell.compute_state(inputs={"x": word})
+                score = pt.layers.fc(decoder.state_cell.get_state("h"),
+                                     self.V, act="softmax")
+                decoder.state_cell.update_states()
+                decoder.output(score)
+            out = decoder()
+            label = pt.layers.data("label", [T], dtype="int64")
+            loss = pt.layers.mean(pt.layers.cross_entropy(
+                pt.layers.reshape(out, [-1, self.V]),
+                pt.layers.reshape(label, [-1, 1])))
+            pt.optimizer.Adam(1e-2).minimize(loss)
+
+        rng = np.random.RandomState(0)
+        B = 6
+        feed = {"enc": rng.rand(B, self.H).astype("float32"),
+                "trg": rng.randint(0, self.V, (B, T)).astype("int64"),
+                "lens": np.full(B, T, "int64"),
+                "label": rng.randint(0, self.V, (B, T)).astype("int64")}
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            losses = [float(np.asarray(exe.run(main, feed=feed,
+                                               fetch_list=[loss])[0])[0])
+                      for _ in range(20)]
+        self.assertLess(losses[-1], losses[0])
+
+    def test_beam_search_decoder(self):
+        from paddle_tpu.contrib.decoder import BeamSearchDecoder
+        T, K = 4, 3
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            cell, enc = self._build_cell()
+            init_ids = pt.layers.data("init_ids", [1], dtype="int64")
+            init_scores = pt.layers.data("init_scores", [1],
+                                         dtype="float32")
+            decoder = BeamSearchDecoder(
+                cell, init_ids, init_scores, target_dict_dim=self.V,
+                word_dim=self.D, max_len=T, beam_size=K, end_id=1,
+                sparse_emb=False)
+            decoder.decode()
+            ids, scores = decoder()
+
+        rng = np.random.RandomState(1)
+        B = 5
+        feed = {"enc": rng.rand(B, self.H).astype("float32"),
+                "init_ids": np.zeros((B, 1), "int64"),
+                "init_scores": np.zeros((B, 1), "float32")}
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            got_ids, got_scores = exe.run(main, feed=feed,
+                                          fetch_list=[ids, scores])
+        got_ids = np.asarray(got_ids)
+        got_scores = np.asarray(got_scores)
+        self.assertEqual(got_ids.shape, (B, K, T))
+        self.assertEqual(got_scores.shape, (B, K, T))
+        self.assertTrue((got_ids >= 0).all())
+        self.assertTrue((got_ids < self.V).all())
+        # beams are distinct hypotheses on at least one row
+        self.assertTrue(
+            any(len({tuple(got_ids[b, k]) for k in range(K)}) > 1
+                for b in range(B)))
+
+
+if __name__ == "__main__":
+    unittest.main()

