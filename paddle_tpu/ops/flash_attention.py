@@ -640,19 +640,23 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale, interpret):
     sk = k.shape[1]
     bb = None if bias is None else _bias_to_bn(bias, b, n, sk)
     call = _small_call if _small_ok(sq, sk) else _flash_call
-    o, lse = call(_to_bn(q), _to_bn(k), _to_bn(v), bb,
-                  causal, sm_scale, interpret)
-    return _from_bn(o, b, n), (q, k, v, bias, o, lse)
+    q_bn, k_bn, v_bn = _to_bn(q), _to_bn(k), _to_bn(v)
+    o, lse = call(q_bn, k_bn, v_bn, bb, causal, sm_scale, interpret)
+    # residuals stay in the KERNEL's (b*n, s, d) layout: the backward
+    # otherwise re-relayouts q/k/v from (b,s,n,d) — 3 of the ~6
+    # full-tensor copies the r3 grid blamed for the s=128 loss
+    # (BASELINE.md r3; VERDICT r3 item 6)
+    return _from_bn(o, b, n), (q_bn, k_bn, v_bn, bias, o, lse, b, n)
 
 
 def _flash_bwd(causal, sm_scale, interpret, res, g):
-    q, k, v, bias, o_bn, lse = res
-    b, sq, n, d = q.shape
-    sk = k.shape[1]
+    q_bn, k_bn, v_bn, bias, o_bn, lse, b, n = res
+    bn, sq, d = q_bn.shape
+    sk = k_bn.shape[1]
     bb = None if bias is None else _bias_to_bn(bias, b, n, sk)
     bwd = _small_bwd_call if _small_ok(sq, sk) else _flash_bwd_call
     dq, dk, dv, db_bn = bwd(
-        _to_bn(q), _to_bn(k), _to_bn(v), bb, o_bn, lse, _to_bn(g),
+        q_bn, k_bn, v_bn, bb, o_bn, lse, _to_bn(g),
         causal, sm_scale, interpret)
     db = None
     if bias is not None:
