@@ -1,5 +1,6 @@
 """Distributed launch utilities (reference: python/paddle/distributed/)."""
 from . import elastic  # noqa: F401
-from .elastic import ElasticController, ElasticAgent  # noqa: F401
+from .elastic import (ElasticController, ElasticAgent,  # noqa: F401
+                      SyncElasticTrainer)
 from . import communicator  # noqa: F401
 from .communicator import Communicator  # noqa: F401

@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["ElasticController", "ElasticAgent"]
+__all__ = ["ElasticController", "ElasticAgent", "SyncElasticTrainer"]
 
 
 class ElasticController:
@@ -197,3 +197,71 @@ class ElasticAgent:
                 self._rpc(f"leave\t{self._id}")
             except (RuntimeError, OSError):
                 pass
+
+
+class SyncElasticTrainer:
+    """Checkpoint-restart-on-resize for SYNC data-parallel training — the
+    standard TPU answer to membership change (a sync collective world
+    cannot be resized mid-round; the program must recompile for the new
+    mesh, and XLA recompilation is exactly a restart).
+
+    build_fn(world_size) -> (target, main, startup, fetch_vars): target is
+    the CompiledProgram (or plain Program) sized to `world_size`; main the
+    raw Program (for persistable listing); fetch_vars what step() returns.
+    world_fn() -> (version, size): e.g. ElasticAgent.world()[:2] or a test
+    stub. On a version change the trainer: (1) saves persistables
+    (atomic, io.py writer), (2) rebuilds via build_fn under a fresh
+    unique_name guard so var names line up, (3) runs the new startup,
+    (4) reloads the checkpoint — training state survives the resize
+    exactly; only the sharding layout changes.
+    """
+
+    def __init__(self, build_fn, world_fn, ckpt_dir, executor=None,
+                 scope=None):
+        from ..framework.executor import Executor, Scope
+        self._build = build_fn
+        self._world = world_fn
+        self._ckpt = ckpt_dir
+        self._exe = executor or Executor()
+        self._scope = scope if scope is not None else Scope()
+        self._version = None
+        self.world_size = None
+        self.resizes = 0
+        self._target = self._main = self._fetches = None
+
+    def _rebuild(self, version, size):
+        from .. import io
+        from ..framework.core import unique_name_guard
+        from ..framework.executor import scope_guard
+
+        import os
+
+        first = self._version is None
+        with scope_guard(self._scope):
+            if not first:
+                io.save_persistables(self._exe, self._ckpt, self._main,
+                                     sync=True)
+            with unique_name_guard():
+                self._target, self._main, startup, self._fetches = \
+                    self._build(size)
+            self._exe.run(startup)
+            # a FRESH worker joining an elastic world must also load: the
+            # survivors' checkpoint is the truth, not its startup init
+            # (otherwise sync gradient averaging mixes random weights in)
+            has_ckpt = os.path.isdir(self._ckpt) and os.listdir(self._ckpt)
+            if not first or has_ckpt:
+                io.load_persistables(self._exe, self._ckpt, self._main)
+            if not first:
+                self.resizes += 1
+        self._version = version
+        self.world_size = size
+
+    def step(self, feed):
+        """One training step; transparently restarts on a world change."""
+        from ..framework.executor import scope_guard
+        version, size = self._world()
+        if version != self._version:
+            self._rebuild(version, size)
+        with scope_guard(self._scope):
+            return self._exe.run(self._target, feed=feed,
+                                 fetch_list=self._fetches)

@@ -180,7 +180,7 @@ class TestImperativeResnet(unittest.TestCase):
                 logits = net(x)
                 l = dygraph.nn.reduce_mean(
                     dygraph.nn.softmax_with_cross_entropy(logits, y))
-                eager_losses.append(float(l.numpy()))
+                eager_losses.append(float(np.ravel(l.numpy())[0]))
                 l.backward()
                 opt.minimize(l, parameter_list=net.parameters())
                 net.clear_gradients()

@@ -1,0 +1,112 @@
+"""Sync-mode elastic resize: checkpoint-restart-on-resize
+(distributed.SyncElasticTrainer — the r3 'sync elastic is one sentence'
+gap). World shrinks dp4 -> dp2 mid-training on the virtual CPU mesh; the
+training state must survive the restart exactly."""
+
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import SyncElasticTrainer
+
+
+def _build(world_size):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [8])
+        y = pt.layers.data("y", [1])
+        h = pt.layers.fc(x, 16, act="relu")
+        pred = pt.layers.fc(h, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(0.05).minimize(loss)
+    target = pt.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=world_size)
+    return target, main, startup, [loss]
+
+
+class TestSyncElastic(unittest.TestCase):
+    def test_resize_preserves_training_state(self):
+        rng = np.random.RandomState(0)
+        xs = rng.rand(40, 8, 8).astype("float32")
+        w_true = rng.rand(8, 1).astype("float32")
+        ys = np.einsum("bij,jk->bik", xs, w_true).astype("float32")
+
+        world = {"version": 1, "size": 4}
+        with tempfile.TemporaryDirectory() as d:
+            trainer = SyncElasticTrainer(
+                _build, lambda: (world["version"], world["size"]), d)
+            losses = []
+            for t in range(40):
+                if t == 20:  # two trainers leave: dp4 -> dp2
+                    world.update(version=2, size=2)
+                l, = trainer.step({"x": xs[t], "y": ys[t]})
+                losses.append(float(np.ravel(l)[0]))
+
+        self.assertEqual(trainer.resizes, 1)
+        self.assertEqual(trainer.world_size, 2)
+        # the restart must not regress the fit: loss right after the
+        # resize stays at the pre-resize level (state reloaded), and
+        # training keeps converging
+        pre = np.mean(losses[17:20])
+        post = np.mean(losses[20:23])
+        self.assertLess(post, pre * 3 + 1e-3,
+                        f"resize lost training state: {pre} -> {post}")
+        self.assertLess(losses[-1], losses[0] * 0.1)
+
+    def test_fresh_joiner_loads_existing_checkpoint(self):
+        """A NEW worker joining an elastic world must adopt the survivors'
+        checkpoint, not its own startup init."""
+        rng = np.random.RandomState(1)
+        xs = rng.rand(10, 8, 8).astype("float32")
+        ys = np.zeros((10, 8, 1), "float32")
+        with tempfile.TemporaryDirectory() as d:
+            t1 = SyncElasticTrainer(_build, lambda: (1, 2), d)
+            for t in range(10):
+                t1.step({"x": xs[t], "y": ys[t]})
+            # survivors checkpoint (what step() does before a resize)
+            from paddle_tpu.framework.executor import scope_guard
+            with scope_guard(t1._scope):
+                pt.io.save_persistables(t1._exe, d, t1._main, sync=True)
+                w_trained = np.asarray(
+                    t1._scope.find_var("fc_0.w_0")).copy()
+
+            t2 = SyncElasticTrainer(_build, lambda: (5, 2), d)
+            t2.step({"x": xs[0], "y": ys[0]})  # first build w/ existing ckpt
+            with scope_guard(t2._scope):
+                w_joined = np.asarray(t2._scope.find_var("fc_0.w_0"))
+        # the joiner's weights came from the checkpoint (then one SGD step
+        # moved them slightly) — nowhere near a fresh random init
+        self.assertLess(np.abs(w_joined - w_trained).max(), 0.05)
+
+    def test_world_change_detection_via_agent_protocol(self):
+        """The TCP controller/agent pair drives the same resize."""
+        from paddle_tpu.distributed import ElasticAgent, ElasticController
+        ctl = ElasticController(heartbeat_timeout=2.0)
+        try:
+            a1 = ElasticAgent("127.0.0.1", ctl.port, "t1",
+                              beat_interval=0.2)
+            a1.start()
+            a2 = ElasticAgent("127.0.0.1", ctl.port, "t2",
+                              beat_interval=0.2)
+            a2.start()
+            v1, s1, _ = a1.world()
+            self.assertEqual(s1, 2)
+            a2.stop(leave=True)
+            import time
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                v2, s2, _ = a1.world()
+                if s2 == 1:
+                    break
+                time.sleep(0.1)
+            self.assertEqual(s2, 1)
+            self.assertNotEqual(v1, v2)
+            a1.stop(leave=True)
+        finally:
+            ctl.close()
+
+
+if __name__ == "__main__":
+    unittest.main()

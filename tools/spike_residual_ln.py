@@ -182,8 +182,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    if "axon" in str(jax.config.jax_platforms or ""):
-        pass  # run on the TPU
+    if jax.default_backend() != "tpu":
+        print("WARNING: not on TPU — numbers below are not the spike's "
+              "accept/reject evidence", file=sys.stderr)
 
     shapes = [(128 * 128, 768), (64 * 128, 768), (256 * 512, 768),
               (128 * 128, 1024)]
