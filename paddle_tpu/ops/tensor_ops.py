@@ -414,7 +414,11 @@ def _range(ctx, ins, attrs):
 
 @register_op("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    """reference: increment_op.cc — step keeps X's dtype (a python-float
+    step must not promote an int64 loop counter to float32, which would
+    re-type a While carry mid-loop)."""
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
 
 
 # ---------------------------------------------------------------------------

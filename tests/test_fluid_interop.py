@@ -397,6 +397,63 @@ class TestInferenceModelFluid(unittest.TestCase):
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
                                    atol=1e-6)
 
+    def test_control_flow_subblocks_roundtrip(self):
+        """Multi-block programs (cond sub-blocks -> BLOCK attrs) survive
+        the fluid wire format and execute identically."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4])
+            flag = pt.layers.data("flag", [], dtype="bool")
+            out = pt.layers.cond(flag,
+                                 lambda: pt.layers.scale(x, scale=2.0),
+                                 lambda: pt.layers.scale(x, scale=-1.0))
+        back = fi.program_from_fluid_bytes(fi.program_to_fluid_bytes(main))
+        self.assertEqual(len(back.blocks), len(main.blocks))
+        cond_op = next(o for o in back.global_block.ops
+                       if "sub_block_t" in o.attrs)
+        self.assertIsInstance(cond_op.attrs["sub_block_t"], int)
+        exe = pt.Executor()
+        xv = np.ones((2, 4), "f")
+        for flag_v, want in ((True, 2.0), (False, -1.0)):
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                r1, = exe.run(main, feed={"x": xv,
+                                          "flag": np.array(flag_v)},
+                              fetch_list=[out])
+            with pt.scope_guard(pt.Scope()):
+                out2 = back.global_block.var(out.name)
+                r2, = exe.run(back, feed={"x": xv,
+                                          "flag": np.array(flag_v)},
+                              fetch_list=[out2])
+            np.testing.assert_allclose(np.asarray(r1), np.asarray(r2))
+            np.testing.assert_allclose(np.asarray(r2),
+                                       np.full((2, 4), want))
+
+    def test_while_subblock_roundtrip(self):
+        """While loops (sub_block BLOCK attr + loop-carried vars) survive
+        the fluid wire format and execute identically."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = pt.layers.fill_constant([1], "int64", 0)
+            limit = pt.layers.fill_constant([1], "int64", 5)
+            acc = pt.layers.fill_constant([1], "float32", 0.0)
+            loop_cond = pt.layers.less_than(i, limit)
+            w = pt.layers.While(loop_cond)
+            with w.block():
+                pt.layers.assign(acc + 2.0, output=acc)
+                pt.layers.increment(i)
+                pt.layers.assign(pt.layers.less_than(i, limit),
+                                 output=loop_cond)
+        back = fi.program_from_fluid_bytes(fi.program_to_fluid_bytes(main))
+        self.assertEqual(len(back.blocks), len(main.blocks))
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            r1, = exe.run(main, fetch_list=[acc])
+        with pt.scope_guard(pt.Scope()):
+            r2, = exe.run(back, fetch_list=[back.global_block.var(acc.name)])
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r2))
+        np.testing.assert_allclose(np.asarray(r2), [10.0])
+
     def test_native_format_still_roundtrips(self):
         main, startup, out = _toy_inference_program()
         exe = pt.Executor()
