@@ -74,6 +74,50 @@ class TestLodConversions(unittest.TestCase):
         np.testing.assert_array_equal(padded[1][:2], [4, 5])
 
 
+class TestThreeLevelLod(unittest.TestCase):
+    def test_three_level_conversions(self):
+        """Arbitrary depth: corpus→doc→sentence→word (3 LoD levels)."""
+        lens = [[2, 1], [2, 1, 2], [3, 1, 2, 2, 1]]
+        lod = lt.convert_to_offset_based(lens)
+        self.assertEqual([list(o) for o in lod],
+                         [[0, 2, 3], [0, 2, 3, 5], [0, 3, 4, 6, 8, 9]])
+        self.assertEqual(lt.convert_to_length_based(lod), lens)
+        abs_lod = lt.to_abs_offsets(lod)
+        # corpus 0 = docs 0-1 = sents 0-2 = words 0-6; corpus 1 = rest
+        self.assertEqual(abs_lod[0].tolist(), [0, 6, 9])
+        self.assertEqual(abs_lod[1].tolist(), [0, 4, 6, 9])
+        vals = np.arange(9)
+        v, got_lod = pt.create_lod_tensor(vals, lens, None)
+        self.assertEqual(len(got_lod), 3)
+        # pad whole corpora as flat word runs via level 0 abs offsets
+        padded, plens = lt.lod_to_padded(vals, lod, level=0)
+        self.assertEqual(plens.tolist(), [6, 3])
+        np.testing.assert_array_equal(padded[0], np.arange(6))
+
+    def test_three_level_graph_pooling(self):
+        """x [b, s1, s2, s3, d] pools at the deepest level with Length
+        [b, s1, s2] — the same rank-driven rule, one level deeper."""
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 2, 3, 4, 5).astype("float32")
+        ln = rng.randint(0, 5, (2, 2, 3)).astype("int64")
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            xv = pt.layers.data("x", [2, 3, 4, 5])
+            lv = pt.layers.data("ln", [2, 3], dtype="int64")
+            out = pt.layers.sequence_pool(xv, "sum", lengths=lv)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            r, = exe.run(main, feed={"x": x, "ln": ln}, fetch_list=[out])
+        got = np.asarray(r)
+        want = np.zeros((2, 2, 3, 5), "float32")
+        for i in range(2):
+            for j in range(2):
+                for k in range(3):
+                    want[i, j, k] = x[i, j, k, :ln[i, j, k]].sum(0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
 class TestNestedSequenceOps(unittest.TestCase):
     """Ops at LoD level 1 (inner): x [b, s1, s2, d] + Length [b, s1]."""
 
