@@ -11,7 +11,10 @@ from typing import Optional
 
 from .core import Parameter, Program
 
-__all__ = ["program_to_code", "draw_program_graphviz"]
+__all__ = ["program_to_code", "draw_program_graphviz",
+           "get_indent_space", "variable_to_code", "op_to_code",
+           "block_to_code", "pprint_program_codes",
+           "pprint_block_codes", "draw_block_graphviz"]
 
 
 def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
@@ -84,3 +87,100 @@ def draw_program_graphviz(program: Program,
         with open(path, "w") as f:
             f.write(dot)
     return dot
+
+
+# -- reference program_utils.py / debugger.py name aliases ------------------
+
+def get_indent_space(indent: int, space_num: int = 4) -> str:
+    """reference: transpiler/details/program_utils.py get_indent_space."""
+    return " " * indent * space_num
+
+
+def variable_to_code(var) -> str:
+    """reference: program_utils.py variable_to_code."""
+    shape = list(var.shape) if var.shape is not None else "?"
+    return (f"{var.name} : paddle_tpu.{var.type}.shape{shape}"
+            f".dtype({var.dtype})"
+            + (".persistable" if var.persistable else ""))
+
+
+def op_to_code(op, skip_op_callstack: bool = True) -> str:
+    """reference: program_utils.py op_to_code."""
+    outs = ", ".join(f"{slot}={names}"
+                     for slot, names in sorted(op.outputs.items()))
+    ins = ", ".join(f"{slot}={names}"
+                    for slot, names in sorted(op.inputs.items()))
+    attrs = ", ".join(f"{k}={v!r}" for k, v in sorted(op.attrs.items())
+                      if k != "op_role")
+    text = f"{{{outs}}} = {op.type}(inputs={{{ins}}}"
+    if attrs:
+        text += f", {attrs}"
+    return text + ")"
+
+
+def block_to_code(block, block_idx: int, fout=None,
+                  skip_op_callstack: bool = True) -> None:
+    """reference: program_utils.py block_to_code — print one block."""
+    import sys
+    fout = fout or sys.stdout
+    print(f"{{ // block {block_idx}, parent {block.parent_idx}", file=fout)
+    for var in block.vars.values():
+        print(get_indent_space(1) + "var " + variable_to_code(var),
+              file=fout)
+    for op in block.ops:
+        print(get_indent_space(1) + op_to_code(op), file=fout)
+    print("}", file=fout)
+
+
+def pprint_program_codes(program) -> None:
+    """reference: fluid/debugger.py pprint_program_codes."""
+    for i, block in enumerate(program.blocks):
+        block_to_code(block, i)
+
+
+def pprint_block_codes(block, fout=None) -> None:
+    """reference: fluid/debugger.py pprint_block_codes — one block, the
+    fluid signature (index read off the block itself)."""
+    block_to_code(block, block.idx, fout)
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
+    """reference: fluid/debugger.py draw_block_graphviz — write THIS
+    block's dataflow as graphviz dot; highlighted var names fill orange.
+    Returns `path` (the fluid contract; use draw_program_graphviz for the
+    dot text of block 0)."""
+    highlights = set(highlights or ())
+
+    def q(s):
+        return '"' + str(s).replace('"', r"\"") + '"'
+
+    lines = ["digraph G {", "  rankdir=TB;",
+             '  node [fontsize=10, fontname="Courier"];']
+    for name, var in block.vars.items():
+        color = "orange" if name in highlights else "lightblue"
+        shape = list(var.shape) if var.shape is not None else "?"
+        lines.append(
+            f"  {q(name)} [shape=ellipse, style=filled, "
+            f"fillcolor=\"{color}\", "
+            f"label={q(f'{name} {shape} {var.dtype}')}];")
+    emitted = set(block.vars)
+    for i, op in enumerate(block.ops):
+        op_id = q(f"op_{i}_{op.type}")
+        lines.append(f"  {op_id} [shape=box, style=filled, "
+                     f"fillcolor=gray90, label={q(op.type)}];")
+        for n in op.input_names() + op.output_names():
+            if n and n not in emitted:  # outer-block reads in sub-blocks
+                emitted.add(n)
+                color = "orange" if n in highlights else "white"
+                lines.append(f"  {q(n)} [shape=ellipse, style=filled, "
+                             f"fillcolor=\"{color}\", label={q(n)}];")
+        for n in op.input_names():
+            if n:
+                lines.append(f"  {q(n)} -> {op_id};")
+        for n in op.output_names():
+            if n:
+                lines.append(f"  {op_id} -> {q(n)};")
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
