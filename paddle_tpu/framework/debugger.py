@@ -18,8 +18,11 @@ __all__ = ["program_to_code", "draw_program_graphviz",
 
 
 def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
-    """Readable text form of every block (reference debugger.py
-    pprint_program_codes)."""
+    """Readable text form of every block — the COMPACT kind-annotated
+    format ("param x: ..."). The fluid-styled pseudo-assembly printers
+    (block_to_code/op_to_code/variable_to_code below) are the reference
+    program_utils.py format; the two formats are intentionally distinct,
+    both pinned by tests."""
     lines = []
     for blk in program.blocks:
         lines.append(f"// block {blk.idx} (parent {blk.parent_idx})")
@@ -41,13 +44,11 @@ def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
     return "\n".join(lines)
 
 
-def draw_program_graphviz(program: Program,
-                          path: Optional[str] = None) -> str:
-    """Graphviz dot source for block 0's dataflow (the graph_viz_pass
-    analog). Ops are boxes, vars are ellipses (params shaded); returns the
-    dot text and optionally writes it to `path` for
-    `dot -Tpdf program.dot -o program.pdf`."""
-    blk = program.global_block
+def _block_dot(blk, highlights=()) -> str:
+    """Shared dot emitter for ONE block's dataflow: ops are role-colored
+    boxes, vars are ellipses shaded by kind (param/persistable), with
+    `highlights` overriding to orange."""
+    highlights = set(highlights)
     out = ["digraph Program {", "  rankdir=TB;",
            '  node [fontsize=10, fontname="Courier"];']
     seen_vars = set()
@@ -57,15 +58,18 @@ def draw_program_graphviz(program: Program,
             ".", "_")
         if name not in seen_vars:
             seen_vars.add(name)
-            style = ""
+            fill = None
             try:
                 v = blk.var(name)
                 if isinstance(v, Parameter):
-                    style = ', style=filled, fillcolor="lightblue"'
+                    fill = "lightblue"
                 elif v.persistable:
-                    style = ', style=filled, fillcolor="lightgrey"'
+                    fill = "lightgrey"
             except KeyError:
                 pass
+            if name in highlights:
+                fill = "orange"
+            style = f', style=filled, fillcolor="{fill}"' if fill else ""
             out.append(f'  {nid} [label="{name}", shape=ellipse{style}];')
         return nid
 
@@ -82,7 +86,16 @@ def draw_program_graphviz(program: Program,
             if n:
                 out.append(f"  {op_id} -> {var_node(n)};")
     out.append("}")
-    dot = "\n".join(out)
+    return "\n".join(out)
+
+
+def draw_program_graphviz(program: Program,
+                          path: Optional[str] = None) -> str:
+    """Graphviz dot source for block 0's dataflow (the graph_viz_pass
+    analog). Ops are boxes, vars are ellipses (params shaded); returns the
+    dot text and optionally writes it to `path` for
+    `dot -Tpdf program.dot -o program.pdf`."""
+    dot = _block_dot(program.global_block)
     if path:
         with open(path, "w") as f:
             f.write(dot)
@@ -146,41 +159,9 @@ def pprint_block_codes(block, fout=None) -> None:
 
 def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
     """reference: fluid/debugger.py draw_block_graphviz — write THIS
-    block's dataflow as graphviz dot; highlighted var names fill orange.
-    Returns `path` (the fluid contract; use draw_program_graphviz for the
-    dot text of block 0)."""
-    highlights = set(highlights or ())
-
-    def q(s):
-        return '"' + str(s).replace('"', r"\"") + '"'
-
-    lines = ["digraph G {", "  rankdir=TB;",
-             '  node [fontsize=10, fontname="Courier"];']
-    for name, var in block.vars.items():
-        color = "orange" if name in highlights else "lightblue"
-        shape = list(var.shape) if var.shape is not None else "?"
-        lines.append(
-            f"  {q(name)} [shape=ellipse, style=filled, "
-            f"fillcolor=\"{color}\", "
-            f"label={q(f'{name} {shape} {var.dtype}')}];")
-    emitted = set(block.vars)
-    for i, op in enumerate(block.ops):
-        op_id = q(f"op_{i}_{op.type}")
-        lines.append(f"  {op_id} [shape=box, style=filled, "
-                     f"fillcolor=gray90, label={q(op.type)}];")
-        for n in op.input_names() + op.output_names():
-            if n and n not in emitted:  # outer-block reads in sub-blocks
-                emitted.add(n)
-                color = "orange" if n in highlights else "white"
-                lines.append(f"  {q(n)} [shape=ellipse, style=filled, "
-                             f"fillcolor=\"{color}\", label={q(n)}];")
-        for n in op.input_names():
-            if n:
-                lines.append(f"  {q(n)} -> {op_id};")
-        for n in op.output_names():
-            if n:
-                lines.append(f"  {op_id} -> {q(n)};")
-    lines.append("}")
+    block's dataflow (sub-blocks included) as graphviz dot; highlighted
+    var names fill orange. Returns `path` (the fluid contract; use
+    draw_program_graphviz for block 0's dot text)."""
     with open(path, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write(_block_dot(block, highlights or ()) + "\n")
     return path

@@ -9,7 +9,8 @@ from ..framework.layer_helper import LayerHelper
 # the fluid API exports a `range` LAYER below; keep the builtin reachable
 _builtin_range = range
 
-__all__ = ["diag", "eye", "linspace", "range", "reverse", "sign",
+__all__ = ["load",
+           "diag", "eye", "linspace", "range", "reverse", "sign",
            "has_inf", "has_nan", "isfinite", "shard_index", "size",
            "create_array", "array_write", "array_read", "array_length",
            "tensor_array_to_tensor",
@@ -617,3 +618,15 @@ def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False,
                      {"axis": int(axis), "use_stack": bool(use_stack)},
                      infer_shape=False)
     return out, idx
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference: layers/io.py load — load op writing a saved tensor into
+    `out` at executor host-op time (io_dist_ops.py load)."""
+    helper = LayerHelper("load")
+    helper.append_op("load", {}, {"Out": [out.name]},
+                     {"file_path": file_path,
+                      **({"load_as_fp16": bool(load_as_fp16)}
+                         if load_as_fp16 is not None else {})},
+                     infer_shape=False)
+    return out

@@ -73,9 +73,14 @@ def _load(op, scope, feed):
         path = path + ".npy"
     arr = np.load(path, allow_pickle=False)
     name = op.output("Out")[0]
-    var = op.block.vars.get(name)
-    if var is not None and var.dtype and str(arr.dtype) != var.dtype:
-        arr = arr.astype(var.dtype)  # fp16-saved params upcast on load
+    if op.attrs.get("load_as_fp16"):
+        # reference load_op.cc: cast the loaded tensor to fp16 regardless
+        # of the var's declared dtype
+        arr = arr.astype(np.float16)
+    else:
+        var = op.block.vars.get(name)
+        if var is not None and var.dtype and str(arr.dtype) != var.dtype:
+            arr = arr.astype(var.dtype)  # fp16-saved params upcast on load
     scope.set_var(name, jnp.asarray(arr))
 
 

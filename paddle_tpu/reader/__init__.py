@@ -4,12 +4,14 @@ from .decorator import (map_readers, buffered, compose, chain, shuffle,  # noqa:
                         firstn, xmap_readers, cache, batch,
                         multiprocess_reader, ComposeNotAligned,
                         PipeReader, Fake)
-from .py_reader import PyReader  # noqa: F401
+from .py_reader import (PyReader, create_py_reader_by_data,  # noqa: F401
+                        read_file, double_buffer)
 from .bucketing import (pow2_boundaries, bucket_for, pad_to_bucket,  # noqa: F401
                         bucketed)
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "batch",
            "multiprocess_reader", "ComposeNotAligned", "PipeReader",
-           "Fake", "PyReader", "pow2_boundaries",
+           "Fake", "PyReader", "create_py_reader_by_data", "read_file",
+           "double_buffer", "pow2_boundaries",
            "bucket_for", "pad_to_bucket", "bucketed"]

@@ -18,7 +18,8 @@ import numpy as np
 
 from ..data_feeder import DataFeeder
 
-__all__ = ["PyReader"]
+__all__ = ["PyReader", "create_py_reader_by_data", "read_file",
+           "double_buffer"]
 
 
 class PyReader:
@@ -192,3 +193,25 @@ class PyReader:
 
     # (iterable mode: start/reset defined above are no-ops only when
     # iterable=True — handled inside those methods)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference: layers/io.py create_py_reader_by_data — a non-iterable
+    PyReader over the given feed vars (the program-embedded reader form;
+    double buffering is the C++ datafeed channel's job here)."""
+    return PyReader(feed_list, capacity=capacity, iterable=False)
+
+
+def read_file(reader):
+    """reference: layers/io.py read_file — the data variables a program
+    reader fills each step. For our PyReader those are the feed vars it
+    was built over (the non-iterable form already appended the read ops)."""
+    return list(reader._feeder.feed_vars)
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference: layers/io.py double_buffer — identity here: the native
+    datafeed channel and the PyReader queue already overlap host fill with
+    device compute (buffered_reader.cc's job)."""
+    return reader
