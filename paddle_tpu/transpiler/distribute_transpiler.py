@@ -349,6 +349,10 @@ class PSPlan:
                 ids = np.concatenate([ids, np.repeat(ids[:1], pad)])
                 rows = np.concatenate([rows, np.repeat(rows[:1], pad,
                                                        axis=0)])
+            # telemetry: the widths the scatter ACTUALLY compiled for
+            # (tests assert these collapse to few buckets)
+            self.scatter_widths = getattr(self, "scatter_widths", [])
+            self.scatter_widths.append(len(ids))
             w = scope.find_var(s.name)
             scope.set_var(s.name, w.at[jnp.asarray(ids)].set(
                 jnp.asarray(rows, dtype=w.dtype)))
