@@ -5,6 +5,8 @@ vs_baseline is measured MFU / 0.45 (the BASELINE.md north-star
 target); current headline ~52% MFU (see BASELINE.md r3).
 Peak flops default to v5e bf16 (197 TFLOP/s); override with PEAK_TFLOPS.
 
+BENCH_MODEL=gpt2 switches to the GPT-2-small causal-LM benchmark
+(tools/bench_gpt.py; same keys, vs_baseline shares the 0.45 north-star).
 BENCH_MODEL=resnet50 switches to the ResNet-50 train benchmark
 (tools/bench_resnet50.py): same keys, plus "vs_jax_probe" giving the
 ratio to the measured raw-JAX ceiling on this chip (~30% MFU — see
@@ -21,11 +23,15 @@ import numpy as np
 
 
 def main():
-    if os.environ.get("BENCH_MODEL", "bert") == "resnet50":
+    model = os.environ.get("BENCH_MODEL", "bert")
+    if model in ("resnet50", "gpt2"):
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
-        import bench_resnet50
-        return bench_resnet50.main()
+        if model == "resnet50":
+            import bench_resnet50
+            return bench_resnet50.main()
+        import bench_gpt
+        return bench_gpt.main()
     import paddle_tpu as pt
     from paddle_tpu.models.bert import (BertConfig, bert_pretrain_program,
                                         flops_per_step)

@@ -117,6 +117,10 @@ def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
     program can be pipelined with PipelineOptimizer — the encoder layers
     form the uniform stage run."""
     seq = int(src_ids.shape[1])
+    if seq > cfg.max_pos:
+        raise ValueError(
+            f"sequence length {seq} exceeds max_pos {cfg.max_pos}; the "
+            "position table would silently clip (raise max_pos)")
 
     word_emb = pt.layers.embedding(
         src_ids, size=[cfg.vocab_size, cfg.hidden],

@@ -1,0 +1,63 @@
+"""GPT-2-small causal-LM train-step MFU on one chip (the decoder-only
+flagship; BENCH_MODEL=gpt2 from bench.py). Same discipline as the BERT
+bench: device-resident feed, async-chained steps, one sync."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import (GPTConfig, flops_per_step,
+                                       gpt_lm_program)
+
+    seq = int(os.environ.get("BENCH_SEQ", 512))
+    batch = int(os.environ.get("BENCH_BATCH", 16))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
+    peak = float(os.environ.get("PEAK_TFLOPS", 197.0)) * 1e12
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    cfg = GPTConfig(max_pos=max(1024, seq),
+                    attn_impl=os.environ.get("BENCH_ATTN", "fused"))
+
+    main_prog, startup, fetches = gpt_lm_program(
+        cfg, seq, learning_rate=1e-4, amp=amp)
+
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    feed = {"tokens": jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))}
+
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        loss_var = fetches["loss"]
+        l, = exe.run(main_prog, feed=feed, fetch_list=[loss_var])
+        assert np.isfinite(l).all(), f"non-finite loss {l}"
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(steps):
+            last = exe.run(main_prog, feed=feed, fetch_list=[loss_var],
+                           return_numpy=False)[0]
+        last.block_until_ready()
+        dt = (time.perf_counter() - t0) / steps
+        assert np.isfinite(np.asarray(last)).all()
+
+    fl = flops_per_step(cfg, batch, seq)
+    mfu = fl / dt / peak
+    print(json.dumps({
+        "metric": "gpt2_small_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "MFU (batch=%d seq=%d, %.1f samples/s, %.1f ms/step)"
+                % (batch, seq, batch / dt, dt * 1e3),
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
