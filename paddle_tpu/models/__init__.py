@@ -4,3 +4,5 @@ from . import resnet  # noqa: F401
 from . import vgg  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import transformer  # noqa: F401
+from . import bert  # noqa: F401
+from . import gpt  # noqa: F401

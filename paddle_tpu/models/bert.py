@@ -14,7 +14,6 @@ import math
 
 import paddle_tpu as pt
 from paddle_tpu.framework.layer_helper import ParamAttr
-from paddle_tpu.initializer import Normal, Constant
 
 __all__ = ["BertConfig", "bert_encoder", "bert_pretrain_program",
            "tp_shardings"]
@@ -42,8 +41,7 @@ class BertConfig:
         self.seq_parallel = seq_parallel  # "ring" | "ulysses"
 
 
-def _attr(name, cfg):
-    return ParamAttr(name=name, initializer=Normal(0.0, cfg.init_range))
+from ._common import attr as _attr  # noqa: E402  (shared with gpt.py)
 
 
 def _attention(x, mask_4d, mask_k, cfg: BertConfig, prefix: str,
@@ -90,21 +88,14 @@ def _attention(x, mask_4d, mask_k, cfg: BertConfig, prefix: str,
     return out
 
 
+from ._common import ffn as _shared_ffn  # noqa: E402
+
+
 def _ffn(x, cfg: BertConfig, prefix: str):
-    h1 = pt.layers.fc(x, cfg.ffn, num_flatten_dims=2, act="gelu",
-                      param_attr=_attr(f"{prefix}/ffn1.w", cfg),
-                      bias_attr=ParamAttr(name=f"{prefix}/ffn1.b"))
-    return pt.layers.fc(h1, cfg.hidden, num_flatten_dims=2,
-                        param_attr=_attr(f"{prefix}/ffn2.w", cfg),
-                        bias_attr=ParamAttr(name=f"{prefix}/ffn2.b"))
+    return _shared_ffn(x, cfg, prefix, names=("ffn1", "ffn2"))
 
 
-def _ln(x, name):
-    return pt.layers.layer_norm(
-        x, begin_norm_axis=2,
-        param_attr=ParamAttr(name=f"{name}.scale",
-                             initializer=Constant(1.0)),
-        bias_attr=ParamAttr(name=f"{name}.bias"))
+from ._common import layer_norm as _ln  # noqa: E402
 
 
 def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
@@ -117,10 +108,8 @@ def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
     program can be pipelined with PipelineOptimizer — the encoder layers
     form the uniform stage run."""
     seq = int(src_ids.shape[1])
-    if seq > cfg.max_pos:
-        raise ValueError(
-            f"sequence length {seq} exceeds max_pos {cfg.max_pos}; the "
-            "position table would silently clip (raise max_pos)")
+    from ._common import check_max_pos
+    check_max_pos(seq, cfg)
 
     word_emb = pt.layers.embedding(
         src_ids, size=[cfg.vocab_size, cfg.hidden],

@@ -15,7 +15,8 @@ import math
 
 import paddle_tpu as pt
 from ..framework.layer_helper import ParamAttr
-from ..initializer import Constant, Normal
+from ._common import attr as _attr, check_max_pos, ffn as _shared_ffn, \
+    layer_norm as _ln
 
 __all__ = ["GPTConfig", "gpt_lm_program", "flops_per_step", "tp_shardings"]
 
@@ -35,18 +36,6 @@ class GPTConfig:
         self.attn_impl = attn_impl
         self.cp_axis = cp_axis
         self.seq_parallel = seq_parallel
-
-
-def _attr(name, cfg):
-    return ParamAttr(name=name, initializer=Normal(0.0, cfg.init_range))
-
-
-def _ln(x, name):
-    return pt.layers.layer_norm(
-        x, begin_norm_axis=2,
-        param_attr=ParamAttr(name=f"{name}.scale",
-                             initializer=Constant(1.0)),
-        bias_attr=ParamAttr(name=f"{name}.bias"))
 
 
 def _causal_attention(x, cfg: GPTConfig, prefix: str, seq: int):
@@ -71,22 +60,14 @@ def _causal_attention(x, cfg: GPTConfig, prefix: str, seq: int):
 
 
 def _mlp(x, cfg: GPTConfig, prefix: str):
-    h1 = pt.layers.fc(x, cfg.ffn, num_flatten_dims=2, act="gelu",
-                      param_attr=_attr(f"{prefix}/mlp1.w", cfg),
-                      bias_attr=ParamAttr(name=f"{prefix}/mlp1.b"))
-    return pt.layers.fc(h1, cfg.hidden, num_flatten_dims=2,
-                        param_attr=_attr(f"{prefix}/mlp2.w", cfg),
-                        bias_attr=ParamAttr(name=f"{prefix}/mlp2.b"))
+    return _shared_ffn(x, cfg, prefix, names=("mlp1", "mlp2"))
 
 
 def gpt_decoder(tokens, cfg: GPTConfig, is_test=False, prefix="gpt"):
     """tokens: int64 (-1, seq) -> hidden states (-1, seq, h), pre-LN
     residual stack with a final LN (GPT-2)."""
     seq = int(tokens.shape[1])
-    if seq > cfg.max_pos:
-        raise ValueError(
-            f"sequence length {seq} exceeds max_pos {cfg.max_pos}; the "
-            "position table would silently clip (raise max_pos)")
+    check_max_pos(seq, cfg)
     wte = pt.layers.embedding(
         tokens, size=[cfg.vocab_size, cfg.hidden],
         param_attr=_attr(f"{prefix}/wte", cfg))
@@ -98,10 +79,21 @@ def gpt_decoder(tokens, cfg: GPTConfig, is_test=False, prefix="gpt"):
     if cfg.dropout > 0:
         x = pt.layers.dropout(x, cfg.dropout, is_test=is_test,
                               dropout_implementation="upscale_in_train")
+    def _resid_drop(t):
+        # GPT-2 resid_pdrop on every sublayer output; attn-prob dropout
+        # stays absent on the fused path (standard for flash kernels,
+        # same documented limitation as models/bert.py attn_impl="fused")
+        if cfg.dropout > 0 and not is_test:
+            return pt.layers.dropout(
+                t, cfg.dropout, is_test=is_test,
+                dropout_implementation="upscale_in_train")
+        return t
+
     for i in range(cfg.layers):
         p = f"{prefix}/l{i}"
-        x = x + _causal_attention(_ln(x, f"{p}/ln1"), cfg, p, seq)
-        x = x + _mlp(_ln(x, f"{p}/ln2"), cfg, p)
+        x = x + _resid_drop(
+            _causal_attention(_ln(x, f"{p}/ln1"), cfg, p, seq))
+        x = x + _resid_drop(_mlp(_ln(x, f"{p}/ln2"), cfg, p))
     return _ln(x, f"{prefix}/lnf")
 
 
