@@ -346,15 +346,12 @@ class BeamSearchDecoder:
 
         # [b, 1] inits -> dense [b, k]: only beam 0 is live at step 0
         prev_ids = layers.expand(self._init_ids, [1, k])
-        neg = layers.fill_constant_batch_size_like(
-            self._init_scores, shape=[-1, k], dtype="float32", value=-1e9)
         first = layers.concat(
             [self._init_scores,
              layers.fill_constant_batch_size_like(
                  self._init_scores, shape=[-1, k - 1], dtype="float32",
                  value=-1e9)], axis=1) if k > 1 else self._init_scores
         prev_scores = first
-        del neg
 
         # static inputs feed every step, tiled once onto the beam axis
         feed_static = {}

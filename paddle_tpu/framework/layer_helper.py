@@ -129,6 +129,21 @@ class LayerHelper:
                       learning_rate=attr.learning_rate,
                       trainable=attr.trainable),
             gshape, dtype)
+        # Reconstruct g = ||v|| in the startup program so the initial
+        # weight w = g*v/||v|| equals the requested initializer's draw
+        # (reference layer_helper_base.py:243 norm_except_dim init).
+        sb = self.startup_program.global_block
+
+        def sop(op_type, ins, out_name=None, attrs=None):
+            if out_name is None:
+                out_name = unique_name(base + ".g_init.tmp")
+                sb.create_var(name=out_name, dtype=dtype, stop_gradient=True)
+            sb.append_op(op_type, ins, {"Out": [out_name]}, attrs or {})
+            return out_name
+
+        sq0 = sop("square", {"X": [v.name]})
+        ss0 = sop("reduce_sum", {"X": [sq0]}, attrs=reduce_attrs)
+        sop("sqrt", {"X": [ss0]}, out_name=g.name)
 
         def op(op_type, ins, attrs=None):
             out = self.create_variable_for_type_inference(dtype)

@@ -128,7 +128,14 @@ def wait_for_saves() -> None:
     for t in pending:
         t.join()
     with _pending_lock:
-        _last_writer_for_path.clear()
+        # only drop registrations whose writer we actually joined (or that
+        # have since finished) — a save submitted between the two critical
+        # sections must keep its predecessor chain intact
+        joined = set(map(id, pending))
+        for path in list(_last_writer_for_path):
+            w = _last_writer_for_path[path]
+            if id(w) in joined or not w.is_alive():
+                del _last_writer_for_path[path]
         errors = list(_save_errors)
         _save_errors.clear()
     if errors:
@@ -165,10 +172,20 @@ def save_vars(executor: Optional[Executor], dirname: str,
     arrays = _collect(scope, vars)
     if format == "fluid":
         if filename is None:
-            # one save_op stream per var, file named by var (fluid io.py:200)
+            # one save_op stream per var, file named by var (fluid io.py:200);
+            # fluid's load_op resolves dirname/<literal var name>, so scoped
+            # names like "gpt/l0/q.w" must become real subdirectories
+            root = os.path.abspath(dirname)
             for name, arr in arrays.items():
                 payload = fluid_interop.lod_tensor_to_bytes(arr)
-                _submit_write(os.path.join(dirname, _mangle(name)),
+                target = os.path.join(dirname, name)
+                # containment: a var name from an untrusted ProgramDesc
+                # ("../x", "/tmp/x") must not escape the checkpoint dir
+                if not os.path.abspath(target).startswith(root + os.sep):
+                    raise ValueError(
+                        f"var name {name!r} escapes save dir {dirname!r}")
+                os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+                _submit_write(target,
                               lambda f, p=payload: f.write(p), sync)
         else:
             # save_combine file, sorted-name order (fluid io.py:242)
@@ -218,9 +235,11 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         # must be present (reference load_vars errors per missing file)
         missing = []
         for v in vars:
-            path = os.path.join(dirname, _mangle(v.name))
+            # literal-name layout (what save_vars writes, and what fluid's
+            # load_op expects) wins over the legacy mangled flat file
+            path = os.path.join(dirname, v.name)
             if not os.path.exists(path):
-                path = os.path.join(dirname, v.name)
+                path = os.path.join(dirname, _mangle(v.name))
             if os.path.exists(path) and _is_fluid_tensor_file(path):
                 with open(path, "rb") as f:
                     arr, _lod = fluid_interop.lod_tensor_from_bytes(f.read())

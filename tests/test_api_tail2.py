@@ -99,7 +99,12 @@ class TestWeightNormAndClips(unittest.TestCase):
             got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
             v = np.asarray(pt.global_scope().find_var("wn.v"))
             g = np.asarray(pt.global_scope().find_var("wn.g"))
-        w = g * v / np.sqrt((v ** 2).sum(axis=0, keepdims=True))
+        norm = np.sqrt((v ** 2).sum(axis=0, keepdims=True))
+        # startup reconstructs g = ||v|| (reference layer_helper_base.py:243)
+        # so the initial effective weight equals the initializer's draw of v
+        np.testing.assert_allclose(g, norm, rtol=1e-5)
+        w = g * v / norm
+        np.testing.assert_allclose(w, v, rtol=1e-5)
         np.testing.assert_allclose(np.asarray(got), xv @ w, rtol=1e-5)
 
     def test_error_clip_by_value(self):

@@ -307,6 +307,32 @@ class TestInferenceModelFluid(unittest.TestCase):
     def test_roundtrip_separate_param_files(self):
         self._save_load_run(params_filename=None)
 
+    def test_per_var_scoped_names_make_subdirs(self):
+        """Fluid's load_op resolves dirname/<literal var name>, so a scoped
+        name like "blk/fc.w" must export as a real subdirectory — not a
+        mangled flat file (reference io.py:200 save_vars per-var path)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", [4])
+            out = pt.layers.fc(x, 3, param_attr=pt.ParamAttr(name="blk/fc.w"),
+                               bias_attr=False)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            w = np.asarray(pt.global_scope().find_var("blk/fc.w"))
+            with tempfile.TemporaryDirectory() as d:
+                pt.io.save_vars(exe, d, main, vars=main.all_parameters(),
+                                format="fluid")
+                pt.io.wait_for_saves()
+                path = os.path.join(d, "blk", "fc.w")
+                self.assertTrue(os.path.exists(path), path)
+                with pt.scope_guard(pt.Scope()):
+                    pt.io.load_vars(exe, d, main,
+                                    vars=main.all_parameters())
+                    back = np.asarray(
+                        pt.global_scope().find_var("blk/fc.w"))
+        np.testing.assert_array_equal(back, w)
+
     def test_roundtrip_combined_params(self):
         self._save_load_run(params_filename="params")
 
