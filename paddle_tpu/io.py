@@ -176,6 +176,20 @@ def save_vars(executor: Optional[Executor], dirname: str,
             # fluid's load_op resolves dirname/<literal var name>, so scoped
             # names like "gpt/l0/q.w" must become real subdirectories
             root = os.path.abspath(dirname)
+            # a var named "blk" colliding with a scope "blk/..." cannot
+            # both be a file and a directory: detect up front and fail
+            # with the var names, not a deferred NotADirectoryError
+            prefixes = set()
+            for name in arrays:
+                parts = name.split("/")
+                prefixes.update("/".join(parts[:i])
+                                for i in range(1, len(parts)))
+            clash = sorted(n for n in arrays if n in prefixes)
+            if clash:
+                raise ValueError(
+                    f"fluid per-var save: var names {clash} collide with "
+                    f"scope prefixes of other vars (file vs directory); "
+                    "use a combined file (filename=...) for this program")
             for name, arr in arrays.items():
                 payload = fluid_interop.lod_tensor_to_bytes(arr)
                 target = os.path.join(dirname, name)
@@ -238,9 +252,12 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             # literal-name layout (what save_vars writes, and what fluid's
             # load_op expects) wins over the legacy mangled flat file
             path = os.path.join(dirname, v.name)
-            if not os.path.exists(path):
+            if not os.path.isfile(path):
+                # not a file (absent, or a DIRECTORY when another var's
+                # scoped name shares this prefix): try the legacy
+                # mangled flat layout before reporting missing
                 path = os.path.join(dirname, _mangle(v.name))
-            if os.path.exists(path) and _is_fluid_tensor_file(path):
+            if os.path.isfile(path) and _is_fluid_tensor_file(path):
                 with open(path, "rb") as f:
                     arr, _lod = fluid_interop.lod_tensor_from_bytes(f.read())
                 scope.set_var(v.name, jnp.asarray(arr))

@@ -290,10 +290,14 @@ def fused_attention(q, k, v, bias_k=None, causal=False, sm_scale=0.0,
     Pallas flash kernel on TPU (ops/flash_attention.py)."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
+    # saved row log-sum-exp: lets the grad op drive the Pallas backward
+    # without re-running the forward kernel (XLA can't CSE custom calls)
+    lse = helper.create_variable_for_type_inference("float32", True)
     ins = {"Q": [q.name], "K": [k.name], "V": [v.name]}
     if bias_k is not None:
         ins["BiasK"] = [bias_k.name]
-    helper.append_op("fused_attention", ins, {"Out": [out.name]},
+    helper.append_op("fused_attention", ins,
+                     {"Out": [out.name], "Lse": [lse.name]},
                      {"causal": causal, "sm_scale": float(sm_scale),
                       "cp_axis": cp_axis, "seq_parallel": seq_parallel,
                       "impl": impl, "batch_axis": batch_axis})

@@ -20,11 +20,81 @@ from ..framework.registry import register_op
 __all__ = []
 
 
-@register_op("fused_attention", no_grad_inputs={"BiasK"})
+def _cp_active(ctx, attrs):
+    cp_axis = attrs.get("cp_axis", "")
+    mesh = ctx.mesh
+    return (cp_axis and mesh is not None and cp_axis in mesh.axis_names
+            and mesh.shape[cp_axis] > 1)
+
+
+def _fused_attention_grad_maker(op, block, no_grad_set):
+    from ..framework.core import grad_var_name
+    ins = {"Q": op.input("Q"), "K": op.input("K"), "V": op.input("V"),
+           "Out": op.output("Out"), "Lse": op.output("Lse"),
+           "Out@GRAD": [grad_var_name(op.output("Out")[0])]}
+    if op.input("BiasK"):
+        ins["BiasK"] = op.input("BiasK")
+    return [{
+        "type": "fused_attention_grad",
+        "inputs": ins,
+        "outputs": {"Q@GRAD": [grad_var_name(op.input("Q")[0])],
+                    "K@GRAD": [grad_var_name(op.input("K")[0])],
+                    "V@GRAD": [grad_var_name(op.input("V")[0])]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+def _fused_attention_grad_lower(ctx, ins, attrs):
+    """Flash path: drive the Pallas backward kernel from the saved Out +
+    Lse — the vjp-replay path re-ran the forward kernel inside the grad
+    (custom calls are opaque to XLA CSE; measured +6.3 ms/step on the GPT
+    flagship, BASELINE.md r5). The XLA-reference path replays via jax.vjp
+    (pure ops, CSE dedupes). The cp paths also replay via jax.vjp; for
+    ring that recompute is inherent to the algorithm, but ulysses on TPU
+    dispatches to the flash kernel inside shard_map, so its replayed
+    forward is still a real second launch — saving lse through shard_map
+    is the known follow-up if ulysses shows up on a profile."""
+    import jax
+    from .flash_attention import attention_bwd_saved, flash_dispatch
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias_k = ins.get("BiasK", [None])[0]
+    out, lse = ins["Out"][0], ins["Lse"][0]
+    g = ins["Out@GRAD"][0]
+    causal = bool(attrs.get("causal", False))
+    sm_scale = float(attrs.get("sm_scale", 0.0)) or None
+    impl = attrs.get("impl", None) or None
+    bias4 = bias_k[:, None, None, :] if bias_k is not None else None
+
+    if not _cp_active(ctx, attrs):
+        use_flash, _ = flash_dispatch(q, k, bias4, impl)
+        if use_flash:
+            dq, dk, dv = attention_bwd_saved(
+                q, k, v, bias4, out, lse, g.astype(out.dtype), causal,
+                sm_scale, impl)
+            return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}
+
+    def f(q_, k_, v_):
+        fwd_ins = {"Q": [q_], "K": [k_], "V": [v_]}
+        if bias_k is not None:
+            fwd_ins["BiasK"] = [bias_k]
+        return _fused_attention(ctx, fwd_ins, attrs)["Out"][0]
+
+    _, vjp_fn = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp_fn(g.astype(out.dtype))
+    return {"Q@GRAD": [dq], "K@GRAD": [dk], "V@GRAD": [dv]}
+
+
+@register_op("fused_attention", no_grad_inputs={"BiasK"},
+             non_diff_outputs={"Lse"},
+             grad_maker=_fused_attention_grad_maker,
+             grad_lower=_fused_attention_grad_lower)
 def _fused_attention(ctx, ins, attrs):
-    from .flash_attention import attention
+    from .flash_attention import attention_fwd_lse
     from ..parallel.ring import (ring_attention_sharded,
                                  ulysses_attention_sharded)
+
+    import jax.numpy as jnp
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias_k = ins.get("BiasK", [None])[0]
@@ -33,10 +103,10 @@ def _fused_attention(ctx, ins, attrs):
     cp_axis = attrs.get("cp_axis", "")
     mode = attrs.get("seq_parallel", "ring")
     impl = attrs.get("impl", None) or None
+    dummy_lse = jnp.zeros((1, 1), jnp.float32)
 
     mesh = ctx.mesh
-    if cp_axis and mesh is not None and cp_axis in mesh.axis_names \
-            and mesh.shape[cp_axis] > 1:
+    if _cp_active(ctx, attrs):
         import functools
         import jax
         from jax.sharding import PartitionSpec as P
@@ -63,10 +133,11 @@ def _fused_attention(ctx, ins, attrs):
             lambda a, b, c, d: fn(a, b, c, d),
             mesh=mesh, in_specs=(spec, spec, spec, bspec),
             out_specs=spec, check_vma=False)(q, k, v, bias_k)
-        return {"Out": [out]}
+        return {"Out": [out], "Lse": [dummy_lse]}
 
     bias4 = None
     if bias_k is not None:
         bias4 = bias_k[:, None, None, :]
-    return {"Out": [attention(q, k, v, bias4, causal=causal,
-                              sm_scale=sm_scale, impl=impl)]}
+    out, lse = attention_fwd_lse(q, k, v, bias4, causal=causal,
+                                 sm_scale=sm_scale, impl=impl)
+    return {"Out": [out], "Lse": [lse if lse is not None else dummy_lse]}

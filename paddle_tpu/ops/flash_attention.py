@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["attention", "flash_attention", "mha_reference"]
+__all__ = ["attention", "attention_fwd_lse", "attention_bwd_saved",
+           "flash_attention", "flash_dispatch", "mha_reference"]
 
 _NEG_INF = -1e30
 _LANES = 128
@@ -669,16 +670,14 @@ def _flash_bwd(causal, sm_scale, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-def attention(q, k, v, bias=None, causal: bool = False,
-              sm_scale: Optional[float] = None, impl: Optional[str] = None):
-    """Dispatching fused attention. impl: None (auto) | 'flash' | 'xla'.
+def flash_dispatch(q, k, bias=None, impl: Optional[str] = None):
+    """The fwd/bwd-shared dispatch decision: (use_flash, interpret).
 
-    bias, when given to the flash path, must be per-key additive
-    (broadcastable from (b, 1, 1, sk)); arbitrary (b, n, sq, sk) biases fall
-    back to the XLA reference.
+    Factored out so an op-level grad can replay the SAME choice the forward
+    made and drive the Pallas backward from saved residuals (out + lse)
+    instead of re-running the forward kernel — XLA does not CSE custom
+    calls, so a vjp-replayed flash forward is a real second kernel launch.
     """
-    if sm_scale is None:
-        sm_scale = 1.0 / np.sqrt(q.shape[-1])
     if impl is None:
         impl = os.environ.get("FLAGS_attention_impl", "")
     flag_ok = impl in ("", "auto", "flash")
@@ -700,9 +699,56 @@ def attention(q, k, v, bias=None, causal: bool = False,
             "flash attention requires a per-key bias of shape (b, sk) or "
             f"(b, 1, 1, sk); got {bias.shape}. Use impl='xla' for general "
             "biases.")
-    if impl == "flash" or (flag_ok and on_tpu and bias_ok and shapes_ok
-                           and long_enough and impl != "xla"):
-        interpret = not on_tpu
+    use = impl == "flash" or (flag_ok and on_tpu and bias_ok and shapes_ok
+                              and long_enough and impl != "xla")
+    return use, not on_tpu
+
+
+def attention(q, k, v, bias=None, causal: bool = False,
+              sm_scale: Optional[float] = None, impl: Optional[str] = None):
+    """Dispatching fused attention. impl: None (auto) | 'flash' | 'xla'.
+
+    bias, when given to the flash path, must be per-key additive
+    (broadcastable from (b, 1, 1, sk)); arbitrary (b, n, sq, sk) biases fall
+    back to the XLA reference.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    use_flash, interpret = flash_dispatch(q, k, bias, impl)
+    if use_flash:
         return flash_attention(q, k, v, bias, causal, float(sm_scale),
                                interpret)
     return mha_reference(q, k, v, bias, causal, sm_scale)
+
+
+def attention_fwd_lse(q, k, v, bias=None, causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      impl: Optional[str] = None):
+    """Forward returning (out, lse) for op-level saved-residual backward.
+
+    lse is the kernel's (b*n, sq) f32 row log-sum-exp on the flash path,
+    None on the XLA path (whose replayed backward is pure ops — CSE-free).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    use_flash, interpret = flash_dispatch(q, k, bias, impl)
+    if not use_flash:
+        return mha_reference(q, k, v, bias, causal, sm_scale), None
+    o, (_, _, _, _, o_bn, lse, _, _) = _flash_fwd(
+        q, k, v, bias, causal, float(sm_scale), interpret)
+    return o, lse
+
+
+def attention_bwd_saved(q, k, v, bias, out, lse, g, causal: bool,
+                        sm_scale: Optional[float] = None,
+                        impl: Optional[str] = None):
+    """Flash backward from saved (out, lse) — no forward recompute.
+    Only valid when the forward's flash_dispatch said use_flash.
+    Returns (dq, dk, dv) in the (b, s, n, d) layout."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    _, interpret = flash_dispatch(q, k, bias, impl)
+    b, sq, n, d = q.shape
+    res = (_to_bn(q), _to_bn(k), _to_bn(v), bias, _to_bn(out), lse, b, n)
+    dq, dk, dv, _ = _flash_bwd(causal, float(sm_scale), interpret, res, g)
+    return dq, dk, dv

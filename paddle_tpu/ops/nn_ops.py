@@ -415,10 +415,53 @@ def _squeeze_label(label):
     return label
 
 
-@register_op("softmax_with_cross_entropy", no_grad_inputs={"Label"})
+def _softmax_xent_grad_maker(op, block, no_grad_set):
+    from ..framework.core import grad_var_name
+    return [{
+        "type": "softmax_with_cross_entropy_grad",
+        "inputs": {"Softmax": op.output("Softmax"),
+                   "Label": op.input("Label"),
+                   "Loss@GRAD": [grad_var_name(op.output("Loss")[0])]},
+        "outputs": {"Logits@GRAD": [grad_var_name(op.input("Logits")[0])]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+def _softmax_xent_grad_lower(ctx, ins, attrs):
+    """d_logits = (softmax - onehot(label)) * d_loss from the SAVED Softmax
+    (the reference grad kernel's design, softmax_with_cross_entropy_op.h).
+    The generic vjp path instead re-ran log_softmax in the backward,
+    materialising a full f32 logp tensor — at GPT vocab scale that was
+    ~12 ms/step of pure HBM traffic (BASELINE.md r5 GPT roofline)."""
+    softmax = ins["Softmax"][0]
+    label = ins["Label"][0]
+    g = ins["Loss@GRAD"][0]
+    axis = attrs.get("axis", -1) % softmax.ndim
+    sm = softmax.astype(jnp.float32)
+    if attrs.get("soft_label", False):
+        d = sm - label.astype(jnp.float32)
+    else:
+        lab = label
+        if lab.ndim == softmax.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis)
+        idx = jnp.expand_dims(lab.astype(jnp.int32), axis)
+        # onehot as iota==label: fuses to a select, no (.., V) materialize
+        iota = jax.lax.broadcasted_iota(jnp.int32, sm.shape, axis)
+        d = sm - (iota == idx).astype(jnp.float32)
+        ignore = attrs.get("ignore_index", -100)
+        d = jnp.where(jnp.expand_dims(lab == ignore, axis), 0.0, d)
+    return {"Logits@GRAD": [(d * g.astype(jnp.float32))
+                            .astype(softmax.dtype)]}
+
+
+@register_op("softmax_with_cross_entropy", no_grad_inputs={"Label"},
+             grad_maker=_softmax_xent_grad_maker,
+             grad_lower=_softmax_xent_grad_lower)
 def _softmax_xent(ctx, ins, attrs):
     """reference: softmax_with_cross_entropy_op.cc — the numerically stable
-    fused path (log-softmax + NLL in one)."""
+    fused path (log-softmax + NLL in one). The grad op consumes the saved
+    Softmax output (as in the reference); gradients do not flow through the
+    Softmax output itself — also the reference's contract."""
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = attrs.get("axis", -1) % logits.ndim
     # f32 internal math: bf16 logits only halve HBM traffic (AMP-safe)

@@ -48,6 +48,15 @@ def main():
         dt = (time.perf_counter() - t0) / steps
         assert np.isfinite(np.asarray(last)).all()
 
+        prof = os.environ.get("BENCH_PROFILE", "")
+        if prof:  # 3 profiled steps for tools/profile_summary.py
+            with pt.profiler.profiler(profile_path=prof):
+                for _ in range(3):
+                    last = exe.run(main_prog, feed=feed,
+                                   fetch_list=[loss_var],
+                                   return_numpy=False)[0]
+                last.block_until_ready()
+
     fl = flops_per_step(cfg, batch, seq)
     mfu = fl / dt / peak
     print(json.dumps({
