@@ -119,7 +119,8 @@ def beam_search_decode(step_logits: Callable[[np.ndarray], np.ndarray],
 def beam_search_decode_on_device(step_logits, batch_size: int,
                                  beam_size: int, bos_id: int, eos_id: int,
                                  max_len: int,
-                                 length_penalty: float = 0.0):
+                                 length_penalty: float = 0.0,
+                                 init_state=None, reorder_state=None):
     """ON-DEVICE beam search: the whole decode loop is ONE jitted XLA
     computation (lax.fori_loop over steps + gather_tree backtrace) — no
     per-step host round trip. Through the TPU tunnel each host-loop step
@@ -128,6 +129,18 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
     step_logits must be a JAX-traceable fn(tokens [b*k, max_len+1],
     t: int32 scalar) -> [b*k, V] next-token logits for the prefix
     tokens[:, :t+1] (static padded shape; use `t` for masking).
+
+    CACHED (incremental-state) steps: pass `init_state` (any pytree —
+    e.g. a KV cache from models/gpt_decode.gpt_prefill) and the step
+    signature becomes fn(tokens, t, state) -> (logits, new_state). After
+    each step's top-k the surviving beams are a parent-permutation of the
+    previous ones, so the state must be reordered too: `reorder_state
+    (state, parent [b, k] int32) -> state` does that (required with
+    init_state unless every state leaf has leading dim b*k, which is
+    reordered automatically). This is the O(1)-per-step contract of the
+    reference's tensor-array decode state (test_machine_translation.py:
+    110-136) — without it each step recomputes the whole padded prefix.
+
     Returns (sequences [b, beam, max_len], scores [b, beam]) best-first,
     matching the host-loop beam_search_decode.
     """
@@ -137,15 +150,38 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
     b, k = batch_size, beam_size
     L = max_len
     neg_inf = -1e9
+    stateful = init_state is not None
+
+    if stateful and reorder_state is None:
+        # the default reorder gathers leaf[parent] along axis 0; under
+        # jit an out-of-range gather CLAMPS instead of erroring, so a
+        # wrong-layout state (e.g. a KV cache with batch at axis 2)
+        # would silently decode garbage — validate up front
+        import jax as _jax
+        for leaf in _jax.tree.leaves(init_state):
+            if leaf.shape[:1] != (b * k,):
+                raise ValueError(
+                    f"init_state leaf has shape {leaf.shape}; the default"
+                    f" reorder needs leading dim b*beam={b * k}. Pass "
+                    "reorder_state= for other layouts (e.g. a KV cache "
+                    "with its batch axis elsewhere)")
+
+    def _default_reorder(state, parent):
+        # every leaf (b*k, ...): gather rows by parent beam
+        flat = (parent + jnp.arange(b)[:, None] * k).reshape(-1)
+        return jax.tree.map(lambda a: a[flat], state)
+
+    do_reorder = reorder_state if reorder_state is not None \
+        else _default_reorder
 
     cache_key = (step_logits, b, k, bos_id, eos_id, L,
-                 float(length_penalty))
+                 float(length_penalty), stateful, reorder_state)
     cached = _ON_DEVICE_CACHE.get(cache_key)
     if cached is not None:
-        seqs, scores = cached()
+        seqs, scores = cached(init_state) if stateful else cached()
         return np.asarray(seqs), np.asarray(scores)
 
-    def decode():
+    def decode(state0=None):
         tokens0 = jnp.full((b * k, L + 1), eos_id, jnp.int32)
         tokens0 = tokens0.at[:, 0].set(bos_id)
         # only beam 0 live initially (identical prefixes must not
@@ -157,8 +193,11 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
         fin0 = jnp.zeros((b, k), jnp.bool_)
 
         def body(t, carry):
-            tokens, scores, ids_stack, par_stack, finished = carry
-            logits = step_logits(tokens, t)          # [b*k, V]
+            tokens, scores, ids_stack, par_stack, finished, state = carry
+            if stateful:
+                logits, state = step_logits(tokens, t, state)
+            else:
+                logits = step_logits(tokens, t)      # [b*k, V]
             v = logits.shape[-1]
             logp = jax.nn.log_softmax(
                 logits.astype(jnp.float32)).reshape(b, k, v)
@@ -179,10 +218,13 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
                 (tok == eos_id)
             ids_stack = ids_stack.at[t].set(tok)
             par_stack = par_stack.at[t].set(parent)
-            return tokens, top_s, ids_stack, par_stack, finished
+            if stateful:
+                state = do_reorder(state, parent)
+            return tokens, top_s, ids_stack, par_stack, finished, state
 
-        tokens, scores, ids_stack, par_stack, _ = jax.lax.fori_loop(
-            0, L, body, (tokens0, scores0, ids_stack0, par_stack0, fin0))
+        tokens, scores, ids_stack, par_stack, _, _ = jax.lax.fori_loop(
+            0, L, body,
+            (tokens0, scores0, ids_stack0, par_stack0, fin0, state0))
 
         # backtrace with the registered gather_tree lowering (one
         # implementation shared with the host-loop variant)
@@ -205,5 +247,5 @@ def beam_search_decode_on_device(step_logits, batch_size: int,
 
     jitted = jax.jit(decode)
     _ON_DEVICE_CACHE[cache_key] = jitted
-    seqs, scores = jitted()
+    seqs, scores = jitted(init_state) if stateful else jitted()
     return np.asarray(seqs), np.asarray(scores)

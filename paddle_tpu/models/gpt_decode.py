@@ -1,0 +1,273 @@
+"""KV-cache autoregressive decoding for the GPT family.
+
+The reference's incremental-decode contract is O(1) state per step: its
+RNN decoder reads the previous step's state from a tensor array and never
+re-runs the prefix (python/paddle/fluid/tests/book/
+test_machine_translation.py:110-136 `pd.array_read(state_array, i=counter)`
+feeding `pd.beam_search`; operators/beam_search_op.cc). This module is the
+TPU-native form of that contract for a decoder-only transformer:
+
+  * a PREFILL pass runs the whole prompt once and fills a KV cache of
+    shape (layers, 2, b, heads, max_len, head_dim),
+  * a DECODE step consumes one token + the cache (dynamic_update_slice at
+    position t, masked attention over [0, t]) — O(max_len·d) per step
+    instead of the O(t²·model) full-prefix recompute,
+  * the whole sampling loop (greedy / top-k / temperature) runs inside
+    ONE jitted lax.fori_loop — a single dispatch for the entire
+    generation, no per-step host round trips (~66 ms each through the
+    TPU tunnel, BASELINE.md).
+
+Weights are read from the training scope by the var names gpt_lm_program
+creates, so a trained static-graph model generates without any export
+step. Forward math mirrors models/gpt.py exactly (pre-LN, separate
+q/k/v, tanh gelu, tied wte head, f32 LN stats).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["collect_gpt_params", "gpt_forward_logits", "gpt_prefill",
+           "gpt_decode_step", "gpt_generate"]
+
+
+def _ln_names(name):
+    return f"{name}.scale", f"{name}.bias"
+
+
+def collect_gpt_params(scope, cfg, prefix="gpt", dtype=None):
+    """Pull the GPT parameter pytree out of an executor scope (the vars
+    models/gpt.py's programs create). dtype=jnp.bfloat16 casts the copy
+    used for decoding (halves HBM traffic; master weights untouched)."""
+    import jax.numpy as jnp
+
+    def get(name):
+        v = scope.find_var(name)
+        if v is None:
+            raise KeyError(f"param {name!r} not found in scope")
+        arr = jnp.asarray(v)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def ln(name):
+        s, b = _ln_names(name)
+        return {"g": get(s), "b": get(b)}
+
+    p = {"wte": get(f"{prefix}/wte"), "wpe": get(f"{prefix}/wpe"),
+         "lnf": ln(f"{prefix}/lnf"), "blocks": []}
+    for i in range(cfg.layers):
+        pre = f"{prefix}/l{i}"
+        blk = {"ln1": ln(f"{pre}/ln1"), "ln2": ln(f"{pre}/ln2")}
+        for nm in ("q", "k", "v", "out", "mlp1", "mlp2"):
+            blk[nm] = {"w": get(f"{pre}/{nm}.w"), "b": get(f"{pre}/{nm}.b")}
+        p["blocks"].append(blk)
+    return p
+
+
+def _ln(x, p, eps=1e-5):
+    import jax
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    m = xf.mean(-1, keepdims=True)
+    v = ((xf - m) ** 2).mean(-1, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    return (y * p["g"].astype(jnp.float32)
+            + p["b"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _dense(x, p):
+    return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+
+
+def _gelu_tanh(x):
+    import jax
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _split_heads(x, heads):
+    b, s, h = x.shape
+    return x.reshape(b, s, heads, h // heads)
+
+
+def gpt_forward_logits(params, cfg, tokens):
+    """Full-prefix forward (no cache): tokens (b, s) -> logits (b, s, V).
+    The no-cache reference the equality tests pin the cached path to."""
+    import jax.numpy as jnp
+
+    b, s = tokens.shape
+    dtype = params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
+        else jnp.float32
+    x = (params["wte"][tokens] + params["wpe"][:s]).astype(dtype)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    for blk in params["blocks"]:
+        h = _ln(x, blk["ln1"])
+        q = _split_heads(_dense(h, blk["q"]), cfg.heads)
+        k = _split_heads(_dense(h, blk["k"]), cfg.heads)
+        v = _split_heads(_dense(h, blk["v"]), cfg.heads)
+        hd = q.shape[-1]
+        scores = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / np.sqrt(hd)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, s, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    x = _ln(x, params["lnf"])
+    return (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32)
+
+
+def gpt_prefill(params, cfg, tokens, max_len):
+    """Run the prompt once, filling the KV cache.
+
+    tokens: (b, P) int32. Returns (logits_last (b, V) f32,
+    cache (layers, 2, b, heads, max_len, head_dim))."""
+    import jax.numpy as jnp
+
+    b, p_len = tokens.shape
+    heads, hd = cfg.heads, cfg.hidden // cfg.heads
+    dtype = params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
+        else jnp.float32
+    x = (params["wte"][tokens] + params["wpe"][:p_len]).astype(dtype)
+    mask = jnp.tril(jnp.ones((p_len, p_len), bool))
+    cache = jnp.zeros((cfg.layers, 2, b, heads, max_len, hd), dtype)
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _split_heads(_dense(h, blk["q"]), heads)
+        k = _split_heads(_dense(h, blk["k"]), heads)
+        v = _split_heads(_dense(h, blk["v"]), heads)
+        # cache layout (.., heads, seq, hd): seq-major per head so the
+        # decode step's dynamic_update_slice touches one lane-row
+        cache = cache.at[li, 0, :, :, :p_len].set(k.transpose(0, 2, 1, 3))
+        cache = cache.at[li, 1, :, :, :p_len].set(v.transpose(0, 2, 1, 3))
+        scores = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(mask, scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bknd->bqnd", probs, v).reshape(b, p_len, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    x = _ln(x[:, -1:], params["lnf"])
+    logits = (x @ params["wte"].T.astype(x.dtype))[:, 0]
+    return logits.astype(jnp.float32), cache
+
+
+def gpt_decode_step(params, cfg, token, cache, t):
+    """One cached decode step. token: (b,) int32, t: traced scalar index
+    of the ABSOLUTE position being computed. Returns (logits (b, V) f32,
+    updated cache). Attention reads keys [0, t] only — O(max_len) work,
+    never O(t²)."""
+    import jax
+    import jax.numpy as jnp
+
+    heads = cfg.heads
+    hd = cfg.hidden // cfg.heads
+    max_len = cache.shape[4]
+    b = token.shape[0]
+    dtype = cache.dtype
+    x = (params["wte"][token] + params["wpe"][t]).astype(dtype)[:, None]
+    pos_mask = (jnp.arange(max_len) <= t)          # [S]
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _dense(h, blk["q"]).reshape(b, heads, 1, hd)
+        k = _dense(h, blk["k"]).reshape(b, heads, 1, hd)
+        v = _dense(h, blk["v"]).reshape(b, heads, 1, hd)
+        cache = jax.lax.dynamic_update_slice(
+            cache, k[None, None], (li, 0, 0, 0, t, 0))
+        cache = jax.lax.dynamic_update_slice(
+            cache, v[None, None], (li, 1, 0, 0, t, 0))
+        K, V = cache[li, 0], cache[li, 1]          # (b, n, S, hd)
+        scores = jnp.einsum("bnqd,bnkd->bnqk", q, K,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(pos_mask[None, None, None, :],
+                           scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, V)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    x = _ln(x, params["lnf"])
+    logits = (x @ params["wte"].T.astype(x.dtype))[:, 0]
+    return logits.astype(jnp.float32), cache
+
+
+def _sample(logits, key, temperature, top_k):
+    import jax
+    import jax.numpy as jnp
+    if temperature == 0.0:                      # greedy
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        vals, idx = jax.lax.top_k(logits, top_k)
+        choice = jax.random.categorical(key, vals)
+        return jnp.take_along_axis(
+            idx, choice[:, None], 1)[:, 0].astype(jnp.int32)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def _generate_impl(params, cfg, prompt, max_new, temperature, top_k,
+                   eos_id, key):
+    import jax
+    import jax.numpy as jnp
+
+    b, p_len = prompt.shape
+    total = p_len + max_new
+    logits, cache = gpt_prefill(params, cfg, prompt, total)
+    tokens = jnp.concatenate(
+        [prompt.astype(jnp.int32),
+         jnp.zeros((b, max_new), jnp.int32)], axis=1)
+    done0 = jnp.zeros((b,), bool)
+
+    def body(i, carry):
+        tokens, cache, logits, key, done = carry
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        tokens = tokens.at[:, p_len + i].set(nxt)
+        logits, cache = gpt_decode_step(params, cfg, nxt, cache,
+                                        p_len + i)
+        return tokens, cache, logits, key, done
+
+    tokens, _, _, _, _ = jax.lax.fori_loop(
+        0, max_new, body, (tokens, cache, logits, key, done0))
+    return tokens
+
+
+_GENERATE_JIT = None
+
+
+def gpt_generate(params, cfg, prompt, max_new_tokens,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, seed: int = 0):
+    """Generate continuations. prompt: (b, P) int array. temperature=0 is
+    greedy; top_k>0 samples among the k best at the given temperature.
+    One jitted dispatch for prefill + all decode steps."""
+    import jax
+    import jax.numpy as jnp
+    p_len = int(np.asarray(prompt).shape[1])
+    if p_len + int(max_new_tokens) > cfg.max_pos:
+        # a traced wpe[t] index CLAMPS past the table under jit — every
+        # token beyond max_pos would silently reuse the last position
+        raise ValueError(
+            f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds cfg.max_pos ({cfg.max_pos})")
+    global _GENERATE_JIT
+    if _GENERATE_JIT is None:
+        _GENERATE_JIT = jax.jit(
+            _generate_impl,
+            static_argnames=("cfg", "max_new", "temperature", "top_k",
+                             "eos_id"))
+    prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
+    out = _GENERATE_JIT(params, cfg, prompt, int(max_new_tokens),
+                        float(temperature), int(top_k), eos_id,
+                        jax.random.PRNGKey(seed))
+    return np.asarray(out)

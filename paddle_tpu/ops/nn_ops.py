@@ -421,7 +421,12 @@ def _softmax_xent_grad_maker(op, block, no_grad_set):
         "type": "softmax_with_cross_entropy_grad",
         "inputs": {"Softmax": op.output("Softmax"),
                    "Label": op.input("Label"),
-                   "Loss@GRAD": [grad_var_name(op.output("Loss")[0])]},
+                   "Loss@GRAD": [grad_var_name(op.output("Loss")[0])],
+                   # present only when an aux loss consumed the Softmax
+                   # output (entropy penalty, distillation) — the accum
+                   # resolves it to "" otherwise and grad_lower skips it
+                   "Softmax@GRAD": [grad_var_name(
+                       op.output("Softmax")[0])]},
         "outputs": {"Logits@GRAD": [grad_var_name(op.input("Logits")[0])]},
         "attrs": dict(op.attrs),
     }]
@@ -450,8 +455,14 @@ def _softmax_xent_grad_lower(ctx, ins, attrs):
         d = sm - (iota == idx).astype(jnp.float32)
         ignore = attrs.get("ignore_index", -100)
         d = jnp.where(jnp.expand_dims(lab == ignore, axis), 0.0, d)
-    return {"Logits@GRAD": [(d * g.astype(jnp.float32))
-                            .astype(softmax.dtype)]}
+    dl = d * g.astype(jnp.float32)
+    g_sm = ins.get("Softmax@GRAD", [None])[0]
+    if g_sm is not None:
+        # aux-loss path through the Softmax output: softmax vjp
+        # dL/dlogits += (g_sm - sum(g_sm * sm)) * sm
+        gs = g_sm.astype(jnp.float32)
+        dl = dl + (gs - jnp.sum(gs * sm, axis=axis, keepdims=True)) * sm
+    return {"Logits@GRAD": [dl.astype(softmax.dtype)]}
 
 
 @register_op("softmax_with_cross_entropy", no_grad_inputs={"Label"},

@@ -1,0 +1,217 @@
+"""KV-cache autoregressive decoding (VERDICT r4 item 2).
+
+Pins the O(1)-per-step decode contract (the reference's incremental
+tensor-array decode state, test_machine_translation.py:110-136) for the
+GPT family: cached == uncached logits/greedy/beam, program parity, and
+the sampling modes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+from paddle_tpu.models import gpt_decode as gd
+
+
+def tiny_cfg():
+    return GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                     max_pos=64, dropout=0.0, attn_impl="xla")
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A randomly initialised tiny GPT: (cfg, params, program logits fn)."""
+    cfg = tiny_cfg()
+    main, startup, fetches = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+
+        def program_logits(tokens):
+            with pt.scope_guard(scope):
+                out, = exe.run(main, feed={"tokens": tokens},
+                               fetch_list=[fetches["logits"]])
+            return out
+    return cfg, params, program_logits
+
+
+def test_forward_matches_program(trained):
+    """The decode module's full forward reproduces the static-graph
+    program's logits (same vars, same math)."""
+    cfg, params, program_logits = trained
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, (2, 8)).astype(np.int64)
+    ref = program_logits(toks)
+    got = gd.gpt_forward_logits(params, cfg, np.asarray(toks, np.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_matches_full_forward(trained):
+    cfg, params, _ = trained
+    rng = np.random.RandomState(1)
+    toks = np.asarray(rng.randint(0, cfg.vocab_size, (3, 6)), np.int32)
+    full = np.asarray(gd.gpt_forward_logits(params, cfg, toks))
+    logits, cache = gd.gpt_prefill(params, cfg, toks, max_len=16)
+    np.testing.assert_allclose(np.asarray(logits), full[:, -1],
+                               rtol=1e-5, atol=1e-5)
+    assert cache.shape == (cfg.layers, 2, 3, cfg.heads, 16,
+                           cfg.hidden // cfg.heads)
+
+
+def test_cached_step_matches_full_forward(trained):
+    """Step-by-step cached logits == full-prefix recompute at every
+    position (the equality the VERDICT asked for)."""
+    import jax.numpy as jnp
+    cfg, params, _ = trained
+    rng = np.random.RandomState(2)
+    toks = np.asarray(rng.randint(0, cfg.vocab_size, (2, 10)), np.int32)
+    full = np.asarray(gd.gpt_forward_logits(params, cfg, toks))
+    # prefill on the first 4, then feed tokens 4..9 one at a time
+    _, cache = gd.gpt_prefill(params, cfg, toks[:, :4], max_len=12)
+    for t in range(4, 10):
+        logits, cache = gd.gpt_decode_step(
+            params, cfg, jnp.asarray(toks[:, t]), cache, t)
+        np.testing.assert_allclose(np.asarray(logits), full[:, t],
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"position {t}")
+
+
+def test_greedy_generate_matches_nocache(trained):
+    cfg, params, _ = trained
+    rng = np.random.RandomState(3)
+    prompt = np.asarray(rng.randint(0, cfg.vocab_size, (2, 4)), np.int32)
+    out = gd.gpt_generate(params, cfg, prompt, max_new_tokens=8)
+    # no-cache reference: recompute the full prefix each step, argmax
+    toks = prompt.copy()
+    for _ in range(8):
+        logits = np.asarray(gd.gpt_forward_logits(params, cfg, toks))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, toks)
+
+
+def test_sampling_modes(trained):
+    cfg, params, _ = trained
+    prompt = np.zeros((2, 2), np.int32)
+    a = gd.gpt_generate(params, cfg, prompt, 6, temperature=0.8,
+                        top_k=5, seed=7)
+    b = gd.gpt_generate(params, cfg, prompt, 6, temperature=0.8,
+                        top_k=5, seed=7)
+    np.testing.assert_array_equal(a, b)  # seeded -> deterministic
+    c = gd.gpt_generate(params, cfg, prompt, 6, temperature=0.8,
+                        top_k=5, seed=8)
+    assert a.shape == c.shape == (2, 8)
+    # top-k=1 at any temperature is greedy
+    d = gd.gpt_generate(params, cfg, prompt, 6, temperature=1.0, top_k=1,
+                        seed=0)
+    e = gd.gpt_generate(params, cfg, prompt, 6, temperature=0.0)
+    np.testing.assert_array_equal(d, e)
+
+
+def test_eos_stops_rows(trained):
+    cfg, params, _ = trained
+    prompt = np.zeros((1, 2), np.int32)
+    # force eos to be whatever greedy produces first -> everything after
+    # must be eos
+    first = gd.gpt_generate(params, cfg, prompt, 1)[0, -1]
+    out = gd.gpt_generate(params, cfg, prompt, 6, eos_id=int(first))
+    assert (out[0, 2:] == first).all()
+
+
+def test_beam_search_cached_equals_uncached(trained):
+    """beam_search_decode_on_device with a KV-cache stateful step returns
+    the same sequences/scores as the full-prefix-recompute step."""
+    import jax
+    import jax.numpy as jnp
+    cfg, params, _ = trained
+    b, k, L = 2, 3, 6
+    bos, eos = 1, 2
+
+    def uncached_step(tokens, t):
+        logits_all = gd.gpt_forward_logits(params, cfg, tokens)
+        return jax.lax.dynamic_index_in_dim(logits_all, t, axis=1,
+                                            keepdims=False)
+
+    seqs_u, scores_u = pt.layers.decode.beam_search_decode_on_device(
+        uncached_step, b, k, bos, eos, L)
+
+    hd = cfg.hidden // cfg.heads
+    cache0 = jnp.zeros((cfg.layers, 2, b * k, cfg.heads, L + 1, hd),
+                       jnp.float32)
+
+    def cached_step(tokens, t, cache):
+        tok = jax.lax.dynamic_index_in_dim(tokens, t, axis=1,
+                                           keepdims=False)
+        return gd.gpt_decode_step(params, cfg, tok, cache, t)
+
+    def reorder(cache, parent):
+        flat = (parent + jnp.arange(b)[:, None] * k).reshape(-1)
+        return cache[:, :, flat]
+
+    seqs_c, scores_c = pt.layers.decode.beam_search_decode_on_device(
+        cached_step, b, k, bos, eos, L,
+        init_state=cache0, reorder_state=reorder)
+
+    np.testing.assert_array_equal(seqs_c, seqs_u)
+    np.testing.assert_allclose(scores_c, scores_u, rtol=1e-4, atol=1e-4)
+
+
+def test_softmax_xent_aux_loss_through_softmax_output():
+    """The custom softmax_with_cross_entropy grad must still propagate
+    gradients that flow through the SOFTMAX output (entropy penalties,
+    distillation) — code-review r5 regression pin."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 7).astype(np.float32)
+    yv = rng.randint(0, 7, (4, 1)).astype(np.int64)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4, 7], append_batch_size=False,
+                           stop_gradient=False)
+        y = pt.layers.data("y", [4, 1], dtype="int64",
+                           append_batch_size=False)
+        loss_ce, sm = pt.layers.softmax_with_cross_entropy(
+            x, y, return_softmax=True)
+        # aux loss through the softmax output: sum of squares
+        total = pt.layers.mean(loss_ce) + \
+            pt.layers.reduce_sum(sm * sm) * 0.3
+        gx, = pt.gradients([total], [x])
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        g, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[gx])
+
+    def ref(logits):
+        logp = jax.nn.log_softmax(logits)
+        sm = jnp.exp(logp)
+        ce = -jnp.take_along_axis(logp, jnp.asarray(yv, jnp.int32), 1)
+        return ce.mean() + 0.3 * jnp.sum(sm * sm)
+
+    g_ref = jax.grad(ref)(jnp.asarray(xv))
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_generate_past_max_pos_raises(trained):
+    cfg, params, _ = trained
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="max_pos"):
+        gd.gpt_generate(params, cfg, prompt, cfg.max_pos)
+
+
+def test_beam_default_reorder_rejects_wrong_layout(trained):
+    import jax.numpy as jnp
+    cfg, params, _ = trained
+
+    def cached_step(tokens, t, cache):
+        return jnp.zeros((6, cfg.vocab_size)), cache
+
+    bad_state = jnp.zeros((cfg.layers, 2, 6, cfg.heads, 8, 8))
+    with pytest.raises(ValueError, match="reorder"):
+        pt.layers.decode.beam_search_decode_on_device(
+            cached_step, 2, 3, 1, 2, 4, init_state=bad_state)
