@@ -355,6 +355,11 @@ class Executor:
             ro_in = dist_plan.place_scope(ro_in)
 
         key = scope.find_var("@RNG@")
+        if dist_plan is not None:
+            # on a multi-process mesh the key must be a GLOBAL replicated
+            # array (every process holds the same key: startup ran with
+            # the same seed everywhere); _put is a no-op otherwise
+            key = dist_plan._put(key, dist_plan.scope_sharding("@RNG@"))
 
         if getattr(self, "capture_hlo", False):
             # tools/comm_volume.py: optimized HLO with the SPMD partitioner's

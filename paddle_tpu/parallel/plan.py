@@ -68,17 +68,68 @@ class ShardingPlan:
         return self._nsh(self._spec())
 
     # -- executor hooks ------------------------------------------------------
+    def _batch_parts(self):
+        """(mesh axes the batch dim shards over, total batch shards) —
+        the ONE place the batch-sharding rule lives, so shard_feed and the
+        jit in_shardings cannot disagree."""
+        return (self.batch_axis,), self.mesh.shape[self.batch_axis]
+
+    def _put(self, v, sharding):
+        """device_put — or, on a multi-process mesh (jax.distributed: one
+        process per host, the reference's launch.py:132 deployment shape),
+        assemble the GLOBAL array from this process's local data. A value
+        that is already a global (non-addressable) array is resharded via
+        device_put, never round-tripped through the host."""
+        import jax
+        cur = getattr(v, "sharding", None)
+        if cur is not None and cur == sharding:
+            return v
+        if jax.process_count() > 1:
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                return jax.device_put(v, sharding)   # global -> reshard
+            import numpy as np
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(v))
+        return jax.device_put(v, sharding)
+
     def shard_feed(self, feed: Dict):
-        """Place feed arrays batch-sharded across the mesh."""
+        """Place feed arrays batch-sharded across the mesh.
+
+        Multi-process contract (each process is a reference trainer):
+        every feed is this process's LOCAL batch shard — the global batch
+        is their rank-order concatenation. A feed that is NOT per-process
+        data (a broadcast lr scalar, a shared table) must be declared via
+        feed_shardings={name: ()}; silently replicating per-process data
+        would make devices disagree on a "replicated" value, which is the
+        one unrecoverable mistake here, so undeclared unshardable feeds
+        raise instead."""
         import jax
         out = {}
+        multi = jax.process_count() > 1
         for k, v in feed.items():
-            out[k] = jax.device_put(
-                v, self.feed_sharding(tuple(v.shape), name=k))
+            shape = tuple(v.shape)
+            if multi and shape:
+                axes, nb = self._batch_parts()
+                local_shards = max(1, nb // jax.process_count())
+                if k in self.feed_shardings:
+                    spec = self._spec(*self.feed_shardings[k])
+                elif shape[0] % local_shards == 0:
+                    spec = self._spec(
+                        axes[0] if len(axes) == 1 else tuple(axes))
+                else:
+                    raise ValueError(
+                        f"multi-process feed {k!r} with local leading dim "
+                        f"{shape[0]} does not divide over this process's "
+                        f"{local_shards} batch shard(s); pad the local "
+                        "batch, or declare the feed's sharding explicitly "
+                        "(feed_shardings={name: ()} for a replicated "
+                        "value)")
+                out[k] = self._put(v, self._nsh(spec))
+            else:
+                out[k] = self._put(v, self.feed_sharding(shape, name=k))
         return out
 
     def place_scope(self, scope_vals: Dict):
-        import jax
         out = {}
         for k, v in scope_vals.items():
             sh = self.scope_sharding(k)
@@ -86,7 +137,7 @@ class ShardingPlan:
             if arr is not None and arr == sh:
                 out[k] = v
             else:
-                out[k] = jax.device_put(v, sh)
+                out[k] = self._put(v, sh)
         return out
 
     def constrain(self, op, env) -> None:
@@ -162,6 +213,14 @@ class CollectiveSpmdPlan(ShardingPlan):
 
     def constrain(self, op, env) -> None:
         pass  # inside shard_map there are no global shardings to assert
+
+    def _batch_parts(self):
+        # SPMD feeds shard over ALL replica axes (feed_spec below) —
+        # including the (inter, intra) pair in hierarchical mode
+        n = 1
+        for a in self.spmd_axes:
+            n *= self.mesh.shape[a]
+        return tuple(self.spmd_axes), n
 
     def jit(self, fn, mutable, created, readonly, feed_shapes):
         import jax
