@@ -44,8 +44,9 @@ def main():
     peak = float(os.environ.get("PEAK_TFLOPS", 197.0)) * 1e12
 
     amp = os.environ.get("BENCH_AMP", "1") == "1"
+    recompute = os.environ.get("BENCH_RECOMPUTE", "0") == "1"
     main_prog, startup, fetches = bert_pretrain_program(
-        cfg, seq, learning_rate=1e-4, amp=amp)
+        cfg, seq, learning_rate=1e-4, amp=amp, recompute=recompute)
 
     rng = np.random.RandomState(0)
     feed = {

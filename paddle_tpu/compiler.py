@@ -12,7 +12,7 @@ performed explicitly.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 from .framework.core import Program
 
@@ -85,6 +85,28 @@ class CompiledProgram:
         self._mesh_shape = mesh_shape
         self._axis_names = tuple(axis_names)
         self._feed_shardings = dict(feed_shardings or {})
+        return self
+
+    def with_recompute(self, checkpoints: Optional[Sequence[str]] = None):
+        """Activation checkpointing: keep only `checkpoints` (default: the
+        per-layer boundaries the model builder recorded on the program)
+        and rematerialize the segments between them in the backward —
+        trades one extra forward for O(layers) instead of O(ops) live
+        activations. Composes with with_data_parallel/with_sharding/
+        with_collective; apply once per program."""
+        ckpts = checkpoints if checkpoints is not None else \
+            getattr(self._program, "_recompute_checkpoints", None)
+        if not ckpts:
+            raise ValueError(
+                "with_recompute: no checkpoints given and the program "
+                "records none (_recompute_checkpoints); pass the boundary "
+                "var names explicitly")
+        from .transpiler.recompute import apply_recompute
+        # rewrite a CLONE: like the other with_* modes, wrapping must not
+        # change the user's Program (fetch vars resolve by name, so the
+        # caller's handles keep working against the clone)
+        self._program = self._program.clone()
+        apply_recompute(self._program, list(ckpts))
         return self
 
     def with_collective(self, nranks: Optional[int] = None,

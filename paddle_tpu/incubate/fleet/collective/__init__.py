@@ -121,6 +121,15 @@ class CollectiveOptimizer(DistributedOptimizer):
                     endpoints=fleet.worker_endpoints()
                     if fleet._is_initialized else None,
                     nranks=nranks)
+        if self._strategy.forward_recompute:
+            from ....transpiler.recompute import apply_recompute
+            ckpts = list(self._strategy.recompute_checkpoints) or \
+                getattr(main, "_recompute_checkpoints", None)
+            if not ckpts:
+                raise ValueError(
+                    "forward_recompute=True needs recompute_checkpoints "
+                    "(the activation var names to keep between segments)")
+            apply_recompute(main, ckpts)
         fleet._transpiled_program = main
         fleet.main_program = main
         return opt_ops, params_grads

@@ -152,14 +152,24 @@ def bert_encoder(src_ids, sent_ids, input_mask, cfg: BertConfig,
 
 def bert_pretrain_program(cfg: BertConfig, seq_len: int, is_test=False,
                           learning_rate=1e-4, optimizer="adam",
-                          amp=False, pipeline_microbatches=None):
+                          amp=False, pipeline_microbatches=None,
+                          recompute=False):
     """Build (main, startup, fetch dict) for an MLM pretraining step with
     tied output embeddings (logits over full vocab at every position).
     amp=True applies the bf16 mixed-precision rewrite (f32 master weights).
     pipeline_microbatches=M wraps the optimizer in PipelineOptimizer with
-    cut points at the encoder layers (SPMD GPipe over the 'pp' axis)."""
+    cut points at the encoder layers (SPMD GPipe over the 'pp' axis).
+    recompute=True checkpoints the per-layer encoder outputs and
+    rematerializes everything between them in the backward — long-context
+    training (s=4096 b=4 on one 16G chip, BASELINE.md r5) at the cost of
+    one extra forward."""
+    if recompute and pipeline_microbatches:
+        raise ValueError(
+            "recompute=True with pipeline_microbatches is not supported "
+            "in one call — PipelineOptimizer already remats its stage "
+            "bodies (parallel/pipeline.py remat=True)")
     main, startup = pt.Program(), pt.Program()
-    cuts = [] if pipeline_microbatches else None
+    cuts = [] if (pipeline_microbatches or recompute) else None
     with pt.program_guard(main, startup):
         src = pt.layers.data("src_ids", [seq_len], dtype="int64")
         sent = pt.layers.data("sent_ids", [seq_len], dtype="int64")
@@ -189,6 +199,11 @@ def bert_pretrain_program(cfg: BertConfig, seq_len: int, is_test=False,
                 opt, cut_list=cuts,
                 num_microbatches=pipeline_microbatches)
         opt.minimize(mean_loss)
+    if cuts is not None:
+        main._recompute_checkpoints = list(cuts)
+    if recompute:
+        from ..transpiler.recompute import apply_recompute
+        apply_recompute(main, cuts)
     return main, startup, {"loss": mean_loss}
 
 

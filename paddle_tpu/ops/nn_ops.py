@@ -385,6 +385,25 @@ def _dropout_grad_lower(ctx, ins, attrs):
     return {"X@GRAD": [g]}
 
 
+@register_op("dropout_mask_apply", not_differentiable=True, grad_free=True)
+def _dropout_mask_apply(ctx, ins, attrs):
+    """Recompute-region replay of a dropout whose Mask was saved: same
+    math as the dropout forward, but with the GIVEN mask — recompute must
+    never re-draw RNG (transpiler/recompute.py). Inserted after backward
+    construction, so it needs no gradient."""
+    x, mask = ins["X"][0], ins["Mask"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):  # frozen dropout replays as identity
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+    elif impl == "upscale_in_train":
+        scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
+        out = x * mask.astype(x.dtype) * scale
+    else:
+        out = x * mask.astype(x.dtype)
+    return {"Out": [out]}
+
+
 @register_op("dropout", stateful=True, non_diff_outputs={"Mask"},
              grad_maker=_dropout_grad_maker, grad_lower=_dropout_grad_lower)
 def _dropout(ctx, ins, attrs):

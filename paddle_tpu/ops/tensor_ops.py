@@ -576,3 +576,16 @@ def _py_func(ctx, ins, attrs):
     if not isinstance(outs, (list, tuple)):
         outs = [outs]
     return {"Out": list(outs)}
+
+
+@register_op("optimization_barrier", not_differentiable=True,
+             grad_free=True)
+def _optimization_barrier(ctx, ins, attrs):
+    """XLA opt-barrier: values pass through unchanged, but the compiler
+    cannot CSE computations across it. The recompute transpiler feeds the
+    cloned segments' inputs through one of these so the clones stay
+    distinct from the original forward ops (exactly how jax.checkpoint
+    keeps its rematerialized HLO from being deduplicated)."""
+    xs = tuple(ins["X"])
+    outs = jax.lax.optimization_barrier(xs)
+    return {"Out": list(outs)}
