@@ -1,9 +1,19 @@
 #!/bin/sh
-# Build the standalone PJRT inference runner + training loop.
+# Build the native inference stack:
+#   libpaddle_tpu_infer.so  - linkable C API engine (paddle_tpu_infer.h)
+#   pjrt_runner             - CLI client of the library
+#   capi_smoke              - plain-C consumer (compiled with gcc -std=c99)
+#   pjrt_trainer            - standalone C++ training loop
 #   native/pjrt_runner/build.sh [out_dir]
 set -e
 cd "$(dirname "$0")"
 OUT="${1:-.}"
-g++ -O2 -std=c++17 -I. pjrt_runner.cc -ldl -o "$OUT/pjrt_runner"
+mkdir -p "$OUT"
+g++ -O2 -std=c++17 -fPIC -shared -I. paddle_tpu_infer.cc -ldl \
+    -o "$OUT/libpaddle_tpu_infer.so"
+g++ -O2 -std=c++17 -I. pjrt_runner.cc -L"$OUT" -lpaddle_tpu_infer \
+    -Wl,-rpath,'$ORIGIN' -o "$OUT/pjrt_runner"
+gcc -O2 -std=c99 -I. capi_smoke.c -L"$OUT" -lpaddle_tpu_infer \
+    -Wl,-rpath,'$ORIGIN' -o "$OUT/capi_smoke"
 g++ -O2 -std=c++17 -I. pjrt_trainer.cc -ldl -o "$OUT/pjrt_trainer"
-echo "built $OUT/pjrt_runner $OUT/pjrt_trainer"
+echo "built $OUT/libpaddle_tpu_infer.so $OUT/pjrt_runner $OUT/capi_smoke $OUT/pjrt_trainer"

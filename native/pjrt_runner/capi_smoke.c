@@ -1,0 +1,95 @@
+/* Plain-C smoke test for libpaddle_tpu_infer (the linkable C API the
+ * reference exposes as paddle_inference_api.h / capi). Compiled with a
+ * C compiler — proving a non-C++ serving process can drive the engine.
+ *
+ *   capi_smoke <plugin.so> <artifact_dir> <in0.bin> [in1.bin ...]
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "paddle_tpu_infer.h"
+
+static char* read_file(const char* path, long long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(n);
+  if (fread(buf, 1, n, f) != (size_t)n) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  fclose(f);
+  *size = n;
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <plugin.so> <artifact> <in0.bin> ... [k=v ...]\n",
+            argv[0]);
+    return 2;
+  }
+  /* args with '=' are plugin create options, the rest input files */
+  const char* files[16];
+  const char* opts[16];
+  int nfiles = 0, nopts = 0;
+  for (int i = 3; i < argc; ++i) {
+    if (strchr(argv[i], '=') && nopts < 16)
+      opts[nopts++] = argv[i];
+    else if (nfiles < 16)
+      files[nfiles++] = argv[i];
+  }
+  char err[512];
+  PTI_Predictor* p =
+      PTI_Create(argv[1], argv[2], nopts ? opts : NULL, nopts, err,
+                 sizeof(err));
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", err);
+    return 1;
+  }
+  int nin = PTI_NumInputs(p), nout = PTI_NumOutputs(p);
+  printf("inputs=%d outputs=%d\n", nin, nout);
+  if (nfiles != nin) {
+    fprintf(stderr, "need %d inputs\n", nin);
+    return 1;
+  }
+  const void** ins = (const void**)calloc(nin, sizeof(void*));
+  for (int i = 0; i < nin; ++i) {
+    long long sz;
+    char* data = read_file(files[i], &sz);
+    if (!data || sz != PTI_InputByteSize(p, i)) {
+      fprintf(stderr, "input %d: bad file or size\n", i);
+      return 1;
+    }
+    ins[i] = data;
+  }
+  void** outs = (void**)calloc(nout, sizeof(void*));
+  for (int i = 0; i < nout; ++i) {
+    long long dims[8];
+    int nd = PTI_OutputShape(p, i, dims, 8);
+    printf("out%d dtype=%s ndims=%d bytes=%lld\n", i,
+           PTI_OutputDtype(p, i), nd, PTI_OutputByteSize(p, i));
+    outs[i] = malloc(PTI_OutputByteSize(p, i));
+  }
+  if (PTI_Run(p, ins, outs, err, sizeof(err))) {
+    fprintf(stderr, "run failed: %s\n", err);
+    return 1;
+  }
+  /* run twice: the predictor must be reusable (buffer lifecycle) */
+  if (PTI_Run(p, ins, outs, err, sizeof(err))) {
+    fprintf(stderr, "second run failed: %s\n", err);
+    return 1;
+  }
+  if (nout > 0 && strcmp(PTI_OutputDtype(p, 0), "float32") == 0) {
+    const float* f = (const float*)outs[0];
+    printf("out0 first=%g\n", f[0]);
+  }
+  PTI_Destroy(p);
+  printf("CAPI-OK\n");
+  return 0;
+}

@@ -1,33 +1,27 @@
-// Standalone C++ inference runner over the PJRT C API.
+// Standalone CLI inference runner — thin client of libpaddle_tpu_infer.
 //
-// The reference trains/serves without Python through its C++ Executor
-// (paddle/fluid/train/demo, inference/api/api.cc). The TPU-native
-// equivalent: the framework exports StableHLO (inference.export_native),
-// and this host loop dlopens ANY PJRT C-API plugin (libtpu.so, a CPU
-// plugin, or the axon tunnel plugin) and runs the model — no Python in
-// the serving path.
+// The reference serves without Python through inference/api/api.cc; the
+// engine here lives in paddle_tpu_infer.cc (the linkable C API), and
+// this binary is just the command-line face of it:
 //
 //   pjrt_runner <plugin.so> <artifact_dir> <in0.bin> [in1.bin ...] \
-//               [-o key=value ...]    # plugin create options
+//               [-o key=value ...] [--repeat N]
 //
 // Inputs are raw little-endian arrays matching manifest.json; outputs
 // are written to <artifact_dir>/out<i>.bin and summarized on stdout.
-//
-// Build:  g++ -O2 -std=c++17 -I<pjrt_c_api_include> pjrt_runner.cc \
-//             -ldl -o pjrt_runner
-// (pjrt_c_api.h is vendored next to this file.)
+// --repeat N times the steady-state PTI_Run latency (for BASELINE rows).
 
-#include <dlfcn.h>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <chrono>
 #include <fstream>
 #include <sstream>
 #include <string>
 #include <vector>
 
-#include "pjrt_c_api.h"
+#include "paddle_tpu_infer.h"
 
 namespace {
 
@@ -36,111 +30,12 @@ namespace {
   std::exit(1);
 }
 
-std::string ReadFile(const std::string& path, bool binary = true) {
-  std::ifstream f(path, binary ? std::ios::binary : std::ios::in);
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
   if (!f) Die("cannot open " + path);
   std::ostringstream ss;
   ss << f.rdbuf();
   return ss.str();
-}
-
-const PJRT_Api* g_api = nullptr;
-
-void Check(PJRT_Error* err, const char* what) {
-  if (err == nullptr) return;
-  PJRT_Error_Message_Args margs;
-  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
-  margs.extension_start = nullptr;
-  margs.error = err;
-  g_api->PJRT_Error_Message(&margs);
-  std::string msg(margs.message, margs.message_size);
-  PJRT_Error_Destroy_Args dargs;
-  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-  dargs.extension_start = nullptr;
-  dargs.error = err;
-  g_api->PJRT_Error_Destroy(&dargs);
-  Die(std::string(what) + ": " + msg);
-}
-
-void Await(PJRT_Event* event, const char* what) {
-  PJRT_Event_Await_Args args;
-  args.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-  args.extension_start = nullptr;
-  args.event = event;
-  Check(g_api->PJRT_Event_Await(&args), what);
-  PJRT_Event_Destroy_Args d;
-  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-  d.extension_start = nullptr;
-  d.event = event;
-  Check(g_api->PJRT_Event_Destroy(&d), "event destroy");
-}
-
-// ---- tiny JSON manifest parsing (flat, trusted artifact) -------------------
-
-struct TensorMeta {
-  std::vector<int64_t> shape;
-  std::string dtype;
-};
-
-// extracts "shape": [..] and "dtype": ".." pairs in order of appearance
-// within the given section ("inputs" / "outputs")
-std::vector<TensorMeta> ParseSection(const std::string& js,
-                                     const std::string& section) {
-  std::vector<TensorMeta> out;
-  size_t sec = js.find("\"" + section + "\"");
-  if (sec == std::string::npos) return out;
-  // find the section's closing bracket by bracket counting
-  size_t open = js.find("[", sec);
-  int depth = 0;
-  size_t close = open;
-  for (size_t i = open; i < js.size(); ++i) {
-    if (js[i] == '[') depth++;
-    if (js[i] == ']' && --depth == 0) {
-      close = i;
-      break;
-    }
-  }
-  std::string body = js.substr(open, close - open + 1);
-  size_t pos = 0;
-  while (true) {
-    size_t sh = body.find("\"shape\"", pos);
-    if (sh == std::string::npos) break;
-    size_t lb = body.find("[", sh);
-    size_t rb = body.find("]", lb);
-    TensorMeta m;
-    std::string nums = body.substr(lb + 1, rb - lb - 1);
-    std::stringstream ns(nums);
-    std::string tok;
-    while (std::getline(ns, tok, ','))
-      if (!tok.empty()) m.shape.push_back(std::stoll(tok));
-    size_t dt = body.find("\"dtype\"", rb);
-    size_t q1 = body.find('"', body.find(':', dt));
-    size_t q2 = body.find('"', q1 + 1);
-    m.dtype = body.substr(q1 + 1, q2 - q1 - 1);
-    out.push_back(m);
-    pos = q2;
-  }
-  return out;
-}
-
-PJRT_Buffer_Type DtypeToPjrt(const std::string& d) {
-  if (d == "float32") return PJRT_Buffer_Type_F32;
-  if (d == "float64") return PJRT_Buffer_Type_F64;
-  if (d == "bfloat16") return PJRT_Buffer_Type_BF16;
-  if (d == "float16") return PJRT_Buffer_Type_F16;
-  if (d == "int64") return PJRT_Buffer_Type_S64;
-  if (d == "int32") return PJRT_Buffer_Type_S32;
-  if (d == "int8") return PJRT_Buffer_Type_S8;
-  if (d == "uint8") return PJRT_Buffer_Type_U8;
-  if (d == "bool") return PJRT_Buffer_Type_PRED;
-  Die("unsupported dtype " + d);
-}
-
-size_t DtypeSize(const std::string& d) {
-  if (d == "float64" || d == "int64") return 8;
-  if (d == "float32" || d == "int32") return 4;
-  if (d == "bfloat16" || d == "float16") return 2;
-  return 1;
 }
 
 }  // namespace
@@ -149,230 +44,94 @@ int main(int argc, char** argv) {
   if (argc < 3) {
     std::fprintf(stderr,
                  "usage: %s <plugin.so> <artifact_dir> [in0.bin ...] "
-                 "[-o key=value ...]\n",
+                 "[-o key=value ...] [--repeat N]\n",
                  argv[0]);
     return 2;
   }
   const std::string plugin = argv[1];
   const std::string dir = argv[2];
   std::vector<std::string> input_files;
-  std::vector<std::pair<std::string, std::string>> opts;
+  std::vector<std::string> opts;
+  int repeat = 1;
   for (int i = 3; i < argc; ++i) {
     if (std::strcmp(argv[i], "-o") == 0 && i + 1 < argc) {
-      std::string kv = argv[++i];
-      size_t eq = kv.find('=');
-      if (eq == std::string::npos) Die("bad -o " + kv);
-      opts.emplace_back(kv.substr(0, eq), kv.substr(eq + 1));
+      opts.emplace_back(argv[++i]);
+    } else if (std::strcmp(argv[i], "--repeat") == 0 && i + 1 < argc) {
+      repeat = std::atoi(argv[++i]);
     } else {
       input_files.push_back(argv[i]);
     }
   }
 
-  // ---- load plugin ---------------------------------------------------------
-  void* handle = dlopen(plugin.c_str(), RTLD_NOW | RTLD_LOCAL);
-  if (!handle) Die(std::string("dlopen: ") + dlerror());
-  using GetApiFn = const PJRT_Api* (*)();
-  auto get_api = reinterpret_cast<GetApiFn>(dlsym(handle, "GetPjrtApi"));
-  if (!get_api) Die("plugin has no GetPjrtApi symbol");
-  g_api = get_api();
-  std::printf("plugin PJRT API v%d.%d (header v%d.%d)\n",
-              g_api->pjrt_api_version.major_version,
-              g_api->pjrt_api_version.minor_version, PJRT_API_MAJOR,
-              PJRT_API_MINOR);
+  std::vector<const char*> opt_ptrs;
+  for (const auto& o : opts) opt_ptrs.push_back(o.c_str());
+  char err[1024];
+  PTI_Predictor* pred =
+      PTI_Create(plugin.c_str(), dir.c_str(),
+                 opt_ptrs.empty() ? nullptr : opt_ptrs.data(),
+                 static_cast<int>(opt_ptrs.size()), err, sizeof(err));
+  if (!pred) Die(err);
+  std::printf("compiled artifact %s (%d inputs, %d outputs)\n",
+              dir.c_str(), PTI_NumInputs(pred), PTI_NumOutputs(pred));
 
-  PJRT_Plugin_Initialize_Args pi;
-  pi.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
-  pi.extension_start = nullptr;
-  Check(g_api->PJRT_Plugin_Initialize(&pi), "plugin init");
-
-  // ---- client with -o options (string or int64 by syntax) ------------------
-  std::vector<PJRT_NamedValue> named;
-  std::vector<int64_t> int_store(opts.size());
-  named.reserve(opts.size());
-  for (size_t i = 0; i < opts.size(); ++i) {
-    PJRT_NamedValue v;
-    v.struct_size = PJRT_NamedValue_STRUCT_SIZE;
-    v.extension_start = nullptr;
-    v.name = opts[i].first.c_str();
-    v.name_size = opts[i].first.size();
-    const std::string& val = opts[i].second;
-    char* endp = nullptr;
-    long long as_int = std::strtoll(val.c_str(), &endp, 10);
-    if (endp && *endp == '\0' && !val.empty()) {
-      int_store[i] = as_int;
-      v.type = PJRT_NamedValue_kInt64;
-      v.int64_value = int_store[i];
-      v.value_size = 1;
-    } else {
-      v.type = PJRT_NamedValue_kString;
-      v.string_value = val.c_str();
-      v.value_size = val.size();
-    }
-    named.push_back(v);
-  }
-
-  PJRT_Client_Create_Args cc;
-  cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
-  cc.extension_start = nullptr;
-  cc.create_options = named.empty() ? nullptr : named.data();
-  cc.num_options = named.size();
-  cc.kv_get_callback = nullptr;
-  cc.kv_get_user_arg = nullptr;
-  cc.kv_put_callback = nullptr;
-  cc.kv_put_user_arg = nullptr;
-  cc.kv_try_get_callback = nullptr;
-  cc.kv_try_get_user_arg = nullptr;
-  Check(g_api->PJRT_Client_Create(&cc), "client create");
-  PJRT_Client* client = cc.client;
-
-  PJRT_Client_AddressableDevices_Args ad;
-  ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
-  ad.extension_start = nullptr;
-  ad.client = client;
-  Check(g_api->PJRT_Client_AddressableDevices(&ad), "devices");
-  if (ad.num_addressable_devices == 0) Die("no addressable devices");
-  PJRT_Device* device = ad.addressable_devices[0];
-  std::printf("devices: %zu\n", ad.num_addressable_devices);
-
-  // ---- compile -------------------------------------------------------------
-  std::string mlir = ReadFile(dir + "/model.mlir", /*binary=*/false);
-  std::string copts = ReadFile(dir + "/compile_options.pb");
-  std::string manifest = ReadFile(dir + "/manifest.json", false);
-  auto in_meta = ParseSection(manifest, "inputs");
-  auto out_meta = ParseSection(manifest, "outputs");
-  if (input_files.size() != in_meta.size())
-    Die("model needs " + std::to_string(in_meta.size()) + " inputs, got " +
+  int nin = PTI_NumInputs(pred);
+  if (static_cast<int>(input_files.size()) != nin)
+    Die("model needs " + std::to_string(nin) + " inputs, got " +
         std::to_string(input_files.size()));
-
-  PJRT_Program prog;
-  prog.struct_size = PJRT_Program_STRUCT_SIZE;
-  prog.extension_start = nullptr;
-  prog.code = mlir.data();
-  prog.code_size = mlir.size();
-  static const char kFmt[] = "mlir";
-  prog.format = kFmt;
-  prog.format_size = sizeof(kFmt) - 1;
-
-  PJRT_Client_Compile_Args comp;
-  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
-  comp.extension_start = nullptr;
-  comp.client = client;
-  comp.program = &prog;
-  comp.compile_options = copts.data();
-  comp.compile_options_size = copts.size();
-  Check(g_api->PJRT_Client_Compile(&comp), "compile");
-  PJRT_LoadedExecutable* exec = comp.executable;
-  std::printf("compiled %zu-byte StableHLO\n", mlir.size());
-
-  // the executable's REAL output count must match the manifest — PJRT
-  // fills output_lists[0][i] for every executable output, so a stale
-  // manifest would otherwise overflow the buffer array
-  PJRT_LoadedExecutable_GetExecutable_Args ge;
-  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
-  ge.extension_start = nullptr;
-  ge.loaded_executable = exec;
-  Check(g_api->PJRT_LoadedExecutable_GetExecutable(&ge), "get executable");
-  PJRT_Executable_NumOutputs_Args no;
-  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
-  no.extension_start = nullptr;
-  no.executable = ge.executable;
-  Check(g_api->PJRT_Executable_NumOutputs(&no), "num outputs");
-  if (no.num_outputs != out_meta.size())
-    Die("manifest lists " + std::to_string(out_meta.size()) +
-        " outputs but the executable produces " +
-        std::to_string(no.num_outputs) + " — regenerate the artifact");
-
-  // ---- stage inputs --------------------------------------------------------
-  std::vector<std::string> raw(in_meta.size());
-  std::vector<PJRT_Buffer*> in_bufs(in_meta.size());
-  for (size_t i = 0; i < in_meta.size(); ++i) {
+  std::vector<std::string> raw(nin);
+  std::vector<const void*> ins(nin);
+  for (int i = 0; i < nin; ++i) {
     raw[i] = ReadFile(input_files[i]);
-    size_t want = DtypeSize(in_meta[i].dtype);
-    for (int64_t d : in_meta[i].shape) want *= d;
-    if (raw[i].size() != want)
+    long long want = PTI_InputByteSize(pred, i);
+    if (static_cast<long long>(raw[i].size()) != want)
       Die("input " + std::to_string(i) + " is " +
           std::to_string(raw[i].size()) + " bytes, manifest wants " +
           std::to_string(want));
-    PJRT_Client_BufferFromHostBuffer_Args hb;
-    hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-    hb.extension_start = nullptr;
-    hb.client = client;
-    hb.data = raw[i].data();
-    hb.type = DtypeToPjrt(in_meta[i].dtype);
-    hb.dims = in_meta[i].shape.data();
-    hb.num_dims = in_meta[i].shape.size();
-    hb.byte_strides = nullptr;
-    hb.num_byte_strides = 0;
-    hb.host_buffer_semantics =
-        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-    hb.device = device;
-    hb.memory = nullptr;
-    hb.device_layout = nullptr;
-    Check(g_api->PJRT_Client_BufferFromHostBuffer(&hb), "h2d");
-    Await(hb.done_with_host_buffer, "h2d done");
-    in_bufs[i] = hb.buffer;
+    ins[i] = raw[i].data();
   }
 
-  // ---- execute -------------------------------------------------------------
-  PJRT_ExecuteOptions eo;
-  eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
-  eo.extension_start = nullptr;
-  eo.send_callbacks = nullptr;
-  eo.recv_callbacks = nullptr;
-  eo.num_send_ops = 0;
-  eo.num_recv_ops = 0;
-  eo.launch_id = 0;
-  eo.non_donatable_input_indices = nullptr;
-  eo.num_non_donatable_input_indices = 0;
-  eo.context = nullptr;
+  int nout = PTI_NumOutputs(pred);
+  std::vector<std::string> host(nout);
+  std::vector<void*> outs(nout);
+  for (int i = 0; i < nout; ++i) {
+    host[i].resize(PTI_OutputByteSize(pred, i));
+    outs[i] = host[i].data();
+  }
 
-  PJRT_LoadedExecutable_Execute_Args ex;
-  ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-  ex.extension_start = nullptr;
-  ex.executable = exec;
-  ex.options = &eo;
-  PJRT_Buffer* const* arg_list = in_bufs.data();
-  ex.argument_lists = &arg_list;
-  ex.num_devices = 1;
-  ex.num_args = in_bufs.size();
-  std::vector<PJRT_Buffer*> out_bufs(out_meta.size());
-  PJRT_Buffer** out_list = out_bufs.data();
-  ex.output_lists = &out_list;
-  PJRT_Event* done = nullptr;
-  ex.device_complete_events = &done;
-  ex.execute_device = nullptr;
-  Check(g_api->PJRT_LoadedExecutable_Execute(&ex), "execute");
-  if (done) Await(done, "execute done");
+  if (PTI_Run(pred, ins.data(), outs.data(), err, sizeof(err)))
+    Die(err);
+  if (repeat > 1) {
+    auto t0 = std::chrono::steady_clock::now();
+    for (int r = 0; r < repeat; ++r) {
+      if (PTI_Run(pred, ins.data(), outs.data(), err, sizeof(err)))
+        Die(err);
+    }
+    double ms = std::chrono::duration<double, std::milli>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count() /
+                repeat;
+    std::printf("steady-state latency: %.3f ms/run over %d runs\n", ms,
+                repeat);
+  }
 
-  // ---- fetch outputs -------------------------------------------------------
-  for (size_t i = 0; i < out_bufs.size(); ++i) {
-    size_t bytes = DtypeSize(out_meta[i].dtype);
-    for (int64_t d : out_meta[i].shape) bytes *= d;
-    std::string host(bytes, '\0');
-    PJRT_Buffer_ToHostBuffer_Args th;
-    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-    th.extension_start = nullptr;
-    th.src = out_bufs[i];
-    th.host_layout = nullptr;
-    th.dst = host.data();
-    th.dst_size = bytes;
-    Check(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
-    Await(th.event, "d2h done");
+  for (int i = 0; i < nout; ++i) {
     std::string out_path = dir + "/out" + std::to_string(i) + ".bin";
     std::ofstream of(out_path, std::ios::binary);
-    of.write(host.data(), host.size());
-    // print a small numeric summary for eyeballing
-    if (out_meta[i].dtype == "float32") {
-      const float* f = reinterpret_cast<const float*>(host.data());
-      size_t n = bytes / 4;
+    of.write(host[i].data(), host[i].size());
+    const char* dt = PTI_OutputDtype(pred, i);
+    if (dt && std::strcmp(dt, "float32") == 0) {
+      const float* f = reinterpret_cast<const float*>(host[i].data());
+      size_t n = host[i].size() / 4;
       double sum = 0;
       for (size_t k = 0; k < n; ++k) sum += f[k];
-      std::printf("out%zu: %zu floats, first=%g mean=%g -> %s\n", i, n,
+      std::printf("out%d: %zu floats, first=%g mean=%g -> %s\n", i, n,
                   n ? f[0] : 0.0, n ? sum / n : 0.0, out_path.c_str());
     } else {
-      std::printf("out%zu: %zu bytes -> %s\n", i, bytes, out_path.c_str());
+      std::printf("out%d: %zu bytes -> %s\n", i, host[i].size(),
+                  out_path.c_str());
     }
   }
+  PTI_Destroy(pred);
   std::printf("OK\n");
   return 0;
 }

@@ -159,10 +159,25 @@ class Communicator:
             g = batch.get(s.grad_name)
             if g is None:
                 continue
-            c = self._client(self._send_clients, s.endpoint)
             if s.sparse and isinstance(g, tuple):
-                c.push_sparse(s.name, g[0], g[1])
+                # id-hash sharded over all servers (this thread's own
+                # client cache). Shards push sequentially; on a partial
+                # failure the batch keeps only the UNSENT rows — a
+                # retried push then cannot double-apply the shards whose
+                # server-side optimizer update already ran.
+                parts = plan.sparse_shard_parts(s, g[0], g[1])
+                for j, (ep, r, v) in enumerate(parts):
+                    try:
+                        self._client(self._send_clients, ep).push_sparse(
+                            s.name, r, v)
+                    except Exception:
+                        rem = parts[j:]
+                        batch[s.grad_name] = (
+                            np.concatenate([p[1] for p in rem]),
+                            np.concatenate([p[2] for p in rem]))
+                        raise
             else:
+                c = self._client(self._send_clients, s.endpoint)
                 c.push_dense(s.name, np.asarray(g, np.float32))
             del batch[s.grad_name]
         self.sent_batches += 1

@@ -111,3 +111,171 @@ def transformer_nmt(src_vocab: int, tgt_vocab: int, src_len: int,
     loss = layers.reduce_sum(ce) / (layers.reduce_sum(tgt_mask) + 1e-9)
     return {"feed": ["src", "src_lens", "tgt_in", "tgt_out", "tgt_lens"],
             "loss": loss, "logits": logits}
+
+
+# ---------------------------------------------------------------------------
+# Shared encoder-block pair for the dygraph<->static parity matrix
+# (reference test_imperative_transformer / test_dist_transformer pattern:
+# the SAME weights through both execution modes must match)
+# ---------------------------------------------------------------------------
+
+def encoder_block_weights(hidden, heads, ffn_dim, n_layers, vocab,
+                          seed=11):
+    """One flat numpy weight dict both builders consume."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+
+    def mat(a, b):
+        return (rng.randn(a, b) * 0.02).astype("float32")
+
+    w = {"emb": mat(vocab, hidden), "cls.w": mat(hidden, vocab),
+         "cls.b": np.zeros(vocab, "float32")}
+    for i in range(n_layers):
+        p = f"l{i}"
+        for nm in ("q", "k", "v", "o"):
+            w[f"{p}.{nm}.w"] = mat(hidden, hidden)
+            w[f"{p}.{nm}.b"] = np.zeros(hidden, "float32")
+        w[f"{p}.f1.w"] = mat(hidden, ffn_dim)
+        w[f"{p}.f1.b"] = np.zeros(ffn_dim, "float32")
+        w[f"{p}.f2.w"] = mat(ffn_dim, hidden)
+        w[f"{p}.f2.b"] = np.zeros(hidden, "float32")
+        for ln in ("ln1", "ln2"):
+            w[f"{p}.{ln}.scale"] = np.ones(hidden, "float32")
+            w[f"{p}.{ln}.bias"] = np.zeros(hidden, "float32")
+    return w
+
+
+def encoder_block_program(w, hidden, heads, ffn_dim, n_layers, seq_len,
+                          vocab):
+    """Static pre-LN encoder stack + mean-pool classifier over vocab.
+    Returns (main, startup, loss)."""
+    import math
+    from ..framework.layer_helper import ParamAttr
+    from ..initializer import NumpyArrayInitializer
+    from ..framework.core import Program, program_guard
+    from .. import optimizer as _opt  # noqa: F401  (callers minimize)
+
+    def attr(name):
+        return ParamAttr(name=name,
+                         initializer=NumpyArrayInitializer(w[name]))
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        toks = layers.data("tokens", [seq_len], dtype="int64")
+        label = layers.data("label", [1], dtype="int64")
+        x = layers.embedding(toks, size=[vocab, hidden],
+                             param_attr=attr("emb"))
+        hd = hidden // heads
+        for i in range(n_layers):
+            p = f"l{i}"
+            h = layers.layer_norm(x, begin_norm_axis=2,
+                                  param_attr=attr(f"{p}.ln1.scale"),
+                                  bias_attr=attr(f"{p}.ln1.bias"))
+
+            def proj(nm):
+                t = layers.fc(h, hidden, num_flatten_dims=2,
+                              param_attr=attr(f"{p}.{nm}.w"),
+                              bias_attr=attr(f"{p}.{nm}.b"))
+                t = layers.reshape(t, [0, seq_len, heads, hd])
+                return layers.transpose(t, [0, 2, 1, 3])
+            q, k, v = proj("q"), proj("k"), proj("v")
+            s = layers.matmul(q, k, transpose_y=True,
+                              alpha=1.0 / math.sqrt(hd))
+            ctx = layers.matmul(layers.softmax(s), v)
+            ctx = layers.reshape(layers.transpose(ctx, [0, 2, 1, 3]),
+                                 [0, seq_len, hidden])
+            x = x + layers.fc(ctx, hidden, num_flatten_dims=2,
+                              param_attr=attr(f"{p}.o.w"),
+                              bias_attr=attr(f"{p}.o.b"))
+            h = layers.layer_norm(x, begin_norm_axis=2,
+                                  param_attr=attr(f"{p}.ln2.scale"),
+                                  bias_attr=attr(f"{p}.ln2.bias"))
+            h = layers.fc(h, ffn_dim, num_flatten_dims=2, act="relu",
+                          param_attr=attr(f"{p}.f1.w"),
+                          bias_attr=attr(f"{p}.f1.b"))
+            x = x + layers.fc(h, hidden, num_flatten_dims=2,
+                              param_attr=attr(f"{p}.f2.w"),
+                              bias_attr=attr(f"{p}.f2.b"))
+        pooled = layers.reduce_mean(x, dim=1)
+        logits = layers.fc(pooled, vocab, param_attr=attr("cls.w"),
+                           bias_attr=attr("cls.b"))
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+    return main, startup, loss
+
+
+def make_dygraph_encoder(w, hidden, heads, ffn_dim, n_layers, vocab):
+    """Eager twin of encoder_block_program: returns (layer_list, forward)
+    where forward(tokens VarBase, label VarBase) -> loss VarBase."""
+    import math
+    from .. import dygraph
+    from ..dygraph.base import trace_op
+    from ..framework.layer_helper import ParamAttr
+    from ..initializer import NumpyArrayInitializer
+
+    def attr(name):
+        return ParamAttr(name=name,
+                         initializer=NumpyArrayInitializer(w[name]))
+
+    emb = dygraph.Embedding([vocab, hidden], param_attr=attr("emb"))
+    blocks = []
+    for i in range(n_layers):
+        p = f"l{i}"
+        blk = {
+            "ln1": dygraph.LayerNorm(
+                hidden, begin_norm_axis=2,
+                param_attr=attr(f"{p}.ln1.scale"),
+                bias_attr=attr(f"{p}.ln1.bias")),
+            "ln2": dygraph.LayerNorm(
+                hidden, begin_norm_axis=2,
+                param_attr=attr(f"{p}.ln2.scale"),
+                bias_attr=attr(f"{p}.ln2.bias")),
+        }
+        for nm in ("q", "k", "v", "o"):
+            blk[nm] = dygraph.Linear(hidden, hidden,
+                                     param_attr=attr(f"{p}.{nm}.w"),
+                                     bias_attr=attr(f"{p}.{nm}.b"))
+        blk["f1"] = dygraph.Linear(hidden, ffn_dim, act="relu",
+                                   param_attr=attr(f"{p}.f1.w"),
+                                   bias_attr=attr(f"{p}.f1.b"))
+        blk["f2"] = dygraph.Linear(ffn_dim, hidden,
+                                   param_attr=attr(f"{p}.f2.w"),
+                                   bias_attr=attr(f"{p}.f2.b"))
+        blocks.append(blk)
+    cls = dygraph.Linear(hidden, vocab, param_attr=attr("cls.w"),
+                         bias_attr=attr("cls.b"))
+    hd = hidden // heads
+
+    def tr1(op, ins, attrs=None):
+        return trace_op(op, ins, attrs or {})["Out"][0]
+
+    def forward(tokens, label):
+        seq = tokens.shape[1]
+        x = emb(tokens)
+        for blk in blocks:
+            h = blk["ln1"](x)
+
+            def proj(nm):
+                t = tr1("reshape2", {"X": [blk[nm](h)]},
+                        {"shape": [0, seq, heads, hd]})
+                return tr1("transpose2", {"X": [t]},
+                           {"axis": [0, 2, 1, 3]})
+            q, k, v = proj("q"), proj("k"), proj("v")
+            s = tr1("matmul", {"X": [q], "Y": [k]},
+                    {"transpose_Y": True, "alpha": 1.0 / math.sqrt(hd)})
+            ctx = tr1("matmul", {"X": [tr1("softmax", {"X": [s]})],
+                                 "Y": [v]})
+            ctx = tr1("reshape2",
+                      {"X": [tr1("transpose2", {"X": [ctx]},
+                                 {"axis": [0, 2, 1, 3]})]},
+                      {"shape": [0, seq, hidden]})
+            x = x + blk["o"](ctx)
+            h2 = blk["f1"](blk["ln2"](x))
+            x = x + blk["f2"](h2)
+        pooled = dygraph.nn.reduce_mean(x, dim=1)
+        loss = dygraph.nn.reduce_mean(
+            dygraph.nn.softmax_with_cross_entropy(cls(pooled), label))
+        return loss
+
+    all_layers = [emb, cls] + [m for blk in blocks for m in blk.values()]
+    return all_layers, forward

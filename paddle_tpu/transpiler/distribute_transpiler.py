@@ -289,6 +289,74 @@ class PSPlan:
                                                trainer_id=self.trainer_id)
         return self._clients[endpoint]
 
+    # -- sparse-table sharding over ALL pservers -----------------------------
+    # The reference shards every var across pservers (VarBlock splitting,
+    # distribute_transpiler.py:70); here dense params stay whole-var
+    # (they're small next to embeddings) but sparse tables shard rows by
+    # id % n_servers over every endpoint, with the per-server round trips
+    # fanned out concurrently — the point of having N servers.
+
+    def _pool(self):
+        with self._lock:  # trainer + communicator threads race first use
+            if getattr(self, "_fanout_pool", None) is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._fanout_pool = ThreadPoolExecutor(
+                    max_workers=max(2, len(self.endpoints)))
+            return self._fanout_pool
+
+    def sparse_shard_parts(self, spec, rows: np.ndarray, vals: np.ndarray):
+        """[(endpoint, rows_shard, vals_shard)] over ALL endpoints (empty
+        shards included — sync aggregation counts a contribution per
+        trainer per table on every server)."""
+        eps = self.endpoints
+        n = len(eps)
+        if n == 1:
+            return [(eps[0], rows, vals)]
+        asn = rows % n
+        out = []
+        for i, ep in enumerate(eps):
+            m = np.nonzero(asn == i)[0]
+            out.append((ep, rows[m], vals[m]))
+        return out
+
+    def pull_sparse_sharded(self, spec, ids: np.ndarray) -> np.ndarray:
+        eps = self.endpoints
+        n = len(eps)
+        if n == 1:
+            return self._client(eps[0]).pull_sparse(spec.name, ids,
+                                                    spec.dim)
+        asn = ids % n
+        out = np.empty((len(ids), spec.dim), np.float32)
+        clients = [self._client(ep) for ep in eps]  # pre-create: the
+        # client cache dict is not touched from worker threads
+
+        def one(i):
+            m = np.nonzero(asn == i)[0]
+            if len(m):
+                out[m] = clients[i].pull_sparse(spec.name, ids[m],
+                                                spec.dim)
+        list(self._pool().map(one, range(n)))
+        return out
+
+    def push_sparse_sharded(self, spec, rows: np.ndarray,
+                            vals: np.ndarray, client_fn=None):
+        """Push sparse grads to their id-hash shards. EVERY server gets a
+        push (possibly zero rows): in sync mode the aggregation barrier
+        counts one contribution per trainer per table, so a skipped empty
+        shard would stall the round."""
+        get = client_fn or self._client
+        parts = self.sparse_shard_parts(spec, rows, vals)
+        if len(parts) == 1:
+            get(parts[0][0]).push_sparse(spec.name, parts[0][1],
+                                         parts[0][2])
+            return
+        clients = [get(ep) for ep, _, _ in parts]
+
+        def one(i):
+            _, r, v = parts[i]
+            clients[i].push_sparse(spec.name, r, v)
+        list(self._pool().map(one, range(len(parts))))
+
     def ensure_init(self, scope):
         """First-run handshake: trainer 0 creates tables and seeds them from
         its startup-initialized scope; everyone then pulls a consistent
@@ -299,14 +367,21 @@ class PSPlan:
                 return
             if self.trainer_id == 0:
                 for s in self.specs:
-                    c = self._client(s.endpoint)
                     h0, h1, h2 = s.hyper
                     w = np.asarray(scope.find_var(s.name), np.float32)
                     if s.sparse:
-                        c.create_sparse(s.name, s.dim, opt=s.opt, lr=0.0,
-                                        beta1=h0, beta2=h1, epsilon=h2)
-                        c.init_sparse(s.name, np.arange(s.shape[0]), w)
+                        # sharded: every server holds its id%n rows
+                        n = len(self.endpoints)
+                        all_ids = np.arange(s.shape[0])
+                        for i, ep in enumerate(self.endpoints):
+                            c = self._client(ep)
+                            c.create_sparse(s.name, s.dim, opt=s.opt,
+                                            lr=0.0, beta1=h0, beta2=h1,
+                                            epsilon=h2)
+                            shard = all_ids[all_ids % n == i]
+                            c.init_sparse(s.name, shard, w[shard])
                     else:
+                        c = self._client(s.endpoint)
                         c.create_dense(s.name, s.size, opt=s.opt, lr=0.0,
                                        beta1=h0, beta2=h1, epsilon=h2)
                         c.init_dense(s.name, w)
@@ -341,7 +416,7 @@ class PSPlan:
                 ids = np.arange(s.shape[0])  # no feed mapping: pull all
             else:
                 ids = np.unique(np.asarray(feed[s.ids_feed]).ravel())
-            rows = self._client(s.endpoint).pull_sparse(s.name, ids, s.dim)
+            rows = self.pull_sparse_sharded(s, ids)
             target = bucket_for(len(ids),
                                 pow2_boundaries(64, int(s.shape[0])))
             if target > len(ids):
@@ -383,7 +458,10 @@ class PSPlan:
     def _sync_lr(self, spec, fetched):
         lr = float(np.ravel(np.asarray(fetched[spec.lr_var]))[0])
         if self._last_lr.get(spec.name) != lr:
-            self._client(spec.endpoint).set_lr(spec.name, lr)
+            # sharded sparse tables exist on EVERY server
+            eps = self.endpoints if spec.sparse else [spec.endpoint]
+            for ep in eps:
+                self._client(ep).set_lr(spec.name, lr)
             self._last_lr[spec.name] = lr
 
     def after_step(self, scope, fetched: Dict[str, object]):
@@ -410,11 +488,10 @@ class PSPlan:
         for s in self.specs:
             self._sync_lr(s, fetched)
             g = self._marshal_grad(s, fetched[s.grad_name])
-            c = self._client(s.endpoint)
             if s.sparse:
-                c.push_sparse(s.name, g[0], g[1])
+                self.push_sparse_sharded(s, g[0], g[1])
             else:
-                c.push_dense(s.name, g)
+                self._client(s.endpoint).push_dense(s.name, g)
         for s in self.specs:
             if s.sparse:
                 continue
@@ -454,6 +531,10 @@ class PSPlan:
         if self._communicator is not None:
             self._communicator.stop()
             self._communicator = None
+        pool = getattr(self, "_fanout_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True)
+            self._fanout_pool = None
         for ep, c in list(self._clients.items()):
             if stop_servers:
                 try:

@@ -42,6 +42,7 @@ _SLOW_FILES = {
     "test_nas.py", "test_pipeline.py", "test_sanitized_native.py",
     "test_dist_ps.py", "test_native_runner.py", "test_native_trainer.py",
     "test_grad_x64.py", "test_detection_models.py", "test_elastic.py",
+    "test_transformer_scale.py", "test_native_capi.py",
 }
 
 # slow tests inside otherwise-quick files (>6s each in the r4 timing run;

@@ -1,0 +1,105 @@
+"""Model-scale Transformer convergence matrix (VERDICT r4 item 7 — the
+reference's test_dist_base.py:436 bar: Transformer trained distributed vs
+local must loss-match within delta, at REAL scale, not a hidden=32 toy).
+
+hidden=256 / 8 heads / ffn 1024: (a) dp8 data-parallel over the virtual
+mesh == single-device trajectory; (b) the same encoder stack trained
+eagerly (dygraph tape) == static Program, shared weights."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.transformer import (
+    encoder_block_program, encoder_block_weights, make_dygraph_encoder,
+    transformer_nmt)
+
+HIDDEN, HEADS, FFN, LAYERS = 256, 8, 1024, 3
+VOCAB, SEQ, BATCH = 1000, 16, 32
+
+
+def _nmt_feeds(steps, rng):
+    feeds = []
+    for _ in range(steps):
+        src = rng.randint(2, VOCAB, (BATCH, SEQ)).astype(np.int64)
+        tgt_full = (src[:, ::-1] + 1) % VOCAB      # reversal task
+        tin = np.concatenate([np.ones((BATCH, 1), np.int64),
+                              tgt_full[:, :-1]], axis=1)
+        feeds.append({"src": src,
+                      "src_lens": np.full((BATCH, 1), SEQ, np.int64),
+                      "tgt_in": tin, "tgt_out": tgt_full,
+                      "tgt_lens": np.full((BATCH, 1), SEQ, np.int64)})
+    return feeds
+
+
+def _run_nmt(feeds, dp8: bool):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 5
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        spec = transformer_nmt(VOCAB, VOCAB, SEQ, SEQ, hidden=HIDDEN,
+                               heads=HEADS, ffn_dim=FFN,
+                               n_layers=LAYERS)
+        pt.optimizer.Adam(1e-3).minimize(spec["loss"])
+    prog = pt.CompiledProgram(main).with_data_parallel() if dp8 else main
+    exe = pt.Executor()
+    losses = []
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for f in feeds:
+            l, = exe.run(prog, feed=f, fetch_list=[spec["loss"]])
+            losses.append(float(np.ravel(l)[0]))
+    return losses
+
+
+def test_transformer_nmt_dp8_matches_single():
+    """The headline row: hidden=256 Transformer NMT, dp8 vs single device,
+    loss-match within the reference sync-mode delta."""
+    feeds = _nmt_feeds(30, np.random.RandomState(3))
+    single = _run_nmt(feeds, dp8=False)
+    dp8 = _run_nmt(feeds, dp8=True)
+    np.testing.assert_allclose(dp8, single, rtol=2e-3, atol=1e-4)
+    # trained, not flat (full task-level convergence needs thousands of
+    # steps at this scale; the matrix's claim is the dp8 loss-match)
+    assert single[-1] < single[0] - 0.1, (single[0], single[-1])
+
+
+def test_encoder_dygraph_matches_static():
+    """Same weights, same data: the eager tape and the static Program
+    must produce matching loss trajectories at hidden=256 scale."""
+    w = encoder_block_weights(HIDDEN, HEADS, FFN, 2, VOCAB)
+    rng = np.random.RandomState(0)
+    steps = 5
+    xs = rng.randint(0, VOCAB, (steps, 8, SEQ)).astype(np.int64)
+    ys = rng.randint(0, VOCAB, (steps, 8, 1)).astype(np.int64)
+
+    main, startup, loss = encoder_block_program(
+        w, HIDDEN, HEADS, FFN, 2, SEQ, VOCAB)
+    with pt.program_guard(main, startup):
+        pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    static_losses = []
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for s in range(steps):
+            l, = exe.run(main, feed={"tokens": xs[s], "label": ys[s]},
+                         fetch_list=[loss])
+            static_losses.append(float(np.ravel(l)[0]))
+
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        layers_, forward = make_dygraph_encoder(
+            w, HIDDEN, HEADS, FFN, 2, VOCAB)
+        opt = pt.optimizer.SGD(0.1)
+        params = [p for lyr in layers_ for p in lyr.parameters()]
+        eager_losses = []
+        for s in range(steps):
+            loss_vb = forward(dygraph.to_variable(xs[s]),
+                              dygraph.to_variable(ys[s]))
+            loss_vb.backward()
+            opt.minimize(loss_vb, parameter_list=params)
+            for lyr in layers_:
+                lyr.clear_gradients()
+            eager_losses.append(float(loss_vb.numpy()))
+
+    np.testing.assert_allclose(eager_losses, static_losses,
+                               rtol=2e-4, atol=1e-5)

@@ -24,6 +24,7 @@ FIELDS = 26
 VOCAB = int(os.environ.get("BENCH_VOCAB", "100000"))
 EMB = 16
 STEPS = int(os.environ.get("BENCH_STEPS", "100"))
+SERVERS = int(os.environ.get("BENCH_SERVERS", "1"))
 
 
 def main():
@@ -38,10 +39,12 @@ def main():
     from paddle_tpu.models.deepfm import deepfm
     from paddle_tpu.transpiler import DistributeTranspiler, start_pserver
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    endpoints = []
+    for _ in range(SERVERS):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        endpoints.append(f"127.0.0.1:{s.getsockname()[1]}")
+        s.close()
 
     main_p, startup = pt.Program(), pt.Program()
     with pt.unique_name_guard(), pt.program_guard(main_p, startup):
@@ -51,9 +54,9 @@ def main():
         pt.optimizer.Adam(learning_rate=1e-3).minimize(spec["loss"])
 
     t = DistributeTranspiler()
-    t.transpile(0, program=main_p, pservers=f"127.0.0.1:{port}",
+    t.transpile(0, program=main_p, pservers=",".join(endpoints),
                 trainers=1, sync_mode=True, startup_program=startup)
-    srv = start_pserver(t.get_pserver_program(f"127.0.0.1:{port}"))
+    srvs = [start_pserver(t.get_pserver_program(ep)) for ep in endpoints]
     n_sparse = sum(1 for sp in main_p._ps_plan.specs if sp.sparse)
 
     exe = pt.Executor()
@@ -75,15 +78,17 @@ def main():
         lv = float(np.ravel(np.asarray(last))[0])
         dt = (time.perf_counter() - t0) / STEPS
     main_p._ps_plan.shutdown()
-    srv.stop()
+    for srv in srvs:
+        srv.stop()
 
     import json
     print(json.dumps({
-        "metric": "deepfm_sparse_ps_samples_per_s",
+        "metric": f"deepfm_sparse_ps_samples_per_s_{SERVERS}srv",
         "value": round(BATCH / dt, 1),
         "unit": (f"samples/s (batch={BATCH} fields={FIELDS} vocab={VOCAB} "
                  f"emb={EMB}, {dt * 1e3:.1f} ms/step, {n_sparse} sparse "
-                 f"tables on pskv, loss={lv:.3f})"),
+                 f"tables sharded over {SERVERS} pskv server(s), "
+                 f"loss={lv:.3f})"),
     }))
 
 
