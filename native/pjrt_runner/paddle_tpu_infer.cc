@@ -12,94 +12,19 @@
 
 #include <cstdint>
 #include <cstring>
-#include <fstream>
-#include <sstream>
 #include <string>
 #include <vector>
 
 #include "pjrt_c_api.h"
+#include "pjrt_util.h"
 
 namespace {
 
-struct TensorMeta {
-  std::vector<int64_t> shape;
-  std::string dtype;
-};
-
-bool ReadFile(const std::string& path, bool binary, std::string* out,
-              std::string* err) {
-  std::ifstream f(path, binary ? std::ios::binary : std::ios::in);
-  if (!f) {
-    *err = "cannot open " + path;
-    return false;
-  }
-  std::ostringstream ss;
-  ss << f.rdbuf();
-  *out = ss.str();
-  return true;
-}
-
-// extracts "shape": [..] and "dtype": ".." pairs in order of appearance
-// within the given section ("inputs" / "outputs") of the flat, trusted
-// artifact manifest
-std::vector<TensorMeta> ParseSection(const std::string& js,
-                                     const std::string& section) {
-  std::vector<TensorMeta> out;
-  size_t sec = js.find("\"" + section + "\"");
-  if (sec == std::string::npos) return out;
-  size_t open = js.find("[", sec);
-  int depth = 0;
-  size_t close = open;
-  for (size_t i = open; i < js.size(); ++i) {
-    if (js[i] == '[') depth++;
-    if (js[i] == ']' && --depth == 0) {
-      close = i;
-      break;
-    }
-  }
-  std::string body = js.substr(open, close - open + 1);
-  size_t pos = 0;
-  while (true) {
-    size_t sh = body.find("\"shape\"", pos);
-    if (sh == std::string::npos) break;
-    size_t lb = body.find("[", sh);
-    size_t rb = body.find("]", lb);
-    TensorMeta m;
-    std::string nums = body.substr(lb + 1, rb - lb - 1);
-    std::stringstream ns(nums);
-    std::string tok;
-    while (std::getline(ns, tok, ','))
-      if (!tok.empty()) m.shape.push_back(std::stoll(tok));
-    size_t dt = body.find("\"dtype\"", rb);
-    size_t q1 = body.find('"', body.find(':', dt));
-    size_t q2 = body.find('"', q1 + 1);
-    m.dtype = body.substr(q1 + 1, q2 - q1 - 1);
-    out.push_back(m);
-    pos = q2;
-  }
-  return out;
-}
-
-bool DtypeToPjrt(const std::string& d, PJRT_Buffer_Type* t) {
-  if (d == "float32") *t = PJRT_Buffer_Type_F32;
-  else if (d == "float64") *t = PJRT_Buffer_Type_F64;
-  else if (d == "bfloat16") *t = PJRT_Buffer_Type_BF16;
-  else if (d == "float16") *t = PJRT_Buffer_Type_F16;
-  else if (d == "int64") *t = PJRT_Buffer_Type_S64;
-  else if (d == "int32") *t = PJRT_Buffer_Type_S32;
-  else if (d == "int8") *t = PJRT_Buffer_Type_S8;
-  else if (d == "uint8") *t = PJRT_Buffer_Type_U8;
-  else if (d == "bool") *t = PJRT_Buffer_Type_PRED;
-  else return false;
-  return true;
-}
-
-size_t DtypeSize(const std::string& d) {
-  if (d == "float64" || d == "int64") return 8;
-  if (d == "float32" || d == "int32") return 4;
-  if (d == "bfloat16" || d == "float16") return 2;
-  return 1;
-}
+using pjrt_util::DtypeSize;
+using pjrt_util::DtypeToPjrt;
+using pjrt_util::ParseSection;
+using pjrt_util::ReadFile;
+using pjrt_util::TensorMeta;
 
 size_t ByteSize(const TensorMeta& m) {
   size_t n = DtypeSize(m.dtype);
@@ -155,11 +80,11 @@ struct PTI_Predictor {
   }
 };
 
-extern "C" {
-
-PTI_Predictor* PTI_Create(const char* plugin_so, const char* artifact_dir,
-                          const char* const* option_kv, int num_options,
-                          char* errbuf, int errbuf_len) {
+static PTI_Predictor* CreateImpl(const char* plugin_so,
+                                 const char* artifact_dir,
+                                 const char* const* option_kv,
+                                 int num_options, char* errbuf,
+                                 int errbuf_len) {
   auto* p = new PTI_Predictor();
   std::string err;
   auto fail = [&](const std::string& m) -> PTI_Predictor* {
@@ -287,6 +212,41 @@ PTI_Predictor* PTI_Create(const char* plugin_so, const char* artifact_dir,
   return p;
 }
 
+static int RunImpl(PTI_Predictor* p, const void* const* inputs,
+                   void* const* outputs, char* errbuf, int errbuf_len);
+
+extern "C" {
+
+// exceptions (e.g. a malformed manifest in ParseSection) must never
+// unwind through the C ABI: the contract is NULL/nonzero + errbuf
+PTI_Predictor* PTI_Create(const char* plugin_so, const char* artifact_dir,
+                          const char* const* option_kv, int num_options,
+                          char* errbuf, int errbuf_len) {
+  try {
+    return CreateImpl(plugin_so, artifact_dir, option_kv, num_options,
+                      errbuf, errbuf_len);
+  } catch (const std::exception& e) {
+    SetErr(errbuf, errbuf_len, std::string("create: ") + e.what());
+    return nullptr;
+  } catch (...) {
+    SetErr(errbuf, errbuf_len, "create: unknown error");
+    return nullptr;
+  }
+}
+
+int PTI_Run(PTI_Predictor* p, const void* const* inputs,
+            void* const* outputs, char* errbuf, int errbuf_len) {
+  try {
+    return RunImpl(p, inputs, outputs, errbuf, errbuf_len);
+  } catch (const std::exception& e) {
+    SetErr(errbuf, errbuf_len, std::string("run: ") + e.what());
+    return 1;
+  } catch (...) {
+    SetErr(errbuf, errbuf_len, "run: unknown error");
+    return 1;
+  }
+}
+
 int PTI_NumInputs(const PTI_Predictor* p) {
   return static_cast<int>(p->in_meta.size());
 }
@@ -330,8 +290,10 @@ long long PTI_OutputByteSize(const PTI_Predictor* p, int i) {
   return static_cast<long long>(ByteSize(p->out_meta[i]));
 }
 
-int PTI_Run(PTI_Predictor* p, const void* const* inputs,
-            void* const* outputs, char* errbuf, int errbuf_len) {
+}  // extern "C"
+
+static int RunImpl(PTI_Predictor* p, const void* const* inputs,
+                   void* const* outputs, char* errbuf, int errbuf_len) {
   std::vector<PJRT_Buffer*> in_bufs;
   std::vector<PJRT_Buffer*> out_bufs(p->out_meta.size(), nullptr);
   auto destroy_all = [&]() {
@@ -432,6 +394,8 @@ int PTI_Run(PTI_Predictor* p, const void* const* inputs,
   }
   return 0;
 }
+
+extern "C" {
 
 void PTI_Destroy(PTI_Predictor* p) {
   if (!p) return;

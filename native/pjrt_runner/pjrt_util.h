@@ -1,0 +1,112 @@
+// Shared artifact-parsing helpers for the native PJRT stack: the
+// inference engine (paddle_tpu_infer.cc) and the standalone trainer
+// (pjrt_trainer.cc) read the same manifest/dtype conventions — one
+// definition so they cannot drift.
+#ifndef PADDLE_TPU_PJRT_UTIL_H_
+#define PADDLE_TPU_PJRT_UTIL_H_
+
+#include <cstdint>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "pjrt_c_api.h"
+
+namespace pjrt_util {
+
+struct TensorMeta {
+  std::vector<int64_t> shape;
+  std::string dtype;
+};
+
+inline bool ReadFile(const std::string& path, bool binary,
+                     std::string* out, std::string* err) {
+  std::ifstream f(path, binary ? std::ios::binary : std::ios::in);
+  if (!f) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+// extracts "shape": [..] and "dtype": ".." pairs in order of appearance
+// within the given section ("inputs" / "outputs") of the artifact
+// manifest. Throws std::runtime_error on malformed input — callers at
+// an extern "C" boundary must catch.
+inline std::vector<TensorMeta> ParseSection(const std::string& js,
+                                            const std::string& section) {
+  std::vector<TensorMeta> out;
+  size_t sec = js.find("\"" + section + "\"");
+  if (sec == std::string::npos) return out;
+  size_t open = js.find("[", sec);
+  if (open == std::string::npos)
+    throw std::runtime_error("manifest: no array for " + section);
+  int depth = 0;
+  size_t close = open;
+  for (size_t i = open; i < js.size(); ++i) {
+    if (js[i] == '[') depth++;
+    if (js[i] == ']' && --depth == 0) {
+      close = i;
+      break;
+    }
+  }
+  std::string body = js.substr(open, close - open + 1);
+  size_t pos = 0;
+  while (true) {
+    size_t sh = body.find("\"shape\"", pos);
+    if (sh == std::string::npos) break;
+    size_t lb = body.find("[", sh);
+    size_t rb = body.find("]", lb);
+    if (lb == std::string::npos || rb == std::string::npos)
+      throw std::runtime_error("manifest: bad shape in " + section);
+    TensorMeta m;
+    std::string nums = body.substr(lb + 1, rb - lb - 1);
+    std::stringstream ns(nums);
+    std::string tok;
+    while (std::getline(ns, tok, ','))
+      if (!tok.empty()) m.shape.push_back(std::stoll(tok));
+    size_t dt = body.find("\"dtype\"", rb);
+    if (dt == std::string::npos)
+      throw std::runtime_error("manifest: missing dtype in " + section);
+    size_t col = body.find(':', dt);
+    size_t q1 = body.find('"', col);
+    size_t q2 = q1 == std::string::npos ? std::string::npos
+                                        : body.find('"', q1 + 1);
+    if (col == std::string::npos || q2 == std::string::npos)
+      throw std::runtime_error("manifest: bad dtype in " + section);
+    m.dtype = body.substr(q1 + 1, q2 - q1 - 1);
+    out.push_back(m);
+    pos = q2;
+  }
+  return out;
+}
+
+inline bool DtypeToPjrt(const std::string& d, PJRT_Buffer_Type* t) {
+  if (d == "float32") *t = PJRT_Buffer_Type_F32;
+  else if (d == "float64") *t = PJRT_Buffer_Type_F64;
+  else if (d == "bfloat16") *t = PJRT_Buffer_Type_BF16;
+  else if (d == "float16") *t = PJRT_Buffer_Type_F16;
+  else if (d == "int64") *t = PJRT_Buffer_Type_S64;
+  else if (d == "int32") *t = PJRT_Buffer_Type_S32;
+  else if (d == "int8") *t = PJRT_Buffer_Type_S8;
+  else if (d == "uint8") *t = PJRT_Buffer_Type_U8;
+  else if (d == "bool") *t = PJRT_Buffer_Type_PRED;
+  else return false;
+  return true;
+}
+
+inline size_t DtypeSize(const std::string& d) {
+  if (d == "float64" || d == "int64") return 8;
+  if (d == "float32" || d == "int32") return 4;
+  if (d == "bfloat16" || d == "float16") return 2;
+  return 1;
+}
+
+}  // namespace pjrt_util
+
+#endif  // PADDLE_TPU_PJRT_UTIL_H_

@@ -217,14 +217,23 @@ def export_native(model_dir: str, out_dir: str, batch_size: int = 1) -> str:
                     "dtype": str(sp.dtype)}
                    for n, sp in zip(feed_names, specs)]
     lowered = jax.jit(entry).lower(*specs)
-    mlir_text = lowered.as_text(dialect="stablehlo")
+    # MLIR BYTECODE, not text: weights are baked as constants, and a
+    # BERT-base textual dump is ~1 GB of hex (measured: the native
+    # runner then spends minutes just reading/uploading the artifact);
+    # bytecode stays at ~weight size and PJRT's "mlir" format accepts it
+    try:
+        from jax._src.interpreters import mlir as _mlir
+        blob = _mlir.module_to_bytecode(
+            lowered.compiler_ir(dialect="stablehlo"))
+    except Exception:  # private-API drift: fall back to text
+        blob = lowered.as_text(dialect="stablehlo").encode()
     outs_meta = [{"shape": [int(d) for d in o.shape],
                   "dtype": str(o.dtype)}
                  for o in jax.eval_shape(entry, *specs)]
 
     _os.makedirs(out_dir, exist_ok=True)
-    with open(_os.path.join(out_dir, "model.mlir"), "w") as f:
-        f.write(mlir_text)
+    with open(_os.path.join(out_dir, "model.mlir"), "wb") as f:
+        f.write(blob)
     opts = _compiler.get_compile_options(num_replicas=1, num_partitions=1)
     with open(_os.path.join(out_dir, "compile_options.pb"), "wb") as f:
         f.write(opts.SerializeAsString())

@@ -147,14 +147,20 @@ def wait_for_saves() -> None:
 # --------------------------------------------------------------------------
 
 def _collect(scope: Scope, vars: Sequence[Variable]) -> dict:
-    """Snapshot var values to host numpy — the step-consistent copy point."""
-    arrays = {}
+    """Snapshot var values to host numpy — the step-consistent copy point.
+
+    ONE batched jax.device_get for all vars: per-var np.asarray costs a
+    full transfer round trip EACH (~110 ms through the TPU tunnel —
+    measured 122 s to save BERT-base's 199 params before this; the same
+    defect r4 fixed in PSPlan.after_step)."""
+    import jax
+    vals = {}
     for v in vars:
         val = scope.find_var(v.name)
         if val is None:
             raise RuntimeError(f"var {v.name!r} not found in scope")
-        arrays[v.name] = np.asarray(val)
-    return arrays
+        vals[v.name] = val
+    return {k: np.asarray(a) for k, a in jax.device_get(vals).items()}
 
 
 def save_vars(executor: Optional[Executor], dirname: str,

@@ -153,7 +153,6 @@ def encoder_block_program(w, hidden, heads, ffn_dim, n_layers, seq_len,
     from ..framework.layer_helper import ParamAttr
     from ..initializer import NumpyArrayInitializer
     from ..framework.core import Program, program_guard
-    from .. import optimizer as _opt  # noqa: F401  (callers minimize)
 
     def attr(name):
         return ParamAttr(name=name,

@@ -169,17 +169,28 @@ def switch_moe_ffn(x, num_experts, d_model, d_ffn, capacity_factor=1.25,
                                                     float(cap))),
         "float32") * assign                              # [b, s, e]
 
-    # dispatch: [b, s, e] x [b, s, d] -> [b, e, s, d] masked token copies
-    disp = layers.einsum("bse,bsd->besd", keep, x)
+    # dispatch mask (Mesh-TF/GSPMD formulation): tokens GATHER into each
+    # expert's fixed [cap] queue instead of a dense [b, e, s, d] copy —
+    # expert flops become b*cap*e (≈ capacity_factor x the dense FFN)
+    # rather than e x the dense FFN, the difference between MoE being a
+    # win and an 8x tax (BASELINE.md r5 MoE row; static shapes kept).
+    slot = layers.reduce_sum(pos * assign, dim=-1, keep_dim=False)
+    slot_idx = layers.cast(
+        layers.clip(slot - 1.0, 0.0, float(cap - 1)), "int64")
+    slot_oh = layers.one_hot(slot_idx, cap)              # [b, s, cap]
+    mask4 = layers.einsum("bse,bsc->bsec", keep, slot_oh)
+
+    disp = layers.einsum("bsec,bsd->ebcd", mask4, x)     # [e, b, cap, d]
 
     w1 = layers.create_parameter([e, d_model, d_ffn], "float32",
                                  attr=ParamAttr(name=f"{name_prefix}/w1"))
     w2 = layers.create_parameter([e, d_ffn, d_model], "float32",
                                  attr=ParamAttr(name=f"{name_prefix}/w2"))
-    h = layers.relu(layers.einsum("besd,edf->besf", disp, w1))
-    y = layers.einsum("besf,efd->besd", h, w2)           # [b, e, s, d]
-    # combine weighted by the gate prob
-    out = layers.einsum("besd,bse->bsd", y, keep * probs)
+    h = layers.relu(layers.einsum("ebcd,edf->ebcf", disp, w1))
+    y = layers.einsum("ebcf,efd->ebcd", h, w2)           # [e, b, cap, d]
+    # combine weighted by the router prob of the chosen expert
+    comb = layers.einsum("bsec,bse->bsec", mask4, probs)
+    out = layers.einsum("ebcd,bsec->bsd", y, comb)
 
     # load-balancing aux loss (Switch eq. 4): e * sum_e f_e * P_e
     frac = layers.reduce_mean(assign, dim=[0, 1])        # [e]

@@ -183,18 +183,23 @@ def mode_dpmp():
 
 
 def mode_ep():
+    # EP_SCALE=1 measures at bench scale (h=768, ffn=3072, b=32 s=128 —
+    # the BASELINE MoE row's shapes) instead of the tiny dryrun config
     import paddle_tpu as pt
     E = N_DEV
+    big = os.environ.get("EP_SCALE", "0") == "1"
+    seq, h, f = (128, 768, 3072) if big else (8, 16, 32)
+    b = 32 if big else E
     rng = np.random.RandomState(1)
-    xv = rng.randn(E, 8, 16).astype(np.float32)
+    xv = rng.randn(b, seq, h).astype(np.float32)
     feed = {"x": xv, "y": np.tanh(xv)}
 
     def build():
         main, startup = pt.Program(), pt.Program()
         with pt.program_guard(main, startup):
-            x = pt.layers.data("x", [8, 16], dtype="float32")
-            y = pt.layers.data("y", [8, 16], dtype="float32")
-            out, aux = pt.nets.switch_moe_ffn(x, E, 16, 32)
+            x = pt.layers.data("x", [seq, h], dtype="float32")
+            y = pt.layers.data("y", [seq, h], dtype="float32")
+            out, aux = pt.nets.switch_moe_ffn(x, E, h, f)
             loss = pt.layers.mean(pt.layers.square(out - y)) + \
                 pt.layers.scale(aux, scale=0.01)
             pt.optimizer.SGD(0.05).minimize(loss)
