@@ -137,6 +137,12 @@ def bench_encoder():
 
 def main():
     import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # co-located simulation: eager dispatches pay the tunnel RTT per
+        # OP (measured 174x "overhead" that is pure wire time); the CPU
+        # backend isolates the tape's host-side cost. The axon
+        # sitecustomize overrides JAX_PLATFORMS, so force via config.
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0].platform
     if os.environ.get("BENCH_DYGRAPH_MODEL", "mlp") == "encoder":
         e, s, desc = bench_encoder()

@@ -64,7 +64,7 @@ def build_model(name):
         else:
             from paddle_tpu.models.resnet import resnet
             img = pt.layers.data("img", [3, 224, 224], dtype="float32")
-            out = resnet(img, class_dim=1000, depth=50, is_test=True)
+            out = resnet(img, depth=50, class_num=1000)
             feeds = ["img"]
 
             def feed_for(b, rng):
