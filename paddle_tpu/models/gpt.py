@@ -63,9 +63,11 @@ def _mlp(x, cfg: GPTConfig, prefix: str):
     return _shared_ffn(x, cfg, prefix, names=("mlp1", "mlp2"))
 
 
-def gpt_decoder(tokens, cfg: GPTConfig, is_test=False, prefix="gpt"):
+def gpt_decoder(tokens, cfg: GPTConfig, is_test=False, prefix="gpt",
+                cut_vars=None):
     """tokens: int64 (-1, seq) -> hidden states (-1, seq, h), pre-LN
-    residual stack with a final LN (GPT-2)."""
+    residual stack with a final LN (GPT-2). cut_vars (list) collects the
+    per-layer residual var names — recompute/pipeline boundaries."""
     seq = int(tokens.shape[1])
     check_max_pos(seq, cfg)
     wte = pt.layers.embedding(
@@ -94,17 +96,23 @@ def gpt_decoder(tokens, cfg: GPTConfig, is_test=False, prefix="gpt"):
         x = x + _resid_drop(
             _causal_attention(_ln(x, f"{p}/ln1"), cfg, p, seq))
         x = x + _resid_drop(_mlp(_ln(x, f"{p}/ln2"), cfg, p))
+        if cut_vars is not None:
+            cut_vars.append(x.name)
     return _ln(x, f"{prefix}/lnf")
 
 
 def gpt_lm_program(cfg: GPTConfig, seq_len: int, is_test=False,
-                   learning_rate=1e-4, optimizer="adam", amp=False):
+                   learning_rate=1e-4, optimizer="adam", amp=False,
+                   recompute=False):
     """(main, startup, fetches) for a causal-LM step: next-token CE with
-    the tied wte head, loss over positions 0..seq-2 predicting 1..seq-1."""
+    the tied wte head, loss over positions 0..seq-2 predicting 1..seq-1.
+    recompute=True checkpoints the per-layer residuals and remats the
+    segments in the backward (transpiler/recompute.py)."""
     main, startup = pt.Program(), pt.Program()
+    cuts = [] if recompute else None
     with pt.program_guard(main, startup):
         tokens = pt.layers.data("tokens", [seq_len], dtype="int64")
-        h = gpt_decoder(tokens, cfg, is_test=is_test)
+        h = gpt_decoder(tokens, cfg, is_test=is_test, cut_vars=cuts)
         wte = main.global_block.var("gpt/wte")
         logits = pt.layers.matmul(h, wte, transpose_y=True)
         # shift: logits[:, :-1] predict tokens[:, 1:]
@@ -125,6 +133,11 @@ def gpt_lm_program(cfg: GPTConfig, seq_len: int, is_test=False,
             opt = mixed_precision.decorate(opt)
         if not is_test:
             opt.minimize(mean_loss)
+    if cuts is not None:
+        main._recompute_checkpoints = list(cuts)
+        if not is_test:
+            from ..transpiler.recompute import apply_recompute
+            apply_recompute(main, cuts)
     return main, startup, {"loss": mean_loss, "logits": logits}
 
 

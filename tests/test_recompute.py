@@ -175,3 +175,33 @@ def test_bert_recompute_pipeline_conflict():
         bert_pretrain_program(BertConfig(vocab_size=64, hidden=32,
                                          layers=2, heads=4), 16,
                               pipeline_microbatches=2, recompute=True)
+
+
+def test_gpt_recompute_matches_baseline():
+    """gpt_lm_program(recompute=True) == baseline trajectories (dropout
+    masks replayed through the causal-flash stack)."""
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+
+    cfg = GPTConfig(vocab_size=89, hidden=32, layers=2, heads=4,
+                    max_pos=32, dropout=0.1, attn_impl="xla")
+
+    def run(recompute):
+        main, startup, fetches = gpt_lm_program(
+            cfg, 16, learning_rate=1e-2, recompute=recompute)
+        main.random_seed = startup.random_seed = 3
+        exe = pt.Executor()
+        rng = np.random.RandomState(0)
+        losses = []
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for _ in range(4):
+                toks = rng.randint(0, cfg.vocab_size,
+                                   (4, 16)).astype(np.int64)
+                l, = exe.run(main, feed={"tokens": toks},
+                             fetch_list=[fetches["loss"]])
+                losses.append(float(np.ravel(l)[0]))
+        return losses
+
+    base = run(False)
+    rc = run(True)
+    np.testing.assert_allclose(rc, base, rtol=1e-5, atol=1e-6)

@@ -26,7 +26,8 @@ def main():
                     attn_impl=os.environ.get("BENCH_ATTN", "fused"))
 
     main_prog, startup, fetches = gpt_lm_program(
-        cfg, seq, learning_rate=1e-4, amp=amp)
+        cfg, seq, learning_rate=1e-4, amp=amp,
+        recompute=os.environ.get("BENCH_RECOMPUTE", "0") == "1")
 
     import jax.numpy as jnp
     rng = np.random.RandomState(0)
