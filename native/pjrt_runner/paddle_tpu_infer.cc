@@ -47,6 +47,10 @@ struct PTI_Predictor {
   PJRT_LoadedExecutable* exec = nullptr;
   PJRT_Device* device = nullptr;
   std::vector<TensorMeta> in_meta, out_meta;
+  // weights-external artifacts: param buffers staged ONCE at create and
+  // passed as leading execute args on every run (manifest "params")
+  std::vector<TensorMeta> param_meta;
+  std::vector<PJRT_Buffer*> param_bufs;
   std::string err;  // last error (internal)
 
   bool Check(PJRT_Error* e, const char* what) {
@@ -79,6 +83,38 @@ struct PTI_Predictor {
     return Check(api->PJRT_Event_Destroy(&d), "event destroy");
   }
 };
+
+// one H2D staging path for params and inputs: fills *buf and the
+// transfer-done event; p->err carries the failure message
+static bool StageHostBuffer(PTI_Predictor* p, const void* data,
+                            const TensorMeta& meta, PJRT_Buffer** buf,
+                            PJRT_Event** done) {
+  PJRT_Buffer_Type t;
+  if (!DtypeToPjrt(meta.dtype, &t)) {
+    p->err = "unsupported dtype " + meta.dtype;
+    return false;
+  }
+  PJRT_Client_BufferFromHostBuffer_Args hb;
+  hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  hb.extension_start = nullptr;
+  hb.client = p->client;
+  hb.data = data;
+  hb.type = t;
+  hb.dims = meta.shape.data();
+  hb.num_dims = meta.shape.size();
+  hb.byte_strides = nullptr;
+  hb.num_byte_strides = 0;
+  hb.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  hb.device = p->device;
+  hb.memory = nullptr;
+  hb.device_layout = nullptr;
+  if (!p->Check(p->api->PJRT_Client_BufferFromHostBuffer(&hb), "h2d"))
+    return false;
+  *buf = hb.buffer;
+  *done = hb.done_with_host_buffer;
+  return true;
+}
 
 static PTI_Predictor* CreateImpl(const char* plugin_so,
                                  const char* artifact_dir,
@@ -167,6 +203,7 @@ static PTI_Predictor* CreateImpl(const char* plugin_so,
     return fail(err);
   p->in_meta = ParseSection(manifest, "inputs");
   p->out_meta = ParseSection(manifest, "outputs");
+  p->param_meta = ParseSection(manifest, "params");
 
   PJRT_Program prog;
   prog.struct_size = PJRT_Program_STRUCT_SIZE;
@@ -209,6 +246,35 @@ static PTI_Predictor* CreateImpl(const char* plugin_so,
                 " outputs but the executable produces " +
                 std::to_string(no.num_outputs) +
                 " — regenerate the artifact");
+
+  // weights-external artifact: stage every param<i>.bin onto the device
+  // now; runs then move only inputs/outputs. All transfers are ISSUED
+  // first and awaited after — a per-param await would serialize ~200
+  // round trips at predictor create
+  std::vector<std::string> raws(p->param_meta.size());
+  std::vector<PJRT_Event*> dones;
+  for (size_t i = 0; i < p->param_meta.size(); ++i) {
+    if (!ReadFile(dir + "/param" + std::to_string(i) + ".bin", true,
+                  &raws[i], &err))
+      return fail(err);
+    if (raws[i].size() != ByteSize(p->param_meta[i]))
+      return fail("param" + std::to_string(i) + ".bin is " +
+                  std::to_string(raws[i].size()) +
+                  " bytes, manifest wants " +
+                  std::to_string(ByteSize(p->param_meta[i])));
+    PJRT_Buffer* buf = nullptr;
+    PJRT_Event* done = nullptr;
+    if (!StageHostBuffer(p, raws[i].data(), p->param_meta[i], &buf,
+                         &done)) {
+      for (PJRT_Event* e : dones) p->Await(e, "param h2d done");
+      return fail(p->err);
+    }
+    p->param_bufs.push_back(buf);
+    dones.push_back(done);
+  }
+  for (PJRT_Event* e : dones) {
+    if (!p->Await(e, "param h2d done")) return fail(p->err);
+  }
   return p;
 }
 
@@ -316,30 +382,21 @@ static int RunImpl(PTI_Predictor* p, const void* const* inputs,
     return 1;
   };
   in_bufs.reserve(p->in_meta.size());
-  for (size_t i = 0; i < p->in_meta.size(); ++i) {
-    PJRT_Buffer_Type t;
-    if (!DtypeToPjrt(p->in_meta[i].dtype, &t))
-      return fail("unsupported dtype " + p->in_meta[i].dtype);
-    PJRT_Client_BufferFromHostBuffer_Args hb;
-    hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-    hb.extension_start = nullptr;
-    hb.client = p->client;
-    hb.data = inputs[i];
-    hb.type = t;
-    hb.dims = p->in_meta[i].shape.data();
-    hb.num_dims = p->in_meta[i].shape.size();
-    hb.byte_strides = nullptr;
-    hb.num_byte_strides = 0;
-    hb.host_buffer_semantics =
-        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-    hb.device = p->device;
-    hb.memory = nullptr;
-    hb.device_layout = nullptr;
-    if (!p->Check(p->api->PJRT_Client_BufferFromHostBuffer(&hb), "h2d"))
-      return fail(p->err);
-    in_bufs.push_back(hb.buffer);
-    if (!p->Await(hb.done_with_host_buffer, "h2d done"))
-      return fail(p->err);
+  {
+    std::vector<PJRT_Event*> dones;
+    for (size_t i = 0; i < p->in_meta.size(); ++i) {
+      PJRT_Buffer* buf = nullptr;
+      PJRT_Event* done = nullptr;
+      if (!StageHostBuffer(p, inputs[i], p->in_meta[i], &buf, &done)) {
+        for (PJRT_Event* e : dones) p->Await(e, "h2d done");
+        return fail(p->err);
+      }
+      in_bufs.push_back(buf);
+      dones.push_back(done);
+    }
+    for (PJRT_Event* e : dones) {
+      if (!p->Await(e, "h2d done")) return fail(p->err);
+    }
   }
 
   PJRT_ExecuteOptions eo;
@@ -359,10 +416,13 @@ static int RunImpl(PTI_Predictor* p, const void* const* inputs,
   ex.extension_start = nullptr;
   ex.executable = p->exec;
   ex.options = &eo;
-  PJRT_Buffer* const* arg_list = in_bufs.data();
+  // weights-external modules take the resident param buffers first
+  std::vector<PJRT_Buffer*> args(p->param_bufs);
+  args.insert(args.end(), in_bufs.begin(), in_bufs.end());
+  PJRT_Buffer* const* arg_list = args.data();
   ex.argument_lists = &arg_list;
   ex.num_devices = 1;
-  ex.num_args = in_bufs.size();
+  ex.num_args = args.size();
   PJRT_Buffer** out_list = out_bufs.data();
   ex.output_lists = &out_list;
   PJRT_Event* done = nullptr;
@@ -400,6 +460,13 @@ extern "C" {
 void PTI_Destroy(PTI_Predictor* p) {
   if (!p) return;
   if (p->api) {
+    for (PJRT_Buffer* b : p->param_bufs) {
+      PJRT_Buffer_Destroy_Args bd;
+      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      bd.extension_start = nullptr;
+      bd.buffer = b;
+      p->api->PJRT_Buffer_Destroy(&bd);
+    }
     if (p->exec) {
       PJRT_LoadedExecutable_Destroy_Args d;
       d.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;

@@ -112,12 +112,17 @@ def bench_native(name, work, batches, feeds, feed_for):
     rows = []
     for b in batches:
         art = os.path.join(build, f"art_{name}_{b}")
-        pt.inference.export_native(work, art, batch_size=b)
+        # weights-external: the module compiles weight-free and params
+        # stage once at create — the only feasible format for the
+        # 100M-param models through this tunnel
+        pt.inference.export_native(work, art, batch_size=b,
+                                   external_params=True)
         feed = feed_for(b, rng)
         files = []
-        for i, k in enumerate(feeds):
+        man = json.load(open(os.path.join(art, "manifest.json")))
+        for i, (k, meta) in enumerate(zip(feeds, man["inputs"])):
             path = os.path.join(art, f"in{i}.bin")
-            feed[k].tofile(path)
+            feed[k].astype(meta["dtype"]).tofile(path)
             files.append(path)
         reps = 20 if b == 1 else 10
         try:
