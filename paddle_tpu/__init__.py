@@ -33,6 +33,7 @@ from . import distributed
 from . import dataset
 from .dataset import DatasetFactory
 from . import inference
+from . import serving
 from . import nets
 from .data_feeder import DataFeeder
 from .reader.py_reader import PyReader

@@ -15,12 +15,14 @@ framework-level format).
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "create_predictor",
+           "create_engine",
            "export_stablehlo", "load_stablehlo", "export_native",
            "export_train_step",
            "PredictorPool"]
@@ -125,16 +127,76 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+def create_engine(config, gpt_config, serving=None, dtype=None):
+    """Build a continuous-batching `serving.ServingEngine` from a saved
+    GPT model dir — the serving-stack entry point, reusing the
+    Config/Predictor loading path (the engine reads the decode weights
+    straight out of the predictor's scope by the var names
+    models/gpt.py's programs create).
+
+    config: inference.Config (or a model_dir string); gpt_config: the
+    models.gpt.GPTConfig the saved model was built with; serving: a
+    serving.ServingConfig (defaults apply when None); dtype: optional
+    cast for the decode weight copy (e.g. jnp.bfloat16)."""
+    from ..models.gpt_decode import collect_gpt_params
+    from ..serving import ServingConfig, ServingEngine
+
+    if isinstance(config, str):
+        config = Config(config)
+    pred = Predictor(config)
+    params = collect_gpt_params(pred._scope, gpt_config, dtype=dtype)
+    return ServingEngine(params, gpt_config,
+                         serving if serving is not None else ServingConfig())
+
+
 class PredictorPool:
     """reference inference/api: a pool of predictors sharing weights; here
     predictors are cheap (compiled executables are cached per process), so
-    the pool just constructs N."""
+    the pool just constructs N.
+
+    Thread-safety audit (serving borrows predictors from here): the
+    scope_guard stack is thread-LOCAL, so different predictors may run
+    from different threads concurrently — but a single Predictor is NOT
+    safe for concurrent run(): each run writes outputs back into the
+    predictor's private scope, and the ZeroCopy `set_input` staging dict
+    is per-instance mutable state. `retrieve(idx)` is the legacy
+    unsynchronized hand-out: the CALLER owns ensuring at most one thread
+    drives index idx at a time. For concurrent callers use `acquire()`: a
+    lock + condition variable checks predictors out exclusively and
+    blocks (or times out) when all are busy."""
 
     def __init__(self, config: Config, size: int = 1):
+        import threading
         self._preds = [Predictor(config) for _ in range(size)]
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._free = list(range(size))
+
+    def size(self) -> int:
+        return len(self._preds)
 
     def retrieve(self, idx: int) -> Predictor:
+        """Unsynchronized hand-out by index (reference API). Single-thread
+        use, or one dedicated thread per index."""
         return self._preds[idx]
+
+    @contextlib.contextmanager
+    def acquire(self, timeout: Optional[float] = None):
+        """Exclusively check out any free predictor; blocks while all are
+        busy. Raises TimeoutError when `timeout` (seconds) elapses first —
+        callers shed load instead of queueing unboundedly."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._free, timeout=timeout):
+                raise TimeoutError(
+                    f"no free predictor in the pool of {len(self._preds)} "
+                    f"after {timeout}s")
+            idx = self._free.pop()
+        try:
+            yield self._preds[idx]
+        finally:
+            with self._cv:
+                self._free.append(idx)
+                self._cv.notify()
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["collect_gpt_params", "gpt_forward_logits", "gpt_prefill",
-           "gpt_decode_step", "gpt_generate"]
+           "gpt_prefill_padded", "gpt_decode_step", "gpt_decode_step_slots",
+           "gpt_generate"]
 
 
 def _ln_names(name):
@@ -120,11 +121,12 @@ def gpt_forward_logits(params, cfg, tokens):
     return (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32)
 
 
-def gpt_prefill(params, cfg, tokens, max_len):
-    """Run the prompt once, filling the KV cache.
-
-    tokens: (b, P) int32. Returns (logits_last (b, V) f32,
-    cache (layers, 2, b, heads, max_len, head_dim))."""
+def _prefill_blocks(params, cfg, tokens, max_len):
+    """Shared prefill body: run the whole (possibly padded) prompt through
+    every block, filling the KV cache. Returns (hidden states (b, P, h)
+    BEFORE the final LN, cache). Both prefill entry points ride this one
+    loop so their math can never diverge — the serving path's token-parity
+    guarantee depends on it."""
     import jax.numpy as jnp
 
     b, p_len = tokens.shape
@@ -152,9 +154,45 @@ def gpt_prefill(params, cfg, tokens, max_len):
         x = x + _dense(ctx, blk["out"])
         h = _ln(x, blk["ln2"])
         x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
-    x = _ln(x[:, -1:], params["lnf"])
-    logits = (x @ params["wte"].T.astype(x.dtype))[:, 0]
-    return logits.astype(jnp.float32), cache
+    return x, cache
+
+
+def _head_logits(params, last):
+    """Final LN + tied-wte head over a (b, 1, h) slice -> (b, V) f32."""
+    import jax.numpy as jnp
+    last = _ln(last, params["lnf"])
+    logits = (last @ params["wte"].T.astype(last.dtype))[:, 0]
+    return logits.astype(jnp.float32)
+
+
+def gpt_prefill(params, cfg, tokens, max_len):
+    """Run the prompt once, filling the KV cache.
+
+    tokens: (b, P) int32. Returns (logits_last (b, V) f32,
+    cache (layers, 2, b, heads, max_len, head_dim))."""
+    x, cache = _prefill_blocks(params, cfg, tokens, max_len)
+    return _head_logits(params, x[:, -1:]), cache
+
+
+def gpt_prefill_padded(params, cfg, tokens, real_len, max_len):
+    """Prefill a RIGHT-PADDED prompt (the serving scheduler's bucketed
+    shapes): tokens (b, L_bucket) int32 padded past the real prompt,
+    real_len (b,) traced actual lengths. Returns (logits at position
+    real_len-1 (b, V) f32, cache (layers, 2, b, heads, max_len, head_dim))
+    with K/V rows [0, L_bucket) written.
+
+    Why the padding is safe: the causal mask keeps every real query
+    position inside the real prefix, and the pad rows the prefill leaves
+    at [real_len, L_bucket) are overwritten by the decode steps at those
+    positions BEFORE any step's [0, t] attention window reaches them —
+    decode at absolute position t writes row t and reads rows <= t only."""
+    import jax.numpy as jnp
+
+    x, cache = _prefill_blocks(params, cfg, tokens, max_len)
+    b = tokens.shape[0]
+    # the last REAL position per row, not the last padded one
+    last = x[jnp.arange(b), real_len - 1][:, None]
+    return _head_logits(params, last), cache
 
 
 def gpt_decode_step(params, cfg, token, cache, t):
@@ -193,9 +231,52 @@ def gpt_decode_step(params, cfg, token, cache, t):
         x = x + _dense(ctx, blk["out"])
         h = _ln(x, blk["ln2"])
         x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
-    x = _ln(x, params["lnf"])
-    logits = (x @ params["wte"].T.astype(x.dtype))[:, 0]
-    return logits.astype(jnp.float32), cache
+    return _head_logits(params, x), cache
+
+
+def gpt_decode_step_slots(params, cfg, tokens, cache, ts):
+    """One cached decode step over the SLOT dimension (continuous
+    batching): every slot advances at its OWN absolute position. tokens:
+    (S,) int32, ts: (S,) int32 per-slot positions, cache: (layers, 2, S,
+    heads, max_len, head_dim). Returns (logits (S, V) f32, updated cache).
+
+    Per-slot math is exactly gpt_decode_step's — the shared-t
+    dynamic_update_slice becomes a per-row scatter at ts[s] and the
+    [0, t] attention window becomes a per-row mask — so a slot's logits
+    match what the same sequence produces on the sequential path.
+    Retired/free slots may keep stepping harmlessly: their writes land at
+    a stale position that admission's prefill overwrites before any
+    future attention window reads it."""
+    import jax.numpy as jnp
+
+    heads = cfg.heads
+    hd = cfg.hidden // cfg.heads
+    max_len = cache.shape[4]
+    s_dim = tokens.shape[0]
+    dtype = cache.dtype
+    rows = jnp.arange(s_dim)
+    x = (params["wte"][tokens] + params["wpe"][ts]).astype(dtype)[:, None]
+    pos_mask = (jnp.arange(max_len)[None, :] <= ts[:, None])   # [S, L]
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _dense(h, blk["q"]).reshape(s_dim, heads, 1, hd)
+        k = _dense(h, blk["k"]).reshape(s_dim, heads, hd)
+        v = _dense(h, blk["v"]).reshape(s_dim, heads, hd)
+        cache = cache.at[li, 0, rows, :, ts, :].set(k)
+        cache = cache.at[li, 1, rows, :, ts, :].set(v)
+        K, V = cache[li, 0], cache[li, 1]          # (S, n, L, hd)
+        scores = jnp.einsum("bnqd,bnkd->bnqk", q, K,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(pos_mask[:, None, None, :],
+                           scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, V)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(s_dim, 1, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    return _head_logits(params, x), cache
 
 
 def _sample(logits, key, temperature, top_k):

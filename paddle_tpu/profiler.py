@@ -29,12 +29,24 @@ def start_profiler(state: str = "All", log_dir: str = "/tmp/paddle_tpu_prof"):
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
+    """Stop the active trace and return its directory. Safe no-op (returns
+    None) when no trace is active — the reference's stop without start is
+    a user error we absorb, and it makes the profiler() context manager
+    exception-safe when the body already stopped the trace itself."""
     global _active_dir
+    if _active_dir is None:
+        return None
     import jax
 
-    jax.profiler.stop_trace()
     d = _active_dir
     _active_dir = None
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError:
+        # the trace was torn down behind our back (e.g. jax-level
+        # stop_trace inside the profiler() body): already stopped is the
+        # state we wanted
+        return None
     return d
 
 
@@ -43,7 +55,9 @@ def profiler(state: str = "All", sorted_key=None,
              profile_path: str = "/tmp/paddle_tpu_prof"):
     """fluid.profiler.profiler context manager analog. The trace directory
     is TensorBoard-loadable (the timeline.py analog is `tensorboard
-    --logdir`)."""
+    --logdir`). Double-stop safe: if the body raises after the trace was
+    already stopped (or stops it explicitly), the exit path no-ops instead
+    of raising over the original exception."""
     start_profiler(state, profile_path)
     try:
         yield

@@ -1,0 +1,25 @@
+"""paddle_tpu.serving — continuous-batching inference above the executor.
+
+The reference keeps inference hardware saturated with async
+executors/DeviceWorkers around AnalysisPredictor (SURVEY §2.8); this
+package is that layer rebuilt for the TPU decode path: a slot-based KV
+pool with O(buckets) compiled shapes (`kv_cache`), an iteration-level
+scheduler that interleaves prefill and batched decode (`scheduler`), a
+request-lifecycle engine with bounded admission and streaming callbacks
+(`engine`), and request/engine metrics (`metrics`).
+
+Entry points: `inference.create_engine(config, gpt_config)` to serve a
+saved model dir, or `ServingEngine(params, cfg)` over an in-memory
+parameter pytree.
+"""
+
+from .engine import (EngineOverloadError, GenerationRequest, ServingConfig,
+                     ServingEngine)
+from .kv_cache import ShapeBuckets, SlotKVCache
+from .metrics import EngineMetrics, RequestMetrics
+from .scheduler import ContinuousBatchingScheduler, SequenceEvent
+
+__all__ = ["ServingEngine", "ServingConfig", "GenerationRequest",
+           "EngineOverloadError", "ShapeBuckets", "SlotKVCache",
+           "ContinuousBatchingScheduler", "SequenceEvent",
+           "EngineMetrics", "RequestMetrics"]

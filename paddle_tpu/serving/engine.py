@@ -1,0 +1,289 @@
+"""Request-lifecycle engine: admission queue, backpressure, streaming.
+
+The reference's serving story is AnalysisPredictor behind async
+executors/DeviceWorkers that pull work from bounded queues and keep the
+device busy (SURVEY §2.8); this is that layer for the continuous-batching
+scheduler. A request moves
+
+    submit() -> QUEUED -> (slot free) RUNNING -> FINISHED
+             -> EngineOverloadError when the admission queue is full
+                (shed at the door — reject-with-overload, never an
+                unbounded queue)
+
+with a per-request streaming callback fired on every emitted token and
+RequestMetrics stamping queue-wait/TTFT/TPOT along the way. The engine
+is driven synchronously — step() interleaves admissions and one batched
+decode; run_until_drained() loops — so tests and batch jobs need no
+threads, while submit() itself is lock-protected so producer threads can
+feed a driver loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .kv_cache import ShapeBuckets, SlotKVCache
+from .metrics import EngineMetrics, RequestMetrics
+from .scheduler import ContinuousBatchingScheduler
+
+__all__ = ["ServingConfig", "ServingEngine", "GenerationRequest",
+           "EngineOverloadError"]
+
+
+class EngineOverloadError(RuntimeError):
+    """Admission queue full: the request was shed, not enqueued."""
+
+
+class ServingConfig:
+    """Engine knobs. num_slots bounds concurrency (the KV pool's batch
+    dim); max_queue bounds the admission queue (beyond it, submit()
+    sheds); prefill_buckets is the fixed set of padded prompt lengths
+    (compile count is O(len(buckets))); max_len is the pool's per-slot
+    capacity (default cfg.max_pos)."""
+
+    def __init__(self, num_slots: int = 4, max_queue: int = 16,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_len: Optional[int] = None, top_k: int = 0,
+                 max_admits_per_step: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.num_slots = int(num_slots)
+        self.max_queue = int(max_queue)
+        self.prefill_buckets = tuple(prefill_buckets) \
+            if prefill_buckets is not None else None
+        self.max_len = max_len
+        self.top_k = int(top_k)
+        self.max_admits_per_step = max_admits_per_step
+        self.clock = clock
+
+
+class GenerationRequest:
+    """One generate call in flight. `tokens` accumulates the generated
+    ids (prompt excluded); `output()` is prompt + generated. state is
+    one of queued / running / finished / cancelled / shed."""
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 temperature: float, seed: int, eos_id: Optional[int],
+                 on_token: Optional[Callable[["GenerationRequest", int],
+                                             Any]],
+                 clock: Callable[[], float]):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.tokens: List[int] = []
+        self.state = "queued"
+        self.metrics = RequestMetrics(clock)
+
+    @property
+    def finished(self) -> bool:
+        return self.state == "finished"
+
+    def output(self) -> np.ndarray:
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+def _default_buckets(max_len: int):
+    sizes, s = [], 16
+    while s < max_len:
+        sizes.append(s)
+        s *= 2
+    sizes.append(max_len)
+    return sizes
+
+
+class ServingEngine:
+    """Continuous-batching generate service over a GPT parameter pytree.
+
+    params/cfg are gpt_decode's (collect_gpt_params + GPTConfig);
+    inference.create_engine() wires them from a saved model dir."""
+
+    def __init__(self, params, cfg, serving: Optional[ServingConfig] = None):
+        serving = serving or ServingConfig()
+        self.cfg = cfg
+        self.config = serving
+        max_len = int(serving.max_len if serving.max_len is not None
+                      else cfg.max_pos)
+        if max_len > cfg.max_pos:
+            raise ValueError(
+                f"max_len {max_len} exceeds cfg.max_pos {cfg.max_pos}")
+        if serving.prefill_buckets is not None:
+            buckets = serving.prefill_buckets
+            too_big = [b for b in buckets if b > max_len]
+            if too_big:
+                raise ValueError(
+                    f"prefill_buckets {too_big} exceed max_len {max_len} "
+                    "— a prompt filling such a bucket could never fit the "
+                    "KV pool")
+        else:
+            buckets = _default_buckets(max_len)
+        self.buckets = ShapeBuckets(buckets)
+        import jax.numpy as jnp
+        dtype = params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
+            else jnp.float32
+        self.kv = SlotKVCache(cfg, serving.num_slots, max_len, dtype)
+        self.scheduler = ContinuousBatchingScheduler(
+            params, cfg, self.kv, self.buckets, top_k=serving.top_k)
+        self.metrics = EngineMetrics()
+        self._queue: List[GenerationRequest] = []
+        self._pending_cancels: List[GenerationRequest] = []
+        self._lock = threading.Lock()
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               seed: int = 0, eos_id: Optional[int] = None,
+               on_token: Optional[Callable] = None) -> GenerationRequest:
+        """Enqueue one generate request. Raises ValueError for requests
+        that can never be served (too long for the buckets/pool) and
+        EngineOverloadError when the queue is full (backpressure: the
+        caller sheds load or retries later; nothing queues unboundedly)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.buckets.bucket_for(prompt.size)          # raises if too long
+        total = prompt.size + max_new_tokens
+        if total > self.kv.max_len:
+            # max_len <= cfg.max_pos (enforced at construction), so this
+            # also guards the wpe-table clamp gpt_generate raises for
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the pool's max_len "
+                f"({self.kv.max_len})")
+        req = GenerationRequest(prompt, max_new_tokens, temperature, seed,
+                                eos_id, on_token, self.config.clock)
+        with self._lock:
+            self.metrics.submitted += 1
+            if len(self._queue) >= self.config.max_queue:
+                self.metrics.shed += 1
+                req.state = "shed"
+                raise EngineOverloadError(
+                    f"admission queue full ({self.config.max_queue}); "
+                    "request shed")
+            req.metrics.mark_submitted()
+            self._queue.append(req)
+            self.metrics.queue_depth = len(self._queue)
+        return req
+
+    # -- drive loop ---------------------------------------------------------
+
+    def _emit(self, event):
+        req: GenerationRequest = event.request
+        if req.state == "cancelled":
+            # cancelled concurrently with the decode step that produced
+            # this token: swallow the emission, the slot frees next step
+            return
+        req.tokens.append(event.token)
+        req.metrics.mark_token()
+        self.metrics.tokens_out += 1
+        if event.finished:
+            req.state = "finished"
+            req.metrics.mark_finished()
+            self.metrics.record(req.metrics)
+        if req.on_token is not None:
+            req.on_token(req, event.token)
+
+    def step(self) -> int:
+        """Admit waiting requests into free slots, then run ONE batched
+        decode step across everything in flight. Returns the number of
+        tokens emitted (0 means idle)."""
+        admitted = []
+        with self._lock:
+            # apply deferred cancels first (scheduler state is only ever
+            # touched from the driver thread; cancel() just marks)
+            for req in self._pending_cancels:
+                self.scheduler.cancel(req)
+            self._pending_cancels.clear()
+            limit = self.config.max_admits_per_step
+            # slots are claimed later in scheduler.admit, so bound the
+            # pop count by the free slots NOW, not per-iteration
+            can_take = self.kv.free_count
+            if limit is not None:
+                can_take = min(can_take, limit)
+            while self._queue and len(admitted) < can_take:
+                admitted.append(self._queue.pop(0))
+            self.metrics.queue_depth = len(self._queue)
+        emitted = 0
+        for req in admitted:
+            # stamp BEFORE the prefill dispatch: queue_wait is time spent
+            # waiting for a slot, not prefill/compile latency (that lands
+            # in ttft)
+            req.state = "running"
+            req.metrics.mark_admitted()
+            self.metrics.admitted += 1
+            self.metrics.prefills += 1
+            event = self.scheduler.admit(
+                req, req.prompt, req.max_new_tokens,
+                temperature=req.temperature, seed=req.seed,
+                eos_id=req.eos_id)
+            assert event is not None  # pop count was bounded by free slots
+            self._emit(event)
+            emitted += 1
+        events = self.scheduler.step()
+        if events:
+            self.metrics.decode_steps += 1
+        for event in events:
+            self._emit(event)
+            emitted += 1
+        self.metrics.active_slots = self.kv.active_count
+        return emitted
+
+    def run_until_drained(self, max_steps: Optional[int] = None) -> int:
+        """Step until queue and slots are empty; returns steps taken."""
+        steps = 0
+        while self._queue or self.scheduler.active_count:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    def generate(self, prompts: Sequence, max_new_tokens: int,
+                 **kw) -> List[np.ndarray]:
+        """Convenience batch call: submit + drive interleaved (steps the
+        engine whenever the admission queue is full, so prompt lists
+        longer than max_queue flow through instead of shedding), then
+        drain. Returns each prompt's full (prompt + generated) array."""
+        reqs = []
+        for p in prompts:
+            while len(self._queue) >= self.config.max_queue:
+                self.step()
+            reqs.append(self.submit(p, max_new_tokens, **kw))
+        self.run_until_drained()
+        return [r.output() for r in reqs]
+
+    def cancel(self, req: GenerationRequest) -> bool:
+        """Abandon a request (client disconnect): drop it from the queue,
+        or mark a running request for the DRIVER thread to free at the
+        start of its next step() — scheduler/slot state is never touched
+        from the calling thread, so cancel() is safe concurrently with a
+        driver inside step()."""
+        with self._lock:
+            if req in self._queue:
+                self._queue.remove(req)
+                req.state = "cancelled"
+                self.metrics.queue_depth = len(self._queue)
+                return True
+            if req.state == "running":
+                req.state = "cancelled"
+                self._pending_cancels.append(req)
+                return True
+        return False
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        s = self.metrics.snapshot()
+        s.update(self.kv.occupancy())
+        s["queue_depth"] = len(self._queue)
+        s["compiled_executables"] = self.scheduler.compile_count
+        return s

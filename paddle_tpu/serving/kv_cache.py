@@ -1,0 +1,128 @@
+"""Slot-based KV-cache manager for the continuous-batching scheduler.
+
+The reference keeps the device saturated by handing each in-flight
+request its own DeviceWorker-owned scope over shared persistables
+(trainer/device_worker layer, SURVEY §2.8); the TPU-native analog is one
+fixed-shape KV pool `(layers, 2, num_slots, heads, max_len, head_dim)`
+where a "slot" is one sequence's cache rows. Fixed shapes are the whole
+point: XLA compiles ONE decode executable for the pool (batch dim =
+num_slots, always), and prefill compiles once per PROMPT-LENGTH BUCKET —
+compile count is O(buckets), never O(requests).
+
+Host-side bookkeeping (alloc/free/length) lives here; the pool array
+itself is a jax value the scheduler threads through its jitted steps and
+stores back (`self.kv`), so slot retirement is free — a retired slot's
+rows simply go stale until the next admission's prefill overwrites them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["ShapeBuckets", "SlotKVCache"]
+
+
+class ShapeBuckets:
+    """The small fixed set of padded prompt lengths prefill compiles for.
+
+    bucket_for(n) returns the smallest bucket >= n; a prompt longer than
+    the largest bucket is a caller error (the engine validates at
+    submit), so admission can never trigger an unplanned compile."""
+
+    def __init__(self, sizes: Sequence[int]):
+        sizes = sorted(set(int(s) for s in sizes))
+        if not sizes:
+            raise ValueError("ShapeBuckets needs at least one size")
+        if sizes[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {sizes[0]}")
+        self.sizes: Tuple[int, ...] = tuple(sizes)
+
+    def __len__(self):
+        return len(self.sizes)
+
+    def __iter__(self):
+        return iter(self.sizes)
+
+    @property
+    def max(self) -> int:
+        return self.sizes[-1]
+
+    def bucket_for(self, n: int) -> int:
+        for s in self.sizes:
+            if s >= n:
+                return s
+        raise ValueError(
+            f"prompt length {n} exceeds the largest prefill bucket "
+            f"{self.sizes[-1]}")
+
+
+class SlotKVCache:
+    """Fixed-shape KV pool + slot allocator.
+
+    kv: (layers, 2, num_slots, heads, max_len, head_dim) — gpt_decode's
+    cache layout with the batch dim reinterpreted as slots. Allocation is
+    a free-list pop; `length(slot)` tracks how many positions hold live
+    K/V (prompt + generated so far) so the engine can report occupancy
+    and validate budgets."""
+
+    def __init__(self, cfg, num_slots: int, max_len: int, dtype=None):
+        import jax.numpy as jnp
+
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        heads, hd = cfg.heads, cfg.hidden // cfg.heads
+        self.dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.dtype(jnp.float32)
+        self.kv = jnp.zeros(
+            (cfg.layers, 2, self.num_slots, heads, self.max_len, hd),
+            self.dtype)
+        self._free = list(range(self.num_slots - 1, -1, -1))  # pop -> 0,1,..
+        self._len = [0] * self.num_slots
+
+    # -- allocation ---------------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot; None when the pool is full (the scheduler
+        leaves the request queued)."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def free(self, slot: int):
+        if slot in self._free or not 0 <= slot < self.num_slots:
+            raise ValueError(f"free() of slot {slot} not allocated")
+        self._len[slot] = 0
+        self._free.append(slot)
+
+    # -- per-slot length tracking ------------------------------------------
+
+    def set_length(self, slot: int, n: int):
+        if not 0 <= n <= self.max_len:
+            raise ValueError(
+                f"slot length {n} out of range [0, {self.max_len}]")
+        self._len[slot] = int(n)
+
+    def advance(self, slot: int):
+        self.set_length(slot, self._len[slot] + 1)
+
+    def length(self, slot: int) -> int:
+        return self._len[slot]
+
+    def occupancy(self) -> Dict[str, int]:
+        return {"num_slots": self.num_slots,
+                "active_slots": self.active_count,
+                "free_slots": self.free_count,
+                "live_positions": sum(self._len)}

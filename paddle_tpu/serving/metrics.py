@@ -1,0 +1,138 @@
+"""Serving metrics: per-request latency breakdown + engine gauges.
+
+The reference's profiler counts op-level host/device events
+(platform/profiler.h RecordEvent); a serving engine needs the
+request-level cuts on top: queue wait (submit -> slot admission), TTFT
+(submit -> first token out), TPOT (mean inter-token time after the
+first), and engine gauges (active slots, queue depth, shed count).
+Everything exports as plain dicts — scrapers and tests consume them
+directly, no metrics-framework dependency. Device-side visibility comes
+from the profiler.RecordEvent scopes the scheduler wraps around every
+prefill/decode dispatch (they land in the jax trace next to the XLA
+ops).
+
+The clock is injectable (default time.monotonic) so tests can pin exact
+TTFT/TPOT values with a fake clock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = ["RequestMetrics", "EngineMetrics"]
+
+
+class RequestMetrics:
+    """Lifecycle timestamps for one request; stamp methods are called by
+    the engine as the request moves queue -> slot -> tokens -> done."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self.submitted_at: Optional[float] = None
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.tokens_out = 0
+
+    def mark_submitted(self):
+        self.submitted_at = self._clock()
+
+    def mark_admitted(self):
+        self.admitted_at = self._clock()
+
+    def mark_token(self):
+        self.tokens_out += 1
+        if self.first_token_at is None:
+            self.first_token_at = self._clock()
+
+    def mark_finished(self):
+        self.finished_at = self._clock()
+
+    # -- derived cuts -------------------------------------------------------
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.submitted_at is None or self.admitted_at is None:
+            return None
+        return self.admitted_at - self.submitted_at
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token: submit -> first emission."""
+        if self.submitted_at is None or self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (the decode-step
+        steady state); None until at least two tokens are out."""
+        if (self.first_token_at is None or self.finished_at is None
+                or self.tokens_out < 2):
+            return None
+        return ((self.finished_at - self.first_token_at)
+                / (self.tokens_out - 1))
+
+    @property
+    def total(self) -> Optional[float]:
+        if self.submitted_at is None or self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def to_dict(self) -> Dict[str, Optional[float]]:
+        return {"queue_wait": self.queue_wait, "ttft": self.ttft,
+                "tpot": self.tpot, "total": self.total,
+                "tokens_out": self.tokens_out}
+
+
+class EngineMetrics:
+    """Engine-level counters + gauges. Counters are monotonic; gauges are
+    set by the engine each step. record() folds a finished request's
+    RequestMetrics into running means so snapshot() carries fleet-level
+    ttft/tpot without keeping every request alive."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.tokens_out = 0
+        self.decode_steps = 0
+        self.prefills = 0
+        # gauges
+        self.active_slots = 0
+        self.queue_depth = 0
+        # running sums over completed requests
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
+        self._tpot_sum = 0.0
+        self._tpot_n = 0
+        self._wait_sum = 0.0
+        self._wait_n = 0
+
+    def record(self, rm: RequestMetrics):
+        self.completed += 1
+        if rm.ttft is not None:
+            self._ttft_sum += rm.ttft
+            self._ttft_n += 1
+        if rm.tpot is not None:
+            self._tpot_sum += rm.tpot
+            self._tpot_n += 1
+        if rm.queue_wait is not None:
+            self._wait_sum += rm.queue_wait
+            self._wait_n += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        def mean(s, n):
+            return s / n if n else None
+        return {"submitted": self.submitted, "admitted": self.admitted,
+                "completed": self.completed, "shed": self.shed,
+                "tokens_out": self.tokens_out,
+                "decode_steps": self.decode_steps,
+                "prefills": self.prefills,
+                "active_slots": self.active_slots,
+                "queue_depth": self.queue_depth,
+                "mean_ttft": mean(self._ttft_sum, self._ttft_n),
+                "mean_tpot": mean(self._tpot_sum, self._tpot_n),
+                "mean_queue_wait": mean(self._wait_sum, self._wait_n)}

@@ -1,0 +1,241 @@
+"""Continuous-batching step loop over the slot KV pool.
+
+Orca/vLLM-style iteration-level scheduling on top of gpt_decode's
+prefill/step split: instead of running each request's whole decode loop
+alone (TPU idle between requests, batch-1 latency everywhere), the
+scheduler keeps ONE batched decode step hot over all slots and admits
+new requests into free slots between steps:
+
+    admit:  pad the prompt to a shape bucket, gpt_prefill_padded into the
+            slot's pool rows, sample the first token from the prompt's
+            last-position logits — one dispatch per bucket shape.
+    step:   gpt_decode_step_slots over the WHOLE pool (fixed batch =
+            num_slots, per-slot positions) + in-graph per-slot sampling —
+            always the same executable, whatever mix of sequences is in
+            flight.
+    retire: finished sequences just free their slot; the batch never
+            stalls and the next admission's prefill overwrites the rows.
+
+Compile discipline (the point of the fixed shapes): executables =
+len(prefill buckets) + 1 decode step + 1 admission sampler. The
+`compile_count`/`compile_events` hook counts traces as they happen so
+tests can assert O(buckets), not O(requests).
+
+Greedy sequences reproduce the sequential `gpt_generate` path
+token-for-token: the per-slot step math is gpt_decode_step's row-by-row,
+and argmax runs in-graph exactly as `_sample` does. Sampled sequences
+(temperature > 0) use a per-slot PRNG key seeded from the request seed —
+deterministic per request, but a different key schedule than
+gpt_generate's single chain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .. import profiler
+from .kv_cache import ShapeBuckets, SlotKVCache
+
+__all__ = ["ContinuousBatchingScheduler", "SequenceEvent"]
+
+
+class SequenceEvent(NamedTuple):
+    """One emitted token: (opaque request object, token id, finished)."""
+    request: Any
+    token: int
+    finished: bool
+
+
+class _Running:
+    """Host-side state of the sequence occupying one slot."""
+
+    __slots__ = ("req", "pos", "last_token", "produced", "max_new",
+                 "eos_id", "temperature")
+
+    def __init__(self, req, pos, last_token, max_new, eos_id, temperature):
+        self.req = req
+        self.pos = pos                    # absolute position fed next
+        self.last_token = last_token      # token to feed at `pos`
+        self.produced = 1                 # prefill already sampled one
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.temperature = temperature
+
+
+class ContinuousBatchingScheduler:
+    """Owns the device state (KV pool, per-slot PRNG keys) and the three
+    jitted entry points; the engine above it owns queues and lifecycle."""
+
+    def __init__(self, params, cfg, kv: SlotKVCache, buckets: ShapeBuckets,
+                 top_k: int = 0):
+        import jax
+
+        self.params = params
+        self.cfg = cfg
+        self.kv = kv
+        self.buckets = buckets
+        self.top_k = int(top_k)
+        self._running: Dict[int, _Running] = {}
+        self._compile_events: List[str] = []
+        self._keys = jax.random.split(
+            jax.random.PRNGKey(0), kv.num_slots)
+        self._prefill_jit = None
+        self._step_jit = None
+        self._admit_jit = None
+
+    # -- jitted entry points ------------------------------------------------
+    #
+    # Each impl appends to _compile_events as a python side effect, which
+    # runs exactly once per trace (= once per distinct input signature =
+    # once per compiled executable): the compile-counter hook.
+
+    def _sample_row(self, key, logits, temp):
+        """In-graph per-slot sampler — gpt_decode._sample with the
+        temperature as a traced per-slot value instead of a static."""
+        import jax
+        import jax.numpy as jnp
+
+        key_next, key_use = jax.random.split(key)
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temp, 1e-6)
+        if self.top_k > 0:
+            vals, idx = jax.lax.top_k(scaled, self.top_k)
+            choice = jax.random.categorical(key_use, vals)
+            drawn = idx[choice].astype(jnp.int32)
+        else:
+            drawn = jax.random.categorical(key_use, scaled).astype(jnp.int32)
+        return jnp.where(temp > 0.0, drawn, greedy), key_next
+
+    def _ensure_jits(self):
+        if self._step_jit is not None:
+            return
+        import jax
+        # deferred: models/__init__ pulls every model module (each doing
+        # `import paddle_tpu`), which must not run during package import
+        from ..models import gpt_decode as gd
+
+        def prefill_impl(params, pool, tokens, real_len, slot):
+            self._compile_events.append(f"prefill:L{tokens.shape[1]}")
+            logits, pc = gd.gpt_prefill_padded(
+                params, self.cfg, tokens, real_len, self.kv.max_len)
+            pool = jax.lax.dynamic_update_slice(
+                pool, pc.astype(pool.dtype), (0, 0, slot, 0, 0, 0))
+            return logits[0], pool
+
+        def admit_impl(keys, slot, seed, logits, temp):
+            self._compile_events.append("admit_sample")
+            keys = keys.at[slot].set(jax.random.PRNGKey(seed))
+            nxt, key_next = self._sample_row(keys[slot], logits, temp)
+            return nxt, keys.at[slot].set(key_next)
+
+        def step_impl(params, pool, tokens, ts, keys, temps):
+            self._compile_events.append("decode_step")
+            logits, pool = gd.gpt_decode_step_slots(
+                params, self.cfg, tokens, pool, ts)
+            nxt, keys = jax.vmap(self._sample_row)(keys, logits, temps)
+            return nxt, pool, keys
+
+        self._prefill_jit = jax.jit(prefill_impl)
+        self._admit_jit = jax.jit(admit_impl)
+        self._step_jit = jax.jit(step_impl)
+
+    # -- compile-counter hook ----------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._compile_events)
+
+    @property
+    def compile_events(self) -> Tuple[str, ...]:
+        return tuple(self._compile_events)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return len(self._running)
+
+    def admit(self, req, prompt: np.ndarray, max_new: int,
+              temperature: float = 0.0, seed: int = 0,
+              eos_id: Optional[int] = None) -> Optional[SequenceEvent]:
+        """Claim a slot, prefill the prompt into it (padded to its shape
+        bucket), sample the first token. Returns the first-token event,
+        or None when no slot is free (caller keeps the request queued)."""
+        self._ensure_jits()
+        slot = self.kv.alloc()
+        if slot is None:
+            return None
+        prompt = np.asarray(prompt, np.int32).reshape(1, -1)
+        p_len = prompt.shape[1]
+        bucket = self.buckets.bucket_for(p_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p_len] = prompt[0]
+        with profiler.RecordEvent("serving/prefill"):
+            logits, pool = self._prefill_jit(
+                self.params, self.kv.kv, padded,
+                np.asarray([p_len], np.int32), np.int32(slot))
+            first, self._keys = self._admit_jit(
+                self._keys, np.int32(slot), np.int32(seed), logits,
+                np.float32(temperature))
+        self.kv.kv = pool
+        self.kv.set_length(slot, p_len)
+        first = int(first)
+        st = _Running(req, pos=p_len, last_token=first, max_new=max_new,
+                      eos_id=eos_id, temperature=temperature)
+        finished = (st.produced >= max_new
+                    or (eos_id is not None and first == eos_id))
+        if finished:
+            self.kv.free(slot)
+        else:
+            self._running[slot] = st
+        return SequenceEvent(req, first, finished)
+
+    def step(self) -> List[SequenceEvent]:
+        """One batched decode step over the whole pool. Free slots ride
+        along with dummy inputs (fixed shapes are what keep this a single
+        executable); their outputs are discarded and their stale-row
+        writes are overwritten by the next admission's prefill before any
+        attention window can read them."""
+        if not self._running:
+            return []
+        self._ensure_jits()
+        s_dim = self.kv.num_slots
+        tokens = np.zeros((s_dim,), np.int32)
+        ts = np.zeros((s_dim,), np.int32)
+        temps = np.zeros((s_dim,), np.float32)
+        for slot, st in self._running.items():
+            tokens[slot] = st.last_token
+            ts[slot] = st.pos
+            temps[slot] = st.temperature
+        with profiler.RecordEvent("serving/decode_step"):
+            nxt, pool, self._keys = self._step_jit(
+                self.params, self.kv.kv, tokens, ts, self._keys, temps)
+        self.kv.kv = pool
+        nxt = np.asarray(nxt)
+        events: List[SequenceEvent] = []
+        for slot in sorted(self._running):
+            st = self._running[slot]
+            tok = int(nxt[slot])
+            st.produced += 1
+            st.last_token = tok
+            st.pos += 1
+            self.kv.advance(slot)
+            finished = (st.produced >= st.max_new
+                        or (st.eos_id is not None and tok == st.eos_id))
+            if finished:
+                del self._running[slot]
+                self.kv.free(slot)
+            events.append(SequenceEvent(st.req, tok, finished))
+        return events
+
+    def cancel(self, req) -> bool:
+        """Drop a running sequence (client disconnect): free its slot
+        without emitting further tokens."""
+        for slot, st in list(self._running.items()):
+            if st.req is req:
+                del self._running[slot]
+                self.kv.free(slot)
+                return True
+        return False

@@ -83,5 +83,48 @@ def test_profile_summary_aggregation():
     assert ("zero", "HBM") not in rows  # zero-time rows dropped
 
 
+def test_profiler_stop_without_start_is_noop():
+    """stop_profiler with no trace active returns None instead of
+    raising (serving PR satellite: safe teardown paths)."""
+    assert pt.profiler.stop_profiler() is None
+    assert pt.profiler.stop_profiler() is None        # idempotent
+
+
+def test_profiler_context_double_stop_safe():
+    """A body that already stopped the trace (or raised after a stop)
+    must not blow up the profiler() exit path."""
+    prof_dir = tempfile.mkdtemp()
+    with pt.profiler.profiler(profile_path=prof_dir):
+        assert pt.profiler.stop_profiler() == prof_dir
+    # exception inside the body after a double-stop: the ORIGINAL error
+    # propagates, not a RuntimeError from the exit path
+    with pytest.raises(ValueError, match="boom"):
+        with pt.profiler.profiler(profile_path=prof_dir):
+            pt.profiler.stop_profiler()
+            raise ValueError("boom")
+    # the profiler still works after the aborted sessions
+    with pt.profiler.profiler(profile_path=prof_dir):
+        pass
+    assert pt.profiler.stop_profiler() is None
+
+
+def test_bench_serving_row_shape():
+    """tools/bench_serving emits one JSON row per (model, concurrency)
+    with throughput/TTFT/TPOT (same style as bench_inference)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_model("tiny", concurrencies=[1, 2],
+                                   requests_per_level=3, max_new=4)
+    assert len(rows) == 2
+    for row in rows:
+        assert row["metric"].startswith("tiny_serving_c")
+        assert row["value"] > 0                  # tokens/s
+        assert row["unit"] == "tokens/s"
+        for k in ("mean_ttft_ms", "mean_tpot_ms", "completed",
+                  "compiled_executables"):
+            assert k in row["extra"], row
+        assert row["extra"]["completed"] == 3
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))

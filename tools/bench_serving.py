@@ -1,0 +1,113 @@
+"""Continuous-batching serving bench: one JSON row per
+(model, concurrency) with generate throughput + TTFT/TPOT — the serving
+companion to tools/bench_inference.py's per-batch latency rows.
+
+Concurrency maps to the engine's slot count; each level pushes a fixed
+request mix (varied prompt lengths over the engine's shape buckets)
+through the engine and reports steady-state tokens/s plus the
+request-level latency cuts from serving.metrics. Usage:
+
+    python tools/bench_serving.py [tiny gpt2]          # default: both
+    BENCH_SERVING_REQUESTS=32 python tools/bench_serving.py gpt2
+
+Prints one JSON line per (model, concurrency), bench_inference style.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+MODELS = {
+    # name -> (GPTConfig kwargs, concurrencies, prompt lens, buckets)
+    "tiny": (dict(vocab_size=97, hidden=32, layers=2, heads=4, max_pos=128,
+                  dropout=0.0, attn_impl="xla"),
+             [1, 2, 4, 8], (4, 7, 12, 15), (8, 16)),
+    "gpt2": (dict(dropout=0.0),                        # GPT-2-small
+             [1, 4, 8, 16], (32, 57, 100, 120), (64, 128)),
+}
+
+
+def build_params(gpt_kwargs):
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+
+    cfg = GPTConfig(**gpt_kwargs)
+    with pt.unique_name_guard():
+        main, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+    return cfg, params
+
+
+def run_model(name, concurrencies=None, requests_per_level=None,
+              max_new=32):
+    """Benchmark one model at each concurrency; returns the JSON rows."""
+    import paddle_tpu as pt
+
+    gpt_kwargs, default_cc, prompt_lens, buckets = MODELS[name]
+    concurrencies = concurrencies or default_cc
+    requests_per_level = requests_per_level or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    cfg, params = build_params(gpt_kwargs)
+    max_len = max(buckets) + max_new
+    rng = np.random.RandomState(0)
+    rows = []
+    for cc in concurrencies:
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(num_slots=cc,
+                                     max_queue=requests_per_level,
+                                     prefill_buckets=buckets,
+                                     max_len=max_len))
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               (prompt_lens[i % len(prompt_lens)],)
+                               ).astype(np.int32)
+                   for i in range(requests_per_level)]
+        # warm the executables (compiles are O(buckets): one request AT
+        # each bucket length warms every prefill shape + the decode step)
+        eng.generate([np.ones((b,), np.int32) for b in buckets],
+                     max_new_tokens=2)
+        eng.metrics = pt.serving.EngineMetrics()   # drop warmup latencies
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.run_until_drained()
+        dt = time.perf_counter() - t0
+        s = eng.stats()
+        tokens = sum(len(r.tokens) for r in reqs)
+        rows.append({
+            "metric": f"{name}_serving_c{cc}",
+            "value": round(tokens / dt, 2),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "extra": {
+                "requests": requests_per_level,
+                "completed": s["completed"],
+                "max_new": max_new,
+                "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2),
+                "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3),
+                "mean_queue_wait_ms": round(s["mean_queue_wait"] * 1e3, 2),
+                "decode_steps": s["decode_steps"],
+                "compiled_executables": s["compiled_executables"],
+            },
+        })
+    return rows
+
+
+def main():
+    models = sys.argv[1:] or ["tiny", "gpt2"]
+    for name in models:
+        for row in run_model(name):
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.dataset",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.profiler",
     "paddle_tpu.dygraph",
     "paddle_tpu.transpiler",
