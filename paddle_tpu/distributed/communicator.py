@@ -18,7 +18,18 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability.tracer import trace_span, tracing_enabled
+
 _LOG = logging.getLogger(__name__)
+
+
+def _comm_span(name, argfn):
+    """Span for one KV-service RPC. `argfn` builds the byte-count args and
+    only runs while tracing is on — the send/recv loops fire every batch
+    and the disabled path must stay (near-)allocation-free."""
+    if not tracing_enabled():
+        return trace_span(name)        # the shared no-op span
+    return trace_span(name, "comm", argfn())
 
 __all__ = ["Communicator"]
 
@@ -168,8 +179,14 @@ class Communicator:
                 parts = plan.sparse_shard_parts(s, g[0], g[1])
                 for j, (ep, r, v) in enumerate(parts):
                     try:
-                        self._client(self._send_clients, ep).push_sparse(
-                            s.name, r, v)
+                        with _comm_span(
+                                "comm/push_sparse",
+                                lambda r=r, v=v: {
+                                    "var": s.name,
+                                    "bytes": int(r.nbytes + v.nbytes),
+                                    "rows": int(r.shape[0])}):
+                            self._client(self._send_clients,
+                                         ep).push_sparse(s.name, r, v)
                     except Exception:
                         rem = parts[j:]
                         batch[s.grad_name] = (
@@ -178,7 +195,11 @@ class Communicator:
                         raise
             else:
                 c = self._client(self._send_clients, s.endpoint)
-                c.push_dense(s.name, np.asarray(g, np.float32))
+                dense = np.asarray(g, np.float32)
+                with _comm_span("comm/push_dense",
+                                lambda: {"var": s.name,
+                                         "bytes": int(dense.nbytes)}):
+                    c.push_dense(s.name, dense)
             del batch[s.grad_name]
         self.sent_batches += 1
 
@@ -217,7 +238,10 @@ class Communicator:
                     continue
                 try:
                     c = self._client(self._recv_clients, s.endpoint)
-                    w = c.pull_dense(s.name, s.size).reshape(s.shape)
+                    with _comm_span("comm/pull_dense",
+                                    lambda: {"var": s.name,
+                                             "bytes": int(s.size * 4)}):
+                        w = c.pull_dense(s.name, s.size).reshape(s.shape)
                 except Exception as e:
                     if not self._running:
                         return  # shutdown

@@ -26,6 +26,7 @@ import numpy as np
 
 from .core import Program, Variable, default_main_program
 from .registry import LowerContext, lower_op, get_op_def
+from ..observability.tracer import trace_span, tracing_enabled
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard",
            "as_jax_function"]
@@ -228,6 +229,13 @@ class Executor:
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
             scope: Optional[Scope] = None,
             return_numpy: bool = True):
+        # one observability span per run; a disabled tracer makes this a
+        # shared-singleton no-op (paddle_tpu.observability.tracer)
+        with trace_span("executor/run", "executor"):
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy)
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from ..compiler import CompiledProgram  # lazy import
 
         if program is None:
@@ -292,7 +300,8 @@ class Executor:
                         f"compute ops; split the program (the reference "
                         f"emits separate save/load programs too)")
         for op in host_pre:
-            _HOST_OPS[op.type](op, scope, feed)
+            with trace_span(f"host/{op.type}", "host"):
+                _HOST_OPS[op.type](op, scope, feed)
         if not compute_seen:
             # host-only program (save/load programs): everything already
             # ran via host_pre above
@@ -328,8 +337,11 @@ class Executor:
         def _do_compile():
             feed_shapes = {k: _sig(v)[0] for k, v in feed.items()}
             self.compile_count += 1
-            return self._compile(program, feed_shapes, fetch_names,
-                                 mutable, created, readonly, dist_plan)
+            with trace_span("executor/compile", "executor",
+                            {"ops": len(blk.ops),
+                             "fetches": len(fetch_names)}):
+                return self._compile(program, feed_shapes, fetch_names,
+                                     mutable, created, readonly, dist_plan)
 
         compiled = self._memo(self._cache, cache_key, _do_compile)
 
@@ -379,7 +391,8 @@ class Executor:
         scope.set_var("@RNG@", new_key)
 
         for op in host_post:  # saves/sends see the post-step scope
-            _HOST_OPS[op.type](op, scope, feed)
+            with trace_span(f"host/{op.type}", "host"):
+                _HOST_OPS[op.type](op, scope, feed)
 
         if finite_flags:
             for tag, ok in finite_flags.items():
@@ -452,9 +465,25 @@ class Executor:
                                mesh=dist_plan.mesh if dist_plan else None,
                                spmd_axes=getattr(dist_plan, "spmd_axes", ())
                                if dist_plan else ())
+            # Per-op host spans (name = op type, args = var names): the
+            # whole-block-jit design lowers each op exactly once, at trace
+            # time, so the spans land on the compiling run — the host-side
+            # analog of the reference executor's per-op RecordEvent.
+            # FLAGS_trace_ops=0 suppresses them while keeping run/compile
+            # spans; checked at trace time, so enable tracing BEFORE the
+            # first run of a program (cached executables re-trace nothing).
+            trace_ops = (tracing_enabled()
+                         and os.environ.get("FLAGS_trace_ops", "1") != "0")
             finite_flags = {}
             for i, op in enumerate(ops):
-                lower_op(ctx, op, env)
+                if trace_ops:
+                    with trace_span(op.type, "op",
+                                    {"op_index": i,
+                                     "inputs": ",".join(op.input_names()),
+                                     "outputs": ",".join(op.output_names())}):
+                        lower_op(ctx, op, env)
+                else:
+                    lower_op(ctx, op, env)
                 if dist_plan is not None:
                     dist_plan.constrain(op, env)
                 if check_nan_inf:

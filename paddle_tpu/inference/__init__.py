@@ -105,11 +105,15 @@ class Predictor:
         """inputs: dict name->array, or list of arrays in get_input_names
         order (ZeroCopy style)."""
         from ..framework.executor import scope_guard
+        from ..observability.tracer import trace_span
         if not isinstance(inputs, dict):
             inputs = dict(zip(self._feed_names, inputs))
-        with scope_guard(self._scope):
-            return self._exe.run(self._program, feed=inputs,
-                                 fetch_list=self._fetch_vars)
+        # no span args: predict is a hot path and the disabled tracer
+        # must cost one call + one flag check, zero allocation
+        with trace_span("inference/predict", "inference"):
+            with scope_guard(self._scope):
+                return self._exe.run(self._program, feed=inputs,
+                                     fetch_list=self._fetch_vars)
 
     # ZeroCopyTensor-flavored API
     def set_input(self, name: str, value):

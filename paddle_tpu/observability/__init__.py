@@ -1,0 +1,41 @@
+"""paddle_tpu.observability: tracing, metrics, and trace export.
+
+The framework-wide observability subsystem (reference: platform/profiler
++ tools/timeline.py, grown into a first-class layer):
+
+* `tracer` — thread-safe ring-buffer span recorder with a near-no-op
+  disabled path. The executor (per-op spans behind FLAGS_trace_ops),
+  the serving engine/scheduler, the distributed communicator, the
+  parallel collectives, and the legacy `paddle_tpu.profiler` API all
+  record here.
+* `metrics` — process-wide registry of labeled counters / gauges /
+  histograms with JSON snapshot and Prometheus text export; the
+  serving engine's TTFT/TPOT/queue metrics are its first tenant.
+* `export` — chrome://tracing (catapult) JSON writer + per-span
+  self-time rollup; `tools/trace_summary.py` is the CLI.
+
+Quick start:
+
+    import paddle_tpu as pt
+    pt.observability.enable_tracing()
+    exe.run(main, feed=..., fetch_list=[loss])        # per-op spans
+    pt.observability.export_chrome_trace("/tmp/trace.json")
+    print(pt.observability.get_registry().to_prometheus())
+
+Stdlib-only on import: safe to import anywhere in the framework with no
+jax side effects.
+"""
+
+from . import export, metrics, tracer  # noqa: F401
+from .export import export_chrome_trace, self_times, summarize
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry)
+from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
+                     get_tracer, trace_span, tracing_enabled)
+
+__all__ = [
+    "Span", "Tracer", "get_tracer", "trace_span", "enable_tracing",
+    "disable_tracing", "tracing_enabled",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "export_chrome_trace", "self_times", "summarize",
+]

@@ -1,0 +1,227 @@
+"""Low-overhead span tracer: the host-event half of the reference's
+profiler (platform/profiler.h RecordEvent / Event table, profiler.cc's
+per-thread event lists), rebuilt as a first-class subsystem.
+
+Design constraints, in order:
+
+* **Disabled is a near-no-op.** `trace_span()` on a disabled tracer
+  returns a shared singleton context manager — no allocation, no clock
+  read, no lock. The serving decode loop and the executor wrap every
+  dispatch in a span, so the disabled path IS the production path.
+* **Thread-safe by construction.** Spans complete into a ring buffer
+  under one small lock (the reference kept per-thread event lists and
+  merged at report time; a single deque + lock is simpler and the
+  ~100 ns lock cost only exists while tracing is ON). Nesting depth is
+  tracked per thread in a `threading.local` stack, so concurrent
+  serving requests never corrupt each other's nesting.
+* **Bounded memory.** The ring holds the most recent `capacity` spans;
+  older spans fall off and are counted in `dropped` instead of growing
+  without bound in a long-running service.
+* **Monotonic clocks.** Timestamps are `time.monotonic_ns` relative to
+  the tracer's epoch, exported as microseconds — the unit Chrome's
+  trace viewer expects — immune to wall-clock steps.
+
+The process-wide tracer (`get_tracer()`) is what the executor, the
+serving engine, the communicator, and the legacy `paddle_tpu.profiler`
+API all record into; `observability.export` turns its snapshot into a
+chrome://tracing JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from collections import deque
+
+__all__ = ["Span", "Tracer", "get_tracer", "trace_span", "enable_tracing",
+           "disable_tracing", "tracing_enabled"]
+
+
+class Span(NamedTuple):
+    """One completed trace range (chrome "X" event)."""
+    name: str
+    cat: str
+    ts_us: float        # start, microseconds since the tracer's epoch
+    dur_us: float
+    tid: int            # recording thread's ident (chrome track id)
+    thread: str         # recording thread's name (track label)
+    depth: int          # nesting depth within the thread at begin time
+    args: Optional[Dict[str, Any]]
+
+
+class _NullSpan:
+    """Shared do-nothing context manager: the disabled fast path. One
+    instance for the whole process — entering/exiting allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """Open span: stamps begin on __enter__, records on __exit__."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_begin_ns", "_depth")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        self._depth = len(stack)
+        stack.append(self)
+        self._begin_ns = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        end_ns = time.monotonic_ns()
+        tr = self._tracer
+        stack = tr._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        else:  # exited out of order (generator teardown): best effort
+            try:
+                stack.remove(self)
+            except ValueError:
+                pass
+        if tr._enabled:  # may have been disabled while the span was open
+            t = threading.current_thread()
+            tr._record(Span(self.name, self.cat,
+                            (self._begin_ns - tr._epoch_ns) / 1e3,
+                            (end_ns - self._begin_ns) / 1e3,
+                            t.ident, t.name, self._depth, self.args))
+        return False
+
+
+class Tracer:
+    """Thread-safe ring-buffer span recorder with a disabled fast path."""
+
+    def __init__(self, capacity: int = 65536):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._lock = threading.Lock()
+        self._capacity = int(capacity)
+        self._spans: "deque[Span]" = deque(maxlen=self._capacity)
+        self._recorded = 0          # total spans ever recorded since clear()
+        self._enabled = False
+        self._local = threading.local()
+        self._epoch_ns = time.monotonic_ns()
+
+    # -- switch --------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity: Optional[int] = None) -> "Tracer":
+        """Turn recording on (optionally resizing the ring). Idempotent."""
+        with self._lock:
+            if capacity is not None and int(capacity) != self._capacity:
+                self._capacity = int(capacity)
+                self._spans = deque(self._spans, maxlen=self._capacity)
+            self._enabled = True
+        return self
+
+    def disable(self) -> None:
+        """Turn recording off; already-recorded spans stay available."""
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._recorded = 0
+
+    # -- recording -----------------------------------------------------------
+
+    def span(self, name: str, cat: str = "",
+             args: Optional[Dict[str, Any]] = None):
+        """Context manager recording one complete span. When the tracer is
+        disabled this returns the shared no-op span — callers can wrap hot
+        paths unconditionally."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "",
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a zero-duration marker at 'now'."""
+        if not self._enabled:
+            return
+        t = threading.current_thread()
+        self._record(Span(name, cat,
+                          (time.monotonic_ns() - self._epoch_ns) / 1e3,
+                          0.0, t.ident, t.name, len(self._stack()), args))
+
+    # -- inspection ----------------------------------------------------------
+
+    def snapshot(self) -> List[Span]:
+        """Consistent copy of the ring (oldest first)."""
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def span_count(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        """Spans pushed off the ring since the last clear()."""
+        with self._lock:
+            return self._recorded - len(self._spans)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    # -- internals -----------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._recorded += 1
+            self._spans.append(span)
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer every instrumented layer records into."""
+    return _GLOBAL
+
+
+def trace_span(name: str, cat: str = "",
+               args: Optional[Dict[str, Any]] = None):
+    """`with trace_span("executor/run"): ...` on the global tracer."""
+    return _GLOBAL.span(name, cat, args)
+
+
+def enable_tracing(capacity: Optional[int] = None) -> Tracer:
+    return _GLOBAL.enable(capacity)
+
+
+def disable_tracing() -> None:
+    _GLOBAL.disable()
+
+
+def tracing_enabled() -> bool:
+    return _GLOBAL._enabled

@@ -29,8 +29,25 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..observability.tracer import trace_span, tracing_enabled
+
 __all__ = ["ring_attention", "ulysses_attention", "ring_attention_sharded",
            "ulysses_attention_sharded"]
+
+
+def _comm_span(kind: str, k, axis_name: str, hops: int):
+    """Observability span for one collective call site. Recorded at trace
+    time (these wrappers run under jit tracing), so the span measures
+    host-side build cost; the byte count is the collective's per-device
+    K+V traffic — the number tools/comm_volume.py accounts for on the
+    wire. k: the local K shard (V matches). Disabled tracing skips the
+    byte math entirely."""
+    if not tracing_enabled():
+        return trace_span(kind)               # the shared no-op span
+    per_hop = 2 * int(np.prod(k.shape)) * k.dtype.itemsize   # K and V
+    return trace_span(f"comm/{kind}", "comm",
+                      {"axis": axis_name, "bytes": per_hop * max(1, hops),
+                       "bytes_per_hop": per_hop})
 
 _NEG_INF = -1e30
 
@@ -146,9 +163,11 @@ def ring_attention(q, k, v, mesh, axis_name: str, bias_k=None,
     mesh axis `axis_name`; bias_k optional (b, s) per-key additive bias."""
     body = functools.partial(ring_attention_sharded, axis_name=axis_name,
                              causal=causal, sm_scale=sm_scale)
-    return _shard_mapped(lambda a, b_, c, d_: body(a, b_, c, d_),
-                         mesh, axis_name, bias_k is not None)(
-        q, k, v, bias_k)
+    hops = int(mesh.shape[axis_name])
+    with _comm_span("ring_attention", k, axis_name, hops):
+        return _shard_mapped(lambda a, b_, c, d_: body(a, b_, c, d_),
+                             mesh, axis_name, bias_k is not None)(
+            q, k, v, bias_k)
 
 
 def ulysses_attention(q, k, v, mesh, axis_name: str, bias_k=None,
@@ -157,6 +176,8 @@ def ulysses_attention(q, k, v, mesh, axis_name: str, bias_k=None,
                       impl: Optional[str] = None):
     body = functools.partial(ulysses_attention_sharded, axis_name=axis_name,
                              causal=causal, sm_scale=sm_scale, impl=impl)
-    return _shard_mapped(lambda a, b_, c, d_: body(a, b_, c, d_),
-                         mesh, axis_name, bias_k is not None)(
-        q, k, v, bias_k)
+    # all_to_all moves each shard once in, once back out: 2 "hops"
+    with _comm_span("ulysses_attention", k, axis_name, 2):
+        return _shard_mapped(lambda a, b_, c, d_: body(a, b_, c, d_),
+                             mesh, axis_name, bias_k is not None)(
+            q, k, v, bias_k)

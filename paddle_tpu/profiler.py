@@ -1,38 +1,65 @@
 """Profiling (reference: python/paddle/fluid/profiler.py + platform/profiler.h
 RecordEvent / platform/device_tracer.cc CUPTI capture).
 
-TPU redesign: jax.profiler already captures both host events and device
-(XLA) timelines into an xplane trace viewable in TensorBoard/Perfetto — the
-equivalent of the reference's host event table + CUPTI DeviceTracer merged
-timeline (tools/timeline.py). `RecordEvent` maps to jax.profiler ranges,
-and the executor annotates every lowered op with jax.named_scope so op-level
-names survive into XLA metadata and show up in the trace.
+Thin adapter over `paddle_tpu.observability`: the legacy API keeps its
+signatures, but the host-event half now records into the observability
+tracer (thread-safe ring buffer, chrome-trace exportable) instead of an
+ad-hoc path, so every existing `RecordEvent` call site — the serving
+scheduler's prefill/decode dispatches, user code — gains real traces for
+free. The device half is unchanged: jax.profiler captures host + device
+(XLA) timelines into an xplane trace viewable in TensorBoard/Perfetto
+(the analog of the reference's host event table + CUPTI DeviceTracer
+merged timeline), and the executor annotates every lowered op with
+jax.named_scope so op-level names survive into XLA metadata.
+
+start_profiler/profiler() drive BOTH: they start a jax xplane trace and
+enable the observability tracer; stop_profiler stops the xplane trace
+and drops a `host_spans.json` chrome trace of the recorded host spans
+into the trace directory. For tracer-only (no jax trace) capture, use
+`paddle_tpu.observability.enable_tracing()` directly.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+
+from .observability import export as _obs_export
+from .observability import tracer as _obs_tracer
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
            "cuda_profiler", "record_event"]
 
 _active_dir = None
+_tracer_was_enabled = False  # tracer state to restore at stop_profiler
 
 
 def start_profiler(state: str = "All", log_dir: str = "/tmp/paddle_tpu_prof"):
-    """reference: profiler.py start_profiler → core.EnableProfiler."""
-    global _active_dir
+    """reference: profiler.py start_profiler → core.EnableProfiler. Starts
+    a jax xplane trace AND enables the observability tracer. A second
+    start while profiling is absorbed (like stop without start), and no
+    profiler state mutates unless jax's trace actually started — a failed
+    start must not leave the tracer stuck on or repoint the active dir."""
+    global _active_dir, _tracer_was_enabled
     import jax
 
+    if _active_dir is not None:
+        return
+    jax.profiler.start_trace(log_dir)   # may raise: state untouched above
+    _tracer_was_enabled = _obs_tracer.tracing_enabled()
+    _obs_tracer.enable_tracing()
     _active_dir = log_dir
-    jax.profiler.start_trace(log_dir)
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
     """Stop the active trace and return its directory. Safe no-op (returns
     None) when no trace is active — the reference's stop without start is
     a user error we absorb, and it makes the profiler() context manager
-    exception-safe when the body already stopped the trace itself."""
+    exception-safe when the body already stopped the trace itself.
+
+    Also exports the host spans recorded since start_profiler as
+    `<dir>/host_spans.json` (chrome-trace JSON) and restores the tracer
+    to its pre-start enabled/disabled state."""
     global _active_dir
     if _active_dir is None:
         return None
@@ -40,6 +67,8 @@ def stop_profiler(sorted_key=None, profile_path=None):
 
     d = _active_dir
     _active_dir = None
+    if not _tracer_was_enabled:
+        _obs_tracer.disable_tracing()  # restore; spans stay readable
     try:
         jax.profiler.stop_trace()
     except RuntimeError:
@@ -47,6 +76,11 @@ def stop_profiler(sorted_key=None, profile_path=None):
         # stop_trace inside the profiler() body): already stopped is the
         # state we wanted
         return None
+    try:
+        _obs_export.export_chrome_trace(os.path.join(d, "host_spans.json"))
+    except OSError:
+        pass  # trace dir vanished (reset_profiler mid-flight): device
+        # trace already stopped cleanly, host spans stay in the ring
     return d
 
 
@@ -72,22 +106,38 @@ def cuda_profiler(*a, **kw):  # API parity; device tracing is always on
 
 
 class RecordEvent:
-    """RAII profiling range (reference: platform/profiler.h:81). Usable as a
-    context manager; shows up in the jax.profiler trace."""
+    """RAII profiling range (reference: platform/profiler.h:81). Usable as
+    a context manager. Records a span into the observability tracer
+    (thread-safe: concurrent serving requests each land on their own
+    thread track) and, for xplane/device visibility, also opens a
+    jax.profiler.TraceAnnotation. Extra keyword args become span args
+    (e.g. byte counts) visible in the chrome trace."""
 
-    def __init__(self, name: str):
+    __slots__ = ("name", "args", "_ctx", "_span")
+
+    def __init__(self, name: str, **args):
         self.name = name
+        self.args = args or None
         self._ctx = None
+        self._span = None
 
     def __enter__(self):
+        # annotation OUTSIDE the tracer span: the span's measured window
+        # must not include the annotation's own setup/teardown cost
         import jax
 
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        self._span = _obs_tracer.trace_span(self.name, "record_event",
+                                            self.args)
+        self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
+        self._span.__exit__(*exc)
+        self._span = None
         self._ctx.__exit__(*exc)
+        self._ctx = None
         return False
 
 
@@ -99,5 +149,6 @@ def reset_profiler():
     next start_profiler begins clean."""
     import glob
     import shutil
+    _obs_tracer.get_tracer().clear()
     for d in glob.glob("/tmp/paddle_tpu_prof*"):
         shutil.rmtree(d, ignore_errors=True)

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability.tracer import trace_span
 from .kv_cache import ShapeBuckets, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
 from .scheduler import ContinuousBatchingScheduler
@@ -196,6 +197,10 @@ class ServingEngine:
         """Admit waiting requests into free slots, then run ONE batched
         decode step across everything in flight. Returns the number of
         tokens emitted (0 means idle)."""
+        with trace_span("serving/engine_step", "serving"):
+            return self._step_impl()
+
+    def _step_impl(self) -> int:
         admitted = []
         with self._lock:
             # apply deferred cancels first (scheduler state is only ever
@@ -281,9 +286,19 @@ class ServingEngine:
 
     # -- observability ------------------------------------------------------
 
+    def close(self) -> None:
+        """Retire the engine: remove its labeled series from the global
+        metrics registry so scrapes stop reporting a dead engine (a
+        long-lived service recreating engines must not accumulate dead
+        labels). stats()/metrics keep working locally afterwards."""
+        self.metrics.unregister()
+
     def stats(self) -> Dict[str, Any]:
         s = self.metrics.snapshot()
         s.update(self.kv.occupancy())
         s["queue_depth"] = len(self._queue)
         s["compiled_executables"] = self.scheduler.compile_count
+        # the registry label this engine's serving_* series carry, so a
+        # caller can find them in observability.get_registry().snapshot()
+        s["engine_label"] = self.metrics.engine_label
         return s

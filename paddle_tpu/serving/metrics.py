@@ -1,15 +1,29 @@
-"""Serving metrics: per-request latency breakdown + engine gauges.
+"""Serving metrics: per-request latency breakdown + engine gauges,
+published through the process-wide observability registry.
 
 The reference's profiler counts op-level host/device events
 (platform/profiler.h RecordEvent); a serving engine needs the
 request-level cuts on top: queue wait (submit -> slot admission), TTFT
 (submit -> first token out), TPOT (mean inter-token time after the
 first), and engine gauges (active slots, queue depth, shed count).
-Everything exports as plain dicts — scrapers and tests consume them
-directly, no metrics-framework dependency. Device-side visibility comes
-from the profiler.RecordEvent scopes the scheduler wraps around every
-prefill/decode dispatch (they land in the jax trace next to the XLA
-ops).
+
+Storage is `paddle_tpu.observability.metrics`: every EngineMetrics
+instance owns labeled series (`engine="<n>"`) under stable names —
+counters `serving_<name>_total`, gauges `serving_active_slots` /
+`serving_queue_depth`, histograms `serving_ttft_seconds` /
+`serving_tpot_seconds` / `serving_queue_wait_seconds` — so a Prometheus
+scrape or `get_registry().snapshot()` sees the serving plane without
+holding the engine, and the bench's p50/p99 rows come registry-sourced.
+`snapshot()` still returns the same plain dict as before (scrapers and
+tests keep consuming it directly), now with p50/p99 columns. Device-side
+visibility comes from the profiler.RecordEvent scopes the scheduler
+wraps around every prefill/decode dispatch (they land in the
+observability tracer AND the jax trace next to the XLA ops).
+
+Degenerate cases return None, never raise and never emit inf: TPOT and
+output-rate cuts are undefined for single-token generations and for
+zero/negative-duration windows (a non-monotonic injected clock), and
+missing lifecycle stamps yield None throughout.
 
 The clock is injectable (default time.monotonic) so tests can pin exact
 TTFT/TPOT values with a fake clock.
@@ -17,8 +31,11 @@ TTFT/TPOT values with a fake clock.
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Callable, Dict, Optional
+
+from ..observability.metrics import MetricsRegistry, get_registry
 
 __all__ = ["RequestMetrics", "EngineMetrics"]
 
@@ -67,12 +84,30 @@ class RequestMetrics:
     @property
     def tpot(self) -> Optional[float]:
         """Mean time per output token AFTER the first (the decode-step
-        steady state); None until at least two tokens are out."""
+        steady state); None until at least two tokens are out, and None
+        for a negative emission window (non-monotonic injected clock) —
+        a nonsense sample must not poison the histogram."""
         if (self.first_token_at is None or self.finished_at is None
                 or self.tokens_out < 2):
             return None
-        return ((self.finished_at - self.first_token_at)
-                / (self.tokens_out - 1))
+        window = self.finished_at - self.first_token_at
+        if window < 0:
+            return None
+        return window / (self.tokens_out - 1)
+
+    @property
+    def output_tps(self) -> Optional[float]:
+        """Decode throughput: tokens after the first over the emission
+        window (first token -> finish). None for single-token
+        generations and zero/negative-duration windows — a rate over an
+        empty window is undefined, not inf."""
+        if (self.first_token_at is None or self.finished_at is None
+                or self.tokens_out < 2):
+            return None
+        window = self.finished_at - self.first_token_at
+        if window <= 0:
+            return None
+        return (self.tokens_out - 1) / window
 
     @property
     def total(self) -> Optional[float]:
@@ -82,57 +117,105 @@ class RequestMetrics:
 
     def to_dict(self) -> Dict[str, Optional[float]]:
         return {"queue_wait": self.queue_wait, "ttft": self.ttft,
-                "tpot": self.tpot, "total": self.total,
-                "tokens_out": self.tokens_out}
+                "tpot": self.tpot, "output_tps": self.output_tps,
+                "total": self.total, "tokens_out": self.tokens_out}
+
+
+_HELP = {
+    "submitted": "requests submitted (incl. shed)",
+    "admitted": "requests admitted into a KV slot",
+    "completed": "requests finished",
+    "shed": "requests rejected at the admission door",
+    "tokens_out": "total generated tokens",
+    "decode_steps": "batched decode steps executed",
+    "prefills": "prefill dispatches",
+    "active_slots": "KV slots currently occupied",
+    "queue_depth": "requests waiting for a slot",
+}
+
+_COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
+             "decode_steps", "prefills")
+_GAUGES = ("active_slots", "queue_depth")
+_HISTOGRAMS = {"ttft": "serving_ttft_seconds",
+               "tpot": "serving_tpot_seconds",
+               "queue_wait": "serving_queue_wait_seconds"}
 
 
 class EngineMetrics:
-    """Engine-level counters + gauges. Counters are monotonic; gauges are
-    set by the engine each step. record() folds a finished request's
-    RequestMetrics into running means so snapshot() carries fleet-level
-    ttft/tpot without keeping every request alive."""
+    """Engine-level counters + gauges, stored as labeled series in the
+    observability registry. Counters are monotonic; gauges are set by the
+    engine each step; record() feeds a finished request's RequestMetrics
+    into the TTFT/TPOT/queue-wait histograms so snapshot() carries
+    fleet-level means AND p50/p99 without keeping every request alive.
 
-    def __init__(self):
-        self.submitted = 0
-        self.admitted = 0
-        self.completed = 0
-        self.shed = 0
-        self.tokens_out = 0
-        self.decode_steps = 0
-        self.prefills = 0
-        # gauges
-        self.active_slots = 0
-        self.queue_depth = 0
-        # running sums over completed requests
-        self._ttft_sum = 0.0
-        self._ttft_n = 0
-        self._tpot_sum = 0.0
-        self._tpot_n = 0
-        self._wait_sum = 0.0
-        self._wait_n = 0
+    The attribute protocol is unchanged (`metrics.submitted += 1`,
+    `metrics.queue_depth = n`): each name is a property over its registry
+    series, so engine code and the registry can never disagree."""
+
+    _ids = itertools.count()
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 engine_label: Optional[str] = None):
+        self._registry = registry or get_registry()
+        self.engine_label = str(engine_label if engine_label is not None
+                                else next(EngineMetrics._ids))
+        label = {"engine": self.engine_label}
+        self._families = []
+        self._series = {}
+        for name in _COUNTERS:
+            fam = self._registry.counter(
+                f"serving_{name}_total", _HELP[name])
+            self._families.append(fam)
+            self._series[name] = fam.labels(**label)
+        for name in _GAUGES:
+            fam = self._registry.gauge(f"serving_{name}", _HELP[name])
+            self._families.append(fam)
+            self._series[name] = fam.labels(**label)
+        self._hists = {}
+        for key, full in _HISTOGRAMS.items():
+            fam = self._registry.histogram(
+                full, f"request {key.replace('_', ' ')} in seconds")
+            self._families.append(fam)
+            self._hists[key] = fam.labels(**label)
+
+    def unregister(self) -> None:
+        """Remove this engine's labeled series from the registry so a
+        retired/replaced engine stops showing up in scrapes (a long-lived
+        service recreating engines must not accumulate dead labels).
+        snapshot() keeps working on the detached series."""
+        for fam in self._families:
+            fam.remove(engine=self.engine_label)
 
     def record(self, rm: RequestMetrics):
         self.completed += 1
         if rm.ttft is not None:
-            self._ttft_sum += rm.ttft
-            self._ttft_n += 1
+            self._hists["ttft"].observe(rm.ttft)
         if rm.tpot is not None:
-            self._tpot_sum += rm.tpot
-            self._tpot_n += 1
+            self._hists["tpot"].observe(rm.tpot)
         if rm.queue_wait is not None:
-            self._wait_sum += rm.queue_wait
-            self._wait_n += 1
+            self._hists["queue_wait"].observe(rm.queue_wait)
 
-    def snapshot(self) -> Dict[str, float]:
-        def mean(s, n):
-            return s / n if n else None
-        return {"submitted": self.submitted, "admitted": self.admitted,
-                "completed": self.completed, "shed": self.shed,
-                "tokens_out": self.tokens_out,
-                "decode_steps": self.decode_steps,
-                "prefills": self.prefills,
-                "active_slots": self.active_slots,
-                "queue_depth": self.queue_depth,
-                "mean_ttft": mean(self._ttft_sum, self._ttft_n),
-                "mean_tpot": mean(self._tpot_sum, self._tpot_n),
-                "mean_queue_wait": mean(self._wait_sum, self._wait_n)}
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        out: Dict[str, Optional[float]] = {}
+        for name in _COUNTERS + _GAUGES:
+            out[name] = int(self._series[name].value)
+        for key, h in self._hists.items():
+            out[f"mean_{key}"] = h.mean
+            out[f"p50_{key}"] = h.quantile(0.5)
+            out[f"p99_{key}"] = h.quantile(0.99)
+        return out
+
+
+def _make_prop(name: str, doc: str) -> property:
+    def _get(self):
+        return int(self._series[name].value)
+
+    def _set(self, value):
+        self._series[name].set(value)
+
+    return property(_get, _set, doc=doc)
+
+
+for _name in _COUNTERS + _GAUGES:
+    setattr(EngineMetrics, _name, _make_prop(_name, _HELP[_name]))
+del _name

@@ -172,7 +172,8 @@ class ContinuousBatchingScheduler:
         bucket = self.buckets.bucket_for(p_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p_len] = prompt[0]
-        with profiler.RecordEvent("serving/prefill"):
+        with profiler.RecordEvent("serving/prefill", bucket=bucket,
+                                  prompt_len=p_len, slot=slot):
             logits, pool = self._prefill_jit(
                 self.params, self.kv.kv, padded,
                 np.asarray([p_len], np.int32), np.int32(slot))
@@ -209,7 +210,8 @@ class ContinuousBatchingScheduler:
             tokens[slot] = st.last_token
             ts[slot] = st.pos
             temps[slot] = st.temperature
-        with profiler.RecordEvent("serving/decode_step"):
+        with profiler.RecordEvent("serving/decode_step",
+                                  active=len(self._running), slots=s_dim):
             nxt, pool, self._keys = self._step_jit(
                 self.params, self.kv.kv, tokens, ts, self._keys, temps)
         self.kv.kv = pool

@@ -87,5 +87,103 @@ class TestAsyncCheckpoint(unittest.TestCase):
                         np.asarray(scope2.find_var(n)), before[n])
 
 
+class TestAtomicWriteCrashSafety(unittest.TestCase):
+    """_atomic_write / wait_for_saves crash-safety: a writer that dies
+    mid-write must never be observable at the destination path, and
+    wait_for_saves must drain every pending async save (and surface its
+    failure) before returning."""
+
+    def test_failed_write_never_touches_existing_destination(self):
+        from paddle_tpu.io import _atomic_write
+        with tempfile.TemporaryDirectory() as d:
+            dest = os.path.join(d, "ckpt.bin")
+            with open(dest, "wb") as f:
+                f.write(b"GOOD CHECKPOINT")
+
+            def bad_write(f):
+                f.write(b"partial garbage")   # bytes hit the TEMP file...
+                raise IOError("disk died mid-write")
+
+            with self.assertRaises(IOError):
+                _atomic_write(dest, bad_write)
+            # previous checkpoint intact, temp file cleaned up
+            with open(dest, "rb") as f:
+                self.assertEqual(f.read(), b"GOOD CHECKPOINT")
+            self.assertEqual(os.listdir(d), ["ckpt.bin"])
+
+    def test_failed_write_leaves_no_new_destination(self):
+        from paddle_tpu.io import _atomic_write
+        with tempfile.TemporaryDirectory() as d:
+            dest = os.path.join(d, "ckpt.bin")
+
+            def bad_write(f):
+                f.write(b"half a header")
+                raise ValueError("serialization bug")
+
+            with self.assertRaises(ValueError):
+                _atomic_write(dest, bad_write)
+            self.assertEqual(os.listdir(d), [])   # no dest, no litter
+
+    def test_wait_for_saves_surfaces_async_failure(self):
+        from paddle_tpu.io import _submit_write, wait_for_saves
+        wait_for_saves()                          # start clean
+        with tempfile.TemporaryDirectory() as d:
+            dest = os.path.join(d, "ckpt.bin")
+
+            def bad_write(f):
+                f.write(b"partial")
+                raise RuntimeError("async writer crashed")
+
+            _submit_write(dest, bad_write, sync=False)
+            with self.assertRaisesRegex(RuntimeError, "async writer"):
+                wait_for_saves()
+            self.assertEqual(os.listdir(d), [])   # dest never appeared
+        wait_for_saves()                          # error queue drained
+
+    def test_wait_for_saves_drains_slow_pending_writes(self):
+        import threading as _threading
+        import time as _time
+        from paddle_tpu.io import _submit_write, wait_for_saves
+        wait_for_saves()
+        with tempfile.TemporaryDirectory() as d:
+            dest = os.path.join(d, "ckpt.bin")
+            started = _threading.Event()
+
+            def slow_write(f):
+                started.set()
+                _time.sleep(0.2)
+                f.write(b"payload")
+
+            _submit_write(dest, slow_write, sync=False)
+            self.assertTrue(started.wait(timeout=10))
+            # the writer is mid-sleep: destination must not exist yet
+            self.assertFalse(os.path.exists(dest))
+            wait_for_saves()                      # blocks until durable
+            with open(dest, "rb") as f:
+                self.assertEqual(f.read(), b"payload")
+            self.assertEqual(os.listdir(d), ["ckpt.bin"])
+
+    def test_same_path_saves_apply_in_submission_order(self):
+        import time as _time
+        from paddle_tpu.io import _submit_write, wait_for_saves
+        wait_for_saves()
+        with tempfile.TemporaryDirectory() as d:
+            dest = os.path.join(d, "ckpt.bin")
+
+            def make(payload, delay):
+                def write(f, p=payload, dl=delay):
+                    _time.sleep(dl)
+                    f.write(p)
+                return write
+
+            # first snapshot is SLOW, second is fast: the newest snapshot
+            # must still be the survivor (predecessor chaining)
+            _submit_write(dest, make(b"old snapshot", 0.2), sync=False)
+            _submit_write(dest, make(b"new snapshot", 0.0), sync=False)
+            wait_for_saves()
+            with open(dest, "rb") as f:
+                self.assertEqual(f.read(), b"new snapshot")
+
+
 if __name__ == "__main__":
     unittest.main()

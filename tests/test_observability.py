@@ -1,0 +1,485 @@
+"""paddle_tpu.observability — tracing, metrics registry, chrome export.
+
+Pins the subsystem's contracts: (1) running a program through
+Executor.run with tracing enabled produces a chrome-trace JSON with at
+least one complete ("ph": "X") event per executed op, loadable in
+catapult format; (2) serving-engine metrics are visible in a registry
+snapshot after a 10-request continuous-batching run and the Prometheus
+text export parses; (3) the disabled-tracer path records nothing — the
+span count stays zero across full executor runs, and trace_span returns
+one shared singleton (no per-call allocation); (4) the legacy
+profiler.RecordEvent API delegates to the tracer and is thread-safe
+under concurrent recording."""
+
+import json
+import re
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with a disabled, empty tracer."""
+    obs.disable_tracing()
+    obs.get_tracer().clear()
+    yield
+    obs.disable_tracing()
+    obs.get_tracer().clear()
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+def test_disabled_trace_span_is_shared_singleton():
+    """Disabled fast path: no allocation — every call returns THE no-op
+    span, and nothing is recorded."""
+    assert obs.trace_span("a") is obs.trace_span("b", "cat", {"k": 1})
+    with obs.trace_span("ignored"):
+        pass
+    assert obs.get_tracer().span_count == 0
+
+
+def test_nested_spans_depths_and_order():
+    obs.enable_tracing()
+    with obs.trace_span("outer", "t"):
+        with obs.trace_span("inner", "t", {"k": "v"}):
+            pass
+    spans = obs.get_tracer().snapshot()
+    # spans complete inner-first
+    assert [s.name for s in spans] == ["inner", "outer"]
+    inner, outer = spans
+    assert inner.depth == 1 and outer.depth == 0
+    assert inner.args == {"k": "v"}
+    assert inner.ts_us >= outer.ts_us
+    assert inner.dur_us <= outer.dur_us
+    assert outer.dur_us >= 0
+
+
+def test_ring_buffer_caps_memory_and_counts_drops():
+    t = obs.Tracer(capacity=4)
+    t.enable()
+    for i in range(10):
+        with t.span(f"s{i}"):
+            pass
+    assert t.span_count == 4
+    assert t.dropped == 6
+    assert [s.name for s in t.snapshot()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_per_thread_tracks():
+    obs.enable_tracing()
+    def work():
+        with obs.trace_span("worker_span"):
+            pass
+    th = threading.Thread(target=work, name="obs-worker")
+    with obs.trace_span("main_span"):
+        th.start()
+        th.join()
+    spans = obs.get_tracer().snapshot()
+    by_name = {s.name: s for s in spans}
+    assert by_name["worker_span"].tid != by_name["main_span"].tid
+    assert by_name["worker_span"].thread == "obs-worker"
+
+
+def test_concurrent_spans_thread_safe():
+    """Hammer the tracer from many threads: every span lands, none torn
+    (the old profiler kept an unlocked module-global list; the satellite
+    asks for this exact pin)."""
+    n_threads, per_thread = 8, 200
+    obs.enable_tracing(capacity=n_threads * per_thread + 100)
+    def work(idx):
+        for i in range(per_thread):
+            with obs.trace_span(f"t{idx}", "stress", {"i": i}):
+                pass
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    tracer = obs.get_tracer()
+    assert tracer.span_count == n_threads * per_thread
+    assert tracer.dropped == 0
+    spans = tracer.snapshot()
+    per = {f"t{i}": 0 for i in range(n_threads)}
+    for s in spans:
+        per[s.name] += 1
+        assert s.dur_us >= 0
+    assert all(v == per_thread for v in per.values())
+
+
+def test_record_event_delegates_to_tracer():
+    obs.enable_tracing()
+    with pt.profiler.RecordEvent("legacy/evt", bytes=128):
+        pass
+    spans = obs.get_tracer().snapshot()
+    assert [s.name for s in spans] == ["legacy/evt"]
+    assert spans[0].cat == "record_event"
+    assert spans[0].args == {"bytes": 128}
+    # disabled -> no recording, still usable
+    obs.disable_tracing()
+    with pt.profiler.RecordEvent("legacy/evt2"):
+        pass
+    assert obs.get_tracer().span_count == 1
+
+
+# ---------------------------------------------------------------------------
+# executor integration: chrome trace with >= 1 "X" event per executed op
+# ---------------------------------------------------------------------------
+
+def _small_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [16])
+        y = pt.layers.fc(x, 16, act="relu")
+        loss = pt.layers.reduce_mean(y)
+    return main, startup, loss
+
+
+def test_executor_run_emits_chrome_trace_per_op():
+    main, startup, loss = _small_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        obs.enable_tracing()
+        obs.get_tracer().clear()
+        exe.run(main, feed={"x": np.random.rand(4, 16).astype("f")},
+                fetch_list=[loss])
+    obs.disable_tracing()
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        path = f.name
+    obs.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    # catapult object form
+    assert isinstance(doc, dict) and "traceEvents" in doc
+    events = doc["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    for e in xs:  # complete events carry the catapult-required keys
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+    # >= 1 complete event per executed op, named by op type, carrying
+    # the op's var names in args
+    ops = [op for op in main.global_block.ops
+           if op.type not in ("feed", "fetch")]
+    assert ops
+    for op in ops:
+        matching = [e for e in xs if e["name"] == op.type]
+        assert matching, f"no span for executed op {op.type!r}"
+        assert any("outputs" in e.get("args", {}) for e in matching)
+    # run-level span present too, and thread metadata names the track
+    assert any(e["name"] == "executor/run" for e in xs)
+    assert any(e.get("ph") == "M" and e["name"] == "thread_name"
+               for e in events)
+
+
+def test_disabled_tracer_records_nothing_during_runs():
+    """The production path: tracer off, full executor runs, zero spans
+    recorded (the disabled trace_span is a no-op, not a buffer)."""
+    main, startup, loss = _small_program()
+    exe = pt.Executor()
+    tracer = obs.get_tracer()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        assert tracer.span_count == 0
+        for _ in range(3):
+            exe.run(main, feed={"x": np.random.rand(4, 16).astype("f")},
+                    fetch_list=[loss])
+        assert tracer.span_count == 0
+        assert tracer.dropped == 0
+
+
+def test_trace_ops_flag_suppresses_per_op_spans(monkeypatch):
+    monkeypatch.setenv("FLAGS_trace_ops", "0")
+    main, startup, loss = _small_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        obs.enable_tracing()
+        obs.get_tracer().clear()
+        exe.run(main, feed={"x": np.random.rand(4, 16).astype("f")},
+                fetch_list=[loss])
+    names = {s.name for s in obs.get_tracer().snapshot()}
+    assert "executor/run" in names          # run/compile spans stay
+    assert "mul" not in names and "relu" not in names
+
+
+def test_self_time_rollup_subtracts_children():
+    obs.enable_tracing()
+    import time
+    with obs.trace_span("parent"):
+        time.sleep(0.002)
+        with obs.trace_span("child"):
+            time.sleep(0.004)
+    st = obs.self_times(obs.get_tracer().snapshot())
+    assert st["parent"]["total_us"] > st["parent"]["self_us"]
+    assert st["child"]["self_us"] == pytest.approx(
+        st["child"]["total_us"])
+    # child consumed most of parent's wall time
+    assert st["parent"]["self_us"] < st["child"]["self_us"] * 2
+    rows = obs.summarize(top=1)
+    assert rows[0]["name"] == "child"
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_snapshot():
+    reg = obs.MetricsRegistry()
+    reg.counter("steps_total", "steps").inc()
+    reg.counter("steps_total").inc(2)
+    reg.gauge("depth").set(7)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["steps_total"]["type"] == "counter"
+    assert snap["steps_total"]["series"][0]["value"] == 3
+    assert snap["depth"]["series"][0]["value"] == 7
+    hrow = snap["lat_seconds"]["series"][0]
+    assert hrow["count"] == 3 and hrow["sum"] == pytest.approx(2.55)
+    assert hrow["min"] == 0.05 and hrow["max"] == 2.0
+    assert hrow["p50"] == 0.5
+    assert hrow["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 3}
+    json.dumps(snap)                     # JSON-able end to end
+    # labeled series are distinct
+    fam = reg.counter("reqs_total")
+    fam.labels(model="a").inc()
+    fam.labels(model="b").inc(5)
+    vals = {s["labels"]["model"]: s["value"]
+            for s in reg.snapshot()["reqs_total"]["series"]}
+    assert vals == {"a": 1, "b": 5}
+
+
+def test_registry_kind_mismatch_and_counter_monotonic():
+    reg = obs.MetricsRegistry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError, match="increase"):
+        reg.counter("y_total").inc(-1)
+
+
+def test_registry_histogram_bucket_conflict_raises():
+    reg = obs.MetricsRegistry()
+    reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    reg.histogram("h_seconds", buckets=[0.1, 1.0])   # same layout: fine
+    reg.histogram("h_seconds")                       # unspecified: fine
+    with pytest.raises(ValueError, match="already registered with"):
+        reg.histogram("h_seconds", buckets=(0.5,))   # silent misfile, no
+
+
+def test_family_remove_retires_labeled_series():
+    reg = obs.MetricsRegistry()
+    fam = reg.gauge("slots")
+    fam.labels(engine="0").set(4)
+    fam.labels(engine="1").set(2)
+    assert fam.remove(engine="0") is True
+    assert fam.remove(engine="0") is False           # already gone
+    labels = [s["labels"] for s in reg.snapshot()["slots"]["series"]]
+    assert labels == [{"engine": "1"}]
+
+
+def test_engine_metrics_unregister_drops_registry_series():
+    """A retired/replaced engine must not leave ghost series in scrapes
+    (tools/bench_serving.py recreates engines per concurrency level)."""
+    from paddle_tpu.serving.metrics import EngineMetrics
+    reg = obs.MetricsRegistry()
+    m = EngineMetrics(registry=reg)
+    m.submitted += 1
+    m.queue_depth = 3
+    label = m.engine_label
+    snap = reg.snapshot()
+    assert any(s["labels"].get("engine") == label
+               for s in snap["serving_submitted_total"]["series"])
+    m.unregister()
+    for fam in reg.snapshot().values():
+        assert not any(s["labels"].get("engine") == label
+                       for s in fam["series"]), fam
+    # the detached instance still answers locally
+    assert m.submitted == 1 and m.snapshot()["queue_depth"] == 3
+
+
+def test_histogram_quantiles_nearest_rank():
+    h = obs.Histogram(buckets=(1.0,))
+    assert h.quantile(0.5) is None       # empty -> None, not a crash
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(0.5) == 50.0
+    assert h.quantile(0.99) == 99.0
+    assert h.quantile(1.0) == 100.0
+
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [0-9eE.+-]+|\+Inf|-Inf$')
+
+
+def test_prometheus_text_export_parses():
+    reg = obs.MetricsRegistry()
+    reg.counter("a_total", "with a\nnewline in help").labels(m="x").inc()
+    reg.gauge("b").set(1.5)
+    reg.histogram("c_seconds", buckets=(0.5,)).observe(0.1)
+    text = reg.to_prometheus()
+    assert text.endswith("\n")
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ",
+                            line), line
+            assert "\n" not in line
+        else:
+            assert _PROM_LINE.match(line), line
+    # histogram exposition: cumulative buckets + sum + count
+    assert 'c_seconds_bucket{le="0.5"} 1' in text
+    assert 'c_seconds_bucket{le="+Inf"} 1' in text
+    assert "c_seconds_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# serving integration: 10-request run lands in the global registry
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_engine_params():
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+    cfg = GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                    max_pos=64, dropout=0.0, attn_impl="xla")
+    main, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+    return cfg, params
+
+
+def test_serving_metrics_in_registry_snapshot(tiny_engine_params):
+    cfg, params = tiny_engine_params
+    eng = pt.serving.ServingEngine(
+        params, cfg, pt.serving.ServingConfig(
+            num_slots=2, max_queue=16, prefill_buckets=(4, 8), max_len=32))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (3 + i % 5,)).astype(np.int32)
+               for i in range(10)]
+    outs = eng.generate(prompts, max_new_tokens=4)
+    assert len(outs) == 10
+    label = eng.stats()["engine_label"]
+
+    snap = obs.get_registry().snapshot()
+
+    def series(name):
+        rows = [r for r in snap[name]["series"]
+                if r["labels"].get("engine") == label]
+        assert len(rows) == 1, (name, rows)
+        return rows[0]
+
+    assert series("serving_submitted_total")["value"] == 10
+    assert series("serving_completed_total")["value"] == 10
+    assert series("serving_tokens_out_total")["value"] == 40
+    assert series("serving_active_slots")["value"] == 0   # drained
+    ttft = series("serving_ttft_seconds")
+    assert ttft["count"] == 10 and ttft["p50"] is not None
+    tpot = series("serving_tpot_seconds")
+    assert tpot["count"] == 10 and tpot["p99"] is not None
+    assert tpot["max"] != float("inf")
+    # the same numbers flow out the Prometheus pipe
+    text = obs.get_registry().to_prometheus()
+    assert f'serving_submitted_total{{engine="{label}"}} 10' in text
+    assert "serving_ttft_seconds_bucket" in text
+    # and the engine's own snapshot agrees with the registry
+    s = eng.stats()
+    assert s["p50_ttft"] == ttft["p50"]
+    assert s["mean_tpot"] == pytest.approx(tpot["sum"] / tpot["count"])
+
+
+# ---------------------------------------------------------------------------
+# degenerate request metrics (satellite): None, never inf / raise
+# ---------------------------------------------------------------------------
+
+def test_engine_close_retires_registry_series(tiny_engine_params):
+    cfg, params = tiny_engine_params
+    eng = pt.serving.ServingEngine(
+        params, cfg, pt.serving.ServingConfig(
+            num_slots=1, prefill_buckets=(4,), max_len=16))
+    eng.generate([np.asarray([1, 2], np.int32)], max_new_tokens=2)
+    label = eng.stats()["engine_label"]
+    eng.close()
+    for fam in obs.get_registry().snapshot().values():
+        assert not any(s["labels"].get("engine") == label
+                       for s in fam["series"]), fam
+    assert eng.stats()["completed"] == 1     # local stats still answer
+
+
+def test_start_profiler_double_start_absorbed(tmp_path):
+    """A second start while profiling must neither repoint the active dir
+    nor leave the tracer stuck enabled after stop."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    pt.profiler.start_profiler(log_dir=d1)
+    pt.profiler.start_profiler(log_dir=d2)     # absorbed
+    assert pt.profiler.stop_profiler() == d1   # first dir wins
+    assert not obs.tracing_enabled()           # restored, not stuck on
+    assert pt.profiler.stop_profiler() is None
+
+
+def test_request_metrics_single_token_generation():
+    from paddle_tpu.serving.metrics import RequestMetrics
+    t = [0.0]
+    rm = RequestMetrics(clock=lambda: t[0])
+    rm.mark_submitted()
+    t[0] = 1.0
+    rm.mark_token()
+    rm.mark_finished()
+    d = rm.to_dict()
+    assert d["ttft"] == 1.0
+    assert d["tpot"] is None            # undefined, not ZeroDivisionError
+    assert d["output_tps"] is None
+    json.dumps(d)                        # no inf/nan leaks into export
+
+
+def test_request_metrics_zero_duration_window():
+    from paddle_tpu.serving.metrics import RequestMetrics
+    rm = RequestMetrics(clock=lambda: 5.0)   # frozen clock: 0-width window
+    rm.mark_submitted()
+    rm.mark_admitted()
+    rm.mark_token()
+    rm.mark_token()
+    rm.mark_token()
+    rm.mark_finished()
+    assert rm.tpot == 0.0                # well-defined: zero elapsed
+    assert rm.output_tps is None         # a rate over 0 s is NOT inf
+    assert rm.total == 0.0
+
+
+def test_request_metrics_backwards_clock_rejected():
+    from paddle_tpu.serving.metrics import RequestMetrics
+    t = [10.0]
+    rm = RequestMetrics(clock=lambda: t[0])
+    rm.mark_submitted()
+    rm.mark_token()
+    t[0] = 3.0                           # clock stepped backwards
+    rm.mark_token()
+    rm.mark_finished()
+    assert rm.tpot is None               # nonsense sample suppressed
+    assert rm.output_tps is None
+
+
+def test_request_metrics_unstamped_everything_none():
+    from paddle_tpu.serving.metrics import RequestMetrics
+    rm = RequestMetrics()
+    d = rm.to_dict()
+    assert d == {"queue_wait": None, "ttft": None, "tpot": None,
+                 "output_tps": None, "total": None, "tokens_out": 0}
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

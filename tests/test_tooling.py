@@ -124,6 +124,38 @@ def test_bench_serving_row_shape():
                   "compiled_executables"):
             assert k in row["extra"], row
         assert row["extra"]["completed"] == 3
+        # registry-sourced percentiles ride along (observability PR)
+        for k in ("p50_ttft_ms", "p99_ttft_ms", "p50_tpot_ms",
+                  "p99_tpot_ms"):
+            assert row["extra"][k] is not None and row["extra"][k] > 0, row
+
+
+def test_trace_summary_cli_smoke():
+    """tools/trace_summary.py over a trace written by the observability
+    exporter: top-N self-time table prints, JSON mode parses."""
+    import paddle_tpu.observability as obs
+    obs.enable_tracing()
+    obs.get_tracer().clear()
+    with obs.trace_span("alpha"):
+        with obs.trace_span("beta"):
+            pass
+    obs.disable_tracing()
+    path = os.path.join(tempfile.mkdtemp(), "trace.json")
+    obs.export_chrome_trace(path)
+    obs.get_tracer().clear()
+    cli = os.path.join(REPO, "tools/trace_summary.py")
+    r = subprocess.run([sys.executable, cli, path, "--top", "5"],
+                       capture_output=True, text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert "alpha" in r.stdout and "beta" in r.stdout
+    assert "self_ms" in r.stdout
+    r = subprocess.run([sys.executable, cli, path, "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    rows = json.loads(r.stdout)
+    assert {row["name"] for row in rows} == {"alpha", "beta"}
 
 
 if __name__ == "__main__":

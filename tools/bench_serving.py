@@ -76,6 +76,7 @@ def run_model(name, concurrencies=None, requests_per_level=None,
         # each bucket length warms every prefill shape + the decode step)
         eng.generate([np.ones((b,), np.int32) for b in buckets],
                      max_new_tokens=2)
+        eng.metrics.unregister()       # retire the warmup series' label
         eng.metrics = pt.serving.EngineMetrics()   # drop warmup latencies
         t0 = time.perf_counter()
         reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
@@ -83,6 +84,7 @@ def run_model(name, concurrencies=None, requests_per_level=None,
         dt = time.perf_counter() - t0
         s = eng.stats()
         tokens = sum(len(r.tokens) for r in reqs)
+        quantiles = _registry_quantiles(s["engine_label"])
         rows.append({
             "metric": f"{name}_serving_c{cc}",
             "value": round(tokens / dt, 2),
@@ -97,9 +99,31 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                 "mean_queue_wait_ms": round(s["mean_queue_wait"] * 1e3, 2),
                 "decode_steps": s["decode_steps"],
                 "compiled_executables": s["compiled_executables"],
+                **quantiles,
             },
         })
+        eng.close()                    # this engine is done: no dead
+        # labels left behind for the next concurrency level's scrape
     return rows
+
+
+def _registry_quantiles(engine_label):
+    """p50/p99 TTFT/TPOT in ms, read back from the observability registry
+    snapshot (NOT from engine internals) — proves the scrape path carries
+    the same numbers an operator would see."""
+    from paddle_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+    out = {}
+    for key, fam in (("ttft", "serving_ttft_seconds"),
+                     ("tpot", "serving_tpot_seconds")):
+        series = next((r for r in snap.get(fam, {}).get("series", [])
+                       if r["labels"].get("engine") == engine_label), None)
+        for q in ("p50", "p99"):
+            v = series[q] if series else None
+            out[f"{q}_{key}_ms"] = round(v * 1e3, 3) if v is not None \
+                else None
+    return out
 
 
 def main():

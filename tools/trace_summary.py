@@ -1,0 +1,68 @@
+"""Top-N spans by self-time from a chrome trace JSON.
+
+The report half of the reference's profiler (profiler.cc PrintProfiler's
+sorted event table) as a standalone CLI over the catapult trace-event
+format — works on traces written by
+`paddle_tpu.observability.export_chrome_trace`, by `tools/timeline.py`,
+or by anything else that emits chrome://tracing JSON.
+
+Usage:
+  python tools/trace_summary.py /tmp/trace.json [--top 20] [--json]
+
+Self time = a span's duration minus the durations of spans directly
+nested inside it on the same thread track; only complete ("ph": "X")
+events are counted.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def load_events(path: str):
+    """Chrome trace JSON: the object form {"traceEvents": [...]} or the
+    bare event-array form."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data
+
+
+def summarize_file(path: str, top=None):
+    from paddle_tpu.observability.export import summarize_chrome_events
+    return summarize_chrome_events(load_events(path), top=top)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="chrome trace JSON path")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows to print (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="print rows as one JSON array instead of a table")
+    args = ap.parse_args(argv)
+
+    rows = summarize_file(args.trace, top=args.top)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        print("no complete ('ph': 'X') events in trace")
+        return 0
+    name_w = max(4, max(len(r["name"]) for r in rows))
+    print(f"{'name':<{name_w}}  {'count':>7}  {'total_ms':>10}  "
+          f"{'self_ms':>10}  {'avg_self_us':>12}")
+    for r in rows:
+        print(f"{r['name']:<{name_w}}  {r['count']:>7}  "
+              f"{r['total_us'] / 1e3:>10.3f}  {r['self_us'] / 1e3:>10.3f}  "
+              f"{r['avg_self_us']:>12.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
