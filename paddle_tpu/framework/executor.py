@@ -26,6 +26,7 @@ import numpy as np
 
 from .core import Program, Variable, default_main_program
 from .registry import LowerContext, lower_op, get_op_def
+from ..observability.metrics import get_registry
 from ..observability.tracer import trace_span, tracing_enabled
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard",
@@ -229,11 +230,34 @@ class Executor:
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
             scope: Optional[Scope] = None,
             return_numpy: bool = True):
-        # one observability span per run; a disabled tracer makes this a
-        # shared-singleton no-op (paddle_tpu.observability.tracer)
-        with trace_span("executor/run", "executor"):
-            return self._run_impl(program, feed, fetch_list, scope,
-                                  return_numpy)
+        # Progress heartbeat for the stall watchdog (observability/
+        # watchdog.py): inflight goes up while a run is on the device,
+        # runs_total advances when it returns. Busy-with-no-progress for
+        # longer than the stall threshold triggers a flight record.
+        # labels() materializes both series BEFORE the run body — a hang
+        # in the very first run must already be visible to the monitor
+        # (runs=0, inflight=1), not hidden behind a counter that never
+        # got created. Families are re-fetched per run (not cached) so a
+        # registry reset can't orphan the heartbeat — the cost is two
+        # dict lookups against ms-scale dispatch.
+        reg = get_registry()
+        runs = reg.counter("executor_runs_total",
+                           "Executor.run calls completed").labels()
+        inflight = reg.gauge("executor_inflight_runs",
+                             "Executor.run calls currently "
+                             "executing").labels()
+        inflight.inc()
+        try:
+            # one observability span per run; a disabled tracer makes
+            # this a shared-singleton no-op — and when a serving request
+            # scope is ambient, the span carries its request_id
+            with trace_span("executor/run", "executor"):
+                out = self._run_impl(program, feed, fetch_list, scope,
+                                     return_numpy)
+            runs.inc()
+            return out
+        finally:
+            inflight.dec()
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from ..compiler import CompiledProgram  # lazy import

@@ -131,7 +131,8 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-def create_engine(config, gpt_config, serving=None, dtype=None):
+def create_engine(config, gpt_config, serving=None, dtype=None,
+                  debug_port=None):
     """Build a continuous-batching `serving.ServingEngine` from a saved
     GPT model dir — the serving-stack entry point, reusing the
     Config/Predictor loading path (the engine reads the decode weights
@@ -141,7 +142,11 @@ def create_engine(config, gpt_config, serving=None, dtype=None):
     config: inference.Config (or a model_dir string); gpt_config: the
     models.gpt.GPTConfig the saved model was built with; serving: a
     serving.ServingConfig (defaults apply when None); dtype: optional
-    cast for the decode weight copy (e.g. jnp.bfloat16)."""
+    cast for the decode weight copy (e.g. jnp.bfloat16); debug_port:
+    when not None, start (or join) the observability debug HTTP server
+    on that port (0 = ephemeral) — the bound port lands on
+    `engine.debug_port`, each engine holds one server reference, and
+    the server stops when the last referencing engine closes."""
     from ..models.gpt_decode import collect_gpt_params
     from ..serving import ServingConfig, ServingEngine
 
@@ -149,8 +154,22 @@ def create_engine(config, gpt_config, serving=None, dtype=None):
         config = Config(config)
     pred = Predictor(config)
     params = collect_gpt_params(pred._scope, gpt_config, dtype=dtype)
-    return ServingEngine(params, gpt_config,
-                         serving if serving is not None else ServingConfig())
+    engine = ServingEngine(params, gpt_config,
+                           serving if serving is not None
+                           else ServingConfig())
+    if debug_port is not None:
+        from ..observability.debug_server import acquire_debug_server
+        try:
+            # refcounted: each engine holds one reference; close()
+            # releases it and the shared server stops with the last one
+            engine.debug_port, engine._debug_server_ref = \
+                acquire_debug_server(port=debug_port)
+        except Exception:
+            # the engine was already built and registered its metrics
+            # series; losing the handle here would leak them forever
+            engine.close()
+            raise
+    return engine
 
 
 class PredictorPool:

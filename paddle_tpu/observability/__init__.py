@@ -1,41 +1,67 @@
-"""paddle_tpu.observability: tracing, metrics, and trace export.
+"""paddle_tpu.observability: tracing, metrics, export, live diagnostics.
 
 The framework-wide observability subsystem (reference: platform/profiler
-+ tools/timeline.py, grown into a first-class layer):
++ tools/timeline.py + the pserver monitor surface, grown into a
+first-class layer):
 
 * `tracer` — thread-safe ring-buffer span recorder with a near-no-op
   disabled path. The executor (per-op spans behind FLAGS_trace_ops),
   the serving engine/scheduler, the distributed communicator, the
   parallel collectives, and the legacy `paddle_tpu.profiler` API all
-  record here.
+  record here. `request_scope(rid)` tags every span a thread records
+  with a request id, so one request's timeline is reconstructable.
 * `metrics` — process-wide registry of labeled counters / gauges /
   histograms with JSON snapshot and Prometheus text export; the
-  serving engine's TTFT/TPOT/queue metrics are its first tenant.
+  serving engine's TTFT/TPOT metrics and the executor's progress
+  heartbeats are its tenants.
 * `export` — chrome://tracing (catapult) JSON writer + per-span
   self-time rollup; `tools/trace_summary.py` is the CLI.
+* `debug_server` — live diagnostics HTTP plane (stdlib-only):
+  `/metrics`, `/healthz`, `/varz`, `/tracez` (`?request_id=`,
+  `?chrome=1`), `/stacksz`. `start_debug_server(port=0)` returns the
+  bound port; `inference.create_engine(..., debug_port=)` wires it in.
+* `watchdog` — stall watchdog + flight recorder: a daemon thread that
+  watches the engine/executor progress heartbeats in the registry and
+  dumps stacks + spans + a metrics snapshot into a bounded-retention
+  `flight_<ts>/` directory when a busy component stops moving;
+  `dump_flight_record()` drives the same path manually, and overload
+  sheds can trigger it too.
 
 Quick start:
 
     import paddle_tpu as pt
     pt.observability.enable_tracing()
-    exe.run(main, feed=..., fetch_list=[loss])        # per-op spans
+    port = pt.observability.start_debug_server()   # curl :port/metrics
+    pt.observability.start_watchdog(stall_threshold=30)
+    exe.run(main, feed=..., fetch_list=[loss])     # per-op spans
     pt.observability.export_chrome_trace("/tmp/trace.json")
-    print(pt.observability.get_registry().to_prometheus())
 
 Stdlib-only on import: safe to import anywhere in the framework with no
 jax side effects.
 """
 
-from . import export, metrics, tracer  # noqa: F401
+from . import debug_server, export, metrics, tracer, watchdog  # noqa: F401
+from .debug_server import (DebugServer, get_debug_server,
+                           start_debug_server, stop_debug_server)
 from .export import export_chrome_trace, self_times, summarize
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
-from .tracer import (Span, Tracer, disable_tracing, enable_tracing,
-                     get_tracer, trace_span, tracing_enabled)
+from .tracer import (Span, Tracer, current_request_id, disable_tracing,
+                     enable_tracing, get_tracer, request_scope, trace_span,
+                     tracing_enabled)
+from .watchdog import (FlightRecorder, ProgressMonitor, Watchdog,
+                       dump_flight_record, format_all_stacks, get_watchdog,
+                       start_watchdog, stop_watchdog)
 
 __all__ = [
     "Span", "Tracer", "get_tracer", "trace_span", "enable_tracing",
-    "disable_tracing", "tracing_enabled",
+    "disable_tracing", "tracing_enabled", "request_scope",
+    "current_request_id",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "export_chrome_trace", "self_times", "summarize",
+    "DebugServer", "start_debug_server", "stop_debug_server",
+    "get_debug_server",
+    "Watchdog", "FlightRecorder", "ProgressMonitor", "start_watchdog",
+    "stop_watchdog", "get_watchdog", "dump_flight_record",
+    "format_all_stacks",
 ]

@@ -1,0 +1,334 @@
+"""Live diagnostics HTTP server: scrape/inspect a *running* process.
+
+The reference exposed its profiler/monitor state over the pserver's RPC
+surface; the serving analog (Dapper/Prometheus tradition, Go's
+net/http/pprof, gRPC's channelz) is a tiny debug HTTP plane an operator
+can curl while the job runs, instead of waiting for post-hoc trace
+files. Stdlib-only (`http.server.ThreadingHTTPServer`): the container
+has no web framework and needs none.
+
+Endpoints:
+
+    /          index (HTML link list)
+    /metrics   Prometheus text exposition of the process registry
+    /healthz   JSON liveness: per-engine + executor heartbeats with
+               last-progress ages, overall ok/stalled verdict
+    /varz      JSON everything: registry snapshot + tracer stats +
+               process info + watchdog status
+    /tracez    recent tracer spans as JSON; ?request_id= filters to one
+               request's end-to-end timeline; ?limit=N newest N;
+               ?chrome=1 downloads a catapult chrome-trace instead
+    /stacksz   all-thread Python stack dump (text/plain)
+
+`start_debug_server(port=0)` binds (0 = ephemeral), serves from daemon
+threads, and returns the bound port. The server holds no references
+into the serving engine — everything it reports flows through the
+observability registry/tracer, so it works for training jobs too, and
+a wedged engine can't wedge its own diagnostics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .export import spans_to_events
+from .metrics import MetricsRegistry, get_registry
+from .tracer import Span, Tracer, get_tracer
+from . import watchdog as _watchdog
+
+__all__ = ["DebugServer", "start_debug_server", "acquire_debug_server",
+           "release_debug_server", "stop_debug_server",
+           "get_debug_server"]
+
+_INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
+<h1>paddle_tpu live diagnostics</h1><ul>
+<li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
+<li><a href="/healthz">/healthz</a> — engine/executor liveness</li>
+<li><a href="/varz">/varz</a> — registry + tracer + process snapshot</li>
+<li><a href="/tracez">/tracez</a> — recent spans
+    (<code>?request_id=</code>, <code>?limit=</code>,
+     <code>?chrome=1</code>)</li>
+<li><a href="/stacksz">/stacksz</a> — all-thread stack dump</li>
+</ul></body></html>
+"""
+
+
+def _span_request_id(s: Span) -> Optional[str]:
+    return s.args.get("request_id") if s.args else None
+
+
+def _query_flag(q: Dict[str, str], name: str) -> bool:
+    return q.get(name, "").lower() not in ("", "0", "false", "no")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "ThreadingHTTPServer"  # carries .debug (DebugServer)
+
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # no stderr spam per scrape
+        pass
+
+    def _send(self, body: bytes, ctype: str, status: int = 200,
+              extra: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj: Any, status: int = 200) -> None:
+        self._send(json.dumps(obj, indent=2, default=str).encode(),
+                   "application/json", status)
+
+    # -- routing -------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        url = urlparse(self.path)
+        query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+        dbg: "DebugServer" = self.server.debug
+        route = dbg.routes.get(url.path)
+        if route is None:
+            self._send_json({"error": f"no such endpoint {url.path!r}",
+                            "endpoints": sorted(dbg.routes)}, status=404)
+            return
+        try:
+            dbg.requests.labels(path=url.path).inc()
+            route(self, query)
+        except BrokenPipeError:
+            pass                     # client went away mid-response
+        except Exception as e:       # a broken endpoint must report, not die
+            try:
+                self._send_json({"error": f"{type(e).__name__}: {e}"},
+                                status=500)
+            except Exception:
+                pass
+
+
+class DebugServer:
+    """One ThreadingHTTPServer bound to (host, port), serving the
+    observability plane from daemon threads."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
+        self._registry = registry or get_registry()
+        self._tracer = tracer or get_tracer()
+        self._monitor = _watchdog.ProgressMonitor(self._registry)
+        self._started_unix = time.time()
+        self.requests = self._registry.counter(
+            "debug_server_requests_total", "debug endpoint hits, by path")
+        self.routes = {
+            "/": self._index, "/metrics": self._metrics,
+            "/healthz": self._healthz, "/varz": self._varz,
+            "/tracez": self._tracez, "/stacksz": self._stacksz,
+        }
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.debug = self
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-debug-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    # -- endpoints -----------------------------------------------------------
+
+    def _index(self, h: _Handler, q: Dict[str, str]) -> None:
+        h._send(_INDEX.encode(), "text/html; charset=utf-8")
+
+    def _metrics(self, h: _Handler, q: Dict[str, str]) -> None:
+        h._send(self._registry.to_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+
+    def _healthz(self, h: _Handler, q: Dict[str, str]) -> None:
+        wd = _watchdog.get_watchdog()
+        raw = q.get("stall_threshold")
+        if raw is None:
+            threshold = wd.stall_threshold if wd else 30.0
+        else:
+            try:
+                threshold = float(raw)
+            except ValueError:
+                threshold = -1.0
+            if threshold <= 0:  # a probe typo must be a 400, not a
+                # 500 or a spurious "stalled" verdict
+                h._send_json({"error": f"bad stall_threshold {raw!r}: "
+                              "expected a positive number of seconds"},
+                             status=400)
+                return
+        progress = self._monitor.observe()
+        stalled = [k for k, e in progress.items()
+                   if e["busy"] and e["age_s"] >= threshold]
+        h._send_json({
+            "status": "stalled" if stalled else "ok",
+            "stalled": stalled,
+            "uptime_s": round(time.time() - self._started_unix, 3),
+            "progress": progress,
+            "watchdog": wd.status() if wd else {"running": False},
+        }, status=503 if stalled else 200)
+
+    def _varz(self, h: _Handler, q: Dict[str, str]) -> None:
+        h._send_json({
+            "process": {
+                "pid": os.getpid(),
+                "python": sys.version.split()[0],
+                "platform": sys.platform,
+                "threads": threading.active_count(),
+                "server_uptime_s": round(
+                    time.time() - self._started_unix, 3),
+                "argv": sys.argv,
+            },
+            "tracer": {
+                "enabled": self._tracer.enabled,
+                "span_count": self._tracer.span_count,
+                "dropped": self._tracer.dropped,
+                "capacity": self._tracer.capacity,
+            },
+            "watchdog": (w.status() if (w := _watchdog.get_watchdog())
+                         else {"running": False}),
+            "metrics": self._registry.snapshot(),
+        })
+
+    def _tracez(self, h: _Handler, q: Dict[str, str]) -> None:
+        spans = self._tracer.snapshot()
+        rid = q.get("request_id")
+        if rid is not None:
+            spans = [s for s in spans if _span_request_id(s) == rid]
+        if "limit" in q:
+            try:
+                limit = max(0, int(q["limit"]))
+            except ValueError:
+                h._send_json({"error": f"bad limit {q['limit']!r}"}, 400)
+                return
+            spans = spans[-limit:] if limit else []
+        if _query_flag(q, "chrome"):
+            payload = {"traceEvents": spans_to_events(spans),
+                       "displayTimeUnit": "ms"}
+            h._send(json.dumps(payload, default=str).encode(),
+                    "application/json",
+                    extra={"Content-Disposition":
+                           'attachment; filename="trace.json"'})
+            return
+        h._send_json({
+            "enabled": self._tracer.enabled,
+            "count": len(spans),
+            "dropped": self._tracer.dropped,
+            "request_id": rid,
+            "spans": [s._asdict() for s in spans],
+        })
+
+    def _stacksz(self, h: _Handler, q: Dict[str, str]) -> None:
+        h._send(_watchdog.format_all_stacks().encode(),
+                "text/plain; charset=utf-8")
+
+
+# ---------------------------------------------------------------------------
+# process-wide instance
+# ---------------------------------------------------------------------------
+
+_SERVER: Optional[DebugServer] = None
+_SERVER_LOCK = threading.Lock()
+_SERVER_REFS = 0
+_SERVER_GEN = 0          # bumped per server instance; stale-release guard
+_OPERATOR_REF = False    # start_debug_server's standing ref, at most one
+
+
+def _ensure_locked(port: int, host: str) -> DebugServer:
+    """Start-or-return under _SERVER_LOCK; raises if a DIFFERENT fixed
+    port than the already-bound one was requested."""
+    global _SERVER, _SERVER_GEN
+    if _SERVER is not None:
+        if port not in (0, _SERVER.port):
+            raise RuntimeError(
+                f"debug server already bound to port {_SERVER.port}; "
+                f"cannot rebind to {port}")
+        return _SERVER
+    _SERVER = DebugServer(port=port, host=host)
+    _SERVER_GEN += 1
+    return _SERVER
+
+
+def start_debug_server(port: int = 0, host: str = "127.0.0.1") -> int:
+    """Start (or join) the process-wide debug server; returns the bound
+    port (pass port=0 for an ephemeral one). Idempotent while running —
+    a second call returns the existing port (and raises if it asked for
+    a DIFFERENT fixed port than the one already bound). A server the
+    operator touched this way holds a standing reference that engine
+    teardowns never release: it stays up until stop_debug_server(),
+    even if it was originally started by create_engine(debug_port=)."""
+    global _SERVER_REFS, _OPERATOR_REF
+    with _SERVER_LOCK:
+        server = _ensure_locked(port, host)
+        if not _OPERATOR_REF:
+            _OPERATOR_REF = True
+            _SERVER_REFS += 1
+        return server.port
+
+
+def acquire_debug_server(port: int = 0,
+                         host: str = "127.0.0.1") -> "tuple[int, int]":
+    """Start-or-join the process-wide server and take a reference
+    (atomic); returns (bound port, release token). Pair every acquire
+    with one release_debug_server(token): the server stops when the
+    LAST reference is released, so rolling engine replacement
+    (create_engine(debug_port=...) while an older engine still serves)
+    can't tear diagnostics down under a live engine."""
+    global _SERVER_REFS
+    with _SERVER_LOCK:
+        server = _ensure_locked(port, host)
+        _SERVER_REFS += 1
+        return server.port, _SERVER_GEN
+
+
+def release_debug_server(token: Optional[int] = None) -> None:
+    """Drop one acquire_debug_server() reference; stops the server when
+    none remain. A token from a PREVIOUS server generation (the holder's
+    server was force-stopped and a new one started since) is ignored —
+    a stale release must not steal the new server's references."""
+    global _SERVER, _SERVER_REFS
+    with _SERVER_LOCK:
+        if _SERVER is None:
+            return
+        if token is not None and token != _SERVER_GEN:
+            return
+        _SERVER_REFS = max(0, _SERVER_REFS - 1)
+        if _SERVER_REFS == 0:
+            _SERVER.stop()
+            _SERVER = None
+
+
+def get_debug_server() -> Optional[DebugServer]:
+    return _SERVER
+
+
+def stop_debug_server() -> None:
+    """Force-stop regardless of outstanding references (operator/test
+    teardown path)."""
+    global _SERVER, _SERVER_REFS, _OPERATOR_REF
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None
+        _SERVER_REFS = 0
+        _OPERATOR_REF = False

@@ -369,18 +369,37 @@ def _prom_name(name: str) -> str:
     return name
 
 
+def _prom_label_name(name: str) -> str:
+    # label names are [a-zA-Z_][a-zA-Z0-9_]* — unlike metric names, colons
+    # are NOT allowed (they're reserved for recording rules)
+    name = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if not re.match(r"[a-zA-Z_]", name):
+        name = "_" + name
+    return name
+
+
 def _prom_escape(text: str) -> str:
+    """HELP-text escaping per the exposition format 0.0.4: backslash and
+    line feed (a raw newline would split the comment into a bogus sample
+    line)."""
     return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _prom_label_value(value: Any) -> str:
+    """Label-value escaping per the exposition format 0.0.4: backslash,
+    double-quote, and line feed — in that order (escaping the backslash
+    last would re-mangle the escapes just written). Raw interpolation of
+    any of the three corrupts the scrape: a quote terminates the value
+    early, a newline splits the sample line."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
 
 
 def _prom_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    parts = []
-    for k in sorted(labels):
-        v = str(labels[k]).replace("\\", r"\\").replace('"', r"\"") \
-            .replace("\n", r"\n")
-        parts.append(f'{_prom_name(k)}="{v}"')
+    parts = [f'{_prom_label_name(k)}="{_prom_label_value(labels[k])}"'
+             for k in sorted(labels)]
     return "{" + ",".join(parts) + "}"
 
 

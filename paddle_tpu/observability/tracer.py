@@ -36,7 +36,8 @@ from typing import Any, Dict, List, NamedTuple, Optional
 from collections import deque
 
 __all__ = ["Span", "Tracer", "get_tracer", "trace_span", "enable_tracing",
-           "disable_tracing", "tracing_enabled"]
+           "disable_tracing", "tracing_enabled", "request_scope",
+           "current_request_id"]
 
 
 class Span(NamedTuple):
@@ -65,6 +66,59 @@ class _NullSpan:
 
 
 _NULL_SPAN = _NullSpan()
+
+# Ambient request id, per thread. Spans recorded while a request scope is
+# active pick up a "request_id" arg automatically (unless the caller passed
+# one explicitly), so every layer under ServingEngine.submit/step — the
+# scheduler's prefill/decode dispatches, executor runs issued on behalf of
+# a request, streamed-token callbacks — lands on the same /tracez timeline
+# without threading an id argument through every signature.
+_REQ_LOCAL = threading.local()
+
+
+def current_request_id() -> Optional[str]:
+    """The thread's ambient request id (None outside a request_scope)."""
+    return getattr(_REQ_LOCAL, "rid", None)
+
+
+class _RequestScope:
+    """Sets the thread's ambient request id for the body; restores the
+    previous id on exit (scopes nest: a sub-request shadows its parent)."""
+
+    __slots__ = ("_rid", "_prev")
+
+    def __init__(self, rid: str):
+        self._rid = rid
+
+    def __enter__(self):
+        self._prev = getattr(_REQ_LOCAL, "rid", None)
+        _REQ_LOCAL.rid = self._rid
+        return self
+
+    def __exit__(self, *exc):
+        _REQ_LOCAL.rid = self._prev
+        return False
+
+
+def request_scope(request_id: str):
+    """`with request_scope(rid): ...` — tag every span recorded in the
+    body (this thread) with the request id. When the global tracer is
+    disabled this returns the shared no-op span: no allocation on the
+    production hot path."""
+    if not _GLOBAL._enabled:
+        return _NULL_SPAN
+    return _RequestScope(str(request_id))
+
+
+def _attach_request_id(args: Optional[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Merge the ambient request id into span args (explicit id wins)."""
+    rid = getattr(_REQ_LOCAL, "rid", None)
+    if rid is None or (args is not None and "request_id" in args):
+        return args
+    merged = dict(args) if args else {}
+    merged["request_id"] = rid
+    return merged
 
 
 class _LiveSpan:
@@ -102,7 +156,8 @@ class _LiveSpan:
             tr._record(Span(self.name, self.cat,
                             (self._begin_ns - tr._epoch_ns) / 1e3,
                             (end_ns - self._begin_ns) / 1e3,
-                            t.ident, t.name, self._depth, self.args))
+                            t.ident, t.name, self._depth,
+                            _attach_request_id(self.args)))
         return False
 
 
@@ -163,7 +218,23 @@ class Tracer:
         t = threading.current_thread()
         self._record(Span(name, cat,
                           (time.monotonic_ns() - self._epoch_ns) / 1e3,
-                          0.0, t.ident, t.name, len(self._stack()), args))
+                          0.0, t.ident, t.name, len(self._stack()),
+                          _attach_request_id(args)))
+
+    def record_complete(self, name: str, begin_ns: int, end_ns: int,
+                        cat: str = "",
+                        args: Optional[Dict[str, Any]] = None) -> None:
+        """Record an externally-timed span (monotonic_ns endpoints). The
+        retroactive path: the serving engine stamps submit time and only
+        materializes the queue-wait span at admission, and the scheduler
+        fans one batched decode dispatch out into per-request
+        decode-iteration spans after the fact."""
+        if not self._enabled:
+            return
+        t = threading.current_thread()
+        self._record(Span(name, cat, (begin_ns - self._epoch_ns) / 1e3,
+                          (end_ns - begin_ns) / 1e3, t.ident, t.name, 0,
+                          _attach_request_id(args)))
 
     # -- inspection ----------------------------------------------------------
 

@@ -25,6 +25,7 @@ import contextlib
 import os
 
 from .observability import export as _obs_export
+from .observability import metrics as _obs_metrics
 from .observability import tracer as _obs_tracer
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
@@ -58,8 +59,10 @@ def stop_profiler(sorted_key=None, profile_path=None):
     exception-safe when the body already stopped the trace itself.
 
     Also exports the host spans recorded since start_profiler as
-    `<dir>/host_spans.json` (chrome-trace JSON) and restores the tracer
-    to its pre-start enabled/disabled state."""
+    `<dir>/host_spans.json` (chrome-trace JSON) plus a metrics-registry
+    snapshot as `<dir>/metrics.json` (the same numbers the debug
+    server's /varz serves, frozen at trace stop), and restores the
+    tracer to its pre-start enabled/disabled state."""
     global _active_dir
     if _active_dir is None:
         return None
@@ -78,6 +81,8 @@ def stop_profiler(sorted_key=None, profile_path=None):
         return None
     try:
         _obs_export.export_chrome_trace(os.path.join(d, "host_spans.json"))
+        with open(os.path.join(d, "metrics.json"), "w") as f:
+            f.write(_obs_metrics.get_registry().to_json(indent=2))
     except OSError:
         pass  # trace dir vanished (reset_profiler mid-flight): device
         # trace already stopped cleanly, host spans stay in the ring

@@ -20,16 +20,20 @@ feed a driver loop.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..observability.tracer import trace_span
+from ..observability import watchdog as _watchdog
+from ..observability.tracer import get_tracer, request_scope, trace_span
 from .kv_cache import ShapeBuckets, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
 from .scheduler import ContinuousBatchingScheduler
+
+_TRACER = get_tracer()
 
 __all__ = ["ServingConfig", "ServingEngine", "GenerationRequest",
            "EngineOverloadError"]
@@ -64,13 +68,16 @@ class ServingConfig:
 class GenerationRequest:
     """One generate call in flight. `tokens` accumulates the generated
     ids (prompt excluded); `output()` is prompt + generated. state is
-    one of queued / running / finished / cancelled / shed."""
+    one of queued / running / finished / cancelled / shed. `request_id`
+    is the engine-minted trace id (`<engine_label>-<n>`) every span this
+    request produces carries — `/tracez?request_id=` keys on it."""
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, seed: int, eos_id: Optional[int],
                  on_token: Optional[Callable[["GenerationRequest", int],
                                              Any]],
-                 clock: Callable[[], float]):
+                 clock: Callable[[], float],
+                 request_id: Optional[str] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -80,6 +87,8 @@ class GenerationRequest:
         self.tokens: List[int] = []
         self.state = "queued"
         self.metrics = RequestMetrics(clock)
+        self.request_id = request_id
+        self._submit_ns: Optional[int] = None  # tracer queue-wait anchor
 
     @property
     def finished(self) -> bool:
@@ -135,6 +144,11 @@ class ServingEngine:
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
         self._lock = threading.Lock()
+        self._rid_counter = itertools.count()
+        self.debug_port: Optional[int] = None   # set by create_engine
+        # debug-server release token from acquire_debug_server (None =
+        # this engine holds no reference); set by create_engine
+        self._debug_server_ref: Optional[int] = None
 
     # -- admission ----------------------------------------------------------
 
@@ -160,20 +174,30 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the pool's max_len "
                 f"({self.kv.max_len})")
-        req = GenerationRequest(prompt, max_new_tokens, temperature, seed,
-                                eos_id, on_token, self.config.clock)
+        req = GenerationRequest(
+            prompt, max_new_tokens, temperature, seed, eos_id, on_token,
+            self.config.clock,
+            request_id=f"{self.metrics.engine_label}-"
+                       f"{next(self._rid_counter)}")
+        if _TRACER.enabled:  # queue-wait anchor; no clock read when off
+            req._submit_ns = time.monotonic_ns()
         with self._lock:
             self.metrics.submitted += 1
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.shed += 1
                 req.state = "shed"
-                raise EngineOverloadError(
-                    f"admission queue full ({self.config.max_queue}); "
-                    "request shed")
-            req.metrics.mark_submitted()
-            self._queue.append(req)
-            self.metrics.queue_depth = len(self._queue)
-        return req
+            else:
+                req.metrics.mark_submitted()
+                self._queue.append(req)
+                self.metrics.queue_depth = len(self._queue)
+                return req
+        # shed path, OUTSIDE the lock: the overload hook may write a
+        # flight record (no-op unless a watchdog with dump_on_overload is
+        # installed) and must not stall concurrent submits/steps
+        _watchdog.notify_overload(self.metrics.engine_label)
+        raise EngineOverloadError(
+            f"admission queue full ({self.config.max_queue}); "
+            "request shed")
 
     # -- drive loop ---------------------------------------------------------
 
@@ -191,7 +215,17 @@ class ServingEngine:
             req.metrics.mark_finished()
             self.metrics.record(req.metrics)
         if req.on_token is not None:
-            req.on_token(req, event.token)
+            if _TRACER.enabled:
+                # streamed-token callback on the request's trace timeline
+                # (args built only here — the disabled path allocates
+                # nothing and calls the callback directly)
+                with _TRACER.span("serving/on_token", "serving",
+                                  {"request_id": req.request_id,
+                                   "token": event.token,
+                                   "finished": event.finished}):
+                    req.on_token(req, event.token)
+            else:
+                req.on_token(req, event.token)
 
     def step(self) -> int:
         """Admit waiting requests into free slots, then run ONE batched
@@ -226,12 +260,23 @@ class ServingEngine:
             req.metrics.mark_admitted()
             self.metrics.admitted += 1
             self.metrics.prefills += 1
-            event = self.scheduler.admit(
-                req, req.prompt, req.max_new_tokens,
-                temperature=req.temperature, seed=req.seed,
-                eos_id=req.eos_id)
-            assert event is not None  # pop count was bounded by free slots
-            self._emit(event)
+            if _TRACER.enabled and req._submit_ns is not None:
+                # the queue-wait interval only materializes as a span at
+                # admission (submit -> slot), retroactively timed
+                _TRACER.record_complete(
+                    "serving/queue_wait", req._submit_ns,
+                    time.monotonic_ns(), "serving",
+                    {"request_id": req.request_id})
+            # ambient request scope: the prefill RecordEvent below (and
+            # any executor/compile spans it triggers) inherit the id;
+            # request_scope is the shared no-op when tracing is off
+            with request_scope(req.request_id):
+                event = self.scheduler.admit(
+                    req, req.prompt, req.max_new_tokens,
+                    temperature=req.temperature, seed=req.seed,
+                    eos_id=req.eos_id)
+                assert event is not None  # pop bounded by free slots
+                self._emit(event)
             emitted += 1
         events = self.scheduler.step()
         if events:
@@ -290,8 +335,16 @@ class ServingEngine:
         """Retire the engine: remove its labeled series from the global
         metrics registry so scrapes stop reporting a dead engine (a
         long-lived service recreating engines must not accumulate dead
-        labels). stats()/metrics keep working locally afterwards."""
+        labels), and release this engine's debug-server reference
+        (inference.create_engine(debug_port=...)) — the shared server
+        stops only when the last referencing engine closes, so rolling
+        replacement never kills diagnostics under a live engine.
+        stats()/metrics keep working locally afterwards."""
         self.metrics.unregister()
+        if self._debug_server_ref is not None:
+            from ..observability.debug_server import release_debug_server
+            token, self._debug_server_ref = self._debug_server_ref, None
+            release_debug_server(token)
 
     def stats(self) -> Dict[str, Any]:
         s = self.metrics.snapshot()

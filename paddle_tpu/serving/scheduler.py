@@ -31,12 +31,16 @@ gpt_generate's single chain.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from .. import profiler
+from ..observability.tracer import get_tracer
 from .kv_cache import ShapeBuckets, SlotKVCache
+
+_TRACER = get_tracer()
 
 __all__ = ["ContinuousBatchingScheduler", "SequenceEvent"]
 
@@ -173,7 +177,9 @@ class ContinuousBatchingScheduler:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p_len] = prompt[0]
         with profiler.RecordEvent("serving/prefill", bucket=bucket,
-                                  prompt_len=p_len, slot=slot):
+                                  prompt_len=p_len, slot=slot,
+                                  request_id=getattr(req, "request_id",
+                                                     None)):
             logits, pool = self._prefill_jit(
                 self.params, self.kv.kv, padded,
                 np.asarray([p_len], np.int32), np.int32(slot))
@@ -210,12 +216,19 @@ class ContinuousBatchingScheduler:
             tokens[slot] = st.last_token
             ts[slot] = st.pos
             temps[slot] = st.temperature
+        # request-id fan-out: ONE batched dispatch serves many requests,
+        # so the step span can't carry a single id — instead each active
+        # slot gets a retroactive per-request "serving/decode_iter" span
+        # over the dispatch window (tracing on only; the disabled path
+        # reads no clock and allocates nothing)
+        begin_ns = time.monotonic_ns() if _TRACER.enabled else 0
         with profiler.RecordEvent("serving/decode_step",
                                   active=len(self._running), slots=s_dim):
             nxt, pool, self._keys = self._step_jit(
                 self.params, self.kv.kv, tokens, ts, self._keys, temps)
         self.kv.kv = pool
         nxt = np.asarray(nxt)
+        end_ns = time.monotonic_ns() if _TRACER.enabled else 0
         events: List[SequenceEvent] = []
         for slot in sorted(self._running):
             st = self._running[slot]
@@ -229,6 +242,12 @@ class ContinuousBatchingScheduler:
             if finished:
                 del self._running[slot]
                 self.kv.free(slot)
+            if begin_ns:
+                _TRACER.record_complete(
+                    "serving/decode_iter", begin_ns, end_ns, "serving",
+                    {"request_id": getattr(st.req, "request_id", None),
+                     "slot": slot, "pos": st.pos, "token": tok,
+                     "finished": finished})
             events.append(SequenceEvent(st.req, tok, finished))
         return events
 

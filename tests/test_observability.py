@@ -73,6 +73,34 @@ def test_ring_buffer_caps_memory_and_counts_drops():
     assert [s.name for s in t.snapshot()] == ["s6", "s7", "s8", "s9"]
 
 
+def test_ring_buffer_wraparound_multiple_times():
+    """Satellite pin: fill the ring far past capacity — the drop count
+    tracks every evicted span exactly, the snapshot is always the newest
+    `capacity` spans in completion order, and clear() resets both."""
+    cap = 8
+    t = obs.Tracer(capacity=cap)
+    t.enable()
+    for i in range(3 * cap + 5):                 # wraps 3+ times
+        with t.span(f"w{i}"):
+            pass
+        assert t.span_count == min(i + 1, cap)
+        assert t.dropped == max(0, i + 1 - cap)
+    total = 3 * cap + 5
+    names = [s.name for s in t.snapshot()]
+    assert names == [f"w{i}" for i in range(total - cap, total)]
+    assert t.dropped == total - cap
+    # instants ride the same ring
+    t.instant("marker")
+    assert [s.name for s in t.snapshot()][-1] == "marker"
+    assert t.dropped == total - cap + 1
+    t.clear()
+    assert t.span_count == 0 and t.dropped == 0
+    with t.span("fresh"):
+        pass
+    assert [s.name for s in t.snapshot()] == ["fresh"]
+    assert t.dropped == 0
+
+
 def test_per_thread_tracks():
     obs.enable_tracing()
     def work():
@@ -341,6 +369,41 @@ def test_prometheus_text_export_parses():
     assert 'c_seconds_bucket{le="0.5"} 1' in text
     assert 'c_seconds_bucket{le="+Inf"} 1' in text
     assert "c_seconds_count 1" in text
+
+
+def test_prometheus_label_value_escaping():
+    """Satellite pin: backslash, double-quote, and newline in label
+    values must be escaped per the exposition format 0.0.4 — raw
+    interpolation lets a quote terminate the value early and a newline
+    split the sample into two bogus lines."""
+    reg = obs.MetricsRegistry()
+    reg.counter("esc_total").labels(
+        path='C:\\tmp\\"quoted"\nnext').inc(2)
+    text = reg.to_prometheus()
+    line = next(l for l in text.split("\n") if l.startswith("esc_total{"))
+    # exactly the escaped form: \\ for backslash, \" for quote, \n for LF
+    assert line == ('esc_total{path="C:\\\\tmp\\\\\\"quoted\\"\\nnext"} 2')
+    # one sample per series: the newline did NOT split the line
+    assert sum(1 for l in text.split("\n")
+               if l.startswith("esc_total")
+               and not l.startswith("#")) == 1
+    # HELP text escapes backslash + newline too
+    reg2 = obs.MetricsRegistry()
+    reg2.gauge("g", help="multi\nline \\ help").set(1)
+    help_line = next(l for l in reg2.to_prometheus().split("\n")
+                     if l.startswith("# HELP"))
+    assert help_line == "# HELP g multi\\nline \\\\ help"
+
+
+def test_prometheus_label_names_sanitized():
+    """Label names allow [a-zA-Z0-9_] only — colons are reserved for
+    metric names (recording rules), and arbitrary chars must not leak
+    into the exposition."""
+    reg = obs.MetricsRegistry()
+    reg.counter("n_total").labels(**{"a:b": "x", "0bad-key": "y"}).inc()
+    text = reg.to_prometheus()
+    line = next(l for l in text.split("\n") if l.startswith("n_total{"))
+    assert line == 'n_total{_0bad_key="y",a_b="x"} 1'
 
 
 # ---------------------------------------------------------------------------

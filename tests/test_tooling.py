@@ -128,6 +128,33 @@ def test_bench_serving_row_shape():
         for k in ("p50_ttft_ms", "p99_ttft_ms", "p50_tpot_ms",
                   "p99_tpot_ms"):
             assert row["extra"][k] is not None and row["extra"][k] > 0, row
+        # measured tracer overhead rides along (diagnostics PR): the
+        # traced re-run really ran (throughput > 0) and the delta is a
+        # finite percentage
+        assert row["extra"]["tokens_per_s_traced"] > 0
+        assert isinstance(row["extra"]["trace_overhead_pct"], float)
+    # the traced re-run restored the disabled production default
+    import paddle_tpu.observability as obs
+    assert not obs.tracing_enabled()
+
+
+def test_bench_serving_debug_port_flag(capsys, monkeypatch):
+    """--debug-port serves the diagnostics plane for the bench run and
+    tears it down afterwards."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    import paddle_tpu.observability as obs
+
+    gpt_kwargs, _, prompt_lens, buckets = bench_serving.MODELS["tiny"]
+    monkeypatch.setitem(bench_serving.MODELS, "tiny",
+                        (gpt_kwargs, [1], prompt_lens, buckets))
+    monkeypatch.setenv("BENCH_SERVING_REQUESTS", "2")
+    bench_serving.main(["tiny", "--debug-port", "0"])
+    out = capsys.readouterr()
+    assert "debug server: http://127.0.0.1:" in out.err
+    rows = [json.loads(line) for line in out.out.strip().splitlines()]
+    assert rows and all("trace_overhead_pct" in r["extra"] for r in rows)
+    assert obs.get_debug_server() is None    # stopped on exit
 
 
 def test_trace_summary_cli_smoke():
@@ -156,6 +183,72 @@ def test_trace_summary_cli_smoke():
     assert r.returncode == 0, r.stderr
     rows = json.loads(r.stdout)
     assert {row["name"] for row in rows} == {"alpha", "beta"}
+
+
+def test_trace_summary_cli_absent_and_empty_files(tmp_path):
+    """Satellite: a missing, empty, or non-JSON trace exits with a
+    helpful message (status 2), never a traceback."""
+    cli = os.path.join(REPO, "tools/trace_summary.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(path):
+        return subprocess.run([sys.executable, cli, path],
+                              capture_output=True, text=True, timeout=120,
+                              env=env)
+
+    r = run(str(tmp_path / "nope.json"))
+    assert r.returncode == 2
+    assert "cannot read" in r.stderr and "Traceback" not in r.stderr
+
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    r = run(str(empty))
+    assert r.returncode == 2
+    assert "is empty" in r.stderr and "enable_tracing" in r.stderr
+    assert "Traceback" not in r.stderr
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    r = run(str(bad))
+    assert r.returncode == 2
+    assert "not chrome-trace JSON" in r.stderr
+    assert "Traceback" not in r.stderr
+
+    # a valid trace with zero complete events still exits 0
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"traceEvents": []}))
+    r = run(str(ok))
+    assert r.returncode == 0
+    assert "no complete" in r.stdout
+    # --json on the same file prints a parseable empty array
+    r = subprocess.run([sys.executable, cli, str(ok), "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0 and json.loads(r.stdout) == []
+
+
+def test_api_freeze_spec_is_current():
+    """Satellite: the API-freeze check runs inside the suite — the live
+    public surface (including this PR's observability additions) must
+    match tools/API.spec signature for signature. In-process (no
+    subprocess) so the diff shows up directly in the failure."""
+    import importlib
+    import tools.print_signatures as ps
+    importlib.reload(ps)      # sys.path games by other tests: stay fresh
+
+    current = sorted(ps.iter_api())
+    spec = os.path.join(REPO, "tools", "API.spec")
+    with open(spec) as f:
+        frozen = sorted(line.rstrip("\n") for line in f if line.strip())
+    added = sorted(set(current) - set(frozen))
+    removed = sorted(set(frozen) - set(current))
+    assert current == frozen, (
+        "public API drifted from tools/API.spec — regenerate deliberately "
+        "with `python tools/print_signatures.py > tools/API.spec`.\n"
+        f"added: {added[:20]}\nremoved: {removed[:20]}")
+    # the diagnostics surface is part of the frozen API
+    assert any("start_debug_server" in line for line in frozen)
+    assert any("dump_flight_record" in line for line in frozen)
 
 
 if __name__ == "__main__":

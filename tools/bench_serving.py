@@ -11,8 +11,16 @@ request-level latency cuts from serving.metrics. Usage:
     BENCH_SERVING_REQUESTS=32 python tools/bench_serving.py gpt2
 
 Prints one JSON line per (model, concurrency), bench_inference style.
+`--debug-port N` additionally serves the live diagnostics plane
+(/metrics, /tracez, ...) for the duration of the bench (0 = ephemeral,
+the bound port is printed to stderr). Each row also reports the
+measured tracing overhead: the same request mix is re-run with the span
+tracer enabled and the throughput delta lands in
+`extra.trace_overhead_pct` (disabled is the production default, so this
+is the cost of flipping tracing ON).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -85,6 +93,19 @@ def run_model(name, concurrencies=None, requests_per_level=None,
         s = eng.stats()
         tokens = sum(len(r.tokens) for r in reqs)
         quantiles = _registry_quantiles(s["engine_label"])
+        # disabled-path overhead: same mix again with the tracer ON
+        # (executables already warm in both passes, so the delta is the
+        # span-recording cost, not compiles)
+        from paddle_tpu import observability as obs
+        was_enabled = obs.tracing_enabled()
+        obs.enable_tracing()
+        t0 = time.perf_counter()
+        treqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.run_until_drained()
+        dt_traced = time.perf_counter() - t0
+        if not was_enabled:
+            obs.disable_tracing()
+        tokens_traced = sum(len(r.tokens) for r in treqs)
         rows.append({
             "metric": f"{name}_serving_c{cc}",
             "value": round(tokens / dt, 2),
@@ -99,6 +120,9 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                 "mean_queue_wait_ms": round(s["mean_queue_wait"] * 1e3, 2),
                 "decode_steps": s["decode_steps"],
                 "compiled_executables": s["compiled_executables"],
+                "tokens_per_s_traced": round(tokens_traced / dt_traced, 2),
+                "trace_overhead_pct": round(
+                    (dt_traced - dt) / dt * 100.0, 2),
                 **quantiles,
             },
         })
@@ -126,11 +150,33 @@ def _registry_quantiles(engine_label):
     return out
 
 
-def main():
-    models = sys.argv[1:] or ["tiny", "gpt2"]
-    for name in models:
-        for row in run_model(name):
-            print(json.dumps(row), flush=True)
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("models", nargs="*",
+                    help=f"models to bench (default: all of "
+                         f"{', '.join(MODELS)})")
+    ap.add_argument("--debug-port", type=int, default=None, metavar="PORT",
+                    help="serve the live diagnostics plane on PORT for "
+                         "the duration of the bench (0 = ephemeral)")
+    args = ap.parse_args(argv)
+    unknown = [m for m in args.models if m not in MODELS]
+    if unknown:
+        ap.error(f"unknown model(s) {unknown}; choose from {list(MODELS)}")
+
+    server_started = False
+    if args.debug_port is not None:
+        from paddle_tpu.observability import (start_debug_server,
+                                              stop_debug_server)
+        port = start_debug_server(port=args.debug_port)
+        server_started = True
+        print(f"debug server: http://127.0.0.1:{port}", file=sys.stderr)
+    try:
+        for name in args.models or list(MODELS):
+            for row in run_model(name):
+                print(json.dumps(row), flush=True)
+    finally:
+        if server_started:
+            stop_debug_server()
 
 
 if __name__ == "__main__":

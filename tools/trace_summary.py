@@ -23,14 +23,41 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
+class TraceError(Exception):
+    """Unreadable/unparsable trace input (reported, never a traceback)."""
+
+
 def load_events(path: str):
     """Chrome trace JSON: the object form {"traceEvents": [...]} or the
-    bare event-array form."""
-    with open(path) as f:
-        data = json.load(f)
+    bare event-array form. Raises TraceError (with a remediation hint)
+    for a missing, empty, or non-JSON file — an operator pointing the
+    CLI at the wrong path gets a message, not a stack trace."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        raise TraceError(f"cannot read {path!r}: {e.strerror or e}")
+    if not raw.strip():
+        raise TraceError(
+            f"{path!r} is empty — no trace was written there. Enable "
+            "tracing before the traced run (observability."
+            "enable_tracing()) and export with export_chrome_trace().")
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise TraceError(
+            f"{path!r} is not chrome-trace JSON (parse error at line "
+            f"{e.lineno}: {e.msg}). Expected the catapult object form "
+            '{"traceEvents": [...]} or a bare event array.')
     if isinstance(data, dict):
-        return data.get("traceEvents", [])
-    return data
+        events = data.get("traceEvents", [])
+    else:
+        events = data
+    if not isinstance(events, list):
+        raise TraceError(
+            f"{path!r}: \"traceEvents\" is {type(events).__name__}, "
+            "expected a list of trace events")
+    return events
 
 
 def summarize_file(path: str, top=None):
@@ -47,7 +74,11 @@ def main(argv=None):
                     help="print rows as one JSON array instead of a table")
     args = ap.parse_args(argv)
 
-    rows = summarize_file(args.trace, top=args.top)
+    try:
+        rows = summarize_file(args.trace, top=args.top)
+    except TraceError as e:
+        print(f"trace_summary: {e}", file=sys.stderr)
+        return 2
     if args.json:
         print(json.dumps(rows, indent=2))
         return 0
