@@ -12,6 +12,32 @@ __all__ = ["ErrorClipByValue", "GradientClipByValue",
            "GradientClipByNorm", "GradientClipByGlobalNorm"]
 
 
+def append_global_norm_ops(block, params_grads, attrs=None, name="global"):
+    """Append the in-graph global-norm reduction over `params_grads`
+    (per-grad squared_l2_norm -> sum -> sqrt); returns the norm
+    Variable. Shared by GradientClipByGlobalNorm and the training
+    telemetry tap (observability/train_stats.py) so the clip norm and
+    the surfaced telemetry norm cannot diverge."""
+    attrs = dict(attrs or {})
+    sq_names = []
+    for _, g in params_grads:
+        sq = block.create_var(name=unique_name(g.name + "@SQNORM"),
+                              shape=(1,), dtype="float32")
+        block.append_op("squared_l2_norm", {"X": [g.name]},
+                        {"Out": [sq.name]}, dict(attrs),
+                        infer_shape=False)
+        sq_names.append(sq.name)
+    total = block.create_var(name=unique_name(f"{name}_sqnorm"),
+                             shape=(1,), dtype="float32")
+    block.append_op("sum", {"X": sq_names}, {"Out": [total.name]},
+                    dict(attrs), infer_shape=False)
+    gnorm = block.create_var(name=unique_name(f"{name}_norm"), shape=(1,),
+                             dtype="float32")
+    block.append_op("sqrt", {"X": [total.name]}, {"Out": [gnorm.name]},
+                    dict(attrs), infer_shape=False)
+    return gnorm
+
+
 class GradientClipByValue:
     def __init__(self, max, min=None):
         self.max = float(max)
@@ -54,21 +80,12 @@ class GradientClipByGlobalNorm:
         if not params_grads:
             return params_grads
         blk = params_grads[0][1].block
-        sq_names = []
-        for _, g in params_grads:
-            sq = blk.create_var(name=unique_name(g.name + "@SQNORM"),
-                                shape=(1,), dtype="float32")
-            blk.append_op("squared_l2_norm", {"X": [g.name]},
-                          {"Out": [sq.name]}, infer_shape=False)
-            sq_names.append(sq.name)
-        total = blk.create_var(name=unique_name("global_sqnorm"), shape=(1,),
-                               dtype="float32")
-        blk.append_op("sum", {"X": sq_names}, {"Out": [total.name]},
-                      infer_shape=False)
-        gnorm = blk.create_var(name=unique_name("global_norm"), shape=(1,),
-                               dtype="float32")
-        blk.append_op("sqrt", {"X": [total.name]}, {"Out": [gnorm.name]},
-                      infer_shape=False)
+        gnorm = append_global_norm_ops(blk, params_grads)
+        # Surface the already-computed norm instead of dropping it: the
+        # training-telemetry tap (observability/train_stats.py) fetches
+        # it per step, and callers can fetch_list it directly.
+        self.last_global_norm_name = gnorm.name
+        blk.program._global_norm_var = gnorm.name
         # scale = clip_norm / max(gnorm, clip_norm)
         maxed = blk.create_var(name=unique_name("global_norm_max"),
                                shape=(1,), dtype="float32")

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -28,6 +29,7 @@ from .core import Program, Variable, default_main_program
 from .registry import LowerContext, lower_op, get_op_def
 from ..observability.metrics import get_registry
 from ..observability.tracer import trace_span, tracing_enabled
+from ..observability import train_stats as _train_stats
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard",
            "as_jax_function"]
@@ -201,15 +203,25 @@ class Executor:
         reader/bucketing.py so a ragged stream converges to <= #buckets
         entries instead of churning the cache."""
         import os as _os
-        from collections import OrderedDict
+        from collections import OrderedDict, deque
         self.place = place
         self._donate = donate
         self._cache: "OrderedDict[Any, Any]" = OrderedDict()
         self._classify_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        self._compile_stats: Dict[Any, Dict[str, Any]] = {}
         self._cache_capacity = int(
             cache_capacity if cache_capacity is not None
             else _os.environ.get("FLAGS_executor_cache_capacity", "64"))
         self.compile_count = 0  # distinct compilations (tests/telemetry)
+        self._compiled_uids = set()  # programs ever compiled, cache-
+        # residency-independent: a miss for a known uid whose entries
+        # were all LRU-evicted is a recompile (cause="evicted"), not a
+        # first compile — cache churn is exactly what the counter is for
+        # structured "why" records for misses after a program's first
+        # compile (recompilation attribution); also mirrored into the
+        # process-wide train_stats.recompile_log() for /trainz
+        self.recompile_log: "deque[Dict[str, Any]]" = deque(maxlen=64)
+        self.last_fetch_names: List[str] = []  # incl. telemetry extras
         _ensure_prng_default()
 
     def _memo(self, cache, key, build):
@@ -262,6 +274,7 @@ class Executor:
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
         from ..compiler import CompiledProgram  # lazy import
 
+        reg = get_registry()
         if program is None:
             program = default_main_program()
 
@@ -332,15 +345,34 @@ class Executor:
             return [np.asarray(scope.find_var(f)) if return_numpy
                     else scope.find_var(f) for f in fetch_names]
 
+        # Training telemetry (observability/train_stats.py): a program
+        # whose minimize() attached the tap carries the loss/grad-norm/
+        # sentinel-flag var names; while a StepLogger is installed those
+        # ride along in the SAME fetch tuple — one jitted computation,
+        # no extra device->host transfer. No logger => fetch list is
+        # exactly the user's (the no-op path; XLA dead-code-eliminates
+        # the unfetched telemetry ops).
+        tele = getattr(program, "_train_telemetry", None)
+        tele_logger = _train_stats.get_step_logger() if tele else None
+        all_fetch = list(fetch_names)
+        if tele_logger is not None:
+            seen = set(all_fetch)
+            for k in ("loss", "grad_norm", "flag", "lr"):
+                n = tele.get(k)
+                if n and n not in seen:
+                    all_fetch.append(n)
+                    seen.add(n)
+        self.last_fetch_names = list(all_fetch)
+
         # classify_persistables walks every op/var — ~6.5 ms of pure Python
         # at ResNet-50 scale, re-done identically every step (measured: the
         # bulk of the r3 "unexplained 4.6% framework overhead"). Same key
         # ingredients as the compile cache, so memoize alongside it.
         cls_key = (getattr(program, "_uid", id(program)), program.version,
-                   frozenset(feed), tuple(fetch_names))
+                   frozenset(feed), tuple(all_fetch))
         mutable, created, readonly = self._memo(
             self._classify_cache, cls_key,
-            lambda: classify_persistables(program, set(feed), fetch_names))
+            lambda: classify_persistables(program, set(feed), all_fetch))
 
         # ensure rng state
         if "@RNG@" not in scope:
@@ -356,18 +388,53 @@ class Executor:
         feed_sig = tuple(sorted((k,) + _sig(v) for k, v in feed.items()))
         cache_key = (getattr(program, "_uid", id(program)), program.version,
                      feed_sig,
-                     tuple(fetch_names), tuple(mutable), tuple(readonly),
+                     tuple(all_fetch), tuple(mutable), tuple(readonly),
                      id(dist_plan) if dist_plan else None)
-        def _do_compile():
+
+        # Compile-cache lookup with hit/miss/eviction counters and, on
+        # every miss after a program's first compile, recompilation
+        # attribution: which ingredient changed vs. the nearest cached
+        # key. Counters are always on (StepLogger or not) — families are
+        # re-fetched per run so a registry reset can't orphan them.
+        was_miss = False
+        compiled = self._cache.get(cache_key)
+        if compiled is not None:
+            self._cache.move_to_end(cache_key)
+            reg.counter("executor_cache_hits_total",
+                        "compile-cache hits").inc()
+        else:
+            was_miss = True
+            reg.counter("executor_cache_misses_total",
+                        "compile-cache misses (compilations)").inc()
+            cause, detail = self._attribute_recompile(cache_key)
+            if cause != "first_compile":
+                reg.counter(
+                    "executor_recompiles_total",
+                    "compile-cache misses after a program's first "
+                    "compile, by cause").labels(cause=cause).inc()
+                rec = {"ts": time.time(), "cause": cause, "detail": detail,
+                       "program": str(cache_key[0])[:8],
+                       "compile_index": self.compile_count + 1}
+                self.recompile_log.append(rec)
+                _train_stats.record_recompile(rec)
             feed_shapes = {k: _sig(v)[0] for k, v in feed.items()}
             self.compile_count += 1
             with trace_span("executor/compile", "executor",
                             {"ops": len(blk.ops),
-                             "fetches": len(fetch_names)}):
-                return self._compile(program, feed_shapes, fetch_names,
-                                     mutable, created, readonly, dist_plan)
-
-        compiled = self._memo(self._cache, cache_key, _do_compile)
+                             "fetches": len(all_fetch),
+                             "cause": cause}):
+                compiled = self._compile(program, feed_shapes, all_fetch,
+                                         mutable, created, readonly,
+                                         dist_plan)
+            self._cache[cache_key] = compiled
+            self._compiled_uids.add(cache_key[0])
+            while len(self._cache) > self._cache_capacity:
+                old_key, _ = self._cache.popitem(last=False)
+                self._compile_stats.pop(old_key, None)
+                reg.counter("executor_cache_evictions_total",
+                            "compile-cache LRU evictions").inc()
+        reg.gauge("executor_cache_size",
+                  "compiled executables cached").set(len(self._cache))
 
         mut_in = {}
         for n in mutable:
@@ -407,6 +474,15 @@ class Executor:
                 self.last_hlo = None
                 self.last_hlo_error = str(e)
 
+        if tele_logger is not None and was_miss:
+            # XLA cost/memory analysis for MFU + peak-per-compile
+            # accounting. AOT lower+compile (before the call — donation
+            # consumes mut_in buffers) — one extra compile per cache
+            # miss, only while a StepLogger is installed.
+            self._compile_stats[cache_key] = self._analyze_compile(
+                compiled, mut_in, ro_in, feed_in, key, reg)
+
+        t0 = time.perf_counter()
         new_mut, fetches, new_key, finite_flags = compiled(
             mut_in, ro_in, feed_in, key)
 
@@ -426,10 +502,148 @@ class Executor:
                         f"nan/inf detected in output {var!r} of op "
                         f"#{idx} ({op_type}) — FLAGS_check_nan_inf")
 
+        if tele_logger is not None:
+            fetches = self._log_step_telemetry(
+                tele, tele_logger, all_fetch, fetch_names, fetches,
+                feed_in, scope, cache_key, was_miss, t0, reg)
+
         if return_numpy:
             from .selected_rows import to_dense
             return [np.asarray(to_dense(f)) for f in fetches]
         return list(fetches)
+
+    # -- training telemetry (observability/train_stats.py) -------------------
+    def _analyze_compile(self, compiled, mut_in, ro_in, feed_in, key, reg):
+        """Flops + memory footprint of the executable just compiled, via
+        the AOT path; best-effort (None fields when the backend or a
+        dist_plan wrapper doesn't support analysis)."""
+        stats: Dict[str, Any] = {"flops": None, "temp_bytes": None,
+                                 "argument_bytes": None,
+                                 "output_bytes": None, "peak_bytes": None}
+        try:
+            aot = compiled.lower(mut_in, ro_in, feed_in, key).compile()
+            ca = aot.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float((ca or {}).get("flops", 0.0))
+            stats["flops"] = flops if flops > 0 else None
+            ma = aot.memory_analysis()
+            if ma is not None:
+                stats["temp_bytes"] = int(ma.temp_size_in_bytes)
+                stats["argument_bytes"] = int(ma.argument_size_in_bytes)
+                stats["output_bytes"] = int(ma.output_size_in_bytes)
+                # peak estimate: args live across the computation plus
+                # temps and outputs
+                stats["peak_bytes"] = (stats["temp_bytes"]
+                                       + stats["argument_bytes"]
+                                       + stats["output_bytes"])
+                reg.gauge("executor_compile_temp_bytes",
+                          "XLA temp allocation of the last "
+                          "compile").set(stats["temp_bytes"])
+                reg.gauge("executor_compile_peak_bytes",
+                          "estimated peak device bytes of the last "
+                          "compile").set(stats["peak_bytes"])
+        except Exception:
+            pass
+        return stats
+
+    def _log_step_telemetry(self, tele, logger, all_fetch, fetch_names,
+                            fetches, feed_in, scope, cache_key, was_miss,
+                            t0, reg):
+        """Convert the telemetry fetches (same output tuple as the user's)
+        into one StepLogger record; returns the user-visible fetch slice.
+        Reading the scalars blocks on the step — that sync IS the step
+        timing; no additional device round trip happens."""
+        by_name = dict(zip(all_fetch, fetches))
+
+        def _scalar(name):
+            if name is None or name not in by_name:
+                return None
+            try:
+                return float(np.asarray(by_name[name]).ravel()[0])
+            except (TypeError, ValueError, IndexError):
+                return None
+
+        loss = _scalar(tele.get("loss"))
+        gnorm = _scalar(tele.get("grad_norm"))
+        lr = _scalar(tele.get("lr"))
+        flag = by_name.get(tele.get("flag"))
+        finite = bool(np.asarray(flag).ravel()[0]) if flag is not None \
+            else True
+        step_time = time.perf_counter() - t0
+
+        # batch size = the largest leading dim across feeds (a (1,)
+        # scalar feed like an lr scale must not masquerade as the batch)
+        examples = tokens = None
+        dims = [int(v.shape[0]) for v in feed_in.values()
+                if getattr(v, "shape", None)]
+        if dims:
+            examples = max(dims)
+        # tokens = the LARGEST integer feed (the token ids), not the sum
+        # — an integer label/mask feed alongside must not double-count
+        int_sizes = [int(v.size) for v in feed_in.values()
+                     if np.issubdtype(np.dtype(str(v.dtype)), np.integer)]
+        if int_sizes:
+            tokens = max(int_sizes)
+
+        scope_bytes = 0
+        for n in scope.var_names():
+            v = scope.find_var(n)
+            nb = getattr(v, "nbytes", None)
+            if nb is None:
+                nb = getattr(getattr(v, "values", None), "nbytes", 0)
+            scope_bytes += int(nb or 0)
+        reg.gauge("executor_scope_live_bytes",
+                  "bytes held by scope device arrays").set(scope_bytes)
+
+        logger.log_step(
+            loss=loss, grad_norm=gnorm, lr=lr, finite=finite,
+            step_time_s=step_time, examples=examples, tokens=tokens,
+            compiled=was_miss,
+            compile_stats=self._compile_stats.get(cache_key),
+            scope_bytes=scope_bytes, program=str(cache_key[0])[:8])
+        return fetches[:len(fetch_names)]
+
+    def _attribute_recompile(self, key):
+        """Why did this compile-cache miss happen? Compare against the
+        nearest cached key (same program preferred) and name the first
+        differing ingredient. Returns (cause, detail)."""
+        uid, version, feed_sig, fetch, mutable, readonly, dist = key
+        same_prog = [k for k in self._cache if k[0] == uid]
+        if not same_prog:
+            if uid in self._compiled_uids:
+                return "evicted", {"cache_capacity": self._cache_capacity}
+            return "first_compile", {}
+
+        def _score(k):
+            return sum(a == b for a, b in zip(k, key))
+
+        near = max(same_prog, key=_score)
+        if near[1] != version:
+            return "program_version", {"from": near[1], "to": version}
+        if near[2] != feed_sig:
+            old = {n: (s, d) for n, s, d in near[2]}
+            new = {n: (s, d) for n, s, d in feed_sig}
+            for n in sorted(set(old) & set(new)):
+                if old[n][0] != new[n][0]:
+                    return "feed_shape", {"var": n,
+                                          "from": list(old[n][0]),
+                                          "to": list(new[n][0])}
+            for n in sorted(set(old) & set(new)):
+                if old[n][1] != new[n][1]:
+                    return "feed_dtype", {"var": n, "from": old[n][1],
+                                          "to": new[n][1]}
+            return "feed_set", {"added": sorted(set(new) - set(old)),
+                                "removed": sorted(set(old) - set(new))}
+        if near[3] != fetch:
+            return "fetch_list", {"added": sorted(set(fetch) - set(near[3])),
+                                  "removed": sorted(set(near[3])
+                                                    - set(fetch))}
+        if near[4] != mutable or near[5] != readonly:
+            return "scope_classification", {}
+        if near[6] != dist:
+            return "dist_plan", {}
+        return "unknown", {}
 
     def _run_ps(self, program, feed, fetch_list, scope, return_numpy, plan):
         from .selected_rows import to_dense
@@ -596,6 +810,7 @@ class Executor:
     # -- utilities -----------------------------------------------------------
     def close(self):
         self._cache.clear()
+        self._compile_stats.clear()
 
 
 def _slot_batch_to_array(var: Variable, vals: np.ndarray,

@@ -20,6 +20,13 @@ first-class layer):
   `/metrics`, `/healthz`, `/varz`, `/tracez` (`?request_id=`,
   `?chrome=1`), `/stacksz`. `start_debug_server(port=0)` returns the
   bound port; `inference.create_engine(..., debug_port=)` wires it in.
+* `train_stats` — training telemetry plane: `StepLogger` per-step
+  scalars (loss, lr, global grad-norm, examples/s, tokens/s, step
+  wall-time, estimated MFU) into the registry + a rotating JSONL log,
+  the in-graph numerics sentinel (warn / skip_step / halt on a
+  non-finite step, one flag fetched with the existing outputs), and
+  the Executor's recompilation-attribution log; `/trainz` serves it,
+  `tools/train_summary.py` renders the JSONL.
 * `watchdog` — stall watchdog + flight recorder: a daemon thread that
   watches the engine/executor progress heartbeats in the registry and
   dumps stacks + spans + a metrics snapshot into a bounded-retention
@@ -40,7 +47,8 @@ Stdlib-only on import: safe to import anywhere in the framework with no
 jax side effects.
 """
 
-from . import debug_server, export, metrics, tracer, watchdog  # noqa: F401
+from . import (debug_server, export, metrics, tracer,  # noqa: F401
+               train_stats, watchdog)
 from .debug_server import (DebugServer, get_debug_server,
                            start_debug_server, stop_debug_server)
 from .export import export_chrome_trace, self_times, summarize
@@ -49,6 +57,10 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
 from .tracer import (Span, Tracer, current_request_id, disable_tracing,
                      enable_tracing, get_tracer, request_scope, trace_span,
                      tracing_enabled)
+from .train_stats import (StepLogger, attach_step_telemetry,
+                          get_step_logger, install_step_logger,
+                          recompile_log, step_logging,
+                          uninstall_step_logger)
 from .watchdog import (FlightRecorder, ProgressMonitor, Watchdog,
                        dump_flight_record, format_all_stacks, get_watchdog,
                        start_watchdog, stop_watchdog)
@@ -64,4 +76,7 @@ __all__ = [
     "Watchdog", "FlightRecorder", "ProgressMonitor", "start_watchdog",
     "stop_watchdog", "get_watchdog", "dump_flight_record",
     "format_all_stacks",
+    "StepLogger", "install_step_logger", "uninstall_step_logger",
+    "get_step_logger", "step_logging", "attach_step_telemetry",
+    "recompile_log",
 ]

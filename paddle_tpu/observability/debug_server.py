@@ -41,6 +41,7 @@ from urllib.parse import parse_qs, urlparse
 from .export import spans_to_events
 from .metrics import MetricsRegistry, get_registry
 from .tracer import Span, Tracer, get_tracer
+from . import train_stats as _train_stats
 from . import watchdog as _watchdog
 
 __all__ = ["DebugServer", "start_debug_server", "acquire_debug_server",
@@ -55,6 +56,8 @@ _INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
 <li><a href="/tracez">/tracez</a> — recent spans
     (<code>?request_id=</code>, <code>?limit=</code>,
      <code>?chrome=1</code>)</li>
+<li><a href="/trainz">/trainz</a> — training telemetry: latest step
+    scalars + recompile log (<code>?limit=</code>)</li>
 <li><a href="/stacksz">/stacksz</a> — all-thread stack dump</li>
 </ul></body></html>
 """
@@ -132,7 +135,8 @@ class DebugServer:
         self.routes = {
             "/": self._index, "/metrics": self._metrics,
             "/healthz": self._healthz, "/varz": self._varz,
-            "/tracez": self._tracez, "/stacksz": self._stacksz,
+            "/tracez": self._tracez, "/trainz": self._trainz,
+            "/stacksz": self._stacksz,
         }
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -236,6 +240,29 @@ class DebugServer:
             "dropped": self._tracer.dropped,
             "request_id": rid,
             "spans": [s._asdict() for s in spans],
+        })
+
+    def _trainz(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Training telemetry: latest-N step scalars (StepLogger ring)
+        plus the recompilation-attribution log, as JSON."""
+        raw = q.get("limit", "50")
+        try:
+            limit = int(raw)
+        except ValueError:
+            limit = -1
+        if limit < 0:
+            h._send_json({"error": f"bad limit {raw!r}: expected a "
+                          "non-negative integer"}, status=400)
+            return
+        logger = _train_stats.get_step_logger()
+        h._send_json({
+            "enabled": logger is not None,
+            "policy": logger.policy if logger else None,
+            "steps_total": logger.step_count if logger else 0,
+            "nan_steps": logger.nan_steps if logger else 0,
+            "log_path": logger.log_path if logger else None,
+            "steps": logger.recent(limit) if logger else [],
+            "recompiles": _train_stats.recompile_log(limit),
         })
 
     def _stacksz(self, h: _Handler, q: Dict[str, str]) -> None:

@@ -103,6 +103,17 @@ class Optimizer:
         opt_ops = self.apply_gradients(
             params_grads, loss.block.program,
             startup_program or default_startup_program())
+        # Training telemetry tap (observability/train_stats.py): while a
+        # StepLogger is installed, attach the global grad-norm var (the
+        # one GradientClipByGlobalNorm already computed, or a fresh
+        # reduction) and the in-graph numerics-sentinel flag. Without a
+        # logger the program stays byte-identical — zero extra ops.
+        from .observability import train_stats
+        logger = train_stats.get_step_logger()
+        if logger is not None:
+            train_stats.attach_step_telemetry(
+                loss.block.program, loss, params_grads, self,
+                policy=logger.policy)
         return opt_ops, params_grads
 
     def _dygraph_minimize(self, loss, parameter_list):

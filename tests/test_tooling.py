@@ -15,7 +15,21 @@ import paddle_tpu as pt
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _has_xprof() -> bool:
+    try:
+        import xprof  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 def test_timeline_export_chrome_trace():
+    pytest.importorskip(
+        "xprof",
+        reason="xprof not installed — tools/timeline.py converts "
+        "jax.profiler xplane captures with xprof's trace_viewer; "
+        "without it the CLI exits 2 with a remediation hint "
+        "(covered by test_timeline_cli_without_xprof)")
     prof_dir = tempfile.mkdtemp()
     main, startup = pt.Program(), pt.Program()
     with pt.unique_name_guard(), pt.program_guard(main, startup):
@@ -37,6 +51,24 @@ def test_timeline_export_chrome_trace():
     d = json.load(open(out))
     ev = d["traceEvents"] if isinstance(d, dict) else d
     assert len(ev) > 10
+
+
+@pytest.mark.skipif(_has_xprof(), reason="xprof installed — the "
+                    "ImportError degradation path cannot trigger")
+def test_timeline_cli_without_xprof(tmp_path):
+    """Satellite: tools/timeline.py and tools/profile_summary.py exit 2
+    with a remediation hint when xprof is missing — never a raw
+    ImportError traceback."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for cli in ("tools/timeline.py", "tools/profile_summary.py"):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, cli),
+             "--profile_path", str(tmp_path)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert r.returncode == 2, (cli, r.returncode, r.stderr)
+        assert "xprof is not importable" in r.stderr, (cli, r.stderr)
+        assert "pip install xprof" in r.stderr
+        assert "Traceback" not in r.stderr, (cli, r.stderr)
 
 
 def test_op_bench_single_op():
@@ -225,6 +257,71 @@ def test_trace_summary_cli_absent_and_empty_files(tmp_path):
                        capture_output=True, text=True, timeout=120,
                        env=env)
     assert r.returncode == 0 and json.loads(r.stdout) == []
+
+
+def test_train_summary_cli_smoke(tmp_path):
+    """tools/train_summary.py over a StepLogger JSONL: annotated step
+    table prints (SPIKE + RECOMPILE + NAN markers), JSON mode parses,
+    and a missing/empty/garbage log exits 2 with a hint."""
+    from paddle_tpu.observability.train_stats import StepLogger
+
+    logger = StepLogger(log_dir=str(tmp_path), run_name="run")
+    for i in range(4):
+        logger.log_step(loss=1.0 - 0.1 * i, grad_norm=0.5, lr=0.01,
+                        step_time_s=0.02, examples=8)
+    logger.event("recompile", cause="feed_shape",
+                 detail={"var": "x", "from": [8, 4], "to": [16, 4]})
+    logger.log_step(loss=50.0, grad_norm=90.0, lr=0.01,
+                    step_time_s=0.02, examples=8)      # spike
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        logger.log_step(loss=float("nan"), grad_norm=float("nan"),
+                        lr=0.01, finite=False, step_time_s=0.02,
+                        examples=8)
+    # a recompile journaled after the last step (crash signature) must
+    # still surface, not silently drop
+    logger.event("recompile", cause="program_version", detail={})
+    logger.close()
+    path = os.path.join(str(tmp_path), "run.jsonl")
+    cli = os.path.join(REPO, "tools/train_summary.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, cli, path], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "SPIKE" in r.stdout
+    assert "RECOMPILE(feed_shape)" in r.stdout
+    assert "RECOMPILE(program_version)" in r.stdout
+    assert "NAN" in r.stdout
+    assert ("6 steps, 1 non-finite, 2 recompile(s) "
+            "(1 after the last step)") in r.stdout
+    r = subprocess.run([sys.executable, cli, path, "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    rows = json.loads(r.stdout)
+    assert len(rows) == 7  # 6 steps + trailing-recompile row
+    assert rows[4]["annotations"] == ["SPIKE", "RECOMPILE(feed_shape)"]
+    assert rows[5]["annotations"] == ["NAN"]
+    assert rows[6]["kind"] == "trailing"
+    assert rows[6]["annotations"] == ["RECOMPILE(program_version)"]
+
+    # degradation: absent / empty / non-JSONL exit 2 with remediation
+    r = subprocess.run([sys.executable, cli, str(tmp_path / "no.jsonl")],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "cannot read" in r.stderr
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    r = subprocess.run([sys.executable, cli, str(empty)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "install_step_logger" in r.stderr
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("{nope\n")
+    r = subprocess.run([sys.executable, cli, str(bad)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "not JSONL" in r.stderr
+    assert "Traceback" not in r.stderr
 
 
 def test_api_freeze_spec_is_current():

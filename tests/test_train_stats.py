@@ -1,0 +1,458 @@
+"""Training telemetry plane (observability/train_stats.py).
+
+Pins the PR-4 contracts: (a) a short train run produces one JSONL
+record per step with finite loss, a grad-norm matching a host-side
+NumPy recomputation, and monotonic step ids; (b) an injected NaN loss
+triggers each sentinel policy correctly — `skip_step` leaves params
+AND optimizer accumulators bit-identical to the pre-step snapshot,
+`halt` raises, `warn` counts — and the sentinel flag travels in the
+SAME fetch tuple as the user's outputs (compile-count/fetch-count
+pinned, no second computation per step); (c) a deliberate feed-shape
+change yields exactly one `executor_recompiles_total{cause=
+"feed_shape"}` increment whose "why" record names the offending var;
+(d) `/trainz` serves the scalars over plain http.client; (e) with no
+StepLogger installed the whole plane is a no-op — zero train registry
+series, zero extra fetch outputs, byte-identical programs."""
+
+import http.client
+import json
+import os
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import train_stats as ts
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Each test starts/ends with no logger installed and a fresh
+    registry (families are re-fetched per use everywhere, so a reset
+    can't orphan live instrumentation)."""
+    ts.uninstall_step_logger()
+    obs.get_registry().reset()
+    yield
+    ts.uninstall_step_logger()
+    obs.get_registry().reset()
+    obs.stop_debug_server()
+
+
+RNG = np.random.RandomState(0)
+X0 = RNG.randn(8, 4).astype("f")
+Y0 = RNG.randn(8, 1).astype("f")
+YNAN = Y0.copy()
+YNAN[0, 0] = np.nan
+
+
+def build_program(grad_clip=None, lr=0.01):
+    """Tiny 2-param regression + Adam; returns (main, startup, loss)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        x = pt.layers.data("x", [4])
+        y = pt.layers.data("y", [1])
+        pred = pt.layers.fc(x, 1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.Adam(lr, grad_clip=grad_clip).minimize(loss)
+    return main, startup, loss
+
+
+def run_steps(exe, main, loss, feeds, fetch_extra=()):
+    outs = []
+    for f in feeds:
+        outs.append(exe.run(main, feed=f,
+                            fetch_list=[loss] + list(fetch_extra)))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# (a) per-step records: JSONL, grad-norm truth, monotonic ids
+# ---------------------------------------------------------------------------
+
+
+def test_step_records_jsonl_and_grad_norm_truth(tmp_path):
+    logger = ts.install_step_logger(
+        ts.StepLogger(log_dir=str(tmp_path), run_name="run"))
+    main, startup, loss = build_program()
+    gnames = [p.name + "@GRAD" for p in main.all_parameters()]
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        outs = run_steps(exe, main, loss, [{"x": X0, "y": Y0}] * 5,
+                         fetch_extra=gnames)
+    recs = logger.recent()
+    assert len(recs) == 5
+    assert [r["step"] for r in recs] == [1, 2, 3, 4, 5]
+    for r in recs:
+        assert r["finite"] and not r["skipped"]
+        assert np.isfinite(r["loss"])
+        assert r["step_time_s"] > 0
+        assert r["examples_per_s"] > 0
+        assert r["lr"] == pytest.approx(0.01, rel=1e-5)
+    # grad-norm matches a host-side NumPy recomputation, every step
+    for r, step_out in zip(recs, outs):
+        grads = step_out[1:]
+        ref = np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
+                          for g in grads))
+        assert r["grad_norm"] == pytest.approx(ref, rel=1e-5)
+    # the JSONL file carries the same 5 records, in order
+    path = os.path.join(str(tmp_path), "run.jsonl")
+    assert logger.log_path == path
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    steps = [l for l in lines if l["kind"] == "step"]
+    assert [l["step"] for l in steps] == [1, 2, 3, 4, 5]
+    assert steps[0]["compiled"] and not steps[1]["compiled"]
+    # compile accounting rode along on the compiling step
+    assert steps[0]["compile"]["flops"] > 0
+    assert steps[0]["compile"]["peak_bytes"] > 0
+    assert recs[-1]["scope_bytes"] > 0
+
+
+def test_jsonl_rotation_is_bounded(tmp_path):
+    logger = ts.StepLogger(log_dir=str(tmp_path), run_name="rot",
+                           max_bytes=2048, max_files=2)
+    for i in range(200):
+        logger.log_step(loss=float(i), step_time_s=0.01)
+    logger.close()
+    files = sorted(os.listdir(str(tmp_path)))
+    assert "rot.jsonl" in files
+    # at most max_files rotated generations survive, never more
+    rotated = [f for f in files if f.startswith("rot.jsonl.")]
+    assert 1 <= len(rotated) <= 2
+    for f in files:
+        assert os.path.getsize(os.path.join(str(tmp_path), f)) <= 4096
+    # newest rotated generation is .1 and every surviving line parses
+    for f in files:
+        for line in open(os.path.join(str(tmp_path), f)):
+            json.loads(line)
+
+
+# ---------------------------------------------------------------------------
+# (b) sentinel policies
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_params_and_accumulators(main):
+    scope = pt.global_scope()
+    names = [p.name for p in main.all_parameters()]
+    names += [n for n in scope.var_names()
+              if "moment" in n or "beta" in n]
+    return {n: scope.get_numpy(n).copy() for n in names}
+
+
+def test_sentinel_skip_step_leaves_state_bit_identical():
+    logger = ts.install_step_logger(ts.StepLogger(policy="skip_step"))
+    main, startup, loss = build_program()
+    assert main._train_telemetry["policy"] == "skip_step"
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        pre = _snapshot_params_and_accumulators(main)
+        assert len(pre) >= 6  # 2 params + adam moments/beta pows
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            l, = exe.run(main, feed={"x": X0, "y": YNAN},
+                         fetch_list=[loss])
+        assert not np.isfinite(l).all()
+        for n, v in pre.items():
+            assert np.array_equal(pt.global_scope().get_numpy(n), v), n
+        # a following good step resumes updating
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        moved = any(
+            not np.array_equal(pt.global_scope().get_numpy(n), v)
+            for n, v in pre.items())
+        assert moved
+        # the flag travelled with the existing outputs: ONE executable
+        # for all five runs of this program (startup was the other
+        # compile), one run per step, and the sentinel fetches are in
+        # the same fetch tuple the executor dispatched
+        assert exe.compile_count == 2
+        snap = obs.get_registry().snapshot()
+        assert snap["executor_runs_total"]["series"][0]["value"] == 4.0
+        assert main._train_telemetry["flag"] in exe.last_fetch_names
+        assert len(exe.last_fetch_names) == 4  # loss+gnorm+flag+lr
+    rec = logger.recent()[1]
+    assert rec["skipped"] and not rec["finite"]
+    assert logger.nan_steps == 1
+    nan = obs.get_registry().snapshot()["nan_steps_total"]["series"]
+    assert nan == [{"labels": {"policy": "skip_step"}, "value": 1.0}]
+
+
+def test_sentinel_halt_raises_and_preserves_params():
+    ts.install_step_logger(ts.StepLogger(policy="halt"))
+    main, startup, loss = build_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        pre = _snapshot_params_and_accumulators(main)
+        with pytest.raises(FloatingPointError, match="halt"):
+            exe.run(main, feed={"x": X0, "y": YNAN}, fetch_list=[loss])
+        for n, v in pre.items():
+            assert np.array_equal(pt.global_scope().get_numpy(n), v), n
+
+
+def test_sentinel_warn_counts_and_does_not_gate():
+    logger = ts.install_step_logger(ts.StepLogger(policy="warn"))
+    main, startup, loss = build_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        with pytest.warns(RuntimeWarning, match="non-finite"):
+            exe.run(main, feed={"x": X0, "y": YNAN}, fetch_list=[loss])
+        # warn does NOT protect the params — NaN propagated (that is
+        # the documented difference vs skip_step/halt)
+        w = pt.global_scope().get_numpy(main.all_parameters()[0].name)
+        assert not np.isfinite(w).all()
+    assert logger.nan_steps == 1
+    rec = logger.recent()[-1]
+    assert not rec["finite"] and not rec["skipped"]
+    nan = obs.get_registry().snapshot()["nan_steps_total"]["series"]
+    assert nan == [{"labels": {"policy": "warn"}, "value": 1.0}]
+
+
+# ---------------------------------------------------------------------------
+# (c) recompilation attribution + cache counters
+# ---------------------------------------------------------------------------
+
+
+def test_feed_shape_recompile_attribution():
+    ts.install_step_logger(ts.StepLogger())
+    main, startup, loss = build_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        exe.run(main, feed={"x": np.tile(X0, (2, 1)),
+                            "y": np.tile(Y0, (2, 1))}, fetch_list=[loss])
+    snap = obs.get_registry().snapshot()
+    rc = snap["executor_recompiles_total"]["series"]
+    assert rc == [{"labels": {"cause": "feed_shape"}, "value": 1.0}]
+    # the why record names the offending variable and both shapes
+    why = exe.recompile_log[-1]
+    assert why["cause"] == "feed_shape"
+    assert why["detail"]["var"] == "x"
+    assert why["detail"]["from"] == [8, 4]
+    assert why["detail"]["to"] == [16, 4]
+    assert ts.recompile_log()[-1]["cause"] == "feed_shape"
+    # cache accounting: 3 misses (startup, main, main-reshaped), 1 hit
+    assert snap["executor_cache_misses_total"]["series"][0]["value"] == 3.0
+    assert snap["executor_cache_hits_total"]["series"][0]["value"] == 1.0
+    assert snap["executor_cache_size"]["series"][0]["value"] == 3.0
+
+
+def test_cache_counters_without_step_logger():
+    """Satellite: executor cache stats export even when the full
+    StepLogger plane is disabled (and land in /varz via the registry
+    snapshot)."""
+    assert ts.get_step_logger() is None
+    main, startup, loss = build_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+    snap = obs.get_registry().snapshot()
+    assert snap["executor_cache_misses_total"]["series"][0]["value"] == 2.0
+    assert snap["executor_cache_hits_total"]["series"][0]["value"] == 1.0
+    assert snap["executor_cache_size"]["series"][0]["value"] == 2.0
+    port = obs.start_debug_server(port=0)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/varz")
+        body = json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+    assert "executor_cache_misses_total" in body["metrics"]
+    assert "executor_cache_size" in body["metrics"]
+
+
+def test_cache_eviction_counter():
+    ts.get_step_logger()  # stays None: counters are logger-independent
+    main, startup, loss = build_program()
+    exe = pt.Executor(cache_capacity=2)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for b in (4, 8, 12):  # 3 distinct feed shapes, capacity 2
+            exe.run(main, feed={"x": np.tile(X0, (b // 8 + 1, 1))[:b],
+                                "y": np.tile(Y0, (b // 8 + 1, 1))[:b]},
+                    fetch_list=[loss])
+    snap = obs.get_registry().snapshot()
+    assert snap["executor_cache_evictions_total"]["series"][0][
+        "value"] >= 2.0
+    assert snap["executor_cache_size"]["series"][0]["value"] == 2.0
+
+
+def test_eviction_churn_is_attributed_not_first_compile():
+    """A miss for a program whose entries were all LRU-evicted is a
+    recompile (cause="evicted") — cache churn must not hide behind
+    first_compile."""
+    main_a, startup_a, loss_a = build_program()
+    main_b, startup_b, loss_b = build_program()
+    exe = pt.Executor(cache_capacity=1)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup_a)
+        exe.run(startup_b)
+        exe.run(main_a, feed={"x": X0, "y": Y0}, fetch_list=[loss_a])
+        exe.run(main_b, feed={"x": X0, "y": Y0}, fetch_list=[loss_b])
+        exe.run(main_a, feed={"x": X0, "y": Y0}, fetch_list=[loss_a])
+    rc = obs.get_registry().snapshot()[
+        "executor_recompiles_total"]["series"]
+    by_cause = {s["labels"]["cause"]: s["value"] for s in rc}
+    # only the final main_a run re-compiles a known program; the four
+    # earlier misses were first compiles of distinct programs
+    assert by_cause == {"evicted": 1.0}
+    why = exe.recompile_log[-1]
+    assert why["cause"] == "evicted"
+    assert why["detail"]["cache_capacity"] == 1
+
+
+# ---------------------------------------------------------------------------
+# clip.py global-norm exposure (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_clip_global_norm_surfaced_matches_numpy_reference():
+    logger = ts.install_step_logger(ts.StepLogger())
+    clip_norm = 0.05  # small enough that clipping definitely engages
+    main, startup, loss = build_program(
+        grad_clip=pt.clip.GradientClipByGlobalNorm(clip_norm))
+    # the clip exposed its in-graph norm var instead of dropping it,
+    # and the telemetry tap reuses that very var
+    assert main._global_norm_var == main._train_telemetry["grad_norm"]
+    gnames = [p.name + "@GRAD" for p in main.all_parameters()]
+    clip_names = sorted(n for n in main.global_block.vars
+                        if "@CLIP" in n)
+    assert clip_names
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": X0, "y": Y0},
+                       fetch_list=[loss] + gnames + [clip_names[0]])
+    grads = outs[1:1 + len(gnames)]
+    ref_norm = np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
+                           for g in grads))
+    rec = logger.recent()[-1]
+    # surfaced norm is the PRE-clip raw global norm
+    assert rec["grad_norm"] == pytest.approx(ref_norm, rel=1e-5)
+    assert ref_norm > clip_norm  # the clipped case really clipped
+    # and the clipped gradient equals g * clip_norm / max(norm, clip)
+    scale = clip_norm / max(ref_norm, clip_norm)
+    raw = dict(zip(gnames, grads))
+    base = clip_names[0].split("@CLIP")[0]  # "<param>@GRAD"
+    np.testing.assert_allclose(outs[-1], raw[base] * scale, rtol=1e-5)
+
+
+def test_unclipped_grad_norm_tap_built_when_no_clip():
+    ts.install_step_logger(ts.StepLogger())
+    main, _, _ = build_program(grad_clip=None)
+    assert getattr(main, "_global_norm_var", None) is None
+    assert "telemetry_grad_norm" in main._train_telemetry["grad_norm"]
+
+
+# ---------------------------------------------------------------------------
+# (d) /trainz
+# ---------------------------------------------------------------------------
+
+
+def test_trainz_serves_step_scalars_and_recompiles():
+    logger = ts.install_step_logger(ts.StepLogger(policy="warn"))
+    main, startup, loss = build_program()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+        exe.run(main, feed={"x": np.tile(X0, (2, 1)),
+                            "y": np.tile(Y0, (2, 1))}, fetch_list=[loss])
+    port = obs.start_debug_server(port=0)
+
+    def get(path, expect=200):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            assert r.status == expect, (path, r.status)
+            return json.loads(r.read())
+        finally:
+            conn.close()
+
+    body = get("/trainz")
+    assert body["enabled"] and body["policy"] == "warn"
+    assert body["steps_total"] == 4 and body["nan_steps"] == 0
+    assert len(body["steps"]) == 4
+    assert body["steps"][-1]["loss"] == logger.recent()[-1]["loss"]
+    assert [s["step"] for s in body["steps"]] == [1, 2, 3, 4]
+    assert body["recompiles"][-1]["cause"] == "feed_shape"
+    # ?limit= truncates to the newest N
+    body = get("/trainz?limit=2")
+    assert [s["step"] for s in body["steps"]] == [3, 4]
+    get("/trainz?limit=bogus", expect=400)
+    # uninstalled logger -> disabled view, not an error
+    ts.uninstall_step_logger()
+    body = get("/trainz")
+    assert body["enabled"] is False and body["steps"] == []
+
+
+# ---------------------------------------------------------------------------
+# (e) disabled path is a no-op
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_plane_is_noop():
+    assert ts.get_step_logger() is None
+    main, startup, loss = build_program()
+    # no logger at build time => the program got NO telemetry ops/vars
+    assert getattr(main, "_train_telemetry", None) is None
+    assert not any("telemetry" in n for n in main.global_block.vars)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+    assert len(outs) == 1
+    assert exe.last_fetch_names == [loss.name]  # zero extra fetches
+    fams = set(obs.get_registry().snapshot())
+    assert not any(f.startswith("train_") or f.startswith("nan_")
+                   for f in fams), fams
+
+
+def test_attached_program_without_logger_adds_no_fetches():
+    """A program built WITH telemetry but run with the logger
+    uninstalled (the bench_gpt timed-loop pattern): no extra fetch
+    outputs, no train registry series, no step records."""
+    ts.install_step_logger(ts.StepLogger())
+    main, startup, loss = build_program()
+    assert main._train_telemetry is not None
+    ts.uninstall_step_logger()
+    obs.get_registry().reset()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": X0, "y": Y0}, fetch_list=[loss])
+    assert len(outs) == 1
+    assert exe.last_fetch_names == [loss.name]
+    fams = set(obs.get_registry().snapshot())
+    assert not any(f.startswith("train_") or f.startswith("nan_")
+                   for f in fams), fams
+
+
+def test_telemetry_prunes_from_test_clone():
+    """clone(for_test=True) drops the whole tap (op_role=optimize)."""
+    ts.install_step_logger(ts.StepLogger(policy="skip_step"))
+    main, _, _ = build_program()
+    test_prog = main.clone(for_test=True)
+    blk = test_prog.global_block
+    for op in blk.ops:
+        assert op.type not in ("isfinite", "logical_and"), op.type
+        assert not any("@PRE_STEP" in n for n in op.output_names())
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

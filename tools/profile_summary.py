@@ -22,9 +22,9 @@ def load_hlo_stats(profile_dir: str):
     import os
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from tools.timeline import find_xplane
-    from xprof.convert import raw_to_tool_data
+    from tools.timeline import find_xplane, load_xprof_converter
 
+    raw_to_tool_data = load_xprof_converter()
     xplane = find_xplane(profile_dir)
     data, _ = raw_to_tool_data.xspace_to_tool_data([xplane], "hlo_stats",
                                                    {})
@@ -80,8 +80,16 @@ def main(argv=None):
     ap.add_argument("--top", type=int, default=12)
     args = ap.parse_args(argv)
 
-    out = summarize(load_hlo_stats(args.profile_path), args.steps,
-                    args.top)
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.timeline import XprofUnavailableError
+    try:
+        stats = load_hlo_stats(args.profile_path)
+    except XprofUnavailableError as e:
+        print(f"profile_summary: {e}", file=sys.stderr)
+        return 2
+    out = summarize(stats, args.steps, args.top)
     print(f"total device self time: {out['total_ms_per_step']:.2f} "
           f"ms/step")
     print(f"{'ms/step':>9}  {'%':>5}  {'TFLOP/s':>8}  {'HBM GiB/s':>9}  "

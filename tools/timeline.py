@@ -19,6 +19,27 @@ import os
 import sys
 
 
+class XprofUnavailableError(Exception):
+    """xprof (the trace converter) is not installed; reported with a
+    remediation hint instead of a raw ImportError traceback."""
+
+
+def load_xprof_converter():
+    """Import xprof's raw->tool-data converter, or raise
+    XprofUnavailableError with remediation. Shared with
+    tools/profile_summary.py so both CLIs degrade the same way."""
+    try:
+        from xprof.convert import raw_to_tool_data
+    except ImportError as e:
+        raise XprofUnavailableError(
+            f"xprof is not importable ({e}). The timeline/profile tools "
+            "convert jax.profiler xplane captures with xprof — install "
+            "it (`pip install xprof`) or, for host-side spans without "
+            "xprof, use paddle_tpu.observability.export_chrome_trace() "
+            "+ tools/trace_summary.py instead.")
+    return raw_to_tool_data
+
+
 def find_xplane(profile_dir: str) -> str:
     pats = [os.path.join(profile_dir, "plugins/profile/*/*.xplane.pb"),
             os.path.join(profile_dir, "**/*.xplane.pb")]
@@ -32,8 +53,8 @@ def find_xplane(profile_dir: str) -> str:
 
 
 def convert(profile_dir: str, out_path: str) -> str:
+    raw_to_tool_data = load_xprof_converter()
     xplane = find_xplane(profile_dir)
-    from xprof.convert import raw_to_tool_data
 
     data, _ = raw_to_tool_data.xspace_to_tool_data(
         [xplane], "trace_viewer", {})
@@ -56,7 +77,11 @@ def main(argv=None):
     ap.add_argument("--profile_path", default="/tmp/paddle_tpu_prof")
     ap.add_argument("--timeline_path", default="/tmp/timeline.json")
     args = ap.parse_args(argv)
-    out = convert(args.profile_path, args.timeline_path)
+    try:
+        out = convert(args.profile_path, args.timeline_path)
+    except XprofUnavailableError as e:
+        print(f"timeline: {e}", file=sys.stderr)
+        return 2
     print(f"wrote {out} — open in chrome://tracing or ui.perfetto.dev")
 
 

@@ -1,0 +1,169 @@
+"""Render a StepLogger JSONL run into an annotated step table.
+
+The training-side analog of tools/trace_summary.py: the reference's
+contrib/model_stat + profiler tables answered "what did this run do";
+this CLI answers it from the telemetry plane's event log
+(observability/train_stats.StepLogger) — per-step loss / grad-norm /
+lr / throughput with loss-spike, non-finite, skipped-step, and
+recompilation annotations.
+
+Usage:
+  python tools/train_summary.py RUN.jsonl [--last N]
+      [--spike-factor 2.0] [--json]
+
+Annotations:
+  NAN        the step's sentinel flag was non-finite
+  SKIP       the sentinel gated the update (policy skip_step/halt)
+  SPIKE      loss > spike-factor x median of the preceding window
+  RECOMPILE  a compile-cache miss was attributed between this step and
+             the previous one (cause in parentheses)
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+SPIKE_WINDOW = 8
+
+
+class TrainLogError(Exception):
+    """Unreadable/unparsable run log (reported, never a traceback)."""
+
+
+def load_records(path: str):
+    """Parse a StepLogger JSONL file into a list of dicts. Raises
+    TrainLogError (with a remediation hint) for a missing, empty, or
+    non-JSONL file."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        raise TrainLogError(f"cannot read {path!r}: {e.strerror or e}")
+    if not raw.strip():
+        raise TrainLogError(
+            f"{path!r} is empty — no telemetry was written there. "
+            "Install a StepLogger with a log_dir (observability."
+            "install_step_logger(StepLogger(log_dir=...))) BEFORE "
+            "building the training program, then train.")
+    records = []
+    for lineno, line in enumerate(raw.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise TrainLogError(
+                f"{path!r} is not JSONL (line {lineno}: {e.msg}). "
+                "Expected one StepLogger JSON record per line.")
+        if not isinstance(rec, dict):
+            raise TrainLogError(
+                f"{path!r} line {lineno} is a {type(rec).__name__}, "
+                "expected a JSON object per line")
+        records.append(rec)
+    return records
+
+
+def annotate(records, spike_factor: float = 2.0):
+    """Split records into step rows (with an `annotations` list) and the
+    recompile events, correlating recompiles to the step that follows
+    them in the stream."""
+    rows = []
+    pending_recompiles = []
+    window = []
+    for rec in records:
+        kind = rec.get("kind", "step")
+        if kind == "recompile":
+            pending_recompiles.append(rec)
+            continue
+        if kind != "step":
+            continue
+        row = dict(rec)
+        notes = []
+        loss = row.get("loss")
+        finite = row.get("finite", True)
+        if not finite:
+            notes.append("NAN")
+        if row.get("skipped"):
+            notes.append("SKIP")
+        if (finite and loss is not None and len(window) >= 3):
+            med = statistics.median(window)
+            if med > 0 and loss > spike_factor * med:
+                notes.append("SPIKE")
+        for rc in pending_recompiles:
+            notes.append(f"RECOMPILE({rc.get('cause', '?')})")
+        row["recompiles"] = pending_recompiles
+        pending_recompiles = []
+        row["annotations"] = notes
+        if finite and loss is not None:
+            window.append(loss)
+            if len(window) > SPIKE_WINDOW:
+                window.pop(0)
+        rows.append(row)
+    if pending_recompiles:
+        # recompile events after the last step — the crash signature
+        # (the why-record lands before the compile that then dies);
+        # surface them as a trailing row instead of dropping them
+        rows.append({
+            "kind": "trailing", "step": None, "finite": True,
+            "recompiles": pending_recompiles,
+            "annotations": [f"RECOMPILE({rc.get('cause', '?')})"
+                            for rc in pending_recompiles],
+        })
+    return rows
+
+
+def _fmt(v, spec="{:.4g}"):
+    return "-" if v is None else spec.format(v)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run", help="StepLogger JSONL path")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only the last N steps (default: all)")
+    ap.add_argument("--spike-factor", type=float, default=2.0,
+                    help="flag loss > factor x rolling median (default 2)")
+    ap.add_argument("--json", action="store_true",
+                    help="print annotated rows as one JSON array")
+    args = ap.parse_args(argv)
+
+    try:
+        rows = annotate(load_records(args.run), args.spike_factor)
+    except TrainLogError as e:
+        print(f"train_summary: {e}", file=sys.stderr)
+        return 2
+    if args.last > 0:
+        rows = rows[-args.last:]
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print("no step records in run log")
+        return 0
+    print(f"{'step':>6}  {'loss':>10}  {'grad_norm':>10}  {'lr':>9}  "
+          f"{'ms':>8}  {'ex/s':>9}  annotations")
+    for r in rows:
+        ms = (r.get("step_time_s") or 0) * 1e3 or None
+        print(f"{r.get('step') or '-':>6}  {_fmt(r.get('loss')):>10}  "
+              f"{_fmt(r.get('grad_norm')):>10}  {_fmt(r.get('lr')):>9}  "
+              f"{_fmt(ms, '{:.2f}'):>8}  "
+              f"{_fmt(r.get('examples_per_s'), '{:.1f}'):>9}  "
+              f"{' '.join(r['annotations'])}")
+    n_steps = sum(1 for r in rows if r.get("kind") != "trailing")
+    n_nan = sum(1 for r in rows if not r.get("finite", True))
+    n_rc = sum(len(r["recompiles"]) for r in rows)
+    trailing = sum(len(r["recompiles"]) for r in rows
+                   if r.get("kind") == "trailing")
+    tail = f" ({trailing} after the last step)" if trailing else ""
+    print(f"-- {n_steps} steps, {n_nan} non-finite, "
+          f"{n_rc} recompile(s){tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
