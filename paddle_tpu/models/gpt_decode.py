@@ -31,7 +31,7 @@ import numpy as np
 
 __all__ = ["collect_gpt_params", "gpt_forward_logits", "gpt_prefill",
            "gpt_prefill_padded", "gpt_decode_step", "gpt_decode_step_slots",
-           "gpt_generate"]
+           "gpt_decode_chunk_slots", "gpt_generate"]
 
 
 def _ln_names(name):
@@ -277,6 +277,69 @@ def gpt_decode_step_slots(params, cfg, tokens, cache, ts):
         h = _ln(x, blk["ln2"])
         x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
     return _head_logits(params, x), cache
+
+
+def gpt_decode_chunk_slots(params, cfg, tokens, cache, ts, keys, temps,
+                           done, remaining, eos_ids, chunk,
+                           sample_fn=None):
+    """Fused multi-token decode: `chunk` iterations of
+    gpt_decode_step_slots + per-slot sampling + in-graph EOS/budget
+    masking inside ONE lax.scan — a single dispatch (and a single host
+    fetch) emits a (chunk, S) token block, amortizing the per-step
+    Python + dispatch + sync cost by the chunk factor.
+
+    tokens/ts: (S,) int32 — the token each slot feeds next and its
+    absolute position. keys: (S, 2) per-slot PRNG keys. temps: (S,) f32.
+    done: (S,) bool — slots that must ride along FROZEN (finished, free,
+    or cancelled); a frozen slot re-emits its last token, never advances
+    ts, and decrements nothing. remaining: (S,) int32 tokens each slot
+    may still emit; a slot freezes in-graph the moment it emits its
+    eos_id (eos_ids: (S,) int32, -1 = no eos — sampled ids are always
+    >= 0 so -1 never matches) or its remaining budget hits zero, exactly
+    the scheduler's host-side finish rule — so the host can consume a
+    slot's column up to ITS OWN finish point and discard the frozen
+    repeats after it, and a chunked stream is token-identical to the
+    per-step path whatever the chunk size.
+
+    A frozen slot's ride-along decode still rewrites row ts of its OWN
+    cache slot (same stale-row discipline as free slots in
+    gpt_decode_step_slots: the next admission's prefill overwrites
+    before anything reads), and ts never reaches max_len: the engine
+    admits only prompt+max_new <= max_len, and the budget mask freezes
+    ts at p_len+max_new-1 at most.
+
+    sample_fn(key, logits_row, temp) -> (token, key_next) is traced
+    per-slot (the serving scheduler passes its temperature/top-k
+    sampler); None means greedy argmax. Keys advance every iteration for
+    every slot — frozen slots included — mirroring the per-step path's
+    whole-pool vmap so per-request streams stay identical across chunk
+    sizes (a request's key is re-seeded at admission anyway).
+
+    Returns (block (chunk, S) int32 — iteration-major, so block[i, s] is
+    slot s's i-th in-chunk token — tokens, cache, ts, keys, done,
+    remaining), the post-chunk carry the next dispatch resumes from.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if sample_fn is None:
+        def sample_fn(key, logits, temp):
+            return jnp.argmax(logits, -1).astype(jnp.int32), key
+
+    def body(carry, _):
+        tok, cache, ts, keys, done, rem = carry
+        logits, cache = gpt_decode_step_slots(params, cfg, tok, cache, ts)
+        nxt, keys = jax.vmap(sample_fn)(keys, logits, temps)
+        emit = jnp.where(done, tok, nxt)
+        rem = jnp.where(done, rem, rem - 1)
+        ndone = done | (emit == eos_ids) | (rem <= 0)
+        ts = jnp.where(done, ts, ts + 1)
+        return (emit, cache, ts, keys, ndone, rem), emit
+
+    (tokens, cache, ts, keys, done, remaining), block = jax.lax.scan(
+        body, (tokens, cache, ts, keys, done, remaining), None,
+        length=int(chunk))
+    return block, tokens, cache, ts, keys, done, remaining
 
 
 def _sample(logits, key, temperature, top_k):

@@ -54,9 +54,11 @@ __all__ = ["ProgressMonitor", "FlightRecorder", "Watchdog",
 
 DEFAULT_FLIGHT_DIR = "/tmp/paddle_tpu_flight"
 
-# registry series feeding the per-engine heartbeat (PR 2 publishes these)
+# registry series feeding the per-engine heartbeat (PR 2 publishes these;
+# dispatches counts at chunk LAUNCH, so a device-side hang with the host
+# blocked in the fetch still shows its last enqueue before freezing)
 _ENGINE_PROGRESS = ("serving_decode_steps_total", "serving_prefills_total",
-                    "serving_tokens_out_total")
+                    "serving_tokens_out_total", "serving_dispatches_total")
 _ENGINE_BUSY = ("serving_active_slots", "serving_queue_depth")
 
 

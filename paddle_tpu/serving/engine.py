@@ -12,8 +12,10 @@ scheduler. A request moves
 
 with a per-request streaming callback fired on every emitted token and
 RequestMetrics stamping queue-wait/TTFT/TPOT along the way. The engine
-is driven synchronously — step() interleaves admissions and one batched
-decode; run_until_drained() loops — so tests and batch jobs need no
+is driven synchronously — step() interleaves admissions with one decode
+pipeline tick (launch the next fused chunk dispatch, fan out the oldest
+completed block; see scheduler.py for the donation/fusion/overlap fast
+path); run_until_drained() loops — so tests and batch jobs need no
 threads, while submit() itself is lock-protected so producer threads can
 feed a driver loop.
 """
@@ -54,6 +56,7 @@ class ServingConfig:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_len: Optional[int] = None, top_k: int = 0,
                  max_admits_per_step: Optional[int] = None,
+                 decode_chunk: int = 8, overlap: bool = True,
                  clock: Callable[[], float] = time.monotonic):
         self.num_slots = int(num_slots)
         self.max_queue = int(max_queue)
@@ -62,6 +65,14 @@ class ServingConfig:
         self.max_len = max_len
         self.top_k = int(top_k)
         self.max_admits_per_step = max_admits_per_step
+        # decode fast path: fused decode iterations per dispatch (token
+        # streams are identical at every setting; higher amortizes
+        # dispatch/sync cost, lower tightens streaming latency), and
+        # whether to keep one dispatch in flight while host post-
+        # processing runs (overlap=False collects each dispatch
+        # immediately — simplest latency profile, no pipelining)
+        self.decode_chunk = int(decode_chunk)
+        self.overlap = bool(overlap)
         self.clock = clock
 
 
@@ -139,7 +150,13 @@ class ServingEngine:
             else jnp.float32
         self.kv = SlotKVCache(cfg, serving.num_slots, max_len, dtype)
         self.scheduler = ContinuousBatchingScheduler(
-            params, cfg, self.kv, self.buckets, top_k=serving.top_k)
+            params, cfg, self.kv, self.buckets, top_k=serving.top_k,
+            decode_chunk=serving.decode_chunk, overlap=serving.overlap)
+        # launch-side heartbeat: bumped at dispatch ENQUEUE inside the
+        # scheduler, not after step() returns — a device hang leaves the
+        # host blocked in the next fetch, and the watchdog/flight record
+        # must still see the last launch that went in
+        self.scheduler.on_launch = self._on_dispatch_launched
         self.metrics = EngineMetrics()
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
@@ -228,9 +245,14 @@ class ServingEngine:
                 req.on_token(req, event.token)
 
     def step(self) -> int:
-        """Admit waiting requests into free slots, then run ONE batched
-        decode step across everything in flight. Returns the number of
-        tokens emitted (0 means idle)."""
+        """Admit waiting requests into free slots, then run one decode
+        pipeline tick: launch the next fused chunk dispatch and fan out
+        the oldest completed one (with overlap on, the first tick of a
+        burst only launches — its tokens surface next tick, hidden
+        under the following dispatch's device time). Returns the number
+        of tokens emitted; 0 means idle OR a launch-only warm-up tick,
+        so drive loops should key on queue/active state, not on the
+        return value."""
         with trace_span("serving/engine_step", "serving"):
             return self._step_impl()
 
@@ -281,11 +303,15 @@ class ServingEngine:
         events = self.scheduler.step()
         if events:
             self.metrics.decode_steps += 1
+            self.metrics.observe_dispatch_tokens(len(events))
         for event in events:
             self._emit(event)
             emitted += 1
         self.metrics.active_slots = self.kv.active_count
         return emitted
+
+    def _on_dispatch_launched(self) -> None:
+        self.metrics.dispatches += 1
 
     def run_until_drained(self, max_steps: Optional[int] = None) -> int:
         """Step until queue and slots are empty; returns steps taken."""

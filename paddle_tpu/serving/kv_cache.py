@@ -13,6 +13,13 @@ Host-side bookkeeping (alloc/free/length) lives here; the pool array
 itself is a jax value the scheduler threads through its jitted steps and
 stores back (`self.kv`), so slot retirement is free — a retired slot's
 rows simply go stale until the next admission's prefill overwrites them.
+
+DONATION DISCIPLINE: the scheduler donates `kv` into every prefill and
+fused decode dispatch (`donate_argnums`), so the buffer behind a
+consumed pool value is reused in place by XLA and the donated-in array
+is DEAD afterwards. Never cache a reference to `cache.kv` across a
+scheduler step — re-read the attribute; the scheduler always stores the
+dispatch's output back before returning.
 """
 
 from __future__ import annotations
@@ -121,8 +128,16 @@ class SlotKVCache:
     def length(self, slot: int) -> int:
         return self._len[slot]
 
+    @property
+    def pool_bytes(self) -> int:
+        """HBM footprint of the pool — constant for the engine's life
+        (donation reuses the same buffer in place every dispatch)."""
+        import numpy as np
+        return int(np.prod(self.kv.shape)) * self.dtype.itemsize
+
     def occupancy(self) -> Dict[str, int]:
         return {"num_slots": self.num_slots,
                 "active_slots": self.active_count,
                 "free_slots": self.free_count,
-                "live_positions": sum(self._len)}
+                "live_positions": sum(self._len),
+                "pool_bytes": self.pool_bytes}

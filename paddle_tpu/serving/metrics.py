@@ -129,16 +129,26 @@ _HELP = {
     "tokens_out": "total generated tokens",
     "decode_steps": "batched decode steps executed",
     "prefills": "prefill dispatches",
+    "dispatches": "fused decode-chunk dispatches launched",
     "active_slots": "KV slots currently occupied",
     "queue_depth": "requests waiting for a slot",
 }
 
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
-             "decode_steps", "prefills")
+             "decode_steps", "prefills", "dispatches")
 _GAUGES = ("active_slots", "queue_depth")
 _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tpot": "serving_tpot_seconds",
-               "queue_wait": "serving_queue_wait_seconds"}
+               "queue_wait": "serving_queue_wait_seconds",
+               "tokens_per_dispatch": "serving_tokens_per_dispatch"}
+_HIST_HELP = {
+    "ttft": "request ttft in seconds",
+    "tpot": "request tpot in seconds",
+    "queue_wait": "request queue wait in seconds",
+    "tokens_per_dispatch": "tokens emitted per fused decode dispatch "
+                           "(the chunk-amortization ratio: dispatches-"
+                           "per-token is its reciprocal)",
+}
 
 
 class EngineMetrics:
@@ -173,8 +183,13 @@ class EngineMetrics:
             self._series[name] = fam.labels(**label)
         self._hists = {}
         for key, full in _HISTOGRAMS.items():
-            fam = self._registry.histogram(
-                full, f"request {key.replace('_', ' ')} in seconds")
+            # tokens-per-dispatch is a COUNT distribution (1..slots*chunk),
+            # not a latency: the default seconds-scaled buckets would dump
+            # every observation in +Inf
+            buckets = ((1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+                       if key == "tokens_per_dispatch" else None)
+            fam = self._registry.histogram(full, _HIST_HELP[key],
+                                           buckets=buckets)
             self._families.append(fam)
             self._hists[key] = fam.labels(**label)
 
@@ -185,6 +200,12 @@ class EngineMetrics:
         snapshot() keeps working on the detached series."""
         for fam in self._families:
             fam.remove(engine=self.engine_label)
+
+    def observe_dispatch_tokens(self, n: int) -> None:
+        """One collected decode dispatch emitted n live tokens (frozen
+        ride-along repeats excluded) — the amortization series the
+        /varz- and bench-visible dispatches-per-token columns read."""
+        self._hists["tokens_per_dispatch"].observe(float(n))
 
     def record(self, rm: RequestMetrics):
         self.completed += 1

@@ -3,30 +3,61 @@
 Orca/vLLM-style iteration-level scheduling on top of gpt_decode's
 prefill/step split: instead of running each request's whole decode loop
 alone (TPU idle between requests, batch-1 latency everywhere), the
-scheduler keeps ONE batched decode step hot over all slots and admits
-new requests into free slots between steps:
+scheduler keeps ONE batched decode dispatch hot over all slots and
+admits new requests into free slots between dispatches:
 
     admit:  pad the prompt to a shape bucket, gpt_prefill_padded into the
             slot's pool rows, sample the first token from the prompt's
             last-position logits — one dispatch per bucket shape.
-    step:   gpt_decode_step_slots over the WHOLE pool (fixed batch =
-            num_slots, per-slot positions) + in-graph per-slot sampling —
-            always the same executable, whatever mix of sequences is in
-            flight.
-    retire: finished sequences just free their slot; the batch never
+    step:   gpt_decode_chunk_slots over the WHOLE pool — `decode_chunk`
+            fused decode iterations (fixed batch = num_slots, per-slot
+            positions, in-graph sampling + EOS/budget masking) per
+            dispatch, returning a (chunk, slots) token block in one
+            fetch. Always the same executable, whatever mix of
+            sequences is in flight.
+    retire: finished sequences freeze IN-GRAPH (the chunk kernel's done
+            mask) and just free their slot host-side; the batch never
             stalls and the next admission's prefill overwrites the rows.
 
+Decode fast path (why this is fast, not just correct):
+
+  * BUFFER DONATION — the KV pool, the per-slot PRNG keys, and the
+    device-resident decode state are donated into every jitted entry
+    point (`donate_argnums`, the executor's `donate=True` discipline),
+    so XLA updates the cache in place instead of materializing a fresh
+    pool per dispatch.
+  * FUSED MULTI-TOKEN DECODE — one dispatch runs `decode_chunk`
+    iterations, amortizing Python + dispatch + host-sync cost by the
+    chunk factor while staying O(buckets)+2 executables.
+  * OVERLAPPED PIPELINE — dispatch k+1 launches BEFORE dispatch k's
+    token block is pulled to host (`jax.device_get` on the previous
+    in-flight result): host post-processing (event fan-out, tracing,
+    slot retire, admissions between chunks) hides under device compute.
+    This is safe without host inspection because the in-graph done mask
+    freezes finished slots — the device never needs the host's verdict
+    to keep the batch sound.
+
+The decode carry (current token, position, done, remaining budget,
+temperature, eos id — all per-slot) lives ON DEVICE between dispatches;
+the host only touches it at admission (the admit executable resets one
+slot's entries in-graph). Each _Running records `live_from`, the index
+of the first dispatch whose block carries its tokens, so a block fetched
+AFTER a slot was retired and re-admitted is never mis-attributed to the
+new occupant (its tokens start in a later dispatch by construction).
+
 Compile discipline (the point of the fixed shapes): executables =
-len(prefill buckets) + 1 decode step + 1 admission sampler. The
+len(prefill buckets) + 1 fused decode chunk + 1 admission sampler. The
 `compile_count`/`compile_events` hook counts traces as they happen so
-tests can assert O(buckets), not O(requests).
+tests can assert O(buckets), not O(requests) — and that the chunk loop
+adds exactly ONE executable whatever decode_chunk is.
 
 Greedy sequences reproduce the sequential `gpt_generate` path
 token-for-token: the per-slot step math is gpt_decode_step's row-by-row,
 and argmax runs in-graph exactly as `_sample` does. Sampled sequences
 (temperature > 0) use a per-slot PRNG key seeded from the request seed —
-deterministic per request, but a different key schedule than
-gpt_generate's single chain.
+deterministic per request AND per chunk size (one key split per decode
+iteration, frozen slots included, exactly the per-step schedule), but a
+different key schedule than gpt_generate's single chain.
 """
 
 from __future__ import annotations
@@ -53,41 +84,73 @@ class SequenceEvent(NamedTuple):
 
 
 class _Running:
-    """Host-side state of the sequence occupying one slot."""
+    """Host-side state of the sequence occupying one slot. Only what the
+    block walk needs lives here — the decode feed itself (current token,
+    position, temperature, remaining budget) is device-resident carry,
+    reset in-graph at admission."""
 
-    __slots__ = ("req", "pos", "last_token", "produced", "max_new",
-                 "eos_id", "temperature")
+    __slots__ = ("req", "pos", "produced", "max_new", "eos_id",
+                 "live_from")
 
-    def __init__(self, req, pos, last_token, max_new, eos_id, temperature):
+    def __init__(self, req, pos, max_new, eos_id, live_from):
         self.req = req
         self.pos = pos                    # absolute position fed next
-        self.last_token = last_token      # token to feed at `pos`
         self.produced = 1                 # prefill already sampled one
         self.max_new = max_new
         self.eos_id = eos_id
-        self.temperature = temperature
+        self.live_from = live_from        # first dispatch carrying tokens
+
+
+class _Inflight(NamedTuple):
+    """One launched-but-unfetched chunk dispatch."""
+    block: Any          # device (chunk, S) int32 token block (a future)
+    index: int          # dispatch index at launch (matches live_from)
+    size: int           # chunk length
+    begin_ns: int       # launch stamp; 0 = tracing was off at launch
 
 
 class ContinuousBatchingScheduler:
-    """Owns the device state (KV pool, per-slot PRNG keys) and the three
-    jitted entry points; the engine above it owns queues and lifecycle."""
+    """Owns the device state (KV pool, per-slot PRNG keys, decode carry)
+    and the three jitted entry points; the engine above it owns queues
+    and lifecycle."""
 
     def __init__(self, params, cfg, kv: SlotKVCache, buckets: ShapeBuckets,
-                 top_k: int = 0):
+                 top_k: int = 0, decode_chunk: int = 8,
+                 overlap: bool = True):
         import jax
 
+        if int(decode_chunk) < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1, got {decode_chunk}")
         self.params = params
         self.cfg = cfg
         self.kv = kv
         self.buckets = buckets
         self.top_k = int(top_k)
+        self.decode_chunk = int(decode_chunk)
+        self.overlap = bool(overlap)
         self._running: Dict[int, _Running] = {}
         self._compile_events: List[str] = []
         self._keys = jax.random.split(
             jax.random.PRNGKey(0), kv.num_slots)
         self._prefill_jit = None
-        self._step_jit = None
+        self._chunk_jit = None
         self._admit_jit = None
+        # device-resident decode carry: (tokens, ts, done, remaining,
+        # temps, eos_ids), all (S,) — built lazily with the jits
+        self._state = None
+        self._inflight: List[_Inflight] = []
+        self._launches = 0
+        # fired inside _launch, right at enqueue — the engine hangs its
+        # dispatches heartbeat here so a device-side stall with the host
+        # blocked in the NEXT collect still shows this launch (a metric
+        # bumped after step() returns would never record it)
+        self.on_launch = None
+        # per-bucket host staging buffers, reused across admissions
+        # (jit copies feed arrays at dispatch, so mutation-after-call is
+        # safe and admission never allocates)
+        self._staging: Dict[int, np.ndarray] = {}
+        self._real_len = np.zeros((1,), np.int32)
 
     # -- jitted entry points ------------------------------------------------
     #
@@ -113,12 +176,21 @@ class ContinuousBatchingScheduler:
         return jnp.where(temp > 0.0, drawn, greedy), key_next
 
     def _ensure_jits(self):
-        if self._step_jit is not None:
+        if self._chunk_jit is not None:
             return
         import jax
+        import jax.numpy as jnp
         # deferred: models/__init__ pulls every model module (each doing
         # `import paddle_tpu`), which must not run during package import
         from ..models import gpt_decode as gd
+
+        s_dim = self.kv.num_slots
+        self._state = (jnp.zeros((s_dim,), jnp.int32),   # tokens
+                       jnp.zeros((s_dim,), jnp.int32),   # ts
+                       jnp.ones((s_dim,), bool),         # done (all frozen)
+                       jnp.zeros((s_dim,), jnp.int32),   # remaining
+                       jnp.zeros((s_dim,), jnp.float32),  # temps
+                       jnp.full((s_dim,), -1, jnp.int32))  # eos_ids
 
         def prefill_impl(params, pool, tokens, real_len, slot):
             self._compile_events.append(f"prefill:L{tokens.shape[1]}")
@@ -128,22 +200,42 @@ class ContinuousBatchingScheduler:
                 pool, pc.astype(pool.dtype), (0, 0, slot, 0, 0, 0))
             return logits[0], pool
 
-        def admit_impl(keys, slot, seed, logits, temp):
+        def admit_impl(keys, state, slot, seed, logits, temp, pos,
+                       max_new, eos_id):
             self._compile_events.append("admit_sample")
+            tokens, ts, done, remaining, temps, eos_ids = state
             keys = keys.at[slot].set(jax.random.PRNGKey(seed))
-            nxt, key_next = self._sample_row(keys[slot], logits, temp)
-            return nxt, keys.at[slot].set(key_next)
+            first, key_next = self._sample_row(keys[slot], logits, temp)
+            keys = keys.at[slot].set(key_next)
+            # finished-at-admission mirrors the host rule exactly so the
+            # device-side done mask never disagrees with _running
+            fin = (max_new <= 1) | ((eos_id >= 0) & (first == eos_id))
+            state = (tokens.at[slot].set(first),
+                     ts.at[slot].set(pos),
+                     done.at[slot].set(fin),
+                     remaining.at[slot].set(max_new - 1),
+                     temps.at[slot].set(temp),
+                     eos_ids.at[slot].set(eos_id))
+            return first, keys, state
 
-        def step_impl(params, pool, tokens, ts, keys, temps):
-            self._compile_events.append("decode_step")
-            logits, pool = gd.gpt_decode_step_slots(
-                params, self.cfg, tokens, pool, ts)
-            nxt, keys = jax.vmap(self._sample_row)(keys, logits, temps)
-            return nxt, pool, keys
+        def chunk_impl(params, pool, keys, state):
+            self._compile_events.append("decode_chunk")
+            tokens, ts, done, remaining, temps, eos_ids = state
+            block, tokens, pool, ts, keys, done, remaining = \
+                gd.gpt_decode_chunk_slots(
+                    params, self.cfg, tokens, pool, ts, keys, temps,
+                    done, remaining, eos_ids, self.decode_chunk,
+                    sample_fn=self._sample_row)
+            return block, pool, keys, (tokens, ts, done, remaining,
+                                       temps, eos_ids)
 
-        self._prefill_jit = jax.jit(prefill_impl)
-        self._admit_jit = jax.jit(admit_impl)
-        self._step_jit = jax.jit(step_impl)
+        # donation (the executor's donate=True discipline): the pool, the
+        # key table, and the decode carry are consumed by exactly one
+        # dispatch and replaced by its outputs, so XLA reuses their
+        # buffers in place instead of copying the KV pool every chunk
+        self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(1,))
+        self._admit_jit = jax.jit(admit_impl, donate_argnums=(0, 1))
+        self._chunk_jit = jax.jit(chunk_impl, donate_argnums=(1, 2, 3))
 
     # -- compile-counter hook ----------------------------------------------
 
@@ -161,12 +253,33 @@ class ContinuousBatchingScheduler:
     def active_count(self) -> int:
         return len(self._running)
 
+    @property
+    def dispatch_count(self) -> int:
+        """Chunk dispatches launched so far (the amortization metric's
+        numerator: tokens-per-dispatch = tokens_out / dispatches)."""
+        return self._launches
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)
+
+    def _staging_for(self, bucket: int) -> np.ndarray:
+        buf = self._staging.get(bucket)
+        if buf is None:
+            buf = self._staging[bucket] = np.zeros((1, bucket), np.int32)
+        return buf
+
     def admit(self, req, prompt: np.ndarray, max_new: int,
               temperature: float = 0.0, seed: int = 0,
               eos_id: Optional[int] = None) -> Optional[SequenceEvent]:
         """Claim a slot, prefill the prompt into it (padded to its shape
-        bucket), sample the first token. Returns the first-token event,
-        or None when no slot is free (caller keeps the request queued)."""
+        bucket), sample the first token, and reset the slot's entries in
+        the device decode carry. Returns the first-token event, or None
+        when no slot is free (caller keeps the request queued).
+
+        With a dispatch in flight, everything here just enqueues behind
+        it (the pool/state inputs are its output futures); only the
+        first-token fetch at the end waits."""
         self._ensure_jits()
         slot = self.kv.alloc()
         if slot is None:
@@ -174,23 +287,27 @@ class ContinuousBatchingScheduler:
         prompt = np.asarray(prompt, np.int32).reshape(1, -1)
         p_len = prompt.shape[1]
         bucket = self.buckets.bucket_for(p_len)
-        padded = np.zeros((1, bucket), np.int32)
+        padded = self._staging_for(bucket)
         padded[0, :p_len] = prompt[0]
+        padded[0, p_len:] = 0
+        self._real_len[0] = p_len
         with profiler.RecordEvent("serving/prefill", bucket=bucket,
                                   prompt_len=p_len, slot=slot,
                                   request_id=getattr(req, "request_id",
                                                      None)):
             logits, pool = self._prefill_jit(
-                self.params, self.kv.kv, padded,
-                np.asarray([p_len], np.int32), np.int32(slot))
-            first, self._keys = self._admit_jit(
-                self._keys, np.int32(slot), np.int32(seed), logits,
-                np.float32(temperature))
+                self.params, self.kv.kv, padded, self._real_len,
+                np.int32(slot))
+            first, self._keys, self._state = self._admit_jit(
+                self._keys, self._state, np.int32(slot), np.int32(seed),
+                logits, np.float32(temperature), np.int32(p_len),
+                np.int32(max_new),
+                np.int32(-1 if eos_id is None else eos_id))
         self.kv.kv = pool
         self.kv.set_length(slot, p_len)
         first = int(first)
-        st = _Running(req, pos=p_len, last_token=first, max_new=max_new,
-                      eos_id=eos_id, temperature=temperature)
+        st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
+                      live_from=self._launches)
         finished = (st.produced >= max_new
                     or (eos_id is not None and first == eos_id))
         if finished:
@@ -200,60 +317,116 @@ class ContinuousBatchingScheduler:
         return SequenceEvent(req, first, finished)
 
     def step(self) -> List[SequenceEvent]:
-        """One batched decode step over the whole pool. Free slots ride
-        along with dummy inputs (fixed shapes are what keep this a single
-        executable); their outputs are discarded and their stale-row
-        writes are overwritten by the next admission's prefill before any
-        attention window can read them."""
-        if not self._running:
+        """One pipeline tick: launch the next chunk dispatch over the
+        whole pool (free/finished slots ride along frozen in-graph —
+        fixed shapes are what keep this a single executable), then fetch
+        and fan out the OLDEST in-flight block. With overlap on, one
+        dispatch is always left in flight while sequences are active, so
+        this tick's host work (device_get, event fan-out, tracing, the
+        engine's retire/admit in between) runs under the NEXT dispatch's
+        device compute."""
+        if not self._running and not self._inflight:
             return []
         self._ensure_jits()
-        s_dim = self.kv.num_slots
-        tokens = np.zeros((s_dim,), np.int32)
-        ts = np.zeros((s_dim,), np.int32)
-        temps = np.zeros((s_dim,), np.float32)
-        for slot, st in self._running.items():
-            tokens[slot] = st.last_token
-            ts[slot] = st.pos
-            temps[slot] = st.temperature
-        # request-id fan-out: ONE batched dispatch serves many requests,
-        # so the step span can't carry a single id — instead each active
-        # slot gets a retroactive per-request "serving/decode_iter" span
-        # over the dispatch window (tracing on only; the disabled path
-        # reads no clock and allocates nothing)
+        launched = False
+        if self._running and self._needs_dispatch():
+            self._launch()
+            launched = True
+        if self._inflight and (len(self._inflight) > 1 or not launched
+                               or not self.overlap):
+            return self._collect(self._inflight.pop(0))
+        return []
+
+    def _needs_dispatch(self) -> bool:
+        """Launch only when some running slot still needs tokens BEYOND
+        what already-launched dispatches will deliver: a slot admitted
+        with budget b has at most b-produced tokens to come, and every
+        in-flight block whose index >= its live_from carries `chunk` of
+        them. Skipping the launch when everything left is already in
+        flight is what keeps dispatches-per-token at exactly 1/chunk in
+        the steady state instead of paying a tail dispatch of frozen
+        ride-alongs per drained batch. (EOS can still finish a slot
+        early — that overshoot is unknowable host-side and bounded by
+        one dispatch.)"""
+        for st in self._running.values():
+            covered = sum(fl.size for fl in self._inflight
+                          if fl.index >= st.live_from)
+            if st.max_new - st.produced > covered:
+                return True
+        return False
+
+    def _launch(self) -> None:
         begin_ns = time.monotonic_ns() if _TRACER.enabled else 0
-        with profiler.RecordEvent("serving/decode_step",
-                                  active=len(self._running), slots=s_dim):
-            nxt, pool, self._keys = self._step_jit(
-                self.params, self.kv.kv, tokens, ts, self._keys, temps)
-        self.kv.kv = pool
-        nxt = np.asarray(nxt)
-        end_ns = time.monotonic_ns() if _TRACER.enabled else 0
+        with profiler.RecordEvent("serving/decode_dispatch",
+                                  active=len(self._running),
+                                  slots=self.kv.num_slots,
+                                  chunk=self.decode_chunk,
+                                  index=self._launches):
+            block, self.kv.kv, self._keys, self._state = self._chunk_jit(
+                self.params, self.kv.kv, self._keys, self._state)
+        self._inflight.append(_Inflight(block, self._launches,
+                                        self.decode_chunk, begin_ns))
+        self._launches += 1
+        if self.on_launch is not None:
+            self.on_launch()
+
+    def _collect(self, fl: _Inflight) -> List[SequenceEvent]:
+        import jax
+
+        block = np.asarray(jax.device_get(fl.block))
+        end_ns = time.monotonic_ns() if fl.begin_ns else 0
         events: List[SequenceEvent] = []
-        for slot in sorted(self._running):
-            st = self._running[slot]
-            tok = int(nxt[slot])
-            st.produced += 1
-            st.last_token = tok
-            st.pos += 1
-            self.kv.advance(slot)
-            finished = (st.produced >= st.max_new
-                        or (st.eos_id is not None and tok == st.eos_id))
-            if finished:
-                del self._running[slot]
-                self.kv.free(slot)
-            if begin_ns:
-                _TRACER.record_complete(
-                    "serving/decode_iter", begin_ns, end_ns, "serving",
-                    {"request_id": getattr(st.req, "request_id", None),
-                     "slot": slot, "pos": st.pos, "token": tok,
-                     "finished": finished})
-            events.append(SequenceEvent(st.req, tok, finished))
+        # iteration-major walk: token i of every slot before token i+1 of
+        # any — the same time-ordering the per-step path emitted, so
+        # streaming callbacks keep per-token granularity and order.
+        for i in range(fl.size):
+            for slot in sorted(self._running):
+                st = self._running[slot]
+                if st.live_from > fl.index:
+                    # admitted after this dispatch launched: its tokens
+                    # start in a later block (the slot was frozen or
+                    # carried the PREVIOUS occupant here)
+                    continue
+                tok = int(block[i, slot])
+                st.produced += 1
+                st.pos += 1
+                self.kv.advance(slot)
+                finished = (st.produced >= st.max_new
+                            or (st.eos_id is not None
+                                and tok == st.eos_id))
+                if finished:
+                    # retire-without-stall: the slot frees NOW (in-graph
+                    # it froze the moment this token was emitted); its
+                    # frozen repeats later in this block are skipped
+                    # because the slot leaves _running
+                    del self._running[slot]
+                    self.kv.free(slot)
+                if fl.begin_ns:
+                    # chunk-interpolated retroactive span: token i of a
+                    # C-token dispatch window [begin, end) gets the
+                    # [i/C, (i+1)/C) sliver, not the whole window
+                    w = end_ns - fl.begin_ns
+                    _TRACER.record_complete(
+                        "serving/decode_iter",
+                        fl.begin_ns + (i * w) // fl.size,
+                        fl.begin_ns + ((i + 1) * w) // fl.size,
+                        "serving",
+                        {"request_id": getattr(st.req, "request_id",
+                                               None),
+                         "slot": slot, "pos": st.pos, "token": tok,
+                         "finished": finished, "chunk_index": i,
+                         "dispatch": fl.index})
+                events.append(SequenceEvent(st.req, tok, finished))
         return events
 
     def cancel(self, req) -> bool:
         """Drop a running sequence (client disconnect): free its slot
-        without emitting further tokens."""
+        without emitting further tokens. Tokens the in-flight dispatch
+        already produced for it are discarded at collect (the slot is no
+        longer in _running); in-graph the abandoned slot freezes by
+        itself within its old budget (remaining hits zero) and its
+        stale-row writes stay confined to its own slot until the next
+        admission's prefill overwrites them."""
         for slot, st in list(self._running.items()):
             if st.req is req:
                 del self._running[slot]

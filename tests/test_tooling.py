@@ -141,13 +141,15 @@ def test_profiler_context_double_stop_safe():
 
 
 def test_bench_serving_row_shape():
-    """tools/bench_serving emits one JSON row per (model, concurrency)
-    with throughput/TTFT/TPOT (same style as bench_inference)."""
+    """tools/bench_serving emits one JSON row per (model, concurrency,
+    decode_chunk) with throughput/TTFT/TPOT + registry-sourced dispatch
+    amortization (same style as bench_inference)."""
     sys.path.insert(0, os.path.join(REPO, "tools"))
     import bench_serving
     rows = bench_serving.run_model("tiny", concurrencies=[1, 2],
-                                   requests_per_level=3, max_new=4)
-    assert len(rows) == 2
+                                   requests_per_level=3, max_new=4,
+                                   decode_chunks=(1, 4))
+    assert len(rows) == 4                        # 2 cc x 2 chunk levels
     for row in rows:
         assert row["metric"].startswith("tiny_serving_c")
         assert row["value"] > 0                  # tokens/s
@@ -160,6 +162,13 @@ def test_bench_serving_row_shape():
         for k in ("p50_ttft_ms", "p99_ttft_ms", "p50_tpot_ms",
                   "p99_tpot_ms"):
             assert row["extra"][k] is not None and row["extra"][k] > 0, row
+        # dispatch-amortization columns (decode fast path): registry-
+        # sourced dispatch count, bounded by the chunk factor
+        chunk = row["extra"]["decode_chunk"]
+        assert row["metric"].endswith(f"_k{chunk}")
+        assert row["extra"]["dispatches"] > 0
+        assert row["extra"]["dispatches_per_token"] <= 1.0 / chunk + 1e-9
+        assert row["extra"]["tokens_per_dispatch"] >= chunk - 1e-9
         # measured tracer overhead rides along (diagnostics PR): the
         # traced re-run really ran (throughput > 0) and the delta is a
         # finite percentage

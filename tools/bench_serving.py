@@ -1,6 +1,7 @@
 """Continuous-batching serving bench: one JSON row per
-(model, concurrency) with generate throughput + TTFT/TPOT — the serving
-companion to tools/bench_inference.py's per-batch latency rows.
+(model, concurrency, decode_chunk) with generate throughput +
+TTFT/TPOT — the serving companion to tools/bench_inference.py's
+per-batch latency rows.
 
 Concurrency maps to the engine's slot count; each level pushes a fixed
 request mix (varied prompt lengths over the engine's shape buckets)
@@ -9,8 +10,15 @@ request-level latency cuts from serving.metrics. Usage:
 
     python tools/bench_serving.py [tiny gpt2]          # default: both
     BENCH_SERVING_REQUESTS=32 python tools/bench_serving.py gpt2
+    python tools/bench_serving.py tiny --decode-chunk 1 8 16
 
-Prints one JSON line per (model, concurrency), bench_inference style.
+Prints one JSON line per (model, concurrency, chunk), bench_inference
+style. `--decode-chunk` sweeps the fused-decode factor (default 1 and
+8: the per-token baseline vs the fast path) and each row carries the
+amortization columns read back from the observability REGISTRY (not
+engine internals): `dispatches` (serving_dispatches_total for the
+engine's label), `dispatches_per_token`, and `tokens_per_dispatch` —
+so the dispatch amortization the fast path buys is measurable per run.
 `--debug-port N` additionally serves the live diagnostics plane
 (/metrics, /tracez, ...) for the duration of the bench (0 = ephemeral,
 the bound port is printed to stderr). Each row also reports the
@@ -57,8 +65,9 @@ def build_params(gpt_kwargs):
 
 
 def run_model(name, concurrencies=None, requests_per_level=None,
-              max_new=32):
-    """Benchmark one model at each concurrency; returns the JSON rows."""
+              max_new=32, decode_chunks=(1, 8)):
+    """Benchmark one model at each (concurrency, decode_chunk); returns
+    the JSON rows."""
     import paddle_tpu as pt
 
     gpt_kwargs, default_cc, prompt_lens, buckets = MODELS[name]
@@ -67,68 +76,95 @@ def run_model(name, concurrencies=None, requests_per_level=None,
         os.environ.get("BENCH_SERVING_REQUESTS", "16"))
     cfg, params = build_params(gpt_kwargs)
     max_len = max(buckets) + max_new
-    rng = np.random.RandomState(0)
     rows = []
     for cc in concurrencies:
-        eng = pt.serving.ServingEngine(
-            params, cfg,
-            pt.serving.ServingConfig(num_slots=cc,
-                                     max_queue=requests_per_level,
-                                     prefill_buckets=buckets,
-                                     max_len=max_len))
-        prompts = [rng.randint(0, cfg.vocab_size,
-                               (prompt_lens[i % len(prompt_lens)],)
-                               ).astype(np.int32)
-                   for i in range(requests_per_level)]
-        # warm the executables (compiles are O(buckets): one request AT
-        # each bucket length warms every prefill shape + the decode step)
-        eng.generate([np.ones((b,), np.int32) for b in buckets],
-                     max_new_tokens=2)
-        eng.metrics.unregister()       # retire the warmup series' label
-        eng.metrics = pt.serving.EngineMetrics()   # drop warmup latencies
-        t0 = time.perf_counter()
-        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
-        eng.run_until_drained()
-        dt = time.perf_counter() - t0
-        s = eng.stats()
-        tokens = sum(len(r.tokens) for r in reqs)
-        quantiles = _registry_quantiles(s["engine_label"])
-        # disabled-path overhead: same mix again with the tracer ON
-        # (executables already warm in both passes, so the delta is the
-        # span-recording cost, not compiles)
-        from paddle_tpu import observability as obs
-        was_enabled = obs.tracing_enabled()
-        obs.enable_tracing()
-        t0 = time.perf_counter()
-        treqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
-        eng.run_until_drained()
-        dt_traced = time.perf_counter() - t0
-        if not was_enabled:
-            obs.disable_tracing()
-        tokens_traced = sum(len(r.tokens) for r in treqs)
-        rows.append({
-            "metric": f"{name}_serving_c{cc}",
-            "value": round(tokens / dt, 2),
-            "unit": "tokens/s",
-            "vs_baseline": None,
-            "extra": {
-                "requests": requests_per_level,
-                "completed": s["completed"],
-                "max_new": max_new,
-                "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2),
-                "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3),
-                "mean_queue_wait_ms": round(s["mean_queue_wait"] * 1e3, 2),
-                "decode_steps": s["decode_steps"],
-                "compiled_executables": s["compiled_executables"],
-                "tokens_per_s_traced": round(tokens_traced / dt_traced, 2),
-                "trace_overhead_pct": round(
-                    (dt_traced - dt) / dt * 100.0, 2),
-                **quantiles,
-            },
-        })
-        eng.close()                    # this engine is done: no dead
-        # labels left behind for the next concurrency level's scrape
+        for chunk in decode_chunks:
+            rng = np.random.RandomState(0)     # same mix per chunk level
+            eng = pt.serving.ServingEngine(
+                params, cfg,
+                pt.serving.ServingConfig(num_slots=cc,
+                                         max_queue=requests_per_level,
+                                         prefill_buckets=buckets,
+                                         max_len=max_len,
+                                         decode_chunk=chunk))
+            prompts = [rng.randint(0, cfg.vocab_size,
+                                   (prompt_lens[i % len(prompt_lens)],)
+                                   ).astype(np.int32)
+                       for i in range(requests_per_level)]
+            # warm the executables (compiles are O(buckets): one request
+            # AT each bucket length warms every prefill shape + the
+            # fused decode chunk)
+            eng.generate([np.ones((b,), np.int32) for b in buckets],
+                         max_new_tokens=2)
+            eng.metrics.unregister()   # retire the warmup series' label
+            eng.metrics = pt.serving.EngineMetrics()   # drop warmup rows
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            eng.run_until_drained()
+            dt = time.perf_counter() - t0
+            s = eng.stats()
+            tokens = sum(len(r.tokens) for r in reqs)
+            label = s["engine_label"]
+            quantiles = _registry_quantiles(label)
+            dispatches = _registry_counter(label,
+                                           "serving_dispatches_total")
+            # disabled-path overhead: same mix again with the tracer ON
+            # (executables already warm in both passes, so the delta is
+            # the span-recording cost, not compiles)
+            from paddle_tpu import observability as obs
+            was_enabled = obs.tracing_enabled()
+            obs.enable_tracing()
+            t0 = time.perf_counter()
+            treqs = [eng.submit(p, max_new_tokens=max_new)
+                     for p in prompts]
+            eng.run_until_drained()
+            dt_traced = time.perf_counter() - t0
+            if not was_enabled:
+                obs.disable_tracing()
+            tokens_traced = sum(len(r.tokens) for r in treqs)
+            rows.append({
+                "metric": f"{name}_serving_c{cc}_k{chunk}",
+                "value": round(tokens / dt, 2),
+                "unit": "tokens/s",
+                "vs_baseline": None,
+                "extra": {
+                    "requests": requests_per_level,
+                    "completed": s["completed"],
+                    "max_new": max_new,
+                    "decode_chunk": chunk,
+                    "dispatches": dispatches,
+                    "dispatches_per_token": round(dispatches / tokens, 4)
+                        if tokens else None,
+                    "tokens_per_dispatch": round(tokens / dispatches, 2)
+                        if dispatches else None,
+                    "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2),
+                    "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3),
+                    "mean_queue_wait_ms": round(
+                        s["mean_queue_wait"] * 1e3, 2),
+                    "decode_steps": s["decode_steps"],
+                    "compiled_executables": s["compiled_executables"],
+                    "tokens_per_s_traced": round(
+                        tokens_traced / dt_traced, 2),
+                    "trace_overhead_pct": round(
+                        (dt_traced - dt) / dt * 100.0, 2),
+                    **quantiles,
+                },
+            })
+            eng.close()                # this engine is done: no dead
+            # labels left behind for the next level's scrape
     return rows
+
+
+def _registry_counter(engine_label, family):
+    """One labeled counter value from the registry snapshot — the same
+    number a /metrics scrape reports for this engine."""
+    from paddle_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+    series = next((r for r in snap.get(family, {}).get("series", [])
+                   if r["labels"].get("engine") == engine_label), None)
+    return int(series["value"]) if series else 0
 
 
 def _registry_quantiles(engine_label):
@@ -158,10 +194,18 @@ def main(argv=None):
     ap.add_argument("--debug-port", type=int, default=None, metavar="PORT",
                     help="serve the live diagnostics plane on PORT for "
                          "the duration of the bench (0 = ephemeral)")
+    ap.add_argument("--decode-chunk", type=int, nargs="+", default=[1, 8],
+                    metavar="K",
+                    help="fused decode iterations per dispatch to sweep "
+                         "(default: 1 8 — per-token baseline vs fast "
+                         "path; token streams are identical at every K)")
     args = ap.parse_args(argv)
     unknown = [m for m in args.models if m not in MODELS]
     if unknown:
         ap.error(f"unknown model(s) {unknown}; choose from {list(MODELS)}")
+    bad = [k for k in args.decode_chunk if k < 1]
+    if bad:
+        ap.error(f"--decode-chunk values must be >= 1, got {bad}")
 
     server_started = False
     if args.debug_port is not None:
@@ -172,7 +216,8 @@ def main(argv=None):
         print(f"debug server: http://127.0.0.1:{port}", file=sys.stderr)
     try:
         for name in args.models or list(MODELS):
-            for row in run_model(name):
+            for row in run_model(name,
+                                 decode_chunks=tuple(args.decode_chunk)):
                 print(json.dumps(row), flush=True)
     finally:
         if server_started:
