@@ -31,7 +31,9 @@ import numpy as np
 
 __all__ = ["collect_gpt_params", "gpt_forward_logits", "gpt_prefill",
            "gpt_prefill_padded", "gpt_decode_step", "gpt_decode_step_slots",
-           "gpt_decode_chunk_slots", "gpt_generate"]
+           "gpt_decode_chunk_slots", "gpt_prefill_pages",
+           "gpt_decode_step_pages", "gpt_decode_chunk_pages",
+           "gpt_generate"]
 
 
 def _ln_names(name):
@@ -340,6 +342,182 @@ def gpt_decode_chunk_slots(params, cfg, tokens, cache, ts, keys, temps,
         body, (tokens, cache, ts, keys, done, remaining), None,
         length=int(chunk))
     return block, tokens, cache, ts, keys, done, remaining
+
+
+def _gather_pages(plane, pages):
+    """Assemble one sequence's K or V matrix from a block arena plane.
+
+    plane: (num_blocks, heads, block_size, hd) — arena[layer, 0|1].
+    pages: (..., P) int32 page table (one row per sequence). Returns
+    (..., heads, P*block_size, hd): the blocks in logical order, so row
+    t of the result is the K/V of absolute position t wherever block
+    t // block_size happens to live in the arena. Entries past a
+    sequence's allocated tail point at the scratch block; the causal
+    mask keeps attention from ever reading those rows."""
+    g = plane[pages]                      # (..., P, heads, bs, hd)
+    g = g.swapaxes(-4, -3)                # (..., heads, P, bs, hd)
+    return g.reshape(*g.shape[:-3], g.shape[-3] * g.shape[-2],
+                     g.shape[-1])
+
+
+def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
+                      pages):
+    """Paged prefill of ONE sequence's prompt SUFFIX into its arena
+    blocks, attending over an already-cached prefix through the page
+    table — the single prefill entry point of the paged serving pool
+    (vLLM-style PagedAttention over hashed shared prefixes).
+
+    tokens: (1, B) int32 suffix, right-padded to a shape bucket.
+    pfx_len: traced scalar — how many leading prompt positions are
+    ALREADY resident in this sequence's blocks (prefix-cache hits,
+    always a multiple of the block size; 0 = cold prompt, which makes
+    this exactly a paged gpt_prefill_padded). real_len: traced scalar,
+    the real (unpadded) suffix length, >= 1 — admission never shares
+    the block holding position p_len-1, so the last prompt position is
+    always computed here and the first-token logits need no cached
+    activations. arena: (layers, 2, num_blocks, heads, block_size, hd).
+    pages: (P,) int32 — THIS sequence's page row; suffix K/V rows are
+    scattered to block pages[pos // bs] offset pos % bs, and attention
+    gathers the whole row back (prefix blocks included) so hit blocks
+    are never recomputed. Pad positions (j >= real_len) write to the
+    SCRATCH block unconditionally: with a large hit prefix and a small
+    suffix bucket, pfx_len + bucket can run past max_pages*bs, where a
+    clamped page gather would collide a pad write with a real row — and
+    no real query ever reads a pad row anyway (the causal mask stops at
+    pos <= p_len - 1).
+
+    Returns (logits of position pfx_len+real_len-1, (1, V) f32, arena).
+    Compiles once per SUFFIX bucket — prefix-cache hits shrink the
+    suffix into the small buckets, which is where the TTFT win on
+    shared-prompt traffic comes from."""
+    import jax.numpy as jnp
+
+    heads, hd = cfg.heads, cfg.hidden // cfg.heads
+    b, B = tokens.shape
+    bs = arena.shape[4]
+    L = pages.shape[0] * bs
+    dtype = arena.dtype
+    j = jnp.arange(B)
+    pos = pfx_len + j                              # absolute positions
+    x = (params["wte"][tokens[0]] + params["wpe"][pos]).astype(dtype)
+    mask = jnp.arange(L)[None, :] <= pos[:, None]  # (B, L) causal
+    # pad rows -> scratch block 0 (see docstring); real rows have
+    # pos < p_len <= max_pages*bs so their page index never clamps
+    wblk = jnp.where(j < real_len,
+                     pages[jnp.minimum(pos // bs, pages.shape[0] - 1)],
+                     0)
+    woff = pos % bs
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _dense(h, blk["q"]).reshape(B, heads, hd)
+        k = _dense(h, blk["k"]).reshape(B, heads, hd)
+        v = _dense(h, blk["v"]).reshape(B, heads, hd)
+        arena = arena.at[li, 0, wblk, :, woff, :].set(k)
+        arena = arena.at[li, 1, wblk, :, woff, :].set(v)
+        K = _gather_pages(arena[li, 0], pages)     # (heads, L, hd)
+        V = _gather_pages(arena[li, 1], pages)
+        scores = jnp.einsum("bnd,nkd->bnk", q, K,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(mask[:, None, :], scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnk,nkd->bnd", probs, V).reshape(B, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    last = x[real_len - 1][None, None]             # (1, 1, h)
+    return _head_logits(params, last), arena
+
+
+def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
+    """gpt_decode_step_slots over a PAGED pool: per-slot K/V live in
+    arena blocks indirected through a page table instead of contiguous
+    slab rows. tokens/ts: (S,) int32, pt: (S, P) int32 page table,
+    arena: (layers, 2, num_blocks, heads, block_size, hd). Returns
+    (logits (S, V) f32, updated arena).
+
+    The slab version's stale-row discipline does not survive paging —
+    a retired slot's blocks are REALLOCATED to other sequences, so a
+    frozen slot riding along must not keep writing through its stale
+    page row. `done` (S,) bool redirects frozen slots' K/V writes to
+    the reserved scratch block 0 in-graph (their gathers still read
+    stale blocks — garbage logits the host discards). done=None keeps
+    every write live (single-sequence/unit-test use)."""
+    import jax.numpy as jnp
+
+    heads = cfg.heads
+    hd = cfg.hidden // cfg.heads
+    bs = arena.shape[4]
+    s_dim, P = pt.shape
+    L = P * bs
+    dtype = arena.dtype
+    rows = jnp.arange(s_dim)
+    x = (params["wte"][tokens] + params["wpe"][ts]).astype(dtype)[:, None]
+    pos_mask = (jnp.arange(L)[None, :] <= ts[:, None])     # [S, L]
+    wblk = pt[rows, ts // bs]
+    if done is not None:
+        wblk = jnp.where(done, 0, wblk)        # frozen -> scratch block
+    woff = ts % bs
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _dense(h, blk["q"]).reshape(s_dim, heads, 1, hd)
+        k = _dense(h, blk["k"]).reshape(s_dim, heads, hd)
+        v = _dense(h, blk["v"]).reshape(s_dim, heads, hd)
+        arena = arena.at[li, 0, wblk, :, woff, :].set(k)
+        arena = arena.at[li, 1, wblk, :, woff, :].set(v)
+        K = _gather_pages(arena[li, 0], pt)    # (S, heads, L, hd)
+        V = _gather_pages(arena[li, 1], pt)
+        scores = jnp.einsum("bnqd,bnkd->bnqk", q, K,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(pos_mask[:, None, None, :],
+                           scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, V)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(s_dim, 1, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    return _head_logits(params, x), arena
+
+
+def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
+                           temps, done, remaining, eos_ids, chunk,
+                           sample_fn=None):
+    """gpt_decode_chunk_slots over the paged pool: `chunk` iterations of
+    gpt_decode_step_pages + per-slot sampling + in-graph EOS/budget
+    masking in ONE lax.scan. Carry/masking semantics are identical to
+    the slab chunk kernel (frozen slots re-emit their last token, never
+    advance ts, keys advance every iteration for every slot), with one
+    paged addition: the done mask also redirects frozen slots' K/V
+    writes to the scratch block, so a retired slot's reallocated blocks
+    are never dirtied by its ride-along decode. The page table `pt`
+    ((S, P) int32) is read-only here — it changes only at admission.
+
+    Returns (block (chunk, S) int32, tokens, arena, ts, keys, done,
+    remaining)."""
+    import jax
+    import jax.numpy as jnp
+
+    if sample_fn is None:
+        def sample_fn(key, logits, temp):
+            return jnp.argmax(logits, -1).astype(jnp.int32), key
+
+    def body(carry, _):
+        tok, arena, ts, keys, done, rem = carry
+        logits, arena = gpt_decode_step_pages(
+            params, cfg, tok, arena, pt, ts, done)
+        nxt, keys = jax.vmap(sample_fn)(keys, logits, temps)
+        emit = jnp.where(done, tok, nxt)
+        rem = jnp.where(done, rem, rem - 1)
+        ndone = done | (emit == eos_ids) | (rem <= 0)
+        ts = jnp.where(done, ts, ts + 1)
+        return (emit, arena, ts, keys, ndone, rem), emit
+
+    (tokens, arena, ts, keys, done, remaining), block = jax.lax.scan(
+        body, (tokens, arena, ts, keys, done, remaining), None,
+        length=int(chunk))
+    return block, tokens, arena, ts, keys, done, remaining
 
 
 def _sample(logits, key, temperature, top_k):

@@ -67,6 +67,28 @@ def _span_request_id(s: Span) -> Optional[str]:
     return s.args.get("request_id") if s.args else None
 
 
+def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-engine paged-KV rollup for /varz: the prefix-cache hit ratio
+    an operator would otherwise have to derive from two counters by
+    hand, keyed by engine label. Computed from the registry snapshot
+    only — no engine references, same as every other /varz column."""
+    def by_engine(name):
+        return {r["labels"].get("engine"): r["value"]
+                for r in snap.get(name, {}).get("series", [])}
+
+    hits = by_engine("serving_prefix_cache_hits_total")
+    misses = by_engine("serving_prefix_cache_misses_total")
+    out = {}
+    for label in sorted(set(hits) | set(misses), key=str):
+        h, m = int(hits.get(label, 0)), int(misses.get(label, 0))
+        out[label] = {
+            "prefix_cache_hits": h,
+            "prefix_cache_misses": m,
+            "prefix_hit_ratio": round(h / (h + m), 4) if h + m else None,
+        }
+    return {"prefix_hit_ratio": out}
+
+
 def _query_flag(q: Dict[str, str], name: str) -> bool:
     return q.get(name, "").lower() not in ("", "0", "false", "no")
 
@@ -193,7 +215,9 @@ class DebugServer:
         }, status=503 if stalled else 200)
 
     def _varz(self, h: _Handler, q: Dict[str, str]) -> None:
+        snap = self._registry.snapshot()
         h._send_json({
+            "serving": _serving_varz(snap),
             "process": {
                 "pid": os.getpid(),
                 "python": sys.version.split()[0],
@@ -211,7 +235,7 @@ class DebugServer:
             },
             "watchdog": (w.status() if (w := _watchdog.get_watchdog())
                          else {"running": False}),
-            "metrics": self._registry.snapshot(),
+            "metrics": snap,
         })
 
     def _tracez(self, h: _Handler, q: Dict[str, str]) -> None:

@@ -2,14 +2,16 @@
 
 The reference keeps inference hardware saturated with async
 executors/DeviceWorkers around AnalysisPredictor (SURVEY §2.8); this
-package is that layer rebuilt for the TPU decode path: a slot-based KV
-pool with O(buckets) compiled shapes (`kv_cache`), an iteration-level
-scheduler that interleaves prefill and fused chunked decode over a
+package is that layer rebuilt for the TPU decode path: a paged KV block
+arena + page tables with hashed prefix sharing (refcounted blocks, LRU
+cached prefixes, copy-on-write isolation) and O(buckets) compiled
+shapes (`kv_cache`), an iteration-level scheduler that admits by pages
+needed and interleaves suffix prefills with fused chunked decode over a
 donated, device-resident pipeline — `decode_chunk` tokens per dispatch,
 the next dispatch launched before the previous block is fetched
 (`scheduler`) — a request-lifecycle engine with bounded admission and
 streaming callbacks (`engine`), and request/engine metrics incl. the
-dispatches/tokens-per-dispatch amortization series (`metrics`).
+dispatch-amortization and block/prefix-cache series (`metrics`).
 
 Entry points: `inference.create_engine(config, gpt_config)` to serve a
 saved model dir, or `ServingEngine(params, cfg)` over an in-memory

@@ -5,10 +5,11 @@ executors/DeviceWorkers that pull work from bounded queues and keep the
 device busy (SURVEY §2.8); this is that layer for the continuous-batching
 scheduler. A request moves
 
-    submit() -> QUEUED -> (slot free) RUNNING -> FINISHED
+    submit() -> QUEUED -> (slot free AND pages free) RUNNING -> FINISHED
              -> EngineOverloadError when the admission queue is full
                 (shed at the door — reject-with-overload, never an
-                unbounded queue)
+                unbounded queue; an arena out of PAGES queues instead —
+                retirements free pages, so the wait is bounded)
 
 with a per-request streaming callback fired on every emitted token and
 RequestMetrics stamping queue-wait/TTFT/TPOT along the way. The engine
@@ -46,17 +47,29 @@ class EngineOverloadError(RuntimeError):
 
 
 class ServingConfig:
-    """Engine knobs. num_slots bounds concurrency (the KV pool's batch
-    dim); max_queue bounds the admission queue (beyond it, submit()
-    sheds); prefill_buckets is the fixed set of padded prompt lengths
-    (compile count is O(len(buckets))); max_len is the pool's per-slot
-    capacity (default cfg.max_pos)."""
+    """Engine knobs. num_slots bounds concurrency (the decode batch
+    dim = page-table rows); max_queue bounds the admission queue (beyond
+    it, submit() sheds); prefill_buckets is the fixed set of padded
+    prompt-SUFFIX lengths (compile count is O(len(buckets))); max_len is
+    the per-sequence position capacity (default cfg.max_pos).
+
+    Paged pool knobs: block_size is the page granularity (HBM is paid
+    per page actually mapped, and prefixes are hash-shared at block
+    granularity); kv_blocks sizes the arena (default: slab-equivalent
+    num_slots × pages-per-max_len + scratch — size it DOWN or num_slots
+    UP to oversubscribe worst-case contexts, admission queues when pages
+    run out); prefix_cache toggles hashed prefix sharing (shared system
+    prompts are prefilled and stored once, refcounted, LRU-kept while
+    unreferenced)."""
 
     def __init__(self, num_slots: int = 4, max_queue: int = 16,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_len: Optional[int] = None, top_k: int = 0,
                  max_admits_per_step: Optional[int] = None,
                  decode_chunk: int = 8, overlap: bool = True,
+                 block_size: int = 16,
+                 kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
                  clock: Callable[[], float] = time.monotonic):
         self.num_slots = int(num_slots)
         self.max_queue = int(max_queue)
@@ -65,6 +78,9 @@ class ServingConfig:
         self.max_len = max_len
         self.top_k = int(top_k)
         self.max_admits_per_step = max_admits_per_step
+        self.block_size = int(block_size)
+        self.kv_blocks = kv_blocks
+        self.prefix_cache = bool(prefix_cache)
         # decode fast path: fused decode iterations per dispatch (token
         # streams are identical at every setting; higher amortizes
         # dispatch/sync cost, lower tightens streaming latency), and
@@ -148,7 +164,10 @@ class ServingEngine:
         import jax.numpy as jnp
         dtype = params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
             else jnp.float32
-        self.kv = SlotKVCache(cfg, serving.num_slots, max_len, dtype)
+        self.kv = SlotKVCache(cfg, serving.num_slots, max_len, dtype,
+                              block_size=serving.block_size,
+                              num_blocks=serving.kv_blocks,
+                              prefix_cache=serving.prefix_cache)
         self.scheduler = ContinuousBatchingScheduler(
             params, cfg, self.kv, self.buckets, top_k=serving.top_k,
             decode_chunk=serving.decode_chunk, overlap=serving.overlap)
@@ -158,6 +177,7 @@ class ServingEngine:
         # must still see the last launch that went in
         self.scheduler.on_launch = self._on_dispatch_launched
         self.metrics = EngineMetrics()
+        self.metrics.kv_blocks_total = self.kv.blocks_total
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
         self._lock = threading.Lock()
@@ -191,6 +211,12 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the pool's max_len "
                 f"({self.kv.max_len})")
+        if self.kv.blocks_for(total) > self.kv.blocks_total:
+            # an undersized arena (kv_blocks oversubscription) must shed
+            # impossible requests at the door, not queue them forever
+            raise ValueError(
+                f"request needs {self.kv.blocks_for(total)} KV blocks "
+                f"but the arena only has {self.kv.blocks_total}")
         req = GenerationRequest(
             prompt, max_new_tokens, temperature, seed, eos_id, on_token,
             self.config.clock,
@@ -274,11 +300,35 @@ class ServingEngine:
                 admitted.append(self._queue.pop(0))
             self.metrics.queue_depth = len(self._queue)
         emitted = 0
-        for req in admitted:
+        for i, req in enumerate(admitted):
+            with self._lock:
+                if req.state != "queued":
+                    # cancelled while popped out of the queue (cancel()
+                    # keys on state, so a request in this local list is
+                    # still cancellable): drop it without admitting
+                    continue
+            # pages-aware admission: the pop above was bounded by free
+            # SLOTS, but the arena may be out of PAGES (short on blocks
+            # after prefix-cache accounting). Head-of-line requests that
+            # don't fit yet go back to the FRONT of the queue — FIFO
+            # order is preserved and a later retirement frees their
+            # pages.
+            if not self.scheduler.can_admit(req.prompt,
+                                            req.max_new_tokens):
+                with self._lock:
+                    self._queue[:0] = [r for r in admitted[i:]
+                                       if r.state == "queued"]
+                    self.metrics.queue_depth = len(self._queue)
+                break
+            with self._lock:
+                if req.state != "queued":   # cancelled during can_admit
+                    continue
+                # the queued->running transition happens under the lock
+                # so cancel() can never miss a request mid-admission
+                req.state = "running"
             # stamp BEFORE the prefill dispatch: queue_wait is time spent
             # waiting for a slot, not prefill/compile latency (that lands
             # in ttft)
-            req.state = "running"
             req.metrics.mark_admitted()
             self.metrics.admitted += 1
             self.metrics.prefills += 1
@@ -297,7 +347,7 @@ class ServingEngine:
                     req, req.prompt, req.max_new_tokens,
                     temperature=req.temperature, seed=req.seed,
                     eos_id=req.eos_id)
-                assert event is not None  # pop bounded by free slots
+                assert event is not None  # can_admit checked, same thread
                 self._emit(event)
             emitted += 1
         events = self.scheduler.step()
@@ -308,6 +358,15 @@ class ServingEngine:
             self._emit(event)
             emitted += 1
         self.metrics.active_slots = self.kv.active_count
+        # paged-pool visibility: block occupancy gauges + prefix-cache
+        # counters (set from the allocator's cumulative totals — the
+        # registry series a scrape reads track the authoritative host
+        # bookkeeping exactly)
+        self.metrics.kv_blocks_total = self.kv.blocks_total
+        self.metrics.kv_blocks_used = self.kv.blocks_used
+        self.metrics.kv_blocks_cached = self.kv.blocks_cached
+        self.metrics.prefix_cache_hits = self.kv.prefix_hits
+        self.metrics.prefix_cache_misses = self.kv.prefix_misses
         return emitted
 
     def _on_dispatch_launched(self) -> None:
@@ -344,10 +403,16 @@ class ServingEngine:
         from the calling thread, so cancel() is safe concurrently with a
         driver inside step()."""
         with self._lock:
-            if req in self._queue:
-                self._queue.remove(req)
+            if req.state == "queued":
+                # keyed on STATE, not queue membership: a head-of-line
+                # request popped for a pages-aware admission check (and
+                # possibly about to be requeued) is still cancellable —
+                # the driver claims queued->running under this same
+                # lock, so the cancel can never be lost
+                if req in self._queue:
+                    self._queue.remove(req)
+                    self.metrics.queue_depth = len(self._queue)
                 req.state = "cancelled"
-                self.metrics.queue_depth = len(self._queue)
                 return True
             if req.state == "running":
                 req.state = "cancelled"

@@ -1,30 +1,55 @@
-"""Slot-based KV-cache manager for the continuous-batching scheduler.
+"""Paged KV-cache manager for the continuous-batching scheduler.
 
-The reference keeps the device saturated by handing each in-flight
-request its own DeviceWorker-owned scope over shared persistables
-(trainer/device_worker layer, SURVEY §2.8); the TPU-native analog is one
-fixed-shape KV pool `(layers, 2, num_slots, heads, max_len, head_dim)`
-where a "slot" is one sequence's cache rows. Fixed shapes are the whole
-point: XLA compiles ONE decode executable for the pool (batch dim =
-num_slots, always), and prefill compiles once per PROMPT-LENGTH BUCKET —
-compile count is O(buckets), never O(requests).
+The pool is a fixed-shape BLOCK ARENA `(layers, 2, num_blocks, heads,
+block_size, head_dim)` plus one page table `(num_slots, max_pages)`
+int32: a "slot" is one sequence's page-table row, and its K/V rows live
+scattered across arena blocks (vLLM-style PagedAttention). Fixed shapes
+are still the whole point — XLA compiles ONE decode executable over the
+arena + page table (batch dim = num_slots, always) and one prefill per
+SUFFIX bucket, so compile count stays O(buckets), never O(requests) —
+but HBM is now paid per PAGE, not per worst-case context: a 10-token
+request holds one block, not max_len rows, so concurrent capacity is
+bounded by actual tokens resident, not by num_slots × max_len.
 
-Host-side bookkeeping (alloc/free/length) lives here; the pool array
-itself is a jax value the scheduler threads through its jitted steps and
-stores back (`self.kv`), so slot retirement is free — a retired slot's
-rows simply go stale until the next admission's prefill overwrites them.
+On top of the allocator sits a HASHED PREFIX CACHE: prompt prefixes are
+hashed at block granularity (a chained blake2b per full block), and a
+new admission whose leading blocks match cached ones maps those blocks
+into its page row (refcounted) instead of re-prefilling them — identical
+system prompts are computed and stored ONCE. Blocks whose refcount drops
+to zero but that still carry a registered hash go to an LRU pool: they
+keep serving hits until arena pressure evicts them (deepest-prefix
+blocks first). Copy-on-write discipline: only blocks FULLY covered by
+the shareable prompt region (never the block holding position p_len-1,
+which the decode tail writes into) are ever shared, so the first block a
+request writes is private by construction and two requests sharing a
+prefix can never see each other's divergence.
 
-DONATION DISCIPLINE: the scheduler donates `kv` into every prefill and
-fused decode dispatch (`donate_argnums`), so the buffer behind a
-consumed pool value is reused in place by XLA and the donated-in array
-is DEAD afterwards. Never cache a reference to `cache.kv` across a
-scheduler step — re-read the attribute; the scheduler always stores the
-dispatch's output back before returning.
+Block index 0 is the reserved SCRATCH block: never allocated, it absorbs
+the in-graph ride-along writes of frozen slots (see
+gpt_decode_step_pages) and the page-row padding past a sequence's tail.
+
+Host-side bookkeeping (slots/blocks/refcounts/hashes) lives here; the
+arena itself is a jax value the scheduler threads through its jitted
+dispatches and stores back (`self.kv`), next to the device-resident page
+table the scheduler owns.
+
+DONATION DISCIPLINE: the scheduler donates the arena AND the device page
+table into every prefill and fused decode dispatch (`donate_argnums`),
+so the buffers behind consumed values are reused in place by XLA and the
+donated-in arrays are DEAD afterwards. Never cache a reference to
+`cache.kv` (or the scheduler's page table) across a scheduler step —
+re-read the attribute; the scheduler always stores the dispatch's output
+back before returning.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+import hashlib
+import math
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 __all__ = ["ShapeBuckets", "SlotKVCache"]
 
@@ -63,35 +88,88 @@ class ShapeBuckets:
             f"{self.sizes[-1]}")
 
 
+SCRATCH_BLOCK = 0
+
+
 class SlotKVCache:
-    """Fixed-shape KV pool + slot allocator.
+    """Paged block arena + slot/page allocator + hashed prefix cache.
 
-    kv: (layers, 2, num_slots, heads, max_len, head_dim) — gpt_decode's
-    cache layout with the batch dim reinterpreted as slots. Allocation is
-    a free-list pop; `length(slot)` tracks how many positions hold live
-    K/V (prompt + generated so far) so the engine can report occupancy
-    and validate budgets."""
+    kv: (layers, 2, num_blocks, heads, block_size, head_dim) — the block
+    arena (block 0 is scratch, never allocated). A slot is a page-table
+    row of up to max_pages block ids; admission maps exactly the pages a
+    request's prompt+budget needs (`blocks_for(p_len + max_new)`), so
+    the arena packs short requests densely instead of paying max_len per
+    slot. `length(slot)` still tracks live positions for occupancy
+    reporting.
 
-    def __init__(self, cfg, num_slots: int, max_len: int, dtype=None):
+    num_blocks defaults to slab-equivalent capacity (num_slots ×
+    max_pages + scratch) so a paged pool is a drop-in replacement; size
+    it DOWN (or num_slots UP) to oversubscribe worst-case contexts —
+    admission falls back to queueing when pages run out."""
+
+    def __init__(self, cfg, num_slots: int, max_len: int, dtype=None,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True):
         import jax.numpy as jnp
 
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 1:
             raise ValueError(f"max_len must be >= 1, got {max_len}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.cfg = cfg
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
+        self.block_size = int(block_size)
+        self.max_pages = -(-self.max_len // self.block_size)  # ceil
+        if num_blocks is None:
+            num_blocks = self.num_slots * self.max_pages + 1
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (scratch + 1), got {num_blocks}")
+        self.prefix_cache_enabled = bool(prefix_cache)
         heads, hd = cfg.heads, cfg.hidden // cfg.heads
         self.dtype = jnp.dtype(dtype) if dtype is not None \
             else jnp.dtype(jnp.float32)
-        self.kv = jnp.zeros(
-            (cfg.layers, 2, self.num_slots, heads, self.max_len, hd),
-            self.dtype)
-        self._free = list(range(self.num_slots - 1, -1, -1))  # pop -> 0,1,..
+        shape = (cfg.layers, 2, self.num_blocks, heads, self.block_size,
+                 hd)
+        self.kv = jnp.zeros(shape, self.dtype)
+        # constant for the engine's life (donation reuses the buffer in
+        # place every dispatch) — computed ONCE, no per-call numpy walk
+        self._pool_bytes = math.prod(shape) * self.dtype.itemsize
+        # -- slot allocator (page-table rows) --
+        self._free = list(range(self.num_slots - 1, -1, -1))  # pop->0,1,..
+        self._free_set = set(self._free)           # O(1) double-free check
         self._len = [0] * self.num_slots
+        self._slot_blocks: List[List[int]] = [[] for _ in
+                                              range(self.num_slots)]
+        # host mirror of the device page table (scratch-filled rows)
+        self.page_table = np.zeros((self.num_slots, self.max_pages),
+                                   np.int32)
+        # -- block allocator (block 0 = scratch, never handed out) --
+        self._free_blocks = list(range(self.num_blocks - 1, 0, -1))
+        self._ref = [0] * self.num_blocks
+        # -- hashed prefix cache --
+        # digest -> block for EVERY registered block (whatever refcount);
+        # _lru is the evictable subset (refcount 0), insertion order =
+        # eviction order (oldest first; free(slot) re-inserts a retiring
+        # sequence's deepest blocks first so shallow prefix blocks — the
+        # likeliest future hits — are evicted last)
+        self._by_hash: Dict[bytes, int] = {}
+        self._hash_of: Dict[int, bytes] = {}
+        self._lru: "OrderedDict[bytes, int]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.peak_blocks_used = 0
+        # one-entry admission-plan memo: can_map() and the map_slot()
+        # that immediately follows share one digest walk instead of
+        # hashing the prompt twice; any allocator mutation invalidates
+        self._plan_gen = 0
+        self._plan_cache = None
 
-    # -- allocation ---------------------------------------------------------
+    # -- slot allocation ----------------------------------------------------
 
     @property
     def free_count(self) -> int:
@@ -102,17 +180,216 @@ class SlotKVCache:
         return self.num_slots - len(self._free)
 
     def alloc(self) -> Optional[int]:
-        """Claim a free slot; None when the pool is full (the scheduler
-        leaves the request queued)."""
+        """Claim a free slot (page-table row); None when every row is
+        occupied (the scheduler leaves the request queued). Pages are
+        mapped separately by map_slot()."""
         if not self._free:
             return None
-        return self._free.pop()
+        slot = self._free.pop()
+        self._free_set.discard(slot)
+        return slot
 
     def free(self, slot: int):
-        if slot in self._free or not 0 <= slot < self.num_slots:
-            raise ValueError(f"free() of slot {slot} not allocated")
+        """Release a slot: every mapped block is unreferenced (cached
+        prefix blocks fall back to the LRU pool, private blocks to the
+        free list) and the page row resets to scratch."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(
+                f"free() of slot {slot} out of range "
+                f"[0, {self.num_slots})")
+        if slot in self._free_set:
+            raise ValueError(f"double free of slot {slot}")
+        # deepest blocks decref'd (and LRU-inserted) first: shallow
+        # prefix blocks land most-recently-used, evicted last
+        for b in reversed(self._slot_blocks[slot]):
+            self._decref(b)
+        self._slot_blocks[slot] = []
+        self.page_table[slot, :] = SCRATCH_BLOCK
         self._len[slot] = 0
         self._free.append(slot)
+        self._free_set.add(slot)
+
+    # -- block accounting ---------------------------------------------------
+
+    @property
+    def blocks_total(self) -> int:
+        """Allocatable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def blocks_used(self) -> int:
+        """Blocks referenced by at least one live slot."""
+        return self.blocks_total - len(self._free_blocks) - len(self._lru)
+
+    @property
+    def blocks_cached(self) -> int:
+        """Unreferenced blocks kept warm for prefix-cache hits (LRU-
+        evicted under pressure)."""
+        return len(self._lru)
+
+    @property
+    def blocks_available(self) -> int:
+        """Blocks an admission can claim right now: free + evictable."""
+        return len(self._free_blocks) + len(self._lru)
+
+    def blocks_for(self, positions: int) -> int:
+        """Pages needed to hold `positions` sequence positions."""
+        if positions < 1:
+            raise ValueError(f"positions must be >= 1, got {positions}")
+        return (positions - 1) // self.block_size + 1
+
+    def _incref(self, block: int) -> None:
+        self._plan_gen += 1
+        self._ref[block] += 1
+        if self._ref[block] == 1:
+            digest = self._hash_of.get(block)
+            if digest is not None:
+                self._lru.pop(digest, None)     # no longer evictable
+
+    def _decref(self, block: int) -> None:
+        if self._ref[block] <= 0:
+            raise ValueError(f"refcount underflow on block {block}")
+        self._plan_gen += 1
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            digest = self._hash_of.get(block)
+            if digest is not None:
+                self._lru[digest] = block       # evictable, MRU end
+            else:
+                self._free_blocks.append(block)
+
+    def _take_block(self) -> int:
+        """Claim one block for exclusive use, evicting the oldest
+        unreferenced cached block if the free list is empty."""
+        self._plan_gen += 1
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        digest, block = self._lru.popitem(last=False)   # oldest
+        del self._by_hash[digest]
+        del self._hash_of[block]
+        return block
+
+    # -- hashed prefix cache ------------------------------------------------
+
+    def _chain_digests(self, prompt: np.ndarray, n_full: int):
+        """Chained per-block digests: digest[i] commits to the whole
+        prefix tokens[0 : (i+1)*block_size], so a hit at block i implies
+        hits at every block before it."""
+        bs = self.block_size
+        data = np.ascontiguousarray(prompt[:n_full * bs], np.int32)
+        digests, h = [], b""
+        for i in range(n_full):
+            h = hashlib.blake2b(
+                h + data[i * bs:(i + 1) * bs].tobytes(),
+                digest_size=16).digest()
+            digests.append(h)
+        return digests
+
+    def _plan(self, prompt: np.ndarray,
+              total_positions: int) -> Tuple[list, List[int], int, bool]:
+        """The admission plan, computed WITHOUT mutating anything:
+        (digests of registerable full blocks, hit block ids, total
+        blocks needed, feasible-right-now). Hit blocks currently in the
+        LRU pool would be claimed, not evicted, so they are excluded
+        from the evictable supply. Memoized per (prompt, total) until
+        the next allocator mutation — the can_map() check and the
+        map_slot() that follows share one digest walk."""
+        key = (prompt.tobytes(), int(total_positions))
+        if self._plan_cache is not None:
+            gen, k, plan = self._plan_cache
+            if gen == self._plan_gen and k == key:
+                return plan
+        p_len = prompt.size
+        total_blocks = self.blocks_for(total_positions)
+        # shareable: full blocks strictly before position p_len-1 (the
+        # suffix prefill always recomputes the last prompt position)
+        shareable = (p_len - 1) // self.block_size
+        digests = self._chain_digests(prompt, p_len // self.block_size) \
+            if self.prefix_cache_enabled else []
+        hit_blocks: List[int] = []
+        lru_hits = 0
+        for i in range(min(shareable, len(digests))):
+            block = self._by_hash.get(digests[i])
+            if block is None:
+                break
+            hit_blocks.append(block)
+            if self._ref[block] == 0:
+                lru_hits += 1
+        feasible = (total_blocks - len(hit_blocks)
+                    <= len(self._free_blocks) + len(self._lru)
+                    - lru_hits)
+        plan = (digests, hit_blocks, total_blocks, feasible)
+        self._plan_cache = (self._plan_gen, key, plan)
+        return plan
+
+    def can_map(self, prompt: np.ndarray, total_positions: int) -> bool:
+        """Feasibility of map_slot() RIGHT NOW, without mutating any
+        allocator state — the engine's pages-aware admission check
+        (stamp/count a request as admitted only when it will fit)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self._plan(prompt, total_positions)[3]
+
+    def map_slot(self, slot: int, prompt: np.ndarray,
+                 total_positions: int) -> Optional[Tuple[np.ndarray, int]]:
+        """Map the pages a request needs into `slot`'s page row.
+
+        prompt: the request's token ids; total_positions: p_len +
+        max_new (every position the sequence may ever write). Leading
+        FULL prompt blocks that hash-match cached ones are shared
+        (refcounted) instead of allocated; the rest come from the free
+        list, evicting LRU cached blocks under pressure. Returns
+        (page_row (max_pages,) int32, prefix_len) — prefix_len is the
+        number of leading positions already resident (a multiple of
+        block_size; the prefill suffix starts there) — or None when the
+        arena cannot hold the request right now (caller keeps it queued;
+        the slot stays allocated and untouched).
+
+        Sharing never includes the block holding position p_len-1: the
+        suffix prefill always recomputes the last prompt position (its
+        logits seed the first token), and the first block the request
+        writes into is private by construction — the copy-on-write
+        guarantee."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p_len = prompt.size
+        if not 1 <= total_positions <= self.max_pages * self.block_size:
+            raise ValueError(
+                f"total_positions {total_positions} out of range "
+                f"[1, {self.max_pages * self.block_size}]")
+        if p_len > total_positions:
+            raise ValueError(
+                f"prompt ({p_len}) longer than total_positions "
+                f"({total_positions})")
+        bs = self.block_size
+        digests, claimed, total_blocks, feasible = \
+            self._plan(prompt, total_positions)
+        if not feasible:
+            return None
+        for b in claimed:
+            self._incref(b)
+        if self.prefix_cache_enabled:
+            self.prefix_hits += len(claimed)
+            self.prefix_misses += (p_len - 1) // bs - len(claimed)
+        blocks = claimed + [self._take_block() for _ in
+                            range(total_blocks - len(claimed))]
+        for b in blocks[len(claimed):]:
+            self._incref(b)
+        # register this prompt's fresh FULL blocks so later admissions
+        # can share them (content is deterministic in the prefix tokens;
+        # the filling prefill dispatch is enqueued before any dispatch
+        # that could read a future hit). A digest already registered to
+        # another block keeps its original mapping.
+        for i in range(len(claimed), len(digests)):
+            if digests[i] not in self._by_hash:
+                self._by_hash[digests[i]] = blocks[i]
+                self._hash_of[blocks[i]] = digests[i]
+        self._slot_blocks[slot] = blocks
+        row = np.full((self.max_pages,), SCRATCH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        self.page_table[slot] = row
+        self._len[slot] = p_len
+        self.peak_blocks_used = max(self.peak_blocks_used,
+                                    self.blocks_used)
+        return row, len(claimed) * bs
 
     # -- per-slot length tracking ------------------------------------------
 
@@ -130,14 +407,20 @@ class SlotKVCache:
 
     @property
     def pool_bytes(self) -> int:
-        """HBM footprint of the pool — constant for the engine's life
+        """HBM footprint of the arena — constant for the engine's life
         (donation reuses the same buffer in place every dispatch)."""
-        import numpy as np
-        return int(np.prod(self.kv.shape)) * self.dtype.itemsize
+        return self._pool_bytes
 
     def occupancy(self) -> Dict[str, int]:
         return {"num_slots": self.num_slots,
                 "active_slots": self.active_count,
                 "free_slots": self.free_count,
                 "live_positions": sum(self._len),
-                "pool_bytes": self.pool_bytes}
+                "pool_bytes": self.pool_bytes,
+                "block_size": self.block_size,
+                "blocks_total": self.blocks_total,
+                "blocks_used": self.blocks_used,
+                "blocks_cached": self.blocks_cached,
+                "peak_blocks_used": self.peak_blocks_used,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses}

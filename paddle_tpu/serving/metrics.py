@@ -9,9 +9,12 @@ first), and engine gauges (active slots, queue depth, shed count).
 
 Storage is `paddle_tpu.observability.metrics`: every EngineMetrics
 instance owns labeled series (`engine="<n>"`) under stable names —
-counters `serving_<name>_total`, gauges `serving_active_slots` /
-`serving_queue_depth`, histograms `serving_ttft_seconds` /
-`serving_tpot_seconds` / `serving_queue_wait_seconds` — so a Prometheus
+counters `serving_<name>_total` (incl. the paged pool's
+`serving_prefix_cache_{hits,misses}_total`), gauges
+`serving_active_slots` / `serving_queue_depth` /
+`serving_kv_blocks_{total,used,cached}`, histograms
+`serving_ttft_seconds` / `serving_tpot_seconds` /
+`serving_queue_wait_seconds` — so a Prometheus
 scrape or `get_registry().snapshot()` sees the serving plane without
 holding the engine, and the bench's p50/p99 rows come registry-sourced.
 `snapshot()` still returns the same plain dict as before (scrapers and
@@ -130,13 +133,23 @@ _HELP = {
     "decode_steps": "batched decode steps executed",
     "prefills": "prefill dispatches",
     "dispatches": "fused decode-chunk dispatches launched",
+    "prefix_cache_hits": "prompt blocks served from the hashed prefix "
+                         "cache instead of re-prefilled",
+    "prefix_cache_misses": "shareable prompt blocks that missed the "
+                           "prefix cache",
     "active_slots": "KV slots currently occupied",
     "queue_depth": "requests waiting for a slot",
+    "kv_blocks_total": "allocatable KV arena blocks (scratch excluded)",
+    "kv_blocks_used": "KV arena blocks referenced by live sequences",
+    "kv_blocks_cached": "unreferenced KV blocks kept warm for "
+                        "prefix-cache hits (LRU-evicted under pressure)",
 }
 
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
-             "decode_steps", "prefills", "dispatches")
-_GAUGES = ("active_slots", "queue_depth")
+             "decode_steps", "prefills", "dispatches",
+             "prefix_cache_hits", "prefix_cache_misses")
+_GAUGES = ("active_slots", "queue_depth", "kv_blocks_total",
+           "kv_blocks_used", "kv_blocks_cached")
 _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tpot": "serving_tpot_seconds",
                "queue_wait": "serving_queue_wait_seconds",

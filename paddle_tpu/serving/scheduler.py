@@ -1,4 +1,4 @@
-"""Continuous-batching step loop over the slot KV pool.
+"""Continuous-batching step loop over the PAGED KV pool.
 
 Orca/vLLM-style iteration-level scheduling on top of gpt_decode's
 prefill/step split: instead of running each request's whole decode loop
@@ -6,26 +6,36 @@ alone (TPU idle between requests, batch-1 latency everywhere), the
 scheduler keeps ONE batched decode dispatch hot over all slots and
 admits new requests into free slots between dispatches:
 
-    admit:  pad the prompt to a shape bucket, gpt_prefill_padded into the
-            slot's pool rows, sample the first token from the prompt's
-            last-position logits — one dispatch per bucket shape.
-    step:   gpt_decode_chunk_slots over the WHOLE pool — `decode_chunk`
+    admit:  map exactly the PAGES the request needs (prompt + budget)
+            into the slot's page-table row — leading prompt blocks that
+            hash-hit the prefix cache are shared in, refcounted, instead
+            of recomputed — then gpt_prefill_pages the remaining SUFFIX
+            (padded to a shape bucket) into the fresh blocks and sample
+            the first token from the last-position logits. One dispatch
+            per suffix-bucket shape; a prefix hit shrinks the suffix
+            into the small buckets, which is the TTFT win.
+    step:   gpt_decode_chunk_pages over the WHOLE pool — `decode_chunk`
             fused decode iterations (fixed batch = num_slots, per-slot
-            positions, in-graph sampling + EOS/budget masking) per
-            dispatch, returning a (chunk, slots) token block in one
-            fetch. Always the same executable, whatever mix of
-            sequences is in flight.
+            positions through the page table, in-graph sampling +
+            EOS/budget masking) per dispatch, returning a (chunk, slots)
+            token block in one fetch. Always the same executable,
+            whatever mix of sequences is in flight.
     retire: finished sequences freeze IN-GRAPH (the chunk kernel's done
-            mask) and just free their slot host-side; the batch never
-            stalls and the next admission's prefill overwrites the rows.
+            mask, which also redirects their ride-along K/V writes to
+            the scratch block — a frozen slot must never dirty blocks
+            that admission has reallocated) and just free their pages
+            host-side; the batch never stalls.
 
 Decode fast path (why this is fast, not just correct):
 
-  * BUFFER DONATION — the KV pool, the per-slot PRNG keys, and the
-    device-resident decode state are donated into every jitted entry
-    point (`donate_argnums`, the executor's `donate=True` discipline),
-    so XLA updates the cache in place instead of materializing a fresh
-    pool per dispatch.
+  * BUFFER DONATION — the block arena, the device page table, the
+    per-slot PRNG keys, and the device-resident decode state are donated
+    into every jitted entry point that consumes them (`donate_argnums`,
+    the executor's `donate=True` discipline), so XLA updates the cache
+    in place instead of materializing a fresh arena per dispatch. The
+    decode chunk reads the page table without donating it (it only
+    changes at admission/release, where it IS donated and updated in
+    place).
   * FUSED MULTI-TOKEN DECODE — one dispatch runs `decode_chunk`
     iterations, amortizing Python + dispatch + host-sync cost by the
     chunk factor while staying O(buckets)+2 executables.
@@ -38,15 +48,23 @@ Decode fast path (why this is fast, not just correct):
     to keep the batch sound.
 
 The decode carry (current token, position, done, remaining budget,
-temperature, eos id — all per-slot) lives ON DEVICE between dispatches;
-the host only touches it at admission (the admit executable resets one
-slot's entries in-graph). Each _Running records `live_from`, the index
-of the first dispatch whose block carries its tokens, so a block fetched
-AFTER a slot was retired and re-admitted is never mis-attributed to the
-new occupant (its tokens start in a later dispatch by construction).
+temperature, eos id — all per-slot) AND the page table live ON DEVICE
+between dispatches; the host only touches them at admission (the
+prefill/admit executables reset one slot's entries in-graph) and at
+cancel (the release executable freezes a cancelled slot and points its
+page row at scratch BEFORE its blocks can be reallocated — EOS/budget
+retirement needs no dispatch because the chunk kernel already froze the
+slot in-graph at the exact finish token). Each _Running records
+`live_from`, the index of the first dispatch whose block carries its
+tokens, so a block fetched AFTER a slot was retired and re-admitted is
+never mis-attributed to the new occupant (its tokens start in a later
+dispatch by construction).
 
 Compile discipline (the point of the fixed shapes): executables =
-len(prefill buckets) + 1 fused decode chunk + 1 admission sampler. The
+len(prefill buckets) + 1 fused decode chunk + 1 admission sampler
+(+ 1 release, compiled lazily on the first cancel). The page table is a
+fixed `(num_slots, max_pages)` int32 array threaded through every
+dispatch, so paging adds ZERO per-request compiles. The
 `compile_count`/`compile_events` hook counts traces as they happen so
 tests can assert O(buckets), not O(requests) — and that the chunk loop
 adds exactly ONE executable whatever decode_chunk is.
@@ -110,9 +128,9 @@ class _Inflight(NamedTuple):
 
 
 class ContinuousBatchingScheduler:
-    """Owns the device state (KV pool, per-slot PRNG keys, decode carry)
-    and the three jitted entry points; the engine above it owns queues
-    and lifecycle."""
+    """Owns the device state (block arena, page table, per-slot PRNG
+    keys, decode carry) and the jitted entry points; the engine above it
+    owns queues and lifecycle."""
 
     def __init__(self, params, cfg, kv: SlotKVCache, buckets: ShapeBuckets,
                  top_k: int = 0, decode_chunk: int = 8,
@@ -136,9 +154,12 @@ class ContinuousBatchingScheduler:
         self._prefill_jit = None
         self._chunk_jit = None
         self._admit_jit = None
+        self._release_jit = None
         # device-resident decode carry: (tokens, ts, done, remaining,
-        # temps, eos_ids), all (S,) — built lazily with the jits
+        # temps, eos_ids), all (S,) — built lazily with the jits, next
+        # to the device page table (all rows scratch until admission)
         self._state = None
+        self._pt = None
         self._inflight: List[_Inflight] = []
         self._launches = 0
         # fired inside _launch, right at enqueue — the engine hangs its
@@ -150,7 +171,6 @@ class ContinuousBatchingScheduler:
         # (jit copies feed arrays at dispatch, so mutation-after-call is
         # safe and admission never allocates)
         self._staging: Dict[int, np.ndarray] = {}
-        self._real_len = np.zeros((1,), np.int32)
 
     # -- jitted entry points ------------------------------------------------
     #
@@ -191,14 +211,17 @@ class ContinuousBatchingScheduler:
                        jnp.zeros((s_dim,), jnp.int32),   # remaining
                        jnp.zeros((s_dim,), jnp.float32),  # temps
                        jnp.full((s_dim,), -1, jnp.int32))  # eos_ids
+        # device page table: every row scratch until its slot admits
+        self._pt = jnp.zeros((s_dim, self.kv.max_pages), jnp.int32)
 
-        def prefill_impl(params, pool, tokens, real_len, slot):
+        def prefill_impl(params, arena, pt, tokens, pfx_len, real_len,
+                         pages, slot):
             self._compile_events.append(f"prefill:L{tokens.shape[1]}")
-            logits, pc = gd.gpt_prefill_padded(
-                params, self.cfg, tokens, real_len, self.kv.max_len)
-            pool = jax.lax.dynamic_update_slice(
-                pool, pc.astype(pool.dtype), (0, 0, slot, 0, 0, 0))
-            return logits[0], pool
+            logits, arena = gd.gpt_prefill_pages(
+                params, self.cfg, tokens, pfx_len, real_len, arena,
+                pages)
+            pt = pt.at[slot].set(pages)
+            return logits[0], arena, pt
 
         def admit_impl(keys, state, slot, seed, logits, temp, pos,
                        max_new, eos_id):
@@ -218,24 +241,40 @@ class ContinuousBatchingScheduler:
                      eos_ids.at[slot].set(eos_id))
             return first, keys, state
 
-        def chunk_impl(params, pool, keys, state):
+        def chunk_impl(params, arena, pt, keys, state):
             self._compile_events.append("decode_chunk")
             tokens, ts, done, remaining, temps, eos_ids = state
-            block, tokens, pool, ts, keys, done, remaining = \
-                gd.gpt_decode_chunk_slots(
-                    params, self.cfg, tokens, pool, ts, keys, temps,
-                    done, remaining, eos_ids, self.decode_chunk,
+            block, tokens, arena, ts, keys, done, remaining = \
+                gd.gpt_decode_chunk_pages(
+                    params, self.cfg, tokens, arena, pt, ts, keys,
+                    temps, done, remaining, eos_ids, self.decode_chunk,
                     sample_fn=self._sample_row)
-            return block, pool, keys, (tokens, ts, done, remaining,
-                                       temps, eos_ids)
+            return block, arena, keys, (tokens, ts, done, remaining,
+                                        temps, eos_ids)
 
-        # donation (the executor's donate=True discipline): the pool, the
-        # key table, and the decode carry are consumed by exactly one
-        # dispatch and replaced by its outputs, so XLA reuses their
-        # buffers in place instead of copying the KV pool every chunk
-        self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(1,))
+        def release_impl(pt, state, slot):
+            # cancel path: the host verdict the in-graph done mask can't
+            # know — freeze the slot and point its page row at scratch
+            # so its ride-along writes stop touching blocks admission
+            # may reallocate
+            self._compile_events.append("release_slot")
+            tokens, ts, done, remaining, temps, eos_ids = state
+            pt = pt.at[slot].set(
+                jnp.zeros((pt.shape[1],), jnp.int32))
+            state = (tokens, ts, done.at[slot].set(True),
+                     remaining.at[slot].set(0), temps, eos_ids)
+            return pt, state
+
+        # donation (the executor's donate=True discipline): the arena,
+        # the page table, the key table, and the decode carry are
+        # consumed by exactly one dispatch and replaced by its outputs,
+        # so XLA reuses their buffers in place instead of copying the
+        # arena every chunk. The chunk READS the page table (no update,
+        # no donation, no copy); prefill/release update it in place.
+        self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(1, 2))
         self._admit_jit = jax.jit(admit_impl, donate_argnums=(0, 1))
-        self._chunk_jit = jax.jit(chunk_impl, donate_argnums=(1, 2, 3))
+        self._chunk_jit = jax.jit(chunk_impl, donate_argnums=(1, 3, 4))
+        self._release_jit = jax.jit(release_impl, donate_argnums=(0, 1))
 
     # -- compile-counter hook ----------------------------------------------
 
@@ -269,42 +308,61 @@ class ContinuousBatchingScheduler:
             buf = self._staging[bucket] = np.zeros((1, bucket), np.int32)
         return buf
 
+    def can_admit(self, prompt: np.ndarray, max_new: int) -> bool:
+        """True when admit() would succeed RIGHT NOW: a page-table row
+        is free and the arena can supply the pages the request needs
+        (prefix-cache hits counted, LRU blocks evictable). Only valid
+        from the driver thread — nothing may mutate the pool between
+        this check and the admit() call."""
+        if self.kv.free_count < 1:
+            return False
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self.kv.can_map(prompt, prompt.size + int(max_new))
+
     def admit(self, req, prompt: np.ndarray, max_new: int,
               temperature: float = 0.0, seed: int = 0,
               eos_id: Optional[int] = None) -> Optional[SequenceEvent]:
-        """Claim a slot, prefill the prompt into it (padded to its shape
-        bucket), sample the first token, and reset the slot's entries in
-        the device decode carry. Returns the first-token event, or None
-        when no slot is free (caller keeps the request queued).
+        """Claim a slot, map the pages the request needs (hash-hit
+        prefix blocks shared in, refcounted), prefill the prompt SUFFIX
+        into the fresh blocks (padded to its shape bucket), sample the
+        first token, and reset the slot's entries in the device decode
+        carry + page table. Returns the first-token event, or None when
+        no slot is free OR the arena is out of pages (caller keeps the
+        request queued).
 
         With a dispatch in flight, everything here just enqueues behind
-        it (the pool/state inputs are its output futures); only the
-        first-token fetch at the end waits."""
+        it (the arena/page-table/state inputs are its output futures);
+        only the first-token fetch at the end waits."""
         self._ensure_jits()
         slot = self.kv.alloc()
         if slot is None:
             return None
         prompt = np.asarray(prompt, np.int32).reshape(1, -1)
         p_len = prompt.shape[1]
-        bucket = self.buckets.bucket_for(p_len)
+        mapped = self.kv.map_slot(slot, prompt[0], p_len + int(max_new))
+        if mapped is None:
+            self.kv.free(slot)           # page shortage: slot untouched
+            return None
+        pages, pfx_len = mapped
+        suffix_len = p_len - pfx_len
+        bucket = self.buckets.bucket_for(suffix_len)
         padded = self._staging_for(bucket)
-        padded[0, :p_len] = prompt[0]
-        padded[0, p_len:] = 0
-        self._real_len[0] = p_len
+        padded[0, :suffix_len] = prompt[0, pfx_len:]
+        padded[0, suffix_len:] = 0
         with profiler.RecordEvent("serving/prefill", bucket=bucket,
                                   prompt_len=p_len, slot=slot,
+                                  prefix_len=pfx_len,
                                   request_id=getattr(req, "request_id",
                                                      None)):
-            logits, pool = self._prefill_jit(
-                self.params, self.kv.kv, padded, self._real_len,
+            logits, self.kv.kv, self._pt = self._prefill_jit(
+                self.params, self.kv.kv, self._pt, padded,
+                np.int32(pfx_len), np.int32(suffix_len), pages,
                 np.int32(slot))
             first, self._keys, self._state = self._admit_jit(
                 self._keys, self._state, np.int32(slot), np.int32(seed),
                 logits, np.float32(temperature), np.int32(p_len),
                 np.int32(max_new),
                 np.int32(-1 if eos_id is None else eos_id))
-        self.kv.kv = pool
-        self.kv.set_length(slot, p_len)
         first = int(first)
         st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
                       live_from=self._launches)
@@ -363,7 +421,8 @@ class ContinuousBatchingScheduler:
                                   chunk=self.decode_chunk,
                                   index=self._launches):
             block, self.kv.kv, self._keys, self._state = self._chunk_jit(
-                self.params, self.kv.kv, self._keys, self._state)
+                self.params, self.kv.kv, self._pt, self._keys,
+                self._state)
         self._inflight.append(_Inflight(block, self._launches,
                                         self.decode_chunk, begin_ns))
         self._launches += 1
@@ -420,16 +479,21 @@ class ContinuousBatchingScheduler:
         return events
 
     def cancel(self, req) -> bool:
-        """Drop a running sequence (client disconnect): free its slot
+        """Drop a running sequence (client disconnect): free its pages
         without emitting further tokens. Tokens the in-flight dispatch
         already produced for it are discarded at collect (the slot is no
-        longer in _running); in-graph the abandoned slot freezes by
-        itself within its old budget (remaining hits zero) and its
-        stale-row writes stay confined to its own slot until the next
-        admission's prefill overwrites them."""
+        longer in _running). Unlike EOS/budget retirement — where the
+        chunk kernel froze the slot in-graph at the exact finish token —
+        a cancel is a host-only verdict, so the release executable
+        freezes the device-side slot and points its page row at scratch
+        BEFORE the freed blocks can be reallocated by a later admission
+        (device dispatch order makes the release run after every
+        already-launched chunk and before that admission's prefill)."""
         for slot, st in list(self._running.items()):
             if st.req is req:
                 del self._running[slot]
+                self._pt, self._state = self._release_jit(
+                    self._pt, self._state, np.int32(slot))
                 self.kv.free(slot)
                 return True
         return False

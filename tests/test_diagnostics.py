@@ -104,6 +104,9 @@ def test_debug_server_serves_all_endpoints():
     assert varz["process"]["pid"] == os.getpid()
     assert varz["tracer"]["enabled"] is False
     assert "metrics" in varz and isinstance(varz["metrics"], dict)
+    # paged-KV rollup: the derived prefix-hit-ratio column is always
+    # present (empty dict when no engine has registered cache counters)
+    assert "prefix_hit_ratio" in varz["serving"]
 
     tracez = _get_json(port, "/tracez")
     assert tracez["count"] == 0 and tracez["spans"] == []

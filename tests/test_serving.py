@@ -624,6 +624,254 @@ def test_dispatch_amortization_metrics(trained):
 
 
 # ---------------------------------------------------------------------------
+# paged pool: capacity, prefix cache, copy-on-write, donation
+# ---------------------------------------------------------------------------
+
+def test_paged_arena_packs_beyond_slab_capacity(trained):
+    """Acceptance pin: mixed short/long admission packs >= 2x the
+    concurrent requests a slab of the SAME arena bytes could hold. 8
+    allocatable blocks of 8 positions = 64 positions = 2 slab slots at
+    max_len 32; the paged pool runs 6 requests concurrently in the same
+    bytes because each maps only the pages its prompt+budget needs."""
+    rng = np.random.RandomState(21)
+    cfg, _ = trained
+    eng = make_engine(trained, num_slots=6, prefill_buckets=(4, 16),
+                      block_size=8, kv_blocks=9)       # 8 + scratch
+    slab_slots = (8 * 8) // eng.kv.max_len             # what a slab held
+    assert slab_slots == 2
+    long_p = rng.randint(0, cfg.vocab_size, (12,)).astype(np.int32)
+    shorts = [rng.randint(0, cfg.vocab_size, (3,)).astype(np.int32)
+              for _ in range(5)]
+    reqs = [eng.submit(long_p, max_new_tokens=8)]      # 20 pos = 3 blocks
+    reqs += [eng.submit(p, max_new_tokens=4) for p in shorts]  # 1 each
+    eng.step()                                         # admit everything
+    assert eng.kv.active_count == 6 >= 2 * slab_slots
+    s = eng.stats()
+    assert s["blocks_used"] == 8 and s["blocks_total"] == 8
+    eng.run_until_drained()
+    assert all(r.finished for r in reqs)
+    np.testing.assert_array_equal(
+        reqs[0].output(), sequential_ref(trained, long_p, 8))
+    for r, p in zip(reqs[1:], shorts):
+        np.testing.assert_array_equal(r.output(),
+                                      sequential_ref(trained, p, 4))
+    assert eng.stats()["peak_blocks_used"] == 8
+    assert eng.stats()["blocks_used"] == 0             # all pages freed
+
+
+def test_prefix_cache_hit_decode_token_identical_to_cold(trained):
+    """Acceptance pin: a prompt re-admitted after its prefix blocks went
+    to the LRU pool maps them back (prefix_hits > 0) and its stream is
+    token-identical to the cold run AND to the sequential path."""
+    rng = np.random.RandomState(22)
+    cfg, _ = trained
+    p = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+    eng = make_engine(trained, prefill_buckets=(4, 16), block_size=4)
+    (cold,) = eng.generate([p], max_new_tokens=6)
+    assert eng.kv.prefix_hits == 0 and eng.kv.prefix_misses == 2
+    assert eng.kv.blocks_cached == 2                   # LRU-warm prefix
+    (warm,) = eng.generate([p], max_new_tokens=6)
+    assert eng.kv.prefix_hits == 2                     # shared, not redone
+    np.testing.assert_array_equal(warm, cold)
+    np.testing.assert_array_equal(warm, sequential_ref(trained, p, 6))
+    s = eng.stats()
+    assert s["prefix_hits"] == 2 and s["prefix_misses"] == 2
+    # registry carries the series for scrapes
+    from paddle_tpu.observability import get_registry
+    snap = get_registry().snapshot()
+    row = next(r for r in
+               snap["serving_prefix_cache_hits_total"]["series"]
+               if r["labels"].get("engine") == s["engine_label"])
+    assert row["value"] == 2
+    eng.close()
+    # close() retires the paged-pool series with the rest of the
+    # engine's labels — no ghost rows for a dead engine
+    snap = get_registry().snapshot()
+    for fam in ("serving_prefix_cache_hits_total",
+                "serving_prefix_cache_misses_total",
+                "serving_kv_blocks_total", "serving_kv_blocks_used",
+                "serving_kv_blocks_cached"):
+        assert not any(r["labels"].get("engine") == s["engine_label"]
+                       for r in snap.get(fam, {}).get("series", []))
+
+
+def test_prefix_cache_off_never_shares(trained):
+    """ServingConfig(prefix_cache=False) disables sharing: identical
+    prompts re-prefill cold every time, streams unchanged."""
+    rng = np.random.RandomState(23)
+    cfg, _ = trained
+    p = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+    eng = make_engine(trained, prefill_buckets=(4, 16), block_size=4,
+                      prefix_cache=False)
+    (a,) = eng.generate([p], max_new_tokens=6)
+    (b,) = eng.generate([p], max_new_tokens=6)
+    assert eng.kv.prefix_hits == 0 and eng.kv.blocks_cached == 0
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, sequential_ref(trained, p, 6))
+
+
+def test_cow_isolation_shared_prefix_divergent_tails(trained):
+    """Copy-on-write pin: two CONCURRENT requests sharing a prefix then
+    diverging never see each other's K/V — the shared full blocks are
+    mapped into both page tables (refcounted) while each divergent tail
+    lives in private blocks, and both streams match the sequential
+    path exactly."""
+    rng = np.random.RandomState(24)
+    cfg, _ = trained
+    pre = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+    x = np.concatenate([pre, [3]]).astype(np.int32)
+    y = np.concatenate([pre, [11]]).astype(np.int32)
+    eng = make_engine(trained, num_slots=2, prefill_buckets=(4, 16),
+                      block_size=4)
+    rx = eng.submit(x, max_new_tokens=7)
+    ry = eng.submit(y, max_new_tokens=7)
+    eng.step()                                         # both admitted
+    assert eng.kv.active_count == 2
+    assert eng.kv.prefix_hits == 2                     # y mapped x's prefix
+    pt = eng.kv.page_table
+    np.testing.assert_array_equal(pt[0][:2], pt[1][:2])  # shared blocks
+    assert pt[0][2] != pt[1][2]                        # private tails
+    eng.run_until_drained()
+    np.testing.assert_array_equal(rx.output(),
+                                  sequential_ref(trained, x, 7))
+    np.testing.assert_array_equal(ry.output(),
+                                  sequential_ref(trained, y, 7))
+
+
+def test_prefix_hits_stay_within_bucket_compile_bound(trained):
+    """Prefix hits shrink the prefill SUFFIX into smaller buckets but
+    never add executables beyond the bucket set: compile count stays
+    O(buckets) + admit + 1 chunk loop through cold AND warm admissions
+    (the page table adds zero per-request compiles)."""
+    rng = np.random.RandomState(25)
+    cfg, _ = trained
+    p = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+    eng = make_engine(trained, prefill_buckets=(4, 16), block_size=4)
+    eng.generate([p], max_new_tokens=5)                # cold: bucket 16
+    eng.generate([p], max_new_tokens=5)                # warm: bucket 4
+    events = eng.scheduler.compile_events
+    assert {e for e in events if e.startswith("prefill")} \
+        <= {"prefill:L4", "prefill:L16"}
+    assert events.count("decode_chunk") == 1
+    assert eng.scheduler.compile_count <= len(eng.buckets) + 2
+
+
+def test_arena_and_page_table_donated_in_place(trained):
+    """Donation pin for the paged pool: the arena consumed by a decode
+    dispatch and the page table consumed by an admission prefill are
+    both invalidated (XLA reused their buffers in place) — stale
+    references raise instead of silently reading dead memory."""
+    cfg, _ = trained
+    eng = make_engine(trained, decode_chunk=2)
+    eng.submit(np.asarray([1, 2, 3], np.int32), max_new_tokens=8)
+    eng.step()                               # admit + first launch
+    stale_arena = eng.kv.kv                  # output future of launch k
+    stale_pt = eng.scheduler._pt             # page table after admit
+    eng.step()                               # launch k+1 donates arena
+    with pytest.raises(RuntimeError):
+        np.asarray(stale_arena)              # deleted: donated away
+    # the chunk READS the page table (no donation there); admission is
+    # where it is updated — and donated
+    eng.submit(np.asarray([4, 5], np.int32), max_new_tokens=2)
+    eng.step()                               # prefill donates + rewrites
+    with pytest.raises(RuntimeError):
+        np.asarray(stale_pt)
+    eng.run_until_drained()                  # engine itself is unharmed
+    assert eng.stats()["completed"] == 2
+
+
+def test_pages_exhausted_queues_then_flows(trained):
+    """An arena too small for every submitted request at once admits by
+    PAGES: head-of-line requests wait for retirements to free blocks,
+    then flow through FIFO — no deadlock, no shed, streams exact."""
+    rng = np.random.RandomState(26)
+    cfg, _ = trained
+    prompts = [rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+               for _ in range(4)]
+    # 4 requests x 2 blocks each, arena of 4 blocks: 2 concurrent max
+    eng = make_engine(trained, num_slots=4, prefill_buckets=(4, 8),
+                      block_size=8, kv_blocks=5, max_len=16)
+    reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.step()
+    assert eng.kv.active_count == 2          # pages, not slots, bound it
+    eng.run_until_drained()
+    assert all(r.finished for r in reqs)
+    assert eng.stats()["shed"] == 0
+    for r, p in zip(reqs, prompts):
+        np.testing.assert_array_equal(r.output(),
+                                      sequential_ref(trained, p, 5))
+
+
+def test_sampled_prefix_hit_stream_chunk_invariant(trained):
+    """Seeded sampling with prefix-cache hits: the warm (shared-prefix)
+    stream is identical to the cold one AND invariant across chunk
+    sizes — mapping cached blocks instead of re-prefilling changes
+    where K/V come from, never what gets sampled."""
+    cfg, _ = trained
+    rng = np.random.RandomState(28)
+    p = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+
+    def run(chunk):
+        eng = make_engine(trained, top_k=5, prefill_buckets=(4, 16),
+                          block_size=4, decode_chunk=chunk)
+        (cold,) = eng.generate([p], max_new_tokens=9, temperature=0.8,
+                               seed=31)
+        (warm,) = eng.generate([p], max_new_tokens=9, temperature=0.8,
+                               seed=31)
+        assert eng.kv.prefix_hits == 2
+        np.testing.assert_array_equal(cold, warm)
+        return warm
+
+    a, b, c = run(1), run(4), run(8)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+def test_prefix_hit_near_full_context_pad_writes_stay_in_scratch(trained):
+    """Regression pin: with a LARGE hit prefix and a small suffix
+    bucket, the padded suffix runs past max_pages*block_size — a
+    clamped page gather would collide pad writes with a real K/V row
+    (position pfx, block max_pages-1, offset 0). Pad writes must land
+    in the scratch block instead, keeping the warm stream exact."""
+    rng = np.random.RandomState(29)
+    cfg, _ = trained
+    p = rng.randint(0, cfg.vocab_size, (30,)).astype(np.int32)
+    eng = make_engine(trained, prefill_buckets=(8, 32), block_size=4,
+                      max_len=32)
+    (cold,) = eng.generate([p], max_new_tokens=2)
+    (warm,) = eng.generate([p], max_new_tokens=2)
+    # pfx = 28 (7 hit blocks), suffix 2 -> bucket 8: pad positions
+    # reach 35 > 31 = last arena position
+    assert eng.kv.prefix_hits == 7
+    np.testing.assert_array_equal(warm, cold)
+    np.testing.assert_array_equal(warm, sequential_ref(trained, p, 2))
+
+
+def test_cancel_releases_pages_on_device(trained):
+    """cancel() frees the slot's pages AND freezes the device-side slot
+    through the release executable, so reallocated blocks are never
+    dirtied by the cancelled slot's ride-along decode — a follow-up
+    request reusing the freed pages stays sequential-identical."""
+    rng = np.random.RandomState(27)
+    cfg, _ = trained
+    eng = make_engine(trained, num_slots=2, prefill_buckets=(4, 8),
+                      block_size=4, kv_blocks=5, max_len=16,
+                      decode_chunk=4)
+    a = eng.submit(rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32),
+                   max_new_tokens=12)                  # 16 pos = 4 blocks
+    eng.step()                               # admitted, chunk in flight
+    assert eng.kv.blocks_used == 4
+    assert eng.cancel(a)
+    eng.step()                               # driver applies the cancel
+    assert eng.kv.blocks_used == 0
+    assert "release_slot" in eng.scheduler.compile_events
+    # the freed pages immediately serve a new request, exactly
+    p2 = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    (out,) = eng.generate([p2], max_new_tokens=8)
+    np.testing.assert_array_equal(out, sequential_ref(trained, p2, 8))
+
+
+# ---------------------------------------------------------------------------
 # kv-cache manager units
 # ---------------------------------------------------------------------------
 
@@ -640,22 +888,84 @@ def test_shape_buckets():
 
 def test_slot_kv_cache_alloc_free(trained):
     cfg, _ = trained
-    kv = SlotKVCache(cfg, num_slots=2, max_len=16)
-    assert kv.kv.shape == (cfg.layers, 2, 2, cfg.heads, 16,
+    kv = SlotKVCache(cfg, num_slots=2, max_len=16, block_size=4)
+    # paged arena: num_blocks defaults to slab-equivalent capacity
+    # (num_slots * pages-per-max_len) + the reserved scratch block 0
+    assert kv.max_pages == 4 and kv.num_blocks == 2 * 4 + 1
+    assert kv.kv.shape == (cfg.layers, 2, 9, cfg.heads, 4,
                            cfg.hidden // cfg.heads)
+    assert kv.blocks_total == 8 and kv.blocks_used == 0
     a, b = kv.alloc(), kv.alloc()
     assert {a, b} == {0, 1} and kv.alloc() is None
     assert kv.free_count == 0 and kv.active_count == 2
-    kv.set_length(a, 5)
+    row, pfx = kv.map_slot(a, np.asarray([1, 2, 3], np.int32), 6)
+    assert pfx == 0 and kv.length(a) == 3
+    mapped = [x for x in row if x != 0]
+    assert len(mapped) == 2 and kv.blocks_used == 2   # 6 positions, bs=4
+    assert (row == kv.page_table[a]).all()
     kv.advance(a)
-    assert kv.length(a) == 6
+    assert kv.length(a) == 4
     kv.free(a)
     assert kv.free_count == 1 and kv.length(a) == 0
-    with pytest.raises(ValueError, match="not allocated"):
+    assert kv.blocks_used == 0
+    assert (kv.page_table[a] == 0).all()              # row back to scratch
+    with pytest.raises(ValueError, match="double free"):
         kv.free(a)
+    with pytest.raises(ValueError, match="out of range"):
+        kv.free(7)
     with pytest.raises(ValueError, match="range"):
         kv.set_length(b, 17)
     assert kv.occupancy()["active_slots"] == 1
+    assert kv.occupancy()["blocks_total"] == 8
+
+
+def test_block_allocator_refcount_lru_eviction(trained):
+    """Prefix-cache block lifecycle: shared blocks are refcounted, drop
+    to the LRU pool when unreferenced, serve hits from there, and are
+    evicted (oldest first) when a fresh allocation needs pages."""
+    cfg, _ = trained
+    kv = SlotKVCache(cfg, num_slots=4, max_len=16, block_size=4,
+                     num_blocks=7)                     # 6 allocatable
+    long = np.arange(1, 12, dtype=np.int32)            # 11 tokens: 2 full
+    a = kv.alloc()
+    row_a, pfx_a = kv.map_slot(a, long, 12)            # 3 blocks, cold
+    assert pfx_a == 0 and kv.prefix_hits == 0 and kv.prefix_misses == 2
+    b = kv.alloc()
+    row_b, pfx_b = kv.map_slot(b, long, 12)            # shares 2 blocks
+    assert pfx_b == 8 and kv.prefix_hits == 2
+    assert list(row_b[:2]) == list(row_a[:2])          # same blocks mapped
+    assert row_b[2] != row_a[2]                        # private tails
+    assert kv.blocks_used == 4                         # 2 shared + 2 tails
+    kv.free(a)
+    # a's shared blocks stay referenced by b; only its tail frees
+    assert kv.blocks_used == 3 and kv.blocks_cached == 0
+    kv.free(b)
+    # now unreferenced but still cached (LRU), not freed
+    assert kv.blocks_used == 0 and kv.blocks_cached == 2
+    c = kv.alloc()
+    row_c, pfx_c = kv.map_slot(c, long, 12)            # hits from LRU
+    assert pfx_c == 8 and kv.prefix_hits == 4
+    assert list(row_c[:2]) == list(row_a[:2])
+    kv.free(c)
+    # a different prompt drains the free list (no eviction needed yet)
+    d = kv.alloc()
+    other = np.arange(50, 66, dtype=np.int32)          # 16 tokens: 4 blocks
+    row_d, _ = kv.map_slot(d, other, 16)
+    assert kv.blocks_used == 4 and kv.blocks_cached == 2
+    # infeasible admission fails cleanly: no partial eviction, no leak
+    e = kv.alloc()
+    assert not kv.can_map(np.arange(3, dtype=np.int32), 9)   # 3 > 2 avail
+    assert kv.map_slot(e, np.arange(3, dtype=np.int32), 9) is None
+    assert kv.blocks_cached == 2 and kv.blocks_used == 4
+    # a feasible one EVICTS the cached prefix blocks under pressure
+    row_e, _ = kv.map_slot(e, np.asarray([7, 8, 9], np.int32), 8)
+    assert kv.blocks_cached == 0 and kv.blocks_used == 6
+    kv.free(e)
+    kv.free(d)
+    # the evicted prefix no longer hits: a fresh `long` maps cold
+    f = kv.alloc()
+    _, pfx_f = kv.map_slot(f, long, 12)
+    assert pfx_f == 0 and kv.prefix_hits == 4          # unchanged
 
 
 # ---------------------------------------------------------------------------

@@ -169,6 +169,12 @@ def test_bench_serving_row_shape():
         assert row["extra"]["dispatches"] > 0
         assert row["extra"]["dispatches_per_token"] <= 1.0 / chunk + 1e-9
         assert row["extra"]["tokens_per_dispatch"] >= chunk - 1e-9
+        # paged-pool columns (paged KV PR): registry-sourced block
+        # occupancy under load + arena-normalized throughput
+        assert row["extra"]["blocks_used"] > 0
+        assert row["extra"]["blocks_total"] > 0
+        assert row["extra"]["tokens_per_s_per_gb"] > 0
+        assert "prefix_hit_rate" in row["extra"]
         # measured tracer overhead rides along (diagnostics PR): the
         # traced re-run really ran (throughput > 0) and the delta is a
         # finite percentage
@@ -177,6 +183,29 @@ def test_bench_serving_row_shape():
     # the traced re-run restored the disabled production default
     import paddle_tpu.observability as obs
     assert not obs.tracing_enabled()
+
+
+def test_bench_serving_shared_prefix_row():
+    """tools/bench_serving --shared-prefix: one row comparing the
+    prefix-cache-off cold baseline against the warm run over one long
+    system prompt — hit rate > 0, shared blocks < cold blocks, and both
+    TTFT cuts present (paged KV PR acceptance row)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_shared_prefix("tiny", requests=4, max_new=4,
+                                           concurrency=4)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "tiny_serving_shared_prefix_c4"
+    assert row["value"] > 0 and row["unit"] == "tokens/s"
+    e = row["extra"]
+    # the warm run really shared: registry-sourced hit rate, and the
+    # shared mapping held fewer arena blocks than the cold run
+    assert e["prefix_hit_rate"] is not None and e["prefix_hit_rate"] > 0
+    assert 0 < e["blocks_used"] < e["blocks_used_cold"]
+    assert e["mean_ttft_ms_cold"] > 0 and e["mean_ttft_ms_warm"] > 0
+    assert isinstance(e["ttft_speedup"], float)
+    assert e["tokens_per_s_per_gb"] > 0 and e["tokens_per_s_cold"] > 0
 
 
 def test_bench_serving_debug_port_flag(capsys, monkeypatch):

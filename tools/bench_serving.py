@@ -26,6 +26,21 @@ measured tracing overhead: the same request mix is re-run with the span
 tracer enabled and the throughput delta lands in
 `extra.trace_overhead_pct` (disabled is the production default, so this
 is the cost of flipping tracing ON).
+
+Paged-pool columns (every row): `blocks_used` (the
+serving_kv_blocks_used gauge sampled under load), `prefix_hit_rate`
+(registry hit/miss counters; None when the mix has no shareable
+blocks), and `tokens_per_s_per_gb` — throughput normalized by the
+arena's HBM footprint, the capacity-efficiency number the paged pool
+exists to raise.
+
+`--shared-prefix` runs the prefix-sharing workload instead: N requests
+over ONE long system prompt (short unique tails), once with the hashed
+prefix cache disabled (the cold baseline) and once enabled — the row
+carries both TTFT cuts, the measured speedup, and the registry-sourced
+hit rate, so the shared-prompt win is a printed number, not a claim:
+
+    python tools/bench_serving.py tiny --shared-prefix
 """
 
 import argparse
@@ -91,6 +106,13 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                                    (prompt_lens[i % len(prompt_lens)],)
                                    ).astype(np.int32)
                        for i in range(requests_per_level)]
+            # fresh draws for the traced re-run: resubmitting the SAME
+            # prompts would prefix-cache-hit and the "tracer overhead"
+            # delta would really be measuring cache wins
+            trace_prompts = [rng.randint(
+                0, cfg.vocab_size,
+                (prompt_lens[i % len(prompt_lens)],)).astype(np.int32)
+                for i in range(requests_per_level)]
             # warm the executables (compiles are O(buckets): one request
             # AT each bucket length warms every prefill shape + the
             # fused decode chunk)
@@ -98,17 +120,24 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                          max_new_tokens=2)
             eng.metrics.unregister()   # retire the warmup series' label
             eng.metrics = pt.serving.EngineMetrics()   # drop warmup rows
+            # the allocator's cumulative cache counters feed the new
+            # series on the next step: drop the warmup's contribution
+            eng.kv.prefix_hits = eng.kv.prefix_misses = 0
             t0 = time.perf_counter()
             reqs = [eng.submit(p, max_new_tokens=max_new)
                     for p in prompts]
+            eng.step()           # admissions land; sample the gauge
+            label = eng.stats()["engine_label"]
+            blocks_used = _registry_counter(label,
+                                            "serving_kv_blocks_used")
             eng.run_until_drained()
             dt = time.perf_counter() - t0
             s = eng.stats()
             tokens = sum(len(r.tokens) for r in reqs)
-            label = s["engine_label"]
             quantiles = _registry_quantiles(label)
             dispatches = _registry_counter(label,
                                            "serving_dispatches_total")
+            hit_rate = _registry_hit_rate(label)
             # disabled-path overhead: same mix again with the tracer ON
             # (executables already warm in both passes, so the delta is
             # the span-recording cost, not compiles)
@@ -117,7 +146,7 @@ def run_model(name, concurrencies=None, requests_per_level=None,
             obs.enable_tracing()
             t0 = time.perf_counter()
             treqs = [eng.submit(p, max_new_tokens=max_new)
-                     for p in prompts]
+                     for p in trace_prompts]
             eng.run_until_drained()
             dt_traced = time.perf_counter() - t0
             if not was_enabled:
@@ -148,6 +177,11 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                         tokens_traced / dt_traced, 2),
                     "trace_overhead_pct": round(
                         (dt_traced - dt) / dt * 100.0, 2),
+                    "blocks_used": blocks_used,
+                    "blocks_total": s["blocks_total"],
+                    "prefix_hit_rate": hit_rate,
+                    "tokens_per_s_per_gb": round(
+                        (tokens / dt) / (s["pool_bytes"] / 2 ** 30), 2),
                     **quantiles,
                 },
             })
@@ -157,14 +191,124 @@ def run_model(name, concurrencies=None, requests_per_level=None,
 
 
 def _registry_counter(engine_label, family):
-    """One labeled counter value from the registry snapshot — the same
-    number a /metrics scrape reports for this engine."""
+    """One labeled counter/gauge value from the registry snapshot — the
+    same number a /metrics scrape reports for this engine."""
     from paddle_tpu.observability import get_registry
 
     snap = get_registry().snapshot()
     series = next((r for r in snap.get(family, {}).get("series", [])
                    if r["labels"].get("engine") == engine_label), None)
     return int(series["value"]) if series else 0
+
+
+def _registry_hit_rate(engine_label):
+    """Prefix-cache hit rate from the registry counters (the same
+    numbers /varz derives its ratio column from); None when the
+    workload had no shareable blocks at all."""
+    hits = _registry_counter(engine_label,
+                             "serving_prefix_cache_hits_total")
+    misses = _registry_counter(engine_label,
+                               "serving_prefix_cache_misses_total")
+    return round(hits / (hits + misses), 4) if hits + misses else None
+
+
+# shared-prefix workload geometry per model: (prefill buckets, block
+# size, system-prompt length, unique-tail length). The system prompt
+# fills most of the LARGE bucket so a cold admission pays the big
+# prefill while a prefix-cache hit prefills only the tail through the
+# SMALL bucket — the TTFT gap the row measures.
+SHARED_PREFIX = {
+    "tiny": ((32, 128), 16, 96, 8),
+    "gpt2": ((64, 256), 32, 224, 16),
+}
+
+
+def run_shared_prefix(name, requests=None, max_new=16, concurrency=None):
+    """The prefix-sharing workload: `requests` generate calls over ONE
+    long system prompt with short unique tails, run twice on fresh
+    engines — prefix cache OFF (every admission re-prefills the system
+    prompt: the cold baseline) then ON (admissions after the first map
+    the cached prefix blocks and prefill only the tail). One JSON row
+    with both TTFT cuts + the registry-sourced hit rate and block
+    occupancy."""
+    import paddle_tpu as pt
+
+    gpt_kwargs, default_cc, _, _ = MODELS[name]
+    buckets, block_size, sys_len, tail_len = SHARED_PREFIX[name]
+    cc = concurrency or max(default_cc)
+    requests = requests or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    cfg, params = build_params(gpt_kwargs)
+    max_len = max(buckets)          # table keeps sys+tail+max_new inside
+    rng = np.random.RandomState(0)
+    sys_prompt = rng.randint(0, cfg.vocab_size, (sys_len,))
+    prompts = [np.concatenate(
+        [sys_prompt, rng.randint(0, cfg.vocab_size, (tail_len,))]
+        ).astype(np.int32) for _ in range(requests)]
+    results = {}
+    for enabled in (False, True):
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(num_slots=cc, max_queue=requests,
+                                     prefill_buckets=buckets,
+                                     max_len=max_len,
+                                     block_size=block_size,
+                                     prefix_cache=enabled))
+        # warm every suffix-bucket executable + the decode chunk —
+        # with RANDOM prompts, not constants: a repeated warmup prompt
+        # would hit its own prefix cache, shrink into a smaller suffix
+        # bucket, and leave the LARGE bucket to compile inside the
+        # timed run
+        wrng = np.random.RandomState(12345)
+        eng.generate([wrng.randint(0, cfg.vocab_size, (max(1, b - 2),))
+                      .astype(np.int32) for b in buckets],
+                     max_new_tokens=2)      # b-2 still buckets to b
+        eng.metrics.unregister()
+        eng.metrics = pt.serving.EngineMetrics()
+        eng.kv.prefix_hits = eng.kv.prefix_misses = 0  # warmup stats out
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.step()
+        label = eng.stats()["engine_label"]
+        blocks_used = _registry_counter(label, "serving_kv_blocks_used")
+        eng.run_until_drained()
+        dt = time.perf_counter() - t0
+        s = eng.stats()
+        results[enabled] = {
+            "dt": dt,
+            "tokens": sum(len(r.tokens) for r in reqs),
+            "mean_ttft": s["mean_ttft"],
+            "blocks_used": blocks_used,
+            "hit_rate": _registry_hit_rate(label),
+            "pool_bytes": s["pool_bytes"],
+        }
+        eng.close()
+    cold, warm = results[False], results[True]
+    return [{
+        "metric": f"{name}_serving_shared_prefix_c{cc}",
+        "value": round(warm["tokens"] / warm["dt"], 2),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {
+            "requests": requests,
+            "sys_prompt_len": sys_len,
+            "tail_len": tail_len,
+            "block_size": block_size,
+            "max_new": max_new,
+            "prefix_hit_rate": warm["hit_rate"],
+            "blocks_used": warm["blocks_used"],
+            "blocks_used_cold": cold["blocks_used"],
+            "mean_ttft_ms_warm": round(warm["mean_ttft"] * 1e3, 2),
+            "mean_ttft_ms_cold": round(cold["mean_ttft"] * 1e3, 2),
+            "ttft_speedup": round(
+                cold["mean_ttft"] / warm["mean_ttft"], 3)
+                if warm["mean_ttft"] else None,
+            "tokens_per_s_cold": round(cold["tokens"] / cold["dt"], 2),
+            "tokens_per_s_per_gb": round(
+                (warm["tokens"] / warm["dt"])
+                / (warm["pool_bytes"] / 2 ** 30), 2),
+        },
+    }]
 
 
 def _registry_quantiles(engine_label):
@@ -199,6 +343,10 @@ def main(argv=None):
                     help="fused decode iterations per dispatch to sweep "
                          "(default: 1 8 — per-token baseline vs fast "
                          "path; token streams are identical at every K)")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="run the prefix-sharing workload instead: N "
+                         "requests over one long system prompt, prefix "
+                         "cache off (cold) vs on, TTFT compared per row")
     args = ap.parse_args(argv)
     unknown = [m for m in args.models if m not in MODELS]
     if unknown:
@@ -216,8 +364,10 @@ def main(argv=None):
         print(f"debug server: http://127.0.0.1:{port}", file=sys.stderr)
     try:
         for name in args.models or list(MODELS):
-            for row in run_model(name,
-                                 decode_chunks=tuple(args.decode_chunk)):
+            rows = run_shared_prefix(name) if args.shared_prefix \
+                else run_model(name,
+                               decode_chunks=tuple(args.decode_chunk))
+            for row in rows:
                 print(json.dumps(row), flush=True)
     finally:
         if server_started:
