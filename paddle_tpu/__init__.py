@@ -24,6 +24,7 @@ from . import regularizer
 from . import clip
 from . import io
 from . import metrics
+from . import analysis
 from . import observability
 from . import profiler
 from . import contrib

@@ -140,6 +140,21 @@ def rewrite_bf16(program: Program,
         else:
             new_ops.append(op)
     blk.ops = new_ops
+    # Re-infer shapes/dtypes from the actual lowering rules over the
+    # rewritten block: the slot-level bookkeeping above marks whitelist
+    # outputs bf16 wholesale, but some rules keep side outputs in f32
+    # (layer_norm's Mean/Variance), and GRAY ops (neither list) compute
+    # in whatever dtype flows in without any declared-metadata update —
+    # stale declared dtypes that the static verifier flags as PT-E006
+    # (and that would mislead exports / feed casting). One pass of the
+    # real inference restores the one-rule-serves-all invariant.
+    from ..framework.registry import (infer_op_shapes, _HOST_OPS, _MACROS)
+    for op in blk.ops:
+        t = op.type
+        if t in ("feed", "fetch") or t in _HOST_OPS or t in _MACROS \
+                or t.endswith("_grad"):
+            continue
+        infer_op_shapes(op, blk)
     program._bump_version()
     return program
 

@@ -19,13 +19,21 @@ paddle/fluid/framework/grad_op_desc_maker.h):
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .core import (Block, Operator, Parameter, Program, Variable,
                    grad_var_name, GRAD_SUFFIX)
 from .registry import get_op_def
 
-__all__ = ["append_backward", "gradients"]
+__all__ = ["append_backward", "gradients", "GradientDropWarning"]
+
+
+class GradientDropWarning(UserWarning):
+    """A gradient the loss demanded was dropped at a not-differentiable
+    op (grad_free=False) whose inputs happened to be non-differentiable —
+    the runtime twin of the static analyzer's PT-W104: both fire on the
+    same case (a gradient flows into an op that cannot produce one)."""
 
 
 def _find_loss_op_idx(block: Block, loss: Variable) -> int:
@@ -115,16 +123,27 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
                         if _var_wants_grad(block, n, no_grad_set)
                         and block.has_var(n)
                         and str(block.var(n).dtype).startswith("float")]
+            dropped = sorted(n for n in op.output_names()
+                             if accum.contribs.get(n))
             if diff_ins:
                 raise RuntimeError(
                     f"op {op.type!r} lies on the loss path (the loss "
-                    f"depends on outputs {sorted(n for n in op.output_names() if accum.contribs.get(n))}) "
+                    f"depends on outputs {dropped}) "
                     f"but has no gradient; inputs {diff_ins} would "
                     f"silently receive no gradient. Mark them "
                     f"stop_gradient=True if that is intended"
                     + (" (for While loops, pass max_trip_count to make "
                        "them differentiable)" if op.type == "while"
                        else ""))
+            # no differentiable input survives to raise for, but a
+            # gradient WAS demanded of this op and is being dropped —
+            # warn with op + var provenance (PT-W104's runtime twin;
+            # before this the drop was silent)
+            warnings.warn(GradientDropWarning(
+                f"op {op.type!r}: gradient demanded for output(s) "
+                f"{dropped} is dropped — the op is not differentiable "
+                f"(grad_free=False); everything upstream receives no "
+                f"gradient [PT-W104]"), stacklevel=3)
         return []
 
     if opdef.grad_maker is not None:
@@ -198,6 +217,38 @@ def _make_grad_op_descs(op: Operator, block: Block, accum: _GradAccum,
     return [Operator(block, op.type + "_grad", ins, outs, dict(op.attrs))]
 
 
+def _prune_dead_grad_ops(grad_ops: List[Operator],
+                         keep_names: Set[str]) -> List[Operator]:
+    """Demand-driven DCE over the emitted grad ops.
+
+    The reverse sweep emits a grad op for every op on the loss path, but
+    a chain whose upstream ends at a not-differentiable op (e.g. the
+    grads of a sequence_mask output) is computed and then dropped — dead
+    trace weight the verifier flags as PT-W101. Keep only ops whose
+    outputs (transitively) reach a demanded gradient: a parameter's, or
+    any leaf var's (data/feed vars — op_test fetches those). Consumers
+    appear after producers in `grad_ops`, so one reversed pass suffices.
+    """
+    needed = set(keep_names)
+    kept: List[Operator] = []
+    for gop in reversed(grad_ops):
+        if any(n and n in needed for n in gop.output_names()):
+            needed.update(n for n in gop.input_names() if n)
+            kept.append(gop)
+    return list(reversed(kept))
+
+
+def _leaf_grad_demand(accum: _GradAccum, produced_fwd: Set[str]) -> Set[str]:
+    """Grad contribution names for LEAF forward vars (not produced by any
+    forward op: params, data/feed vars) — the terminal demand of the
+    backward pass."""
+    keep: Set[str] = set()
+    for v, lst in accum.contribs.items():
+        if v not in produced_fwd:
+            keep.update(n for n in lst if n)
+    return keep
+
+
 def _apply_error_clips(op, block, accum, grad_ops):
     """error_clip (reference clip.py ErrorClipByValue via
     _callback_lookup_): a forward var carrying .error_clip has its grad
@@ -232,6 +283,7 @@ def append_backward(loss: Variable,
 
     loss_idx = _find_loss_op_idx(block, loss)
     path = _collect_path_ops(block, loss_idx)
+    produced_fwd = {n for op in block.ops for n in op.output_names() if n}
 
     accum = _GradAccum(block)
 
@@ -264,6 +316,10 @@ def append_backward(loss: Variable,
     for p in params:
         param_final[p.name] = accum.finalize(p.name)
     grad_ops.extend(accum.pending_ops)
+
+    keep = _leaf_grad_demand(accum, produced_fwd)
+    keep.update(g for g in param_final.values() if g)
+    grad_ops = _prune_dead_grad_ops(grad_ops, keep)
 
     for gop in grad_ops:
         gop.attrs.setdefault("op_role", "backward")
@@ -303,6 +359,7 @@ def gradients(targets: Sequence[Variable], inputs: Sequence[Variable],
             "target_gradients")
     block = targets[0].block
     no_grad = set(no_grad_set or ())
+    produced_fwd = {n for op in block.ops for n in op.output_names() if n}
 
     # union of the targets' producing paths, in forward order
     idxs = [_find_loss_op_idx(block, t) for t in targets]
@@ -340,6 +397,11 @@ def gradients(targets: Sequence[Variable], inputs: Sequence[Variable],
     accum.pending_ops.clear()
     finals = [accum.finalize(v.name) for v in inputs]
     grad_ops.extend(accum.pending_ops)
+
+    keep = _leaf_grad_demand(accum, produced_fwd)
+    keep.update(f for f in finals if f)
+    grad_ops = _prune_dead_grad_ops(grad_ops, keep)
+
     for gop in grad_ops:
         gop.attrs.setdefault("op_role", "backward")
         block.ops.append(gop)

@@ -17,12 +17,18 @@ __all__ = ["program_to_code", "draw_program_graphviz",
            "pprint_block_codes", "draw_block_graphviz"]
 
 
-def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
+def program_to_code(program: Program, skip_op_callstack: bool = True,
+                    diagnostics=None) -> str:
     """Readable text form of every block — the COMPACT kind-annotated
     format ("param x: ..."). The fluid-styled pseudo-assembly printers
     (block_to_code/op_to_code/variable_to_code below) are the reference
     program_utils.py format; the two formats are intentionally distinct,
-    both pinned by tests."""
+    both pinned by tests.
+
+    diagnostics — an analysis.DiagnosticReport (or list of Diagnostics):
+    flagged ops and vars are annotated inline (`!! PT-...`), so the
+    debugger dump and tools/check_program.py tell one story."""
+    op_diags, var_diags, tail = _index_diagnostics(diagnostics)
     lines = []
     for blk in program.blocks:
         lines.append(f"// block {blk.idx} (parent {blk.parent_idx})")
@@ -33,6 +39,8 @@ def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
             extra = " [selected_rows]" if v.type == "selected_rows" else ""
             lines.append(f"  {kind} {v.name}: {v.dtype}{list(v.shape or [])}"
                          f"{extra}")
+            for d in var_diags.get((blk.idx, v.name), ()):
+                lines.append(f"    !! {d.code} [{d.severity}]: {d.message}")
         for i, op in enumerate(blk.ops):
             ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items() if v)
             outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items() if v)
@@ -41,7 +49,31 @@ def program_to_code(program: Program, skip_op_callstack: bool = True) -> str:
             role = op.attrs.get("op_role", "forward")
             lines.append(f"  [{i}] {op.type}({ins}) -> {outs}"
                          f"  // {role} {attrs if attrs else ''}".rstrip())
+            for d in op_diags.get((blk.idx, i), ()):
+                var = f" (var {d.var!r})" if d.var else ""
+                lines.append(f"    !! {d.code} [{d.severity}]{var}: "
+                             f"{d.message}")
+    if tail:
+        lines.append(tail)
     return "\n".join(lines)
+
+
+def _index_diagnostics(diagnostics):
+    """(block, op_idx)->diags, (block, var)->op-less diags, summary line."""
+    if diagnostics is None:
+        return {}, {}, ""
+    diags = getattr(diagnostics, "diagnostics", diagnostics)
+    op_diags, var_diags = {}, {}
+    for d in diags:
+        if d.op_idx is not None:
+            op_diags.setdefault((d.block_idx, d.op_idx), []).append(d)
+        elif d.var:
+            var_diags.setdefault((d.block_idx, d.var), []).append(d)
+    n_err = sum(1 for d in diags if d.severity == "error")
+    n_warn = len(list(diags)) - n_err
+    tail = (f"// verifier: {n_err} error(s), {n_warn} warning(s)"
+            if diags else "// verifier: clean")
+    return op_diags, var_diags, tail
 
 
 def _block_dot(blk, highlights=()) -> str:

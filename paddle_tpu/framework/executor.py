@@ -213,6 +213,10 @@ class Executor:
             cache_capacity if cache_capacity is not None
             else _os.environ.get("FLAGS_executor_cache_capacity", "64"))
         self.compile_count = 0  # distinct compilations (tests/telemetry)
+        # run(validate=True) pre-flight reports, keyed like the compile
+        # cache (program uid, version, feed set, fetch list); LRU via
+        # the shared _memo helper
+        self._validated: "OrderedDict[Any, Any]" = OrderedDict()
         self._compiled_uids = set()  # programs ever compiled, cache-
         # residency-independent: a miss for a known uid whose entries
         # were all LRU-evicted is a recompile (cause="evicted"), not a
@@ -241,7 +245,8 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
             scope: Optional[Scope] = None,
-            return_numpy: bool = True):
+            return_numpy: bool = True,
+            validate: bool = False):
         # Progress heartbeat for the stall watchdog (observability/
         # watchdog.py): inflight goes up while a run is on the device,
         # runs_total advances when it returns. Busy-with-no-progress for
@@ -265,13 +270,32 @@ class Executor:
             # scope is ambient, the span carries its request_id
             with trace_span("executor/run", "executor"):
                 out = self._run_impl(program, feed, fetch_list, scope,
-                                     return_numpy)
+                                     return_numpy, validate)
             runs.inc()
             return out
         finally:
             inflight.dec()
 
-    def _run_impl(self, program, feed, fetch_list, scope, return_numpy):
+    def _validate_preflight(self, program, feed, fetch_names):
+        """Opt-in static verification before lowering/compiling: a
+        malformed program raises ProgramVerificationError with the
+        diagnostic (code + op + var), not an XLA/jit traceback. Memoized
+        per (program, version, feed set, fetch list) so steady-state runs
+        pay two dict lookups; verification itself is read-only, so the
+        compile cache and program bytes are untouched either way."""
+        from ..analysis import verify_program
+        key = (getattr(program, "_uid", id(program)), program.version,
+               frozenset(feed), tuple(fetch_names))
+        cached = self._memo(
+            self._validated, key,
+            lambda: verify_program(program, fetch_list=fetch_names,
+                                   feed_names=set(feed)))
+        if not cached.ok:
+            from ..analysis import ProgramVerificationError
+            raise ProgramVerificationError(cached, program)
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  validate=False):
         from ..compiler import CompiledProgram  # lazy import
 
         reg = get_registry()
@@ -285,6 +309,15 @@ class Executor:
 
         scope = scope or global_scope()
         feed = feed or {}
+
+        # pre-flight BEFORE any dispatch branch — the PS path below
+        # re-enters run() for the jitted half and must not silently
+        # bypass a requested validation
+        if validate:
+            self._validate_preflight(
+                program, feed,
+                [f.name if isinstance(f, Variable) else f
+                 for f in (fetch_list or [])])
 
         # parameter-server trainer program: jitted step bracketed by host
         # push/pull through the native KV service (transpiler/

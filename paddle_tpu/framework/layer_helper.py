@@ -87,6 +87,34 @@ class LayerHelper:
                                         if not is_bias else f"{self.name}.b")
         init = attr.initializer or default_initializer or (
             Constant(0.0) if is_bias else Xavier())
+        # shared param (a named ParamAttr reused across layers, e.g. a
+        # tied embedding): return the existing Parameter instead of
+        # re-creating it — re-creating also re-appended its init op, so
+        # the startup program initialized the same param N times (dead
+        # writes, flagged by the verifier as PT-W103)
+        existing = self.main_program.global_block.vars.get(name)
+        if existing is not None:
+            from .core import Parameter
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    f"var {name!r} already exists and is not a Parameter")
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    f"shared parameter {name!r} redefined with shape "
+                    f"{list(shape)} != existing {list(existing.shape)}")
+            from .core import convert_np_dtype
+            if existing.dtype != convert_np_dtype(dtype):
+                raise ValueError(
+                    f"shared parameter {name!r} redefined with dtype "
+                    f"{dtype!r} != existing {existing.dtype!r}")
+            if existing.trainable != attr.trainable:
+                raise ValueError(
+                    f"shared parameter {name!r} redefined with "
+                    f"trainable={attr.trainable} != existing "
+                    f"trainable={existing.trainable}")
+            # initializer / regularizer / learning_rate: first definition
+            # wins (the shared-ParamAttr contract — one param, one init)
+            return existing
         # parameters always live in the GLOBAL block, even when the layer
         # is built inside a control-flow sub-block (reference framework.py:
         # Parameter is global-block-bound) — sub-block vars are loop-local

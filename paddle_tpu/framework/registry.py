@@ -35,6 +35,24 @@ __all__ = ["OpDef", "register_op", "get_op_def", "has_op_def",
 DUMMY_BATCH = 8191
 
 
+def shape_spec(shape, dtype):
+    """jax.ShapeDtypeStruct from declared var metadata, -1 (batch) dims
+    substituted with DUMMY_BATCH — the one spec convention shared by
+    build-time inference here and the static verifier's read-only
+    shape walk (analysis/analyzers.py)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(
+        tuple(DUMMY_BATCH if d == -1 else d for d in shape),
+        jnp.dtype(dtype))
+
+
+def concrete_to_batch(shape):
+    """Map DUMMY_BATCH dims of an inferred shape back to -1 (apply only
+    when some input carried a -1 dim)."""
+    return tuple(-1 if d == DUMMY_BATCH else d for d in shape)
+
+
 @dataclass
 class OpDef:
     type: str
@@ -356,7 +374,6 @@ def infer_op_shapes(op: Operator, block: Block) -> None:
     back to -1 in the outputs.
     """
     import jax
-    import jax.numpy as jnp
 
     if op.type in ("feed", "fetch"):
         return
@@ -376,9 +393,8 @@ def infer_op_shapes(op: Operator, block: Block) -> None:
             if v.shape is None:
                 raise RuntimeError(f"input var {n!r} of op {op.type} has no "
                                    "shape; declare it first")
-            shape = tuple(DUMMY_BATCH if d == -1 else d for d in v.shape)
             saw_dummy = saw_dummy or (-1 in v.shape)
-            lst.append(jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype)))
+            lst.append(shape_spec(v.shape, v.dtype))
         specs[slot] = lst
 
     ctx = LowerContext(abstract=True)
@@ -405,7 +421,7 @@ def infer_op_shapes(op: Operator, block: Block) -> None:
                 name=n)
             shape = tuple(sds.shape)
             if saw_dummy:
-                shape = tuple(-1 if d == DUMMY_BATCH else d for d in shape)
+                shape = concrete_to_batch(shape)
             v.shape = shape
             v.dtype = str(np.dtype(sds.dtype))
 

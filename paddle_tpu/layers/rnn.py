@@ -41,9 +41,13 @@ def _rnn_op(op_type, input, size, lengths, h0, c0, param_attr, bias_attr,
 
 def dynamic_lstm(input, size, sequence_length=None, h0=None, c0=None,
                  param_attr=None, bias_attr=None, use_peepholes=False,
-                 is_reverse=False, name=None):
+                 is_reverse=False, name=None, need_cell=True):
     """fluid.layers.dynamic_lstm analog. `size` = 4*hidden (as in fluid);
-    input must be pre-projected to [b, s, 4*hidden] by an fc."""
+    input must be pre-projected to [b, s, 4*hidden] by an fc.
+    need_cell=False returns (h, None) on every path, and on the
+    is_reverse path also skips building the cell-state un-reverse op —
+    callers that discard the cell (the bidirectional wrapper) would
+    otherwise build a dead op (PT-W101)."""
     if is_reverse:
         from .sequence import sequence_reverse
         input = sequence_reverse(input, sequence_length)
@@ -54,8 +58,8 @@ def dynamic_lstm(input, size, sequence_length=None, h0=None, c0=None,
     if is_reverse:
         from .sequence import sequence_reverse
         h = sequence_reverse(h, sequence_length)
-        c = sequence_reverse(c, sequence_length)
-    return h, c
+        c = sequence_reverse(c, sequence_length) if need_cell else None
+    return h, (c if need_cell else None)
 
 
 def dynamic_gru(input, size, sequence_length=None, h0=None,
@@ -83,18 +87,22 @@ def simple_rnn(input, size, sequence_length=None, h0=None, param_attr=None,
 
 def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
          num_layers=1, dropout_prob=0.0, is_bidirec=False,
-         sequence_length=None, name=None):
+         sequence_length=None, name=None, last_states=True):
     """Multi-layer (optionally bidirectional) LSTM — the cudnn_lstm analog
     (reference: layers/nn.py lstm). Returns (out, last_h, last_c): out is
     [b, s, h*(2 if bidirec else 1)]; last_h/last_c are the top layer's
-    forward-direction final states [b, h]."""
+    forward-direction final states [b, h]. last_states=False skips
+    building the final-state extraction ops and returns (out, None,
+    None) — unlike the reference's fused cudnn op, our decomposed form
+    pays real (dead) ops for discarded states, which the static verifier
+    flags as PT-W101."""
     from . import nn as nn_layers
     from .tensor import concat
     from . import nn
     from .sequence import sequence_last_step
 
     x = input
-    cell = None
+    fwd = cell = None
     for layer in range(num_layers):
         proj = nn_layers.fc(x, 4 * hidden_size, num_flatten_dims=2,
                             bias_attr=False)
@@ -105,12 +113,16 @@ def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
                                   bias_attr=False)
             bwd, _ = dynamic_lstm(proj_b, 4 * hidden_size,
                                   sequence_length=sequence_length,
-                                  is_reverse=True)
+                                  is_reverse=True, need_cell=False)
             x = concat([fwd, bwd], axis=2)
         else:
             x = fwd
         if dropout_prob > 0 and layer < num_layers - 1:
             x = nn.dropout(x, dropout_prob)
-        last_h = sequence_last_step(fwd, sequence_length)
-        last_c = sequence_last_step(cell, sequence_length)
+    if not last_states:
+        return x, None, None
+    # top layer only — the per-layer extraction this loop used to do
+    # built dead ops for every non-top layer (PT-W101)
+    last_h = sequence_last_step(fwd, sequence_length)
+    last_c = sequence_last_step(cell, sequence_length)
     return x, last_h, last_c

@@ -181,7 +181,8 @@ def label_semantic_roles(word_vocab: int, label_num: int, seq_len: int,
 
     out, _, _ = layers.lstm(x, hidden_size=hidden, num_layers=depth,
                             is_bidirec=True,
-                            sequence_length=layers.squeeze(lens, axes=[1]))
+                            sequence_length=layers.squeeze(lens, axes=[1]),
+                            last_states=False)
     logits = layers.fc(out, label_num, num_flatten_dims=2)
     ce = layers.softmax_with_cross_entropy(
         logits, layers.unsqueeze(target, axes=[2]))

@@ -111,9 +111,13 @@ def apply_recompute(program, checkpoints: Sequence[str]) -> int:
         blk.create_var(name=bar[n], shape=src.shape, dtype=src.dtype,
                        stop_gradient=True)
     pos = first_bwd
+    # infer_shape=True: the barrier's lowering canonicalizes dtypes
+    # (int64 ids come out int32 with x64 off), so the declared metadata
+    # must come from the rule, not a copy of the source var's — a copied
+    # int64 here is stale (verifier: PT-E006)
     blk.insert_op(pos, "optimization_barrier", {"X": ext},
                   {"Out": [bar[n] for n in ext]},
-                  {"op_role": "backward"}, infer_shape=False)
+                  {"op_role": "backward"}, infer_shape=True)
     pos += 1
 
     # clone outputs all get fresh names, but only NON-kept ones are
@@ -122,7 +126,13 @@ def apply_recompute(program, checkpoints: Sequence[str]) -> int:
     # stays the saved one)
     ren_all, ren = {}, {}
     for i in clone_idx:
-        for n in fwd[i].output_names():
+        op = fwd[i]
+        # a dropout clone is a dropout_mask_apply that replays the saved
+        # Mask — it produces only Out; declaring a Mask@RECOMPUTE var
+        # nothing ever writes leaves an orphan (verifier: PT-W102)
+        out_names = op.output("Out") if op.type == "dropout" \
+            else op.output_names()
+        for n in out_names:
             if n:
                 ren_all[n] = n + _SUFFIX
                 if not is_keep(n):

@@ -1,0 +1,679 @@
+"""Static program verifier (paddle_tpu.analysis) tests.
+
+Structure:
+  * a seeded DEFECT CORPUS — one minimal program per diagnostic code:
+    the positive half asserts the code fires with the right op/var, the
+    repaired twin asserts it verifies clean of that code;
+  * self-audit — every book/GPT model family program verifies fully clean
+    (the satellite that caught the shared-param double-init, the dead
+    backward chains, and the stale AMP/recompute metadata this PR fixed);
+  * surfaces — Program.validate() / Executor.run(validate=True) /
+    check_program.py CLI, plus the read-only (no mutation) pins;
+  * agreement — backward.py's GradientDropWarning and the analyzer's
+    PT-W104 fire on the SAME case.
+"""
+
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers
+from paddle_tpu.analysis import ProgramVerificationError, verify_program
+from paddle_tpu.framework.backward import GradientDropWarning
+from paddle_tpu.framework.registry import DUMMY_BATCH, register_op
+
+
+# test-only op: a pass-through that claims it is NOT differentiable and
+# NOT provably grad-free — the PT-W104 / GradientDropWarning probe
+@register_op("t_nondiff_pass", not_differentiable=True)
+def _t_nondiff_pass(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("t_nondiff_free", not_differentiable=True, grad_free=True)
+def _t_nondiff_free(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+def _codes(report):
+    return {d.code for d in report.diagnostics}
+
+
+# ---------------------------------------------------------------------------
+# defect corpus
+# ---------------------------------------------------------------------------
+
+class TestDefectCorpus:
+    # -- PT-E001 undefined var ---------------------------------------------
+    def test_e001_undefined_var(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("relu", {"X": ["ghost"]}, {"Out": ["o"]},
+                      infer_shape=False)
+        rep = verify_program(p)
+        d, = rep.by_code("PT-E001")
+        assert (d.var, d.op_idx, d.op_type) == ("ghost", 0, "relu")
+        assert not rep.ok
+
+    def test_e001_negative_declared_data(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="ghost", shape=(4,), is_data=True)
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("relu", {"X": ["ghost"]}, {"Out": ["o"]},
+                      infer_shape=False)
+        rep = verify_program(p)
+        assert "PT-E001" not in _codes(rep) and rep.ok
+
+    # -- PT-E002 read before write -----------------------------------------
+    def _rbw_program(self, initialized):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,))
+        blk.create_var(name="o", shape=(4,))
+        if initialized:
+            blk.append_op("fill_constant", {}, {"Out": ["x"]},
+                          {"shape": [4], "dtype": "float32", "value": 1.0},
+                          infer_shape=False)
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]},
+                      infer_shape=False)
+        return p
+
+    def test_e002_read_before_write(self):
+        rep = verify_program(self._rbw_program(False))
+        d, = rep.by_code("PT-E002")
+        assert d.var == "x" and d.op_type == "relu"
+
+    def test_e002_negative_initialized(self):
+        rep = verify_program(self._rbw_program(True))
+        assert "PT-E002" not in _codes(rep) and rep.ok
+
+    # -- PT-E003 op cycle ---------------------------------------------------
+    def _cycle_program(self, seeded):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="a", shape=(4,))
+        blk.create_var(name="b", shape=(4,))
+        if seeded:
+            blk.append_op("fill_constant", {}, {"Out": ["a"]},
+                          {"shape": [4], "dtype": "float32", "value": 0.5},
+                          infer_shape=False)
+        blk.append_op("relu", {"X": ["a"]}, {"Out": ["b"]},
+                      infer_shape=False)
+        blk.append_op("relu", {"X": ["b"]}, {"Out": ["a"]},
+                      infer_shape=False)
+        return p
+
+    def test_e003_cycle(self):
+        rep = verify_program(self._cycle_program(False))
+        assert rep.by_code("PT-E003"), rep.render()
+        d = rep.by_code("PT-E003")[0]
+        assert d.var in ("a", "b")
+        # the cycle subsumes the forward-reference read (not double-
+        # reported as a misorder)
+        assert not rep.by_code("PT-E002")
+
+    def test_e003_negative_seeded(self):
+        rep = verify_program(self._cycle_program(True))
+        assert "PT-E003" not in _codes(rep) and rep.ok
+
+    def test_e003_negative_accumulators_not_a_cycle(self):
+        """Read-modify-write accumulator pairs are ordinary sequential
+        dataflow — an unrelated forward reference in the same block must
+        not drag them into a bogus SCC (reaching-def edge semantics)."""
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,))
+        blk.create_var(name="b", shape=(4,))
+        blk.append_op("fill_constant", {}, {"Out": ["x"]},
+                      {"shape": [4], "dtype": "float32", "value": 1.0},
+                      infer_shape=False)
+        for s in (2.0, 3.0):  # two in-place accumulators on x
+            blk.append_op("scale", {"X": ["x"]}, {"Out": ["x"]},
+                          {"scale": s}, infer_shape=False)
+        # unrelated forward reference: triggers the cycle/misorder pass
+        blk.append_op("relu", {"X": ["b"]}, {"Out": ["c"]},
+                      infer_shape=False)
+        blk.append_op("fill_constant", {}, {"Out": ["b"]},
+                      {"shape": [4], "dtype": "float32", "value": 0.0},
+                      infer_shape=False)
+        rep = verify_program(p)
+        assert not rep.by_code("PT-E003"), rep.render()
+        d, = rep.by_code("PT-E002")  # the fwd ref is a misorder, named
+        assert d.var == "b" and "op #4" in d.message
+
+    # -- PT-E004 unknown op type -------------------------------------------
+    def test_e004_unknown_op(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,), is_data=True)
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("totally_bogus_frobnicate", {"X": ["x"]},
+                      {"Out": ["o"]}, infer_shape=False)
+        rep = verify_program(p)
+        d, = rep.by_code("PT-E004")
+        assert d.op_type == "totally_bogus_frobnicate" and d.op_idx == 0
+
+    def test_e004_negative_registered(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,), is_data=True)
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]},
+                      infer_shape=False)
+        assert "PT-E004" not in _codes(verify_program(p))
+
+    # -- PT-E005 attr schema ------------------------------------------------
+    def test_e005_bad_op_role_and_sub_block(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,), is_data=True)
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]},
+                      {"op_role": "sideways"}, infer_shape=False)
+        blk.append_op("while", {"X": ["o"]}, {"Out": ["o"]},
+                      {"sub_block": 99}, infer_shape=False)
+        rep = verify_program(p)
+        assert len(rep.by_code("PT-E005")) == 2
+        roles = [d for d in rep.by_code("PT-E005") if "op_role" in d.message]
+        subs = [d for d in rep.by_code("PT-E005") if "sub_block" in d.message]
+        assert roles[0].op_idx == 0 and subs[0].op_idx == 1
+
+    def test_e005_negative_valid_attrs(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,), is_data=True)
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]},
+                      {"op_role": "backward"}, infer_shape=False)
+        assert "PT-E005" not in _codes(verify_program(p))
+
+    # -- PT-E006 shape/dtype walk ------------------------------------------
+    def test_e006_trace_failure_names_op_and_var(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(2, 3), is_data=True)
+        blk.create_var(name="y", shape=(4, 5), is_data=True)
+        blk.create_var(name="o", shape=(2, 5))
+        blk.append_op("matmul", {"X": ["x"], "Y": ["y"]}, {"Out": ["o"]},
+                      infer_shape=False)
+        rep = verify_program(p)
+        d = rep.by_code("PT-E006")[0]
+        assert d.op_type == "matmul" and d.op_idx == 0 and d.var == "x"
+        assert "[2, 3]" in d.message and "[4, 5]" in d.message
+
+    def test_e006_declared_vs_inferred_mismatch(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(2, 3), is_data=True)
+        blk.create_var(name="o", shape=(9, 9))  # wrong on purpose
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]},
+                      infer_shape=False)
+        rep = verify_program(p)
+        d, = rep.by_code("PT-E006")
+        assert d.var == "o" and "[9, 9]" in d.message \
+            and "[2, 3]" in d.message
+
+    def test_e006_negative_consistent(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(2, 3), is_data=True)
+        blk.create_var(name="y", shape=(3, 5), is_data=True)
+        blk.append_op("matmul", {"X": ["x"], "Y": ["y"]}, {"Out": ["o"]})
+        rep = verify_program(p)
+        assert "PT-E006" not in _codes(rep) and rep.ok
+
+    # -- PT-E007 unpaired grad op ------------------------------------------
+    def test_e007_orphan_and_nondiff_grad(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="g", shape=(4,), is_data=True)
+        blk.create_var(name="o", shape=(4,))
+        blk.append_op("bogus_fwd_grad", {"Out@GRAD": ["g"]},
+                      {"X@GRAD": ["o"]}, infer_shape=False)
+        blk.append_op("sequence_mask_grad", {"Y@GRAD": ["g"]},
+                      {"X@GRAD": ["o"]}, infer_shape=False)
+        rep = verify_program(p)
+        ds = rep.by_code("PT-E007")
+        assert len(ds) == 2
+        assert "not registered" in ds[0].message
+        assert "not differentiable" in ds[1].message
+        # _grad types are exempt from PT-E004 (unregistered by design)
+        assert "PT-E004" not in _codes(rep)
+
+    def test_e007_negative_real_backward(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            w = layers.create_parameter([4], "float32", name="w_e007")
+            loss = layers.mean(layers.elementwise_mul(x, w))
+            pt.append_backward(loss)
+        assert "PT-E007" not in _codes(verify_program(main))
+
+    # -- PT-W101 dead op ----------------------------------------------------
+    def _dead_op_program(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            dead = layers.relu(x)          # never fetched, feeds nothing
+            live = layers.mean(layers.scale(x, scale=2.0))
+        return main, dead, live
+
+    def test_w101_dead_op(self):
+        main, dead, live = self._dead_op_program()
+        rep = verify_program(main, fetch_list=[live])
+        d, = rep.by_code("PT-W101")
+        assert d.op_type == "relu" and d.var == dead.name
+        assert rep.ok  # warnings only
+
+    def test_w101_negative_fetched(self):
+        main, dead, live = self._dead_op_program()
+        rep = verify_program(main, fetch_list=[live, dead])
+        assert "PT-W101" not in _codes(rep)
+        # ... and with NO fetch roots the analyzer cannot judge intent
+        assert "PT-W101" not in _codes(verify_program(main))
+
+    # -- PT-W102 orphan var -------------------------------------------------
+    def test_w102_orphan_var(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,), is_data=True)
+        blk.create_var(name="orphan", shape=(2,))
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]})
+        rep = verify_program(p)
+        d, = rep.by_code("PT-W102")
+        assert d.var == "orphan"
+
+    def test_w102_negative_consumed(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(4,), is_data=True)
+        blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]})
+        assert "PT-W102" not in _codes(verify_program(p))
+
+    # -- PT-W103 write-after-write -----------------------------------------
+    def test_w103_shadowed_write(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="t", shape=(4,))
+        blk.append_op("fill_constant", {}, {"Out": ["t"]},
+                      {"shape": [4], "dtype": "float32", "value": 1.0},
+                      infer_shape=False)
+        blk.append_op("fill_constant", {}, {"Out": ["t"]},
+                      {"shape": [4], "dtype": "float32", "value": 2.0},
+                      infer_shape=False)
+        blk.append_op("relu", {"X": ["t"]}, {"Out": ["o"]})
+        rep = verify_program(p)
+        d, = rep.by_code("PT-W103")
+        assert d.var == "t" and d.op_idx == 0
+
+    def test_w103_negative_read_between(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="t", shape=(4,))
+        blk.append_op("fill_constant", {}, {"Out": ["t"]},
+                      {"shape": [4], "dtype": "float32", "value": 1.0},
+                      infer_shape=False)
+        blk.append_op("relu", {"X": ["t"]}, {"Out": ["o1"]})
+        blk.append_op("fill_constant", {}, {"Out": ["t"]},
+                      {"shape": [4], "dtype": "float32", "value": 2.0},
+                      infer_shape=False)
+        blk.append_op("relu", {"X": ["t"]}, {"Out": ["o2"]})
+        assert "PT-W103" not in _codes(verify_program(p))
+
+    # -- PT-W104 dropped gradient (+ runtime agreement) ---------------------
+    def _nondiff_on_grad_path(self, op_type):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4])  # stop_gradient=True (data default)
+            blk = main.global_block
+            blk.append_op(op_type, {"X": [x.name]}, {"Out": ["y"]})
+            y = blk.var("y")
+            w = layers.create_parameter([4], "float32", name="w_w104")
+            loss = layers.mean(layers.elementwise_mul(y, w))
+        return main, loss
+
+    def test_w104_and_runtime_warning_agree(self):
+        main, loss = self._nondiff_on_grad_path("t_nondiff_pass")
+        with pytest.warns(GradientDropWarning) as rec:
+            pt.append_backward(loss)
+        # runtime warning names op + var
+        msg = str(rec[0].message)
+        assert "t_nondiff_pass" in msg and "'y'" in msg \
+            and "PT-W104" in msg
+        # ... and the static analyzer flags the SAME case
+        rep = verify_program(main, fetch_list=[loss])
+        d, = rep.by_code("PT-W104")
+        assert d.op_type == "t_nondiff_pass" and d.var == "y"
+
+    def test_w104_negative_grad_free(self):
+        main, loss = self._nondiff_on_grad_path("t_nondiff_free")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", GradientDropWarning)
+            pt.append_backward(loss)  # grad_free => no warning
+        rep = verify_program(main, fetch_list=[loss])
+        assert "PT-W104" not in _codes(rep)
+
+    # -- PT-W105 stop_gradient inconsistency -------------------------------
+    def _stop_grad_program(self, stop):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="v", shape=(4,), is_data=True,
+                       stop_gradient=stop)
+        blk.create_var(name="v@GRAD", shape=(4,))
+        blk.append_op("fill_constant", {}, {"Out": ["v@GRAD"]},
+                      {"shape": [4], "dtype": "float32", "value": 0.0},
+                      infer_shape=False)
+        return p
+
+    def test_w105_stop_gradient_grad_written(self):
+        rep = verify_program(self._stop_grad_program(True))
+        d, = rep.by_code("PT-W105")
+        assert d.var == "v" and d.op_type == "fill_constant"
+
+    def test_w105_negative(self):
+        rep = verify_program(self._stop_grad_program(False))
+        assert "PT-W105" not in _codes(rep)
+
+    # -- PT-W106 untrained parameter ---------------------------------------
+    def _two_param_program(self, both_on_loss_path):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            w1 = layers.create_parameter([4], "float32", name="w_used")
+            w2 = layers.create_parameter([4], "float32", name="w_stray")
+            z1 = layers.elementwise_mul(x, w1)
+            z2 = layers.elementwise_mul(x, w2)
+            if both_on_loss_path:
+                loss = layers.mean(z1 + z2)
+            else:
+                loss = layers.mean(z1)  # z2 computed, never reaches loss
+            pt.append_backward(loss)
+        return main, loss
+
+    def test_w106_untrained_param(self):
+        main, loss = self._two_param_program(False)
+        rep = verify_program(main, fetch_list=[loss])
+        ds = rep.by_code("PT-W106")
+        assert [d.var for d in ds] == ["w_stray"]
+
+    def test_w106_negative_all_trained(self):
+        main, loss = self._two_param_program(True)
+        rep = verify_program(main, fetch_list=[loss])
+        assert "PT-W106" not in _codes(rep)
+
+    # -- PT-W107 recompile hazard ------------------------------------------
+    def test_w107_leaked_dummy_batch_dim(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4])          # (-1, 4)
+            flat = layers.reshape(x, [-1])     # folds batch into features
+        rep = verify_program(main)
+        ds = rep.by_code("PT-W107")
+        assert any(d.var == flat.name for d in ds), rep.render()
+        d = next(d for d in ds if d.var == flat.name)
+        assert str(4 * DUMMY_BATCH) in str(
+            main.global_block.var(flat.name).shape)
+
+    def test_w107_static_target_shape(self):
+        p = pt.Program()
+        blk = p.global_block
+        blk.create_var(name="x", shape=(-1, 4), is_data=True)
+        blk.create_var(name="o", shape=(8, 4))
+        blk.append_op("reshape", {"X": ["x"]}, {"Out": ["o"]},
+                      {"shape": [8, 4]}, infer_shape=False)
+        rep = verify_program(p)
+        assert any(d.var == "x" and d.op_idx == 0
+                   for d in rep.by_code("PT-W107"))
+
+    def test_w107_negative_batch_preserved(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name_guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.reshape(x, [0, 2, 2])   # 0 = copy batch dim
+        assert "PT-W107" not in _codes(verify_program(main))
+        assert main.global_block.var(y.name).shape == (-1, 2, 2)
+
+
+def test_shared_param_reuse_checks_shape_and_dtype():
+    """The shared-ParamAttr fix returns the existing Parameter — but a
+    conflicting redefinition must raise, not silently first-win."""
+    from paddle_tpu.framework.layer_helper import LayerHelper, ParamAttr
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        h = LayerHelper("t_shared")
+        p1 = h.create_parameter(ParamAttr(name="t_shared_w"), [4, 4])
+        assert h.create_parameter(ParamAttr(name="t_shared_w"),
+                                  [4, 4]) is p1
+        assert len(startup.global_block.ops) == 1  # ONE init op
+        with pytest.raises(ValueError, match="shape"):
+            h.create_parameter(ParamAttr(name="t_shared_w"), [4, 5])
+        with pytest.raises(ValueError, match="dtype"):
+            h.create_parameter(ParamAttr(name="t_shared_w"), [4, 4],
+                               dtype="bfloat16")
+
+
+def test_every_code_has_corpus_coverage():
+    """The corpus above must cover every registered diagnostic code."""
+    import inspect
+    src = inspect.getsource(TestDefectCorpus)
+    for code in analysis.all_codes():
+        assert code.replace("PT-", "").lower() in src.lower().replace(
+            "pt-", ""), f"no corpus test mentions {code}"
+
+
+# ---------------------------------------------------------------------------
+# self-audit: our own model programs verify clean
+# ---------------------------------------------------------------------------
+
+def _build_trained(build, fetch_of=lambda out: [out["loss"]]):
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name_guard(), pt.program_guard(main, startup):
+        out = build()
+        pt.optimizer.Adam(learning_rate=0.05).minimize(out["loss"])
+    return main, startup, fetch_of(out)
+
+
+def _book_cases():
+    from paddle_tpu.models import book, deepfm, transformer
+    # NOTE: case ids must dodge conftest._SLOW_PATTERNS substrings
+    # ("label_semantic", "transformer_nmt", ...) — these audits only
+    # BUILD + verify (no training), so they belong in the quick lane
+    return {
+        "nmt_transformer": lambda: _build_trained(
+            lambda: transformer.transformer_nmt(
+                src_vocab=30, tgt_vocab=30, src_len=6, tgt_len=6,
+                hidden=32, heads=2, ffn_dim=64, n_layers=1)),
+        "fit_a_line": lambda: _build_trained(book.fit_a_line),
+        "word2vec": lambda: _build_trained(
+            lambda: book.word2vec(60, emb_dim=8, hidden=16)),
+        "recommender": lambda: _build_trained(book.recommender),
+        "seq2seq_attention": lambda: _build_trained(
+            lambda: book.seq2seq_attention(30, 30, 6, 6)),
+        "label_sem_roles": lambda: _build_trained(
+            lambda: book.label_semantic_roles(40, 5, 6)),
+        "rnn_encoder_decoder": lambda: _build_trained(
+            lambda: book.rnn_encoder_decoder(20, 20, 5, 5)),
+        "deepfm": lambda: _build_trained(
+            lambda: deepfm.deepfm(num_fields=4, sparse_feature_dim=64),
+            fetch_of=lambda o: [o["loss"], o["prob"], o["auc_input"]]),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_book_cases()))
+def test_self_audit_book_models(name):
+    main, startup, fetches = _book_cases()[name]()
+    rep = verify_program(main, fetch_list=fetches)
+    assert not rep.diagnostics, f"{name} main:\n{rep.render()}"
+    rep_s = verify_program(startup)
+    assert not rep_s.diagnostics, f"{name} startup:\n{rep_s.render()}"
+
+
+@pytest.mark.parametrize("variant", ["train", "eval", "amp_recompute"])
+def test_self_audit_gpt_programs(variant):
+    """The GPT builders — including the bench_gpt amp+recompute path and
+    the is_test=True program bench_serving's build_params uses."""
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    cfg = GPTConfig(vocab_size=96, hidden=32, layers=2, heads=2,
+                    max_pos=32)
+    kw = {"train": dict(learning_rate=1e-3),
+          "eval": dict(is_test=True),
+          "amp_recompute": dict(learning_rate=1e-3, amp=True,
+                                recompute=True)}[variant]
+    with pt.unique_name_guard():
+        main, startup, fetches = gpt_lm_program(cfg, 16, **kw)
+    rep = verify_program(main, fetch_list=[fetches["loss"]])
+    assert not rep.diagnostics, f"gpt {variant} main:\n{rep.render()}"
+    rep_s = verify_program(startup)
+    assert not rep_s.diagnostics, f"gpt {variant} startup:\n{rep_s.render()}"
+
+
+# ---------------------------------------------------------------------------
+# surfaces: Program.validate / Executor.run(validate=True) / read-only pins
+# ---------------------------------------------------------------------------
+
+def _malformed_matmul_program():
+    p = pt.Program()
+    blk = p.global_block
+    blk.create_var(name="x", shape=(2, 3), is_data=True)
+    blk.create_var(name="y", shape=(4, 5), is_data=True)
+    blk.create_var(name="o", shape=(2, 5))
+    blk.append_op("matmul", {"X": ["x"], "Y": ["y"]}, {"Out": ["o"]},
+                  infer_shape=False)
+    return p
+
+
+def test_program_validate_is_read_only():
+    p = _malformed_matmul_program()
+    before_bytes = p.serialize_to_string()
+    before_version = p.version
+    rep = p.validate(fetch_list=["o"])
+    assert not rep.ok and rep.by_code("PT-E006")
+    assert p.serialize_to_string() == before_bytes
+    assert p.version == before_version
+
+
+def test_executor_validate_raises_diagnostic_not_jit_trace():
+    p = _malformed_matmul_program()
+    exe = pt.Executor()
+    feed = {"x": np.zeros((2, 3), np.float32),
+            "y": np.zeros((4, 5), np.float32)}
+    with pytest.raises(ProgramVerificationError) as ei:
+        exe.run(p, feed=feed, fetch_list=["o"], validate=True)
+    msg = str(ei.value)
+    # code + op + var provenance, not an XLA traceback
+    assert "PT-E006" in msg and "matmul" in msg and "op #0" in msg
+    assert "jaxlib" not in msg.lower().split("hint")[0][:80]
+    assert exe.compile_count == 0  # rejected before lowering/compiling
+
+
+def test_executor_validate_off_is_byte_identical():
+    """validate=False leaves everything exactly as before; validate=True
+    on a CLEAN program adds no compiles and mutates nothing."""
+    p = pt.Program()
+    blk = p.global_block
+    blk.create_var(name="x", shape=(-1, 4), is_data=True)
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]})
+    feed = {"x": np.ones((2, 4), np.float32)}
+
+    before_bytes = p.serialize_to_string()
+    before_version = p.version
+
+    exe_off = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        r_off, = exe_off.run(p, feed=feed, fetch_list=["o"])
+    exe_on = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        r_on, = exe_on.run(p, feed=feed, fetch_list=["o"], validate=True)
+        # memoized: a second validated run re-verifies nothing
+        exe_on.run(p, feed=feed, fetch_list=["o"], validate=True)
+
+    np.testing.assert_array_equal(r_off, r_on)
+    assert exe_off.compile_count == exe_on.compile_count == 1
+    assert len(exe_on._validated) == 1
+    assert p.serialize_to_string() == before_bytes
+    assert p.version == before_version
+
+
+def test_debugger_annotates_diagnostics():
+    from paddle_tpu.framework.debugger import program_to_code
+    p = _malformed_matmul_program()
+    rep = p.validate()
+    code = program_to_code(p, diagnostics=rep)
+    assert "!! PT-E006" in code
+    assert "// verifier: 1 error(s)" in code
+    # without diagnostics the dump is unannotated (back-compat)
+    assert "!!" not in program_to_code(p)
+
+
+# ---------------------------------------------------------------------------
+# check_program.py CLI
+# ---------------------------------------------------------------------------
+
+def _cli(tmp_path, program, *args):
+    import check_program
+    f = tmp_path / "prog.json"
+    f.write_bytes(program.serialize_to_string())
+    return check_program.main([str(f), *args])
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    import check_program
+    # errors -> 1, with the diagnostic on stdout
+    assert _cli(tmp_path, _malformed_matmul_program()) == 1
+    out = capsys.readouterr().out
+    assert "PT-E006" in out and "hint:" in out
+
+    # clean -> 0
+    clean = pt.Program()
+    blk = clean.global_block
+    blk.create_var(name="x", shape=(4,), is_data=True)
+    blk.append_op("relu", {"X": ["x"]}, {"Out": ["o"]})
+    assert _cli(tmp_path, clean) == 0
+    assert "verifies clean" in capsys.readouterr().out
+
+    # warnings: 0 by default, 1 under --strict, 0 again when skipped
+    main, dead, live = TestDefectCorpus()._dead_op_program()
+    assert _cli(tmp_path, main, "--fetch", live.name) == 0
+    assert _cli(tmp_path, main, "--fetch", live.name, "--strict") == 1
+    assert _cli(tmp_path, main, "--fetch", live.name, "--strict",
+                "--skip", "PT-W101") == 0
+    capsys.readouterr()
+
+    # unusable input -> 2 with a remediation hint, never a traceback
+    assert check_program.main([str(tmp_path / "missing.json")]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert check_program.main([str(empty)]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json at all")
+    assert check_program.main([str(bad)]) == 2
+    err = capsys.readouterr().err
+    assert "check_program:" in err and "serialize_to_string" in err
+
+
+def test_cli_json_output(tmp_path, capsys):
+    rc = _cli(tmp_path, _malformed_matmul_program(), "--json")
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is False and out["failed"] is True
+    assert out["errors"] >= 1
+    d = out["diagnostics"][0]
+    assert d["code"] == "PT-E006" and d["op_type"] == "matmul" \
+        and d["op_idx"] == 0 and d["severity"] == "error" and d["hint"]
+
+
+def test_cli_dump_annotated(tmp_path, capsys):
+    rc = _cli(tmp_path, _malformed_matmul_program(), "--dump")
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "!! PT-E006" in out and "matmul" in out

@@ -57,9 +57,13 @@ def test_word2vec():
         d["target"] = ((ctx.sum(1) + 1) % V)[:, None].astype(np.int64)
         return d
 
+    # 40 steps: at 12 the loss is still inside init noise, so the
+    # assertion was coupled to the exact startup RNG draw (it flipped
+    # when the shared-embedding double-init bug was fixed and the draw
+    # stream shifted)
     losses, *_ = _train(lambda: book.word2vec(V, emb_dim=16, hidden=32),
-                        feed, steps=12)
-    assert losses[-1] < losses[0], losses
+                        feed, steps=40)
+    assert min(losses[-3:]) < losses[0], losses
 
 
 def test_word2vec_shared_embedding_is_one_param():

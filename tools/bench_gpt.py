@@ -41,6 +41,16 @@ def main():
         recompute=os.environ.get("BENCH_RECOMPUTE", "0") == "1")
     train_stats.uninstall_step_logger()
 
+    # static pre-flight: the program must verify clean BEFORE any bench
+    # time is spent on it. This runs once at build (here), never inside
+    # the timed loop — verify_ms in `extra` pins the build-time-only cost.
+    from paddle_tpu import analysis
+    t_v = time.perf_counter()
+    vrep = analysis.verify_program(main_prog,
+                                   fetch_list=[fetches["loss"]])
+    verify_ms = (time.perf_counter() - t_v) * 1e3
+    assert not vrep.errors, f"program failed verification:\n{vrep.render()}"
+
     import jax.numpy as jnp
     rng = np.random.RandomState(0)
     feed = {"tokens": jnp.asarray(rng.randint(
@@ -104,6 +114,7 @@ def main():
 
     fl = flops_per_step(cfg, batch, seq)
     mfu = fl / dt / peak
+    extra["verify_ms"] = round(verify_ms, 1)
     print(json.dumps({
         "metric": "gpt2_small_train_mfu",
         "value": round(mfu, 4),

@@ -70,7 +70,12 @@ def build_params(gpt_kwargs):
 
     cfg = GPTConfig(**gpt_kwargs)
     with pt.unique_name_guard():
-        main, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+        main, startup, fetches = gpt_lm_program(cfg, 8, is_test=True)
+    # static pre-flight at build time (never inside the bench loop): the
+    # parameter-source program must verify clean before anything is timed
+    from paddle_tpu import analysis
+    vrep = analysis.verify_program(main, fetch_list=[fetches["loss"]])
+    assert not vrep.errors, f"program failed verification:\n{vrep.render()}"
     exe = pt.Executor()
     scope = pt.Scope()
     with pt.scope_guard(scope):

@@ -1,0 +1,112 @@
+"""Static program verifier CLI: lint a serialized Program before running it.
+
+The command-line face of paddle_tpu.analysis.verify_program — feed it a
+program serialized with `Program.serialize_to_string()` (JSON) and it
+prints structured diagnostics (stable PT-Exxx/PT-Wxxx codes, op-level
+provenance, fix hints) instead of the XLA trace error you would get at
+run time. The reference's analog is the build-time InferShape/CheckAttrs
+aborts plus ir::Graph validation, surfaced as a lint report.
+
+Usage:
+  python tools/check_program.py program.json [--strict] [--json]
+      [--fetch NAME ...] [--feed NAME ...] [--skip CODE ...] [--dump]
+
+Exit codes (the trace_summary/train_summary convention):
+  0  program verifies clean (no errors; no warnings either under --strict)
+  1  diagnostics at the failing severity were found
+  2  unusable input (missing/empty/non-JSON file) — with a remediation hint
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+class CheckError(Exception):
+    """Unreadable/unparsable program input (reported, never a traceback)."""
+
+
+def load_program(path: str):
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise CheckError(f"cannot read {path!r}: {e.strerror or e}")
+    if not raw.strip():
+        raise CheckError(
+            f"{path!r} is empty — no program was written there. Serialize "
+            "one with open(path, 'wb').write(program"
+            ".serialize_to_string()).")
+    from paddle_tpu.framework.core import Program
+    try:
+        return Program.parse_from_string(raw)
+    except (ValueError, KeyError, TypeError) as e:
+        raise CheckError(
+            f"{path!r} is not a serialized Program (parse error: {e}). "
+            "Expected the JSON emitted by Program.serialize_to_string().")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Statically verify a serialized paddle_tpu Program")
+    ap.add_argument("program", help="path to Program.serialize_to_string() "
+                                    "JSON")
+    ap.add_argument("--fetch", action="append", default=[],
+                    metavar="NAME",
+                    help="fetch target var (repeatable); enables dead-op "
+                         "analysis (PT-W101)")
+    ap.add_argument("--feed", action="append", default=[], metavar="NAME",
+                    help="var bound by feed at run time (repeatable), "
+                         "beyond vars declared is_data")
+    ap.add_argument("--skip", action="append", default=[], metavar="CODE",
+                    help="suppress a diagnostic code (repeatable), e.g. "
+                         "--skip PT-W101")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail (exit 1)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--dump", action="store_true",
+                    help="print the program dump with diagnostics "
+                         "annotated inline (debugger.program_to_code)")
+    args = ap.parse_args(argv)
+
+    try:
+        program = load_program(args.program)
+    except CheckError as e:
+        print(f"check_program: {e}", file=sys.stderr)
+        return 2
+
+    from paddle_tpu import analysis
+    try:
+        report = analysis.verify_program(
+            program, fetch_list=args.fetch or None,
+            feed_names=args.feed or None, skip_codes=args.skip or None)
+    except ValueError as e:  # unknown --skip code
+        print(f"check_program: {e}", file=sys.stderr)
+        return 2
+
+    failing = report.errors + (report.warnings if args.strict else [])
+    if args.json:
+        out = report.to_dict()
+        out["strict"] = args.strict
+        out["failed"] = bool(failing)
+        print(json.dumps(out, indent=2))
+    else:
+        if args.dump:
+            from paddle_tpu.framework.debugger import program_to_code
+            print(program_to_code(program, diagnostics=report))
+        else:
+            print(report.render())
+        if failing:
+            print(f"\ncheck_program: FAILED ({len(failing)} finding(s) at "
+                  f"{'warning' if args.strict else 'error'}+ severity)",
+                  file=sys.stderr)
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

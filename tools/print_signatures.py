@@ -26,6 +26,7 @@ MODULES = [
     "paddle_tpu.nets",
     "paddle_tpu.io",
     "paddle_tpu.metrics",
+    "paddle_tpu.analysis",
     "paddle_tpu.clip",
     "paddle_tpu.regularizer",
     "paddle_tpu.initializer",
