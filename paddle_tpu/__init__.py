@@ -36,6 +36,7 @@ from . import dataset
 from .dataset import DatasetFactory
 from . import inference
 from . import serving
+from . import server
 from . import nets
 from .data_feeder import DataFeeder
 from .reader.py_reader import PyReader

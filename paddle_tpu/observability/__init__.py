@@ -12,8 +12,11 @@ first-class layer):
   with a request id, so one request's timeline is reconstructable.
 * `metrics` — process-wide registry of labeled counters / gauges /
   histograms with JSON snapshot and Prometheus text export; the
-  serving engine's TTFT/TPOT metrics and the executor's progress
-  heartbeats are its tenants.
+  serving engine's TTFT/TPOT metrics, the executor's progress
+  heartbeats, and the HTTP service plane's per-tenant request
+  counters + router gauges (`paddle_tpu.server`:
+  `server_requests_total{router,tenant,code}`,
+  `server_active_streams`, ...) are its tenants.
 * `export` — chrome://tracing (catapult) JSON writer + per-span
   self-time rollup; `tools/trace_summary.py` is the CLI.
 * `debug_server` — live diagnostics HTTP plane (stdlib-only):

@@ -1,0 +1,38 @@
+"""paddle_tpu.server — the deployable serving service over the engine.
+
+PRs 1–6 made `paddle_tpu.serving` a continuous-batching library
+(paged KV arena, fused chunked decode, overlapped pipeline); this
+package is the wire around it — the reference's deployable inference
+surface (`paddle_inference_api.h` + the multi-trainer/DeviceWorker
+saturation story) rebuilt as a service plane:
+
+* `service` — stdlib HTTP/1.1 frontend (`ThreadingHTTPServer`, the
+  debug_server idiom): `POST /v1/generate` streams tokens out as SSE
+  (client disconnect cancels the request so its KV pages free),
+  `GET /healthz` readiness with per-replica gauges, `GET /metrics`
+  the shared Prometheus registry. Overload and quota exhaustion map
+  to 429 + Retry-After (queue-wait-p50-derived), drain to 503 — never
+  an exception escaping a handler thread.
+* `router` — front tier over N `ServingEngine` replicas: least-loaded
+  admission off the live EngineMetrics gauges, per-tenant token-bucket
+  quotas, per-request deadlines that cancel in-flight work, graceful
+  drain, and one driver thread per replica. Shed storms fire the
+  watchdog overload hook so they leave flight records.
+
+Quick start:
+
+    import paddle_tpu as pt
+    server = pt.server.serve(params, gpt_cfg,
+                             pt.server.ServerConfig(replicas=2))
+    # curl -N -X POST :{server.port}/v1/generate \
+    #      -d '{"prompt": [5, 7, 11], "max_new_tokens": 32}'
+    server.shutdown()          # drain, then refcounted engine close()
+"""
+
+from .router import (DrainingError, QuotaConfig, QuotaExceededError,
+                     Router, RouterMetrics, StreamHandle, TokenBucket)
+from .service import GenerationServer, ServerConfig, serve
+
+__all__ = ["GenerationServer", "ServerConfig", "serve", "Router",
+           "StreamHandle", "TokenBucket", "QuotaConfig",
+           "QuotaExceededError", "DrainingError", "RouterMetrics"]

@@ -1,0 +1,583 @@
+"""Multi-replica front tier: least-loaded admission, quotas, deadlines.
+
+The reference saturates inference hardware by fanning requests over many
+trainer/DeviceWorker instances around AnalysisPredictor (PAPER.md layer
+map); this router is that front tier for N `ServingEngine` replicas.
+One wire request flows
+
+    Router.submit() -> tenant token-bucket check  (QuotaExceededError)
+                    -> least-loaded replica pick  (live slot/queue
+                       gauges from EngineMetrics, round-robin ties)
+                    -> replica.engine.submit()    (EngineOverloadError
+                       when EVERY replica sheds)
+                    -> StreamHandle               (the handler thread
+                       consumes events() while the replica's driver
+                       thread produces tokens)
+
+Each replica owns a driver thread stepping its engine (the engines'
+submit()/cancel() are lock-protected exactly for this split: producer
+threads feed a single driver loop). Per-request deadlines are enforced
+by the driver between steps — an expired request is cancelled through
+the engine's cancel path, so its KV pages free and co-batched streams
+never notice. Graceful drain stops admission (DrainingError), lets
+every queued/in-flight stream finish, then tears engines down via the
+refcounted close() path.
+
+Backpressure is structured, never parsed from messages: quota sheds
+carry the bucket-computed retry hint, engine sheds carry the queue-wait
+p50 hint the engine stamps on EngineOverloadError, and both shed paths
+fire the watchdog overload hook so shed storms leave flight records.
+
+Metrics land in the process-wide observability registry under the
+router's label: `server_requests_total{router,tenant,code}`,
+`server_quota_rejections_total{router,tenant}`,
+`server_client_disconnects_total{router,tenant}`, and gauges
+`server_active_streams` / `server_replicas` / `server_draining`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import queue
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..observability import watchdog as _watchdog
+from ..observability.metrics import MetricsRegistry, get_registry
+from ..serving.engine import EngineOverloadError, ServingEngine
+
+__all__ = ["Router", "StreamHandle", "TokenBucket", "QuotaConfig",
+           "QuotaExceededError", "DrainingError", "RouterMetrics"]
+
+
+class QuotaExceededError(RuntimeError):
+    """Tenant token bucket empty: the request was shed at the router.
+
+    Structured fields (`tenant`, `retry_after_s`) so callers map it to
+    a 429 + Retry-After without parsing the message."""
+
+    def __init__(self, tenant: str, retry_after_s: float):
+        super().__init__(
+            f"tenant {tenant!r} quota exhausted; retry in "
+            f"{retry_after_s:.3f}s")
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class DrainingError(RuntimeError):
+    """The router is draining (or closed): not admitting new requests."""
+
+
+class QuotaConfig:
+    """Per-tenant token-bucket shape. A request costs its total token
+    budget (prompt length + max_new_tokens) — work-proportional, so one
+    giant request can't ride a per-request count. `capacity` is the
+    burst allowance, `refill_per_s` the sustained tokens/second."""
+
+    def __init__(self, capacity: float, refill_per_s: float):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be > 0, got {capacity}")
+        if refill_per_s < 0:
+            raise ValueError(
+                f"refill_per_s must be >= 0, got {refill_per_s}")
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+
+
+class TokenBucket:
+    """Classic token bucket with an injectable clock (tests pin exact
+    grant/deny/retry math with a fake clock). Thread-safe."""
+
+    def __init__(self, capacity: float, refill_per_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._tokens = float(capacity)
+        self._stamp = clock()
+        self._lock = threading.Lock()
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        elapsed = now - self._stamp
+        self._stamp = now
+        if elapsed > 0:
+            self._tokens = min(self.capacity,
+                               self._tokens + elapsed * self.refill_per_s)
+
+    def try_take(self, n: float = 1.0) -> float:
+        """Take `n` tokens if available; returns 0.0 on grant, else the
+        seconds until the bucket could grant `n` (inf when the bucket
+        can NEVER grant it: n > capacity or no refill)."""
+        with self._lock:
+            self._refill_locked()
+            if n <= self._tokens:
+                self._tokens -= n
+                return 0.0
+            if n > self.capacity or self.refill_per_s <= 0:
+                return math.inf
+            return (n - self._tokens) / self.refill_per_s
+
+    def refund(self, n: float) -> None:
+        """Credit tokens back — a take whose request was never served
+        (every replica shed, or validation failed downstream) must not
+        burn the tenant's budget. Capped at capacity."""
+        with self._lock:
+            self._refill_locked()
+            self._tokens = min(self.capacity, self._tokens + float(n))
+
+
+class StreamHandle:
+    """One routed request in flight. The submitting (handler) thread
+    consumes `events()` / `result()`; the replica's driver thread
+    produces into the internal queue via the engine's on_token callback.
+    Exactly one terminal ("done", reason) event is ever emitted — reason
+    is one of "stop" (EOS), "length" (budget), "cancelled" (client went
+    away), "deadline_exceeded", or "error"."""
+
+    def __init__(self, router: "Router", replica: "Replica", tenant: str,
+                 deadline: Optional[float]):
+        self._router = router
+        self.replica = replica
+        self.tenant = tenant
+        self.deadline = deadline            # absolute router-clock stamp
+        self.request = None                 # GenerationRequest, set post-submit
+        self.finish_reason: Optional[str] = None
+        self._flock = threading.Lock()
+        self._events: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+
+    @property
+    def request_id(self) -> Optional[str]:
+        return self.request.request_id if self.request is not None else None
+
+    # driver-thread side ----------------------------------------------------
+
+    def _on_token(self, req, token: int) -> None:
+        # the engine's streaming callback: runs on the replica's driver
+        # thread, with req.state already advanced for this emission
+        self.request = req
+        self._events.put(("token", int(token)))
+        if req.finished:
+            reason = ("stop" if (req.eos_id is not None
+                                 and int(token) == req.eos_id)
+                      else "length")
+            self._finish(reason)
+
+    def _finish(self, reason: str) -> bool:
+        """First finisher wins (natural finish on the driver vs cancel
+        from a handler thread race here); emits the terminal event and
+        detaches from the router exactly once."""
+        with self._flock:
+            if self.finish_reason is not None:
+                return False
+            self.finish_reason = reason
+        self._events.put(("done", reason))
+        self._done.set()
+        self._router._stream_closed(self)
+        return True
+
+    # handler-thread side ---------------------------------------------------
+
+    def events(self, timeout: Optional[float] = None):
+        """Yield ("token", id) events then one final ("done", reason).
+        `timeout` bounds the wait per event (TimeoutError past it)."""
+        while True:
+            try:
+                kind, payload = self._events.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no stream event within {timeout}s "
+                    f"(request {self.request_id})")
+            yield kind, payload
+            if kind == "done":
+                return
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the stream finishes; returns (tokens, reason)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} unfinished after {timeout}s")
+        tokens = list(self.request.tokens) if self.request is not None \
+            else []
+        return tokens, self.finish_reason
+
+
+class Replica:
+    """One ServingEngine plus the driver thread that steps it. The
+    driver is the only thread touching scheduler/slot state (the
+    engine's documented contract); handler threads only submit/cancel."""
+
+    def __init__(self, engine: ServingEngine,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self._clock = clock
+        self._handles: set = set()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def label(self) -> str:
+        return self.engine.metrics.engine_label
+
+    def load(self) -> int:
+        """Live queue + slot occupancy, read from the engine's registry
+        gauges (the same numbers a /metrics scrape sees)."""
+        m = self.engine.metrics
+        return int(m.queue_depth) + int(m.active_slots)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.engine._queue
+                    or self.engine.scheduler.active_count
+                    or self.engine._pending_cancels)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._drive, name=f"pt-serve-drive-{self.label}",
+            daemon=True)
+        self._thread.start()
+
+    def kick(self) -> None:
+        self._work.set()
+
+    def watch(self, handle: StreamHandle) -> None:
+        with self._lock:
+            self._handles.add(handle)
+
+    def forget(self, handle: StreamHandle) -> None:
+        with self._lock:
+            self._handles.discard(handle)
+
+    def _drive(self) -> None:
+        while not self._stop:
+            self._expire_deadlines()
+            if self.busy:
+                try:
+                    self.engine.step()
+                except Exception:
+                    # a dead driver would wedge every stream on this
+                    # replica: fail the live handles loudly and keep the
+                    # loop alive (the next submit may still work)
+                    traceback.print_exc()
+                    with self._lock:
+                        stuck = list(self._handles)
+                    for h in stuck:
+                        if h.request is not None:
+                            self.engine.cancel(h.request)
+                        h._finish("error")
+                    time.sleep(0.05)
+            else:
+                # idle: sleep until a submit kicks us (the timeout only
+                # bounds shutdown latency — deadline checks matter only
+                # while requests are in flight, which keeps the loop hot)
+                self._work.wait(timeout=0.02)
+                self._work.clear()
+
+    def _expire_deadlines(self) -> None:
+        now = self._clock()
+        with self._lock:
+            expired = [h for h in self._handles
+                       if h.deadline is not None and now >= h.deadline
+                       and h.finish_reason is None]
+        for h in expired:
+            # cancel through the engine (queued -> dropped, running ->
+            # freed at the top of the next step, pages released) BEFORE
+            # emitting the terminal event
+            self.engine.cancel(h.request)
+            h._finish("deadline_exceeded")
+
+    def stop(self, join: bool = True) -> None:
+        self._stop = True
+        self._work.set()
+        if join and self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+
+class RouterMetrics:
+    """Router-labeled series in the process registry. Per-tenant label
+    sets are created on first use and tracked so unregister() can retire
+    every series this router minted (a recreated router must not leave
+    dead labels behind — same discipline as EngineMetrics)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 label: Optional[str] = None):
+        self._registry = registry or get_registry()
+        self.label = str(label if label is not None
+                         else next(RouterMetrics._ids))
+        r = self._registry
+        self._requests = r.counter(
+            "server_requests_total",
+            "wire requests by tenant and HTTP response code")
+        self._quota = r.counter(
+            "server_quota_rejections_total",
+            "requests shed by a tenant token-bucket quota")
+        self._disconnects = r.counter(
+            "server_client_disconnects_total",
+            "streams dropped by the client before completion")
+        self._gauge_fams = {
+            "active_streams": r.gauge(
+                "server_active_streams", "wire streams currently open"),
+            "replicas": r.gauge(
+                "server_replicas", "engine replicas behind the router"),
+            "draining": r.gauge(
+                "server_draining",
+                "1 while the router refuses new admissions"),
+        }
+        base = {"router": self.label}
+        self.active_streams = self._gauge_fams["active_streams"].labels(
+            **base)
+        self.replicas = self._gauge_fams["replicas"].labels(**base)
+        self.draining = self._gauge_fams["draining"].labels(**base)
+        # (family, sorted label items) pairs created lazily per tenant
+        self._dynamic: set = set()
+        self._dyn_lock = threading.Lock()
+
+    def _inc(self, fam, **labels) -> None:
+        labels["router"] = self.label
+        fam.labels(**labels).inc()
+        with self._dyn_lock:
+            self._dynamic.add((fam, tuple(sorted(labels.items()))))
+
+    def observe_request(self, tenant: str, code: int) -> None:
+        self._inc(self._requests, tenant=tenant, code=str(code))
+
+    def observe_quota_rejection(self, tenant: str) -> None:
+        self._inc(self._quota, tenant=tenant)
+
+    def observe_disconnect(self, tenant: str) -> None:
+        self._inc(self._disconnects, tenant=tenant)
+
+    def unregister(self) -> None:
+        """Retire every series this router registered."""
+        for name, fam in self._gauge_fams.items():
+            fam.remove(router=self.label)
+        with self._dyn_lock:
+            dynamic, self._dynamic = self._dynamic, set()
+        for fam, items in dynamic:
+            fam.remove(**dict(items))
+
+
+class Router:
+    """Front tier over N ServingEngine replicas: least-loaded admission,
+    per-tenant token-bucket quotas, per-request deadlines, graceful
+    drain. Construct over already-built engines (they must not be
+    driven by any other thread once start() runs)."""
+
+    def __init__(self, engines: Sequence[ServingEngine],
+                 quotas: Optional[Dict[str, QuotaConfig]] = None,
+                 default_quota: Optional[QuotaConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 label: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("router needs at least one engine replica")
+        self._clock = clock
+        self.metrics = RouterMetrics(registry=registry, label=label)
+        self.replicas = [Replica(e, clock) for e in engines]
+        self.metrics.replicas.set(len(self.replicas))
+        self._quota_cfg = dict(quotas or {})
+        self._default_quota = default_quota
+        self._buckets: Dict[str, Optional[TokenBucket]] = {}
+        self._bucket_lock = threading.Lock()
+        self._admit_lock = threading.Lock()
+        self._draining = False
+        self._closed = False
+        self._started = False
+        self._rr = itertools.count()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start one driver thread per replica (idempotent)."""
+        self._started = True
+        for r in self.replicas:
+            r.start()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def inflight(self) -> int:
+        return int(self.metrics.active_streams.value)
+
+    # -- admission ----------------------------------------------------------
+
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        with self._bucket_lock:
+            if tenant in self._buckets:
+                return self._buckets[tenant]
+            cfg = self._quota_cfg.get(tenant, self._default_quota)
+            bucket = None if cfg is None else TokenBucket(
+                cfg.capacity, cfg.refill_per_s, clock=self._clock)
+            self._buckets[tenant] = bucket
+            return bucket
+
+    def submit(self, prompt, max_new_tokens: int, tenant: str = "default",
+               deadline_s: Optional[float] = None,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> StreamHandle:
+        """Route one request. Raises DrainingError (draining/closed),
+        QuotaExceededError (tenant bucket empty), EngineOverloadError
+        (EVERY replica shed — the least-loaded replica's structured
+        error propagates), or ValueError (request can never be served,
+        straight from engine validation)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._admit_lock:
+            if self._draining or self._closed:
+                raise DrainingError(
+                    "router is draining; not admitting new requests")
+            bucket = self._bucket_for(tenant)
+            cost = prompt.size + int(max_new_tokens)
+            if bucket is not None:
+                retry = bucket.try_take(cost)
+                if retry > 0:
+                    self.metrics.observe_quota_rejection(tenant)
+                    # quota shed storms leave flight records, exactly
+                    # like engine-queue sheds (engine.submit fires this
+                    # hook itself on its own shed path)
+                    _watchdog.notify_overload(
+                        f"router-{self.metrics.label}")
+                    raise QuotaExceededError(tenant, retry)
+            # least-loaded admission over the live registry gauges;
+            # round-robin offset breaks ties so equal-load replicas
+            # share cold-start traffic instead of replica 0 taking all
+            rr = next(self._rr)
+            n = len(self.replicas)
+            order = sorted(range(n),
+                           key=lambda i: (self.replicas[i].load(),
+                                          (i - rr) % n))
+            last_err: Optional[EngineOverloadError] = None
+            granted = False
+            try:
+                for i in order:
+                    replica = self.replicas[i]
+                    handle = StreamHandle(
+                        self, replica, tenant,
+                        None if deadline_s is None
+                        else self._clock() + float(deadline_s))
+                    try:
+                        req = replica.engine.submit(
+                            prompt, max_new_tokens,
+                            temperature=temperature,
+                            seed=seed, eos_id=eos_id,
+                            on_token=handle._on_token)
+                    except EngineOverloadError as e:
+                        last_err = e
+                        continue
+                    handle.request = req
+                    replica.watch(handle)
+                    self.metrics.active_streams.inc()
+                    replica.kick()
+                    granted = True
+                    return handle
+                assert last_err is not None
+                raise last_err
+            finally:
+                # a request that was never admitted (every replica shed,
+                # or engine validation raised) must not burn the
+                # tenant's quota: refund the tokens taken above
+                if not granted and bucket is not None:
+                    bucket.refund(cost)
+
+    def cancel(self, handle: StreamHandle,
+               reason: str = "cancelled") -> bool:
+        """Abandon a routed request (client disconnect): cancel through
+        the engine so its KV pages free on the replica's next step, and
+        finish the stream with `reason`. Safe from any thread, safe to
+        call after natural completion (returns False then)."""
+        if handle.request is not None:
+            handle.replica.engine.cancel(handle.request)
+        finished = handle._finish(reason)
+        if finished and reason == "cancelled":
+            self.metrics.observe_disconnect(handle.tenant)
+        handle.replica.kick()
+        return finished
+
+    def _stream_closed(self, handle: StreamHandle) -> None:
+        handle.replica.forget(handle)
+        self.metrics.active_streams.dec()
+
+    # -- drain / teardown ---------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting (submit raises DrainingError),
+        then wait until every queued and in-flight request has finished
+        streaming. Returns True when fully drained, False when `timeout`
+        (wall seconds) elapsed first — nothing is cancelled either way;
+        close() decides what happens to leftovers."""
+        with self._admit_lock:
+            self._draining = True
+        self.metrics.draining.set(1)
+        for r in self.replicas:
+            r.kick()
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            if all(not r.busy and not r._handles for r in self.replicas):
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Tear down: optional graceful drain, force-cancel whatever is
+        left, stop the driver threads, then close every engine through
+        the refcounted close() path (registry series retired, shared
+        debug server released by the last holder)."""
+        if self._closed:
+            return
+        if drain:
+            self.drain(timeout=timeout)
+        with self._admit_lock:
+            self._draining = True
+            self._closed = True
+        self.metrics.draining.set(1)
+        for r in self.replicas:
+            with r._lock:
+                leftovers = list(r._handles)
+            for h in leftovers:
+                if h.request is not None:
+                    r.engine.cancel(h.request)
+                h._finish("cancelled")
+            r.kick()
+        for r in self.replicas:
+            r.stop()
+        for r in self.replicas:
+            if r._thread is None or not r._thread.is_alive():
+                # driver joined: apply any still-pending cancels from
+                # THIS thread so device pages are freed before close
+                try:
+                    while r.busy:
+                        r.engine.step()
+                except Exception:
+                    traceback.print_exc()
+            # else: the driver outlived its join timeout (wedged in a
+            # dispatch) and still owns scheduler state — never step
+            # under it; close() below only retires registry series
+            r.engine.close()
+        self.metrics.unregister()

@@ -43,7 +43,21 @@ __all__ = ["ServingConfig", "ServingEngine", "GenerationRequest",
 
 
 class EngineOverloadError(RuntimeError):
-    """Admission queue full: the request was shed, not enqueued."""
+    """Admission queue full: the request was shed, not enqueued.
+
+    Structured fields — the server/router and bench tooling read state
+    instead of parsing the message: `queue_depth` (requests waiting at
+    shed time), `running` (slots occupied), `retry_after_s` (suggested
+    client backoff: the engine's queue-wait p50 when it has samples,
+    else None — callers apply their own floor)."""
+
+    def __init__(self, message: str, queue_depth: Optional[int] = None,
+                 running: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.running = running
+        self.retry_after_s = retry_after_s
 
 
 class ServingConfig:
@@ -229,6 +243,7 @@ class ServingEngine:
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.shed += 1
                 req.state = "shed"
+                shed_depth = len(self._queue)
             else:
                 req.metrics.mark_submitted()
                 self._queue.append(req)
@@ -240,7 +255,9 @@ class ServingEngine:
         _watchdog.notify_overload(self.metrics.engine_label)
         raise EngineOverloadError(
             f"admission queue full ({self.config.max_queue}); "
-            "request shed")
+            "request shed",
+            queue_depth=shed_depth, running=self.kv.active_count,
+            retry_after_s=self.metrics.queue_wait_p50())
 
     # -- drive loop ---------------------------------------------------------
 

@@ -214,6 +214,13 @@ class EngineMetrics:
         for fam in self._families:
             fam.remove(engine=self.engine_label)
 
+    def queue_wait_p50(self) -> Optional[float]:
+        """Median queue wait (seconds) over the recent request window —
+        the Retry-After hint a shed (EngineOverloadError) carries so the
+        HTTP tier can tell clients how long a slot realistically takes
+        to free. None until a request has completed the queue."""
+        return self._hists["queue_wait"].quantile(0.5)
+
     def observe_dispatch_tokens(self, n: int) -> None:
         """One collected decode dispatch emitted n live tokens (frozen
         ride-along repeats excluded) — the amortization series the

@@ -202,6 +202,35 @@ def test_overload_sheds_instead_of_queueing(trained):
     assert eng.stats()["completed"] == 2     # shed requests never ran
 
 
+def test_overload_error_carries_structured_fields(trained):
+    """EngineOverloadError exposes queue depth / running count / a
+    retry-after hint as FIELDS (the HTTP tier and bench tooling read
+    state, never parse messages). The hint is the queue-wait p50 once
+    requests have flowed, None before any sample exists."""
+    eng = make_engine(trained, num_slots=1, max_queue=1)
+    p = np.asarray([1, 2, 3], np.int32)
+    eng.submit(p, max_new_tokens=2)
+    with pytest.raises(EngineOverloadError) as ei:
+        eng.submit(p, max_new_tokens=2)
+    assert ei.value.queue_depth == 1
+    assert ei.value.running == 0             # nothing admitted yet
+    assert ei.value.retry_after_s is None    # no queue-wait samples yet
+    assert eng.metrics.queue_wait_p50() is None
+    eng.run_until_drained()                  # completes the queued one
+    eng.submit(p, max_new_tokens=8)
+    eng.step()                               # admit: occupies the slot
+    eng.submit(p, max_new_tokens=2)          # queue full again
+    with pytest.raises(EngineOverloadError) as ei:
+        eng.submit(p, max_new_tokens=2)
+    assert ei.value.queue_depth == 1
+    assert ei.value.running == 1             # the admitted request
+    # the hint now comes from the completed request's queue wait
+    assert ei.value.retry_after_s == eng.metrics.queue_wait_p50()
+    assert ei.value.retry_after_s is not None
+    assert ei.value.retry_after_s >= 0
+    eng.run_until_drained()
+
+
 def test_submit_validation(trained):
     eng = make_engine(trained)               # buckets (4, 8), max_len 32
     with pytest.raises(ValueError, match="bucket"):

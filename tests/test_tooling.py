@@ -227,6 +227,96 @@ def test_bench_serving_debug_port_flag(capsys, monkeypatch):
     assert obs.get_debug_server() is None    # stopped on exit
 
 
+def test_bench_serving_http_row_shape():
+    """tools/bench_serving --http: one wire-path row per concurrency
+    with client-measured end-to-end TTFT/TPOT next to the same
+    registry-sourced engine columns the library rows carry."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_http("tiny", concurrencies=[2],
+                                  requests_per_level=3, max_new=4)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "tiny_serving_http_c2"
+    assert row["value"] > 0 and row["unit"] == "tokens/s"
+    e = row["extra"]
+    assert e["transport"] == "http"
+    assert e["completed"] == 3
+    # end-to-end wire cuts present and sane (wire TTFT includes the
+    # engine-side TTFT plus HTTP/JSON/SSE overhead)
+    assert e["e2e_mean_ttft_ms"] > 0
+    assert e["e2e_p50_ttft_ms"] > 0
+    assert e["e2e_mean_ttft_ms"] >= e["mean_ttft_ms"] * 0.5
+    # registry-sourced engine columns preserved, same as library rows
+    for k in ("mean_ttft_ms", "mean_tpot_ms", "p50_ttft_ms",
+              "p99_ttft_ms", "dispatches", "blocks_total",
+              "compiled_executables"):
+        assert e[k] is not None, (k, e)
+    assert e["server_requests_ok"] == 3
+    # the server was torn down: no leftover wire surface
+    import paddle_tpu as pt
+    snap = pt.observability.get_registry().snapshot()
+    assert not snap.get("server_active_streams", {}).get("series")
+
+
+def test_server_smoke_start_generate_drain():
+    """Serving-service smoke on an ephemeral port: start -> one SSE
+    generate -> graceful drain/shutdown, engine + router registry
+    series retired afterwards."""
+    import http.client
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+
+    cfg = GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                    max_pos=64, dropout=0.0, attn_impl="xla")
+    main_prog, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+    server = pt.server.serve(
+        params, cfg,
+        pt.server.ServerConfig(
+            port=0, serving=pt.serving.ServingConfig(
+                num_slots=2, prefill_buckets=(4, 8), max_len=32)))
+    try:
+        assert server.port > 0
+        eng_label = server.router.replicas[0].engine.metrics.engine_label
+        router_label = server.router.metrics.label
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"prompt": [5, 7, 11],
+                                 "max_new_tokens": 4}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        body = r.read().decode()
+        conn.close()
+        assert body.count("data: ") == 5       # 4 tokens + done frame
+        assert "event: done" in body
+        assert '"finish_reason": "length"' in body
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        conn.close()
+        assert health["status"] == "ok"
+        assert health["replicas"][0]["engine"] == eng_label
+    finally:
+        server.shutdown()                      # drain -> close engines
+    snap = pt.observability.get_registry().snapshot()
+    for family, label_key, label in (
+            ("serving_submitted_total", "engine", eng_label),
+            ("server_active_streams", "router", router_label),
+            ("server_requests_total", "router", router_label)):
+        rows = snap.get(family, {}).get("series", [])
+        assert not any(s["labels"].get(label_key) == label
+                       for s in rows), (family, rows)
+
+
 def test_trace_summary_cli_smoke():
     """tools/trace_summary.py over a trace written by the observability
     exporter: top-N self-time table prints, JSON mode parses."""

@@ -41,12 +41,25 @@ carries both TTFT cuts, the measured speedup, and the registry-sourced
 hit rate, so the shared-prompt win is a printed number, not a claim:
 
     python tools/bench_serving.py tiny --shared-prefix
+
+`--http` additionally drives a LIVE `paddle_tpu.server` instance over
+the wire with threaded SSE clients and prints one
+`<model>_serving_http_c<cc>` row per concurrency NEXT TO the
+library-path rows: `value` is wire tokens/s, `extra` carries the
+END-TO-END client-measured TTFT/TPOT (request sent -> first/ last SSE
+frame, i.e. including HTTP+JSON+SSE overhead) alongside the same
+registry-sourced engine-side columns the library rows report — the
+wire tax is the delta between the paired rows:
+
+    python tools/bench_serving.py tiny --http
 """
 
 import argparse
+import http.client
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -316,6 +329,165 @@ def run_shared_prefix(name, requests=None, max_new=16, concurrency=None):
     }]
 
 
+def _sse_generate(port, payload, timeout=120):
+    """POST /v1/generate and consume the SSE stream, stamping
+    perf_counter at every frame. Returns (status, tokens, stamps,
+    done_payload) — stamps[0] is the first-token arrival, the
+    end-to-end TTFT numerator."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/generate", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        if r.status != 200:
+            return r.status, [], [], json.loads(r.read() or b"{}")
+        tokens, stamps, done, event = [], [], None, "message"
+        for line in iter(r.readline, b""):
+            line = line.decode().rstrip("\n")
+            if not line:
+                event = "message"
+                continue
+            if line.startswith("event: "):
+                event = line[7:]
+                continue
+            if line.startswith("data: "):
+                obj = json.loads(line[6:])
+                if event == "done":
+                    done = obj
+                else:
+                    tokens.append(obj["token"])
+                    stamps.append(time.perf_counter())
+        return 200, tokens, stamps, done
+    finally:
+        conn.close()
+
+
+def run_http(name, concurrencies=None, requests_per_level=None,
+             max_new=32, decode_chunk=8):
+    """--http mode: the library request mix driven over the wire against
+    a live GenerationServer (one engine per level, cc client threads).
+    Rows mirror run_model's registry-sourced engine columns and ADD the
+    client-measured end-to-end cuts, so wire overhead is the printed
+    delta between `<model>_serving_c<cc>_k<chunk>` and
+    `<model>_serving_http_c<cc>` rows."""
+    import paddle_tpu as pt
+    from paddle_tpu.server import GenerationServer, ServerConfig
+
+    gpt_kwargs, default_cc, prompt_lens, buckets = MODELS[name]
+    concurrencies = concurrencies or default_cc
+    requests_per_level = requests_per_level or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    cfg, params = build_params(gpt_kwargs)
+    max_len = max(buckets) + max_new
+    rows = []
+    for cc in concurrencies:
+        rng = np.random.RandomState(0)         # same mix as run_model
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(num_slots=cc,
+                                     max_queue=max(requests_per_level,
+                                                   16),
+                                     prefill_buckets=buckets,
+                                     max_len=max_len,
+                                     decode_chunk=decode_chunk))
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               (prompt_lens[i % len(prompt_lens)],)
+                               ).astype(np.int32)
+                   for i in range(requests_per_level)]
+        # warm every executable on the library path BEFORE the server
+        # owns the engine, then drop the warmup's registry rows
+        eng.generate([np.ones((b,), np.int32) for b in buckets],
+                     max_new_tokens=2)
+        eng.metrics.unregister()
+        eng.metrics = pt.serving.EngineMetrics()
+        eng.kv.prefix_hits = eng.kv.prefix_misses = 0
+        server = GenerationServer([eng], ServerConfig())
+        port = server.serve()
+        work = list(enumerate(prompts))
+        results, lock = [], threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    i, p = work.pop()
+                t_sent = time.perf_counter()
+                status, tokens, stamps, done = _sse_generate(
+                    port, {"prompt": [int(x) for x in p],
+                           "max_new_tokens": max_new, "seed": i})
+                with lock:
+                    results.append((status, t_sent, tokens, stamps))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker) for _ in range(cc)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        label = eng.stats()["engine_label"]
+        s = eng.stats()
+        ok = [row for row in results if row[0] == 200]
+        tokens = sum(len(r[2]) for r in ok)
+        ttfts = sorted(r[3][0] - r[1] for r in ok if r[3])
+        tpots = [(r[3][-1] - r[3][0]) / (len(r[3]) - 1)
+                 for r in ok if len(r[3]) > 1]
+        quantiles = _registry_quantiles(label)
+        dispatches = _registry_counter(label, "serving_dispatches_total")
+        rows.append({
+            "metric": f"{name}_serving_http_c{cc}",
+            "value": round(tokens / dt, 2) if dt else None,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "extra": {
+                "transport": "http",
+                "requests": requests_per_level,
+                "completed": len(ok),
+                "max_new": max_new,
+                "decode_chunk": decode_chunk,
+                # client-measured end-to-end cuts (incl. wire overhead)
+                "e2e_mean_ttft_ms": round(
+                    sum(ttfts) / len(ttfts) * 1e3, 2) if ttfts else None,
+                "e2e_p50_ttft_ms": round(
+                    ttfts[len(ttfts) // 2] * 1e3, 2) if ttfts else None,
+                "e2e_mean_tpot_ms": round(
+                    sum(tpots) / len(tpots) * 1e3, 3) if tpots else None,
+                # the same registry-sourced engine-side columns the
+                # library rows carry (scrape-path truth, not internals)
+                "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2)
+                    if s["mean_ttft"] is not None else None,
+                "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3)
+                    if s["mean_tpot"] is not None else None,
+                "dispatches": dispatches,
+                "dispatches_per_token": round(dispatches / tokens, 4)
+                    if tokens else None,
+                "blocks_used_peak": s["peak_blocks_used"],
+                "blocks_total": s["blocks_total"],
+                "compiled_executables": s["compiled_executables"],
+                "server_requests_ok": _server_requests(
+                    server.router.metrics.label, "200"),
+                **quantiles,
+            },
+        })
+        server.shutdown()      # drain + refcounted engine close()
+    return rows
+
+
+def _server_requests(router_label, code):
+    """server_requests_total summed over tenants for one router+code —
+    the wire-level acceptance count a scrape sees."""
+    from paddle_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+    total = 0
+    for row in snap.get("server_requests_total", {}).get("series", []):
+        if row["labels"].get("router") == router_label \
+                and row["labels"].get("code") == code:
+            total += int(row["value"])
+    return total
+
+
 def _registry_quantiles(engine_label):
     """p50/p99 TTFT/TPOT in ms, read back from the observability registry
     snapshot (NOT from engine internals) — proves the scrape path carries
@@ -352,6 +524,11 @@ def main(argv=None):
                     help="run the prefix-sharing workload instead: N "
                          "requests over one long system prompt, prefix "
                          "cache off (cold) vs on, TTFT compared per row")
+    ap.add_argument("--http", action="store_true",
+                    help="also drive a live paddle_tpu.server over the "
+                         "wire: one <model>_serving_http_c<cc> row per "
+                         "concurrency with client-measured end-to-end "
+                         "TTFT/TPOT next to the library-path rows")
     args = ap.parse_args(argv)
     unknown = [m for m in args.models if m not in MODELS]
     if unknown:
@@ -369,9 +546,16 @@ def main(argv=None):
         print(f"debug server: http://127.0.0.1:{port}", file=sys.stderr)
     try:
         for name in args.models or list(MODELS):
-            rows = run_shared_prefix(name) if args.shared_prefix \
-                else run_model(name,
-                               decode_chunks=tuple(args.decode_chunk))
+            if args.shared_prefix:
+                rows = run_shared_prefix(name)
+            else:
+                rows = run_model(name,
+                                 decode_chunks=tuple(args.decode_chunk))
+                if args.http:
+                    # wire rows ride NEXT TO the library rows so the
+                    # HTTP/SSE overhead is the visible per-cc delta
+                    rows += run_http(
+                        name, decode_chunk=max(args.decode_chunk))
             for row in rows:
                 print(json.dumps(row), flush=True)
     finally:

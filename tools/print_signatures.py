@@ -34,6 +34,7 @@ MODULES = [
     "paddle_tpu.dataset",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.server",
     "paddle_tpu.profiler",
     "paddle_tpu.observability",
     "paddle_tpu.dygraph",
