@@ -21,6 +21,12 @@ Weights are read from the training scope by the var names gpt_lm_program
 creates, so a trained static-graph model generates without any export
 step. Forward math mirrors models/gpt.py exactly (pre-LN, separate
 q/k/v, tanh gelu, tied wte head, f32 LN stats).
+
+The serving chunk kernels additionally support SPECULATIVE DECODING
+(speculate_k > 0): a carried per-slot n-gram drafter proposes k tokens,
+one gpt_decode_verify_{slots,pages} pass scores them all, and in-graph
+exact-match acceptance commits 1..k+1 tokens per model pass without
+changing any stream (see _spec_step).
 """
 
 from __future__ import annotations
@@ -33,7 +39,8 @@ __all__ = ["collect_gpt_params", "gpt_forward_logits", "gpt_prefill",
            "gpt_prefill_padded", "gpt_decode_step", "gpt_decode_step_slots",
            "gpt_decode_chunk_slots", "gpt_prefill_pages",
            "gpt_decode_step_pages", "gpt_decode_chunk_pages",
-           "gpt_generate"]
+           "gpt_decode_verify_slots", "gpt_decode_verify_pages",
+           "spec_ngram_seed", "gpt_generate"]
 
 
 def _ln_names(name):
@@ -281,9 +288,230 @@ def gpt_decode_step_slots(params, cfg, tokens, cache, ts):
     return _head_logits(params, x), cache
 
 
+def gpt_decode_verify_slots(params, cfg, toks, cache, ts):
+    """Multi-position decode step over the slot dim — the speculative
+    VERIFY pass. toks: (S, D) int32 candidate tokens at absolute
+    positions ts..ts+D-1 per slot (column 0 is each slot's committed
+    current token, columns 1.. the drafter's proposals). One batched
+    pass writes all D K/V rows and returns logits for EVERY position —
+    (S, D, V) f32 — so one model dispatch scores the whole draft run
+    instead of D sequential steps.
+
+    Causality inside the window: the query at offset j attends
+    [0, ts+j], and rows ts..ts+j are written THIS pass before the
+    layer's attention gather — so a previous pass's rejected-tail rows
+    in [ts, ts+D) are always rewritten before anything reads them
+    (the write-pointer "rewind" is implicit in re-verifying from the
+    committed position). Writes past max_len are dropped by the
+    scatter; the budget mask never commits tokens there. Per-position
+    math is gpt_decode_step_slots's row-for-row: D=1 is exactly that
+    kernel."""
+    import jax.numpy as jnp
+
+    heads = cfg.heads
+    hd = cfg.hidden // cfg.heads
+    max_len = cache.shape[4]
+    s_dim, D = toks.shape
+    dtype = cache.dtype
+    rows = jnp.arange(s_dim)[:, None]
+    pos = ts[:, None] + jnp.arange(D)[None, :]           # (S, D)
+    x = (params["wte"][toks] + params["wpe"][pos]).astype(dtype)
+    pos_mask = (jnp.arange(max_len)[None, None, :] <= pos[:, :, None])
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _dense(h, blk["q"]).reshape(s_dim, D, heads, hd)
+        k = _dense(h, blk["k"]).reshape(s_dim, D, heads, hd)
+        v = _dense(h, blk["v"]).reshape(s_dim, D, heads, hd)
+        cache = cache.at[li, 0, rows, :, pos, :].set(k)
+        cache = cache.at[li, 1, rows, :, pos, :].set(v)
+        K, V = cache[li, 0], cache[li, 1]          # (S, n, L, hd)
+        scores = jnp.einsum("bqnd,bnkd->bnqk", q, K,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(pos_mask[:, None, :, :],
+                           scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bnkd->bqnd", probs, V).reshape(s_dim, D, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    x = _ln(x, params["lnf"])
+    return (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32), cache
+
+
+def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
+    """gpt_decode_verify_slots over the PAGED pool: the D per-slot K/V
+    writes go through the page table, and two redirects keep the arena
+    sound — `done` slots write the reserved scratch block (the frozen-
+    slot discipline: a retired slot's reallocated blocks must never be
+    dirtied by its ride-along verify), and positions whose page index
+    runs past the page row land in scratch too (draft overshoot past a
+    sequence's allocated tail, same rule as gpt_prefill_pages' pad
+    writes). Candidates at such positions read garbage and are never
+    committed — the budget mask stops strictly before the allocated
+    region ends."""
+    import jax.numpy as jnp
+
+    heads = cfg.heads
+    hd = cfg.hidden // cfg.heads
+    bs = arena.shape[4]
+    s_dim, P = pt.shape
+    D = toks.shape[1]
+    L = P * bs
+    dtype = arena.dtype
+    rows = jnp.arange(s_dim)[:, None]
+    pos = ts[:, None] + jnp.arange(D)[None, :]           # (S, D)
+    x = (params["wte"][toks] + params["wpe"][pos]).astype(dtype)
+    pos_mask = (jnp.arange(L)[None, None, :] <= pos[:, :, None])
+    pidx = pos // bs
+    wblk = jnp.where(pidx < P, pt[rows, jnp.minimum(pidx, P - 1)], 0)
+    if done is not None:
+        wblk = jnp.where(done[:, None], 0, wblk)
+    woff = pos % bs
+    for li, blk in enumerate(params["blocks"]):
+        h = _ln(x, blk["ln1"])
+        q = _dense(h, blk["q"]).reshape(s_dim, D, heads, hd)
+        k = _dense(h, blk["k"]).reshape(s_dim, D, heads, hd)
+        v = _dense(h, blk["v"]).reshape(s_dim, D, heads, hd)
+        arena = arena.at[li, 0, wblk, :, woff, :].set(k)
+        arena = arena.at[li, 1, wblk, :, woff, :].set(v)
+        K = _gather_pages(arena[li, 0], pt)        # (S, n, L, hd)
+        V = _gather_pages(arena[li, 1], pt)
+        scores = jnp.einsum("bqnd,bnkd->bnqk", q, K,
+                            preferred_element_type=jnp.float32)
+        scores = jnp.where(pos_mask[:, None, :, :],
+                           scores / np.sqrt(hd), -1e30)
+        probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
+        ctx = jnp.einsum("bnqk,bnkd->bqnd", probs, V).reshape(s_dim, D, -1)
+        x = x + _dense(ctx, blk["out"])
+        h = _ln(x, blk["ln2"])
+        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+    x = _ln(x, params["lnf"])
+    return (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32), arena
+
+
+def _ngram_hash(a, b, size):
+    """Hash a 2-token drafter context into [0, size). Deterministic in
+    the token ids; collisions only cost acceptance rate, never
+    correctness — every draft is verified by the target model."""
+    import jax.numpy as jnp
+    ua = a.astype(jnp.uint32) * jnp.uint32(2654435761)
+    ub = b.astype(jnp.uint32) * jnp.uint32(40503)
+    return ((ua ^ ub) % jnp.uint32(size)).astype(jnp.int32)
+
+
+def spec_ngram_seed(table, slot, tokens, real_len):
+    """Reset one slot's drafter row and seed it with the prompt's
+    trigram statistics: context (tokens[j-2], tokens[j-1]) predicts
+    tokens[j] for every real j — prompt-lookup decoding's free lunch on
+    repetitive/structured text. tokens: (B,) int32 right-padded prompt
+    suffix; real_len: traced scalar count of real entries. table:
+    (S, T+1) int32 where column T is the trash column masked writes
+    land in and -1 marks "no prediction". The RESET is what matters for
+    hygiene: slot reuse must not draft from the previous occupant's
+    stream (drafts are verified, so stale entries could never corrupt
+    tokens — but acceptance stats must be a function of THIS request
+    alone)."""
+    import jax.numpy as jnp
+    B = tokens.shape[0]
+    size = table.shape[1] - 1
+    table = table.at[slot].set(-1)
+    if B < 3:
+        return table
+    idx = _ngram_hash(tokens[:-2], tokens[1:-1], size)   # (B-2,)
+    idx = jnp.where(jnp.arange(2, B) < real_len, idx, size)
+    return table.at[slot, idx].set(tokens[2:])
+
+
+def _spec_step(verify, sample_fn, temps, eos_ids, speculate_k, carry):
+    """One draft -> verify -> accept iteration of the speculative chunk
+    loop, shared by the slab and paged kernels. carry = (tok, pool, ts,
+    keys, done, rem, prev, table); verify(inputs (S, k+1), pool, ts,
+    done) -> (logits (S, k+1, V), pool). Returns (carry', (out_tokens
+    (k+1, S), counts (S,))).
+
+    Acceptance is EXACT-MATCH against what the sampler itself produces:
+    candidate j is sample_fn(key_j, logits_j, temp) where the key chain
+    advances one split per candidate — precisely the sequential
+    schedule — and logits_j are conditioned on the committed stream
+    only while every draft before j matched. So each committed token
+    equals, bit for bit, what the non-speculative path would have
+    emitted with the same seed: the drafter changes WHEN tokens arrive
+    (how many commit per model pass), never WHICH. Greedy is the
+    temp=0 special case (candidates are argmax rows).
+
+    EOS/budget stops are applied inside the accepted run with the
+    host's exact finish rule, so the committed run always ends at the
+    finish token; frozen slots re-emit their token with count 1 and
+    advance their key chain by one split — the non-speculative
+    ride-along cadence."""
+    import jax
+    import jax.numpy as jnp
+
+    k = int(speculate_k)
+    tok, pool, ts, keys, done, rem, prev, table = carry
+    s_dim = tok.shape[0]
+    rows = jnp.arange(s_dim)
+    size = table.shape[1] - 1
+    # draft: k chained trigram lookups; a miss (-1) proposes token 0 —
+    # shapes are fixed, so a hopeless draft costs nothing extra
+    drafts = []
+    a, b = prev, tok
+    for _ in range(k):
+        d = table[rows, _ngram_hash(a, b, size)]
+        d = jnp.where(d < 0, 0, d)
+        drafts.append(d)
+        a, b = b, d
+    inputs = jnp.stack([tok] + drafts, axis=1)           # (S, k+1)
+    logits, pool = verify(inputs, pool, ts, done)
+    cands, chain, cur = [], [keys], keys
+    for j in range(k + 1):
+        cj, cur = jax.vmap(sample_fn)(cur, logits[:, j], temps)
+        cands.append(cj)
+        chain.append(cur)
+    cands = jnp.stack(cands, axis=1)                     # (S, k+1)
+    chain = jnp.stack(chain, axis=1)                     # (S, k+2, key)
+    dr = jnp.stack(drafts, axis=1)                       # (S, k)
+    # candidate j is valid only while drafts 0..j-1 all matched (its
+    # logits saw the committed stream); the mask is monotone by cumprod
+    lead = jnp.cumprod((cands[:, :k] == dr).astype(jnp.int32), axis=1)
+    base = jnp.concatenate(
+        [jnp.ones((s_dim, 1), bool), lead.astype(bool)], axis=1)
+    jj = jnp.arange(k + 1)[None, :]
+    stop = (cands == eos_ids[:, None]) | (rem[:, None] - (jj + 1) <= 0)
+    stopped_before = jnp.concatenate(
+        [jnp.zeros((s_dim, 1), bool),
+         jnp.cumsum(stop.astype(jnp.int32), axis=1)[:, :-1] > 0], axis=1)
+    can = base & ~stopped_before             # monotone commit mask
+    c = can.sum(axis=1).astype(jnp.int32)    # >= 1: j=0 always commits
+    live = ~done
+    last = cands[rows, c - 1]
+    prev_commit = jnp.where(c >= 2, cands[rows, jnp.maximum(c - 2, 0)],
+                            tok)
+    ndone = done | (can & stop).any(axis=1)
+    # n-gram table update: every committed token registered under its
+    # 2-token context (frozen slots and rejected tails -> trash column)
+    seq = jnp.concatenate([prev[:, None], tok[:, None], cands], axis=1)
+    idx = _ngram_hash(seq[:, :k + 1], seq[:, 1:k + 2], size)
+    idx = jnp.where(can & live[:, None], idx, size)
+    table = table.at[rows[:, None], idx].set(cands)
+    out = jnp.where(live[:, None],
+                    jnp.where(can, cands, last[:, None]), tok[:, None])
+    counts = jnp.where(live, c, 1)
+    keys = chain[rows, jnp.where(live, c, 1)]
+    tok = jnp.where(live, last, tok)
+    prev = jnp.where(live, prev_commit, prev)
+    ts = jnp.where(live, ts + c, ts)
+    rem = jnp.where(live, rem - c, rem)
+    return ((tok, pool, ts, keys, ndone, rem, prev, table),
+            (out.T, counts))
+
+
 def gpt_decode_chunk_slots(params, cfg, tokens, cache, ts, keys, temps,
                            done, remaining, eos_ids, chunk,
-                           sample_fn=None):
+                           sample_fn=None, speculate_k=0,
+                           spec_state=None):
     """Fused multi-token decode: `chunk` iterations of
     gpt_decode_step_slots + per-slot sampling + in-graph EOS/budget
     masking inside ONE lax.scan — a single dispatch (and a single host
@@ -320,6 +548,20 @@ def gpt_decode_chunk_slots(params, cfg, tokens, cache, ts, keys, temps,
     Returns (block (chunk, S) int32 — iteration-major, so block[i, s] is
     slot s's i-th in-chunk token — tokens, cache, ts, keys, done,
     remaining), the post-chunk carry the next dispatch resumes from.
+
+    SPECULATIVE MODE (speculate_k > 0): each scan iteration becomes a
+    draft -> verify -> accept pass — the per-slot n-gram drafter in
+    spec_state = (prev (S,) int32 previous committed token, table
+    (S, T+1) int32 trigram table; see spec_ngram_seed) proposes
+    speculate_k tokens, ONE gpt_decode_verify_slots pass scores every
+    draft position, and in-graph exact-match acceptance (_spec_step)
+    commits the matched run plus one corrected token — between 1 and
+    speculate_k+1 tokens per model pass, streams bit-identical to
+    speculate_k=0 at every chunk size. The return shape changes to
+    (block (chunk, speculate_k+1, S), counts (chunk, S), tokens, cache,
+    ts, keys, done, remaining, spec_state): block[i, :counts[i, s], s]
+    are slot s's committed tokens of pass i, entries past the count
+    are frozen repeats the host discards.
     """
     import jax
     import jax.numpy as jnp
@@ -327,6 +569,24 @@ def gpt_decode_chunk_slots(params, cfg, tokens, cache, ts, keys, temps,
     if sample_fn is None:
         def sample_fn(key, logits, temp):
             return jnp.argmax(logits, -1).astype(jnp.int32), key
+
+    if int(speculate_k) > 0:
+        prev, table = spec_state
+
+        def verify(inputs, cache, ts, done):
+            return gpt_decode_verify_slots(params, cfg, inputs, cache,
+                                           ts)
+
+        def body(carry, _):
+            return _spec_step(verify, sample_fn, temps, eos_ids,
+                              speculate_k, carry)
+
+        carry = (tokens, cache, ts, keys, done, remaining, prev, table)
+        (tokens, cache, ts, keys, done, remaining, prev, table), \
+            (block, counts) = jax.lax.scan(body, carry, None,
+                                           length=int(chunk))
+        return (block, counts, tokens, cache, ts, keys, done, remaining,
+                (prev, table))
 
     def body(carry, _):
         tok, cache, ts, keys, done, rem = carry
@@ -483,7 +743,8 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
 
 def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
                            temps, done, remaining, eos_ids, chunk,
-                           sample_fn=None):
+                           sample_fn=None, speculate_k=0,
+                           spec_state=None):
     """gpt_decode_chunk_slots over the paged pool: `chunk` iterations of
     gpt_decode_step_pages + per-slot sampling + in-graph EOS/budget
     masking in ONE lax.scan. Carry/masking semantics are identical to
@@ -495,13 +756,39 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
     ((S, P) int32) is read-only here — it changes only at admission.
 
     Returns (block (chunk, S) int32, tokens, arena, ts, keys, done,
-    remaining)."""
+    remaining).
+
+    SPECULATIVE MODE (speculate_k > 0): as in gpt_decode_chunk_slots —
+    each iteration drafts speculate_k tokens from the carried per-slot
+    n-gram table, verifies them in one gpt_decode_verify_pages pass
+    (frozen slots' AND past-the-page-row writes redirected to scratch),
+    and commits the accepted run + one corrected token in-graph.
+    Returns (block (chunk, speculate_k+1, S), counts (chunk, S),
+    tokens, arena, ts, keys, done, remaining, spec_state)."""
     import jax
     import jax.numpy as jnp
 
     if sample_fn is None:
         def sample_fn(key, logits, temp):
             return jnp.argmax(logits, -1).astype(jnp.int32), key
+
+    if int(speculate_k) > 0:
+        prev, table = spec_state
+
+        def verify(inputs, arena, ts, done):
+            return gpt_decode_verify_pages(params, cfg, inputs, arena,
+                                           pt, ts, done)
+
+        def body(carry, _):
+            return _spec_step(verify, sample_fn, temps, eos_ids,
+                              speculate_k, carry)
+
+        carry = (tokens, arena, ts, keys, done, remaining, prev, table)
+        (tokens, arena, ts, keys, done, remaining, prev, table), \
+            (block, counts) = jax.lax.scan(body, carry, None,
+                                           length=int(chunk))
+        return (block, counts, tokens, arena, ts, keys, done, remaining,
+                (prev, table))
 
     def body(carry, _):
         tok, arena, ts, keys, done, rem = carry

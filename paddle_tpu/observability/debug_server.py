@@ -68,10 +68,12 @@ def _span_request_id(s: Span) -> Optional[str]:
 
 
 def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
-    """Per-engine paged-KV rollup for /varz: the prefix-cache hit ratio
-    an operator would otherwise have to derive from two counters by
-    hand, keyed by engine label. Computed from the registry snapshot
-    only — no engine references, same as every other /varz column."""
+    """Per-engine serving rollups for /varz: ratios an operator would
+    otherwise have to derive from counter pairs by hand — the paged
+    pool's prefix-cache hit ratio and the speculative decoder's draft
+    acceptance ratio — keyed by engine label. Computed from the
+    registry snapshot only — no engine references, same as every other
+    /varz column."""
     def by_engine(name):
         return {r["labels"].get("engine"): r["value"]
                 for r in snap.get(name, {}).get("series", [])}
@@ -86,7 +88,19 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
             "prefix_cache_misses": m,
             "prefix_hit_ratio": round(h / (h + m), 4) if h + m else None,
         }
-    return {"prefix_hit_ratio": out}
+    proposed = by_engine("serving_spec_proposed_total")
+    accepted = by_engine("serving_spec_accepted_total")
+    spec = {}
+    for label in sorted(set(proposed) | set(accepted), key=str):
+        p, a = int(proposed.get(label, 0)), int(accepted.get(label, 0))
+        spec[label] = {
+            "spec_proposed": p,
+            "spec_accepted": a,
+            # share of drafted tokens that verification accepted; None
+            # until the engine has run a speculative pass
+            "spec_accept_ratio": round(a / p, 4) if p else None,
+        }
+    return {"prefix_hit_ratio": out, "spec_accept_ratio": spec}
 
 
 def _query_flag(q: Dict[str, str], name: str) -> bool:

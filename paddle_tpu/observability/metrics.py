@@ -213,13 +213,26 @@ class MetricFamily:
         self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
         self._lock = threading.Lock()
 
-    def labels(self, **labels: Any):
-        """Get or create the series for this label set."""
+    def labels(self, _buckets: Optional[Sequence[float]] = None,
+               **labels: Any):
+        """Get or create the series for this label set. `_buckets`
+        (histogram families only) overrides the family bucket layout for
+        THIS series at creation — for count-scaled histograms whose
+        natural range is a per-creator parameter (e.g. tokens-per-
+        dispatch scales with an engine's decode_chunk × speculation
+        factor, and engines with different settings share one process
+        registry). The override is explicit per series, so the family-
+        level conflict check below still guards against two creators
+        silently misfiling into each other's layout; a later labels()
+        call for an existing series ignores `_buckets`."""
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         with self._lock:
             series = self._series.get(key)
             if series is None:
-                series = _KINDS[self.kind](**self._series_kw)
+                kw = dict(self._series_kw)
+                if _buckets is not None:
+                    kw["buckets"] = tuple(_buckets)
+                series = _KINDS[self.kind](**kw)
                 self._series[key] = series
             return series
 

@@ -74,7 +74,14 @@ class ServingConfig:
     UP to oversubscribe worst-case contexts, admission queues when pages
     run out); prefix_cache toggles hashed prefix sharing (shared system
     prompts are prefilled and stored once, refcounted, LRU-kept while
-    unreferenced)."""
+    unreferenced).
+
+    Speculation knobs: speculate_k > 0 turns every fused decode
+    iteration into a draft -> verify -> accept pass over k self-drafted
+    tokens (in-graph per-slot n-gram drafter — no second model), so
+    tokens-per-model-pass rises to up to k+1 on accept streaks while
+    token streams stay bit-identical to speculate_k=0;
+    speculate_ngram sizes the hashed per-slot drafter table."""
 
     def __init__(self, num_slots: int = 4, max_queue: int = 16,
                  prefill_buckets: Optional[Sequence[int]] = None,
@@ -84,6 +91,8 @@ class ServingConfig:
                  block_size: int = 16,
                  kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
+                 speculate_k: int = 0,
+                 speculate_ngram: int = 512,
                  clock: Callable[[], float] = time.monotonic):
         self.num_slots = int(num_slots)
         self.max_queue = int(max_queue)
@@ -103,6 +112,13 @@ class ServingConfig:
         # immediately — simplest latency profile, no pipelining)
         self.decode_chunk = int(decode_chunk)
         self.overlap = bool(overlap)
+        # speculative decoding (off by default): each chunk iteration
+        # drafts speculate_k tokens from a per-slot n-gram table and
+        # verifies them in ONE model pass — between 1 and k+1 tokens
+        # per pass, token streams bit-identical to speculate_k=0.
+        # speculate_ngram sizes the hashed trigram table per slot.
+        self.speculate_k = int(speculate_k)
+        self.speculate_ngram = int(speculate_ngram)
         self.clock = clock
 
 
@@ -184,13 +200,22 @@ class ServingEngine:
                               prefix_cache=serving.prefix_cache)
         self.scheduler = ContinuousBatchingScheduler(
             params, cfg, self.kv, self.buckets, top_k=serving.top_k,
-            decode_chunk=serving.decode_chunk, overlap=serving.overlap)
+            decode_chunk=serving.decode_chunk, overlap=serving.overlap,
+            speculate_k=serving.speculate_k,
+            speculate_ngram=serving.speculate_ngram)
         # launch-side heartbeat: bumped at dispatch ENQUEUE inside the
         # scheduler, not after step() returns — a device hang leaves the
         # host blocked in the next fetch, and the watchdog/flight record
         # must still see the last launch that went in
         self.scheduler.on_launch = self._on_dispatch_launched
-        self.metrics = EngineMetrics()
+        # count-scaled histogram layout: one dispatch can emit up to
+        # num_slots * decode_chunk * (1 + speculate_k) tokens, and the
+        # acceptance histogram spans 0..speculate_k accepted per pass
+        self.metrics = EngineMetrics(
+            max_tokens_per_dispatch=(serving.num_slots
+                                     * serving.decode_chunk
+                                     * (1 + serving.speculate_k)),
+            speculate_k=serving.speculate_k)
         self.metrics.kv_blocks_total = self.kv.blocks_total
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
@@ -374,6 +399,15 @@ class ServingEngine:
         for event in events:
             self._emit(event)
             emitted += 1
+        if self.scheduler.speculate_k:
+            # speculation telemetry: the scheduler's cumulative host
+            # totals ARE the registry truth (same discipline as the
+            # prefix-cache counters below), and each live verify pass
+            # feeds one accepted-run sample into the histogram
+            self.metrics.spec_proposed = self.scheduler.spec_proposed
+            self.metrics.spec_accepted = self.scheduler.spec_accepted
+            for run in self.scheduler.drain_spec_samples():
+                self.metrics.observe_spec_run(run)
         self.metrics.active_slots = self.kv.active_count
         # paged-pool visibility: block occupancy gauges + prefix-cache
         # counters (set from the allocator's cumulative totals — the

@@ -10,7 +10,8 @@ first), and engine gauges (active slots, queue depth, shed count).
 Storage is `paddle_tpu.observability.metrics`: every EngineMetrics
 instance owns labeled series (`engine="<n>"`) under stable names —
 counters `serving_<name>_total` (incl. the paged pool's
-`serving_prefix_cache_{hits,misses}_total`), gauges
+`serving_prefix_cache_{hits,misses}_total` and the speculative
+decoder's `serving_spec_{proposed,accepted}_total`), gauges
 `serving_active_slots` / `serving_queue_depth` /
 `serving_kv_blocks_{total,used,cached}`, histograms
 `serving_ttft_seconds` / `serving_tpot_seconds` /
@@ -133,6 +134,10 @@ _HELP = {
     "decode_steps": "batched decode steps executed",
     "prefills": "prefill dispatches",
     "dispatches": "fused decode-chunk dispatches launched",
+    "spec_proposed": "draft tokens proposed by the speculative "
+                     "n-gram drafter (k per live verify pass)",
+    "spec_accepted": "draft tokens accepted by verification (each "
+                     "saves one full model pass)",
     "prefix_cache_hits": "prompt blocks served from the hashed prefix "
                          "cache instead of re-prefilled",
     "prefix_cache_misses": "shareable prompt blocks that missed the "
@@ -147,13 +152,15 @@ _HELP = {
 
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
              "decode_steps", "prefills", "dispatches",
+             "spec_proposed", "spec_accepted",
              "prefix_cache_hits", "prefix_cache_misses")
 _GAUGES = ("active_slots", "queue_depth", "kv_blocks_total",
            "kv_blocks_used", "kv_blocks_cached")
 _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tpot": "serving_tpot_seconds",
                "queue_wait": "serving_queue_wait_seconds",
-               "tokens_per_dispatch": "serving_tokens_per_dispatch"}
+               "tokens_per_dispatch": "serving_tokens_per_dispatch",
+               "spec_accepted_run": "serving_spec_accepted_run"}
 _HIST_HELP = {
     "ttft": "request ttft in seconds",
     "tpot": "request tpot in seconds",
@@ -161,7 +168,29 @@ _HIST_HELP = {
     "tokens_per_dispatch": "tokens emitted per fused decode dispatch "
                            "(the chunk-amortization ratio: dispatches-"
                            "per-token is its reciprocal)",
+    "spec_accepted_run": "accepted draft-run length per speculative "
+                         "verify pass (0 = every draft rejected; "
+                         "tokens per pass is this + 1)",
 }
+
+def _count_buckets(upper: int):
+    """Power-of-two count-histogram bounds covering [1, upper] — the
+    scale-free grid for "how many per dispatch" distributions."""
+    bounds, b = [], 1
+    while b < upper:
+        bounds.append(b)
+        b *= 2
+    bounds.append(b)
+    return tuple(bounds)
+
+
+# count-scaled base layouts (NOT latency seconds): identical for every
+# EngineMetrics at the family level, per-engine scaling happens through
+# the per-SERIES bucket override (engines with different decode_chunk /
+# speculate_k share one process registry, and the registry rightly
+# refuses conflicting family-level layouts)
+_TPD_BASE = _count_buckets(512)
+_SPEC_RUN_BASE = (0, 1, 2, 3, 4, 6, 8, 12, 16)
 
 
 class EngineMetrics:
@@ -178,10 +207,19 @@ class EngineMetrics:
     _ids = itertools.count()
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 engine_label: Optional[str] = None):
+                 engine_label: Optional[str] = None,
+                 max_tokens_per_dispatch: Optional[int] = None,
+                 speculate_k: int = 0):
         self._registry = registry or get_registry()
         self.engine_label = str(engine_label if engine_label is not None
                                 else next(EngineMetrics._ids))
+        # bucket-scaling inputs kept readable so a replacement instance
+        # (an engine's post-warmup metrics reset) reproduces this
+        # engine's series layout instead of re-deriving the formula
+        self.max_tokens_per_dispatch = (int(max_tokens_per_dispatch)
+                                        if max_tokens_per_dispatch
+                                        else None)
+        self.speculate_k = int(speculate_k)
         label = {"engine": self.engine_label}
         self._families = []
         self._series = {}
@@ -196,15 +234,29 @@ class EngineMetrics:
             self._series[name] = fam.labels(**label)
         self._hists = {}
         for key, full in _HISTOGRAMS.items():
-            # tokens-per-dispatch is a COUNT distribution (1..slots*chunk),
-            # not a latency: the default seconds-scaled buckets would dump
-            # every observation in +Inf
-            buckets = ((1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
-                       if key == "tokens_per_dispatch" else None)
+            # tokens-per-dispatch / accepted-run are COUNT distributions,
+            # not latencies: the default seconds-scaled buckets would
+            # dump every observation in +Inf. The family registers the
+            # shared base grid; THIS engine's series widens it to
+            # num_slots * decode_chunk * (1 + speculate_k) (the true
+            # per-dispatch token ceiling under speculation) resp.
+            # 0..speculate_k, so accepted runs never pile into the top
+            # bucket however the engine is configured.
+            buckets = series_buckets = None
+            if key == "tokens_per_dispatch":
+                buckets = _TPD_BASE
+                if max_tokens_per_dispatch:
+                    series_buckets = _count_buckets(
+                        max(int(max_tokens_per_dispatch), _TPD_BASE[-1]))
+            elif key == "spec_accepted_run":
+                buckets = _SPEC_RUN_BASE
+                if speculate_k:
+                    series_buckets = tuple(range(int(speculate_k) + 1))
             fam = self._registry.histogram(full, _HIST_HELP[key],
                                            buckets=buckets)
             self._families.append(fam)
-            self._hists[key] = fam.labels(**label)
+            self._hists[key] = fam.labels(_buckets=series_buckets,
+                                          **label)
 
     def unregister(self) -> None:
         """Remove this engine's labeled series from the registry so a
@@ -226,6 +278,12 @@ class EngineMetrics:
         ride-along repeats excluded) — the amortization series the
         /varz- and bench-visible dispatches-per-token columns read."""
         self._hists["tokens_per_dispatch"].observe(float(n))
+
+    def observe_spec_run(self, accepted: int) -> None:
+        """One live speculative verify pass accepted `accepted` draft
+        tokens (0..speculate_k) — the per-pass acceptance distribution
+        behind the /varz acceptance-ratio rollup."""
+        self._hists["spec_accepted_run"].observe(float(accepted))
 
     def record(self, rm: RequestMetrics):
         self.completed += 1

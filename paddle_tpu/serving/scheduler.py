@@ -76,6 +76,24 @@ and argmax runs in-graph exactly as `_sample` does. Sampled sequences
 deterministic per request AND per chunk size (one key split per decode
 iteration, frozen slots included, exactly the per-step schedule), but a
 different key schedule than gpt_generate's single chain.
+
+SPECULATIVE DECODING (speculate_k > 0): every chunk iteration becomes a
+draft -> verify -> accept pass — a per-slot trigram table (carried in
+the donated device state, seeded from the prompt at prefill) proposes
+up to k tokens, ONE multi-position model pass scores them all, and
+in-graph exact-match acceptance commits the matched run plus one
+corrected token (models/gpt_decode._spec_step). Tokens-per-model-pass
+rises from exactly 1 to between 1 and k+1 WITHOUT changing any stream:
+acceptance is "the sampler would have produced this token anyway", key
+chain advanced one split per committed token, so greedy AND seeded
+streams stay bit-identical to speculate_k=0 (and to sequential
+gpt_generate for greedy). The dispatch block grows a per-(iteration,
+slot) commit count; `_collect` walks exactly the committed tokens and
+the host finish rule still lands on the same token the in-graph stop
+froze at. `_needs_dispatch` keeps using `chunk` as each in-flight
+dispatch's GUARANTEED token floor — acceptance only over-delivers, so
+the 1/chunk steady-state dispatch bound is preserved and the only cost
+of a lucky streak is one EOS-style overshoot dispatch at the tail.
 """
 
 from __future__ import annotations
@@ -125,6 +143,8 @@ class _Inflight(NamedTuple):
     index: int          # dispatch index at launch (matches live_from)
     size: int           # chunk length
     begin_ns: int       # launch stamp; 0 = tracing was off at launch
+    counts: Any = None  # spec mode: device (chunk, S) int32 commit
+    #                     counts; block is (chunk, k+1, S) then
 
 
 class ContinuousBatchingScheduler:
@@ -134,12 +154,19 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, params, cfg, kv: SlotKVCache, buckets: ShapeBuckets,
                  top_k: int = 0, decode_chunk: int = 8,
-                 overlap: bool = True):
+                 overlap: bool = True, speculate_k: int = 0,
+                 speculate_ngram: int = 512):
         import jax
 
         if int(decode_chunk) < 1:
             raise ValueError(
                 f"decode_chunk must be >= 1, got {decode_chunk}")
+        if int(speculate_k) < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0, got {speculate_k}")
+        if int(speculate_ngram) < 1:
+            raise ValueError(
+                f"speculate_ngram must be >= 1, got {speculate_ngram}")
         self.params = params
         self.cfg = cfg
         self.kv = kv
@@ -147,6 +174,17 @@ class ContinuousBatchingScheduler:
         self.top_k = int(top_k)
         self.decode_chunk = int(decode_chunk)
         self.overlap = bool(overlap)
+        self.speculate_k = int(speculate_k)
+        self.speculate_ngram = int(speculate_ngram)
+        # host-side speculation telemetry, accumulated at collect over
+        # LIVE verify passes only (frozen ride-alongs excluded): the
+        # engine syncs these cumulative totals into its registry
+        # counters and drains the per-pass accepted-run samples into
+        # the acceptance histogram
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_passes = 0
+        self._spec_samples: List[int] = []
         self._running: Dict[int, _Running] = {}
         self._compile_events: List[str] = []
         self._keys = jax.random.split(
@@ -211,39 +249,71 @@ class ContinuousBatchingScheduler:
                        jnp.zeros((s_dim,), jnp.int32),   # remaining
                        jnp.zeros((s_dim,), jnp.float32),  # temps
                        jnp.full((s_dim,), -1, jnp.int32))  # eos_ids
+        if self.speculate_k:
+            # drafter carry rides in the SAME donated state tuple:
+            # prev committed token + per-slot trigram table (the extra
+            # column is the trash lane masked scatter writes land in)
+            self._state += (
+                jnp.zeros((s_dim,), jnp.int32),          # prev
+                jnp.full((s_dim, self.speculate_ngram + 1), -1,
+                         jnp.int32))                     # ngram table
+
         # device page table: every row scratch until its slot admits
         self._pt = jnp.zeros((s_dim, self.kv.max_pages), jnp.int32)
 
-        def prefill_impl(params, arena, pt, tokens, pfx_len, real_len,
-                         pages, slot):
+        def prefill_impl(params, arena, pt, state, tokens, pfx_len,
+                         real_len, pages, slot):
             self._compile_events.append(f"prefill:L{tokens.shape[1]}")
             logits, arena = gd.gpt_prefill_pages(
                 params, self.cfg, tokens, pfx_len, real_len, arena,
                 pages)
             pt = pt.at[slot].set(pages)
-            return logits[0], arena, pt
+            if self.speculate_k:
+                # slot reuse hygiene: wipe the previous occupant's
+                # n-grams, then seed from THIS prompt's suffix (with a
+                # prefix-cache hit the hit blocks' tokens aren't here —
+                # seeding is best-effort; drafts are always verified)
+                state = state[:7] + (gd.spec_ngram_seed(
+                    state[7], slot, tokens[0], real_len),)
+            return logits[0], arena, pt, state
 
         def admit_impl(keys, state, slot, seed, logits, temp, pos,
-                       max_new, eos_id):
+                       max_new, eos_id, prev_tok):
             self._compile_events.append("admit_sample")
-            tokens, ts, done, remaining, temps, eos_ids = state
+            tokens, ts, done, remaining, temps, eos_ids = state[:6]
             keys = keys.at[slot].set(jax.random.PRNGKey(seed))
             first, key_next = self._sample_row(keys[slot], logits, temp)
             keys = keys.at[slot].set(key_next)
             # finished-at-admission mirrors the host rule exactly so the
             # device-side done mask never disagrees with _running
             fin = (max_new <= 1) | ((eos_id >= 0) & (first == eos_id))
-            state = (tokens.at[slot].set(first),
-                     ts.at[slot].set(pos),
-                     done.at[slot].set(fin),
-                     remaining.at[slot].set(max_new - 1),
-                     temps.at[slot].set(temp),
-                     eos_ids.at[slot].set(eos_id))
-            return first, keys, state
+            new_state = (tokens.at[slot].set(first),
+                         ts.at[slot].set(pos),
+                         done.at[slot].set(fin),
+                         remaining.at[slot].set(max_new - 1),
+                         temps.at[slot].set(temp),
+                         eos_ids.at[slot].set(eos_id))
+            if self.speculate_k:
+                # first drafter context = (last prompt token, first
+                # sampled token); the table row was seeded at prefill
+                new_state += (state[6].at[slot].set(prev_tok),
+                              state[7])
+            return first, keys, new_state
 
         def chunk_impl(params, arena, pt, keys, state):
             self._compile_events.append("decode_chunk")
-            tokens, ts, done, remaining, temps, eos_ids = state
+            tokens, ts, done, remaining, temps, eos_ids = state[:6]
+            if self.speculate_k:
+                (block, counts, tokens, arena, ts, keys, done,
+                 remaining, spec) = gd.gpt_decode_chunk_pages(
+                    params, self.cfg, tokens, arena, pt, ts, keys,
+                    temps, done, remaining, eos_ids, self.decode_chunk,
+                    sample_fn=self._sample_row,
+                    speculate_k=self.speculate_k,
+                    spec_state=(state[6], state[7]))
+                return ((block, counts), arena, keys,
+                        (tokens, ts, done, remaining, temps, eos_ids)
+                        + spec)
             block, tokens, arena, ts, keys, done, remaining = \
                 gd.gpt_decode_chunk_pages(
                     params, self.cfg, tokens, arena, pt, ts, keys,
@@ -256,13 +326,15 @@ class ContinuousBatchingScheduler:
             # cancel path: the host verdict the in-graph done mask can't
             # know — freeze the slot and point its page row at scratch
             # so its ride-along writes stop touching blocks admission
-            # may reallocate
+            # may reallocate (the drafter tail, if any, rides along
+            # untouched: the next admission resets it at prefill)
             self._compile_events.append("release_slot")
-            tokens, ts, done, remaining, temps, eos_ids = state
+            tokens, ts, done, remaining, temps, eos_ids = state[:6]
             pt = pt.at[slot].set(
                 jnp.zeros((pt.shape[1],), jnp.int32))
             state = (tokens, ts, done.at[slot].set(True),
-                     remaining.at[slot].set(0), temps, eos_ids)
+                     remaining.at[slot].set(0), temps, eos_ids) \
+                + tuple(state[6:])
             return pt, state
 
         # donation (the executor's donate=True discipline): the arena,
@@ -271,7 +343,8 @@ class ContinuousBatchingScheduler:
         # so XLA reuses their buffers in place instead of copying the
         # arena every chunk. The chunk READS the page table (no update,
         # no donation, no copy); prefill/release update it in place.
-        self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(1, 2))
+        self._prefill_jit = jax.jit(prefill_impl,
+                                    donate_argnums=(1, 2, 3))
         self._admit_jit = jax.jit(admit_impl, donate_argnums=(0, 1))
         self._chunk_jit = jax.jit(chunk_impl, donate_argnums=(1, 3, 4))
         self._release_jit = jax.jit(release_impl, donate_argnums=(0, 1))
@@ -354,15 +427,17 @@ class ContinuousBatchingScheduler:
                                   prefix_len=pfx_len,
                                   request_id=getattr(req, "request_id",
                                                      None)):
-            logits, self.kv.kv, self._pt = self._prefill_jit(
-                self.params, self.kv.kv, self._pt, padded,
-                np.int32(pfx_len), np.int32(suffix_len), pages,
-                np.int32(slot))
+            logits, self.kv.kv, self._pt, self._state = \
+                self._prefill_jit(
+                    self.params, self.kv.kv, self._pt, self._state,
+                    padded, np.int32(pfx_len), np.int32(suffix_len),
+                    pages, np.int32(slot))
             first, self._keys, self._state = self._admit_jit(
                 self._keys, self._state, np.int32(slot), np.int32(seed),
                 logits, np.float32(temperature), np.int32(p_len),
                 np.int32(max_new),
-                np.int32(-1 if eos_id is None else eos_id))
+                np.int32(-1 if eos_id is None else eos_id),
+                np.int32(prompt[0, -1]))
         first = int(first)
         st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
                       live_from=self._launches)
@@ -423,8 +498,12 @@ class ContinuousBatchingScheduler:
             block, self.kv.kv, self._keys, self._state = self._chunk_jit(
                 self.params, self.kv.kv, self._pt, self._keys,
                 self._state)
+        counts = None
+        if self.speculate_k:
+            block, counts = block
         self._inflight.append(_Inflight(block, self._launches,
-                                        self.decode_chunk, begin_ns))
+                                        self.decode_chunk, begin_ns,
+                                        counts))
         self._launches += 1
         if self.on_launch is not None:
             self.on_launch()
@@ -432,12 +511,19 @@ class ContinuousBatchingScheduler:
     def _collect(self, fl: _Inflight) -> List[SequenceEvent]:
         import jax
 
-        block = np.asarray(jax.device_get(fl.block))
+        if fl.counts is None:
+            block = np.asarray(jax.device_get(fl.block))
+            counts = None
+        else:
+            block, counts = jax.device_get((fl.block, fl.counts))
+            block, counts = np.asarray(block), np.asarray(counts)
         end_ns = time.monotonic_ns() if fl.begin_ns else 0
         events: List[SequenceEvent] = []
         # iteration-major walk: token i of every slot before token i+1 of
         # any — the same time-ordering the per-step path emitted, so
-        # streaming callbacks keep per-token granularity and order.
+        # streaming callbacks keep per-token granularity and order. In
+        # spec mode an "iteration" is one verify pass committing
+        # counts[i, slot] tokens per slot.
         for i in range(fl.size):
             for slot in sorted(self._running):
                 st = self._running[slot]
@@ -446,37 +532,64 @@ class ContinuousBatchingScheduler:
                     # start in a later block (the slot was frozen or
                     # carried the PREVIOUS occupant here)
                     continue
-                tok = int(block[i, slot])
-                st.produced += 1
-                st.pos += 1
-                self.kv.advance(slot)
-                finished = (st.produced >= st.max_new
-                            or (st.eos_id is not None
-                                and tok == st.eos_id))
-                if finished:
-                    # retire-without-stall: the slot frees NOW (in-graph
-                    # it froze the moment this token was emitted); its
-                    # frozen repeats later in this block are skipped
-                    # because the slot leaves _running
-                    del self._running[slot]
-                    self.kv.free(slot)
-                if fl.begin_ns:
-                    # chunk-interpolated retroactive span: token i of a
-                    # C-token dispatch window [begin, end) gets the
-                    # [i/C, (i+1)/C) sliver, not the whole window
-                    w = end_ns - fl.begin_ns
-                    _TRACER.record_complete(
-                        "serving/decode_iter",
-                        fl.begin_ns + (i * w) // fl.size,
-                        fl.begin_ns + ((i + 1) * w) // fl.size,
-                        "serving",
-                        {"request_id": getattr(st.req, "request_id",
-                                               None),
-                         "slot": slot, "pos": st.pos, "token": tok,
-                         "finished": finished, "chunk_index": i,
-                         "dispatch": fl.index})
-                events.append(SequenceEvent(st.req, tok, finished))
+                if counts is None:
+                    toks = (int(block[i, slot]),)
+                else:
+                    n = int(counts[i, slot])
+                    toks = tuple(int(block[i, j, slot])
+                                 for j in range(n))
+                    # acceptance telemetry over LIVE passes only: k
+                    # proposed, n-1 draft tokens accepted (the +1 is
+                    # the corrected/bonus token every pass emits)
+                    self.spec_passes += 1
+                    self.spec_proposed += self.speculate_k
+                    self.spec_accepted += n - 1
+                    self._spec_samples.append(n - 1)
+                for j, tok in enumerate(toks):
+                    st.produced += 1
+                    st.pos += 1
+                    self.kv.advance(slot)
+                    finished = (st.produced >= st.max_new
+                                or (st.eos_id is not None
+                                    and tok == st.eos_id))
+                    if finished:
+                        # retire-without-stall: the slot frees NOW
+                        # (in-graph it froze the moment this token was
+                        # emitted — in spec mode the commit run ends at
+                        # this exact token); its frozen repeats later in
+                        # this block are skipped because the slot
+                        # leaves _running
+                        del self._running[slot]
+                        self.kv.free(slot)
+                    if fl.begin_ns:
+                        # chunk-interpolated retroactive span: token j
+                        # of pass i of a C-pass dispatch window
+                        # [begin, end) gets the matching sliver of
+                        # [i/C, (i+1)/C), not the whole window
+                        w = end_ns - fl.begin_ns
+                        lo = fl.begin_ns + (i * w) // fl.size
+                        hi = fl.begin_ns + ((i + 1) * w) // fl.size
+                        _TRACER.record_complete(
+                            "serving/decode_iter",
+                            lo + (j * (hi - lo)) // len(toks),
+                            lo + ((j + 1) * (hi - lo)) // len(toks),
+                            "serving",
+                            {"request_id": getattr(st.req, "request_id",
+                                                   None),
+                             "slot": slot, "pos": st.pos, "token": tok,
+                             "finished": finished, "chunk_index": i,
+                             "dispatch": fl.index})
+                    events.append(SequenceEvent(st.req, tok, finished))
+                    if finished:
+                        break
         return events
+
+    def drain_spec_samples(self) -> List[int]:
+        """Hand the accepted-run-length samples gathered since the last
+        drain to the caller (the engine's acceptance histogram feed);
+        empties the buffer."""
+        samples, self._spec_samples = self._spec_samples, []
+        return samples
 
     def cancel(self, req) -> bool:
         """Drop a running sequence (client disconnect): free its pages

@@ -901,6 +901,319 @@ def test_cancel_releases_pages_on_device(trained):
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding: draft/verify inside the fused chunk loop
+# ---------------------------------------------------------------------------
+
+def test_spec_chunk_kernel_commits_nonspec_stream(trained):
+    """Kernel pin (slab path): gpt_decode_chunk_slots with speculate_k>0
+    commits EXACTLY the non-speculative stream — acceptance changes how
+    many tokens each verify pass emits (the counts column), never which
+    tokens — and the carry (ts/remaining) advances by the committed
+    totals."""
+    import jax
+    import jax.numpy as jnp
+    cfg, params = trained
+    rng = np.random.RandomState(40)
+    a = np.asarray(rng.randint(0, cfg.vocab_size, (1, 3)), np.int32)
+    b = np.asarray(rng.randint(0, cfg.vocab_size, (1, 6)), np.int32)
+    _, ca = gd.gpt_prefill(params, cfg, a, max_len=32)
+    _, cb = gd.gpt_prefill(params, cfg, b, max_len=32)
+    tok0 = jnp.asarray([5, 9], jnp.int32)
+    ts = jnp.asarray([3, 6], jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(0), 2)
+    temps = jnp.zeros((2,), jnp.float32)
+    done = jnp.zeros((2,), bool)
+    rem = jnp.asarray([20, 20], jnp.int32)
+    eos = jnp.full((2,), -1, jnp.int32)
+
+    ref_block, *_ = gd.gpt_decode_chunk_slots(
+        params, cfg, tok0, jnp.concatenate([ca, cb], axis=2), ts, keys,
+        temps, done, rem, eos, chunk=6)
+    ref = np.asarray(ref_block)                    # (6, 2)
+
+    spec = (jnp.zeros((2,), jnp.int32),
+            jnp.full((2, 65), -1, jnp.int32))      # ngram table T=64
+    block, counts, _, _, ts_f, _, _, rem_f, _ = gd.gpt_decode_chunk_slots(
+        params, cfg, tok0, jnp.concatenate([ca, cb], axis=2), ts, keys,
+        temps, done, rem, eos, chunk=6, speculate_k=3, spec_state=spec)
+    block, counts = np.asarray(block), np.asarray(counts)
+    for s in range(2):
+        committed = [int(block[i, j, s]) for i in range(6)
+                     for j in range(counts[i, s])]
+        assert committed[:6] == list(ref[:, s])
+        total = counts[:, s].sum()
+        assert np.asarray(ts_f)[s] == [3, 6][s] + total
+        assert np.asarray(rem_f)[s] == 20 - total
+    assert (counts >= 1).all() and (counts <= 4).all()
+
+
+def test_spec_greedy_parity_all_chunk_sizes(trained):
+    """Acceptance pin: speculation ON keeps ≥10 concurrent greedy
+    streams token-identical to sequential gpt_generate at decode_chunk
+    1, 4, and 8, and the speculative chunk loop still traces exactly
+    ONE executable (compile count stays O(buckets) + admit + 1)."""
+    rng = np.random.RandomState(41)
+    cfg, _ = trained
+    lens = [2, 3, 4, 5, 6, 7, 8, 3, 5, 7]
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lens]
+    refs = [sequential_ref(trained, p, 6) for p in prompts]
+    for chunk in (1, 4, 8):
+        eng = make_engine(trained, num_slots=4, decode_chunk=chunk,
+                          speculate_k=3)
+        outs = eng.generate(prompts, max_new_tokens=6)
+        for o, ref in zip(outs, refs):
+            np.testing.assert_array_equal(o, ref)
+        events = eng.scheduler.compile_events
+        assert events.count("decode_chunk") == 1, events
+        assert eng.scheduler.compile_count <= len(eng.buckets) + 2
+        eng.close()
+
+
+def test_spec_seeded_stream_identical_on_off(trained):
+    """Seeded sampling pin: temperature/top-k streams are identical
+    with speculation on and off, at every speculate_k and chunk size —
+    acceptance is exact-match against the sampler's own draw under the
+    sequential key schedule, so the drafter can never change a sampled
+    token either."""
+    cfg, _ = trained
+    p = np.asarray([2, 7, 1], np.int32)
+
+    def run(k, chunk):
+        eng = make_engine(trained, top_k=5, decode_chunk=chunk,
+                          speculate_k=k)
+        (out,) = eng.generate([p], max_new_tokens=9, temperature=0.8,
+                              seed=23)
+        eng.close()
+        return out
+
+    base = run(0, 4)
+    for k in (1, 2, 4):
+        for chunk in (1, 4):
+            np.testing.assert_array_equal(base, run(k, chunk))
+
+
+def test_spec_mid_chunk_eos_retires_early(trained):
+    """EOS emitted mid-verify-run freezes the slot in-graph at exactly
+    the EOS token with speculation on: the committed run ends there,
+    the host retires at the same token, and nothing after it is
+    emitted."""
+    cfg, _ = trained
+    rng = np.random.RandomState(7)      # same stream as the non-spec pin
+    k = None
+    for _ in range(20):
+        p = rng.randint(0, cfg.vocab_size, (3,)).astype(np.int32)
+        gen = list(sequential_ref(trained, p, 12)[3:])
+        k = next((i for i in range(1, len(gen))
+                  if gen[i] not in gen[:i]), None)
+        if k is not None and k % 8 != 7:
+            break
+    assert k is not None, "no usable greedy stream found"
+    eos = int(gen[k])
+    eng = make_engine(trained, decode_chunk=8, speculate_k=3)
+    req = eng.submit(p, max_new_tokens=12, eos_id=eos)
+    eng.run_until_drained()
+    assert req.finished
+    assert req.tokens[-1] == eos and len(req.tokens) == k + 1
+    assert eng.stats()["free_slots"] == eng.kv.num_slots
+    eng.close()
+
+
+def test_spec_prefix_cache_hit_stream_identical(trained):
+    """Paged-path pin: prefix-cache hits with speculation on — the warm
+    stream (drafter seeded only from the shrunken prompt SUFFIX) is
+    identical to the cold run and to the sequential path; sharing
+    changes where K/V come from and how much the drafter sees, never
+    what commits."""
+    rng = np.random.RandomState(42)
+    cfg, _ = trained
+    p = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+    eng = make_engine(trained, prefill_buckets=(4, 16), block_size=4,
+                      speculate_k=2)
+    (cold,) = eng.generate([p], max_new_tokens=6)
+    (warm,) = eng.generate([p], max_new_tokens=6)
+    assert eng.kv.prefix_hits == 2
+    np.testing.assert_array_equal(warm, cold)
+    np.testing.assert_array_equal(warm, sequential_ref(trained, p, 6))
+    eng.close()
+
+
+def test_spec_retire_admit_slot_reuse(trained):
+    """Slot reuse under speculation: budgets ending mid-chunk through
+    ONE slot — each retirement frees the slot, the next admission
+    resets the drafter row (no n-gram leakage from the previous
+    occupant can change tokens anyway: drafts are verified), and every
+    stream stays sequential-identical."""
+    rng = np.random.RandomState(43)
+    cfg, _ = trained
+    prompts = [rng.randint(0, cfg.vocab_size, (2 + i,)).astype(np.int32)
+               for i in range(3)]
+    budgets = [5, 3, 6]
+    eng = make_engine(trained, num_slots=1, decode_chunk=4,
+                      speculate_k=2)
+    reqs = [eng.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, budgets)]
+    eng.run_until_drained()
+    for r, p, m in zip(reqs, prompts, budgets):
+        assert r.finished and len(r.tokens) == m
+        np.testing.assert_array_equal(r.output(),
+                                      sequential_ref(trained, p, m))
+    eng.close()
+
+
+def test_spec_cancel_mid_chunk_discards_unverified(trained):
+    """Satellite pin: cancel with speculation active discards BOTH the
+    uncollected in-flight tokens and any speculated-but-unverified
+    drafter state — the live_from walk skips the cancelled slot's
+    (token, count) columns entirely, the release executable freezes it
+    on device, and a follow-up request through the SAME slot (whose
+    admission resets the drafter row) still matches the sequential
+    path."""
+    cfg, _ = trained
+    rng = np.random.RandomState(44)
+    eng = make_engine(trained, num_slots=1, decode_chunk=4,
+                      speculate_k=3)
+    a = eng.submit(rng.randint(0, cfg.vocab_size, (3,)).astype(np.int32),
+                   max_new_tokens=20)
+    eng.step()                 # admit + launch (overlap: not collected)
+    eng.step()                 # launch k+1, collect k
+    n_a = len(a.tokens)
+    assert n_a < 20            # mid-stream, speculation or not
+    assert eng.cancel(a) and a.state == "cancelled"
+    eng.run_until_drained()    # driver applies the cancel, drains
+    assert len(a.tokens) == n_a            # nothing after the cancel
+    assert eng.kv.free_count == 1
+    assert "release_slot" in eng.scheduler.compile_events
+    p2 = rng.randint(0, cfg.vocab_size, (5,)).astype(np.int32)
+    (out,) = eng.generate([p2], max_new_tokens=6)
+    np.testing.assert_array_equal(out, sequential_ref(trained, p2, 6))
+    eng.close()
+
+
+def test_spec_acceptance_telemetry_repetitive_prompt(trained):
+    """A repetitive prompt (tiled motif) makes the self-drafter earn
+    its keep: >1 token committed per verify pass, and the telemetry is
+    registry-visible — serving_spec_{proposed,accepted}_total counters,
+    the per-pass acceptance histogram, and the /varz acceptance-ratio
+    rollup all carry the engine's numbers."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.debug_server import _serving_varz
+    rng = np.random.RandomState(45)
+    cfg, _ = trained
+    motif = rng.randint(0, cfg.vocab_size, (4,))
+    p = np.tile(motif, 2).astype(np.int32)
+    eng = make_engine(trained, num_slots=1, prefill_buckets=(8,),
+                      max_len=48, decode_chunk=8, speculate_k=4)
+    (out,) = eng.generate([p], max_new_tokens=32)
+    np.testing.assert_array_equal(out, sequential_ref(trained, p, 32))
+    sched = eng.scheduler
+    assert sched.spec_passes > 0
+    assert sched.spec_proposed == 4 * sched.spec_passes
+    assert sched.spec_accepted > sched.spec_passes  # >1 accepted/pass avg
+    tokens_per_pass = (sched.spec_passes + sched.spec_accepted) \
+        / sched.spec_passes
+    assert tokens_per_pass > 2.0, tokens_per_pass
+    s = eng.stats()
+    assert s["spec_proposed"] == sched.spec_proposed
+    assert s["spec_accepted"] == sched.spec_accepted
+    assert s["mean_spec_accepted_run"] > 1.0
+    snap = get_registry().snapshot()
+    for fam, want in (("serving_spec_proposed_total",
+                       sched.spec_proposed),
+                      ("serving_spec_accepted_total",
+                       sched.spec_accepted)):
+        row = next(r for r in snap[fam]["series"]
+                   if r["labels"].get("engine") == s["engine_label"])
+        assert row["value"] == want
+    hist = next(r for r in snap["serving_spec_accepted_run"]["series"]
+                if r["labels"].get("engine") == s["engine_label"])
+    assert hist["count"] == sched.spec_passes
+    varz = _serving_varz(snap)["spec_accept_ratio"][s["engine_label"]]
+    assert varz["spec_proposed"] == sched.spec_proposed
+    assert varz["spec_accept_ratio"] == round(
+        sched.spec_accepted / sched.spec_proposed, 4)
+    eng.close()
+
+
+def test_spec_dispatch_floor_preserved(trained):
+    """Speculation only over-delivers: dispatches-per-token stays at or
+    under the 1/chunk steady-state bound (each dispatch still carries
+    at least `chunk` tokens per live slot), and acceptance REDUCES the
+    dispatch count on drafter-friendly streams."""
+    rng = np.random.RandomState(46)
+    cfg, _ = trained
+    motif = rng.randint(0, cfg.vocab_size, (4,))
+    p = np.tile(motif, 2).astype(np.int32)
+    counts = {}
+    for k in (0, 4):
+        eng = make_engine(trained, num_slots=1, prefill_buckets=(8,),
+                          max_len=48, decode_chunk=8, speculate_k=k)
+        (out,) = eng.generate([p], max_new_tokens=32)
+        s = eng.stats()
+        # launch bound: never more dispatches than the non-spec path
+        # needs (31 decode tokens / 8 per dispatch, +1 tail overshoot)
+        assert 1 <= s["dispatches"] <= -(-31 // 8) + 1
+        counts[k] = s["dispatches"]
+        eng.close()
+    assert counts[4] < counts[0], counts
+
+
+def test_spec_metrics_bucket_scaling():
+    """Satellite pin: the tokens-per-dispatch histogram series is
+    count-scaled by chunk * (1 + speculate_k) — an engine whose
+    per-dispatch ceiling exceeds the base grid gets widened per-series
+    buckets (so accepted runs don't all pile into +Inf), while the
+    family-level layout stays shared and conflict-free; the acceptance
+    histogram spans exactly 0..speculate_k."""
+    from paddle_tpu.serving.metrics import (EngineMetrics, _count_buckets,
+                                            _TPD_BASE)
+    assert _count_buckets(512) == _TPD_BASE
+    # 16 slots x chunk 8 x (1 + k=4) = 640 > 512: widened to 1024
+    m = EngineMetrics(max_tokens_per_dispatch=16 * 8 * 5, speculate_k=4)
+    tpd = m._hists["tokens_per_dispatch"]
+    assert tpd._bounds[-1] == 1024 and tpd._bounds[0] == 1
+    run = m._hists["spec_accepted_run"]
+    assert run._bounds == (0, 1, 2, 3, 4)
+    m.observe_dispatch_tokens(640)              # not in +Inf
+    assert dict(tpd.cumulative_buckets())["1024"] == 1
+    m.unregister()
+    # a default engine in the SAME registry keeps the base layout —
+    # no family-level bucket conflict between differently-sized engines
+    m2 = EngineMetrics()
+    assert m2._hists["tokens_per_dispatch"]._bounds == _TPD_BASE
+    m2.unregister()
+
+
+@pytest.mark.slow
+def test_spec_long_acceptance_soak(trained):
+    """Slow soak: many requests, mixed repetitive/random prompts, spec
+    on — every stream sequential-identical over hundreds of verify
+    passes, acceptance telemetry consistent (accepted <= proposed,
+    histogram count == passes)."""
+    rng = np.random.RandomState(47)
+    cfg, _ = trained
+    prompts = []
+    for i in range(24):
+        if i % 2:
+            motif = rng.randint(0, cfg.vocab_size, (3,))
+            prompts.append(np.tile(motif, 3)[:8].astype(np.int32))
+        else:
+            prompts.append(rng.randint(0, cfg.vocab_size, (5 + i % 4,))
+                           .astype(np.int32))
+    refs = [sequential_ref(trained, p, 20) for p in prompts]
+    eng = make_engine(trained, num_slots=4, max_queue=24, max_len=32,
+                      decode_chunk=8, speculate_k=3)
+    outs = eng.generate(prompts, max_new_tokens=20)
+    for o, ref in zip(outs, refs):
+        np.testing.assert_array_equal(o, ref)
+    sched = eng.scheduler
+    assert sched.spec_passes > 100
+    assert 0 <= sched.spec_accepted <= sched.spec_proposed
+    assert sched.spec_proposed == 3 * sched.spec_passes
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
 # kv-cache manager units
 # ---------------------------------------------------------------------------
 

@@ -208,6 +208,41 @@ def test_bench_serving_shared_prefix_row():
     assert e["tokens_per_s_per_gb"] > 0 and e["tokens_per_s_cold"] > 0
 
 
+def test_bench_serving_speculate_row_shape():
+    """tools/bench_serving --speculate: one row per speculate_k over
+    the repetitive-text workload with registry-sourced acceptance
+    columns — the K=0 baseline prints None in the spec columns, the
+    K>0 row shows >1 accepted token per verify pass (the raw
+    tokens-per-model-pass win the speculative chunk loop exists for)
+    while the dispatch-amortization bound holds."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_speculate("tiny", speculate_ks=(0, 4),
+                                       requests=4, concurrency=2)
+    assert len(rows) == 2
+    for row, k in zip(rows, (0, 4)):
+        assert row["metric"] == f"tiny_serving_spec_c2_s{k}"
+        assert row["value"] > 0 and row["unit"] == "tokens/s"
+        e = row["extra"]
+        assert e["speculate_k"] == k
+        assert e["completed"] == 4
+        assert e["dispatches"] > 0
+        assert e["dispatches_per_token"] <= 1.0 / 8 + 1e-9
+        assert e["compiled_executables"] > 0
+        assert e["mean_ttft_ms"] > 0 and e["mean_tpot_ms"] > 0
+    base, spec = rows[0]["extra"], rows[1]["extra"]
+    assert base["spec_proposed"] == 0 and base["spec_accepted"] == 0
+    assert base["spec_accept_rate"] is None
+    assert base["accepted_per_pass"] is None
+    # the speculative row really drafted AND accepted: >1 token commits
+    # per verify pass on repetitive text (the acceptance criterion)
+    assert spec["spec_proposed"] > 0
+    assert 0 < spec["spec_accepted"] <= spec["spec_proposed"]
+    assert 0 < spec["spec_accept_rate"] <= 1
+    assert spec["accepted_per_pass"] > 1.0, spec
+    assert spec["dispatches"] <= base["dispatches"]
+
+
 def test_bench_serving_debug_port_flag(capsys, monkeypatch):
     """--debug-port serves the diagnostics plane for the bench run and
     tears it down afterwards."""

@@ -52,6 +52,19 @@ registry-sourced engine-side columns the library rows report — the
 wire tax is the delta between the paired rows:
 
     python tools/bench_serving.py tiny --http
+
+`--speculate K...` runs the SPECULATIVE-DECODING workload instead: a
+repetitive-text request mix (prompts tile a short motif — the regime
+the in-graph n-gram self-drafter exists for) swept over the given
+`speculate_k` values on fresh engines, one row per K. Each row carries
+the registry-sourced acceptance columns next to tokens/s:
+`spec_proposed` / `spec_accepted` (the serving_spec_*_total counters),
+`spec_accept_rate` (accepted/proposed), and `accepted_per_pass` —
+committed tokens per verify pass, the raw tokens-per-model-pass lever
+(> 1 means speculation is beating sequential decode; K=0 rows print
+the no-speculation baseline with None in the spec columns):
+
+    python tools/bench_serving.py tiny --speculate 0 4
 """
 
 import argparse
@@ -329,6 +342,109 @@ def run_shared_prefix(name, requests=None, max_new=16, concurrency=None):
     }]
 
 
+# speculative workload geometry per model: (prefill buckets, motif
+# length, prompt length, max_new). Prompts tile a `motif_len`-token
+# motif to `prompt_len` so the trigram drafter seeds from the prompt
+# and greedy continuations settle into drafter-predictable cycles —
+# the repetitive-text regime speculation is built for.
+SPECULATE = {
+    "tiny": ((8, 16), 4, 16, 48),
+    "gpt2": ((32, 64), 8, 64, 64),
+}
+
+
+def run_speculate(name, speculate_ks=(0, 4), requests=None,
+                  concurrency=None, decode_chunk=8):
+    """The speculative-decoding sweep: the repetitive-text mix run once
+    per speculate_k value on fresh engines, emitting one row per K with
+    registry-sourced acceptance columns (accepted tokens per verify
+    pass, draft accept rate) next to throughput — the tokens-per-model-
+    pass win is a printed number, not a claim. Token streams are
+    bit-identical at every K (pinned in tests/test_serving.py); only
+    the pass count changes."""
+    import paddle_tpu as pt
+
+    gpt_kwargs, default_cc, _, _ = MODELS[name]
+    buckets, motif_len, prompt_len, max_new = SPECULATE[name]
+    cc = concurrency or min(4, max(default_cc))
+    requests = requests or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    cfg, params = build_params(gpt_kwargs)
+    max_len = prompt_len + max_new
+    rows = []
+    for k in speculate_ks:
+        rng = np.random.RandomState(0)       # same mix per K level
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(num_slots=cc, max_queue=requests,
+                                     prefill_buckets=buckets,
+                                     max_len=max_len,
+                                     decode_chunk=decode_chunk,
+                                     speculate_k=k))
+        prompts = [np.tile(rng.randint(0, cfg.vocab_size, (motif_len,)),
+                           -(-prompt_len // motif_len))[:prompt_len]
+                   .astype(np.int32) for _ in range(requests)]
+        # warm every executable (random prompts so the large bucket
+        # cannot shrink into a prefix-cache hit), then drop the warmup
+        # registry rows
+        wrng = np.random.RandomState(12345)
+        eng.generate([wrng.randint(0, cfg.vocab_size, (max(1, b - 2),))
+                      .astype(np.int32) for b in buckets],
+                     max_new_tokens=2)
+        old = eng.metrics
+        old.unregister()
+        # reuse the engine's own bucket-scaling inputs so the reset
+        # series keeps the exact layout ServingEngine constructed
+        eng.metrics = pt.serving.EngineMetrics(
+            max_tokens_per_dispatch=old.max_tokens_per_dispatch,
+            speculate_k=old.speculate_k)
+        eng.kv.prefix_hits = eng.kv.prefix_misses = 0
+        eng.scheduler.spec_proposed = eng.scheduler.spec_accepted = 0
+        eng.scheduler.spec_passes = 0
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.run_until_drained()
+        dt = time.perf_counter() - t0
+        s = eng.stats()
+        label = s["engine_label"]
+        tokens = sum(len(r.tokens) for r in reqs)
+        dispatches = _registry_counter(label, "serving_dispatches_total")
+        proposed = _registry_counter(label, "serving_spec_proposed_total")
+        accepted = _registry_counter(label, "serving_spec_accepted_total")
+        # verify passes = proposed / k (each live pass proposes k), and
+        # every pass commits its accepted run + one corrected token
+        passes = proposed // k if k else None
+        rows.append({
+            "metric": f"{name}_serving_spec_c{cc}_s{k}",
+            "value": round(tokens / dt, 2),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "extra": {
+                "requests": requests,
+                "completed": s["completed"],
+                "max_new": max_new,
+                "decode_chunk": decode_chunk,
+                "speculate_k": k,
+                "spec_proposed": proposed,
+                "spec_accepted": accepted,
+                "spec_accept_rate": round(accepted / proposed, 4)
+                    if proposed else None,
+                "accepted_per_pass": round(1 + accepted / passes, 3)
+                    if passes else None,
+                "dispatches": dispatches,
+                "dispatches_per_token": round(dispatches / tokens, 4)
+                    if tokens else None,
+                "tokens_per_dispatch": round(tokens / dispatches, 2)
+                    if dispatches else None,
+                "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2),
+                "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3),
+                "compiled_executables": s["compiled_executables"],
+            },
+        })
+        eng.close()
+    return rows
+
+
 def _sse_generate(port, payload, timeout=120):
     """POST /v1/generate and consume the SSE stream, stamping
     perf_counter at every frame. Returns (status, tokens, stamps,
@@ -524,6 +640,15 @@ def main(argv=None):
                     help="run the prefix-sharing workload instead: N "
                          "requests over one long system prompt, prefix "
                          "cache off (cold) vs on, TTFT compared per row")
+    ap.add_argument("--speculate", type=int, nargs="+", default=None,
+                    metavar="K",
+                    help="run the speculative-decoding workload "
+                         "instead: the repetitive-text mix swept over "
+                         "these speculate_k values (e.g. 0 4 — baseline "
+                         "vs 4-token drafts), one row per K with "
+                         "registry-sourced accepted_per_pass / "
+                         "spec_accept_rate columns; streams are "
+                         "bit-identical at every K")
     ap.add_argument("--http", action="store_true",
                     help="also drive a live paddle_tpu.server over the "
                          "wire: one <model>_serving_http_c<cc> row per "
@@ -536,6 +661,16 @@ def main(argv=None):
     bad = [k for k in args.decode_chunk if k < 1]
     if bad:
         ap.error(f"--decode-chunk values must be >= 1, got {bad}")
+    if args.speculate is not None:
+        bad = [k for k in args.speculate if k < 0]
+        if bad:
+            ap.error(f"--speculate values must be >= 0, got {bad}")
+        if args.http:
+            ap.error("--speculate replaces the standard workload and "
+                     "has no wire-path pairing; drop --http")
+        if args.shared_prefix:
+            ap.error("--speculate and --shared-prefix each replace the "
+                     "standard workload; pick one")
 
     server_started = False
     if args.debug_port is not None:
@@ -548,6 +683,9 @@ def main(argv=None):
         for name in args.models or list(MODELS):
             if args.shared_prefix:
                 rows = run_shared_prefix(name)
+            elif args.speculate is not None:
+                rows = run_speculate(name,
+                                     speculate_ks=tuple(args.speculate))
             else:
                 rows = run_model(name,
                                  decode_chunks=tuple(args.decode_chunk))
