@@ -100,7 +100,20 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
             # until the engine has run a speculative pass
             "spec_accept_ratio": round(a / p, 4) if p else None,
         }
-    return {"prefix_hit_ratio": out, "spec_accept_ratio": spec}
+    # host-swap preemption rollup: how often page pressure evicted a
+    # running sequence, how many resumed, and how many sit parked NOW
+    pre = by_engine("serving_preemptions_total")
+    swins = by_engine("serving_swap_ins_total")
+    parked = by_engine("serving_swapped_slots")
+    swap = {}
+    for label in sorted(set(pre) | set(swins) | set(parked), key=str):
+        swap[label] = {
+            "preemptions": int(pre.get(label, 0)),
+            "swap_ins": int(swins.get(label, 0)),
+            "swapped_slots": int(parked.get(label, 0)),
+        }
+    return {"prefix_hit_ratio": out, "spec_accept_ratio": spec,
+            "preemption": swap}
 
 
 def _query_flag(q: Dict[str, str], name: str) -> bool:

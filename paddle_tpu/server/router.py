@@ -43,7 +43,7 @@ import queue
 import threading
 import time
 import traceback
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -144,7 +144,15 @@ class StreamHandle:
     produces into the internal queue via the engine's on_token callback.
     Exactly one terminal ("done", reason) event is ever emitted — reason
     is one of "stop" (EOS), "length" (budget), "cancelled" (client went
-    away), "deadline_exceeded", or "error"."""
+    away), "deadline_exceeded", "replica_failed" (the serving replica
+    died after the stream had emitted tokens — the prefix cannot be
+    transparently replayed; retry with backoff), or "error".
+
+    The submit arguments are retained on the handle so a replica
+    failure can transparently re-submit a ZERO-token stream to a
+    healthy replica (same prompt, seed, and deadline — the retried
+    stream is bit-identical to what the dead replica would have
+    produced)."""
 
     def __init__(self, router: "Router", replica: "Replica", tenant: str,
                  deadline: Optional[float]):
@@ -154,6 +162,11 @@ class StreamHandle:
         self.deadline = deadline            # absolute router-clock stamp
         self.request = None                 # GenerationRequest, set post-submit
         self.finish_reason: Optional[str] = None
+        # retained submit args + failover bookkeeping
+        self.prompt = None
+        self.submit_kw: dict = {}
+        self.emitted = 0                    # tokens streamed so far
+        self.retries = 0                    # failover re-submissions
         self._flock = threading.Lock()
         self._events: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
@@ -167,7 +180,12 @@ class StreamHandle:
     def _on_token(self, req, token: int) -> None:
         # the engine's streaming callback: runs on the replica's driver
         # thread, with req.state already advanced for this emission
+        if self.finish_reason is not None:
+            # a late emission after the stream already terminated (a
+            # failover race lost to a cancel): the consumer is gone
+            return
         self.request = req
+        self.emitted += 1
         self._events.put(("token", int(token)))
         if req.finished:
             reason = ("stop" if (req.eos_id is not None
@@ -215,14 +233,31 @@ class StreamHandle:
 
 
 class Replica:
-    """One ServingEngine plus the driver thread that steps it. The
-    driver is the only thread touching scheduler/slot state (the
-    engine's documented contract); handler threads only submit/cancel."""
+    """One ServingEngine plus the SUPERVISED driver thread stepping it.
+    The driver is the only thread touching scheduler/slot state (the
+    engine's documented contract); handler threads only submit/cancel.
+
+    The driver runs under a supervisor: an exception escaping
+    ``engine.step()`` marks the replica FAILED — its stranded work is
+    handed back to the router (queued + zero-token streams re-admitted
+    to healthy replicas, mid-emission streams terminated with
+    ``replica_failed``), a flight record fires through the watchdog
+    overload hook, and, when the router has an engine factory, the
+    replica REBUILDS: a fresh engine from the same params after an
+    exponential backoff, then state returns to OK and the replica
+    rejoins admission. Without a factory the replica parks FAILED and
+    the router routes around it. States: ``ok`` / ``failed`` /
+    ``restarting``."""
 
     def __init__(self, engine: ServingEngine,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self._clock = clock
+        self._router: Optional["Router"] = None   # set by Router.__init__
+        self.state = "ok"
+        self.failures = 0                  # consecutive failed rebuilds
+        self.failures_total = 0
+        self.restarts_total = 0
         self._handles: set = set()
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -241,9 +276,14 @@ class Replica:
 
     @property
     def busy(self) -> bool:
+        if self.state != "ok":
+            # a broken engine's queues are abandoned state, not work;
+            # counting them busy would wedge drain forever
+            return False
         return bool(self.engine._queue
                     or self.engine.scheduler.active_count
-                    or self.engine._pending_cancels)
+                    or self.engine._pending_cancels
+                    or self.engine.swapped_count)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -260,34 +300,120 @@ class Replica:
         with self._lock:
             self._handles.add(handle)
 
+    def adopt(self, handle: StreamHandle, engine: ServingEngine) -> bool:
+        """watch() plus a post-hoc health check closing the submit/watch
+        race: if the supervisor failed this replica between the handler's
+        engine.submit and here, the failure sweep may have snapshotted
+        ``_handles`` before the handle was added — leaving it parked on a
+        dead replica where nothing would ever disposition it. `engine` is
+        the instance the caller submitted to: a state=='ok' read alone
+        is defeated by a full failed→rebuilt→ok cycle inside the window
+        (the request would sit queued on the discarded engine forever),
+        so the identity must match too. The sweep and this reclaim both
+        mutate ``_handles`` under ``_lock``, so exactly one of them sees
+        the handle: returns False when the caller must disposition it
+        (reroute), True when this replica — or its failure sweep — owns
+        it."""
+        with self._lock:
+            self._handles.add(handle)
+        if self.state == "ok" and self.engine is engine:
+            return True
+        with self._lock:
+            if handle in self._handles:
+                self._handles.discard(handle)
+                return False
+        return True
+
     def forget(self, handle: StreamHandle) -> None:
         with self._lock:
             self._handles.discard(handle)
 
     def _drive(self) -> None:
         while not self._stop:
+            if self.state != "ok":
+                self._rebuild_or_park()
+                continue
             self._expire_deadlines()
             if self.busy:
                 try:
                     self.engine.step()
                 except Exception:
-                    # a dead driver would wedge every stream on this
-                    # replica: fail the live handles loudly and keep the
-                    # loop alive (the next submit may still work)
-                    traceback.print_exc()
-                    with self._lock:
-                        stuck = list(self._handles)
-                    for h in stuck:
-                        if h.request is not None:
-                            self.engine.cancel(h.request)
-                        h._finish("error")
-                    time.sleep(0.05)
+                    self._on_failure()
             else:
                 # idle: sleep until a submit kicks us (the timeout only
                 # bounds shutdown latency — deadline checks matter only
                 # while requests are in flight, which keeps the loop hot)
                 self._work.wait(timeout=0.02)
                 self._work.clear()
+
+    def _on_failure(self) -> None:
+        """Supervisor path, on the driver thread: the engine threw out
+        of step(). Its internal state is untrustworthy from here — no
+        further engine calls; stranded work is rerouted or terminated
+        and the loop moves to rebuild/park."""
+        traceback.print_exc()
+        self.state = "failed"
+        self.failures += 1
+        self.failures_total += 1
+        router = self._router
+        with self._lock:
+            stranded = list(self._handles)
+            self._handles.clear()
+        if router is not None:
+            router._replica_failed(self, stranded)
+        else:
+            for h in stranded:
+                h._finish("replica_failed")
+
+    def _rebuild_or_park(self) -> None:
+        """FAILED-state driver turn: rebuild a fresh engine when the
+        router has a factory (exponential backoff between consecutive
+        failures), else park until stop — the router routes around a
+        parked replica."""
+        router = self._router
+        factory = router._engine_factory if router is not None else None
+        if factory is None:
+            self._work.wait(timeout=0.05)
+            self._work.clear()
+            return
+        self.state = "restarting"
+        delay = min(router._restart_backoff_cap_s,
+                    router._restart_backoff_s
+                    * (2 ** min(self.failures - 1, 10)))
+        deadline = time.monotonic() + delay
+        while not self._stop and time.monotonic() < deadline:
+            time.sleep(min(0.01, delay))
+        if self._stop:
+            self.state = "failed"
+            return
+        dead_label = self.label       # attribute the restart to the
+        #                               replica that failed, matching
+        #                               observe_replica_failure — the
+        #                               fresh engine's label is a new
+        #                               series nobody has scraped yet
+        try:
+            self.engine.close()       # retire the dead engine's series
+        except Exception:
+            traceback.print_exc()
+        try:
+            engine = factory()
+        except Exception:
+            # the factory itself failed (e.g. an injected build fault):
+            # stay failed, back off longer next turn
+            traceback.print_exc()
+            self.failures += 1
+            self.failures_total += 1
+            self.state = "failed"
+            return
+        self.engine = engine
+        self.failures = 0
+        # counters BEFORE the state flip: anyone polling for state ==
+        # "ok" (healthz, tests) must never read a stale restart count
+        # once the replica looks healthy
+        self.restarts_total += 1
+        if router is not None:
+            router.metrics.observe_replica_restart(dead_label)
+        self.state = "ok"
 
     def _expire_deadlines(self) -> None:
         now = self._clock()
@@ -332,6 +458,16 @@ class RouterMetrics:
         self._disconnects = r.counter(
             "server_client_disconnects_total",
             "streams dropped by the client before completion")
+        self._replica_failures = r.counter(
+            "server_replica_failures_total",
+            "replica driver failures (exceptions escaping engine.step)")
+        self._replica_restarts = r.counter(
+            "server_replica_restarts_total",
+            "replica engines successfully rebuilt after a failure")
+        # host-side mirrors for /healthz (int reads without a registry
+        # snapshot walk)
+        self.replica_failures = 0
+        self.replica_restarts = 0
         self._gauge_fams = {
             "active_streams": r.gauge(
                 "server_active_streams", "wire streams currently open"),
@@ -365,6 +501,20 @@ class RouterMetrics:
     def observe_disconnect(self, tenant: str) -> None:
         self._inc(self._disconnects, tenant=tenant)
 
+    def observe_replica_failure(self, replica: str) -> None:
+        # host mirror under the same lock the dynamic set uses:
+        # concurrent driver threads can fail replicas simultaneously,
+        # and an unsynchronized += would let /healthz drift under the
+        # locked registry counters /metrics reports
+        with self._dyn_lock:
+            self.replica_failures += 1
+        self._inc(self._replica_failures, replica=replica)
+
+    def observe_replica_restart(self, replica: str) -> None:
+        with self._dyn_lock:
+            self.replica_restarts += 1
+        self._inc(self._replica_restarts, replica=replica)
+
     def unregister(self) -> None:
         """Retire every series this router registered."""
         for name, fam in self._gauge_fams.items():
@@ -386,13 +536,33 @@ class Router:
                  default_quota: Optional[QuotaConfig] = None,
                  clock: Callable[[], float] = time.monotonic,
                  label: Optional[str] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 engine_factory: Optional[
+                     Callable[[], ServingEngine]] = None,
+                 max_stream_retries: int = 1,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_cap_s: float = 2.0):
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine replica")
+        if max_stream_retries < 0:
+            raise ValueError(
+                f"max_stream_retries must be >= 0, got "
+                f"{max_stream_retries}")
         self._clock = clock
         self.metrics = RouterMetrics(registry=registry, label=label)
+        # failover knobs: a FAILED replica rebuilds via engine_factory
+        # (None = park failed, route around it); zero-token streams
+        # stranded by a failure re-submit up to max_stream_retries
+        # times; consecutive rebuild failures back off exponentially
+        # from restart_backoff_s up to the cap
+        self._engine_factory = engine_factory
+        self._max_stream_retries = int(max_stream_retries)
+        self._restart_backoff_s = float(restart_backoff_s)
+        self._restart_backoff_cap_s = float(restart_backoff_cap_s)
         self.replicas = [Replica(e, clock) for e in engines]
+        for r in self.replicas:
+            r._router = self
         self.metrics.replicas.set(len(self.replicas))
         self._quota_cfg = dict(quotas or {})
         self._default_quota = default_quota
@@ -436,6 +606,19 @@ class Router:
             self._buckets[tenant] = bucket
             return bucket
 
+    def _healthy_order(self) -> List[int]:
+        """Admission order over the live registry gauges: healthy
+        replicas only (FAILED/RESTARTING ones are routed around until
+        their supervisor rebuilds them), least-loaded first, with a
+        round-robin offset breaking ties so equal-load replicas share
+        cold-start traffic instead of replica 0 taking all. Shared by
+        first admission (submit) and failover re-admission (_reroute)."""
+        rr = next(self._rr)
+        n = len(self.replicas)
+        return sorted(
+            (i for i in range(n) if self.replicas[i].state == "ok"),
+            key=lambda i: (self.replicas[i].load(), (i - rr) % n))
+
     def submit(self, prompt, max_new_tokens: int, tenant: str = "default",
                deadline_s: Optional[float] = None,
                temperature: float = 0.0, seed: int = 0,
@@ -462,25 +645,30 @@ class Router:
                     _watchdog.notify_overload(
                         f"router-{self.metrics.label}")
                     raise QuotaExceededError(tenant, retry)
-            # least-loaded admission over the live registry gauges;
-            # round-robin offset breaks ties so equal-load replicas
-            # share cold-start traffic instead of replica 0 taking all
-            rr = next(self._rr)
-            n = len(self.replicas)
-            order = sorted(range(n),
-                           key=lambda i: (self.replicas[i].load(),
-                                          (i - rr) % n))
+            order = self._healthy_order()
             last_err: Optional[EngineOverloadError] = None
             granted = False
             try:
+                if not order:
+                    raise EngineOverloadError(
+                        "no healthy replicas (all failed or "
+                        "restarting); retry after the supervisor "
+                        "rebuilds one",
+                        retry_after_s=self._restart_backoff_s)
                 for i in order:
                     replica = self.replicas[i]
                     handle = StreamHandle(
                         self, replica, tenant,
                         None if deadline_s is None
                         else self._clock() + float(deadline_s))
+                    handle.prompt = prompt
+                    handle.submit_kw = dict(
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, seed=seed,
+                        eos_id=eos_id)
+                    engine = replica.engine
                     try:
-                        req = replica.engine.submit(
+                        req = engine.submit(
                             prompt, max_new_tokens,
                             temperature=temperature,
                             seed=seed, eos_id=eos_id,
@@ -489,10 +677,16 @@ class Router:
                         last_err = e
                         continue
                     handle.request = req
-                    replica.watch(handle)
                     self.metrics.active_streams.inc()
-                    replica.kick()
                     granted = True
+                    if not replica.adopt(handle, engine):
+                        # the replica died between submit and watch and
+                        # its stranded-stream sweep missed this handle:
+                        # disposition it ourselves (re-admit elsewhere
+                        # or terminate) instead of stranding the stream
+                        self._reroute(handle)
+                        return handle
+                    replica.kick()
                     return handle
                 assert last_err is not None
                 raise last_err
@@ -509,9 +703,18 @@ class Router:
         the engine so its KV pages free on the replica's next step, and
         finish the stream with `reason`. Safe from any thread, safe to
         call after natural completion (returns False then)."""
-        if handle.request is not None:
-            handle.replica.engine.cancel(handle.request)
+        req = handle.request
+        if req is not None:
+            handle.replica.engine.cancel(req)
         finished = handle._finish(reason)
+        # a concurrent failover _reroute may have re-submitted this
+        # handle to another replica in the window above; its own
+        # finish_reason re-check only catches cancels that completed
+        # before it ran, so re-read and reap a swapped-in request
+        # (engine.cancel is idempotent — both sides reaping is fine)
+        req2 = handle.request
+        if req2 is not None and req2 is not req:
+            handle.replica.engine.cancel(req2)
         if finished and reason == "cancelled":
             self.metrics.observe_disconnect(handle.tenant)
         handle.replica.kick()
@@ -520,6 +723,62 @@ class Router:
     def _stream_closed(self, handle: StreamHandle) -> None:
         handle.replica.forget(handle)
         self.metrics.active_streams.dec()
+
+    # -- replica failover ----------------------------------------------------
+
+    def _replica_failed(self, replica: Replica,
+                        stranded: Sequence[StreamHandle]) -> None:
+        """Supervisor callback (on the FAILED replica's driver thread):
+        count + flight-record the failure, then disposition every
+        stranded stream — zero-token streams (queued or admitted but
+        not yet emitting) re-submit transparently to a healthy replica
+        (bounded by max_stream_retries; the retried stream is
+        bit-identical since prompt/seed/deadline ride the handle),
+        mid-emission streams terminate with ``replica_failed`` (their
+        prefix cannot be replayed without duplicate tokens)."""
+        self.metrics.observe_replica_failure(replica.label)
+        # shed storms and replica deaths leave the same evidence trail:
+        # a flight record through the watchdog overload hook
+        _watchdog.notify_overload(f"replica-{replica.label}")
+        for handle in stranded:
+            self._reroute(handle)
+
+    def _reroute(self, handle: StreamHandle) -> None:
+        if handle.finish_reason is not None:
+            return                          # already terminal (cancel won)
+        if (handle.emitted > 0
+                or handle.retries >= self._max_stream_retries
+                or self._draining or self._closed):
+            handle._finish("replica_failed")
+            return
+        handle.retries += 1
+        for i in self._healthy_order():
+            replica = self.replicas[i]
+            engine = replica.engine
+            try:
+                req = engine.submit(
+                    handle.prompt, on_token=handle._on_token,
+                    **handle.submit_kw)
+            except (EngineOverloadError, ValueError):
+                continue
+            # replica before request: cancel() re-reads request then
+            # replica, so a new request must never pair with the old
+            # replica
+            handle.replica = replica
+            handle.request = req
+            if handle.finish_reason is not None:
+                # a cancel won between our entry check and the submit:
+                # nothing else knows about the fresh request — reap it
+                # so it doesn't burn a slot generating dropped tokens
+                engine.cancel(req)
+                replica.kick()
+                return
+            if not replica.adopt(handle, engine):
+                continue        # this one died in the window too
+            replica.kick()
+            return
+        # nowhere to go (every healthy replica shed, or none left)
+        handle._finish("replica_failed")
 
     # -- drain / teardown ---------------------------------------------------
 

@@ -37,6 +37,7 @@ the refcounted ``close()`` path.
 
 from __future__ import annotations
 
+import copy
 import json
 import math
 import threading
@@ -85,6 +86,9 @@ class ServerConfig:
                  drain_timeout_s: float = 30.0,
                  retry_after_floor_s: float = 1.0,
                  stream_event_timeout_s: float = 60.0,
+                 max_stream_retries: int = 1,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_cap_s: float = 2.0,
                  clock: Callable[[], float] = time.monotonic):
         self.host = host
         self.port = int(port)
@@ -97,6 +101,13 @@ class ServerConfig:
         self.drain_timeout_s = float(drain_timeout_s)
         self.retry_after_floor_s = float(retry_after_floor_s)
         self.stream_event_timeout_s = float(stream_event_timeout_s)
+        # failover knobs (router pass-through): how many times a
+        # zero-token stream stranded by a replica failure re-submits,
+        # and the backoff between a failed replica's rebuilds (base,
+        # doubling each consecutive failure, capped at the cap)
+        self.max_stream_retries = int(max_stream_retries)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.restart_backoff_cap_s = float(restart_backoff_cap_s)
         self.clock = clock
 
 
@@ -237,12 +248,22 @@ class _Handler(BaseHTTPRequestHandler):
             "status": "draining" if draining else "ok",
             "inflight": router.inflight,
             "uptime_s": round(time.time() - srv._started_unix, 3),
+            # fleet-level fault-tolerance counters (the same numbers
+            # the server_replica_{failures,restarts}_total series
+            # carry in /metrics)
+            "replica_failures": router.metrics.replica_failures,
+            "replica_restarts": router.metrics.replica_restarts,
             "replicas": [
                 {"engine": r.label,
+                 # OK / FAILED / RESTARTING supervision state (lower-
+                 # case to match the router's internal names)
+                 "state": r.state,
                  "active_slots": int(r.engine.metrics.active_slots),
                  "queue_depth": int(r.engine.metrics.queue_depth),
                  "kv_blocks_used": int(r.engine.metrics.kv_blocks_used),
-                 "kv_blocks_total": int(r.engine.metrics.kv_blocks_total)}
+                 "kv_blocks_total": int(r.engine.metrics.kv_blocks_total),
+                 "swapped_slots": int(r.engine.metrics.swapped_slots),
+                 "preemptions": int(r.engine.metrics.preemptions)}
                 for r in router.replicas],
         }, status=503 if draining else 200)
 
@@ -314,13 +335,20 @@ class _Handler(BaseHTTPRequestHandler):
             srv.router.cancel(handle, reason="error")
             return self._reject(srv, 500, str(e), tenant)
         srv.router.metrics.observe_request(tenant, 200)
-        self._send_json({
+        body = {
             "request_id": handle.request_id,
             "tokens": tokens,
             "finish_reason": reason,
             "metrics": handle.request.metrics.to_dict()
             if handle.request is not None else {},
-        })
+        }
+        if reason == "replica_failed":
+            # the serving replica died mid-generation: the client should
+            # re-submit after a short backoff (a header can't carry this
+            # — the 200 status line is long gone on the SSE twin, so
+            # both paths put the hint in the terminal payload)
+            body["retry_after_s"] = srv.config.retry_after_floor_s
+        self._send_json(body)
 
     def _stream_sse(self, srv: "GenerationServer", handle: StreamHandle,
                     tenant: str) -> None:
@@ -345,6 +373,11 @@ class _Handler(BaseHTTPRequestHandler):
                 else:   # terminal event
                     done = {"request_id": handle.request_id,
                             "finish_reason": value, "tokens": index}
+                    if value == "replica_failed":
+                        # mid-stream replica death: headers are long
+                        # sent, so the retry hint rides the done frame
+                        done["retry_after_s"] = \
+                            srv.config.retry_after_floor_s
                     if handle.request is not None:
                         done["metrics"] = handle.request.metrics.to_dict()
                     self.wfile.write(
@@ -374,11 +407,18 @@ class GenerationServer:
         if isinstance(engines, Router):
             self.router = engines
         else:
-            self.router = Router(list(engines),
-                                 quotas=self.config.quotas,
-                                 default_quota=self.config.default_quota,
-                                 clock=self.config.clock,
-                                 registry=registry)
+            # no engine factory here (the caller owns engine
+            # construction): failed replicas park and are routed
+            # around; pt.server.serve() builds a factory-backed router
+            self.router = Router(
+                list(engines),
+                quotas=self.config.quotas,
+                default_quota=self.config.default_quota,
+                clock=self.config.clock,
+                registry=registry,
+                max_stream_retries=self.config.max_stream_retries,
+                restart_backoff_s=self.config.restart_backoff_s,
+                restart_backoff_cap_s=self.config.restart_backoff_cap_s)
         self._registry = registry or get_registry()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -451,8 +491,34 @@ def serve(params, cfg, config: Optional[ServerConfig] = None,
     config = config or ServerConfig()
     serving = config.serving if config.serving is not None \
         else ServingConfig()
-    engines = [ServingEngine(params, cfg, serving)
-               for _ in range(config.replicas)]
-    server = GenerationServer(engines, config, registry=registry)
+
+    def factory() -> ServingEngine:
+        # the replica supervisor's rebuild hook: a FAILED replica gets
+        # a FRESH engine over the same params/config and rejoins
+        # admission (params live for the server's life either way) —
+        # minus any fault plan: a plan observes ONE engine's step
+        # stream (faults.py contract), and a rebuilt engine restarts
+        # at step 0, so re-arming the schedule would turn a one-shot
+        # injected fault into a permanent crash/rebuild loop
+        if serving.fault_plan is not None:
+            clean = copy.copy(serving)
+            clean.fault_plan = None
+            return ServingEngine(params, cfg, clean)
+        return ServingEngine(params, cfg, serving)
+
+    def initial() -> ServingEngine:
+        return ServingEngine(params, cfg, serving)
+
+    engines = [initial() for _ in range(config.replicas)]
+    router = Router(engines,
+                    quotas=config.quotas,
+                    default_quota=config.default_quota,
+                    clock=config.clock,
+                    registry=registry,
+                    engine_factory=factory,
+                    max_stream_retries=config.max_stream_retries,
+                    restart_backoff_s=config.restart_backoff_s,
+                    restart_backoff_cap_s=config.restart_backoff_cap_s)
+    server = GenerationServer(router, config, registry=registry)
     server.serve()
     return server

@@ -18,13 +18,17 @@ saved model dir, or `ServingEngine(params, cfg)` over an in-memory
 parameter pytree.
 """
 
-from .engine import (EngineOverloadError, GenerationRequest, ServingConfig,
-                     ServingEngine)
+from .engine import (DEFAULT_RETRY_AFTER_S, EngineOverloadError,
+                     GenerationRequest, ServingConfig, ServingEngine)
+from .faults import FaultPlan, InjectedFault
 from .kv_cache import ShapeBuckets, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
-from .scheduler import ContinuousBatchingScheduler, SequenceEvent
+from .scheduler import (ContinuousBatchingScheduler, SequenceEvent,
+                        SwappedSequence)
 
 __all__ = ["ServingEngine", "ServingConfig", "GenerationRequest",
-           "EngineOverloadError", "ShapeBuckets", "SlotKVCache",
+           "EngineOverloadError", "DEFAULT_RETRY_AFTER_S",
+           "ShapeBuckets", "SlotKVCache",
            "ContinuousBatchingScheduler", "SequenceEvent",
+           "SwappedSequence", "FaultPlan", "InjectedFault",
            "EngineMetrics", "RequestMetrics"]

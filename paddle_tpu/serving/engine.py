@@ -39,7 +39,15 @@ from .scheduler import ContinuousBatchingScheduler
 _TRACER = get_tracer()
 
 __all__ = ["ServingConfig", "ServingEngine", "GenerationRequest",
-           "EngineOverloadError"]
+           "EngineOverloadError", "DEFAULT_RETRY_AFTER_S"]
+
+# Retry-After hint a shed carries before the engine has any queue-wait
+# samples (cold engine): a conservative 100ms — long enough that an
+# immediate-retry storm can't hammer a just-started engine, short
+# enough that the first real p50 takes over almost immediately. With
+# this default the hint is ALWAYS a number, so HTTP 429s carry a
+# well-formed Retry-After from the very first shed.
+DEFAULT_RETRY_AFTER_S = 0.1
 
 
 class EngineOverloadError(RuntimeError):
@@ -49,7 +57,9 @@ class EngineOverloadError(RuntimeError):
     instead of parsing the message: `queue_depth` (requests waiting at
     shed time), `running` (slots occupied), `retry_after_s` (suggested
     client backoff: the engine's queue-wait p50 when it has samples,
-    else None — callers apply their own floor)."""
+    else the documented DEFAULT_RETRY_AFTER_S — never None from the
+    engine's own shed path, so Retry-After headers are always
+    well-formed)."""
 
     def __init__(self, message: str, queue_depth: Optional[int] = None,
                  running: Optional[int] = None,
@@ -93,6 +103,9 @@ class ServingConfig:
                  prefix_cache: bool = True,
                  speculate_k: int = 0,
                  speculate_ngram: int = 512,
+                 preempt: bool = False,
+                 preempt_policy="newest",
+                 fault_plan=None,
                  clock: Callable[[], float] = time.monotonic):
         self.num_slots = int(num_slots)
         self.max_queue = int(max_queue)
@@ -119,6 +132,19 @@ class ServingConfig:
         # speculate_ngram sizes the hashed trigram table per slot.
         self.speculate_k = int(speculate_k)
         self.speculate_ngram = int(speculate_ngram)
+        # host-swap preemption (off by default — opt in where the arena
+        # is deliberately oversubscribed): under page pressure the
+        # engine evicts the policy-chosen RUNNING sequence's pages to a
+        # host swap pool and resumes it when pages free, instead of
+        # only queueing new admissions. preempt_policy: "newest"
+        # (default), "oldest", or a callable over the running table.
+        # Resumed streams are bit-identical to never-preempted runs.
+        self.preempt = bool(preempt)
+        self.preempt_policy = preempt_policy
+        # deterministic fault injection (serving.faults.FaultPlan):
+        # scheduled step exceptions / forced page shortages / delays —
+        # None in production
+        self.fault_plan = fault_plan
         self.clock = clock
 
 
@@ -219,12 +245,31 @@ class ServingEngine:
         self.metrics.kv_blocks_total = self.kv.blocks_total
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
+        # host swap pool: SwappedSequence records of preempted RUNNING
+        # sequences, FIFO (oldest-preempted resumes first). Driver-
+        # thread state, like the scheduler.
+        self._swapped: List[Any] = []
+        self.faults = serving.fault_plan
+        self._step_no = 0
         self._lock = threading.Lock()
         self._rid_counter = itertools.count()
         self.debug_port: Optional[int] = None   # set by create_engine
         # debug-server release token from acquire_debug_server (None =
         # this engine holds no reference); set by create_engine
         self._debug_server_ref: Optional[int] = None
+
+    @property
+    def faults(self):
+        """The installed FaultPlan (None = no injection). Assigning
+        here is the documented post-construction install path — the
+        setter mirrors the plan onto the scheduler so dispatch-level
+        faults (slow_dispatches) fire too, not just step-level ones."""
+        return self._faults
+
+    @faults.setter
+    def faults(self, plan) -> None:
+        self._faults = plan
+        self.scheduler.faults = plan
 
     # -- admission ----------------------------------------------------------
 
@@ -278,11 +323,13 @@ class ServingEngine:
         # flight record (no-op unless a watchdog with dump_on_overload is
         # installed) and must not stall concurrent submits/steps
         _watchdog.notify_overload(self.metrics.engine_label)
+        p50 = self.metrics.queue_wait_p50()
         raise EngineOverloadError(
             f"admission queue full ({self.config.max_queue}); "
             "request shed",
             queue_depth=shed_depth, running=self.kv.active_count,
-            retry_after_s=self.metrics.queue_wait_p50())
+            retry_after_s=p50 if p50 is not None
+            else DEFAULT_RETRY_AFTER_S)
 
     # -- drive loop ---------------------------------------------------------
 
@@ -325,13 +372,47 @@ class ServingEngine:
             return self._step_impl()
 
     def _step_impl(self) -> int:
+        step_no = self._step_no
+        self._step_no += 1
+        if self.faults is not None:
+            # counter already advanced: an injected exception fires
+            # exactly once, and a supervisor retrying the driver loop
+            # proceeds past it
+            self.faults.begin_step(step_no)
         admitted = []
         with self._lock:
             # apply deferred cancels first (scheduler state is only ever
             # touched from the driver thread; cancel() just marks)
             for req in self._pending_cancels:
-                self.scheduler.cancel(req)
+                if not self.scheduler.cancel(req):
+                    # not running on-device: the request may be parked
+                    # in the host swap pool — drop its record (its
+                    # pages were already freed at swap-out)
+                    n = len(self._swapped)
+                    self._swapped = [s for s in self._swapped
+                                     if s.req is not req]
+                    if len(self._swapped) != n:
+                        self.metrics.swapped_slots = len(self._swapped)
             self._pending_cancels.clear()
+        # resume-first: preempted sequences have strict priority over
+        # new admissions for freed pages/slots (they hold finished work
+        # and a host-side arena copy; admissions behind them are what
+        # put them out). FIFO scan — oldest-preempted first, but a
+        # record whose ORIGINAL slot is still occupied doesn't block a
+        # later one whose slot freed.
+        if self._swapped:
+            for sw in list(self._swapped):
+                if not self.scheduler.can_swap_in(sw):
+                    continue
+                t0 = time.perf_counter()
+                slot = self.scheduler.swap_in(sw)
+                assert slot is not None  # checked, same thread
+                self._swapped.remove(sw)
+                self.metrics.swap_ins += 1
+                self.metrics.observe_swap("swap_in",
+                                          time.perf_counter() - t0)
+            self.metrics.swapped_slots = len(self._swapped)
+        with self._lock:
             limit = self.config.max_admits_per_step
             # slots are claimed later in scheduler.admit, so bound the
             # pop count by the free slots NOW, not per-iteration
@@ -354,9 +435,10 @@ class ServingEngine:
             # after prefix-cache accounting). Head-of-line requests that
             # don't fit yet go back to the FRONT of the queue — FIFO
             # order is preserved and a later retirement frees their
-            # pages.
-            if not self.scheduler.can_admit(req.prompt,
-                                            req.max_new_tokens):
+            # pages. With preemption enabled, page pressure first tries
+            # to evict running sequences to the host swap pool (inside
+            # _admission_feasible).
+            if not self._admission_feasible(req, step_no):
                 with self._lock:
                     self._queue[:0] = [r for r in admitted[i:]
                                        if r.state == "queued"]
@@ -409,6 +491,7 @@ class ServingEngine:
             for run in self.scheduler.drain_spec_samples():
                 self.metrics.observe_spec_run(run)
         self.metrics.active_slots = self.kv.active_count
+        self.metrics.swapped_slots = len(self._swapped)
         # paged-pool visibility: block occupancy gauges + prefix-cache
         # counters (set from the allocator's cumulative totals — the
         # registry series a scrape reads track the authoritative host
@@ -420,13 +503,91 @@ class ServingEngine:
         self.metrics.prefix_cache_misses = self.kv.prefix_misses
         return emitted
 
+    def _admission_feasible(self, req, step_no: int) -> bool:
+        """Can `req` take a slot + pages RIGHT NOW? Applies, in order:
+        injected page shortages (requeue, never preempt — a forced
+        shortage simulates transient pressure, not an evictable
+        resident), the swap-pool page reservation (parked sequences
+        have strict priority over new admissions for freed pages, else
+        a stream of short requests starves every preempted one), the
+        real allocator check, and finally — preemption enabled, nothing
+        already parked — eviction of running sequences until the
+        admission fits."""
+        if self.faults is not None and self.faults.deny_pages(step_no):
+            return False
+        if self._swapped:
+            # conservative page reservation: ignores the prefix-cache
+            # hits the admission might enjoy, so it can only
+            # over-reserve
+            reserved = sum(s.n_blocks for s in self._swapped)
+            need = self.kv.blocks_for(req.prompt.size
+                                      + req.max_new_tokens)
+            if self.kv.blocks_available < reserved + need:
+                return False
+            # no slot reservation needed: the resume-first loop at the
+            # top of every step hands freed slots to parked sequences
+            # BEFORE any admission runs, and the sampler is
+            # slot-independent, so resumes take whatever row frees up
+        if self.scheduler.can_admit(req.prompt, req.max_new_tokens):
+            return True
+        if not self.config.preempt or self._swapped:
+            # preempting while sequences already wait in the swap pool
+            # would ping-pong residents; pressure with a non-empty pool
+            # always queues
+            return False
+        while not self.scheduler.can_admit(req.prompt,
+                                           req.max_new_tokens):
+            if not self._preempt_once(req):
+                return False
+        return True
+
+    def _preempt_once(self, req) -> bool:
+        """Evict one policy-chosen RUNNING sequence to the host swap
+        pool. Returns True when admission should be re-checked: either
+        a victim moved out, or the pipeline fence's collected
+        retirements already freed the pages without any eviction."""
+        if self.scheduler.active_count == 0:
+            return False
+        # swap_out requires an empty pipeline; the fence's tokens fan
+        # out NOW (and may retire slots — re-check before sacrificing
+        # anything). Per-dispatch batches so fenced collections feed
+        # the same decode_steps / tokens-per-dispatch telemetry the
+        # normal step() path does — preemption-heavy regimes would
+        # otherwise read inconsistently high tokens-per-dispatch
+        for batch in self.scheduler._sync_batches():
+            if batch:
+                self.metrics.decode_steps += 1
+                self.metrics.observe_dispatch_tokens(len(batch))
+            for event in batch:
+                self._emit(event)
+        if self.scheduler.can_admit(req.prompt, req.max_new_tokens):
+            return True
+        slot = self.scheduler.pick_victim(self.config.preempt_policy)
+        if slot is None:
+            return False
+        t0 = time.perf_counter()
+        sw = self.scheduler.swap_out(slot)
+        self._swapped.append(sw)
+        self.metrics.preemptions += 1
+        self.metrics.observe_swap("swap_out", time.perf_counter() - t0)
+        self.metrics.swapped_slots = len(self._swapped)
+        return True
+
+    @property
+    def swapped_count(self) -> int:
+        """Preempted sequences currently parked in the host swap pool
+        (they still owe tokens: drain loops must count them as work)."""
+        return len(self._swapped)
+
     def _on_dispatch_launched(self) -> None:
         self.metrics.dispatches += 1
 
     def run_until_drained(self, max_steps: Optional[int] = None) -> int:
-        """Step until queue and slots are empty; returns steps taken."""
+        """Step until queue, slots, and swap pool are empty; returns
+        steps taken."""
         steps = 0
-        while self._queue or self.scheduler.active_count:
+        while (self._queue or self.scheduler.active_count
+               or self._swapped):
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -492,6 +653,9 @@ class ServingEngine:
         s = self.metrics.snapshot()
         s.update(self.kv.occupancy())
         s["queue_depth"] = len(self._queue)
+        # host memory the swap pool currently pins (0 when nothing is
+        # preempted — the pool exists only under pressure)
+        s["swap_pool_bytes"] = sum(sw.swap_bytes for sw in self._swapped)
         s["compiled_executables"] = self.scheduler.compile_count
         # the registry label this engine's serving_* series carry, so a
         # caller can find them in observability.get_registry().snapshot()

@@ -182,7 +182,10 @@ class SlotKVCache:
     def alloc(self) -> Optional[int]:
         """Claim a free slot (page-table row); None when every row is
         occupied (the scheduler leaves the request queued). Pages are
-        mapped separately by map_slot()."""
+        mapped separately by map_slot(). Host-swap resumes allocate
+        through here too: the serving sampler is slot-independent
+        (scheduler._sample_row), so a preempted sequence may resume in
+        ANY free row bit-identically."""
         if not self._free:
             return None
         slot = self._free.pop()
@@ -382,14 +385,58 @@ class SlotKVCache:
             if digests[i] not in self._by_hash:
                 self._by_hash[digests[i]] = blocks[i]
                 self._hash_of[blocks[i]] = digests[i]
+        row = self._install_blocks(slot, blocks, p_len)
+        return row, len(claimed) * bs
+
+    def _install_blocks(self, slot: int, blocks, length: int):
+        """Install already-claimed+increffed blocks into `slot`'s page
+        row (scratch-padded) and update length/peak accounting — the
+        shared tail of map_slot (admission) and adopt_blocks (swap-in)."""
         self._slot_blocks[slot] = blocks
         row = np.full((self.max_pages,), SCRATCH_BLOCK, np.int32)
         row[:len(blocks)] = blocks
         self.page_table[slot] = row
-        self._len[slot] = p_len
+        self._len[slot] = int(length)
         self.peak_blocks_used = max(self.peak_blocks_used,
                                     self.blocks_used)
-        return row, len(claimed) * bs
+        return row
+
+    def mapped_block_count(self, slot: int) -> int:
+        """Blocks currently mapped into `slot`'s page row — what a
+        host-swap of this slot must copy out and later re-adopt."""
+        return len(self._slot_blocks[slot])
+
+    # -- host-swap adoption -------------------------------------------------
+
+    def can_adopt(self, n_blocks: int) -> bool:
+        """Feasibility of adopt_blocks() RIGHT NOW: the arena can supply
+        `n_blocks` private blocks (free + LRU-evictable)."""
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        return n_blocks <= self.blocks_available
+
+    def adopt_blocks(self, slot: int, n_blocks: int,
+                     length: int) -> np.ndarray:
+        """Claim `n_blocks` PRIVATE blocks for a swapped-in sequence and
+        install them in `slot`'s page row (length = live positions).
+
+        Unlike map_slot() this never consults or feeds the prefix
+        cache: the blocks' contents are about to be restored from the
+        host swap pool, and a swapped-in prefix re-registering its
+        hashes would race the admission that may have re-registered the
+        same digests while the sequence was out. Returns the page row
+        ((max_pages,) int32, scratch-padded) to scatter the payload
+        through; caller must have checked can_adopt()."""
+        if self._slot_blocks[slot]:
+            raise ValueError(f"slot {slot} already has mapped blocks")
+        if not self.can_adopt(n_blocks):
+            raise ValueError(
+                f"arena cannot supply {n_blocks} blocks "
+                f"({self.blocks_available} available)")
+        blocks = [self._take_block() for _ in range(n_blocks)]
+        for b in blocks:
+            self._incref(b)
+        return self._install_blocks(slot, blocks, length)
 
     # -- per-slot length tracking ------------------------------------------
 

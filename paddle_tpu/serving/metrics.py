@@ -142,8 +142,13 @@ _HELP = {
                          "cache instead of re-prefilled",
     "prefix_cache_misses": "shareable prompt blocks that missed the "
                            "prefix cache",
+    "preemptions": "running sequences preempted to the host swap pool "
+                   "under page pressure",
+    "swap_ins": "preempted sequences resumed from the host swap pool",
     "active_slots": "KV slots currently occupied",
     "queue_depth": "requests waiting for a slot",
+    "swapped_slots": "preempted sequences currently parked in the host "
+                     "swap pool, waiting for pages",
     "kv_blocks_total": "allocatable KV arena blocks (scratch excluded)",
     "kv_blocks_used": "KV arena blocks referenced by live sequences",
     "kv_blocks_cached": "unreferenced KV blocks kept warm for "
@@ -153,14 +158,17 @@ _HELP = {
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
              "decode_steps", "prefills", "dispatches",
              "spec_proposed", "spec_accepted",
-             "prefix_cache_hits", "prefix_cache_misses")
+             "prefix_cache_hits", "prefix_cache_misses",
+             "preemptions", "swap_ins")
 _GAUGES = ("active_slots", "queue_depth", "kv_blocks_total",
-           "kv_blocks_used", "kv_blocks_cached")
+           "kv_blocks_used", "kv_blocks_cached", "swapped_slots")
 _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tpot": "serving_tpot_seconds",
                "queue_wait": "serving_queue_wait_seconds",
                "tokens_per_dispatch": "serving_tokens_per_dispatch",
-               "spec_accepted_run": "serving_spec_accepted_run"}
+               "spec_accepted_run": "serving_spec_accepted_run",
+               "swap_out": "serving_swap_out_seconds",
+               "swap_in": "serving_swap_in_seconds"}
 _HIST_HELP = {
     "ttft": "request ttft in seconds",
     "tpot": "request tpot in seconds",
@@ -171,6 +179,10 @@ _HIST_HELP = {
     "spec_accepted_run": "accepted draft-run length per speculative "
                          "verify pass (0 = every draft rejected; "
                          "tokens per pass is this + 1)",
+    "swap_out": "host-swap copy-out latency per preemption in seconds "
+                "(pipeline fence + device_get of the slot's blocks)",
+    "swap_in": "host-swap restore latency per resume in seconds "
+               "(block adoption + scatter + carry rebuild)",
 }
 
 def _count_buckets(upper: int):
@@ -284,6 +296,12 @@ class EngineMetrics:
         tokens (0..speculate_k) — the per-pass acceptance distribution
         behind the /varz acceptance-ratio rollup."""
         self._hists["spec_accepted_run"].observe(float(accepted))
+
+    def observe_swap(self, direction: str, seconds: float) -> None:
+        """One host-swap transfer took `seconds`; direction is
+        "swap_out" (preemption copy-out) or "swap_in" (resume restore)
+        — the latency series behind the bench's swap_in_ms column."""
+        self._hists[direction].observe(float(seconds))
 
     def record(self, rm: RequestMetrics):
         self.completed += 1

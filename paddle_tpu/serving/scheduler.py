@@ -72,10 +72,15 @@ adds exactly ONE executable whatever decode_chunk is.
 Greedy sequences reproduce the sequential `gpt_generate` path
 token-for-token: the per-slot step math is gpt_decode_step's row-by-row,
 and argmax runs in-graph exactly as `_sample` does. Sampled sequences
-(temperature > 0) use a per-slot PRNG key seeded from the request seed —
-deterministic per request AND per chunk size (one key split per decode
-iteration, frozen slots included, exactly the per-step schedule), but a
-different key schedule than gpt_generate's single chain.
+(temperature > 0) use a per-slot threefry2x32 Gumbel-max sampler
+(gpt_decode.sample_gumbel — NOT jax.random: the fleet's default rbg
+PRNG is not vmap-invariant, see _sample_row) keyed from the request
+seed, one key split per decode iteration, frozen slots included. A
+request's seeded stream is therefore a pure function of (params,
+prompt, seed, chain position): invariant to chunk size, slot
+placement, admission timing, co-batched load, and host-swap
+preemption — but a different key schedule than gpt_generate's single
+chain.
 
 SPECULATIVE DECODING (speculate_k > 0): every chunk iteration becomes a
 draft -> verify -> accept pass — a per-slot trigram table (carried in
@@ -109,7 +114,8 @@ from .kv_cache import ShapeBuckets, SlotKVCache
 
 _TRACER = get_tracer()
 
-__all__ = ["ContinuousBatchingScheduler", "SequenceEvent"]
+__all__ = ["ContinuousBatchingScheduler", "SequenceEvent",
+           "SwappedSequence"]
 
 
 class SequenceEvent(NamedTuple):
@@ -126,15 +132,56 @@ class _Running:
     reset in-graph at admission."""
 
     __slots__ = ("req", "pos", "produced", "max_new", "eos_id",
-                 "live_from")
+                 "live_from", "seq")
 
-    def __init__(self, req, pos, max_new, eos_id, live_from):
+    def __init__(self, req, pos, max_new, eos_id, live_from, seq=0):
         self.req = req
         self.pos = pos                    # absolute position fed next
         self.produced = 1                 # prefill already sampled one
         self.max_new = max_new
         self.eos_id = eos_id
         self.live_from = live_from        # first dispatch carrying tokens
+        self.seq = seq                    # admission order (preemption
+        #                                   policies key on it; preserved
+        #                                   across swap-out/swap-in)
+
+
+class SwappedSequence:
+    """Host-side swap-pool record of a preempted RUNNING sequence: the
+    slot's arena blocks pulled to host memory plus the per-slot rows of
+    the device decode carry (current token, position, remaining budget,
+    temperature, eos id, PRNG key — and the drafter rows under
+    speculation), so swap-in can rebuild the slot bit-exactly and the
+    resumed stream stays token-identical to a never-preempted run."""
+
+    __slots__ = ("req", "pos", "produced", "max_new", "eos_id",
+                 "seq", "length", "n_blocks", "payload", "token", "ts",
+                 "remaining", "temp", "eos", "key_row", "spec")
+
+    def __init__(self, req, pos, produced, max_new, eos_id, seq,
+                 length, n_blocks, payload, token, ts, remaining, temp,
+                 eos, key_row, spec=None):
+        self.req = req
+        self.pos = pos
+        self.produced = produced
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.seq = seq
+        self.length = length              # kv length() at swap-out
+        self.n_blocks = n_blocks          # blocks to re-adopt at resume
+        self.payload = payload            # (L, 2, P, heads, bs, hd) host
+        self.token = token                # decode-carry rows, host side
+        self.ts = ts
+        self.remaining = remaining
+        self.temp = temp
+        self.eos = eos
+        self.key_row = key_row
+        self.spec = spec                  # (prev, ngram row) or None
+
+    @property
+    def swap_bytes(self) -> int:
+        """Host swap-pool footprint of this record's KV payload."""
+        return self.payload.nbytes
 
 
 class _Inflight(NamedTuple):
@@ -187,12 +234,17 @@ class ContinuousBatchingScheduler:
         self._spec_samples: List[int] = []
         self._running: Dict[int, _Running] = {}
         self._compile_events: List[str] = []
-        self._keys = jax.random.split(
-            jax.random.PRNGKey(0), kv.num_slots)
+        # (S, 2) uint32 sampler keys (gpt_decode.threefry2x32 streams,
+        # NOT jax.random — see _sample_row); every row is re-seeded
+        # in-graph at admission, so zeros are fine here
+        self._keys = jax.numpy.zeros((kv.num_slots, 2), jax.numpy.uint32)
         self._prefill_jit = None
         self._chunk_jit = None
         self._admit_jit = None
         self._release_jit = None
+        self._swapout_jit = None
+        self._swapin_jit = None
+        self._admit_counter = 0           # admission order for _Running.seq
         # device-resident decode carry: (tokens, ts, done, remaining,
         # temps, eos_ids), all (S,) — built lazily with the jits, next
         # to the device page table (all rows scratch until admission)
@@ -205,6 +257,10 @@ class ContinuousBatchingScheduler:
         # blocked in the NEXT collect still shows this launch (a metric
         # bumped after step() returns would never record it)
         self.on_launch = None
+        # deterministic fault injection (serving.faults.FaultPlan or
+        # None): the engine installs its plan here so scheduled
+        # dispatch delays fire at the launch site
+        self.faults = None
         # per-bucket host staging buffers, reused across admissions
         # (jit copies feed arrays at dispatch, so mutation-after-call is
         # safe and admission never allocates)
@@ -217,20 +273,29 @@ class ContinuousBatchingScheduler:
     # once per compiled executable): the compile-counter hook.
 
     def _sample_row(self, key, logits, temp):
-        """In-graph per-slot sampler — gpt_decode._sample with the
-        temperature as a traced per-slot value instead of a static."""
+        """In-graph per-slot sampler: counter-based threefry2x32 +
+        Gumbel-max (gpt_decode.sample_gumbel) with the temperature as a
+        traced per-slot value. Deliberately NOT jax.random: the fleet's
+        default rbg PRNG is not vmap-invariant (a vmapped draw follows
+        keys[0]'s stream, not each row's own key), while this sampler is
+        plain vectorized uint32/f32 math — a row's draw is a pure
+        function of (its key, its logits, its temp), so a sequence's
+        seeded stream survives slot changes, late admission, and
+        host-swap preemption bit-identically."""
         import jax
         import jax.numpy as jnp
+        from ..models import gpt_decode as gd
 
-        key_next, key_use = jax.random.split(key)
+        key_next = gd.sample_split(key)
         greedy = jnp.argmax(logits, -1).astype(jnp.int32)
         scaled = logits / jnp.maximum(temp, 1e-6)
         if self.top_k > 0:
             vals, idx = jax.lax.top_k(scaled, self.top_k)
-            choice = jax.random.categorical(key_use, vals)
-            drawn = idx[choice].astype(jnp.int32)
+            g = gd.sample_gumbel(key, self.top_k)
+            drawn = idx[jnp.argmax(vals + g)].astype(jnp.int32)
         else:
-            drawn = jax.random.categorical(key_use, scaled).astype(jnp.int32)
+            g = gd.sample_gumbel(key, logits.shape[-1])
+            drawn = jnp.argmax(scaled + g).astype(jnp.int32)
         return jnp.where(temp > 0.0, drawn, greedy), key_next
 
     def _ensure_jits(self):
@@ -281,7 +346,7 @@ class ContinuousBatchingScheduler:
                        max_new, eos_id, prev_tok):
             self._compile_events.append("admit_sample")
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
-            keys = keys.at[slot].set(jax.random.PRNGKey(seed))
+            keys = keys.at[slot].set(gd.sample_key(seed))
             first, key_next = self._sample_row(keys[slot], logits, temp)
             keys = keys.at[slot].set(key_next)
             # finished-at-admission mirrors the host rule exactly so the
@@ -337,6 +402,45 @@ class ContinuousBatchingScheduler:
                 + tuple(state[6:])
             return pt, state
 
+        def swapout_impl(arena, keys, state, blocks, slot):
+            # host-swap copy-out: gather ONLY this slot's block rows
+            # (scratch-padded to max_pages — one executable whatever the
+            # block count) plus its rows of the decode carry. Read-only:
+            # nothing is donated, the arena stays live for the release
+            # + later dispatches enqueued behind this.
+            self._compile_events.append("swap_out")
+            payload = jnp.take(arena, blocks, axis=2)
+            tokens, ts, _done, remaining, temps, eos_ids = state[:6]
+            rows = (tokens[slot], ts[slot], remaining[slot], temps[slot],
+                    eos_ids[slot], keys[slot])
+            if self.speculate_k:
+                rows += (state[6][slot], state[7][slot])
+            return (payload,) + rows
+
+        def swapin_impl(arena, pt, keys, state, payload, blocks, slot,
+                        token, ts_v, rem, temp, eos, key_row, *spec_rows):
+            # host-swap restore: scatter the payload back through the
+            # freshly adopted page row (padding lanes land in scratch,
+            # the trash lane) and rebuild the slot's decode-carry rows
+            # exactly as saved — the PRNG chain continues where it
+            # stopped, so resumed streams are bit-identical.
+            self._compile_events.append("swap_in")
+            arena = arena.at[:, :, blocks].set(payload)
+            pt = pt.at[slot].set(blocks)
+            keys = keys.at[slot].set(key_row)
+            tokens, ts, done, remaining, temps, eos_ids = state[:6]
+            new_state = (tokens.at[slot].set(token),
+                         ts.at[slot].set(ts_v),
+                         done.at[slot].set(False),
+                         remaining.at[slot].set(rem),
+                         temps.at[slot].set(temp),
+                         eos_ids.at[slot].set(eos))
+            if self.speculate_k:
+                prev, table = state[6], state[7]
+                new_state += (prev.at[slot].set(spec_rows[0]),
+                              table.at[slot].set(spec_rows[1]))
+            return arena, pt, keys, new_state
+
         # donation (the executor's donate=True discipline): the arena,
         # the page table, the key table, and the decode carry are
         # consumed by exactly one dispatch and replaced by its outputs,
@@ -348,6 +452,9 @@ class ContinuousBatchingScheduler:
         self._admit_jit = jax.jit(admit_impl, donate_argnums=(0, 1))
         self._chunk_jit = jax.jit(chunk_impl, donate_argnums=(1, 3, 4))
         self._release_jit = jax.jit(release_impl, donate_argnums=(0, 1))
+        self._swapout_jit = jax.jit(swapout_impl)
+        self._swapin_jit = jax.jit(swapin_impl,
+                                   donate_argnums=(0, 1, 2, 3))
 
     # -- compile-counter hook ----------------------------------------------
 
@@ -440,7 +547,8 @@ class ContinuousBatchingScheduler:
                 np.int32(prompt[0, -1]))
         first = int(first)
         st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
-                      live_from=self._launches)
+                      live_from=self._launches, seq=self._admit_counter)
+        self._admit_counter += 1
         finished = (st.produced >= max_new
                     or (eos_id is not None and first == eos_id))
         if finished:
@@ -489,6 +597,8 @@ class ContinuousBatchingScheduler:
         return False
 
     def _launch(self) -> None:
+        if self.faults is not None:
+            self.faults.before_dispatch(self._launches)
         begin_ns = time.monotonic_ns() if _TRACER.enabled else 0
         with profiler.RecordEvent("serving/decode_dispatch",
                                   active=len(self._running),
@@ -610,3 +720,138 @@ class ContinuousBatchingScheduler:
                 self.kv.free(slot)
                 return True
         return False
+
+    # -- host-swap preemption ------------------------------------------------
+
+    def sync(self) -> List[SequenceEvent]:
+        """Collect EVERY in-flight dispatch and return its events — the
+        fence swap_out() needs: once the pipeline is empty, the device
+        carry and arena reflect exactly the tokens the host has seen,
+        so a slot's rows can be copied out without losing in-flight
+        work. A slow path by construction (it forfeits the overlap
+        win); callers reach for it only under page pressure or at
+        shutdown."""
+        return [e for batch in self._sync_batches() for e in batch]
+
+    def _sync_batches(self) -> List[List[SequenceEvent]]:
+        """sync() with per-dispatch granularity: one event list per
+        collected in-flight dispatch, so the engine's fence path can
+        feed the same decode_steps / tokens-per-dispatch telemetry the
+        normal step() collection does."""
+        batches: List[List[SequenceEvent]] = []
+        while self._inflight:
+            batches.append(self._collect(self._inflight.pop(0)))
+        return batches
+
+    def pick_victim(self, policy="newest") -> Optional[int]:
+        """The slot the preemption policy sacrifices next, or None when
+        nothing is running. "newest" (the default — the youngest
+        sequence has the least work to lose and re-waits the shortest
+        queue) and "oldest" key on admission order; a callable receives
+        {slot: running-state} (objects expose .seq/.pos/.produced/
+        .max_new) and returns a slot."""
+        if not self._running:
+            return None
+        if callable(policy):
+            slot = policy(dict(self._running))
+            if slot not in self._running:
+                raise ValueError(
+                    f"preempt policy returned {slot!r}, not a running "
+                    f"slot {sorted(self._running)}")
+            return slot
+        if policy == "newest":
+            return max(self._running,
+                       key=lambda s: (self._running[s].seq, s))
+        if policy == "oldest":
+            return min(self._running,
+                       key=lambda s: (self._running[s].seq, s))
+        raise ValueError(
+            f"unknown preempt policy {policy!r} (newest/oldest/callable)")
+
+    def swap_out(self, slot: int) -> SwappedSequence:
+        """Preempt the sequence in `slot`: copy its arena blocks and
+        decode-carry rows to host memory, freeze the slot in-graph
+        (release executable — its ride-along writes go to scratch, not
+        to blocks admission will reallocate), and free its pages.
+        Caller must have drained the pipeline (sync()) first — a block
+        in flight could still carry this slot's tokens."""
+        import jax
+
+        if self._inflight:
+            raise RuntimeError(
+                "swap_out with dispatches in flight — sync() first")
+        self._ensure_jits()
+        st = self._running.pop(slot)
+        n_blocks = self.kv.mapped_block_count(slot)
+        blocks_row = self.kv.page_table[slot].copy()
+        host = jax.device_get(self._swapout_jit(
+            self.kv.kv, self._keys, self._state, blocks_row,
+            np.int32(slot)))
+        payload, token, ts, rem, temp, eos, key_row = host[:7]
+        spec = (host[7], host[8]) if self.speculate_k else None
+        # park only the rows the sequence owns: the gather is scratch-
+        # padded to max_pages so ONE executable serves every block
+        # count, but keeping the full-width copy would pin up to
+        # max_pages/n_blocks times the KV bytes actually owned (and
+        # swap_pool_bytes would report the inflated number); swap_in
+        # re-pads host-side before the scatter, executable unchanged
+        payload = np.ascontiguousarray(
+            np.asarray(payload)[:, :, :n_blocks])
+        sw = SwappedSequence(
+            st.req, st.pos, st.produced, st.max_new, st.eos_id,
+            st.seq, self.kv.length(slot), n_blocks, payload,
+            token, ts, rem, temp, eos, np.asarray(key_row), spec)
+        self._pt, self._state = self._release_jit(
+            self._pt, self._state, np.int32(slot))
+        self.kv.free(slot)
+        return sw
+
+    def can_swap_in(self, sw: SwappedSequence) -> bool:
+        """True when swap_in() would succeed RIGHT NOW: a page-table
+        row is free and the arena can supply the sequence's blocks.
+        Driver-thread only, same discipline as can_admit()."""
+        return (self.kv.free_count > 0
+                and self.kv.can_adopt(sw.n_blocks))
+
+    def swap_in(self, sw: SwappedSequence) -> Optional[int]:
+        """Resume a preempted sequence: adopt fresh private blocks into
+        any free slot (the sampler is slot-independent — _sample_row —
+        so the row need not match the one it was preempted from),
+        scatter the host payload back through the new page row, and
+        rebuild the slot's decode-carry rows exactly as saved. The
+        restored sampler key row continues the per-token split chain,
+        so the resumed stream is bit-identical to a never-preempted run
+        (greedy and seeded, with and without speculation). Returns the
+        slot, or None when no slot or pages are available yet.
+
+        Safe with dispatches in flight: live_from is stamped at the
+        CURRENT launch index, so blocks launched while the sequence was
+        out are never attributed to it."""
+        self._ensure_jits()
+        if not self.can_swap_in(sw):
+            return None
+        slot = self.kv.alloc()
+        assert slot is not None          # free_count held, same thread
+        row = self.kv.adopt_blocks(slot, sw.n_blocks, sw.length)
+        # re-pad the parked payload to the executable's max_pages width
+        # (swap_out slices it to the owned rows); the pad lanes ride
+        # the row's scratch entries, i.e. land in the trash block
+        payload = sw.payload
+        if payload.shape[2] < len(row):
+            full = np.zeros(payload.shape[:2] + (len(row),)
+                            + payload.shape[3:], payload.dtype)
+            full[:, :, :sw.n_blocks] = payload
+            payload = full
+        args = [self.kv.kv, self._pt, self._keys, self._state,
+                payload, row, np.int32(slot), sw.token, sw.ts,
+                sw.remaining, sw.temp, sw.eos, sw.key_row]
+        if self.speculate_k:
+            args += [sw.spec[0], sw.spec[1]]
+        self.kv.kv, self._pt, self._keys, self._state = \
+            self._swapin_jit(*args)
+        st = _Running(sw.req, pos=sw.pos, max_new=sw.max_new,
+                      eos_id=sw.eos_id, live_from=self._launches,
+                      seq=sw.seq)
+        st.produced = sw.produced
+        self._running[slot] = st
+        return slot

@@ -28,8 +28,8 @@ from paddle_tpu.models import gpt_decode as gd
 from paddle_tpu.server import (DrainingError, GenerationServer,
                                QuotaConfig, QuotaExceededError, Router,
                                ServerConfig, TokenBucket)
-from paddle_tpu.serving import (EngineOverloadError, ServingConfig,
-                                ServingEngine)
+from paddle_tpu.serving import (EngineOverloadError, FaultPlan,
+                                ServingConfig, ServingEngine)
 
 
 def tiny_cfg():
@@ -597,7 +597,10 @@ def test_router_least_loaded_spread_and_structured_overload(trained):
             router.submit([1, 2], 2)
         assert ei.value.queue_depth == 1
         assert ei.value.running == 0
-        assert ei.value.retry_after_s is None     # no samples yet
+        # cold engines have no queue-wait samples: the shed still
+        # carries the documented conservative default, never None
+        assert (ei.value.retry_after_s
+                == pt.serving.DEFAULT_RETRY_AFTER_S)
     finally:
         router.close(drain=False)
     # close cancelled the queued handles and retired the engine series
@@ -735,6 +738,234 @@ def test_multi_replica_soak(trained):
     for e in engines:
         assert _registry_value("serving_submitted_total",
                                engine=e.metrics.engine_label) is None
+
+
+# ---------------------------------------------------------------------------
+# replica supervision + failover
+# ---------------------------------------------------------------------------
+
+def test_zero_token_streams_failover_to_healthy_replica(trained):
+    """A replica that dies before any of its streams emitted a token
+    hands them to a healthy replica TRANSPARENTLY: the retried stream
+    is bit-identical (prompt/seed/deadline ride the handle), the
+    failure is counted, and — with no engine factory — the dead
+    replica parks FAILED and the router routes around it."""
+    # both replicas idle + equal load -> the round-robin tie-break
+    # deterministically sends the FIRST submit to replica 0
+    faulty = make_engine(trained,
+                         fault_plan=FaultPlan(step_exceptions={0}))
+    healthy = make_engine(trained)
+    router = Router([faulty, healthy])
+    router.start()
+    try:
+        prompt = np.asarray([3, 1, 4], np.int32)
+        ref = library_stream(trained, [3, 1, 4], 6)
+        h = router.submit(prompt, 6)
+        assert h.replica.engine is faulty      # tie-break is rr-deterministic
+        tokens, reason = h.result(timeout=60)
+        assert reason == "length"
+        assert tokens == ref                   # retried bit-identically
+        assert h.retries == 1 and h.emitted == 6
+        assert router.metrics.replica_failures == 1
+        assert _registry_value(
+            "server_replica_failures_total",
+            replica=faulty.metrics.engine_label) == 1
+        states = sorted(r.state for r in router.replicas)
+        assert states == ["failed", "ok"]      # parked, not rebuilt
+        # new admissions route around the dead replica
+        h2 = router.submit(prompt, 6)
+        assert h2.replica.engine is healthy
+        tokens, reason = h2.result(timeout=60)
+        assert reason == "length" and tokens == ref
+    finally:
+        router.close(drain=False)
+
+
+def test_mid_stream_replica_failure_terminates_replica_failed(trained):
+    """A stream that already emitted tokens cannot be transparently
+    replayed: a replica death mid-emission terminates it with
+    finish_reason=replica_failed (exactly one terminal event, no hang),
+    and with no healthy replica left admission sheds with a structured
+    no-healthy-replicas overload."""
+    faulty = make_engine(trained,
+                         fault_plan=FaultPlan(step_exceptions={3}))
+    router = Router([faulty])
+    router.start()
+    try:
+        prompt = np.asarray([3, 1, 4], np.int32)
+        h = router.submit(prompt, 24)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "replica_failed"
+        assert 0 < h.emitted < 24              # mid-stream, not complete
+        assert router.metrics.replica_failures == 1
+        with pytest.raises(EngineOverloadError,
+                           match="no healthy replicas") as ei:
+            router.submit(prompt, 4)
+        assert ei.value.retry_after_s is not None
+    finally:
+        router.close(drain=False)
+
+
+def test_failed_replica_rebuilds_via_factory_and_rejoins(trained):
+    """With an engine factory the supervisor rebuilds a FAILED replica:
+    fresh engine from the same params after backoff, state returns to
+    OK, the restart is counted, and the dead engine's registry series
+    are retired."""
+    built = []
+
+    def factory():
+        eng = make_engine(trained)
+        built.append(eng)
+        return eng
+
+    faulty = make_engine(trained,
+                         fault_plan=FaultPlan(step_exceptions={0}))
+    dead_label = faulty.metrics.engine_label
+    router = Router([faulty], engine_factory=factory,
+                    restart_backoff_s=0.01)
+    router.start()
+    try:
+        prompt = np.asarray([3, 1, 4], np.int32)
+        ref = library_stream(trained, [3, 1, 4], 6)
+        h = router.submit(prompt, 6)
+        # zero-token stream but no healthy replica to retry on: the
+        # stream terminates rather than waiting out the rebuild
+        _, reason = h.result(timeout=60)
+        assert reason == "replica_failed"
+        deadline = time.monotonic() + 30
+        while (router.replicas[0].state != "ok"
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert router.replicas[0].state == "ok"
+        assert len(built) == 1
+        assert router.replicas[0].engine is built[0]
+        assert router.metrics.replica_restarts == 1
+        assert _registry_value("server_replica_restarts_total",
+                               replica=dead_label) == 1
+        # the dead engine's serving series were retired at rebuild
+        assert _registry_value("serving_submitted_total",
+                               engine=dead_label) is None
+        tokens, reason = router.submit(prompt, 6).result(timeout=60)
+        assert reason == "length" and tokens == ref
+    finally:
+        router.close(drain=False)
+
+
+def test_healthz_reports_replica_supervision_state(trained):
+    """/healthz carries the fault-tolerance surface: per-replica
+    supervision state + swapped_slots/preemptions gauges and the
+    fleet-level failure/restart counters; a replica death flips its
+    state and the terminal SSE frame carries the retry hint."""
+    srv = make_server(trained,
+                      fault_plan=FaultPlan(step_exceptions={0}))
+    try:
+        _, body = _get_json(srv.port, "/healthz", expect=200)
+        rep = body["replicas"][0]
+        assert rep["state"] == "ok"
+        assert rep["swapped_slots"] == 0 and rep["preemptions"] == 0
+        assert body["replica_failures"] == 0
+        assert body["replica_restarts"] == 0
+        status, _, tokens, done = sse_generate(
+            srv.port, {"prompt": [3, 1, 4], "max_new_tokens": 6})
+        assert status == 200
+        assert tokens == [] and done["finish_reason"] == "replica_failed"
+        assert done["retry_after_s"] > 0
+        _, body = _get_json(srv.port, "/healthz", expect=200)
+        assert body["replicas"][0]["state"] in ("failed", "restarting")
+        assert body["replica_failures"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_drain_finishes_parked_swapped_sequences(trained):
+    """The PR 8 zero-dropped-tokens drain pin extended to preemption:
+    drain begins while a preempted sequence sits in the host swap pool,
+    and still every stream finishes with its full budget and the arena
+    returns to zero pages used."""
+    cfg, _ = trained
+    # over-subscribed arena (the test_serving PRESSURE geometry) +
+    # slow-step injection so the parked window is wide enough to
+    # observe without racing the driver
+    eng = make_engine(trained, num_slots=4, max_queue=16, block_size=4,
+                      kv_blocks=12, decode_chunk=4, preempt=True,
+                      fault_plan=FaultPlan(
+                          slow_steps={i: 0.001 for i in range(2, 12)}))
+    router = Router([eng])
+    router.start()
+    try:
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (5, 7, 4, 6)]
+        handles = [router.submit(p, 12) for p in prompts]
+        deadline = time.monotonic() + 30
+        while eng.swapped_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.0005)
+        assert eng.swapped_count >= 1          # parked as drain begins
+        assert router.drain(timeout=120)
+        for h in handles:
+            tokens, reason = h.result(timeout=1)
+            assert reason == "length"
+            assert len(tokens) == 12           # zero dropped tokens
+        assert eng.swapped_count == 0
+        assert eng.kv.blocks_used == 0
+        assert eng.stats()["preemptions"] >= 1
+    finally:
+        router.close(drain=False)
+
+
+@pytest.mark.slow
+def test_chaos_soak_every_request_terminal(trained):
+    """Seeded mixed-fault storm (step exceptions, forced page
+    shortages, delays) over a 2-replica router with preemption ON and
+    rebuild enabled: every submitted request reaches a terminal
+    finish_reason — no stream hangs — and surviving engines drain to
+    zero pages. The same seeds replay the same storm."""
+    def factory():
+        return make_engine(trained, num_slots=2, max_queue=64,
+                           block_size=4, kv_blocks=12, decode_chunk=4,
+                           preempt=True)
+
+    engines = []
+    for i in range(2):
+        eng = factory()
+        eng.faults = FaultPlan.chaos(seed=100 + i, steps=400,
+                                     p_exception=0.005, p_shortage=0.05,
+                                     p_slow=0.02, slow_s=0.001)
+        engines.append(eng)
+    router = Router(engines, engine_factory=factory,
+                    restart_backoff_s=0.01, max_stream_retries=2)
+    router.start()
+    cfg, _ = trained
+    rng = np.random.RandomState(7)
+    handles, shed = [], 0
+    try:
+        for i in range(24):
+            p = rng.randint(0, cfg.vocab_size,
+                            (int(rng.randint(3, 8)),)).astype(np.int32)
+            kw = {}
+            if i % 3 == 1:
+                kw = dict(temperature=0.8, seed=int(i))
+            if i % 5 == 4:
+                kw["deadline_s"] = 60.0
+            try:
+                handles.append(
+                    router.submit(p, int(rng.randint(4, 16)), **kw))
+            except EngineOverloadError:
+                shed += 1                      # a shed IS terminal too
+            time.sleep(0.002)
+        terminal = {"stop", "length", "cancelled", "deadline_exceeded",
+                    "replica_failed"}
+        for h in handles:
+            _, reason = h.result(timeout=120)
+            assert reason in terminal, reason
+        assert len(handles) + shed == 24       # every request accounted
+        assert router.drain(timeout=120)
+        for r in router.replicas:
+            if r.state == "ok":
+                assert r.engine.kv.blocks_used == 0
+                assert r.engine.swapped_count == 0
+    finally:
+        router.close(drain=False)
 
 
 if __name__ == "__main__":

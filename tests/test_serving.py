@@ -16,7 +16,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
 from paddle_tpu.models import gpt_decode as gd
-from paddle_tpu.serving import (EngineOverloadError, ServingConfig,
+from paddle_tpu.serving import (EngineOverloadError, FaultPlan,
+                                InjectedFault, ServingConfig,
                                 ServingEngine, ShapeBuckets, SlotKVCache)
 
 
@@ -206,7 +207,9 @@ def test_overload_error_carries_structured_fields(trained):
     """EngineOverloadError exposes queue depth / running count / a
     retry-after hint as FIELDS (the HTTP tier and bench tooling read
     state, never parse messages). The hint is the queue-wait p50 once
-    requests have flowed, None before any sample exists."""
+    requests have flowed; before any sample exists (cold engine) it is
+    the documented conservative DEFAULT_RETRY_AFTER_S, never None — so
+    429 Retry-After headers are always well-formed."""
     eng = make_engine(trained, num_slots=1, max_queue=1)
     p = np.asarray([1, 2, 3], np.int32)
     eng.submit(p, max_new_tokens=2)
@@ -214,7 +217,8 @@ def test_overload_error_carries_structured_fields(trained):
         eng.submit(p, max_new_tokens=2)
     assert ei.value.queue_depth == 1
     assert ei.value.running == 0             # nothing admitted yet
-    assert ei.value.retry_after_s is None    # no queue-wait samples yet
+    # no queue-wait samples yet -> the documented cold-engine default
+    assert ei.value.retry_after_s == pt.serving.DEFAULT_RETRY_AFTER_S
     assert eng.metrics.queue_wait_p50() is None
     eng.run_until_drained()                  # completes the queued one
     eng.submit(p, max_new_tokens=8)
@@ -1386,3 +1390,255 @@ def test_predictor_pool_exclusive_acquire(tmp_path):
         with pytest.raises(TimeoutError, match="no free predictor"):
             with pool.acquire(timeout=0.05):
                 pass
+
+
+# ---------------------------------------------------------------------------
+# host-swap preemption + deterministic fault injection
+# ---------------------------------------------------------------------------
+
+# over-subscribed arena: 4 requests x blocks_for(7 prompt + 12 new) =
+# 5 blocks each = up to 20 blocks demanded vs 11 allocatable -> the
+# engine MUST preempt (host-swap a running sequence out) to flow
+PRESSURE = dict(num_slots=4, max_queue=16, block_size=4, kv_blocks=12,
+                decode_chunk=4, preempt=True)
+
+
+def _pressure_prompts(cfg):
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in (5, 7, 4, 6)]
+
+
+def test_preempt_swap_resume_greedy_identity_and_no_leaks(trained):
+    """The tentpole pin, greedy half: an over-subscribed arena forces a
+    preemption (pages host-swapped, slot freed, sequence later resumed)
+    and every stream is STILL bit-identical to the sequential
+    gpt_generate path; after the drain no pages, no parked sequences,
+    and no host swap-pool bytes are left behind. The registry series
+    and the /varz preemption rollup carry the same numbers the engine
+    stats report."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.debug_server import _serving_varz
+
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    eng = make_engine(trained, **PRESSURE)
+    outs = eng.generate(prompts, max_new_tokens=12)
+    s = eng.stats()
+    assert s["preemptions"] >= 1, "arena not tight enough to preempt"
+    assert s["swap_ins"] == s["preemptions"]   # everything parked resumed
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(o, sequential_ref(trained, p, 12))
+    # leak-free drain: no parked work, no pages, no host pool bytes
+    assert s["swapped_slots"] == 0
+    assert s["blocks_used"] == 0
+    assert s["swap_pool_bytes"] == 0
+    label = s["engine_label"]
+    snap = get_registry().snapshot()
+    for fam, want in (("serving_preemptions_total", s["preemptions"]),
+                      ("serving_swap_ins_total", s["swap_ins"]),
+                      ("serving_swapped_slots", 0)):
+        row = next(r for r in snap[fam]["series"]
+                   if r["labels"].get("engine") == label)
+        assert row["value"] == want, fam
+    for fam in ("serving_swap_out_seconds", "serving_swap_in_seconds"):
+        hist = next(r for r in snap[fam]["series"]
+                    if r["labels"].get("engine") == label)
+        assert hist["count"] == s["preemptions"], fam
+    assert _serving_varz(snap)["preemption"][label] == {
+        "preemptions": s["preemptions"], "swap_ins": s["swap_ins"],
+        "swapped_slots": 0}
+    eng.close()
+
+
+@pytest.mark.parametrize("k", [0, 2])
+def test_preempt_seeded_stream_identity(trained, k):
+    """The tentpole pin, seeded half (with and without speculation): a
+    preempted + swapped + resumed run produces bit-identical sampled
+    streams to an unpressured run of the same requests. This is what
+    the slot-independent threefry sampler buys — the resumed sequence
+    may land in a different slot at a different step and still replay
+    its exact key chain."""
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    tight = make_engine(trained, speculate_k=k, **PRESSURE)
+    roomy = make_engine(trained, num_slots=4, max_queue=16, block_size=4,
+                        decode_chunk=4, speculate_k=k)
+    o_t = tight.generate(prompts, max_new_tokens=12, temperature=0.8,
+                         seed=3)
+    o_r = roomy.generate(prompts, max_new_tokens=12, temperature=0.8,
+                         seed=3)
+    assert tight.stats()["preemptions"] >= 1
+    assert roomy.stats()["preemptions"] == 0
+    for a, b in zip(o_t, o_r):
+        np.testing.assert_array_equal(a, b)
+    assert tight.stats()["blocks_used"] == 0
+    tight.close()
+    roomy.close()
+
+
+def test_drain_with_swapped_sequences_finishes_every_stream(trained):
+    """Graceful drain while preempted sequences sit in the host swap
+    pool: the drive loop counts parked work as pending, swaps it back
+    in when pages free, and every stream finishes with its full budget
+    — zero dropped tokens, zero leaked pages. Slow-step injection
+    widens the parked window so the test observes the swapped state
+    deterministically rather than racing the driver."""
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    plan = FaultPlan(slow_steps={i: 0.001 for i in range(2, 10)})
+    eng = make_engine(trained, fault_plan=plan, **PRESSURE)
+    streams = {i: [] for i in range(len(prompts))}
+
+    def tap(i):
+        return lambda req, tok: streams[i].append(tok)
+
+    reqs = [eng.submit(p, 12, on_token=tap(i))
+            for i, p in enumerate(prompts)]
+    seen_parked = 0
+    for _ in range(60):
+        eng.step()
+        seen_parked = max(seen_parked, eng.swapped_count)
+        if seen_parked:
+            break
+    assert seen_parked >= 1            # a sequence is parked RIGHT NOW
+    eng.run_until_drained()
+    for i, (req, p) in enumerate(zip(reqs, prompts)):
+        assert req.state == "finished"
+        assert len(streams[i]) == 12           # zero dropped tokens
+        np.testing.assert_array_equal(
+            req.output(), sequential_ref(trained, p, 12))
+    s = eng.stats()
+    assert s["swapped_slots"] == 0 and s["blocks_used"] == 0
+    eng.close()
+
+
+def test_preempt_policy_selection(trained):
+    """pick_victim: "newest" sacrifices the latest admission (least
+    work lost), "oldest" the earliest, a callable sees the running
+    table and must return one of its slots."""
+    from types import SimpleNamespace
+
+    eng = make_engine(trained, preempt=True)
+    sched = eng.scheduler
+    assert sched.pick_victim() is None         # nothing running
+    sched._running = {3: SimpleNamespace(seq=0),
+                      1: SimpleNamespace(seq=2),
+                      2: SimpleNamespace(seq=1)}
+    try:
+        assert sched.pick_victim("newest") == 1
+        assert sched.pick_victim("oldest") == 3
+        assert sched.pick_victim(lambda running: min(running)) == 1
+        with pytest.raises(ValueError, match="not a running slot"):
+            sched.pick_victim(lambda running: 9)
+        with pytest.raises(ValueError, match="unknown preempt policy"):
+            sched.pick_victim("fifo")
+    finally:
+        sched._running = {}
+        eng.close()
+
+
+def test_adopt_blocks_accounting_and_guards(trained):
+    """The swap-in allocator path: adopt_blocks claims private blocks
+    for a resumed sequence (never consulting the prefix cache), guards
+    against occupied slots and over-asks, and free() returns exactly
+    the adopted blocks."""
+    cfg, _ = trained
+    kv = SlotKVCache(cfg, num_slots=2, max_len=16, block_size=4,
+                     num_blocks=7)                     # 6 allocatable
+    s = kv.alloc()
+    kv.map_slot(s, np.arange(1, 10, dtype=np.int32), 12)   # 3 blocks
+    assert kv.mapped_block_count(s) == 3
+    with pytest.raises(ValueError, match="already has mapped blocks"):
+        kv.adopt_blocks(s, 1, 4)
+    with pytest.raises(ValueError, match="n_blocks must be >= 1"):
+        kv.can_adopt(0)
+    assert not kv.can_adopt(kv.blocks_available + 1)
+    t = kv.alloc()
+    with pytest.raises(ValueError, match="cannot supply"):
+        kv.adopt_blocks(t, kv.blocks_available + 1, 4)
+    row = kv.adopt_blocks(t, 2, length=6)
+    assert kv.mapped_block_count(t) == 2
+    assert kv.length(t) == 6
+    assert kv.blocks_used == 5
+    assert len(set(row[:2]) & set(kv.page_table[s][:3])) == 0
+    kv.free(t)
+    assert kv.blocks_used == 3
+
+
+def test_fault_plan_chaos_is_seed_deterministic():
+    """Same seed, same storm — the chaos soak replays exactly."""
+    a = FaultPlan.chaos(seed=7, steps=200)
+    b = FaultPlan.chaos(seed=7, steps=200)
+    assert a.step_exceptions == b.step_exceptions
+    assert a.page_shortages == b.page_shortages
+    assert a.slow_steps == b.slow_steps
+    c = FaultPlan.chaos(seed=8, steps=200)
+    assert (a.step_exceptions, a.page_shortages, a.slow_steps) \
+        != (c.step_exceptions, c.page_shortages, c.slow_steps)
+    assert a.summary()["scheduled_shortages"] == len(a.page_shortages)
+
+
+def test_fault_plan_forced_page_shortage_requeues_not_preempts(trained):
+    """A scheduled page shortage makes admission act page-starved: the
+    head-of-line request requeues at the queue FRONT (FIFO preserved),
+    nothing is admitted that step, and — preemption enabled — a forced
+    shortage never evicts a resident (it simulates transient pressure,
+    not an evictable sequence)."""
+    plan = FaultPlan(page_shortages={0, 1})
+    eng = make_engine(trained, preempt=True, fault_plan=plan)
+    p = np.asarray([1, 2, 3], np.int32)
+    r1 = eng.submit(p, 4)
+    r2 = eng.submit(p, 4)
+    eng.step()                                 # step 0: denied
+    assert plan.denied_steps == 1
+    assert eng.scheduler.active_count == 0     # nothing admitted
+    assert r1.state == "queued" and r2.state == "queued"
+    eng.step()                                 # step 1: denied again
+    assert plan.denied_steps == 2
+    eng.run_until_drained()
+    assert r1.state == "finished" and r2.state == "finished"
+    np.testing.assert_array_equal(r1.output(),
+                                  sequential_ref(trained, p, 4))
+    assert eng.stats()["preemptions"] == 0
+    eng.close()
+
+
+def test_fault_plan_step_exception_fires_exactly_once(trained):
+    """The replica-failover trigger: engine.step() raises the scheduled
+    InjectedFault AT the scheduled index and never again — the step
+    counter advances before the raise, so a supervisor that retries the
+    loop proceeds past the fault and the engine completes its work."""
+    plan = FaultPlan(step_exceptions={1})
+    eng = make_engine(trained, fault_plan=plan)
+    p = np.asarray([1, 2, 3], np.int32)
+    req = eng.submit(p, 4)
+    eng.step()                                 # step 0: clean (admits)
+    with pytest.raises(InjectedFault) as ei:
+        eng.step()                             # step 1: scheduled fault
+    assert ei.value.step == 1
+    assert plan.injected_exceptions == 1
+    eng.run_until_drained()                    # steps 2..: clean again
+    assert plan.injected_exceptions == 1       # fired exactly once
+    assert req.state == "finished"
+    np.testing.assert_array_equal(req.output(),
+                                  sequential_ref(trained, p, 4))
+    eng.close()
+
+
+def test_fault_plan_slow_steps_and_dispatch_delays(trained):
+    """Scheduled delays fire through the injectable sleep — once at the
+    top of the scheduled engine step, once right before the scheduled
+    chunk launch — and the plan's telemetry counts them."""
+    naps = []
+    plan = FaultPlan(slow_steps={0: 0.025}, slow_dispatches={0: 0.05},
+                     sleep=naps.append)
+    eng = make_engine(trained, fault_plan=plan)
+    p = np.asarray([1, 2, 3], np.int32)
+    eng.submit(p, 6)
+    eng.run_until_drained()
+    assert naps.count(0.025) == 1
+    assert naps.count(0.05) == 1
+    assert plan.slept_steps == 2
+    assert plan.summary()["scheduled_delays"] == 2
+    eng.close()

@@ -243,6 +243,34 @@ def test_bench_serving_speculate_row_shape():
     assert spec["dispatches"] <= base["dispatches"]
 
 
+def test_bench_serving_oversubscribe_row_shape():
+    """tools/bench_serving --oversubscribe: one row over the workload
+    whose page demand exceeds the deliberately undersized arena, with
+    registry-sourced fault-tolerance columns — preemptions really
+    happened, every swap-out got a matching latency sample, every
+    request still finished its full budget, and the arena drained to
+    zero blocks (the no-leaked-pages acceptance pin, bench-visible)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_oversubscribe("tiny", requests=6,
+                                           concurrency=4)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "tiny_serving_oversub_c4"
+    assert row["value"] > 0 and row["unit"] == "tokens/s"
+    e = row["extra"]
+    assert e["completed"] == 6
+    assert e["oversubscription"] > 1.0          # demand really > arena
+    assert e["worst_case_blocks"] > e["kv_blocks"]
+    assert e["preemptions"] >= 1                # pressure really evicted
+    assert e["swap_ins"] == e["preemptions"]    # every victim resumed
+    assert e["swapped_now"] == 0
+    assert e["swap_in_ms"] is not None and e["swap_in_ms"] > 0
+    assert e["swap_out_ms"] is not None and e["swap_out_ms"] > 0
+    assert e["blocks_used_after_drain"] == 0    # no leaked pages
+    assert 0 < e["blocks_used_peak"] <= e["blocks_total"]
+
+
 def test_bench_serving_debug_port_flag(capsys, monkeypatch):
     """--debug-port serves the diagnostics plane for the bench run and
     tears it down afterwards."""
