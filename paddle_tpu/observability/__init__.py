@@ -30,6 +30,15 @@ first-class layer):
   non-finite step, one flag fetched with the existing outputs), and
   the Executor's recompilation-attribution log; `/trainz` serves it,
   `tools/train_summary.py` renders the JSONL.
+* `request_log` — serving request-lifecycle event log: the StepLogger
+  idiom applied to serving — every transition a request moves through
+  (submitted/queued/shed, routed, admitted, prefill, each decode
+  dispatch, preempted/swapped-in, failover, finished with
+  finish_reason) journaled with monotonic stamps + request_id into a
+  rotating JSONL + in-memory ring; `/requestz` serves it live,
+  `tools/serving_summary.py` renders per-request phase timelines.
+  Uninstalled (the default) it costs one attribute read per
+  transition — streams and registry series bit-identical.
 * `watchdog` — stall watchdog + flight recorder: a daemon thread that
   watches the engine/executor progress heartbeats in the registry and
   dumps stacks + spans + a metrics snapshot into a bounded-retention
@@ -50,13 +59,16 @@ Stdlib-only on import: safe to import anywhere in the framework with no
 jax side effects.
 """
 
-from . import (debug_server, export, metrics, tracer,  # noqa: F401
-               train_stats, watchdog)
+from . import (debug_server, export, metrics, request_log,  # noqa: F401
+               tracer, train_stats, watchdog)
 from .debug_server import (DebugServer, get_debug_server,
                            start_debug_server, stop_debug_server)
 from .export import export_chrome_trace, self_times, summarize
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
+from .request_log import (RequestLog, get_request_log,
+                          install_request_log, request_logging,
+                          uninstall_request_log)
 from .tracer import (Span, Tracer, current_request_id, disable_tracing,
                      enable_tracing, get_tracer, request_scope, trace_span,
                      tracing_enabled)
@@ -82,4 +94,6 @@ __all__ = [
     "StepLogger", "install_step_logger", "uninstall_step_logger",
     "get_step_logger", "step_logging", "attach_step_telemetry",
     "recompile_log",
+    "RequestLog", "install_request_log", "uninstall_request_log",
+    "get_request_log", "request_logging",
 ]

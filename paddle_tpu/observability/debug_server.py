@@ -18,6 +18,9 @@ Endpoints:
     /tracez    recent tracer spans as JSON; ?request_id= filters to one
                request's end-to-end timeline; ?limit=N newest N;
                ?chrome=1 downloads a catapult chrome-trace instead
+    /requestz  serving request-lifecycle events (the installed request
+               log's ring): in-flight ids + recent transitions;
+               ?request_id= one request's timeline, ?limit=N newest N
     /stacksz   all-thread Python stack dump (text/plain)
 
 `start_debug_server(port=0)` binds (0 = ephemeral), serves from daemon
@@ -41,12 +44,13 @@ from urllib.parse import parse_qs, urlparse
 from .export import spans_to_events
 from .metrics import MetricsRegistry, get_registry
 from .tracer import Span, Tracer, get_tracer
+from . import request_log as _request_log
 from . import train_stats as _train_stats
 from . import watchdog as _watchdog
 
 __all__ = ["DebugServer", "start_debug_server", "acquire_debug_server",
            "release_debug_server", "stop_debug_server",
-           "get_debug_server"]
+           "get_debug_server", "registry_rollup", "ratio"]
 
 _INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
 <h1>paddle_tpu live diagnostics</h1><ul>
@@ -58,6 +62,9 @@ _INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
      <code>?chrome=1</code>)</li>
 <li><a href="/trainz">/trainz</a> — training telemetry: latest step
     scalars + recompile log (<code>?limit=</code>)</li>
+<li><a href="/requestz">/requestz</a> — serving request-lifecycle
+    events: in-flight ids + recent transitions
+    (<code>?request_id=</code>, <code>?limit=</code>)</li>
 <li><a href="/stacksz">/stacksz</a> — all-thread stack dump</li>
 </ul></body></html>
 """
@@ -67,53 +74,151 @@ def _span_request_id(s: Span) -> Optional[str]:
     return s.args.get("request_id") if s.args else None
 
 
-def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
-    """Per-engine serving rollups for /varz: ratios an operator would
-    otherwise have to derive from counter pairs by hand — the paged
-    pool's prefix-cache hit ratio and the speculative decoder's draft
-    acceptance ratio — keyed by engine label. Computed from the
-    registry snapshot only — no engine references, same as every other
-    /varz column."""
-    def by_engine(name):
-        return {r["labels"].get("engine"): r["value"]
-                for r in snap.get(name, {}).get("series", [])}
+def _series_by_label(snap: Dict[str, Any], family: str, label_key: str,
+                     field: str = "value") -> Dict[Any, float]:
+    """{label value: summed `field`} over one family's series in a
+    registry snapshot. Summing handles families whose series split a
+    label further (e.g. server_slo_met_total carries tenant AND
+    objective: keyed by tenant, the objectives aggregate)."""
+    out: Dict[Any, float] = {}
+    for row in snap.get(family, {}).get("series", []):
+        label = row["labels"].get(label_key)
+        out[label] = out.get(label, 0) + (row.get(field) or 0)
+    return out
 
-    hits = by_engine("serving_prefix_cache_hits_total")
-    misses = by_engine("serving_prefix_cache_misses_total")
-    out = {}
-    for label in sorted(set(hits) | set(misses), key=str):
-        h, m = int(hits.get(label, 0)), int(misses.get(label, 0))
-        out[label] = {
-            "prefix_cache_hits": h,
-            "prefix_cache_misses": m,
-            "prefix_hit_ratio": round(h / (h + m), 4) if h + m else None,
-        }
-    proposed = by_engine("serving_spec_proposed_total")
-    accepted = by_engine("serving_spec_accepted_total")
-    spec = {}
-    for label in sorted(set(proposed) | set(accepted), key=str):
-        p, a = int(proposed.get(label, 0)), int(accepted.get(label, 0))
-        spec[label] = {
-            "spec_proposed": p,
-            "spec_accepted": a,
+
+def registry_rollup(snap: Dict[str, Any],
+                    fields: Dict[str, Any],
+                    label_key: str = "engine",
+                    derived=()) -> Dict[Any, Dict[str, Any]]:
+    """Join labeled registry series into per-label rollup rows — the
+    one helper behind every /varz ratio block (prefix-cache, spec
+    acceptance, preemption, host-overhead, SLO) instead of a
+    copy-pasted loop per subsystem.
+
+    `fields` maps output column -> family name (counter/gauge `value`,
+    cast to int) or -> (family, field, cast) for histogram columns
+    (`field` "sum"/"count", cast float/int). `derived` is a sequence of
+    (column, fn(row) -> value) appended in order — `ratio()` builds the
+    common safe-division case. Returns {label: row} over the union of
+    labels across all fields, sorted by str."""
+    cols: Dict[str, Any] = {}
+    for out_field, spec in fields.items():
+        if isinstance(spec, str):
+            family, field, cast = spec, "value", int
+        else:
+            family, field, cast = spec
+        cols[out_field] = (_series_by_label(snap, family, label_key,
+                                            field), cast)
+    labels: set = set()
+    for vals, _ in cols.values():
+        labels |= set(vals)
+    out: Dict[Any, Dict[str, Any]] = {}
+    for label in sorted(labels, key=str):
+        row: Dict[str, Any] = {f: cast(vals.get(label, 0))
+                               for f, (vals, cast) in cols.items()}
+        for out_field, fn in derived:
+            row[out_field] = fn(row)
+        out[label] = row
+    return out
+
+
+def ratio(num: str, den, digits: int = 4, scale: float = 1.0):
+    """derived-fn factory for registry_rollup: `num` over the SUM of
+    `den` field(s), rounded, None on a zero denominator (a ratio with
+    no observations is unknown, not 0)."""
+    den = (den,) if isinstance(den, str) else tuple(den)
+
+    def fn(row: Dict[str, Any]):
+        d = sum(row[k] for k in den)
+        return round(row[num] * scale / d, digits) if d else None
+    return fn
+
+
+def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-engine/per-tenant serving rollups for /varz: ratios an
+    operator would otherwise derive from counter pairs by hand, all
+    built by registry_rollup over the snapshot only — no engine
+    references, same as every other /varz column."""
+    return {
+        "prefix_hit_ratio": registry_rollup(snap, {
+            "prefix_cache_hits": "serving_prefix_cache_hits_total",
+            "prefix_cache_misses": "serving_prefix_cache_misses_total",
+        }, derived=[
+            # share of shareable prompt blocks served from the cache;
+            # None until the engine has seen one
+            ("prefix_hit_ratio",
+             ratio("prefix_cache_hits",
+                   ("prefix_cache_hits", "prefix_cache_misses")))]),
+        "spec_accept_ratio": registry_rollup(snap, {
+            "spec_proposed": "serving_spec_proposed_total",
+            "spec_accepted": "serving_spec_accepted_total",
+        }, derived=[
             # share of drafted tokens that verification accepted; None
             # until the engine has run a speculative pass
-            "spec_accept_ratio": round(a / p, 4) if p else None,
-        }
-    # host-swap preemption rollup: how often page pressure evicted a
-    # running sequence, how many resumed, and how many sit parked NOW
-    pre = by_engine("serving_preemptions_total")
-    swins = by_engine("serving_swap_ins_total")
-    parked = by_engine("serving_swapped_slots")
-    swap = {}
-    for label in sorted(set(pre) | set(swins) | set(parked), key=str):
-        swap[label] = {
-            "preemptions": int(pre.get(label, 0)),
-            "swap_ins": int(swins.get(label, 0)),
-            "swapped_slots": int(parked.get(label, 0)),
-        }
-    return {"prefix_hit_ratio": out, "spec_accept_ratio": spec,
-            "preemption": swap}
+            ("spec_accept_ratio",
+             ratio("spec_accepted", "spec_proposed"))]),
+        # host-swap preemption: how often page pressure evicted a
+        # running sequence, how many resumed, how many sit parked NOW
+        "preemption": registry_rollup(snap, {
+            "preemptions": "serving_preemptions_total",
+            "swap_ins": "serving_swap_ins_total",
+            "swapped_slots": "serving_swapped_slots",
+        }),
+        # host/device dispatch split (ServingConfig(dispatch_timing)):
+        # mean launch-side host ms per fused dispatch — the pinned
+        # baseline the native continuous-batching core is judged
+        # against — plus the host share of attributed wall time
+        "host_overhead_per_dispatch": registry_rollup(snap, {
+            "dispatches": ("serving_dispatch_host_seconds", "count",
+                           int),
+            "host_s_total": ("serving_dispatch_host_seconds", "sum",
+                             float),
+            "device_s_total": ("serving_dispatch_device_seconds",
+                               "sum", float),
+        }, derived=[
+            ("host_overhead_ms",
+             ratio("host_s_total", "dispatches", digits=3,
+                   scale=1e3)),
+            ("host_share",
+             ratio("host_s_total",
+                   ("host_s_total", "device_s_total")))]),
+        # per-tenant SLO attainment + goodput (router-scored; /slozv
+        # carries the per-objective breakdown, this is the scrape-path
+        # summary)
+        "slo": registry_rollup(snap, {
+            "slo_met": "server_slo_met_total",
+            "slo_missed": "server_slo_missed_total",
+            "tokens": "server_slo_tokens_total",
+            "goodput_tokens": "server_goodput_tokens_total",
+        }, label_key="tenant", derived=[
+            ("slo_attainment",
+             ratio("slo_met", ("slo_met", "slo_missed"))),
+            ("goodput_ratio",
+             ratio("goodput_tokens", "tokens"))]),
+    }
+
+
+_BAD_LIMIT = object()   # _parse_limit sentinel: 400 already sent
+
+
+def _parse_limit(h, q: Dict[str, str], default):
+    """Parse ``?limit=`` for the ring-serving endpoints (/tracez,
+    /trainz, /requestz): a non-negative int, `default` when absent.
+    A malformed or negative value sends the 400 and returns
+    `_BAD_LIMIT` — the caller just returns."""
+    raw = q.get("limit")
+    if raw is None:
+        return default
+    try:
+        limit = int(raw)
+    except ValueError:
+        limit = -1
+    if limit < 0:
+        h._send_json({"error": f"bad limit {raw!r}: expected a "
+                      "non-negative integer"}, status=400)
+        return _BAD_LIMIT
+    return limit
 
 
 def _query_flag(q: Dict[str, str], name: str) -> bool:
@@ -185,7 +290,7 @@ class DebugServer:
             "/": self._index, "/metrics": self._metrics,
             "/healthz": self._healthz, "/varz": self._varz,
             "/tracez": self._tracez, "/trainz": self._trainz,
-            "/stacksz": self._stacksz,
+            "/requestz": self._requestz, "/stacksz": self._stacksz,
         }
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -270,12 +375,10 @@ class DebugServer:
         rid = q.get("request_id")
         if rid is not None:
             spans = [s for s in spans if _span_request_id(s) == rid]
-        if "limit" in q:
-            try:
-                limit = max(0, int(q["limit"]))
-            except ValueError:
-                h._send_json({"error": f"bad limit {q['limit']!r}"}, 400)
-                return
+        limit = _parse_limit(h, q, default=None)
+        if limit is _BAD_LIMIT:
+            return
+        if limit is not None:
             spans = spans[-limit:] if limit else []
         if _query_flag(q, "chrome"):
             payload = {"traceEvents": spans_to_events(spans),
@@ -296,14 +399,8 @@ class DebugServer:
     def _trainz(self, h: _Handler, q: Dict[str, str]) -> None:
         """Training telemetry: latest-N step scalars (StepLogger ring)
         plus the recompilation-attribution log, as JSON."""
-        raw = q.get("limit", "50")
-        try:
-            limit = int(raw)
-        except ValueError:
-            limit = -1
-        if limit < 0:
-            h._send_json({"error": f"bad limit {raw!r}: expected a "
-                          "non-negative integer"}, status=400)
+        limit = _parse_limit(h, q, default=50)
+        if limit is _BAD_LIMIT:
             return
         logger = _train_stats.get_step_logger()
         h._send_json({
@@ -314,6 +411,28 @@ class DebugServer:
             "log_path": logger.log_path if logger else None,
             "steps": logger.recent(limit) if logger else [],
             "recompiles": _train_stats.recompile_log(limit),
+        })
+
+    def _requestz(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Serving request-lifecycle events (the process request log's
+        ring): in-flight request ids + recent transitions as JSON.
+        ?request_id= filters to one request's timeline; ?limit=N newest
+        N events (after the filter)."""
+        limit = _parse_limit(h, q, default=200)
+        if limit is _BAD_LIMIT:
+            return
+        rlog = _request_log.get_request_log()
+        events = rlog.recent() if rlog else []
+        rid = q.get("request_id")
+        if rid is not None:
+            events = [e for e in events if e.get("request_id") == rid]
+        h._send_json({
+            "enabled": rlog is not None,
+            "log_path": rlog.log_path if rlog else None,
+            "events_total": rlog.event_count if rlog else 0,
+            "inflight": rlog.inflight_ids() if rlog else [],
+            "request_id": rid,
+            "events": events[-limit:] if limit else [],
         })
 
     def _stacksz(self, h: _Handler, q: Dict[str, str]) -> None:

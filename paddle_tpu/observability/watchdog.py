@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional
 from .export import export_chrome_trace
 from .metrics import MetricsRegistry, get_registry
 from .tracer import Tracer, get_tracer
+from . import request_log as _request_log
 
 __all__ = ["ProgressMonitor", "FlightRecorder", "Watchdog",
            "start_watchdog", "stop_watchdog", "get_watchdog",
@@ -207,6 +208,13 @@ class FlightRecorder:
                     "time_unix": time.time(),
                     "time_iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
                     "details": details or {}}
+            # in-flight request ids at dump time (when a request log is
+            # installed): a stall/overload record joins against the
+            # request event log on these ids — which requests were live
+            # when things wedged, not just which series stopped moving
+            rlog = _request_log.get_request_log()
+            meta["inflight_request_ids"] = (rlog.inflight_ids()
+                                            if rlog is not None else [])
             with open(os.path.join(path, "meta.json"), "w") as f:
                 json.dump(meta, f, indent=2, default=str)
             self._written.append(path)

@@ -17,7 +17,11 @@ saturation story) rebuilt as a service plane:
   admission off the live EngineMetrics gauges, per-tenant token-bucket
   quotas, per-request deadlines that cancel in-flight work, graceful
   drain, and one driver thread per replica. Shed storms fire the
-  watchdog overload hook so they leave flight records.
+  watchdog overload hook so they leave flight records. Per-tenant
+  `SLOConfig` objectives (TTFT/TPOT/e2e, wired like quotas) are scored
+  at stream close into `server_slo_{met,missed}_total` + goodput
+  counters; `GET /slozv` serves the cross-replica per-tenant
+  attainment breakdown.
 
 Quick start:
 
@@ -30,9 +34,11 @@ Quick start:
 """
 
 from .router import (DrainingError, QuotaConfig, QuotaExceededError,
-                     Router, RouterMetrics, StreamHandle, TokenBucket)
+                     Router, RouterMetrics, SLOConfig, StreamHandle,
+                     TokenBucket)
 from .service import GenerationServer, ServerConfig, serve
 
 __all__ = ["GenerationServer", "ServerConfig", "serve", "Router",
            "StreamHandle", "TokenBucket", "QuotaConfig",
-           "QuotaExceededError", "DrainingError", "RouterMetrics"]
+           "QuotaExceededError", "DrainingError", "RouterMetrics",
+           "SLOConfig"]

@@ -33,6 +33,14 @@ router's label: `server_requests_total{router,tenant,code}`,
 `server_quota_rejections_total{router,tenant}`,
 `server_client_disconnects_total{router,tenant}`, and gauges
 `server_active_streams` / `server_replicas` / `server_draining`.
+
+Per-tenant SLO objectives (`SLOConfig`, wired like quotas) are scored
+once per closed stream: `server_slo_{met,missed}_total{tenant,
+objective}` counters, goodput accounting (`server_goodput_tokens_total`
+vs `server_slo_tokens_total` + the `server_goodput_ratio` gauge), and
+`Router.slo_report()` — the `/slozv` payload aggregating cross-replica
+attainment per tenant. With no SLOConfig set, none of those series
+exist.
 """
 
 from __future__ import annotations
@@ -43,16 +51,18 @@ import queue
 import threading
 import time
 import traceback
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import request_log as _request_log
 from ..observability import watchdog as _watchdog
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..serving.engine import EngineOverloadError, ServingEngine
 
 __all__ = ["Router", "StreamHandle", "TokenBucket", "QuotaConfig",
-           "QuotaExceededError", "DrainingError", "RouterMetrics"]
+           "QuotaExceededError", "DrainingError", "RouterMetrics",
+           "SLOConfig"]
 
 
 class QuotaExceededError(RuntimeError):
@@ -87,6 +97,49 @@ class QuotaConfig:
                 f"refill_per_s must be >= 0, got {refill_per_s}")
         self.capacity = float(capacity)
         self.refill_per_s = float(refill_per_s)
+
+
+class SLOConfig:
+    """Per-tenant service-level objectives, in seconds (None = the
+    objective is not tracked; at least one must be set):
+
+    * ``ttft_s`` — submit -> first token out
+    * ``tpot_s`` — mean inter-token time after the first
+    * ``e2e_s``  — submit -> finish
+
+    Wired through the router like QuotaConfig (``slos`` per tenant +
+    ``default_slo`` for unlisted tenants): when a routed stream closes,
+    each configured objective is scored against the stream's
+    CLIENT-observed cuts (router-clock stamps spanning every failover
+    attempt and the backoff between them) and counted in
+    ``server_slo_{met,missed}_total{tenant,objective}``; a request whose
+    every scored objective was met contributes its tokens to the
+    tenant's GOODPUT (``server_goodput_tokens_total`` vs
+    ``server_slo_tokens_total``, ratio gauge ``server_goodput_ratio``).
+    With no SLOConfig anywhere, none of those series exist (pinned
+    no-op)."""
+
+    def __init__(self, ttft_s: Optional[float] = None,
+                 tpot_s: Optional[float] = None,
+                 e2e_s: Optional[float] = None):
+        for name, v in (("ttft_s", ttft_s), ("tpot_s", tpot_s),
+                        ("e2e_s", e2e_s)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
+        if ttft_s is None and tpot_s is None and e2e_s is None:
+            raise ValueError(
+                "SLOConfig needs at least one objective "
+                "(ttft_s / tpot_s / e2e_s)")
+        self.ttft_s = None if ttft_s is None else float(ttft_s)
+        self.tpot_s = None if tpot_s is None else float(tpot_s)
+        self.e2e_s = None if e2e_s is None else float(e2e_s)
+
+    def objectives(self) -> Dict[str, float]:
+        """{objective name: target seconds} for the configured ones."""
+        return {name: v for name, v in (("ttft", self.ttft_s),
+                                        ("tpot", self.tpot_s),
+                                        ("e2e", self.e2e_s))
+                if v is not None}
 
 
 class TokenBucket:
@@ -167,6 +220,16 @@ class StreamHandle:
         self.submit_kw: dict = {}
         self.emitted = 0                    # tokens streamed so far
         self.retries = 0                    # failover re-submissions
+        # client-observed SLO cuts (router clock): unlike the engine's
+        # RequestMetrics — which a failover RESETS (the retried request
+        # re-marks submission) — these span every attempt plus the
+        # backoff between them, so attainment reflects what the client
+        # actually waited. Stamped only when the SLO plane is on (the
+        # dormant path stays clock-read-free).
+        self.submitted_t: Optional[float] = \
+            router._clock() if router.slo_enabled else None
+        self.first_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
         self._flock = threading.Lock()
         self._events: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
@@ -186,6 +249,8 @@ class StreamHandle:
             return
         self.request = req
         self.emitted += 1
+        if self.emitted == 1 and self.submitted_t is not None:
+            self.first_token_t = self._router._clock()
         self._events.put(("token", int(token)))
         if req.finished:
             reason = ("stop" if (req.eos_id is not None
@@ -201,6 +266,8 @@ class StreamHandle:
             if self.finish_reason is not None:
                 return False
             self.finish_reason = reason
+            if self.submitted_t is not None:
+                self.finished_t = self._router._clock()
         self._events.put(("done", reason))
         self._done.set()
         self._router._stream_closed(self)
@@ -485,10 +552,19 @@ class RouterMetrics:
         # (family, sorted label items) pairs created lazily per tenant
         self._dynamic: set = set()
         self._dyn_lock = threading.Lock()
+        # SLO/goodput host mirrors for slo_report() (/slozv reads these
+        # without a registry snapshot walk): tenant -> counts
+        self._slo: Dict[str, Dict[str, Any]] = {}
 
-    def _inc(self, fam, **labels) -> None:
+    def _inc(self, fam, amount: float = 1.0, **labels) -> None:
         labels["router"] = self.label
-        fam.labels(**labels).inc()
+        fam.labels(**labels).inc(amount)
+        with self._dyn_lock:
+            self._dynamic.add((fam, tuple(sorted(labels.items()))))
+
+    def _set(self, fam, value: float, **labels) -> None:
+        labels["router"] = self.label
+        fam.labels(**labels).set(value)
         with self._dyn_lock:
             self._dynamic.add((fam, tuple(sorted(labels.items()))))
 
@@ -514,6 +590,94 @@ class RouterMetrics:
         with self._dyn_lock:
             self.replica_restarts += 1
         self._inc(self._replica_restarts, replica=replica)
+
+    # -- SLO / goodput (families created lazily: with no SLOConfig the
+    # -- registry carries ZERO slo/goodput series — the pinned no-op) --------
+
+    def _slo_entry_locked(self, tenant: str) -> Dict[str, Any]:
+        ent = self._slo.get(tenant)
+        if ent is None:
+            ent = self._slo[tenant] = {"met": {}, "missed": {},
+                                       "tokens": 0, "goodput_tokens": 0}
+        return ent
+
+    def observe_slo(self, tenant: str,
+                    results: Dict[str, bool]) -> None:
+        """One closed stream's objective verdicts ({objective: met})."""
+        met_fam = self._registry.counter(
+            "server_slo_met_total",
+            "closed streams meeting a tenant SLO objective, by "
+            "objective")
+        missed_fam = self._registry.counter(
+            "server_slo_missed_total",
+            "closed streams missing a tenant SLO objective, by "
+            "objective")
+        with self._dyn_lock:
+            ent = self._slo_entry_locked(tenant)
+            for obj, ok in results.items():
+                key = "met" if ok else "missed"
+                ent[key][obj] = ent[key].get(obj, 0) + 1
+        for obj, ok in results.items():
+            self._inc(met_fam if ok else missed_fam,
+                      tenant=tenant, objective=obj)
+
+    def observe_goodput(self, tenant: str, tokens: int,
+                        good: bool) -> None:
+        """One closed stream delivered `tokens`; `good` = every scored
+        objective met (the tokens count toward goodput)."""
+        if tokens <= 0:
+            return
+        tok_fam = self._registry.counter(
+            "server_slo_tokens_total",
+            "tokens delivered to SLO-tracked tenants")
+        good_fam = self._registry.counter(
+            "server_goodput_tokens_total",
+            "tokens delivered within every scored SLO objective")
+        ratio_fam = self._registry.gauge(
+            "server_goodput_ratio",
+            "goodput tokens / delivered tokens per tenant")
+        with self._dyn_lock:
+            ent = self._slo_entry_locked(tenant)
+            ent["tokens"] += tokens
+            if good:
+                ent["goodput_tokens"] += tokens
+            ratio = ent["goodput_tokens"] / ent["tokens"]
+        self._inc(tok_fam, amount=tokens, tenant=tenant)
+        if good:
+            self._inc(good_fam, amount=tokens, tenant=tenant)
+        self._set(ratio_fam, ratio, tenant=tenant)
+
+    def slo_report(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant SLO attainment + goodput rollup (the /slozv
+        payload): objective-level met/missed/attainment, the cross-
+        objective attainment ratio, and goodput tokens vs total."""
+        with self._dyn_lock:
+            snapshot = {t: {"met": dict(e["met"]),
+                            "missed": dict(e["missed"]),
+                            "tokens": e["tokens"],
+                            "goodput_tokens": e["goodput_tokens"]}
+                        for t, e in self._slo.items()}
+        out: Dict[str, Dict[str, Any]] = {}
+        for tenant, e in sorted(snapshot.items()):
+            objectives = {}
+            for obj in sorted(set(e["met"]) | set(e["missed"])):
+                m, x = e["met"].get(obj, 0), e["missed"].get(obj, 0)
+                objectives[obj] = {
+                    "met": m, "missed": x,
+                    "attainment": round(m / (m + x), 4) if m + x
+                    else None}
+            m = sum(e["met"].values())
+            x = sum(e["missed"].values())
+            t, g = e["tokens"], e["goodput_tokens"]
+            out[tenant] = {
+                "objectives": objectives,
+                "met": m, "missed": x,
+                "slo_attainment": round(m / (m + x), 4) if m + x
+                else None,
+                "tokens": t, "goodput_tokens": g,
+                "goodput_ratio": round(g / t, 4) if t else None,
+            }
+        return out
 
     def unregister(self) -> None:
         """Retire every series this router registered."""
@@ -541,7 +705,9 @@ class Router:
                      Callable[[], ServingEngine]] = None,
                  max_stream_retries: int = 1,
                  restart_backoff_s: float = 0.05,
-                 restart_backoff_cap_s: float = 2.0):
+                 restart_backoff_cap_s: float = 2.0,
+                 slos: Optional[Dict[str, SLOConfig]] = None,
+                 default_slo: Optional[SLOConfig] = None):
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine replica")
@@ -566,6 +732,11 @@ class Router:
         self.metrics.replicas.set(len(self.replicas))
         self._quota_cfg = dict(quotas or {})
         self._default_quota = default_quota
+        # per-tenant SLO objectives (the quota-layer wiring pattern):
+        # scored at stream close; with neither set the whole SLO plane
+        # is dormant — zero registry series, zero per-close work
+        self._slo_cfg = dict(slos or {})
+        self._default_slo = default_slo
         self._buckets: Dict[str, Optional[TokenBucket]] = {}
         self._bucket_lock = threading.Lock()
         self._admit_lock = threading.Lock()
@@ -593,6 +764,16 @@ class Router:
     @property
     def inflight(self) -> int:
         return int(self.metrics.active_streams.value)
+
+    @property
+    def slo_enabled(self) -> bool:
+        return bool(self._slo_cfg or self._default_slo)
+
+    def slo_report(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant SLO attainment + goodput (the /slozv payload,
+        aggregated across every replica this router fronts — objective
+        scoring happens here, so one report covers the fleet)."""
+        return self.metrics.slo_report()
 
     # -- admission ----------------------------------------------------------
 
@@ -639,6 +820,11 @@ class Router:
                 retry = bucket.try_take(cost)
                 if retry > 0:
                     self.metrics.observe_quota_rejection(tenant)
+                    rlog = _request_log.get_request_log()
+                    if rlog is not None:   # no request_id yet: the shed
+                        # happened before any engine minted one
+                        rlog.event("quota_rejected", tenant=tenant,
+                                   retry_after_s=retry)
                     # quota shed storms leave flight records, exactly
                     # like engine-queue sheds (engine.submit fires this
                     # hook itself on its own shed path)
@@ -679,6 +865,10 @@ class Router:
                     handle.request = req
                     self.metrics.active_streams.inc()
                     granted = True
+                    rlog = _request_log.get_request_log()
+                    if rlog is not None:
+                        rlog.event("routed", request_id=req.request_id,
+                                   tenant=tenant, replica=replica.label)
                     if not replica.adopt(handle, engine):
                         # the replica died between submit and watch and
                         # its stranded-stream sweep missed this handle:
@@ -720,9 +910,75 @@ class Router:
         handle.replica.kick()
         return finished
 
+    def _slo_for(self, tenant: str) -> Optional[SLOConfig]:
+        return self._slo_cfg.get(tenant, self._default_slo)
+
     def _stream_closed(self, handle: StreamHandle) -> None:
         handle.replica.forget(handle)
         self.metrics.active_streams.dec()
+        self._finalize_stream(handle)
+
+    def _finalize_stream(self, handle: StreamHandle) -> None:
+        """Exactly-once per stream (rides _finish): score the tenant's
+        SLO objectives against the stream's client-observed latency
+        cuts, account goodput, and journal the terminal event. Client cancels are
+        excluded from SLO scoring (the client walked away — not a
+        service miss); deadline/replica/error terminations miss every
+        configured objective."""
+        reason = handle.finish_reason
+        req = handle.request
+        tokens = len(req.tokens) if req is not None else 0
+        cfg = self._slo_for(handle.tenant) if self.slo_enabled else None
+        slo_missed: List[str] = []
+        if cfg is not None and reason != "cancelled":
+            delivered = reason in ("stop", "length")
+            # client-observed cuts from the handle's own stamps, NOT the
+            # engine's RequestMetrics: a failover re-submission resets
+            # the engine-side marks, which would score the retried
+            # attempt alone and report attainment healthiest exactly
+            # when replicas are failing
+            t_sub, t_first, t_end = (handle.submitted_t,
+                                     handle.first_token_t,
+                                     handle.finished_t)
+            cuts = {
+                "ttft": (t_first - t_sub
+                         if t_first is not None and t_sub is not None
+                         else None),
+                "e2e": (t_end - t_sub
+                        if t_end is not None and t_sub is not None
+                        else None),
+                "tpot": ((t_end - t_first) / (tokens - 1)
+                         if tokens > 1 and t_end is not None
+                         and t_first is not None else None),
+            }
+            results: Dict[str, bool] = {}
+            for obj, target in cfg.objectives().items():
+                if not delivered:
+                    results[obj] = False
+                    continue
+                actual = cuts[obj]
+                if actual is None or actual < 0:
+                    continue    # unscorable (tpot of a 1-token
+                    #             generation, a non-monotonic injected
+                    #             clock): neither met nor missed
+                results[obj] = actual <= target
+            if results:
+                self.metrics.observe_slo(handle.tenant, results)
+                slo_missed = sorted(o for o, ok in results.items()
+                                    if not ok)
+            self.metrics.observe_goodput(
+                handle.tenant, tokens,
+                good=bool(reason in ("stop", "length")
+                          and not slo_missed))
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            fields: Dict[str, Any] = dict(
+                tenant=handle.tenant, reason=reason, tokens=tokens,
+                replica=handle.replica.label)
+            if cfg is not None:
+                fields["slo_missed"] = slo_missed
+            rlog.event("stream_closed", request_id=handle.request_id,
+                       **fields)
 
     # -- replica failover ----------------------------------------------------
 
@@ -752,6 +1008,11 @@ class Router:
             handle._finish("replica_failed")
             return
         handle.retries += 1
+        rlog = _request_log.get_request_log()
+        stranded_rid = handle.request_id
+        if rlog is not None:
+            rlog.event("failover", request_id=stranded_rid,
+                       tenant=handle.tenant, retries=handle.retries)
         for i in self._healthy_order():
             replica = self.replicas[i]
             engine = replica.engine
@@ -761,6 +1022,15 @@ class Router:
                     **handle.submit_kw)
             except (EngineOverloadError, ValueError):
                 continue
+            if rlog is not None:
+                # the retried stream carries a NEW engine-minted id;
+                # rerouted_from chains the timelines (and retires the
+                # superseded id from the in-flight set — including a
+                # prior attempt whose adopt() lost to a replica death)
+                rlog.event("routed", request_id=req.request_id,
+                           tenant=handle.tenant, replica=replica.label,
+                           rerouted_from=stranded_rid)
+                stranded_rid = req.request_id
             # replica before request: cancel() re-reads request then
             # replica, so a new request must never pair with the old
             # replica

@@ -51,7 +51,7 @@ import numpy as np
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..serving.engine import EngineOverloadError, ServingEngine
 from .router import (DrainingError, QuotaConfig, QuotaExceededError,
-                     Router, StreamHandle)
+                     Router, SLOConfig, StreamHandle)
 
 __all__ = ["ServerConfig", "GenerationServer", "serve"]
 
@@ -60,6 +60,7 @@ _INDEX = """<html><head><title>paddle_tpu server</title></head><body>
 <li><code>POST /v1/generate</code> — JSON in, SSE token stream out</li>
 <li><a href="/healthz">/healthz</a> — readiness + replica gauges</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
+<li><a href="/slozv">/slozv</a> — per-tenant SLO attainment + goodput</li>
 </ul></body></html>
 """
 
@@ -81,6 +82,8 @@ class ServerConfig:
                  serving=None,
                  quotas: Optional[Dict[str, QuotaConfig]] = None,
                  default_quota: Optional[QuotaConfig] = None,
+                 slos: Optional[Dict[str, SLOConfig]] = None,
+                 default_slo: Optional[SLOConfig] = None,
                  default_deadline_s: Optional[float] = None,
                  max_deadline_s: Optional[float] = None,
                  drain_timeout_s: float = 30.0,
@@ -96,6 +99,12 @@ class ServerConfig:
         self.serving = serving
         self.quotas = dict(quotas or {})
         self.default_quota = default_quota
+        # per-tenant SLO objectives, the quota wiring pattern: `slos`
+        # maps tenant -> SLOConfig with `default_slo` for unlisted
+        # tenants (None everywhere = the SLO plane stays dormant:
+        # zero extra registry series)
+        self.slos = dict(slos or {})
+        self.default_slo = default_slo
         self.default_deadline_s = default_deadline_s
         self.max_deadline_s = max_deadline_s
         self.drain_timeout_s = float(drain_timeout_s)
@@ -206,6 +215,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/metrics":
                 self._send(srv._registry.to_prometheus().encode(),
                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/slozv":
+                self._slozv(srv)
             elif path == "/v1/generate":
                 self._send_json({"error": "use POST"}, status=405,
                                 extra={"Allow": "POST"})
@@ -213,7 +224,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"no such endpoint {path!r}",
                      "endpoints": ["/", "/healthz", "/metrics",
-                                   "/v1/generate"]}, status=404)
+                                   "/slozv", "/v1/generate"]},
+                    status=404)
         except BrokenPipeError:
             pass
         except Exception as e:   # a broken endpoint must report, not die
@@ -266,6 +278,20 @@ class _Handler(BaseHTTPRequestHandler):
                  "preemptions": int(r.engine.metrics.preemptions)}
                 for r in router.replicas],
         }, status=503 if draining else 200)
+
+    def _slozv(self, srv: "GenerationServer") -> None:
+        """Router-level SLO attainment: per-tenant objective met/missed
+        + goodput, aggregated across every replica (scoring happens at
+        the router, so one report covers the fleet). `slo_enabled`
+        False means no SLOConfig is set anywhere — the accounting plane
+        is dormant and `tenants` stays empty."""
+        router = srv.router
+        self._send_json({
+            "router": router.metrics.label,
+            "slo_enabled": router.slo_enabled,
+            "replicas": len(router.replicas),
+            "tenants": router.slo_report(),
+        })
 
     def _reject(self, srv: "GenerationServer", code: int, message: str,
                 tenant: str,
@@ -414,6 +440,8 @@ class GenerationServer:
                 list(engines),
                 quotas=self.config.quotas,
                 default_quota=self.config.default_quota,
+                slos=self.config.slos,
+                default_slo=self.config.default_slo,
                 clock=self.config.clock,
                 registry=registry,
                 max_stream_retries=self.config.max_stream_retries,
@@ -513,6 +541,8 @@ def serve(params, cfg, config: Optional[ServerConfig] = None,
     router = Router(engines,
                     quotas=config.quotas,
                     default_quota=config.default_quota,
+                    slos=config.slos,
+                    default_slo=config.default_slo,
                     clock=config.clock,
                     registry=registry,
                     engine_factory=factory,

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import request_log as _request_log
 from ..observability import watchdog as _watchdog
 from ..observability.tracer import get_tracer, request_scope, trace_span
 from .kv_cache import ShapeBuckets, SlotKVCache
@@ -91,7 +92,14 @@ class ServingConfig:
     tokens (in-graph per-slot n-gram drafter — no second model), so
     tokens-per-model-pass rises to up to k+1 on accept streaks while
     token streams stay bit-identical to speculate_k=0;
-    speculate_ngram sizes the hashed per-slot drafter table."""
+    speculate_ngram sizes the hashed per-slot drafter table.
+
+    Observability knobs: dispatch_timing=True attributes every fused
+    decode dispatch's wall time into launch-side host work vs the
+    blocking wait for its result (serving_dispatch_{host,device}_seconds
+    histograms; off by default — disabled adds zero registry series and
+    zero clock reads). The request event log is process-wide, not an
+    engine knob: observability.install_request_log()."""
 
     def __init__(self, num_slots: int = 4, max_queue: int = 16,
                  prefill_buckets: Optional[Sequence[int]] = None,
@@ -106,6 +114,7 @@ class ServingConfig:
                  preempt: bool = False,
                  preempt_policy="newest",
                  fault_plan=None,
+                 dispatch_timing: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         self.num_slots = int(num_slots)
         self.max_queue = int(max_queue)
@@ -145,6 +154,12 @@ class ServingConfig:
         # scheduled step exceptions / forced page shortages / delays —
         # None in production
         self.fault_plan = fault_plan
+        # host/device dispatch split (off by default — on, every fused
+        # decode dispatch's wall time is attributed into launch-side
+        # host work vs the blocking wait for its result, published as
+        # serving_dispatch_{host,device}_seconds; off, zero extra
+        # registry series and zero extra clock reads)
+        self.dispatch_timing = bool(dispatch_timing)
         self.clock = clock
 
 
@@ -241,7 +256,13 @@ class ServingEngine:
             max_tokens_per_dispatch=(serving.num_slots
                                      * serving.decode_chunk
                                      * (1 + serving.speculate_k)),
-            speculate_k=serving.speculate_k)
+            speculate_k=serving.speculate_k,
+            dispatch_timing=serving.dispatch_timing)
+        if serving.dispatch_timing:
+            self.scheduler.dispatch_timing = True
+            # bound through self.metrics at CALL time so a bench's
+            # metrics reset keeps feeding the replacement instance
+            self.scheduler.on_dispatch_timed = self._on_dispatch_timed
         self.metrics.kv_blocks_total = self.kv.blocks_total
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
@@ -308,20 +329,36 @@ class ServingEngine:
                        f"{next(self._rid_counter)}")
         if _TRACER.enabled:  # queue-wait anchor; no clock read when off
             req._submit_ns = time.monotonic_ns()
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            rlog.event("submitted", request_id=req.request_id,
+                       engine=self.metrics.engine_label,
+                       prompt_len=int(prompt.size),
+                       max_new=int(max_new_tokens))
         with self._lock:
             self.metrics.submitted += 1
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.shed += 1
                 req.state = "shed"
                 shed_depth = len(self._queue)
+                queued_depth = None
             else:
                 req.metrics.mark_submitted()
                 self._queue.append(req)
-                self.metrics.queue_depth = len(self._queue)
-                return req
-        # shed path, OUTSIDE the lock: the overload hook may write a
-        # flight record (no-op unless a watchdog with dump_on_overload is
-        # installed) and must not stall concurrent submits/steps
+                self.metrics.queue_depth = queued_depth = \
+                    len(self._queue)
+        # journal + hooks OUTSIDE the lock: the overload hook may write
+        # a flight record (no-op unless a watchdog with dump_on_overload
+        # is installed) and neither it nor the JSONL write may stall
+        # concurrent submits/steps
+        if queued_depth is not None:
+            if rlog is not None:
+                rlog.event("queued", request_id=req.request_id,
+                           queue_depth=queued_depth)
+            return req
+        if rlog is not None:
+            rlog.event("shed", request_id=req.request_id,
+                       queue_depth=shed_depth)
         _watchdog.notify_overload(self.metrics.engine_label)
         p50 = self.metrics.queue_wait_p50()
         raise EngineOverloadError(
@@ -346,6 +383,14 @@ class ServingEngine:
             req.state = "finished"
             req.metrics.mark_finished()
             self.metrics.record(req.metrics)
+            rlog = _request_log.get_request_log()
+            if rlog is not None:
+                rlog.event(
+                    "finished", request_id=req.request_id,
+                    finish_reason="stop" if (req.eos_id is not None
+                                             and event.token == req.eos_id)
+                    else "length",
+                    tokens=len(req.tokens))
         if req.on_token is not None:
             if _TRACER.enabled:
                 # streamed-token callback on the request's trace timeline
@@ -456,6 +501,10 @@ class ServingEngine:
             req.metrics.mark_admitted()
             self.metrics.admitted += 1
             self.metrics.prefills += 1
+            rlog = _request_log.get_request_log()
+            if rlog is not None:
+                rlog.event("admitted", request_id=req.request_id,
+                           queue_wait_s=req.metrics.queue_wait)
             if _TRACER.enabled and req._submit_ns is not None:
                 # the queue-wait interval only materializes as a span at
                 # admission (submit -> slot), retroactively timed
@@ -582,6 +631,9 @@ class ServingEngine:
     def _on_dispatch_launched(self) -> None:
         self.metrics.dispatches += 1
 
+    def _on_dispatch_timed(self, host_s: float, device_s: float) -> None:
+        self.metrics.observe_dispatch_split(host_s, device_s)
+
     def run_until_drained(self, max_steps: Optional[int] = None) -> int:
         """Step until queue, slots, and swap pool are empty; returns
         steps taken."""
@@ -614,6 +666,7 @@ class ServingEngine:
         start of its next step() — scheduler/slot state is never touched
         from the calling thread, so cancel() is safe concurrently with a
         driver inside step()."""
+        cancelled_from = None
         with self._lock:
             if req.state == "queued":
                 # keyed on STATE, not queue membership: a head-of-line
@@ -625,12 +678,18 @@ class ServingEngine:
                     self._queue.remove(req)
                     self.metrics.queue_depth = len(self._queue)
                 req.state = "cancelled"
-                return True
-            if req.state == "running":
+                cancelled_from = "queued"
+            elif req.state == "running":
                 req.state = "cancelled"
                 self._pending_cancels.append(req)
-                return True
-        return False
+                cancelled_from = "running"
+        if cancelled_from is None:
+            return False
+        rlog = _request_log.get_request_log()
+        if rlog is not None:   # journal outside the lock (JSONL write)
+            rlog.event("cancelled", request_id=req.request_id,
+                       was=cancelled_from, tokens=len(req.tokens))
+        return True
 
     # -- observability ------------------------------------------------------
 

@@ -15,7 +15,9 @@ decoder's `serving_spec_{proposed,accepted}_total`), gauges
 `serving_active_slots` / `serving_queue_depth` /
 `serving_kv_blocks_{total,used,cached}`, histograms
 `serving_ttft_seconds` / `serving_tpot_seconds` /
-`serving_queue_wait_seconds` — so a Prometheus
+`serving_queue_wait_seconds` (and, only when the engine runs with
+`dispatch_timing=True`, the host/device split pair
+`serving_dispatch_{host,device}_seconds`) — so a Prometheus
 scrape or `get_registry().snapshot()` sees the serving plane without
 holding the engine, and the bench's p50/p99 rows come registry-sourced.
 `snapshot()` still returns the same plain dict as before (scrapers and
@@ -185,6 +187,21 @@ _HIST_HELP = {
                "(block adoption + scatter + carry rebuild)",
 }
 
+# host/device dispatch split (ServingConfig(dispatch_timing=True) only:
+# the disabled default must add ZERO registry series): per fused decode
+# dispatch, the launch-side host segment vs the blocking wait for its
+# result. host seconds per dispatch is the pinned baseline the native
+# continuous-batching core is judged against.
+_TIMING_HISTOGRAMS = {"dispatch_host": "serving_dispatch_host_seconds",
+                      "dispatch_device": "serving_dispatch_device_seconds"}
+_TIMING_HELP = {
+    "dispatch_host": "launch-side host seconds per fused decode "
+                     "dispatch (arg flatten + enqueue; the host "
+                     "overhead the native-core work must shrink)",
+    "dispatch_device": "blocking wait per fused decode dispatch for "
+                       "its result (un-hidden device execution)",
+}
+
 def _count_buckets(upper: int):
     """Power-of-two count-histogram bounds covering [1, upper] — the
     scale-free grid for "how many per dispatch" distributions."""
@@ -221,7 +238,7 @@ class EngineMetrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  engine_label: Optional[str] = None,
                  max_tokens_per_dispatch: Optional[int] = None,
-                 speculate_k: int = 0):
+                 speculate_k: int = 0, dispatch_timing: bool = False):
         self._registry = registry or get_registry()
         self.engine_label = str(engine_label if engine_label is not None
                                 else next(EngineMetrics._ids))
@@ -232,6 +249,7 @@ class EngineMetrics:
                                         if max_tokens_per_dispatch
                                         else None)
         self.speculate_k = int(speculate_k)
+        self.dispatch_timing = bool(dispatch_timing)
         label = {"engine": self.engine_label}
         self._families = []
         self._series = {}
@@ -269,6 +287,13 @@ class EngineMetrics:
             self._families.append(fam)
             self._hists[key] = fam.labels(_buckets=series_buckets,
                                           **label)
+        if self.dispatch_timing:
+            # registered ONLY when the split is on: the disabled path
+            # is pinned to add zero registry families/series
+            for key, full in _TIMING_HISTOGRAMS.items():
+                fam = self._registry.histogram(full, _TIMING_HELP[key])
+                self._families.append(fam)
+                self._hists[key] = fam.labels(**label)
 
     def unregister(self) -> None:
         """Remove this engine's labeled series from the registry so a
@@ -302,6 +327,19 @@ class EngineMetrics:
         "swap_out" (preemption copy-out) or "swap_in" (resume restore)
         — the latency series behind the bench's swap_in_ms column."""
         self._hists[direction].observe(float(seconds))
+
+    def observe_dispatch_split(self, host_s: float,
+                               device_s: float) -> None:
+        """One fused decode dispatch spent `host_s` launch-side and
+        `device_s` blocked on its result — the host/device attribution
+        behind the /varz host_overhead_per_dispatch rollup and the
+        bench's host_overhead_ms column. No-op unless this instance was
+        built with dispatch_timing=True (the series don't exist
+        otherwise)."""
+        if not self.dispatch_timing:
+            return
+        self._hists["dispatch_host"].observe(float(host_s))
+        self._hists["dispatch_device"].observe(float(device_s))
 
     def record(self, rm: RequestMetrics):
         self.completed += 1

@@ -109,6 +109,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from .. import profiler
+from ..observability import request_log as _request_log
 from ..observability.tracer import get_tracer
 from .kv_cache import ShapeBuckets, SlotKVCache
 
@@ -192,6 +193,8 @@ class _Inflight(NamedTuple):
     begin_ns: int       # launch stamp; 0 = tracing was off at launch
     counts: Any = None  # spec mode: device (chunk, S) int32 commit
     #                     counts; block is (chunk, k+1, S) then
+    host_s: float = 0.0  # launch-side host seconds (dispatch_timing on;
+    #                      0.0 when the split is disabled)
 
 
 class ContinuousBatchingScheduler:
@@ -257,6 +260,15 @@ class ContinuousBatchingScheduler:
         # blocked in the NEXT collect still shows this launch (a metric
         # bumped after step() returns would never record it)
         self.on_launch = None
+        # host/device dispatch split (off by default — the disabled
+        # path must stay clock-read-free): when on, _launch times the
+        # launch-side host segment (trace + enqueue of the chunk jit)
+        # and _collect times the block on this dispatch's result — the
+        # device-attributed segment — then fires on_dispatch_timed
+        # (host_s, device_s) per dispatch. The engine wires this to the
+        # serving_dispatch_{host,device}_seconds histograms.
+        self.dispatch_timing = False
+        self.on_dispatch_timed = None
         # deterministic fault injection (serving.faults.FaultPlan or
         # None): the engine installs its plan here so scheduled
         # dispatch delays fire at the launch site
@@ -546,6 +558,12 @@ class ContinuousBatchingScheduler:
                 np.int32(-1 if eos_id is None else eos_id),
                 np.int32(prompt[0, -1]))
         first = int(first)
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            rlog.event("prefill",
+                       request_id=getattr(req, "request_id", None),
+                       slot=slot, bucket=bucket, prompt_len=p_len,
+                       prefix_len=int(pfx_len), suffix_len=suffix_len)
         st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
                       live_from=self._launches, seq=self._admit_counter)
         self._admit_counter += 1
@@ -600,6 +618,11 @@ class ContinuousBatchingScheduler:
         if self.faults is not None:
             self.faults.before_dispatch(self._launches)
         begin_ns = time.monotonic_ns() if _TRACER.enabled else 0
+        # host segment: everything between here and the enqueue
+        # returning — trace/lower on the first call, argument
+        # flattening + dispatch enqueue after (the async dispatch
+        # returns futures, so none of the device execution is in it)
+        host_t0 = time.perf_counter() if self.dispatch_timing else 0.0
         with profiler.RecordEvent("serving/decode_dispatch",
                                   active=len(self._running),
                                   slots=self.kv.num_slots,
@@ -608,12 +631,14 @@ class ContinuousBatchingScheduler:
             block, self.kv.kv, self._keys, self._state = self._chunk_jit(
                 self.params, self.kv.kv, self._pt, self._keys,
                 self._state)
+        host_s = (time.perf_counter() - host_t0) if self.dispatch_timing \
+            else 0.0
         counts = None
         if self.speculate_k:
             block, counts = block
         self._inflight.append(_Inflight(block, self._launches,
                                         self.decode_chunk, begin_ns,
-                                        counts))
+                                        counts, host_s))
         self._launches += 1
         if self.on_launch is not None:
             self.on_launch()
@@ -621,13 +646,29 @@ class ContinuousBatchingScheduler:
     def _collect(self, fl: _Inflight) -> List[SequenceEvent]:
         import jax
 
+        # device segment: the block on THIS dispatch's result. With
+        # overlap on, host post-processing of the previous block already
+        # ran under this dispatch's device time, so the wait here is the
+        # un-hidden device execution remainder — host_s + device_s is
+        # the dispatch's wall attribution, and host_s is the per-
+        # dispatch overhead the native-core work is judged against.
+        dev_t0 = time.perf_counter() if self.dispatch_timing else 0.0
         if fl.counts is None:
             block = np.asarray(jax.device_get(fl.block))
             counts = None
         else:
             block, counts = jax.device_get((fl.block, fl.counts))
             block, counts = np.asarray(block), np.asarray(counts)
+        if self.dispatch_timing and self.on_dispatch_timed is not None:
+            self.on_dispatch_timed(fl.host_s,
+                                   time.perf_counter() - dev_t0)
         end_ns = time.monotonic_ns() if fl.begin_ns else 0
+        rlog = _request_log.get_request_log()
+        # per-(request, dispatch) token attribution for the event log:
+        # accumulated during the walk, one "decode" record per request
+        # this block delivered tokens for (never per token)
+        emitted: Optional[Dict[int, List[Any]]] = \
+            {} if rlog is not None else None
         events: List[SequenceEvent] = []
         # iteration-major walk: token i of every slot before token i+1 of
         # any — the same time-ordering the per-step path emitted, so
@@ -690,8 +731,21 @@ class ContinuousBatchingScheduler:
                              "finished": finished, "chunk_index": i,
                              "dispatch": fl.index})
                     events.append(SequenceEvent(st.req, tok, finished))
+                    if emitted is not None:
+                        ent = emitted.get(slot)
+                        if ent is None:
+                            ent = emitted[slot] = [st.req, 0, False]
+                        ent[1] += 1
+                        ent[2] = finished
                     if finished:
                         break
+        if emitted:
+            for slot in sorted(emitted):
+                req, n, fin = emitted[slot]
+                rlog.event("decode",
+                           request_id=getattr(req, "request_id", None),
+                           slot=slot, dispatch=fl.index, tokens=n,
+                           finished=fin)
         return events
 
     def drain_spec_samples(self) -> List[int]:
@@ -804,6 +858,11 @@ class ContinuousBatchingScheduler:
         self._pt, self._state = self._release_jit(
             self._pt, self._state, np.int32(slot))
         self.kv.free(slot)
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            rlog.event("preempted",
+                       request_id=getattr(st.req, "request_id", None),
+                       slot=slot, blocks=n_blocks, produced=st.produced)
         return sw
 
     def can_swap_in(self, sw: SwappedSequence) -> bool:
@@ -854,4 +913,9 @@ class ContinuousBatchingScheduler:
                       seq=sw.seq)
         st.produced = sw.produced
         self._running[slot] = st
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            rlog.event("swapped_in",
+                       request_id=getattr(sw.req, "request_id", None),
+                       slot=slot, produced=sw.produced)
         return slot

@@ -543,6 +543,280 @@ def test_request_metrics_unstamped_everything_none():
                  "output_tps": None, "total": None, "tokens_out": 0}
 
 
+# ---------------------------------------------------------------------------
+# registry rollup helper (/varz blocks deduped — observability PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_registry_rollup_counters_and_ratio():
+    """registry_rollup joins labeled counter families into per-label
+    rows and ratio() derives safe divisions (None on an empty
+    denominator, never a ZeroDivisionError)."""
+    from paddle_tpu.observability.debug_server import (ratio,
+                                                       registry_rollup)
+    snap = {
+        "hits_total": {"series": [
+            {"labels": {"engine": "a"}, "value": 3},
+            {"labels": {"engine": "b"}, "value": 0}]},
+        "misses_total": {"series": [
+            {"labels": {"engine": "a"}, "value": 1}]},
+    }
+    out = registry_rollup(snap, {"hits": "hits_total",
+                                 "misses": "misses_total"},
+                          derived=[("hit_ratio",
+                                    ratio("hits", ("hits", "misses")))])
+    assert out == {
+        "a": {"hits": 3, "misses": 1, "hit_ratio": 0.75},
+        "b": {"hits": 0, "misses": 0, "hit_ratio": None},
+    }
+    # absent families roll up to an empty dict, not a KeyError
+    assert registry_rollup({}, {"x": "nope_total"}) == {}
+
+
+def test_registry_rollup_histogram_fields_and_label_sums():
+    """Histogram columns join on sum/count with a float cast, and a
+    family whose series split the join label further (tenant AND
+    objective) SUMS into the per-label row instead of overwriting."""
+    from paddle_tpu.observability.debug_server import (ratio,
+                                                       registry_rollup)
+    snap = {
+        "lat_seconds": {"series": [
+            {"labels": {"engine": "a"}, "count": 4, "sum": 0.02}]},
+        "slo_met_total": {"series": [
+            {"labels": {"tenant": "t", "objective": "ttft"}, "value": 2},
+            {"labels": {"tenant": "t", "objective": "e2e"}, "value": 3}]},
+    }
+    out = registry_rollup(
+        snap, {"n": ("lat_seconds", "count", int),
+               "total_s": ("lat_seconds", "sum", float)},
+        derived=[("mean_ms", ratio("total_s", "n", digits=3,
+                                   scale=1e3))])
+    assert out == {"a": {"n": 4, "total_s": 0.02, "mean_ms": 5.0}}
+    out = registry_rollup(snap, {"met": "slo_met_total"},
+                          label_key="tenant")
+    assert out == {"t": {"met": 5}}            # objectives aggregated
+
+
+def test_serving_varz_uses_rollup_for_every_block(tiny_engine_params):
+    """The deduped _serving_varz keeps the exact pre-refactor shape for
+    the PR 6/9/10 blocks (other tests pin the values) and grows the
+    host-overhead and SLO blocks — empty dicts while those planes are
+    dormant, never missing keys."""
+    from paddle_tpu.observability.debug_server import _serving_varz
+    varz = _serving_varz(obs.get_registry().snapshot())
+    assert set(varz) == {"prefix_hit_ratio", "spec_accept_ratio",
+                         "preemption", "host_overhead_per_dispatch",
+                         "slo"}
+
+
+# ---------------------------------------------------------------------------
+# histogram meta-test (observability PR satellite): every registered
+# histogram family has sane buckets and loses no observation
+# ---------------------------------------------------------------------------
+
+def test_every_registered_histogram_has_monotone_buckets():
+    """Guard on the per-series `_buckets=` override machinery: drive
+    engines with DIFFERENT count-scaled layouts through one registry,
+    then assert for every histogram series in the process registry —
+    strictly monotone bucket bounds, non-decreasing cumulative counts,
+    and a +Inf bucket equal to the observation count (every observed
+    sample landed in a bucket; silent misfiling would break one of
+    these)."""
+    import math
+    from paddle_tpu.serving.metrics import EngineMetrics
+
+    # two engines with different per-series layouts + the split hists
+    m1 = EngineMetrics(max_tokens_per_dispatch=24, speculate_k=2,
+                       dispatch_timing=True)
+    m2 = EngineMetrics(max_tokens_per_dispatch=640, speculate_k=6)
+    for m, runs in ((m1, (0, 1, 2)), (m2, (0, 3, 6))):
+        for i, n in enumerate(runs):
+            m.observe_dispatch_tokens(1 + 7 * i)
+            m.observe_spec_run(n)
+            m.observe_swap("swap_out", 0.001 * (i + 1))
+            m.observe_swap("swap_in", 0.002)
+    m1.observe_dispatch_split(0.0005, 0.004)
+    m1.observe_dispatch_split(0.0008, 0.0)     # boundary-ish values
+    checked = 0
+    for fam in obs.get_registry().families():
+        if fam.kind != "histogram":
+            continue
+        for labels, series in fam.series_items():
+            bounds = series._bounds
+            assert all(a < b for a, b in zip(bounds, bounds[1:])), \
+                (fam.name, labels, bounds)
+            cum = series.cumulative_buckets()
+            counts = [c for _, c in cum]
+            assert counts == sorted(counts), (fam.name, labels, cum)
+            assert cum[-1][0] == "+Inf"
+            assert cum[-1][1] == series.count, (fam.name, labels, cum)
+            assert series.count == 0 or series.sum != math.inf
+            checked += 1
+    assert checked >= 9   # the meta-test really walked the families
+    m1.unregister()
+    m2.unregister()
+
+
+# ---------------------------------------------------------------------------
+# request event log (observability PR tentpole)
+# ---------------------------------------------------------------------------
+
+def test_request_log_events_ring_inflight_and_jsonl(tmp_path):
+    """RequestLog unit contract: events stamp wall + monotonic clocks,
+    the ring serves recent(), in-flight tracking adds on the first
+    non-terminal event and retires on terminal kinds AND on
+    rerouted_from links, and the JSONL file carries one record per
+    event."""
+    from paddle_tpu.observability.request_log import (
+        RequestLog, get_request_log, install_request_log,
+        uninstall_request_log)
+
+    assert get_request_log() is None
+    log = install_request_log(RequestLog(log_dir=str(tmp_path),
+                                         run_name="r"))
+    try:
+        assert get_request_log() is log
+        log.event("submitted", request_id="e-0", engine="e")
+        log.event("queued", request_id="e-0", queue_depth=1)
+        log.event("submitted", request_id="e-1", engine="e")
+        assert log.inflight_ids() == ["e-0", "e-1"]
+        log.event("finished", request_id="e-0", finish_reason="length",
+                  tokens=3)
+        assert log.inflight_ids() == ["e-1"]
+        # failover: the new id supersedes the stranded one
+        log.event("routed", request_id="f-7", rerouted_from="e-1",
+                  tenant="t")
+        assert log.inflight_ids() == ["f-7"]
+        log.event("stream_closed", request_id="f-7", reason="length")
+        assert log.inflight_ids() == []
+        recent = log.recent()
+        assert [r["kind"] for r in recent] == [
+            "submitted", "queued", "submitted", "finished", "routed",
+            "stream_closed"]
+        assert all("ts" in r and "t_mono" in r for r in recent)
+        monos = [r["t_mono"] for r in recent]
+        assert monos == sorted(monos)
+        assert log.event_count == 6
+        assert log.recent(2)[-1]["kind"] == "stream_closed"
+    finally:
+        uninstall_request_log()
+    assert get_request_log() is None
+    lines = [json.loads(l) for l in
+             open(str(tmp_path / "r.jsonl")) if l.strip()]
+    assert len(lines) == 6
+    assert lines[0]["kind"] == "submitted"
+    assert lines[4]["rerouted_from"] == "e-1"
+
+
+def test_request_log_rotation_bounded(tmp_path):
+    """The JSONL rotates at max_bytes keeping max_files generations —
+    the StepLogger discipline, so a chatty serving fleet can never grow
+    the log without bound."""
+    import os
+    from paddle_tpu.observability.request_log import RequestLog
+
+    log = RequestLog(log_dir=str(tmp_path), run_name="rot",
+                     max_bytes=600, max_files=2)
+    for i in range(60):
+        log.event("decode", request_id=f"e-{i % 4}", slot=i % 4,
+                  dispatch=i, tokens=8)
+    log.close()
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "rot.jsonl" in names
+    gens = [n for n in names if n.startswith("rot.jsonl.")]
+    assert gens and len(gens) <= 2             # bounded retention
+    assert all(os.path.getsize(str(tmp_path / n)) <= 600 + 200
+               for n in names)
+
+
+def test_requestz_endpoint_serves_inflight_and_filter(tiny_engine_params,
+                                                      tmp_path):
+    """/requestz serves the installed log's in-flight ids + recent
+    events, filters by ?request_id=, and reports enabled=false with no
+    log installed."""
+    import urllib.request
+    from paddle_tpu.observability.request_log import (
+        RequestLog, install_request_log, uninstall_request_log)
+
+    cfg, params = tiny_engine_params
+    server = obs.DebugServer(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}{path}",
+                    timeout=10) as r:
+                return json.loads(r.read())
+
+        off = get("/requestz")
+        assert off["enabled"] is False and off["events"] == []
+        log = install_request_log(RequestLog(log_dir=str(tmp_path)))
+        try:
+            eng = pt.serving.ServingEngine(
+                params, cfg, pt.serving.ServingConfig(
+                    num_slots=2, prefill_buckets=(4, 8), max_len=32))
+            r1 = eng.submit(np.asarray([1, 2, 3], np.int32), 4)
+            r2 = eng.submit(np.asarray([4, 5], np.int32), 4)
+            mid = get("/requestz")
+            assert mid["enabled"] is True
+            assert set(mid["inflight"]) == {r1.request_id,
+                                            r2.request_id}
+            eng.run_until_drained()
+            done = get(f"/requestz?request_id={r1.request_id}")
+            assert done["inflight"] == []
+            kinds = [e["kind"] for e in done["events"]]
+            assert kinds[0] == "submitted" and kinds[-1] == "finished"
+            assert all(e["request_id"] == r1.request_id
+                       for e in done["events"])
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/requestz?limit=bogus")
+            assert ei.value.code == 400
+            eng.close()
+        finally:
+            uninstall_request_log()
+    finally:
+        server.stop()
+
+
+def test_flight_record_meta_joins_inflight_requests(tiny_engine_params,
+                                                    tmp_path):
+    """Watchdog satellite: a flight record's meta.json snapshots the
+    in-flight request ids at dump time, so a stall/overload dump joins
+    against the request event log — the dumped id has a full lifecycle
+    prefix in the log, and a post-drain dump carries none."""
+    import os
+    from paddle_tpu.observability.request_log import (
+        RequestLog, install_request_log, uninstall_request_log)
+
+    cfg, params = tiny_engine_params
+    log = install_request_log(RequestLog(log_dir=str(tmp_path / "lg")))
+    try:
+        eng = pt.serving.ServingEngine(
+            params, cfg, pt.serving.ServingConfig(
+                num_slots=2, prefill_buckets=(4, 8), max_len=32))
+        req = eng.submit(np.asarray([1, 2, 3], np.int32), 6)
+        rec = obs.FlightRecorder(base_dir=str(tmp_path / "f"))
+        path = rec.dump("stall", {"stalled": {"engine:x": {}}})
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        assert req.request_id in meta["inflight_request_ids"]
+        # the join: the dumped id's lifecycle prefix is in the log
+        kinds = [e["kind"] for e in log.recent()
+                 if e["request_id"] == req.request_id]
+        assert "submitted" in kinds and "queued" in kinds
+        eng.run_until_drained()
+        path2 = rec.dump("manual")
+        meta2 = json.load(open(os.path.join(path2, "meta.json")))
+        assert meta2["inflight_request_ids"] == []
+        eng.close()
+    finally:
+        uninstall_request_log()
+    # with no log installed the field is present and empty (meta shape
+    # is stable for tooling)
+    rec2 = obs.FlightRecorder(base_dir=str(tmp_path / "f2"))
+    meta3 = json.load(open(os.path.join(rec2.dump("manual"),
+                                        "meta.json")))
+    assert meta3["inflight_request_ids"] == []
+
+
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-x", "-q"]))

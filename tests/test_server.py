@@ -971,3 +971,210 @@ def test_chaos_soak_every_request_terminal(trained):
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+# ---------------------------------------------------------------------------
+# SLO & goodput accounting (observability PR)
+# ---------------------------------------------------------------------------
+
+def test_slo_accounting_goodput_and_slozv(trained):
+    """SLOConfig per-tenant objectives wired through the router: a
+    tenant with generous targets meets every objective (tokens count as
+    goodput), a tenant under an impossible TTFT target misses (tokens
+    delivered but NOT goodput), /slozv aggregates the per-tenant
+    breakdown, and the registry carries the
+    server_slo_{met,missed}_total / goodput series — which are retired
+    on shutdown like every other router series."""
+    from paddle_tpu.server import SLOConfig
+
+    srv = make_server(trained, server_kw=dict(
+        slos={"gold": SLOConfig(ttft_s=60.0, tpot_s=5.0, e2e_s=120.0)},
+        # unlisted tenants score an impossible TTFT: always missed
+        default_slo=SLOConfig(ttft_s=1e-9)))
+    router_label = srv.router.metrics.label
+    try:
+        st, _, toks, done = sse_generate(
+            srv.port, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                       "tenant": "gold"})
+        assert st == 200 and len(toks) == 4
+        assert done["finish_reason"] == "length"
+        st, _, toks, done = sse_generate(
+            srv.port, {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert st == 200 and len(toks) == 4
+
+        _, rep = _get_json(srv.port, "/slozv", expect=200)
+        assert rep["slo_enabled"] is True
+        assert rep["router"] == router_label
+        gold = rep["tenants"]["gold"]
+        assert gold["missed"] == 0 and gold["met"] == 3  # 3 objectives
+        assert gold["slo_attainment"] == 1.0
+        assert gold["objectives"]["ttft"] == {
+            "met": 1, "missed": 0, "attainment": 1.0}
+        assert gold["tokens"] == 4 and gold["goodput_tokens"] == 4
+        assert gold["goodput_ratio"] == 1.0
+        dflt = rep["tenants"]["default"]
+        assert dflt["objectives"]["ttft"]["missed"] == 1
+        assert dflt["slo_attainment"] == 0.0
+        # tokens were DELIVERED but outside objective: zero goodput
+        assert dflt["tokens"] == 4 and dflt["goodput_tokens"] == 0
+        assert dflt["goodput_ratio"] == 0.0
+
+        # scrape-path truth: the same numbers as labeled series
+        assert _registry_value("server_slo_met_total",
+                               router=router_label, tenant="gold",
+                               objective="ttft") == 1
+        assert _registry_value("server_slo_missed_total",
+                               router=router_label, tenant="default",
+                               objective="ttft") == 1
+        assert _registry_value("server_goodput_tokens_total",
+                               router=router_label, tenant="gold") == 4
+        assert _registry_value("server_slo_tokens_total",
+                               router=router_label,
+                               tenant="default") == 4
+        assert _registry_value("server_goodput_ratio",
+                               router=router_label, tenant="gold") == 1.0
+    finally:
+        srv.shutdown()
+    # unregister retired every SLO/goodput series this router minted
+    snap = pt.observability.get_registry().snapshot()
+    for fam in ("server_slo_met_total", "server_slo_missed_total",
+                "server_slo_tokens_total", "server_goodput_tokens_total",
+                "server_goodput_ratio"):
+        rows = snap.get(fam, {}).get("series", [])
+        assert not any(r["labels"].get("router") == router_label
+                       for r in rows), (fam, rows)
+
+
+def test_slo_disabled_is_registry_noop(trained):
+    """With no SLOConfig anywhere (the default), the SLO plane is
+    dormant: /slozv reports slo_enabled false with no tenants, and the
+    router mints NO slo/goodput series for any tenant it serves."""
+    srv = make_server(trained)
+    router_label = srv.router.metrics.label
+    try:
+        st, _, toks, _ = sse_generate(
+            srv.port, {"prompt": [2, 3, 4], "max_new_tokens": 3,
+                       "tenant": "anyone"})
+        assert st == 200 and len(toks) == 3
+        _, rep = _get_json(srv.port, "/slozv", expect=200)
+        assert rep["slo_enabled"] is False
+        assert rep["tenants"] == {}
+        snap = pt.observability.get_registry().snapshot()
+        for fam in ("server_slo_met_total", "server_slo_missed_total",
+                    "server_slo_tokens_total",
+                    "server_goodput_tokens_total",
+                    "server_goodput_ratio"):
+            rows = snap.get(fam, {}).get("series", [])
+            assert not any(r["labels"].get("router") == router_label
+                           for r in rows), (fam, rows)
+    finally:
+        srv.shutdown()
+
+
+def test_slo_deadline_miss_counts_every_objective(trained):
+    """A stream terminated by the service (deadline_exceeded) missed
+    every configured objective, and its partial tokens count toward the
+    tenant's total but never its goodput; a CLIENT cancel is excluded
+    from scoring entirely."""
+    from paddle_tpu.server import SLOConfig
+
+    eng = make_engine(trained, num_slots=1)
+    router = Router([eng], default_slo=SLOConfig(ttft_s=60.0,
+                                                 e2e_s=120.0))
+    router.start()
+    try:
+        # deadline that expires mid-generation (driver checks between
+        # steps): long budget, tiny deadline
+        h = router.submit(np.asarray([1, 2, 3], np.int32), 24,
+                          deadline_s=0.15)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "deadline_exceeded"
+        rep = router.slo_report()["default"]
+        assert rep["missed"] == 2 and rep["met"] == 0   # both objectives
+        assert rep["goodput_tokens"] == 0
+        assert rep["tokens"] == len(tokens)
+    finally:
+        router.close(drain=False)
+    # a CLIENT cancel is not a service miss: score nothing. A router
+    # whose driver never ran makes this deterministic — the request is
+    # still queued when the cancel lands, so "cancelled" is the only
+    # possible terminal reason.
+    eng2 = make_engine(trained, num_slots=1)
+    router2 = Router([eng2], default_slo=SLOConfig(ttft_s=60.0))
+    try:
+        h2 = router2.submit(np.asarray([1, 2, 3], np.int32), 8)
+        router2.cancel(h2)
+        assert h2.result(timeout=10)[1] == "cancelled"
+        assert router2.slo_report() == {}      # nothing scored
+    finally:
+        router2.close(drain=False)
+
+
+def test_slozv_attainment_after_failover(trained):
+    """Cross-replica aggregation: a request that failed over to a
+    healthy replica still scores its tenant's objectives once at stream
+    close — /slozv reflects the fleet outcome, not a per-replica
+    view."""
+    from paddle_tpu.server import SLOConfig
+
+    faulty = make_engine(trained,
+                         fault_plan=FaultPlan(step_exceptions={0}))
+    healthy = make_engine(trained)
+    router = Router([faulty, healthy],
+                    default_slo=SLOConfig(e2e_s=120.0))
+    router.start()
+    try:
+        h = router.submit(np.asarray([3, 1, 4], np.int32), 6)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "length" and len(tokens) == 6
+        assert h.retries == 1                  # really failed over
+        rep = router.slo_report()["default"]
+        assert rep["met"] == 1 and rep["missed"] == 0
+        assert rep["goodput_tokens"] == 6
+    finally:
+        router.close(drain=False)
+
+
+def test_slo_failover_scores_client_observed_cuts(trained):
+    """SLO scoring spans the WHOLE client wait, not the retried attempt
+    alone: a failover re-submission resets the engine-side
+    RequestMetrics marks, so scoring those would report attainment
+    healthiest exactly when replicas are failing. The router clock is
+    advanced far past the targets at the re-submission boundary — the
+    retried attempt alone meets every objective (its engine-side ttft
+    is seconds), but the client-observed cuts must miss."""
+    from paddle_tpu.server import SLOConfig
+
+    t = [0.0]
+    faulty = make_engine(trained,
+                         fault_plan=FaultPlan(step_exceptions={0}))
+    healthy = make_engine(trained)
+    orig_submit = healthy.submit
+
+    def slow_resubmit(*args, **kw):
+        # the failover re-submission boundary: the client has now
+        # "waited" 1000 router-clock seconds across attempt 1 + backoff
+        t[0] = 1000.0
+        return orig_submit(*args, **kw)
+
+    healthy.submit = slow_resubmit
+    router = Router([faulty, healthy], clock=lambda: t[0],
+                    default_slo=SLOConfig(ttft_s=30.0, e2e_s=30.0))
+    router.start()
+    try:
+        h = router.submit(np.asarray([3, 1, 4], np.int32), 6)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "length" and len(tokens) == 6
+        assert h.retries == 1                  # really failed over
+        # the retried attempt ALONE met the targets (engine-side clock
+        # is real monotonic; the whole retry ran in well under 30s) —
+        # the old rm-based scoring would have counted these as met
+        assert h.request.metrics.ttft < 30.0
+        rep = router.slo_report()["default"]
+        assert rep["met"] == 0 and rep["missed"] == 2
+        assert rep["objectives"]["ttft"]["missed"] == 1
+        assert rep["objectives"]["e2e"]["missed"] == 1
+        # delivered tokens count, but none are goodput
+        assert rep["tokens"] == 6 and rep["goodput_tokens"] == 0
+    finally:
+        router.close(drain=False)

@@ -1642,3 +1642,144 @@ def test_fault_plan_slow_steps_and_dispatch_delays(trained):
     assert plan.slept_steps == 2
     assert plan.summary()["scheduled_delays"] == 2
     eng.close()
+
+
+# ---------------------------------------------------------------------------
+# request-lifecycle plane (observability PR): disabled no-op pin +
+# dispatch split + event log
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_plane_disabled_is_noop(trained):
+    """Acceptance pin: with no request log installed and
+    dispatch_timing off (the defaults), serving is bit-identical to the
+    pre-plane behavior — token streams match a fully-instrumented run
+    of the same mix, the compile-event sequence is unchanged, and the
+    engine's registry footprint is exactly the pre-PR family set (no
+    dispatch-split series, no request-log series of any kind)."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability import request_log as rl
+
+    assert rl.get_request_log() is None        # the production default
+    rng = np.random.RandomState(11)
+    cfg, _ = trained
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (3 + i % 4,)).astype(np.int32)
+               for i in range(6)]
+    eng = make_engine(trained, num_slots=2)
+    label = eng.stats()["engine_label"]
+    outs = eng.generate(prompts, max_new_tokens=6,
+                        temperature=0.7, seed=13)
+    events_off = eng.scheduler.compile_events
+    snap = get_registry().snapshot()
+    # the engine's label appears under EXACTLY the pre-plane families —
+    # "zero extra registry series" is a set equality, not an absence
+    # check, so a renamed family can't slip through either
+    expected = (
+        {f"serving_{n}_total" for n in
+         ("submitted", "admitted", "completed", "shed", "tokens_out",
+          "decode_steps", "prefills", "dispatches", "spec_proposed",
+          "spec_accepted", "prefix_cache_hits", "prefix_cache_misses",
+          "preemptions", "swap_ins")}
+        | {f"serving_{n}" for n in
+           ("active_slots", "queue_depth", "kv_blocks_total",
+            "kv_blocks_used", "kv_blocks_cached", "swapped_slots")}
+        | {"serving_ttft_seconds", "serving_tpot_seconds",
+           "serving_queue_wait_seconds", "serving_tokens_per_dispatch",
+           "serving_spec_accepted_run", "serving_swap_out_seconds",
+           "serving_swap_in_seconds"})
+    labeled = {name for name, fam in snap.items()
+               if any(r["labels"].get("engine") == label
+                      for r in fam.get("series", []))}
+    assert labeled == expected, labeled ^ expected
+    eng.close()
+
+    # the fully-instrumented run: request log installed AND the
+    # host/device dispatch split on — streams must not move a bit
+    with rl.request_logging() as log:
+        eng2 = make_engine(trained, num_slots=2, dispatch_timing=True)
+        label2 = eng2.stats()["engine_label"]
+        outs2 = eng2.generate(prompts, max_new_tokens=6,
+                              temperature=0.7, seed=13)
+        events_on = eng2.scheduler.compile_events
+        snap2 = get_registry().snapshot()
+        eng2.close()
+    for a, b in zip(outs, outs2):
+        np.testing.assert_array_equal(a, b)
+    assert events_off == events_on             # zero extra compiles
+    # the instrumented run really measured: both split histograms
+    # carry one sample per launched dispatch
+    for fam in ("serving_dispatch_host_seconds",
+                "serving_dispatch_device_seconds"):
+        row = next(r for r in snap2[fam]["series"]
+                   if r["labels"].get("engine") == label2)
+        assert row["count"] > 0, fam
+    # and journaled the full lifecycle for every request
+    kinds = {e["kind"] for e in log.recent()}
+    assert {"submitted", "queued", "admitted", "prefill", "decode",
+            "finished"} <= kinds
+    assert log.inflight_ids() == []            # everything terminal
+
+
+def test_dispatch_split_attributes_host_and_device_time(trained):
+    """dispatch_timing=True: every collected dispatch lands one sample
+    in BOTH split histograms, stats() grows the split columns, and the
+    /varz host_overhead_per_dispatch rollup derives the same mean the
+    registry sum/count implies."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.debug_server import _serving_varz
+
+    eng = make_engine(trained, num_slots=2, dispatch_timing=True)
+    prompts = [np.asarray([1, 2, 3], np.int32),
+               np.asarray([5, 4, 3, 2, 1], np.int32)]
+    eng.generate(prompts, max_new_tokens=8)
+    label = eng.stats()["engine_label"]
+    snap = get_registry().snapshot()
+    host = next(r for r in snap["serving_dispatch_host_seconds"]
+                ["series"] if r["labels"].get("engine") == label)
+    dev = next(r for r in snap["serving_dispatch_device_seconds"]
+               ["series"] if r["labels"].get("engine") == label)
+    assert host["count"] == dev["count"] > 0
+    assert host["sum"] > 0 and dev["sum"] >= 0
+    varz = _serving_varz(snap)["host_overhead_per_dispatch"][label]
+    assert varz["dispatches"] == host["count"]
+    assert varz["host_overhead_ms"] == round(
+        host["sum"] / host["count"] * 1e3, 3)
+    assert varz["host_share"] is not None and 0 < varz["host_share"] <= 1
+    # stats() carries the split means alongside the other histograms
+    s = eng.stats()
+    assert s["mean_dispatch_host"] > 0
+    assert s["mean_dispatch_device"] >= 0
+    eng.close()
+
+
+def test_request_log_preemption_timeline(trained):
+    """The request log captures a preempted request's full phase
+    sequence — submitted/queued/admitted/prefill, preempted and
+    swapped_in under page pressure, per-dispatch decode records, and
+    the terminal finished event — all correlated on request_id."""
+    from paddle_tpu.observability import request_log as rl
+
+    with rl.request_logging() as log:
+        eng = make_engine(trained, **PRESSURE)
+        prompts = _pressure_prompts(cfg=trained[0])
+        reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        eng.run_until_drained()
+        assert eng.stats()["preemptions"] >= 1
+        eng.close()
+    events = log.recent()
+    preempted_ids = {e["request_id"] for e in events
+                     if e["kind"] == "preempted"}
+    assert preempted_ids                        # pressure really evicted
+    rid = sorted(preempted_ids)[0]
+    kinds = [e["kind"] for e in events if e["request_id"] == rid]
+    for needed in ("submitted", "queued", "admitted", "prefill",
+                   "preempted", "swapped_in", "decode", "finished"):
+        assert needed in kinds, (needed, kinds)
+    # phase order: admission precedes the preemption, the swap-in
+    # precedes the finish
+    assert kinds.index("admitted") < kinds.index("preempted") \
+        < kinds.index("swapped_in") < len(kinds) - 1
+    assert kinds[-1] == "finished"
+    # every request reached a terminal event and the budget delivered
+    assert all(r.state == "finished" and len(r.tokens) == 12
+               for r in reqs)

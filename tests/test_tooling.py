@@ -180,6 +180,12 @@ def test_bench_serving_row_shape():
         # finite percentage
         assert row["extra"]["tokens_per_s_traced"] > 0
         assert isinstance(row["extra"]["trace_overhead_pct"], float)
+        # host/device dispatch split (SLO/lifecycle PR): registry-
+        # sourced mean launch-side host ms per dispatch — the native-
+        # core baseline column — plus the device wait next to it
+        assert row["extra"]["host_overhead_ms"] is not None
+        assert row["extra"]["host_overhead_ms"] > 0
+        assert row["extra"]["device_ms_per_dispatch"] is not None
     # the traced re-run restored the disabled production default
     import paddle_tpu.observability as obs
     assert not obs.tracing_enabled()
@@ -316,6 +322,13 @@ def test_bench_serving_http_row_shape():
               "compiled_executables"):
         assert e[k] is not None, (k, e)
     assert e["server_requests_ok"] == 3
+    # SLO/goodput plane (SLO/lifecycle PR): the bench runs under a
+    # generous default SLO, so a healthy run attains 1.0 and every
+    # delivered token is goodput
+    assert e["slo_attainment"] == 1.0
+    assert e["goodput_tokens_per_s"] is not None
+    assert e["goodput_tokens_per_s"] > 0
+    assert e["host_overhead_ms"] is not None and e["host_overhead_ms"] > 0
     # the server was torn down: no leftover wire surface
     import paddle_tpu as pt
     snap = pt.observability.get_registry().snapshot()
@@ -506,6 +519,129 @@ def test_train_summary_cli_smoke(tmp_path):
                        capture_output=True, text=True, timeout=120,
                        env=env)
     assert r.returncode == 2 and "install_step_logger" in r.stderr
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("{nope\n")
+    r = subprocess.run([sys.executable, cli, str(bad)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "not JSONL" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_serving_summary_reconstructs_preempt_and_failover(tmp_path):
+    """Acceptance: a seeded run with the request log enabled — one
+    workload preempted under an over-subscribed arena, one failed over
+    after a replica death — reconstructs full phase timelines via
+    tools/serving_summary.py: the summary table carries PREEMPT and
+    FAILOVER annotations, --request-id prints the phase-by-phase
+    timeline (queued -> admitted -> prefill -> preempted -> swapped_in
+    -> decode -> finished), and failover chains merge into ONE
+    request row."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+    from paddle_tpu.observability.request_log import (
+        RequestLog, install_request_log, uninstall_request_log)
+    from paddle_tpu.server import Router, SLOConfig
+    from paddle_tpu.serving import (FaultPlan, ServingConfig,
+                                    ServingEngine)
+
+    cfg = GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                    max_pos=64, dropout=0.0, attn_impl="xla")
+    main_prog, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+
+    log = install_request_log(RequestLog(log_dir=str(tmp_path)))
+    try:
+        # part 1 (seeded): an over-subscribed arena forces preemption
+        eng = ServingEngine(params, cfg, ServingConfig(
+            num_slots=3, max_queue=16, prefill_buckets=(4, 8),
+            max_len=24, block_size=4, kv_blocks=10, preempt=True))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (6,))
+                   .astype(np.int32) for _ in range(3)]
+        outs = eng.generate(prompts, max_new_tokens=12,
+                            temperature=0.5, seed=7)
+        assert eng.stats()["preemptions"] >= 1
+        assert all(len(o) == 18 for o in outs)
+        eng.close()
+        # part 2: a replica that dies at step 0 fails its stream over
+        faulty = ServingEngine(params, cfg, ServingConfig(
+            num_slots=2, prefill_buckets=(4, 8), max_len=32,
+            fault_plan=FaultPlan(step_exceptions={0})))
+        healthy = ServingEngine(params, cfg, ServingConfig(
+            num_slots=2, prefill_buckets=(4, 8), max_len=32))
+        router = Router([faulty, healthy],
+                        default_slo=SLOConfig(e2e_s=120.0))
+        router.start()
+        h = router.submit(np.asarray([3, 1, 4], np.int32), 6)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "length" and h.retries == 1
+        failover_root = None
+        for e in log.recent():
+            if e["kind"] == "failover":
+                failover_root = e["request_id"]
+        assert failover_root is not None
+        router.close(drain=False)
+    finally:
+        uninstall_request_log()
+
+    log_path = str(tmp_path / "serving.jsonl")
+    cli = os.path.join(REPO, "tools/serving_summary.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, cli, log_path],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    assert "PREEMPT" in r.stdout and "FAILOVER" in r.stdout
+    assert "1 preempted" in r.stdout or "preempted" in r.stdout
+    # JSON mode: the preempted request's row carries its phase cuts and
+    # the failover chain merged into one row (original id as root)
+    r = subprocess.run([sys.executable, cli, log_path, "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    rows = {row["request_id"]: row for row in json.loads(r.stdout)}
+    pre = next(row for row in rows.values()
+               if "PREEMPT" in row["annotations"])
+    assert pre["reason"] == "length" and pre["tokens"] == 12
+    assert pre["queue_ms"] is not None and pre["total_ms"] > 0
+    assert pre["dispatches"] >= 1 and pre["preemptions"] >= 1
+    fo = rows[failover_root]
+    assert "FAILOVER" in fo["annotations"]
+    assert len(fo["chain"]) == 2               # stranded id + retried id
+    assert fo["tokens"] == 6
+    # --request-id: the full phase timeline, preemption inline
+    r = subprocess.run([sys.executable, cli, log_path,
+                        "--request-id", pre["request_id"]],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    order = [line.split()[3] for line in r.stdout.splitlines()
+             if line.strip().startswith("+")]
+    for a, b in (("queued", "admitted"), ("admitted", "prefill"),
+                 ("prefill", "preempted"), ("preempted", "swapped_in"),
+                 ("swapped_in", "finished")):
+        assert order.index(a) < order.index(b), (a, b, order)
+
+    # degradation: absent / empty / non-JSONL exit 2 with remediation
+    # (the shared summary_io convention)
+    r = subprocess.run([sys.executable, cli,
+                        str(tmp_path / "nope.jsonl")],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "cannot read" in r.stderr
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    r = subprocess.run([sys.executable, cli, str(empty)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "install_request_log" in r.stderr
     bad = tmp_path / "bad.jsonl"
     bad.write_text("{nope\n")
     r = subprocess.run([sys.executable, cli, str(bad)],

@@ -34,6 +34,15 @@ blocks), and `tokens_per_s_per_gb` — throughput normalized by the
 arena's HBM footprint, the capacity-efficiency number the paged pool
 exists to raise.
 
+Dispatch-split columns (library + http rows; engines run with
+`dispatch_timing=True`): `host_overhead_ms` — mean launch-side host ms
+per fused decode dispatch from the serving_dispatch_host_seconds
+histogram, the pinned baseline the native continuous-batching core is
+judged against — and `device_ms_per_dispatch` next to it. The `--http`
+rows additionally run under a generous default SLO and report
+registry-sourced `slo_attainment` (server_slo_{met,missed}_total) and
+`goodput_tokens_per_s` (server_goodput_tokens_total / wall time).
+
 `--shared-prefix` runs the prefix-sharing workload instead: N requests
 over ONE long system prompt (short unique tails), once with the hashed
 prefix cache disabled (the cold baseline) and once enabled — the row
@@ -132,7 +141,8 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                                          max_queue=requests_per_level,
                                          prefill_buckets=buckets,
                                          max_len=max_len,
-                                         decode_chunk=chunk))
+                                         decode_chunk=chunk,
+                                         dispatch_timing=True))
             prompts = [rng.randint(0, cfg.vocab_size,
                                    (prompt_lens[i % len(prompt_lens)],)
                                    ).astype(np.int32)
@@ -149,8 +159,14 @@ def run_model(name, concurrencies=None, requests_per_level=None,
             # fused decode chunk)
             eng.generate([np.ones((b,), np.int32) for b in buckets],
                          max_new_tokens=2)
-            eng.metrics.unregister()   # retire the warmup series' label
-            eng.metrics = pt.serving.EngineMetrics()   # drop warmup rows
+            old = eng.metrics
+            old.unregister()           # retire the warmup series' label
+            # drop the warmup rows, keeping the engine's own series
+            # layout (bucket scaling + the dispatch-split histograms)
+            eng.metrics = pt.serving.EngineMetrics(
+                max_tokens_per_dispatch=old.max_tokens_per_dispatch,
+                speculate_k=old.speculate_k,
+                dispatch_timing=old.dispatch_timing)
             # the allocator's cumulative cache counters feed the new
             # series on the next step: drop the warmup's contribution
             eng.kv.prefix_hits = eng.kv.prefix_misses = 0
@@ -213,6 +229,15 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                     "prefix_hit_rate": hit_rate,
                     "tokens_per_s_per_gb": round(
                         (tokens / dt) / (s["pool_bytes"] / 2 ** 30), 2),
+                    # host/device dispatch split (registry-sourced, the
+                    # serving_dispatch_*_seconds histograms): mean
+                    # launch-side host ms per fused dispatch — the
+                    # pinned baseline native-core work is judged
+                    # against — and the blocking device wait next to it
+                    "host_overhead_ms": _registry_hist_ms(
+                        label, "serving_dispatch_host_seconds"),
+                    "device_ms_per_dispatch": _registry_hist_ms(
+                        label, "serving_dispatch_device_seconds"),
                     **quantiles,
                 },
             })
@@ -622,7 +647,8 @@ def run_http(name, concurrencies=None, requests_per_level=None,
                                                    16),
                                      prefill_buckets=buckets,
                                      max_len=max_len,
-                                     decode_chunk=decode_chunk))
+                                     decode_chunk=decode_chunk,
+                                     dispatch_timing=True))
         prompts = [rng.randint(0, cfg.vocab_size,
                                (prompt_lens[i % len(prompt_lens)],)
                                ).astype(np.int32)
@@ -631,10 +657,20 @@ def run_http(name, concurrencies=None, requests_per_level=None,
         # owns the engine, then drop the warmup's registry rows
         eng.generate([np.ones((b,), np.int32) for b in buckets],
                      max_new_tokens=2)
-        eng.metrics.unregister()
-        eng.metrics = pt.serving.EngineMetrics()
+        old = eng.metrics
+        old.unregister()
+        eng.metrics = pt.serving.EngineMetrics(
+            max_tokens_per_dispatch=old.max_tokens_per_dispatch,
+            speculate_k=old.speculate_k,
+            dispatch_timing=old.dispatch_timing)
         eng.kv.prefix_hits = eng.kv.prefix_misses = 0
-        server = GenerationServer([eng], ServerConfig())
+        # generous default SLOs: the slo_attainment / goodput columns
+        # are registry-sourced numbers a healthy run meets, so misses
+        # on the row mean the service really degraded
+        from paddle_tpu.server import SLOConfig
+        server = GenerationServer([eng], ServerConfig(
+            default_slo=SLOConfig(ttft_s=30.0, tpot_s=1.0,
+                                  e2e_s=120.0)))
         port = server.serve()
         work = list(enumerate(prompts))
         results, lock = [], threading.Lock()
@@ -700,11 +736,43 @@ def run_http(name, concurrencies=None, requests_per_level=None,
                 "compiled_executables": s["compiled_executables"],
                 "server_requests_ok": _server_requests(
                     server.router.metrics.label, "200"),
+                # SLO/goodput plane (registry-sourced, the router-
+                # scored server_slo_* / server_goodput_* series) +
+                # the host/device dispatch split
+                "host_overhead_ms": _registry_hist_ms(
+                    label, "serving_dispatch_host_seconds"),
+                "slo_attainment": _registry_slo_attainment(
+                    server.router.metrics.label),
+                "goodput_tokens_per_s": round(
+                    _registry_router_counter(
+                        server.router.metrics.label,
+                        "server_goodput_tokens_total") / dt, 2)
+                    if dt else None,
                 **quantiles,
             },
         })
         server.shutdown()      # drain + refcounted engine close()
     return rows
+
+
+def _registry_router_counter(router_label, family):
+    """One router-labeled counter family summed over its tenant (and
+    objective) splits — the scrape-path read behind the SLO columns."""
+    from paddle_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+    return sum(int(row["value"])
+               for row in snap.get(family, {}).get("series", [])
+               if row["labels"].get("router") == router_label)
+
+
+def _registry_slo_attainment(router_label):
+    """met / (met + missed) across every tenant and objective this
+    router scored; None before any stream closed under an SLO."""
+    met = _registry_router_counter(router_label, "server_slo_met_total")
+    missed = _registry_router_counter(router_label,
+                                      "server_slo_missed_total")
+    return round(met / (met + missed), 4) if met + missed else None
 
 
 def _server_requests(router_label, code):

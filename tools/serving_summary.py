@@ -1,0 +1,257 @@
+"""Render a RequestLog JSONL into per-request phase timelines.
+
+The serving-side analog of tools/train_summary.py: the reference's
+profiler + timeline tooling answered "what did this run do" per op;
+this CLI answers it per REQUEST from the serving lifecycle event log
+(observability/request_log.RequestLog) — one row per request with its
+phase durations (queue wait, prefill, decode), dispatch count, finish
+reason, and incident annotations; `--request-id` prints one request's
+full event-by-event timeline.
+
+Failover chains are stitched: a replica death re-submits a stranded
+stream under a NEW engine-minted request id, and the router journals
+the link (``routed{rerouted_from=}``) — the summary merges the chain
+into one timeline keyed by the ORIGINAL id.
+
+Usage:
+  python tools/serving_summary.py LOG.jsonl [--last N] [--json]
+      [--request-id ID]
+
+Annotations:
+  PREEMPT    the sequence was host-swapped out under page pressure
+             (and later resumed)
+  FAILOVER   the stream was re-submitted after a replica failure
+  SLO-MISS   the stream closed outside one of its tenant's SLO
+             objectives (named in parentheses)
+  SHED       rejected at the engine admission door
+  CANCELLED / DEADLINE  terminal reasons worth flagging
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_TOOLS, ".."))
+sys.path.insert(0, _TOOLS)
+
+from summary_io import (SummaryInputError, load_jsonl_records,  # noqa: E402
+                        report_error)
+
+EMPTY_HINT = ("no request events were written there. Install a "
+              "RequestLog with a log_dir (observability."
+              "install_request_log(RequestLog(log_dir=...))) before "
+              "serving traffic, then re-run.")
+
+# terminal reasons a timeline ends on, in stream_closed/finished order
+_PHASE_EVENTS = ("submitted", "queued", "routed", "admitted", "prefill",
+                 "decode", "preempted", "swapped_in", "failover",
+                 "finished", "cancelled", "shed", "stream_closed")
+
+
+def load_events(path: str):
+    return load_jsonl_records(path, empty_hint=EMPTY_HINT,
+                              what="RequestLog")
+
+
+def _chains(events):
+    """Group events by request id and stitch failover chains: a
+    ``routed`` event carrying ``rerouted_from`` merges the new id's
+    events into the ORIGINAL id's timeline. Link resolution is a first
+    pass (union-find) because the retried submission's engine-level
+    events land in the file BEFORE the router journals the link.
+    Returns [(root id, chain ids in order, [events])] in file order."""
+    parent = {}
+
+    def find(x):
+        while parent.get(x, x) != x:
+            x = parent[x]
+        return x
+
+    for rec in events:
+        rid, old = rec.get("request_id"), rec.get("rerouted_from")
+        if rid is not None and old is not None:
+            parent[find(rid)] = find(old)
+    groups, chains, order = {}, {}, []
+    for rec in events:
+        rid = rec.get("request_id")
+        if rid is None:
+            continue
+        root = find(rid)
+        if root not in groups:
+            groups[root], chains[root] = [], []
+            order.append(root)
+        if rid not in chains[root]:
+            chains[root].append(rid)
+        groups[root].append(rec)
+    return [(root, chains[root], groups[root]) for root in order]
+
+
+def _ms(a, b):
+    if a is None or b is None:
+        return None
+    return (b - a) * 1e3
+
+
+def summarize(events):
+    """One summary row per request chain: phase durations, dispatch
+    count, finish reason, annotations."""
+    rows = []
+    for root, chain, evs in _chains(events):
+        evs = sorted(evs, key=lambda r: r.get("t_mono", 0))
+        first = {}
+        for rec in evs:
+            first.setdefault(rec["kind"], rec)
+        kinds = [rec["kind"] for rec in evs]
+        t0 = evs[0].get("t_mono")
+        terminal = next((rec for rec in reversed(evs)
+                         if rec["kind"] in ("stream_closed", "finished",
+                                            "cancelled", "shed")), None)
+        closed = next((rec for rec in reversed(evs)
+                       if rec["kind"] == "stream_closed"), None)
+        reason = None
+        if closed is not None:
+            reason = closed.get("reason")
+        elif terminal is not None:
+            reason = {"finished": first.get("finished", {})
+                      .get("finish_reason"),
+                      "cancelled": "cancelled",
+                      "shed": "shed"}.get(terminal["kind"])
+        decode_evs = [rec for rec in evs if rec["kind"] == "decode"]
+        t_admit = first.get("admitted", {}).get("t_mono")
+        t_prefill = first.get("prefill", {}).get("t_mono")
+        t_end = terminal.get("t_mono") if terminal is not None else None
+        tokens = None
+        for rec in (closed, first.get("finished")):
+            if rec is not None and rec.get("tokens") is not None:
+                tokens = rec["tokens"]
+                break
+        if tokens is None and decode_evs:
+            tokens = sum(rec.get("tokens") or 0 for rec in decode_evs)
+        notes = []
+        if "preempted" in kinds:
+            notes.append("PREEMPT")
+        if "failover" in kinds or len(chain) > 1:
+            notes.append("FAILOVER")
+        missed = (closed or {}).get("slo_missed") or []
+        if missed:
+            notes.append(f"SLO-MISS({','.join(missed)})")
+        if "shed" in kinds:
+            notes.append("SHED")
+        if reason == "cancelled":
+            notes.append("CANCELLED")
+        if reason == "deadline_exceeded":
+            notes.append("DEADLINE")
+        rows.append({
+            "request_id": root,
+            "chain": chain,
+            "tenant": ((first.get("routed") or closed or {})
+                       .get("tenant")),
+            "reason": reason,
+            "tokens": tokens,
+            "queue_ms": _ms(t0, t_admit),
+            "prefill_ms": _ms(t_admit, t_prefill),
+            "decode_ms": _ms(t_prefill, t_end),
+            "total_ms": _ms(t0, t_end),
+            "dispatches": len(decode_evs),
+            "preemptions": kinds.count("preempted"),
+            "annotations": notes,
+            "events": [{"kind": rec["kind"],
+                        "t_ms": _ms(t0, rec.get("t_mono")),
+                        "request_id": rec.get("request_id")}
+                       for rec in evs],
+        })
+    return rows
+
+
+def _fmt(v, spec="{:.2f}"):
+    return "-" if v is None else spec.format(v)
+
+
+def _print_timeline(row, events):
+    """--request-id mode: the chain's full event-by-event timeline with
+    +delta-ms offsets and the interesting fields inline."""
+    print(f"request {row['request_id']}"
+          + (f"  (chain: {' -> '.join(row['chain'])})"
+             if len(row["chain"]) > 1 else ""))
+    print(f"tenant={row['tenant'] or '-'}  reason={row['reason'] or '-'}"
+          f"  tokens={row['tokens'] if row['tokens'] is not None else '-'}"
+          f"  {' '.join(row['annotations'])}")
+    chain = set(row["chain"])
+    evs = sorted((rec for rec in events
+                  if rec.get("request_id") in chain),
+                 key=lambda r: r.get("t_mono", 0))
+    t0 = evs[0].get("t_mono") if evs else None
+    for rec in evs:
+        extras = {k: v for k, v in rec.items()
+                  if k not in ("kind", "ts", "t_mono", "request_id")
+                  and v is not None}
+        detail = "  ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+        off = _ms(t0, rec.get("t_mono"))
+        print(f"  +{_fmt(off, '{:9.2f}')} ms  "
+              f"{rec['kind']:<13} {detail}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log", help="RequestLog JSONL path")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only the last N requests (default: all)")
+    ap.add_argument("--request-id", default=None, metavar="ID",
+                    help="print one request's full event timeline "
+                         "(matches any id in a failover chain)")
+    ap.add_argument("--json", action="store_true",
+                    help="print summary rows as one JSON array")
+    args = ap.parse_args(argv)
+
+    try:
+        events = load_events(args.log)
+        rows = summarize(events)
+    except SummaryInputError as e:
+        return report_error("serving_summary", e)
+    if args.request_id is not None:
+        row = next((r for r in rows
+                    if args.request_id in r["chain"]), None)
+        if row is None:
+            print(f"serving_summary: no events for request "
+                  f"{args.request_id!r} in {args.log!r}",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(row, indent=2, default=str))
+        else:
+            _print_timeline(row, events)
+        return 0
+    if args.last > 0:
+        rows = rows[-args.last:]
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print("no request records in event log")
+        return 0
+    rid_w = max(7, max(len(r["request_id"]) for r in rows))
+    print(f"{'request':<{rid_w}}  {'tenant':<8}  {'reason':<16}  "
+          f"{'tok':>5}  {'queue_ms':>9}  {'decode_ms':>10}  "
+          f"{'total_ms':>9}  {'disp':>4}  annotations")
+    for r in rows:
+        print(f"{r['request_id']:<{rid_w}}  "
+              f"{(r['tenant'] or '-'):<8}  "
+              f"{(r['reason'] or '-'):<16}  "
+              f"{r['tokens'] if r['tokens'] is not None else '-':>5}  "
+              f"{_fmt(r['queue_ms']):>9}  {_fmt(r['decode_ms']):>10}  "
+              f"{_fmt(r['total_ms']):>9}  {r['dispatches']:>4}  "
+              f"{' '.join(r['annotations'])}")
+    n_pre = sum(1 for r in rows if "PREEMPT" in r["annotations"])
+    n_fo = sum(1 for r in rows if "FAILOVER" in r["annotations"])
+    n_miss = sum(1 for r in rows
+                 if any(a.startswith("SLO-MISS") for a in
+                        r["annotations"]))
+    print(f"-- {len(rows)} requests, {n_pre} preempted, "
+          f"{n_fo} failed over, {n_miss} SLO miss(es)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Shared input loading + exit-2 convention for the summary CLIs.
+
+tools/trace_summary.py, tools/train_summary.py, and
+tools/serving_summary.py all render an observability artifact (a chrome
+trace, a StepLogger JSONL, a RequestLog JSONL) and all degrade the same
+way: a missing, empty, or unparsable input exits with status 2 and a
+remediation hint on stderr — never a traceback. This module is that
+convention, extracted once:
+
+* `SummaryInputError` — the one exception class every loader raises
+  (each CLI catches it, prints ``<tool>: <message>``, returns 2).
+* `read_input(path, empty_hint)` — read a text file; "cannot read" on
+  OSError, "<path> is empty — <hint>" on whitespace-only content.
+* `load_jsonl_records(path, empty_hint, what)` — the JSONL event-log
+  form both loggers write: one JSON object per line, line-numbered
+  parse errors.
+* `report_error(tool, err)` — the stderr line + exit status.
+"""
+
+import json
+import sys
+
+__all__ = ["SummaryInputError", "read_input", "load_jsonl_records",
+           "report_error"]
+
+
+class SummaryInputError(Exception):
+    """Unreadable/unparsable summary input (reported, never a
+    traceback)."""
+
+
+def read_input(path: str, empty_hint: str) -> str:
+    """The file's text. Raises SummaryInputError for a missing or
+    unreadable path ("cannot read ...") and for an empty file — with
+    `empty_hint` telling the operator how the artifact gets written in
+    the first place."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        raise SummaryInputError(
+            f"cannot read {path!r}: {e.strerror or e}")
+    if not raw.strip():
+        raise SummaryInputError(f"{path!r} is empty — {empty_hint}")
+    return raw
+
+
+def load_jsonl_records(path: str, empty_hint: str,
+                       what: str = "event"):
+    """Parse a JSONL event log into a list of dicts (blank lines
+    skipped). Raises SummaryInputError with the line number for
+    non-JSON lines and for lines that aren't objects."""
+    raw = read_input(path, empty_hint)
+    records = []
+    for lineno, line in enumerate(raw.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise SummaryInputError(
+                f"{path!r} is not JSONL (line {lineno}: {e.msg}). "
+                f"Expected one {what} JSON record per line.")
+        if not isinstance(rec, dict):
+            raise SummaryInputError(
+                f"{path!r} line {lineno} is a {type(rec).__name__}, "
+                "expected a JSON object per line")
+        records.append(rec)
+    return records
+
+
+def report_error(tool: str, err: Exception) -> int:
+    """The exit-2-with-remediation convention: one stderr line, status
+    2 back to the caller's `return`."""
+    print(f"{tool}: {err}", file=sys.stderr)
+    return 2

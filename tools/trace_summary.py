@@ -19,29 +19,31 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".."))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_TOOLS, ".."))
+sys.path.insert(0, _TOOLS)
+
+from summary_io import (SummaryInputError, read_input,  # noqa: E402
+                        report_error)
 
 
-class TraceError(Exception):
-    """Unreadable/unparsable trace input (reported, never a traceback)."""
+# kept as an alias of SummaryInputError (not a subclass) so existing
+# callers' `except TraceError` still catches the missing/empty-file
+# errors that summary_io.read_input now raises
+TraceError = SummaryInputError
 
 
 def load_events(path: str):
     """Chrome trace JSON: the object form {"traceEvents": [...]} or the
-    bare event-array form. Raises TraceError (with a remediation hint)
-    for a missing, empty, or non-JSON file — an operator pointing the
-    CLI at the wrong path gets a message, not a stack trace."""
-    try:
-        with open(path) as f:
-            raw = f.read()
-    except OSError as e:
-        raise TraceError(f"cannot read {path!r}: {e.strerror or e}")
-    if not raw.strip():
-        raise TraceError(
-            f"{path!r} is empty — no trace was written there. Enable "
-            "tracing before the traced run (observability."
-            "enable_tracing()) and export with export_chrome_trace().")
+    bare event-array form. Raises TraceError/SummaryInputError (with a
+    remediation hint) for a missing, empty, or non-JSON file — an
+    operator pointing the CLI at the wrong path gets a message, not a
+    stack trace."""
+    raw = read_input(
+        path,
+        empty_hint="no trace was written there. Enable tracing before "
+        "the traced run (observability.enable_tracing()) and export "
+        "with export_chrome_trace().")
     try:
         data = json.loads(raw)
     except json.JSONDecodeError as e:
@@ -76,9 +78,8 @@ def main(argv=None):
 
     try:
         rows = summarize_file(args.trace, top=args.top)
-    except TraceError as e:
-        print(f"trace_summary: {e}", file=sys.stderr)
-        return 2
+    except SummaryInputError as e:
+        return report_error("trace_summary", e)
     if args.json:
         print(json.dumps(rows, indent=2))
         return 0

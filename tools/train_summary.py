@@ -25,47 +25,31 @@ import os
 import statistics
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".."))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_TOOLS, ".."))
+sys.path.insert(0, _TOOLS)
+
+from summary_io import (SummaryInputError, load_jsonl_records,  # noqa: E402
+                        report_error)
 
 SPIKE_WINDOW = 8
 
-
-class TrainLogError(Exception):
-    """Unreadable/unparsable run log (reported, never a traceback)."""
+# kept as a SummaryInputError subclass so existing callers' except
+# clauses keep working; the shared loader raises the base class
+TrainLogError = SummaryInputError
 
 
 def load_records(path: str):
     """Parse a StepLogger JSONL file into a list of dicts. Raises
     TrainLogError (with a remediation hint) for a missing, empty, or
     non-JSONL file."""
-    try:
-        with open(path) as f:
-            raw = f.read()
-    except OSError as e:
-        raise TrainLogError(f"cannot read {path!r}: {e.strerror or e}")
-    if not raw.strip():
-        raise TrainLogError(
-            f"{path!r} is empty — no telemetry was written there. "
-            "Install a StepLogger with a log_dir (observability."
-            "install_step_logger(StepLogger(log_dir=...))) BEFORE "
-            "building the training program, then train.")
-    records = []
-    for lineno, line in enumerate(raw.splitlines(), 1):
-        if not line.strip():
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError as e:
-            raise TrainLogError(
-                f"{path!r} is not JSONL (line {lineno}: {e.msg}). "
-                "Expected one StepLogger JSON record per line.")
-        if not isinstance(rec, dict):
-            raise TrainLogError(
-                f"{path!r} line {lineno} is a {type(rec).__name__}, "
-                "expected a JSON object per line")
-        records.append(rec)
-    return records
+    return load_jsonl_records(
+        path,
+        empty_hint="no telemetry was written there. Install a "
+        "StepLogger with a log_dir (observability."
+        "install_step_logger(StepLogger(log_dir=...))) BEFORE "
+        "building the training program, then train.",
+        what="StepLogger")
 
 
 def annotate(records, spike_factor: float = 2.0):
@@ -134,9 +118,8 @@ def main(argv=None):
 
     try:
         rows = annotate(load_records(args.run), args.spike_factor)
-    except TrainLogError as e:
-        print(f"train_summary: {e}", file=sys.stderr)
-        return 2
+    except SummaryInputError as e:
+        return report_error("train_summary", e)
     if args.last > 0:
         rows = rows[-args.last:]
     if args.json:
