@@ -183,6 +183,19 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
             ("host_share",
              ratio("host_s_total",
                    ("host_s_total", "device_s_total")))]),
+        # cross-replica migration: completed hand-offs by router,
+        # failure incidents, and the mean end-to-end handoff latency
+        # (order created -> sequence adopted on the target). Families
+        # exist only once a migration ran — rebalancer off = no rows.
+        "migration": registry_rollup(snap, {
+            "migrations": "server_migrations_total",
+            "migration_failures": "server_migration_failures_total",
+            "count": ("serving_migration_seconds", "count", int),
+            "seconds_total": ("serving_migration_seconds", "sum",
+                              float),
+        }, label_key="router", derived=[
+            ("migration_ms",
+             ratio("seconds_total", "count", digits=3, scale=1e3))]),
         # per-tenant SLO attainment + goodput (router-scored; /slozv
         # carries the per-objective breakdown, this is the scrape-path
         # summary)

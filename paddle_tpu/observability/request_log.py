@@ -17,6 +17,14 @@ moves through:
                                            tokens)
     preempted -> swapped_in               (host-swap under page pressure)
     failover -> routed{rerouted_from=}    (replica death re-submission)
+    migrate_out -> migrate_in{rerouted_from=}
+                                          (live cross-replica migration:
+                                           source/target replica labels,
+                                           payload bytes, phase; the
+                                           adopting engine mints a new
+                                           id and rerouted_from chains
+                                           the hop exactly like a
+                                           failover re-submission)
     finished | cancelled | stream_closed  (terminal, with finish_reason)
 
 Every record carries a wall stamp (`ts`), a monotonic stamp (`t_mono`,

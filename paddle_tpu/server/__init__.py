@@ -34,11 +34,11 @@ Quick start:
 """
 
 from .router import (DrainingError, QuotaConfig, QuotaExceededError,
-                     Router, RouterMetrics, SLOConfig, StreamHandle,
-                     TokenBucket)
+                     RebalanceConfig, Router, RouterMetrics, SLOConfig,
+                     StreamHandle, TokenBucket)
 from .service import GenerationServer, ServerConfig, serve
 
 __all__ = ["GenerationServer", "ServerConfig", "serve", "Router",
            "StreamHandle", "TokenBucket", "QuotaConfig",
            "QuotaExceededError", "DrainingError", "RouterMetrics",
-           "SLOConfig"]
+           "SLOConfig", "RebalanceConfig"]

@@ -41,6 +41,34 @@ vs `server_slo_tokens_total` + the `server_goodput_ratio` gauge), and
 `Router.slo_report()` — the `/slozv` payload aggregating cross-replica
 attainment per tenant. With no SLOConfig set, none of those series
 exist.
+
+CROSS-REPLICA MIGRATION (this PR): `SwappedSequence` generalized into
+an engine-independent `MigrationTicket` lets the router REBALANCE live
+sequences instead of only failing over dead ones. One migration flows
+
+    order (rebalancer / restart drain / Router.migrate())
+      -> source driver: pipeline fence -> migrate_out -> ticket
+         (the stream handle detaches; the client's SSE connection
+          stays open — its event queue simply pauses)
+      -> transfer: router picks a compatible healthy target
+      -> target driver: migrate_in -> strict-priority resume (the
+         PR 10 swap-in rule) -> handle re-attaches, tokens continue
+         BIT-IDENTICALLY (the ticket's PRNG key row continues the
+         per-token split chain)
+
+Every phase is exactly-once under injected faults (FaultPlan migration
+phases): an extract fault leaves the sequence running on the source, a
+transfer/adopt fault re-adopts it at home or re-places the ticket, and
+exhausted recovery falls back to PR 10 failover semantics — with the
+tenant's quota refunded EXACTLY ONCE when the migration plane kills a
+stream its ticket had already detached. The rebalancer thread
+(`RebalanceConfig`) orders migrations on sustained pressure imbalance
+(block/queue/swap gauges, with hysteresis and a fleet-wide concurrency
+cap) and on fresh tenant SLO misses; `restart_replica()` drains ONE
+replica by migrating its queued and running sequences to peers, then
+rebuilds it via the engine factory — a zero-downtime rolling restart.
+With `rebalance=None` and no migrate/restart calls, none of the
+migration machinery runs and no migration registry families exist.
 """
 
 from __future__ import annotations
@@ -59,10 +87,11 @@ from ..observability import request_log as _request_log
 from ..observability import watchdog as _watchdog
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..serving.engine import EngineOverloadError, ServingEngine
+from ..serving.migration import MigrationError
 
 __all__ = ["Router", "StreamHandle", "TokenBucket", "QuotaConfig",
            "QuotaExceededError", "DrainingError", "RouterMetrics",
-           "SLOConfig"]
+           "SLOConfig", "RebalanceConfig"]
 
 
 class QuotaExceededError(RuntimeError):
@@ -140,6 +169,83 @@ class SLOConfig:
                                         ("tpot", self.tpot_s),
                                         ("e2e", self.e2e_s))
                 if v is not None}
+
+
+class RebalanceConfig:
+    """Pressure-driven cross-replica rebalancing knobs. With no
+    RebalanceConfig on the router (the default), the rebalancer does
+    not exist: no thread, no migration registry families — behavior
+    bit-identical to a router without the migration plane.
+
+    * ``interval_s`` — rebalancer poll period.
+    * ``pressure_gap`` — minimum (hot − cold) pressure-score gap that
+      counts as imbalance. A replica's score is
+      blocks_used/blocks_total + queue_depth/max_queue +
+      swapped_slots/num_slots, each term clamped to [0, 1] (score
+      spans 0..3), read from the live EngineMetrics gauges.
+    * ``hysteresis`` — consecutive polls the gap must persist before a
+      migration is ordered; the streak resets after every order, so a
+      one-poll spike never moves a sequence and rebalancing cannot
+      thrash.
+    * ``max_concurrent`` — fleet-wide cap on simultaneously in-flight
+      migrations; imbalance beyond it waits for the next poll.
+    * ``slo_pressure`` — when True, a tenant SLO objective missed
+      since the last poll (scored by the PR 11 SLO plane) triggers a
+      migration off the hottest replica immediately, reason="slo",
+      even below ``pressure_gap`` — provided the hot replica actually
+      has queued work to relieve."""
+
+    def __init__(self, interval_s: float = 0.05,
+                 pressure_gap: float = 0.75, hysteresis: int = 3,
+                 max_concurrent: int = 1, slo_pressure: bool = True):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        if pressure_gap <= 0:
+            raise ValueError(
+                f"pressure_gap must be > 0, got {pressure_gap}")
+        if hysteresis < 1:
+            raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
+        if max_concurrent < 1:
+            raise ValueError(
+                f"max_concurrent must be >= 1, got {max_concurrent}")
+        self.interval_s = float(interval_s)
+        self.pressure_gap = float(pressure_gap)
+        self.hysteresis = int(hysteresis)
+        self.max_concurrent = int(max_concurrent)
+        self.slo_pressure = bool(slo_pressure)
+
+
+class _MigrationOrder:
+    """One sequence hand-off in flight between replicas. Created by
+    the router (rebalancer, restart drain, or the manual ``migrate()``
+    API), executed on the SOURCE replica's driver thread (pipeline
+    fence + ticket extraction) and then the TARGET's driver (adoption)
+    — scheduler state is only ever touched by its owning driver. The
+    order owns the stream handle between the source's ``forget`` and
+    the target's ``watch``, so a failure sweep on either side cannot
+    double-disposition it. ``done``/``outcome`` report the terminal
+    disposition: "migrated", "readopted" (recovered back onto the
+    source), "aborted:*" (clean refusal, sequence untouched), or
+    "failed:*" (failover semantics applied)."""
+
+    def __init__(self, router: "Router", source: "Replica",
+                 target: Optional["Replica"], reason: str,
+                 handle: Optional["StreamHandle"] = None):
+        self.router = router
+        self.source = source
+        self.target = target
+        self.reason = reason
+        self.handle = handle
+        self.ticket = None
+        self.attempts = 0              # adoption attempts so far
+        self.t0 = router._clock()
+        self.outcome: Optional[str] = None
+        self.done = threading.Event()
+
+    def finish(self, outcome: str) -> None:
+        self.outcome = outcome
+        self.router._migration_done(self)
+        self.done.set()
 
 
 class TokenBucket:
@@ -220,6 +326,12 @@ class StreamHandle:
         self.submit_kw: dict = {}
         self.emitted = 0                    # tokens streamed so far
         self.retries = 0                    # failover re-submissions
+        # migration bookkeeping: the engine-minted ids this stream has
+        # worn (the ticket's rerouted_from chain), and whether a failed
+        # migration already refunded the tenant's quota — the refund is
+        # exactly-once however many failure paths observe the corpse
+        self.rid_history: List[str] = []
+        self.quota_refunded = False
         # client-observed SLO cuts (router clock): unlike the engine's
         # RequestMetrics — which a failover RESETS (the retried request
         # re-marks submission) — these span every attempt plus the
@@ -325,6 +437,14 @@ class Replica:
         self.failures = 0                  # consecutive failed rebuilds
         self.failures_total = 0
         self.restarts_total = 0
+        # cross-replica migration: completed hand-offs this replica
+        # sourced / adopted (host mirrors for /healthz), the order
+        # inboxes its driver serves, and the planned-restart flag
+        self.migrations_out = 0
+        self.migrations_in = 0
+        self._migrations_out: List["_MigrationOrder"] = []
+        self._migrations_in: List["_MigrationOrder"] = []
+        self._restart = False
         self._handles: set = set()
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -343,10 +463,14 @@ class Replica:
 
     @property
     def busy(self) -> bool:
-        if self.state != "ok":
+        if self.state not in ("ok", "draining"):
             # a broken engine's queues are abandoned state, not work;
-            # counting them busy would wedge drain forever
+            # counting them busy would wedge drain forever (a replica
+            # DRAINING for a planned restart still owns live work)
             return False
+        with self._lock:
+            if self._migrations_out or self._migrations_in:
+                return True
         return bool(self.engine._queue
                     or self.engine.scheduler.active_count
                     or self.engine._pending_cancels
@@ -383,7 +507,11 @@ class Replica:
         it."""
         with self._lock:
             self._handles.add(handle)
-        if self.state == "ok" and self.engine is engine:
+        # "draining" (planned restart) is ALIVE: the engine accepted the
+        # submit and the restart drain will displace/migrate this handle
+        # — returning False here would make the caller re-submit a
+        # duplicate stream next to the one already queued
+        if self.state in ("ok", "draining") and self.engine is engine:
             return True
         with self._lock:
             if handle in self._handles:
@@ -397,10 +525,23 @@ class Replica:
 
     def _drive(self) -> None:
         while not self._stop:
-            if self.state != "ok":
+            if self.state in ("failed", "restarting"):
                 self._rebuild_or_park()
                 continue
+            # migration order inboxes first: an adoption or extraction
+            # waiting behind a long step would stretch the handoff gap
+            # (the client's stream is paused while the ticket travels)
+            self._process_migrations_in()
+            self._process_migrations_out()
             self._expire_deadlines()
+            if self.state == "draining" and self._restart:
+                # only a PLANNED RESTART drains toward a rebuild; a bare
+                # state="draining" (operator cordon, bench admission
+                # hold) just keeps the replica out of _healthy_order
+                # while its work runs out normally
+                self._restart_turn()
+                if self.state != "draining":
+                    continue            # rebuilt (or parked failed)
             if self.busy:
                 try:
                     self.engine.step()
@@ -413,22 +554,281 @@ class Replica:
                 self._work.wait(timeout=0.02)
                 self._work.clear()
 
+    # -- cross-replica migration (driver-thread halves) ----------------------
+
+    def _handle_for(self, req) -> Optional[StreamHandle]:
+        with self._lock:
+            return next((h for h in self._handles
+                         if h.request is req), None)
+
+    def _pick_migratable(self) -> Optional[StreamHandle]:
+        """The sequence this replica would hand off next: a PARKED one
+        first (its swap-pool record is already serialized — the handoff
+        is a pure host-side wrap), else the NEWEST running one (the
+        preemption default: least work in flight, shortest re-wait).
+        Only router-watched streams qualify — a library-submitted
+        request has no handle to re-attach and simply finishes here."""
+        eng = self.engine
+        for sw in eng._swapped:
+            h = self._handle_for(sw.req)
+            if h is not None and h.finish_reason is None:
+                return h
+        running = eng.scheduler._running
+        for slot in sorted(running,
+                           key=lambda s: (running[s].seq, s),
+                           reverse=True):
+            h = self._handle_for(running[slot].req)
+            if h is not None and h.finish_reason is None:
+                return h
+        return None
+
+    def _process_migrations_out(self) -> None:
+        while True:
+            with self._lock:
+                if not self._migrations_out:
+                    return
+                order = self._migrations_out.pop(0)
+            self._migrate_out_one(order)
+
+    def _migrate_out_one(self, order: "_MigrationOrder") -> None:
+        """SOURCE-driver half of one migration: pick/validate the
+        victim, extract the ticket (pipeline fence inside migrate_out —
+        fenced tokens stream to the client normally), run the transfer
+        phase, and deliver to the target's adoption inbox. Every
+        failure leaves the sequence running on the source, re-adopted
+        on the source, or handed to failover — never duplicated, never
+        in limbo."""
+        router = self._router
+        handle = order.handle
+        if handle is None:
+            handle = order.handle = self._pick_migratable()
+        if (handle is None or handle.finish_reason is not None
+                or handle.request is None or handle.replica is not self):
+            order.finish("aborted:no-candidate")
+            return
+        if router._draining or router._closed:
+            # last pre-extraction check on the driver itself: a drain
+            # that began after the order was created must not see a
+            # ticket extracted that no engine will adopt
+            order.finish("aborted:router-draining")
+            return
+        try:
+            ticket = self.engine.migrate_out(handle.request)
+        except MigrationError as e:
+            # clean refusal (draining / finished during the fence /
+            # not migratable): nothing moved, the stream stays here
+            order.finish(f"aborted:{e}")
+            return
+        except Exception:
+            # injected/organic extract fault: migrate_out mutates
+            # nothing before its extract hook fires, so the sequence
+            # is still running here and the stream continues
+            traceback.print_exc()
+            router.metrics.observe_migration_failure("extract")
+            order.finish("failed:extract")
+            return
+        # the order owns the handle from here: a source failure sweep
+        # must not double-disposition a stream whose state just left
+        self.forget(handle)
+        # router-side annotations ride OUTSIDE the ticket checksum
+        ticket.tenant = handle.tenant
+        ticket.rerouted_from = tuple(handle.rid_history)
+        if handle.submitted_t is not None:
+            ticket.slo_stamps = {"submitted_t": handle.submitted_t,
+                                 "first_token_t": handle.first_token_t}
+        handle.rid_history.append(ticket.request_id)
+        order.ticket = ticket
+        try:
+            if self.engine.faults is not None:
+                self.engine.faults.migration_phase("transfer")
+        except Exception:
+            # transfer fault: the sequence is OFF the source — recovery
+            # re-adopts it at home (through this driver's own adoption
+            # inbox) or falls over; either way the request stays
+            # terminal-bound and pages stay balanced
+            traceback.print_exc()
+            router.metrics.observe_migration_failure("transfer")
+            router._route_home_or_failover(order)
+            return
+        router._deliver_ticket(order)
+
+    def _process_migrations_in(self) -> None:
+        while True:
+            with self._lock:
+                if not self._migrations_in:
+                    return
+                order = self._migrations_in.pop(0)
+            self._adopt_one(order)
+
+    def _adopt_one(self, order: "_MigrationOrder") -> None:
+        """TARGET-driver half: adopt the ticket into this engine (an
+        injected adopt fault or a geometry surprise hands the ticket
+        back to the router for re-placement) and re-attach the stream.
+        Runs on the owning driver thread, so the submit/watch failure
+        race `adopt()` closes cannot occur here — a plain watch()
+        suffices, and a concurrent planned-restart flip to "draining"
+        just means the next restart turn migrates the sequence out
+        again."""
+        router = self._router
+        handle = order.handle
+        if handle.finish_reason is not None:
+            order.finish("aborted:terminal")
+            return
+        try:
+            req = self.engine.migrate_in(order.ticket,
+                                         on_token=handle._on_token)
+        except Exception:
+            traceback.print_exc()
+            router.metrics.observe_migration_failure("adopt")
+            order.attempts += 1
+            router._adoption_failed(order, failed_on=self)
+            return
+        # replica before request: cancel() reads request then replica,
+        # so a new request must never pair with the old replica
+        handle.replica = self
+        handle.request = req
+        if handle.finish_reason is not None:
+            # a cancel/deadline won during the handoff gap: reap the
+            # adopted request so it never burns a slot
+            self.engine.cancel(req)
+            self.kick()
+            order.finish("aborted:terminal")
+            return
+        self.watch(handle)
+        self.kick()
+        if self is order.source:
+            # home re-adoption after a transfer/adopt failure: the
+            # sequence recovered in place — not a completed migration
+            order.finish("readopted")
+            return
+        self.migrations_in += 1
+        order.source.migrations_out += 1
+        router.metrics.observe_migration(
+            order.reason, max(0.0, router._clock() - order.t0))
+        order.finish("migrated")
+
+    # -- planned rolling restart (driver-thread half) ------------------------
+
+    def _displace_queued(self) -> None:
+        """Hand every router-watched QUEUED request to a healthy peer
+        (a fresh submit is bit-identical — nothing was emitted). Used
+        only by the restart drain; sequences no peer can take fall back
+        to PR 10 failover semantics inside _reroute."""
+        router = self._router
+        with self.engine._lock:
+            queued = list(self.engine._queue)
+        for req in queued:
+            handle = self._handle_for(req)
+            if handle is None or handle.finish_reason is not None:
+                continue               # library-submitted: finishes here
+            self.engine.cancel(req)    # drops it from the queue only
+            self.forget(handle)
+            router._reroute(handle, exclude=self, count_retry=False)
+
+    def _restart_turn(self) -> None:
+        """One planned-restart drain turn (state == "draining"): hand
+        queued requests to peers (no ticket needed), migrate
+        running/parked sequences out ONE order at a time — the engine
+        keeps stepping between orders, so resident streams keep
+        producing tokens throughout the drain — and rebuild once
+        nothing is left."""
+        router = self._router
+        if router is None:
+            self._planned_rebuild()
+            return
+        if router._draining or router._closed:
+            # a router-wide drain overrides a planned restart: peers
+            # refuse adoptions while draining, so migrating would spin
+            # — finish residents in place instead and skip the rebuild
+            self._restart = False
+            self.state = "ok"
+            return
+        self._displace_queued()
+        with self._lock:
+            if self._migrations_out or self._migrations_in:
+                return                 # an order is already in flight
+        if router._has_orders_involving(self):
+            return
+        handle = self._pick_migratable()
+        if handle is not None:
+            router._order_migration(self, None, "restart", handle=handle)
+            return
+        if not self.busy:
+            with self._lock:
+                leftovers = bool(self._handles)
+            if not leftovers:
+                self._planned_rebuild()
+
+    def _planned_rebuild(self) -> None:
+        """The zero-downtime tail of restart_replica: the engine is
+        empty (every sequence migrated, displaced, or finished) — build
+        the fresh engine via the router's factory (build BEFORE closing
+        the old one: a failed build must not destroy a working engine's
+        registry series for nothing), retire the old engine's series,
+        count the restart, and rejoin admission. With no factory the
+        drained engine itself rejoins — a soft restart."""
+        router = self._router
+        factory = router._engine_factory if router is not None else None
+        dead_label = self.label
+        if factory is not None:
+            try:
+                engine = factory()
+            except Exception:
+                # the planned rebuild failed to build: park FAILED —
+                # the supervisor's backoff path owns it from here
+                traceback.print_exc()
+                self.failures += 1
+                self.failures_total += 1
+                self._restart = False
+                self.state = "failed"
+                return
+            try:
+                self.engine.close()    # retire the drained engine's series
+            except Exception:
+                traceback.print_exc()
+            self.engine = engine
+        # counters BEFORE the state flip (the PR 10 rule): a poller
+        # seeing a healthy replica must never read a stale restart count
+        self.restarts_total += 1
+        if router is not None:
+            router.metrics.observe_replica_restart(dead_label)
+        self._restart = False
+        self.state = "ok"
+
     def _on_failure(self) -> None:
         """Supervisor path, on the driver thread: the engine threw out
         of step(). Its internal state is untrustworthy from here — no
-        further engine calls; stranded work is rerouted or terminated
-        and the loop moves to rebuild/park."""
+        further engine calls; stranded work is rerouted or terminated,
+        in-flight migration orders are dissolved (outbound: the
+        sequence is still in the stranded sweep) or re-placed (inbound
+        tickets stay adoptable elsewhere — replica death mid-migration
+        must not entomb a sequence), and the loop moves to
+        rebuild/park."""
         traceback.print_exc()
         self.state = "failed"
         self.failures += 1
         self.failures_total += 1
+        self._restart = False          # a crash aborts a planned restart
         router = self._router
         with self._lock:
             stranded = list(self._handles)
             self._handles.clear()
+            mig_in = list(self._migrations_in)
+            self._migrations_in.clear()
+            mig_out = list(self._migrations_out)
+            self._migrations_out.clear()
+        for order in mig_out:
+            # not yet extracted: the sequence (and its handle) is still
+            # in the stranded sweep below — the order just dissolves
+            order.finish("aborted:source-failed")
         if router is not None:
+            for order in mig_in:
+                order.attempts += 1
+                router._adoption_failed(order, failed_on=self)
             router._replica_failed(self, stranded)
         else:
+            for order in mig_in:
+                order.finish("failed:target-failed")
             for h in stranded:
                 h._finish("replica_failed")
 
@@ -568,6 +968,12 @@ class RouterMetrics:
         with self._dyn_lock:
             self._dynamic.add((fam, tuple(sorted(labels.items()))))
 
+    def _observe(self, fam, value: float, **labels) -> None:
+        labels["router"] = self.label
+        fam.labels(**labels).observe(value)
+        with self._dyn_lock:
+            self._dynamic.add((fam, tuple(sorted(labels.items()))))
+
     def observe_request(self, tenant: str, code: int) -> None:
         self._inc(self._requests, tenant=tenant, code=str(code))
 
@@ -590,6 +996,42 @@ class RouterMetrics:
         with self._dyn_lock:
             self.replica_restarts += 1
         self._inc(self._replica_restarts, replica=replica)
+
+    # -- cross-replica migration (families created lazily, the SLO
+    # -- discipline: rebalancer off + no migrate/restart calls = ZERO
+    # -- migration series, registry family set bit-identical to
+    # -- pre-migration — the pinned no-op) ------------------------------------
+
+    def observe_migration(self, reason: str, seconds: float) -> None:
+        """One COMPLETED cross-replica migration (order created ->
+        sequence adopted on the target), by trigger."""
+        fam = self._registry.counter(
+            "server_migrations_total",
+            "sequences migrated across replicas, by trigger "
+            "(rebalance / restart / slo)")
+        hist = self._registry.histogram(
+            "serving_migration_seconds",
+            "end-to-end cross-replica migration latency: order "
+            "created -> sequence adopted on the target")
+        self._inc(fam, reason=reason)
+        self._observe(hist, seconds)
+
+    def observe_migration_failure(self, phase: str) -> None:
+        """One migration attempt failed at `phase` (extract / transfer
+        / adopt). The sequence is never lost — it stays on the source,
+        re-adopts, or fails over — this counts the incident."""
+        fam = self._registry.counter(
+            "server_migration_failures_total",
+            "migration attempts failed, by phase "
+            "(extract / transfer / adopt)")
+        self._inc(fam, phase=phase)
+
+    def slo_missed_total(self) -> int:
+        """Total objective misses across tenants (host mirror, no
+        registry walk) — the rebalancer's SLO-pressure delta signal."""
+        with self._dyn_lock:
+            return sum(sum(e["missed"].values())
+                       for e in self._slo.values())
 
     # -- SLO / goodput (families created lazily: with no SLOConfig the
     # -- registry carries ZERO slo/goodput series — the pinned no-op) --------
@@ -707,7 +1149,8 @@ class Router:
                  restart_backoff_s: float = 0.05,
                  restart_backoff_cap_s: float = 2.0,
                  slos: Optional[Dict[str, SLOConfig]] = None,
-                 default_slo: Optional[SLOConfig] = None):
+                 default_slo: Optional[SLOConfig] = None,
+                 rebalance: Optional[RebalanceConfig] = None):
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine replica")
@@ -744,14 +1187,33 @@ class Router:
         self._closed = False
         self._started = False
         self._rr = itertools.count()
+        # cross-replica migration plane: in-flight orders (drain waits
+        # for them — a ticket stranded by teardown would strand its
+        # stream) and the optional pressure-driven rebalancer thread
+        self._rebalance = rebalance
+        self._rebalance_thread: Optional[threading.Thread] = None
+        self._rebalance_stop = threading.Event()
+        self._migrations: set = set()
+        self._mig_lock = threading.Lock()
+
+    # adoption attempts (initial target + re-placements) before a
+    # migration falls back to failover semantics
+    _MAX_ADOPTION_ATTEMPTS = 3
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
-        """Start one driver thread per replica (idempotent)."""
+        """Start one driver thread per replica, plus the rebalancer
+        thread when a RebalanceConfig is set (idempotent)."""
         self._started = True
         for r in self.replicas:
             r.start()
+        if self._rebalance is not None and self._rebalance_thread is None:
+            self._rebalance_thread = threading.Thread(
+                target=self._rebalance_loop,
+                name=f"pt-serve-rebalance-{self.metrics.label}",
+                daemon=True)
+            self._rebalance_thread.start()
 
     @property
     def draining(self) -> bool:
@@ -999,22 +1461,41 @@ class Router:
         for handle in stranded:
             self._reroute(handle)
 
-    def _reroute(self, handle: StreamHandle) -> None:
+    def _reroute(self, handle: StreamHandle,
+                 exclude: Optional["Replica"] = None,
+                 count_retry: bool = True) -> None:
+        """Re-submit a ZERO-token stream to a healthy replica.
+        `count_retry=False` is the planned-displacement flavor (restart
+        drain hands queued requests to peers): it neither burns the
+        handle's failover-retry budget nor journals a `failover` event
+        — the `routed{rerouted_from=}` link still chains the ids.
+        `exclude` skips one replica (the one being drained)."""
         if handle.finish_reason is not None:
             return                          # already terminal (cancel won)
         if (handle.emitted > 0
-                or handle.retries >= self._max_stream_retries
+                or (count_retry
+                    and handle.retries >= self._max_stream_retries)
                 or self._draining or self._closed):
             handle._finish("replica_failed")
             return
-        handle.retries += 1
+        if count_retry:
+            handle.retries += 1
         rlog = _request_log.get_request_log()
         stranded_rid = handle.request_id
         if rlog is not None:
-            rlog.event("failover", request_id=stranded_rid,
-                       tenant=handle.tenant, retries=handle.retries)
+            if count_retry:
+                rlog.event("failover", request_id=stranded_rid,
+                           tenant=handle.tenant, retries=handle.retries)
+            else:
+                # planned displacement (restart drain), not a failure:
+                # its own kind so serving_summary renders the move
+                # without a FAILOVER annotation
+                rlog.event("displaced", request_id=stranded_rid,
+                           tenant=handle.tenant)
         for i in self._healthy_order():
             replica = self.replicas[i]
+            if replica is exclude:
+                continue
             engine = replica.engine
             try:
                 req = engine.submit(
@@ -1050,6 +1531,329 @@ class Router:
         # nowhere to go (every healthy replica shed, or none left)
         handle._finish("replica_failed")
 
+    # -- cross-replica migration ---------------------------------------------
+
+    def migrate(self, handle: StreamHandle,
+                target: Optional[Any] = None,
+                reason: str = "rebalance") -> "_MigrationOrder":
+        """Migrate one routed stream to another replica: pipeline fence
+        + ticket extraction on the source driver, adoption on the
+        target driver, the client's SSE stream held open throughout and
+        token-identical across the move. `target` is a replica index or
+        Replica (None = the router picks the least-loaded compatible
+        peer at delivery time). Returns the order — wait on
+        ``order.done`` and read ``order.outcome``. Raises DrainingError
+        while draining/closed."""
+        if self._draining or self._closed:
+            raise DrainingError("router is draining; not migrating")
+        source = handle.replica
+        if isinstance(target, int):
+            if not 0 <= target < len(self.replicas):
+                raise ValueError(
+                    f"replica index {target} out of range "
+                    f"[0, {len(self.replicas)})")
+            tgt = self.replicas[target]
+        else:
+            tgt = target
+        if tgt is source:
+            raise ValueError("migration target is the source replica")
+        return self._order_migration(source, tgt, reason, handle=handle)
+
+    def _order_migration(self, source: "Replica",
+                         target: Optional["Replica"], reason: str,
+                         handle: Optional[StreamHandle] = None
+                         ) -> "_MigrationOrder":
+        order = _MigrationOrder(self, source, target, reason, handle)
+        if self._draining or self._closed:
+            # an order created after drain began could extract a ticket
+            # nobody will adopt (every engine is — or is about to be —
+            # flagged draining) and get a healthy stream killed by the
+            # failover fallback; refuse instead, the drain finishes the
+            # sequence in place
+            order.finish("aborted:router-draining")
+            return order
+        with self._mig_lock:
+            self._migrations.add(order)
+        # state re-checked UNDER the inbox lock: _on_failure flips state
+        # before sweeping the inboxes under this same lock, so an order
+        # appended while the state still reads alive is guaranteed to be
+        # seen by the sweep — it can never land in a just-cleared inbox
+        with source._lock:
+            if source.state not in ("ok", "draining"):
+                alive = False
+            else:
+                source._migrations_out.append(order)
+                alive = True
+        if not alive:
+            order.finish("aborted:source-unhealthy")
+            return order
+        source.kick()
+        return order
+
+    def _enqueue_adoption(self, replica: "Replica",
+                          order: "_MigrationOrder") -> bool:
+        """Append `order` to a replica's adoption inbox iff the replica
+        is still alive — re-checked under the inbox lock (the lock
+        _on_failure's sweep holds, with the state flipped first), so a
+        ticket can never be entombed in a dead replica's cleared inbox.
+        False = the caller must re-place the order."""
+        with replica._lock:
+            if replica.state not in ("ok", "draining"):
+                return False
+            replica._migrations_in.append(order)
+        replica.kick()
+        return True
+
+    def _migration_done(self, order: "_MigrationOrder") -> None:
+        with self._mig_lock:
+            self._migrations.discard(order)
+
+    def _migrations_active(self) -> bool:
+        with self._mig_lock:
+            return bool(self._migrations)
+
+    def _has_orders_involving(self, replica: "Replica") -> bool:
+        with self._mig_lock:
+            return any(o.source is replica or o.target is replica
+                       for o in self._migrations)
+
+    def _candidate_targets(self, order: "_MigrationOrder",
+                           exclude=()) -> List["Replica"]:
+        """Healthy, geometry-compatible adoption targets, least-loaded
+        first (ticket.compatible only reads immutable engine geometry,
+        so the pre-screen is safe cross-thread)."""
+        out = []
+        for i in self._healthy_order():
+            r = self.replicas[i]
+            if r is order.source or r in exclude:
+                continue
+            if order.ticket.compatible(r.engine):
+                out.append(r)
+        return out
+
+    def _deliver_ticket(self, order: "_MigrationOrder") -> None:
+        """SOURCE-driver: hand an extracted ticket to its target's
+        adoption inbox (re-picking when the chosen target went
+        unhealthy or can't host the geometry). No peer can host it ->
+        the sequence re-adopts at home (it simply stays) — except under
+        a planned restart, where home is going away, so PR 10 failover
+        semantics apply."""
+        target = order.target
+        if (target is None or target.state != "ok"
+                or not order.ticket.compatible(target.engine)):
+            targets = self._candidate_targets(order)
+            target = targets[0] if targets else None
+        while target is not None:
+            order.target = target
+            if self._enqueue_adoption(target, order):
+                return
+            # the picked target died between the pre-screen and the
+            # append: try the next one
+            targets = self._candidate_targets(order, exclude=(target,))
+            target = targets[0] if targets else None
+        if order.reason == "restart":
+            self._migration_failover(order)
+        else:
+            self._route_home_or_failover(order)
+
+    def _route_home_or_failover(self, order: "_MigrationOrder") -> None:
+        """Recovery for a ticket that cannot reach a peer: re-adopt on
+        the SOURCE (routed through its own adoption inbox so the
+        migrate_in runs on the owning driver thread). A source that is
+        gone — or going away for a restart — leaves only failover."""
+        src = order.source
+        if order.reason != "restart":
+            order.target = src
+            if self._enqueue_adoption(src, order):
+                return
+        self._migration_failover(order)
+
+    def _adoption_failed(self, order: "_MigrationOrder",
+                         failed_on: "Replica") -> None:
+        """An adoption attempt failed (injected fault, geometry
+        surprise, or the target died first): re-place the ticket —
+        another peer, then home — bounded by _MAX_ADOPTION_ATTEMPTS,
+        then failover. The ticket is never lost and never adopted
+        twice: exactly one inbox (or the failover path) holds the
+        order at any moment."""
+        if order.attempts < self._MAX_ADOPTION_ATTEMPTS:
+            exclude = [failed_on]
+            while True:
+                targets = self._candidate_targets(order,
+                                                  exclude=tuple(exclude))
+                if not targets:
+                    break
+                order.target = targets[0]
+                if self._enqueue_adoption(targets[0], order):
+                    return
+                exclude.append(targets[0])
+            src = order.source
+            if order.reason != "restart" and src is not failed_on:
+                self._route_home_or_failover(order)
+                return
+        self._migration_failover(order)
+
+    def _migration_failover(self, order: "_MigrationOrder") -> None:
+        """Terminal migration disposition — PR 10 failover semantics: a
+        zero-token stream re-submits transparently to a healthy replica
+        (a fresh submit is bit-identical), a mid-emission stream
+        terminates with replica_failed. Either way, when the stream
+        dies OF the migration (its ticket had already detached it), the
+        tenant's quota is refunded EXACTLY ONCE — the tokens it paid
+        for will never be delivered by this request."""
+        handle = order.handle
+        if handle.finish_reason is None:
+            if handle.emitted:
+                self._refund_once(handle)
+                handle._finish("replica_failed")
+            else:
+                self._reroute(handle)
+                if handle.finish_reason == "replica_failed":
+                    self._refund_once(handle)
+        order.finish("failed:" + ("terminal"
+                                  if handle.finish_reason
+                                  == "replica_failed" else "rerouted"))
+
+    def _refund_once(self, handle: StreamHandle) -> None:
+        """Credit the tenant's bucket back for a stream the migration
+        plane killed after its ticket detached it — exactly once, no
+        matter how many failure paths observe the same corpse."""
+        with handle._flock:
+            if handle.quota_refunded:
+                return
+            handle.quota_refunded = True
+        bucket = self._bucket_for(handle.tenant)
+        if bucket is not None and handle.prompt is not None:
+            bucket.refund(handle.prompt.size
+                          + int(handle.submit_kw.get(
+                                "max_new_tokens", 0)))
+
+    # -- pressure-driven rebalancer ------------------------------------------
+
+    def _pressure(self, replica: "Replica") -> float:
+        """Replica pressure score in [0, 3] off the live registry
+        gauges: block occupancy + queue backlog + swap-pool depth, each
+        normalized and clamped (see RebalanceConfig)."""
+        eng = replica.engine
+        m = eng.metrics
+        blocks = min(1.0, int(m.kv_blocks_used)
+                     / max(1, int(m.kv_blocks_total)))
+        queue = min(1.0, int(m.queue_depth)
+                    / max(1, eng.config.max_queue))
+        swapped = min(1.0, int(m.swapped_slots)
+                      / max(1, eng.config.num_slots))
+        return blocks + queue + swapped
+
+    def _rebalance_loop(self) -> None:
+        """The rebalancer thread: poll replica pressure, order ONE
+        migration from the hottest to the coldest replica when the gap
+        persists past the hysteresis window (reason="rebalance") or a
+        tenant scored a fresh SLO miss while the hot replica has queued
+        work (reason="slo"). The max_concurrent cap and the
+        streak-reset-after-order rule make thrash impossible: pressure
+        must re-prove itself between moves."""
+        cfg = self._rebalance
+        streak = 0
+        last_missed = self.metrics.slo_missed_total()
+        while not self._rebalance_stop.wait(cfg.interval_s):
+            if self._draining or self._closed:
+                return
+            ok = [r for r in self.replicas if r.state == "ok"]
+            if len(ok) < 2:
+                streak = 0
+                continue
+            scores = {r: self._pressure(r) for r in ok}
+            hot = max(ok, key=lambda r: scores[r])
+            cold = min(ok, key=lambda r: scores[r])
+            gap = scores[hot] - scores[cold]
+            reason = None
+            if gap >= cfg.pressure_gap:
+                streak += 1
+                if streak >= cfg.hysteresis:
+                    reason = "rebalance"
+            else:
+                streak = 0
+            missed = self.metrics.slo_missed_total()
+            if (reason is None and cfg.slo_pressure
+                    and missed > last_missed and gap > 0
+                    and int(hot.engine.metrics.queue_depth) > 0):
+                reason = "slo"
+            last_missed = missed
+            if reason is None:
+                continue
+            with self._mig_lock:
+                inflight = len(self._migrations)
+            if inflight >= cfg.max_concurrent:
+                continue
+            self._order_migration(hot, cold, reason)
+            streak = 0
+
+    def _stop_rebalancer(self) -> None:
+        self._rebalance_stop.set()
+        thread, self._rebalance_thread = self._rebalance_thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- zero-downtime rolling restart ---------------------------------------
+
+    def restart_replica(self, i: int,
+                        timeout: Optional[float] = None,
+                        force: bool = False) -> bool:
+        """Rolling restart of ONE replica with zero dropped tokens:
+        drain it by MIGRATING its queued and running/parked sequences
+        to healthy peers (client SSE streams stay open and
+        token-identical throughout; sequences no peer can host fall
+        back to PR 10 failover semantics), then rebuild via the engine
+        factory (no factory: the drained engine rejoins as-is) and
+        return it to admission. Blocks until the rebuild completed
+        (True) or `timeout` wall-seconds elapsed / the restart was
+        overridden by a router drain (False — a timed-out drain keeps
+        going in the background; poll /healthz). Raises DrainingError
+        while the router drains/closes and ValueError for an index out
+        of range, a replica that is not ok, or — unless `force=True` —
+        the LAST healthy replica (with no peer, every stream would
+        fail over instead of migrating: that is a wipeout, not a
+        rolling restart). The peer check and the state flip are atomic
+        under the admission lock, so two concurrent restarts can never
+        drain the whole fleet at once."""
+        if not 0 <= i < len(self.replicas):
+            raise ValueError(
+                f"replica index {i} out of range "
+                f"[0, {len(self.replicas)})")
+        replica = self.replicas[i]
+        with self._admit_lock:
+            if self._draining or self._closed:
+                raise DrainingError(
+                    "router is draining; not restarting replicas")
+            if replica.state != "ok":
+                raise ValueError(
+                    f"replica {replica.label} is {replica.state}; "
+                    "rolling restart needs a healthy replica")
+            if not force and not any(
+                    r.state == "ok" for r in self.replicas
+                    if r is not replica):
+                raise ValueError(
+                    f"replica {replica.label} is the only healthy "
+                    "replica; restarting it would fail over every "
+                    "stream instead of migrating (pass force=True to "
+                    "do it anyway)")
+            restarts_before = replica.restarts_total
+            replica._restart = True
+            replica.state = "draining"
+        replica.kick()
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while replica._restart or replica.state == "draining":
+            if replica.state == "failed":
+                return False        # the planned rebuild's factory failed
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        # the restart counter is the truth, not the state flip: a
+        # router-wide drain overriding the planned restart returns the
+        # replica to "ok" WITHOUT rebuilding — that is not a restart
+        return replica.restarts_total > restarts_before
+
     # -- drain / teardown ---------------------------------------------------
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -1057,16 +1861,29 @@ class Router:
         then wait until every queued and in-flight request has finished
         streaming. Returns True when fully drained, False when `timeout`
         (wall seconds) elapsed first — nothing is cancelled either way;
-        close() decides what happens to leftovers."""
+        close() decides what happens to leftovers.
+
+        Migration interplay: in-flight migrations are allowed to LAND
+        first (a ticket stranded by the drain would strand its stream —
+        drain's contract is zero dropped tokens), THEN every engine is
+        flagged draining so late migrate calls refuse cleanly instead
+        of parking sequences nobody will resume."""
         with self._admit_lock:
             self._draining = True
         self.metrics.draining.set(1)
-        for r in self.replicas:
-            r.kick()
         deadline = None if timeout is None \
             else time.monotonic() + float(timeout)
+        while self._migrations_active():
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.002)
+        for r in self.replicas:
+            r.engine.begin_drain()
+            r.kick()
         while True:
-            if all(not r.busy and not r._handles for r in self.replicas):
+            if (not self._migrations_active()
+                    and all(not r.busy and not r._handles
+                            for r in self.replicas)):
                 return True
             if deadline is not None and time.monotonic() >= deadline:
                 return False
@@ -1080,6 +1897,7 @@ class Router:
         debug server released by the last holder)."""
         if self._closed:
             return
+        self._stop_rebalancer()
         if drain:
             self.drain(timeout=timeout)
         with self._admit_lock:
@@ -1096,6 +1914,22 @@ class Router:
             r.kick()
         for r in self.replicas:
             r.stop()
+        # disposition streams stranded mid-migration (drain=False, or a
+        # timed-out drain): their tickets die with the process — the
+        # streams must still reach a terminal event. The replica inboxes
+        # empty too: the drivers are stopped, and a pending order left
+        # behind would keep `busy` true forever under the step loop
+        # below
+        with self._mig_lock:
+            orders = list(self._migrations)
+        for o in orders:
+            if o.handle is not None:
+                o.handle._finish("cancelled")
+            o.finish("aborted:closed")
+        for r in self.replicas:
+            with r._lock:
+                r._migrations_out.clear()
+                r._migrations_in.clear()
         for r in self.replicas:
             if r._thread is None or not r._thread.is_alive():
                 # driver joined: apply any still-pending cancels from

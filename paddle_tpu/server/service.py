@@ -51,7 +51,7 @@ import numpy as np
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..serving.engine import EngineOverloadError, ServingEngine
 from .router import (DrainingError, QuotaConfig, QuotaExceededError,
-                     Router, SLOConfig, StreamHandle)
+                     RebalanceConfig, Router, SLOConfig, StreamHandle)
 
 __all__ = ["ServerConfig", "GenerationServer", "serve"]
 
@@ -61,6 +61,8 @@ _INDEX = """<html><head><title>paddle_tpu server</title></head><body>
 <li><a href="/healthz">/healthz</a> — readiness + replica gauges</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
 <li><a href="/slozv">/slozv</a> — per-tenant SLO attainment + goodput</li>
+<li><code>POST /admin/restart</code> — zero-downtime rolling restart of
+one replica (<code>{"replica": i}</code>)</li>
 </ul></body></html>
 """
 
@@ -92,6 +94,7 @@ class ServerConfig:
                  max_stream_retries: int = 1,
                  restart_backoff_s: float = 0.05,
                  restart_backoff_cap_s: float = 2.0,
+                 rebalance: Optional[RebalanceConfig] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.host = host
         self.port = int(port)
@@ -117,6 +120,10 @@ class ServerConfig:
         self.max_stream_retries = int(max_stream_retries)
         self.restart_backoff_s = float(restart_backoff_s)
         self.restart_backoff_cap_s = float(restart_backoff_cap_s)
+        # pressure-driven cross-replica rebalancing (router
+        # pass-through; None — the default — means the rebalancer
+        # thread and its migration registry families don't exist)
+        self.rebalance = rebalance
         self.clock = clock
 
 
@@ -224,7 +231,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"no such endpoint {path!r}",
                      "endpoints": ["/", "/healthz", "/metrics",
-                                   "/slozv", "/v1/generate"]},
+                                   "/slozv", "/v1/generate",
+                                   "/admin/restart"]},
                     status=404)
         except BrokenPipeError:
             pass
@@ -236,6 +244,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/v1/generate":
                 self._generate(self.server.gen_server)
+            elif path == "/admin/restart":
+                self._admin_restart(self.server.gen_server)
             else:
                 self._send_json(
                     {"error": f"no such endpoint {path!r}"}, status=404)
@@ -275,7 +285,12 @@ class _Handler(BaseHTTPRequestHandler):
                  "kv_blocks_used": int(r.engine.metrics.kv_blocks_used),
                  "kv_blocks_total": int(r.engine.metrics.kv_blocks_total),
                  "swapped_slots": int(r.engine.metrics.swapped_slots),
-                 "preemptions": int(r.engine.metrics.preemptions)}
+                 "preemptions": int(r.engine.metrics.preemptions),
+                 # completed cross-replica migrations this replica
+                 # sourced / adopted (host mirrors of the
+                 # server_migrations_total accounting)
+                 "migrations_out": r.migrations_out,
+                 "migrations_in": r.migrations_in}
                 for r in router.replicas],
         }, status=503 if draining else 200)
 
@@ -292,6 +307,51 @@ class _Handler(BaseHTTPRequestHandler):
             "replicas": len(router.replicas),
             "tenants": router.slo_report(),
         })
+
+    def _admin_restart(self, srv: "GenerationServer") -> None:
+        """POST /admin/restart {"replica": i}: zero-downtime rolling
+        restart of one replica — its queued and running sequences
+        MIGRATE to healthy peers (open SSE streams continue
+        token-identically), then the replica rebuilds via the engine
+        factory and rejoins. Blocks until done (bounded by the drain
+        timeout): 200 on success, 400 for a bad body/index, 409 when
+        the replica is not currently ok, 503 while draining, 504 when
+        the restart outran the timeout (it keeps going — poll
+        /healthz)."""
+        router = srv.router
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, TypeError) as e:
+            return self._send_json(
+                {"error": f"bad request body: {e}"}, status=400)
+        idx = payload.get("replica")
+        if not isinstance(idx, int) or isinstance(idx, bool) \
+                or not 0 <= idx < len(router.replicas):
+            return self._send_json(
+                {"error": "'replica' must be an integer in "
+                          f"[0, {len(router.replicas)})"}, status=400)
+        force = payload.get("force", False)
+        if not isinstance(force, bool):
+            return self._send_json(
+                {"error": "'force' must be a boolean"}, status=400)
+        old_label = router.replicas[idx].label
+        try:
+            ok = router.restart_replica(
+                idx, timeout=srv.config.drain_timeout_s, force=force)
+        except DrainingError as e:
+            return self._send_json({"error": str(e)}, status=503)
+        except ValueError as e:       # replica not in a restartable state
+            return self._send_json({"error": str(e)}, status=409)
+        replica = router.replicas[idx]
+        body = {"restarted": ok, "replica": idx,
+                "old_engine": old_label, "engine": replica.label,
+                "state": replica.state,
+                "migrations_out": replica.migrations_out,
+                "restarts_total": replica.restarts_total}
+        self._send_json(body, status=200 if ok else 504)
 
     def _reject(self, srv: "GenerationServer", code: int, message: str,
                 tenant: str,
@@ -446,7 +506,8 @@ class GenerationServer:
                 registry=registry,
                 max_stream_retries=self.config.max_stream_retries,
                 restart_backoff_s=self.config.restart_backoff_s,
-                restart_backoff_cap_s=self.config.restart_backoff_cap_s)
+                restart_backoff_cap_s=self.config.restart_backoff_cap_s,
+                rebalance=self.config.rebalance)
         self._registry = registry or get_registry()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -548,7 +609,8 @@ def serve(params, cfg, config: Optional[ServerConfig] = None,
                     engine_factory=factory,
                     max_stream_retries=config.max_stream_retries,
                     restart_backoff_s=config.restart_backoff_s,
-                    restart_backoff_cap_s=config.restart_backoff_cap_s)
+                    restart_backoff_cap_s=config.restart_backoff_cap_s,
+                    rebalance=config.rebalance)
     server = GenerationServer(router, config, registry=registry)
     server.serve()
     return server

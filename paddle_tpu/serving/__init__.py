@@ -23,6 +23,8 @@ from .engine import (DEFAULT_RETRY_AFTER_S, EngineOverloadError,
 from .faults import FaultPlan, InjectedFault
 from .kv_cache import ShapeBuckets, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
+from .migration import (TICKET_VERSION, MigrationError, MigrationTicket,
+                        TicketError)
 from .scheduler import (ContinuousBatchingScheduler, SequenceEvent,
                         SwappedSequence)
 
@@ -31,4 +33,6 @@ __all__ = ["ServingEngine", "ServingConfig", "GenerationRequest",
            "ShapeBuckets", "SlotKVCache",
            "ContinuousBatchingScheduler", "SequenceEvent",
            "SwappedSequence", "FaultPlan", "InjectedFault",
-           "EngineMetrics", "RequestMetrics"]
+           "EngineMetrics", "RequestMetrics",
+           "MigrationTicket", "MigrationError", "TicketError",
+           "TICKET_VERSION"]

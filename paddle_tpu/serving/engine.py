@@ -273,6 +273,11 @@ class ServingEngine:
         self.faults = serving.fault_plan
         self._step_no = 0
         self._lock = threading.Lock()
+        # drain flag (begin_drain): cross-replica migration refuses on
+        # a draining engine — a sequence handed off mid-drain could
+        # never resume (the router has stopped adopting), so refusal
+        # beats a stuck ticket. Normal stepping/drain is unaffected.
+        self._draining = False
         self._rid_counter = itertools.count()
         self.debug_port: Optional[int] = None   # set by create_engine
         # debug-server release token from acquire_debug_server (None =
@@ -599,16 +604,8 @@ class ServingEngine:
             return False
         # swap_out requires an empty pipeline; the fence's tokens fan
         # out NOW (and may retire slots — re-check before sacrificing
-        # anything). Per-dispatch batches so fenced collections feed
-        # the same decode_steps / tokens-per-dispatch telemetry the
-        # normal step() path does — preemption-heavy regimes would
-        # otherwise read inconsistently high tokens-per-dispatch
-        for batch in self.scheduler._sync_batches():
-            if batch:
-                self.metrics.decode_steps += 1
-                self.metrics.observe_dispatch_tokens(len(batch))
-            for event in batch:
-                self._emit(event)
+        # anything)
+        self._fence()
         if self.scheduler.can_admit(req.prompt, req.max_new_tokens):
             return True
         slot = self.scheduler.pick_victim(self.config.preempt_policy)
@@ -622,11 +619,178 @@ class ServingEngine:
         self.metrics.swapped_slots = len(self._swapped)
         return True
 
+    def _fence(self) -> None:
+        """Drain the overlap pipeline and fan its tokens out NOW — the
+        precondition for swap_out/migrate_out (a block in flight could
+        still carry the victim's tokens). Per-dispatch batches so
+        fenced collections feed the same decode_steps /
+        tokens-per-dispatch telemetry the normal step() path does —
+        fence-heavy regimes would otherwise read inconsistently high
+        tokens-per-dispatch."""
+        for batch in self.scheduler._sync_batches():
+            if batch:
+                self.metrics.decode_steps += 1
+                self.metrics.observe_dispatch_tokens(len(batch))
+            for event in batch:
+                self._emit(event)
+
     @property
     def swapped_count(self) -> int:
         """Preempted sequences currently parked in the host swap pool
         (they still owe tokens: drain loops must count them as work)."""
         return len(self._swapped)
+
+    # -- cross-replica migration ---------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Flag the engine as draining: from here on migrate_out and
+        migrate_in REFUSE with MigrationError (never deadlock) — a
+        sequence handed off during drain could never resume, because
+        the drain loop is finishing residents, not adopting new ones.
+        Stepping, run_until_drained, and swap-in of already-parked
+        sequences continue unaffected. Idempotent; the router calls
+        this on every replica engine when its own drain begins."""
+        self._draining = True
+
+    def migrate_out(self, request) -> "Any":
+        """Extract one RUNNING or PARKED sequence into a portable
+        MigrationTicket: fence the pipeline (its tokens fan out
+        normally — they were produced before the handoff), copy the
+        sequence's KV blocks + decode carry to host via the swap-out
+        path, free its pages/slot, and detach the stream (the
+        GenerationRequest left behind goes state="migrated" and never
+        emits again). `request` is a GenerationRequest or its
+        request_id. DRIVER-THREAD ONLY, like every scheduler-touching
+        path.
+
+        Raises MigrationError — with the sequence left exactly where it
+        was — when the engine is draining (a migrated sequence could
+        never resume; refusal beats deadlock), when the request is not
+        running or parked here (queued requests re-route without a
+        ticket; finished/cancelled ones have nothing to move), or when
+        the pipeline fence finishes the sequence first. An injected
+        extract-phase fault (FaultPlan.migration_faults) fires after
+        the fence and before any state moves, so a fault there leaves
+        the sequence running on this engine."""
+        from .migration import MigrationError, MigrationTicket
+
+        if self._draining:
+            raise MigrationError(
+                "engine is draining; migrate_out refused — the drain "
+                "loop finishes residents in place")
+        rid = request if isinstance(request, str) \
+            else getattr(request, "request_id", None)
+        rlog = _request_log.get_request_log()
+        # parked first: a swap-pool record is already serialized — the
+        # handoff is a pure host-side wrap, no fence, no dispatch
+        for sw in self._swapped:
+            if getattr(sw.req, "request_id", None) == rid:
+                if sw.req.state != "running":
+                    raise MigrationError(
+                        f"request {rid} is {sw.req.state}, not "
+                        "migratable")
+                if self.faults is not None:
+                    self.faults.migration_phase("extract")
+                self._swapped.remove(sw)
+                self.metrics.swapped_slots = len(self._swapped)
+                sw.req.state = "migrated"
+                ticket = MigrationTicket.from_swapped(
+                    sw, self.kv.block_size)
+                if rlog is not None:
+                    rlog.event("migrate_out", request_id=rid,
+                               replica=self.metrics.engine_label,
+                               phase="parked", blocks=ticket.n_blocks,
+                               bytes=ticket.swap_bytes,
+                               produced=ticket.produced)
+                return ticket
+
+        def _find_slot():
+            return next(
+                (s for s, st in self.scheduler._running.items()
+                 if getattr(st.req, "request_id", None) == rid
+                 and st.req.state == "running"), None)
+
+        if _find_slot() is None:
+            raise MigrationError(
+                f"request {rid} is not running or parked on this "
+                "engine (queued requests re-route without a ticket)")
+        # fence BEFORE extraction: in-flight blocks may still carry the
+        # victim's tokens; they stream to the client normally
+        self._fence()
+        if self.faults is not None:
+            self.faults.migration_phase("extract")
+        slot = _find_slot()
+        if slot is None:
+            # the fence's collected tokens finished (or a pending
+            # cancel consumed) the sequence: nothing left to move
+            raise MigrationError(
+                f"request {rid} finished during the migration fence")
+        # journal=False: this copy-out is a handoff, not page pressure —
+        # the migrate_out event below tells the story, and a spurious
+        # "preempted" would miscount real preemptions in the summary
+        sw = self.scheduler.swap_out(slot, journal=False)
+        sw.req.state = "migrated"
+        ticket = MigrationTicket.from_swapped(sw, self.kv.block_size)
+        if rlog is not None:
+            rlog.event("migrate_out", request_id=rid,
+                       replica=self.metrics.engine_label,
+                       phase="running", blocks=ticket.n_blocks,
+                       bytes=ticket.swap_bytes,
+                       produced=ticket.produced)
+        return ticket
+
+    def migrate_in(self, ticket, on_token: Optional[Callable] = None
+                   ) -> GenerationRequest:
+        """Adopt a migrated sequence: validate the ticket (checksum +
+        geometry — TicketError rejects it whole, nothing mutated), mint
+        a fresh GenerationRequest continuing the SAME client stream
+        (emitted prefix pre-loaded, so budget math and finish_reason
+        land on the exact token a never-migrated run would), and park
+        the sequence in the host swap pool — the resume-first rule then
+        gives it STRICT priority over new admissions for freed
+        pages/slots, exactly like a PR 10 preemption resume. The
+        restored PRNG key row continues the per-token split chain, so
+        the resumed stream is bit-identical wherever it lands.
+        DRIVER-THREAD ONLY. Raises MigrationError while draining; an
+        injected adopt-phase fault fires before any state changes."""
+        from .migration import MigrationError
+
+        if self._draining:
+            raise MigrationError(
+                "engine is draining; migrate_in refused — not adopting "
+                "new residents")
+        if self.faults is not None:
+            self.faults.migration_phase("adopt")
+        ticket.validate_for(self)
+        req = GenerationRequest(
+            ticket.prompt, ticket.max_new, ticket.temperature,
+            ticket.seed, ticket.eos_id, on_token, self.config.clock,
+            request_id=f"{self.metrics.engine_label}-"
+                       f"{next(self._rid_counter)}")
+        req.tokens = list(ticket.tokens)
+        req.state = "running"
+        # adoption stamps: queue_wait/ttft on THIS engine measure the
+        # handoff-to-next-token gap; client-facing SLO cuts live on the
+        # router's StreamHandle and span the whole migration
+        req.metrics.mark_submitted()
+        req.metrics.mark_admitted()
+        self._swapped.append(ticket.to_swapped(req))
+        self.metrics.swapped_slots = len(self._swapped)
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            # rerouted_from chains the journals (and retires the
+            # superseded id from the in-flight set), the same link a
+            # failover re-submission writes
+            rlog.event("migrate_in", request_id=req.request_id,
+                       replica=self.metrics.engine_label,
+                       rerouted_from=ticket.request_id,
+                       bytes=ticket.swap_bytes,
+                       produced=ticket.produced)
+        return req
 
     def _on_dispatch_launched(self) -> None:
         self.metrics.dispatches += 1

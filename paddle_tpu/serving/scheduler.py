@@ -822,13 +822,18 @@ class ContinuousBatchingScheduler:
         raise ValueError(
             f"unknown preempt policy {policy!r} (newest/oldest/callable)")
 
-    def swap_out(self, slot: int) -> SwappedSequence:
+    def swap_out(self, slot: int, journal: bool = True) -> SwappedSequence:
         """Preempt the sequence in `slot`: copy its arena blocks and
         decode-carry rows to host memory, freeze the slot in-graph
         (release executable — its ride-along writes go to scratch, not
         to blocks admission will reallocate), and free its pages.
         Caller must have drained the pipeline (sync()) first — a block
-        in flight could still carry this slot's tokens."""
+        in flight could still carry this slot's tokens.
+        `journal=False` suppresses the "preempted" request-log event —
+        the migration path copies a sequence out for a HANDOFF, not
+        under page pressure, and journals its own migrate_out instead
+        (a spurious PREEMPT annotation would miscount real
+        preemptions)."""
         import jax
 
         if self._inflight:
@@ -858,11 +863,14 @@ class ContinuousBatchingScheduler:
         self._pt, self._state = self._release_jit(
             self._pt, self._state, np.int32(slot))
         self.kv.free(slot)
-        rlog = _request_log.get_request_log()
-        if rlog is not None:
-            rlog.event("preempted",
-                       request_id=getattr(st.req, "request_id", None),
-                       slot=slot, blocks=n_blocks, produced=st.produced)
+        if journal:
+            rlog = _request_log.get_request_log()
+            if rlog is not None:
+                rlog.event("preempted",
+                           request_id=getattr(st.req, "request_id",
+                                              None),
+                           slot=slot, blocks=n_blocks,
+                           produced=st.produced)
         return sw
 
     def can_swap_in(self, sw: SwappedSequence) -> bool:

@@ -599,13 +599,17 @@ def test_registry_rollup_histogram_fields_and_label_sums():
 def test_serving_varz_uses_rollup_for_every_block(tiny_engine_params):
     """The deduped _serving_varz keeps the exact pre-refactor shape for
     the PR 6/9/10 blocks (other tests pin the values) and grows the
-    host-overhead and SLO blocks — empty dicts while those planes are
-    dormant, never missing keys."""
+    host-overhead, SLO, and migration blocks — empty dicts while those
+    planes are dormant, never missing keys."""
     from paddle_tpu.observability.debug_server import _serving_varz
     varz = _serving_varz(obs.get_registry().snapshot())
     assert set(varz) == {"prefix_hit_ratio", "spec_accept_ratio",
                          "preemption", "host_overhead_per_dispatch",
-                         "slo"}
+                         "slo", "migration"}
+    # the migration plane is dormant here: the rollup key exists but
+    # carries no rows (its registry families are created lazily on the
+    # first migration — the disabled-noop discipline)
+    assert varz["migration"] == {}
 
 
 # ---------------------------------------------------------------------------

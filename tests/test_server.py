@@ -1178,3 +1178,409 @@ def test_slo_failover_scores_client_observed_cuts(trained):
         assert rep["tokens"] == 6 and rep["goodput_tokens"] == 0
     finally:
         router.close(drain=False)
+
+# ---------------------------------------------------------------------------
+# live cross-replica migration: rebalancing + rolling restart
+# ---------------------------------------------------------------------------
+
+def _slowed(plan_steps=200, delay=0.002, **fault_kw):
+    """A FaultPlan that stretches every engine step — wide, determinate
+    windows for catching streams mid-generation without racing the
+    driver."""
+    return FaultPlan(slow_steps={i: delay for i in range(plan_steps)},
+                     **fault_kw)
+
+
+def _await_emitted(handle, n=2, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while handle.emitted < n:
+        assert time.monotonic() < deadline, "stream never emitted"
+        time.sleep(0.002)
+    assert handle.finish_reason is None
+
+
+def test_router_migrate_stream_token_identical(trained):
+    """The tentpole pin at the router: a live SSE-backed stream
+    migrated between replicas mid-generation keeps its handle (the
+    client never reconnects) and stays bit-identical — greedy and
+    seeded — while the registry counts the migration and both arenas
+    drain clean."""
+    e0 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=_slowed())
+    e1 = make_engine(trained, decode_chunk=4, max_len=48)
+    router = Router([e0, e1])
+    router.start()
+    try:
+        p = [3, 1, 4]
+        ref = library_stream(trained, p, 24, temperature=0.8, seed=5)
+        h = router.submit(np.asarray(p, np.int32), 24,
+                          temperature=0.8, seed=5)
+        assert h.replica.engine is e0          # rr tie-break: first -> 0
+        _await_emitted(h)
+        order = router.migrate(h, target=1)
+        assert order.done.wait(30)
+        assert order.outcome == "migrated", order.outcome
+        tokens, reason = h.result(timeout=60)
+        assert reason == "length" and tokens == ref
+        assert h.replica is router.replicas[1]
+        assert router.replicas[0].migrations_out == 1
+        assert router.replicas[1].migrations_in == 1
+        assert _registry_value("server_migrations_total",
+                               router=router.metrics.label,
+                               reason="rebalance") == 1
+        snap = pt.observability.get_registry().snapshot()
+        hist = next(r for r in snap["serving_migration_seconds"]["series"]
+                    if r["labels"].get("router") == router.metrics.label)
+        assert hist["count"] == 1 and hist["sum"] > 0
+        # /varz migration rollup rides the same snapshot
+        from paddle_tpu.observability.debug_server import _serving_varz
+        roll = _serving_varz(snap)["migration"][router.metrics.label]
+        assert roll["migrations"] == 1
+        assert roll["migration_failures"] == 0
+        assert roll["migration_ms"] > 0
+    finally:
+        router.close(drain=True)
+    assert e0.kv.blocks_used == 0 and e1.kv.blocks_used == 0
+    assert e0.swapped_count == 0 and e1.swapped_count == 0
+
+
+def test_rebalancer_moves_skewed_load(trained):
+    """Pressure-driven rebalancing: the whole mix admitted onto one
+    replica of two (its peer briefly held out of admission) — the
+    rebalancer migrates running sequences to the idle peer, every
+    stream stays bit-identical, and the migrations are registry-
+    counted with reason=rebalance."""
+    e0 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=_slowed())
+    e1 = make_engine(trained, decode_chunk=4, max_len=48)
+    router = Router([e0, e1], rebalance=pt.server.RebalanceConfig(
+        interval_s=0.005, pressure_gap=0.2, hysteresis=2,
+        max_concurrent=2))
+    router.start()
+    try:
+        p = [3, 1, 4]
+        refs = {i: library_stream(trained, p, 28, seed=i)
+                for i in range(6)}
+        router.replicas[1].state = "draining"   # skew the admissions
+        handles = [router.submit(np.asarray(p, np.int32), 28, seed=i)
+                   for i in range(6)]
+        router.replicas[1].state = "ok"
+        assert all(h.replica.engine is e0 for h in handles)
+        for i, h in enumerate(handles):
+            tokens, reason = h.result(timeout=120)
+            assert reason == "length"
+            assert tokens == refs[i]
+        migs = _registry_value("server_migrations_total",
+                               router=router.metrics.label,
+                               reason="rebalance")
+        assert migs is not None and migs >= 1
+        assert router.replicas[1].migrations_in >= 1
+    finally:
+        router.close(drain=True)
+    assert e0.kv.blocks_used == 0 and e1.kv.blocks_used == 0
+
+
+def test_migration_disabled_is_registry_noop(trained):
+    """Acceptance pin: with no RebalanceConfig and no migrate/restart
+    calls, the migration plane adds NOTHING — no rebalancer thread, no
+    migration registry families — the family set is bit-identical to a
+    pre-migration router."""
+    import threading as _threading
+
+    before = {f.name for f in
+              pt.observability.get_registry().families()}
+    e0, e1 = make_engine(trained), make_engine(trained)
+    router = Router([e0, e1])
+    router.start()
+    try:
+        assert router._rebalance_thread is None
+        assert not any("rebalance" in t.name
+                       for t in _threading.enumerate())
+        tokens, reason = router.submit(
+            np.asarray([3, 1, 4], np.int32), 6).result(timeout=60)
+        assert reason == "length" and len(tokens) == 6
+    finally:
+        router.close(drain=True)
+    after = {f.name for f in pt.observability.get_registry().families()}
+    for fam in ("server_migrations_total",
+                "server_migration_failures_total",
+                "serving_migration_seconds"):
+        assert fam not in after - before
+        assert fam not in after or fam in before
+
+
+@pytest.mark.parametrize("phase", ["extract", "transfer", "adopt"])
+def test_migration_fault_each_phase_recovers_exactly_once(trained, phase):
+    """Exactly-once under injected migration faults: a fault at any
+    phase leaves the sequence either still on the source (extract),
+    recovered onto the source (transfer/adopt re-adoption), or
+    migrated on retry — never duplicated, never leaked — and the
+    stream completes bit-identically. The failure is counted under its
+    phase label."""
+    src_faults = {phase: {0}} if phase in ("extract", "transfer") \
+        else None
+    tgt_faults = {"adopt": {0}} if phase == "adopt" else None
+    e0 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=_slowed(
+                         migration_faults=src_faults))
+    e1 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=FaultPlan(migration_faults=tgt_faults)
+                     if tgt_faults else None)
+    router = Router([e0, e1])
+    router.start()
+    try:
+        p = [3, 1, 4]
+        ref = library_stream(trained, p, 24, temperature=0.8, seed=7)
+        h = router.submit(np.asarray(p, np.int32), 24,
+                          temperature=0.8, seed=7)
+        _await_emitted(h)
+        order = router.migrate(h, target=1)
+        assert order.done.wait(30)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "length" and tokens == ref
+        assert _registry_value("server_migration_failures_total",
+                               router=router.metrics.label,
+                               phase=phase) == 1
+        if phase == "extract":
+            assert order.outcome == "failed:extract"
+            assert h.replica is router.replicas[0]   # never left
+        elif phase == "transfer":
+            assert order.outcome == "readopted"
+            assert h.replica is router.replicas[0]   # recovered home
+        else:
+            assert order.outcome == "readopted"
+            plan = e1.faults
+            assert plan.injected_migration_faults == 1
+    finally:
+        router.close(drain=True)
+    for eng in (e0, e1):
+        assert eng.kv.blocks_used == 0 and eng.swapped_count == 0
+
+
+def test_migration_failure_refunds_quota_exactly_once(trained):
+    """Regression (satellite bugfix): when every recovery path fails
+    after the ticket detached the stream — the stream dies
+    replica_failed — the tenant's token bucket is refunded EXACTLY
+    once, however many failure paths observe the corpse."""
+    e0 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=_slowed(migration_faults={
+                         "transfer": {0}, "adopt": {0, 1}}))
+    e1 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=FaultPlan(
+                         migration_faults={"adopt": {0}}))
+    router = Router([e0, e1],
+                    quotas={"t": QuotaConfig(capacity=100.0,
+                                             refill_per_s=0.0)})
+    router.start()
+    try:
+        p = [3, 1, 4]
+        h = router.submit(np.asarray(p, np.int32), 24, tenant="t")
+        bucket = router._bucket_for("t")
+        assert bucket.tokens == 100.0 - 27.0    # cost = 3 + 24
+        _await_emitted(h)
+        order = router.migrate(h, target=1)
+        assert order.done.wait(30)
+        tokens, reason = h.result(timeout=60)
+        assert reason == "replica_failed"
+        assert order.outcome == "failed:terminal"
+        assert bucket.tokens == 100.0           # refunded in full...
+        router._refund_once(h)                  # ...and EXACTLY once
+        assert bucket.tokens == 100.0
+        assert h.quota_refunded
+    finally:
+        router.close(drain=False)
+
+
+def test_restart_replica_zero_dropped_tokens(trained):
+    """Zero-downtime rolling restart under concurrent load: one
+    replica of two drains by MIGRATING its live streams to the peer,
+    rebuilds via the engine factory, and rejoins — every stream
+    delivers its full budget bit-identically (the client connections
+    never closed), the dead engine's registry series are retired, and
+    the restart is counted."""
+    built = []
+
+    def factory():
+        eng = make_engine(trained, decode_chunk=4, max_len=48)
+        built.append(eng)
+        return eng
+
+    e0 = make_engine(trained, decode_chunk=4, max_len=48,
+                     fault_plan=_slowed())
+    e1 = make_engine(trained, decode_chunk=4, max_len=48)
+    dead_label = e0.metrics.engine_label
+    router = Router([e0, e1], engine_factory=factory)
+    router.start()
+    try:
+        p = [3, 1, 4]
+        refs = {i: library_stream(trained, p, 28, seed=i)
+                for i in range(4)}
+        router.replicas[1].state = "draining"   # pin the load on 0
+        handles = [router.submit(np.asarray(p, np.int32), 28, seed=i)
+                   for i in range(4)]
+        router.replicas[1].state = "ok"
+        _await_emitted(handles[0])
+        assert router.restart_replica(0, timeout=60)
+        assert router.replicas[0].state == "ok"
+        assert router.replicas[0].engine is built[0]
+        assert router.replicas[0].restarts_total == 1
+        assert router.replicas[0].migrations_out >= 1
+        for i, h in enumerate(handles):
+            tokens, reason = h.result(timeout=120)
+            assert reason == "length", (i, reason)
+            assert len(tokens) == 28            # zero dropped tokens
+            assert tokens == refs[i]
+        # the drained engine's serving series were retired at rebuild
+        assert _registry_value("serving_submitted_total",
+                               engine=dead_label) is None
+        assert _registry_value("server_replica_restarts_total",
+                               replica=dead_label) == 1
+        migs = _registry_value("server_migrations_total",
+                               router=router.metrics.label,
+                               reason="restart")
+        assert migs is not None and migs >= 1
+        # the rebuilt replica serves fresh admissions
+        tokens, reason = router.submit(
+            np.asarray(p, np.int32), 6).result(timeout=60)
+        assert reason == "length" and len(tokens) == 6
+        # a second restart of a healthy replica also works (rolling)
+        assert router.restart_replica(1, timeout=60)
+        assert router.replicas[1].restarts_total == 1
+    finally:
+        router.close(drain=True)
+
+
+def test_restart_replica_validation(trained):
+    """restart_replica argument/state guards: bad index and non-ok
+    replicas raise ValueError, restarting the LAST healthy replica is
+    refused without force=True (no peer = every stream would fail over
+    — a wipeout, not a rolling restart), and a draining router raises
+    DrainingError."""
+    e0 = make_engine(trained)
+    router = Router([e0])
+    router.start()
+    try:
+        with pytest.raises(ValueError, match="out of range"):
+            router.restart_replica(3)
+        router.replicas[0].state = "failed"
+        with pytest.raises(ValueError, match="needs a healthy"):
+            router.restart_replica(0)
+        router.replicas[0].state = "ok"
+        # the only healthy replica: guarded, force overrides (the
+        # replica is idle, so the forced soft restart is instant)
+        with pytest.raises(ValueError, match="only healthy"):
+            router.restart_replica(0)
+        assert router.restart_replica(0, timeout=60, force=True)
+        assert router.replicas[0].restarts_total == 1
+    finally:
+        router.close(drain=True)
+    with pytest.raises(DrainingError):
+        router.restart_replica(0, force=True)
+
+
+def test_admin_restart_endpoint(trained):
+    """POST /admin/restart drains and restarts one replica over the
+    wire (soft restart — GenerationServer owns no factory), /healthz
+    carries the per-replica migration counters, and bad bodies map to
+    400."""
+    srv = make_server(trained, n=2)
+    try:
+        _, body = _get_json(srv.port, "/healthz", expect=200)
+        assert body["replicas"][0]["migrations_out"] == 0
+        assert body["replicas"][0]["migrations_in"] == 0
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request("POST", "/admin/restart",
+                     json.dumps({"replica": 0}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        conn.close()
+        assert r.status == 200, body
+        assert body["restarted"] is True
+        assert body["state"] == "ok"
+        assert body["restarts_total"] == 1
+        # the restarted server still serves
+        status, _, tokens, done = sse_generate(
+            srv.port, {"prompt": [3, 1, 4], "max_new_tokens": 6})
+        assert status == 200 and len(tokens) == 6
+        assert done["finish_reason"] == "length"
+        # malformed replica index -> 400
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        conn.request("POST", "/admin/restart",
+                     json.dumps({"replica": 99}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_soak_with_migrations_every_request_terminal(trained):
+    """The migration chaos soak: a 2-replica router with the
+    rebalancer ON, seeded fault storms including migration-phase
+    injections (extract/transfer/adopt), preemption pressure, replica
+    deaths with factory rebuilds, AND a rolling restart fired
+    mid-storm — every submitted request reaches a terminal
+    finish_reason, no stream hangs, and surviving engines drain to
+    zero pages and empty swap pools on both sides of every handoff."""
+    def factory():
+        return make_engine(trained, num_slots=2, max_queue=64,
+                           block_size=4, kv_blocks=12, decode_chunk=4,
+                           preempt=True, max_len=32)
+
+    engines = []
+    for i in range(2):
+        eng = factory()
+        eng.faults = FaultPlan.chaos(seed=300 + i, steps=400,
+                                     p_exception=0.004, p_shortage=0.04,
+                                     p_slow=0.05, slow_s=0.002,
+                                     p_migration=0.15)
+        engines.append(eng)
+    router = Router(engines, engine_factory=factory,
+                    restart_backoff_s=0.01, max_stream_retries=2,
+                    rebalance=pt.server.RebalanceConfig(
+                        interval_s=0.005, pressure_gap=0.3,
+                        hysteresis=2, max_concurrent=2))
+    router.start()
+    cfg, _ = trained
+    rng = np.random.RandomState(9)
+    handles, shed = [], 0
+    try:
+        for i in range(24):
+            p = rng.randint(0, cfg.vocab_size,
+                            (int(rng.randint(3, 8)),)).astype(np.int32)
+            kw = {}
+            if i % 3 == 1:
+                kw = dict(temperature=0.8, seed=int(i))
+            try:
+                handles.append(
+                    router.submit(p, int(rng.randint(8, 20)), **kw))
+            except EngineOverloadError:
+                shed += 1
+            if i == 10:
+                # a rolling restart in the middle of the storm; the
+                # replica may be mid-failure — refusal is fine, the
+                # storm continues either way
+                try:
+                    router.restart_replica(0, timeout=30)
+                except (ValueError, DrainingError):
+                    pass
+            time.sleep(0.002)
+        terminal = {"stop", "length", "cancelled", "deadline_exceeded",
+                    "replica_failed"}
+        for h in handles:
+            _, reason = h.result(timeout=120)
+            assert reason in terminal, reason
+        assert len(handles) + shed == 24
+        assert router.drain(timeout=120)
+        for r in router.replicas:
+            if r.state == "ok":
+                assert r.engine.kv.blocks_used == 0
+                assert r.engine.swapped_count == 0
+    finally:
+        router.close(drain=False)

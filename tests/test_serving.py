@@ -1783,3 +1783,271 @@ def test_request_log_preemption_timeline(trained):
     # every request reached a terminal event and the budget delivered
     assert all(r.state == "finished" and len(r.tokens) == 12
                for r in reqs)
+
+# ---------------------------------------------------------------------------
+# cross-replica migration (engine-level halves: MigrationTicket +
+# migrate_out/migrate_in)
+# ---------------------------------------------------------------------------
+
+def _drive_until_running_with_tokens(eng, req, n=2):
+    """Step until `req` has streamed >= n tokens and is still running
+    (callers size max_new so the first collects can't finish it)."""
+    while len(req.tokens) < n:
+        eng.step()
+    assert not req.finished
+
+
+@pytest.mark.parametrize("k", [0, 4])
+def test_migrate_stream_identity_greedy_and_seeded(trained, k):
+    """The tentpole pin: a stream migrated MID-GENERATION between two
+    engines (fence -> ticket -> adopt -> resume) is bit-identical to a
+    never-migrated run — greedy AND seeded, with and without
+    speculation — and both engines drain to zero pages, zero parked
+    sequences. The slot-independent threefry sampler is what makes
+    this work: the ticket's key row continues the per-token split
+    chain on whatever engine (and slot) the sequence lands."""
+    cfg, _ = trained
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    for temp, seed in ((0.0, 0), (0.8, 3)):
+        src = make_engine(trained, speculate_k=k, decode_chunk=4,
+                          max_len=48)
+        dst = make_engine(trained, speculate_k=k, decode_chunk=4,
+                          max_len=48)
+        stream = []
+        req = src.submit(p, 40, temperature=temp, seed=seed,
+                         on_token=lambda r, t: stream.append(t))
+        _drive_until_running_with_tokens(src, req)
+        ticket = src.migrate_out(req)
+        assert ticket.verify()
+        assert ticket.emitted == len(stream)
+        assert req.state == "migrated"          # detached, never emits
+        req2 = dst.migrate_in(ticket,
+                              on_token=lambda r, t: stream.append(t))
+        src.run_until_drained()
+        dst.run_until_drained()
+        assert req2.state == "finished"
+        if temp == 0.0:
+            np.testing.assert_array_equal(
+                req2.output(), sequential_ref(trained, p, 40))
+        ref_eng = make_engine(trained, speculate_k=k, decode_chunk=4,
+                              max_len=48)
+        ref_stream = []
+        ref_eng.submit(p, 40, temperature=temp, seed=seed,
+                       on_token=lambda r, t: ref_stream.append(t))
+        ref_eng.run_until_drained()
+        assert stream == ref_stream, (k, temp)
+        for eng in (src, dst):
+            s = eng.stats()
+            assert s["blocks_used"] == 0 and s["swapped_slots"] == 0
+            assert s["swap_pool_bytes"] == 0
+            eng.close()
+        ref_eng.close()
+
+
+def test_migrate_with_prefix_cache_hit_stream_identical(trained):
+    """Migration of a sequence whose prompt mapped shared prefix-cache
+    blocks: the ticket copies the SHARED block contents into private
+    blocks on the target (the target's cache is cold), and the stream
+    stays bit-identical to a never-migrated warm run."""
+    cfg, _ = trained
+    rng = np.random.RandomState(11)
+    sys_prompt = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+    tail_a = rng.randint(0, cfg.vocab_size, (3,)).astype(np.int32)
+    tail_b = rng.randint(0, cfg.vocab_size, (3,)).astype(np.int32)
+    pa = np.concatenate([sys_prompt, tail_a])
+    pb = np.concatenate([sys_prompt, tail_b])
+
+    def warm_engine():
+        eng = make_engine(trained, num_slots=2, block_size=4,
+                          decode_chunk=4, max_len=48,
+                          prefill_buckets=(4, 16))
+        eng.generate([pa], max_new_tokens=4)    # registers the prefix
+        return eng
+
+    src = warm_engine()
+    dst = make_engine(trained, num_slots=2, block_size=4,
+                      decode_chunk=4, max_len=48, prefill_buckets=(4, 16))
+    stream = []
+    req = src.submit(pb, 30, temperature=0.7, seed=9,
+                     on_token=lambda r, t: stream.append(t))
+    _drive_until_running_with_tokens(src, req)
+    assert src.kv.prefix_hits > 0               # the hit really happened
+    req2 = dst.migrate_in(src.migrate_out(req),
+                          on_token=lambda r, t: stream.append(t))
+    src.run_until_drained()
+    dst.run_until_drained()
+    assert req2.state == "finished"
+    ref_eng = warm_engine()
+    ref_stream = []
+    ref_eng.submit(pb, 30, temperature=0.7, seed=9,
+                   on_token=lambda r, t: ref_stream.append(t))
+    ref_eng.run_until_drained()
+    assert stream == ref_stream
+    assert src.stats()["blocks_used"] <= src.kv.blocks_cached \
+        + src.stats()["blocks_used"]            # shared blocks refcounted
+    dst.close(); src.close(); ref_eng.close()
+
+
+def test_migrate_parked_sequence_from_swap_pool(trained):
+    """A PREEMPTED (host-parked) sequence migrates without any fence or
+    dispatch — its swap-pool record is already serialized — and resumes
+    bit-identically on the target; the source's swap pool shrinks and
+    no pages leak on either side."""
+    from paddle_tpu.serving import FaultPlan
+
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    plan = FaultPlan(slow_steps={i: 0.001 for i in range(2, 10)})
+    tight = make_engine(trained, fault_plan=plan, **PRESSURE)
+    roomy = make_engine(trained, num_slots=4, block_size=4,
+                        decode_chunk=4)
+    streams = {i: [] for i in range(len(prompts))}
+
+    def tap(i):
+        return lambda req, tok: streams[i].append(tok)
+
+    reqs = [tight.submit(p, 12, temperature=0.8, seed=3,
+                         on_token=tap(i))
+            for i, p in enumerate(prompts)]
+    for _ in range(60):
+        tight.step()
+        if tight.swapped_count:
+            break
+    assert tight.swapped_count >= 1
+    parked_req = tight._swapped[0].req
+    idx = reqs.index(parked_req)
+    before = tight.swapped_count
+    ticket = tight.migrate_out(parked_req)
+    assert tight.swapped_count == before - 1
+    roomy.migrate_in(ticket, on_token=tap(idx))
+    tight.run_until_drained()
+    roomy.run_until_drained()
+    # the whole mix is bit-identical to an unpressured run
+    ref = make_engine(trained, num_slots=4, block_size=4,
+                      decode_chunk=4)
+    ref_streams = {i: [] for i in range(len(prompts))}
+
+    def rtap(i):
+        return lambda req, tok: ref_streams[i].append(tok)
+
+    for i, p in enumerate(prompts):
+        ref.submit(p, 12, temperature=0.8, seed=3, on_token=rtap(i))
+    ref.run_until_drained()
+    assert streams == ref_streams
+    for eng in (tight, roomy):
+        assert eng.stats()["blocks_used"] == 0
+        assert eng.swapped_count == 0
+        eng.close()
+    ref.close()
+
+
+def test_migrate_out_refuses_during_drain_not_deadlock(trained):
+    """Regression (satellite bugfix): migrate_out/migrate_in on a
+    DRAINING engine refuse immediately with MigrationError — they must
+    never park a sequence nobody will resume (the drain-loop deadlock)
+    — and the drain itself still finishes every stream."""
+    from paddle_tpu.serving import MigrationError
+
+    src = make_engine(trained, decode_chunk=4, max_len=48)
+    peer = make_engine(trained, decode_chunk=4, max_len=48)
+    p = np.asarray([1, 2, 3], np.int32)
+    req = src.submit(p, 30)
+    _drive_until_running_with_tokens(src, req)
+    src.begin_drain()
+    assert src.draining
+    with pytest.raises(MigrationError, match="draining"):
+        src.migrate_out(req)
+    # the refused sequence is untouched: the drain completes it
+    src.run_until_drained()
+    assert req.state == "finished" and len(req.tokens) == 30
+    np.testing.assert_array_equal(req.output(),
+                                  sequential_ref(trained, p, 30))
+    # inbound adoption refuses on a draining engine too
+    req2 = peer.submit(p, 30)
+    _drive_until_running_with_tokens(peer, req2)
+    ticket = peer.migrate_out(req2)
+    with pytest.raises(MigrationError, match="draining"):
+        src.migrate_in(ticket)
+    # the ticket survives the refusal: a healthy engine adopts it
+    other = make_engine(trained, decode_chunk=4, max_len=48)
+    req3 = other.migrate_in(ticket)
+    peer.run_until_drained()
+    other.run_until_drained()
+    np.testing.assert_array_equal(req3.output(),
+                                  sequential_ref(trained, p, 30))
+    src.close(); peer.close(); other.close()
+
+
+def test_migration_ticket_integrity_and_compatibility(trained):
+    """The ticket's safety rails: a corrupted payload fails the
+    checksum, and geometry/speculation mismatches are rejected whole —
+    TicketError, nothing mutated on the refusing engine."""
+    from paddle_tpu.serving import TicketError
+
+    src = make_engine(trained, decode_chunk=4, max_len=48)
+    p = np.asarray([5, 7, 11], np.int32)
+    req = src.submit(p, 30)
+    _drive_until_running_with_tokens(src, req)
+    ticket = src.migrate_out(req)
+    assert ticket.version == pt.serving.TICKET_VERSION
+    assert ticket.swap_bytes == ticket.payload.nbytes
+    # corruption: flip one payload value (via a copy — the extracted
+    # payload buffer is read-only) and the checksum catches it
+    tampered = ticket.payload.copy()
+    tampered[0, 0, 0, 0, 0, 0] += 1.0
+    good_payload, ticket.payload = ticket.payload, tampered
+    assert not ticket.verify()
+    victim = make_engine(trained, decode_chunk=4, max_len=48)
+    before = victim.stats()
+    with pytest.raises(TicketError, match="checksum"):
+        victim.migrate_in(ticket)
+    after = victim.stats()
+    assert after["swapped_slots"] == before["swapped_slots"] == 0
+    ticket.payload = good_payload
+    assert ticket.verify()
+    # geometry: block size and speculation config must match
+    with pytest.raises(TicketError, match="block_size"):
+        make_engine(trained, block_size=8, max_len=48).migrate_in(ticket)
+    with pytest.raises(TicketError, match="speculation"):
+        make_engine(trained, speculate_k=4, max_len=48).migrate_in(ticket)
+    # the intact ticket still adopts fine after every rejection
+    dst = make_engine(trained, decode_chunk=4, max_len=48)
+    req2 = dst.migrate_in(ticket)
+    src.run_until_drained()
+    dst.run_until_drained()
+    np.testing.assert_array_equal(req2.output(),
+                                  sequential_ref(trained, p, 30))
+    src.close(); dst.close(); victim.close()
+
+
+def test_migration_request_log_chains_hops(trained):
+    """migrate_out/migrate_in land in the request event log with
+    replica labels and payload bytes, and the adopting engine's new id
+    chains to the source id via rerouted_from — the same link failover
+    re-submissions write, so one request stays ONE timeline."""
+    from paddle_tpu.observability import request_log as rl
+
+    with rl.request_logging() as log:
+        src = make_engine(trained, decode_chunk=4, max_len=48)
+        dst = make_engine(trained, decode_chunk=4, max_len=48)
+        p = np.asarray([2, 7, 1], np.int32)
+        req = src.submit(p, 30)
+        _drive_until_running_with_tokens(src, req)
+        ticket = src.migrate_out(req)
+        req2 = dst.migrate_in(ticket)
+        src.run_until_drained()
+        dst.run_until_drained()
+        src.close(); dst.close()
+    events = log.recent()
+    out = next(e for e in events if e["kind"] == "migrate_out")
+    assert out["request_id"] == ticket.request_id
+    assert out["replica"] == src.metrics.engine_label
+    assert out["bytes"] == ticket.swap_bytes and out["bytes"] > 0
+    assert out["phase"] == "running"
+    inn = next(e for e in events if e["kind"] == "migrate_in")
+    assert inn["request_id"] == req2.request_id
+    assert inn["rerouted_from"] == ticket.request_id
+    assert inn["replica"] == dst.metrics.engine_label
+    # the superseded id left the in-flight set at adoption, and the
+    # new id went terminal at finish
+    assert log.inflight_ids() == []

@@ -677,3 +677,112 @@ def test_api_freeze_spec_is_current():
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_bench_serving_rebalance_row_shape():
+    """tools/bench_serving --rebalance: one row over the skewed-
+    admission workload with registry-sourced migration columns — the
+    rebalancer-on run really migrated (and the off run registered
+    ZERO migrations), every migration got a latency sample, the hot
+    replica's tail columns are present both ways, and the streams were
+    asserted bit-identical inside the workload itself."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_rebalance("tiny", requests=6)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "tiny_serving_rebalance_r2"
+    assert row["value"] > 0 and row["unit"] == "tokens/s"
+    e = row["extra"]
+    assert e["requests"] == 6 and e["replicas"] == 2
+    assert e["migrations"] >= 1                 # the rebalancer fired
+    assert e["migrations_off"] == 0             # baseline stayed put
+    assert e["migration_ms"] is not None and e["migration_ms"] > 0
+    assert e["migration_failures"] == 0
+    assert e["p99_tpot_ms_on"] is not None
+    assert e["p99_tpot_ms_off"] is not None
+    assert e["p99_ttft_ms_on"] is not None
+    assert e["p99_ttft_ms_off"] is not None
+    assert e["tokens_per_s_off"] > 0
+    # both routers were torn down: no leftover migration series
+    snap = pt.observability.get_registry().snapshot()
+    assert not snap.get("server_migrations_total", {}).get("series")
+
+
+def test_serving_summary_stitches_migration_hops(tmp_path):
+    """tools/serving_summary renders a migrated request as ONE
+    timeline: the migrate_in's rerouted_from link joins the source and
+    target engine ids through the same union-find failover chains use,
+    the row carries a MIGRATE annotation + migration count, and the
+    footer counts migrated requests."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+    from paddle_tpu.observability.request_log import (
+        RequestLog, install_request_log, uninstall_request_log)
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                    max_pos=64, dropout=0.0, attn_impl="xla")
+    main_prog, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+
+    def make():
+        return ServingEngine(params, cfg, ServingConfig(
+            num_slots=2, prefill_buckets=(4, 8), max_len=48,
+            decode_chunk=4))
+
+    log = install_request_log(RequestLog(log_dir=str(tmp_path)))
+    try:
+        src, dst = make(), make()
+        req = src.submit(np.asarray([3, 1, 4], np.int32), 30)
+        while len(req.tokens) < 2:
+            src.step()
+        ticket = src.migrate_out(req)
+        req2 = dst.migrate_in(ticket)
+        src.run_until_drained()
+        dst.run_until_drained()
+        assert req2.state == "finished"
+        src.close()
+        dst.close()
+        source_rid, target_rid = req.request_id, req2.request_id
+    finally:
+        uninstall_request_log()
+
+    log_path = str(tmp_path / "serving.jsonl")
+    cli = os.path.join(REPO, "tools/serving_summary.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, cli, log_path, "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    rows = json.loads(r.stdout)
+    row = next(rw for rw in rows if rw["request_id"] == source_rid)
+    assert row["chain"] == [source_rid, target_rid]   # one timeline
+    assert "MIGRATE" in row["annotations"]
+    assert "FAILOVER" not in row["annotations"]       # hop, not failure
+    assert "PREEMPT" not in row["annotations"]        # handoff, not
+    assert row["preemptions"] == 0                    # page pressure
+    assert row["migrations"] == 1
+    assert row["tokens"] == 30
+    # table mode: annotation inline + migrated count in the footer
+    r = subprocess.run([sys.executable, cli, log_path],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    assert "MIGRATE" in r.stdout
+    assert "1 migrated" in r.stdout
+    # --request-id on EITHER id prints the stitched event timeline
+    r = subprocess.run([sys.executable, cli, log_path,
+                        "--request-id", target_rid],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    order = [line.split()[3] for line in r.stdout.splitlines()
+             if line.strip().startswith("+")]
+    assert order.index("migrate_out") < order.index("migrate_in") \
+        < order.index("finished")

@@ -62,6 +62,20 @@ wire tax is the delta between the paired rows:
 
     python tools/bench_serving.py tiny --http
 
+`--rebalance` runs the CROSS-REPLICA MIGRATION workload instead: the
+request mix is admitted SKEWED onto one replica of N (the others
+briefly held out of admission) and run twice — rebalancer OFF (the
+hot replica grinds through its backlog alone while its peers idle)
+then ON (the router's pressure loop live-migrates running sequences
+to the idle peers). One row with registry-sourced `migrations` /
+`migration_ms` (server_migrations_total + the serving_migration_seconds
+histogram) and the hot replica's p99 TPOT with the rebalancer on vs
+off — the tail-latency win rebalancing exists for, as a printed
+number. Token streams are bit-identical on and off (pinned in
+tests/test_server.py):
+
+    python tools/bench_serving.py tiny --rebalance
+
 `--speculate K...` runs the SPECULATIVE-DECODING workload instead: a
 repetitive-text request mix (prompts tile a short motif — the regime
 the in-graph n-gram self-drafter exists for) swept over the given
@@ -246,14 +260,15 @@ def run_model(name, concurrencies=None, requests_per_level=None,
     return rows
 
 
-def _registry_series(engine_label, family):
-    """This engine's series row for `family` from a registry snapshot
-    (None when absent) — the same data a /metrics scrape reports."""
+def _registry_series(label, family, label_key="engine"):
+    """The series row for `family` matching {label_key: label} in a
+    registry snapshot (None when absent) — the same data a /metrics
+    scrape reports."""
     from paddle_tpu.observability import get_registry
 
     snap = get_registry().snapshot()
     return next((r for r in snap.get(family, {}).get("series", [])
-                 if r["labels"].get("engine") == engine_label), None)
+                 if r["labels"].get(label_key) == label), None)
 
 
 def _registry_counter(engine_label, family):
@@ -475,13 +490,151 @@ def run_oversubscribe(name, requests=None, concurrency=None):
     return [row]
 
 
-def _registry_hist_ms(engine_label, family):
+def _registry_hist_ms(label, family, label_key="engine"):
     """Mean of a latency histogram in ms (sum/count of the registry
-    snapshot series) — the swap_in_ms/swap_out_ms columns."""
-    series = _registry_series(engine_label, family)
+    snapshot series matching {label_key: label}) — the swap_in_ms /
+    swap_out_ms / migration_ms columns."""
+    series = _registry_series(label, family, label_key)
     if not series or not series.get("count"):
         return None
     return round(series["sum"] / series["count"] * 1e3, 3)
+
+
+# rebalance workload geometry per model: (prefill buckets, prompt
+# length, max_new, replicas, per-replica slots). The mix is admitted
+# skewed onto replica 0 (its peers briefly held out of admission), so
+# the run measures what the pressure-driven rebalancer buys: live
+# migrations onto the idle peers vs the hot replica grinding alone.
+REBALANCE = {
+    "tiny": ((8, 16), 12, 48, 2, 2),
+    "gpt2": ((32, 64), 48, 64, 2, 4),
+}
+
+
+def run_rebalance(name, requests=None, replicas=None):
+    """The --rebalance workload: a skewed admission burst onto one
+    replica of N, run twice on fresh engines — rebalancer OFF (the
+    baseline: the hot replica serves its whole backlog) then ON (the
+    router live-migrates running sequences to the idle peers). One row
+    with registry-sourced migration columns (`migrations`,
+    `migration_ms`) and the HOT replica's p99 TPOT on vs off — the
+    tail-latency number rebalancing exists to shrink. Token streams
+    are bit-identical in both runs (each request re-derives the same
+    seeded stream; migration identity is pinned in tests)."""
+    import paddle_tpu as pt
+    from paddle_tpu.server import RebalanceConfig, Router
+
+    gpt_kwargs, _, _, _ = MODELS[name]
+    buckets, prompt_len, max_new, n_replicas, slots = REBALANCE[name]
+    replicas = replicas or n_replicas
+    requests = requests or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    cfg, params = build_params(gpt_kwargs)
+    max_len = prompt_len + max_new
+    results = {}
+    for enabled in (False, True):
+        engines = []
+        for _ in range(replicas):
+            eng = pt.serving.ServingEngine(
+                params, cfg,
+                pt.serving.ServingConfig(num_slots=slots,
+                                         max_queue=requests,
+                                         prefill_buckets=buckets,
+                                         max_len=max_len,
+                                         decode_chunk=8))
+            # warm every executable on the library path, then drop the
+            # warmup's registry rows (the standard bench discipline)
+            wrng = np.random.RandomState(12345)
+            eng.generate([wrng.randint(0, cfg.vocab_size,
+                                       (max(1, b - 2),)).astype(np.int32)
+                          for b in buckets], max_new_tokens=2)
+            # warm the migration executables too (swap_out / release /
+            # swap_in compile lazily on first use, and a cold compile
+            # would dominate the migration_ms column): one ticket per
+            # engine, extracted and re-adopted locally
+            wreq = eng.submit(wrng.randint(0, cfg.vocab_size, (4,))
+                              .astype(np.int32), max_new)
+            while not wreq.tokens:
+                eng.step()
+            eng.migrate_in(eng.migrate_out(wreq))
+            eng.run_until_drained()
+            old = eng.metrics
+            old.unregister()
+            eng.metrics = pt.serving.EngineMetrics(
+                max_tokens_per_dispatch=old.max_tokens_per_dispatch,
+                speculate_k=old.speculate_k)
+            eng.kv.prefix_hits = eng.kv.prefix_misses = 0
+            engines.append(eng)
+        router = Router(
+            engines,
+            rebalance=RebalanceConfig(interval_s=0.002,
+                                      pressure_gap=0.2, hysteresis=2,
+                                      max_concurrent=2)
+            if enabled else None)
+        router.start()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+                   .astype(np.int32) for _ in range(requests)]
+        # skew: hold every peer out of admission for the burst, so the
+        # whole mix lands on replica 0 and the imbalance is maximal
+        for r in router.replicas[1:]:
+            r.state = "draining"
+        t0 = time.perf_counter()
+        handles = [router.submit(p, max_new, seed=i)
+                   for i, p in enumerate(prompts)]
+        for r in router.replicas[1:]:
+            r.state = "ok"
+        streams = [h.result(timeout=600)[0] for h in handles]
+        dt = time.perf_counter() - t0
+        tokens = sum(len(s) for s in streams)
+        hot_label = engines[0].metrics.engine_label
+        hot = _registry_series(hot_label, "serving_tpot_seconds")
+        hot_ttft = _registry_series(hot_label, "serving_ttft_seconds")
+        results[enabled] = {
+            "dt": dt, "tokens": tokens, "streams": streams,
+            "p99_tpot_ms": round(hot["p99"] * 1e3, 3)
+            if hot and hot.get("p99") is not None else None,
+            "p99_ttft_ms": round(hot_ttft["p99"] * 1e3, 3)
+            if hot_ttft and hot_ttft.get("p99") is not None else None,
+            "migrations": _registry_router_counter(
+                router.metrics.label, "server_migrations_total"),
+            "migration_failures": _registry_router_counter(
+                router.metrics.label, "server_migration_failures_total"),
+            "migration_ms": _registry_hist_ms(
+                router.metrics.label, "serving_migration_seconds",
+                label_key="router"),
+        }
+        router.close()               # drains + refcounted engine close()
+    off, on = results[False], results[True]
+    assert off["streams"] == on["streams"], \
+        "rebalanced streams diverged from the baseline run"
+    return [{
+        "metric": f"{name}_serving_rebalance_r{replicas}",
+        "value": round(on["tokens"] / on["dt"], 2),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {
+            "requests": requests,
+            "replicas": replicas,
+            "num_slots": slots,
+            "max_new": max_new,
+            # registry-sourced migration columns (rebalancer-on run)
+            "migrations": on["migrations"],
+            "migration_failures": on["migration_failures"],
+            "migration_ms": on["migration_ms"],
+            # the tail-latency comparison the workload exists for: the
+            # HOT replica's p99 TTFT (queue relief — migrations free
+            # slots for its backlog) and p99 TPOT with peers helping
+            # vs grinding alone
+            "p99_ttft_ms_on": on["p99_ttft_ms"],
+            "p99_ttft_ms_off": off["p99_ttft_ms"],
+            "p99_tpot_ms_on": on["p99_tpot_ms"],
+            "p99_tpot_ms_off": off["p99_tpot_ms"],
+            "tokens_per_s_off": round(off["tokens"] / off["dt"], 2),
+            "migrations_off": off["migrations"],   # pinned 0: the
+            # rebalancer-off run must not register a single migration
+        },
+    }]
 
 
 # speculative workload geometry per model: (prefill buckets, motif
@@ -834,6 +987,13 @@ def main(argv=None):
                          "registry-sourced accepted_per_pass / "
                          "spec_accept_rate columns; streams are "
                          "bit-identical at every K")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="run the cross-replica migration workload "
+                         "instead: a skewed admission burst onto one "
+                         "replica of N, rebalancer off vs on — one row "
+                         "with registry-sourced migrations / "
+                         "migration_ms and the hot replica's p99 TPOT "
+                         "both ways (streams bit-identical on and off)")
     ap.add_argument("--oversubscribe", action="store_true",
                     help="run the over-subscription workload instead: "
                          "requests demanding more KV pages than the "
@@ -868,9 +1028,18 @@ def main(argv=None):
         clashing = [f for f, on in (("--shared-prefix", args.shared_prefix),
                                     ("--speculate",
                                      args.speculate is not None),
-                                    ("--http", args.http)) if on]
+                                    ("--http", args.http),
+                                    ("--rebalance", args.rebalance)) if on]
         if clashing:
             ap.error(f"--oversubscribe replaces the standard workload; "
+                     f"drop {' '.join(clashing)}")
+    if args.rebalance:
+        clashing = [f for f, on in (("--shared-prefix", args.shared_prefix),
+                                    ("--speculate",
+                                     args.speculate is not None),
+                                    ("--http", args.http)) if on]
+        if clashing:
+            ap.error(f"--rebalance replaces the standard workload; "
                      f"drop {' '.join(clashing)}")
 
     server_started = False
@@ -884,6 +1053,8 @@ def main(argv=None):
         for name in args.models or list(MODELS):
             if args.shared_prefix:
                 rows = run_shared_prefix(name)
+            elif args.rebalance:
+                rows = run_rebalance(name)
             elif args.oversubscribe:
                 rows = run_oversubscribe(name)
             elif args.speculate is not None:

@@ -21,6 +21,11 @@ Annotations:
   PREEMPT    the sequence was host-swapped out under page pressure
              (and later resumed)
   FAILOVER   the stream was re-submitted after a replica failure
+  MIGRATE    the sequence was live-migrated across replicas (count in
+             parentheses when it hopped more than once); migration
+             hops chain through the same ``rerouted_from`` union-find
+             as failover re-submissions, so a migrated request is ONE
+             timeline keyed by its original id
   SLO-MISS   the stream closed outside one of its tenant's SLO
              objectives (named in parentheses)
   SHED       rejected at the engine admission door
@@ -47,6 +52,7 @@ EMPTY_HINT = ("no request events were written there. Install a "
 # terminal reasons a timeline ends on, in stream_closed/finished order
 _PHASE_EVENTS = ("submitted", "queued", "routed", "admitted", "prefill",
                  "decode", "preempted", "swapped_in", "failover",
+                 "displaced", "migrate_out", "migrate_in",
                  "finished", "cancelled", "shed", "stream_closed")
 
 
@@ -132,7 +138,16 @@ def summarize(events):
         notes = []
         if "preempted" in kinds:
             notes.append("PREEMPT")
-        if "failover" in kinds or len(chain) > 1:
+        migrations = kinds.count("migrate_in")
+        if migrations:
+            notes.append("MIGRATE" if migrations == 1
+                         else f"MIGRATE(x{migrations})")
+        # planned moves (restart displacement of a queued request) also
+        # chain ids via rerouted_from but are journaled "displaced" —
+        # only unexplained extra hops count as failover
+        displaced = kinds.count("displaced")
+        if "failover" in kinds \
+                or len(chain) > 1 + migrations + displaced:
             notes.append("FAILOVER")
         missed = (closed or {}).get("slo_missed") or []
         if missed:
@@ -156,6 +171,7 @@ def summarize(events):
             "total_ms": _ms(t0, t_end),
             "dispatches": len(decode_evs),
             "preemptions": kinds.count("preempted"),
+            "migrations": migrations,
             "annotations": notes,
             "events": [{"kind": rec["kind"],
                         "t_ms": _ms(t0, rec.get("t_mono")),
@@ -245,11 +261,13 @@ def main(argv=None):
               f"{' '.join(r['annotations'])}")
     n_pre = sum(1 for r in rows if "PREEMPT" in r["annotations"])
     n_fo = sum(1 for r in rows if "FAILOVER" in r["annotations"])
+    n_mig = sum(1 for r in rows if r["migrations"])
     n_miss = sum(1 for r in rows
                  if any(a.startswith("SLO-MISS") for a in
                         r["annotations"]))
     print(f"-- {len(rows)} requests, {n_pre} preempted, "
-          f"{n_fo} failed over, {n_miss} SLO miss(es)")
+          f"{n_fo} failed over, {n_mig} migrated, "
+          f"{n_miss} SLO miss(es)")
     return 0
 
 
