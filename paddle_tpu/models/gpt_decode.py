@@ -745,7 +745,7 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
 def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
                            temps, done, remaining, eos_ids, chunk,
                            sample_fn=None, speculate_k=0,
-                           spec_state=None):
+                           spec_state=None, arena_constraint=None):
     """gpt_decode_chunk_slots over the paged pool: `chunk` iterations of
     gpt_decode_step_pages + per-slot sampling + in-graph EOS/budget
     masking in ONE lax.scan. Carry/masking semantics are identical to
@@ -765,7 +765,14 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
     (frozen slots' AND past-the-page-row writes redirected to scratch),
     and commits the accepted run + one corrected token in-graph.
     Returns (block (chunk, speculate_k+1, S), counts (chunk, S),
-    tokens, arena, ts, keys, done, remaining, spec_state)."""
+    tokens, arena, ts, keys, done, remaining, spec_state).
+
+    `arena_constraint` (tensor-parallel serving, else None): a
+    callable re-asserting the arena's mesh sharding, applied to the
+    scan carry at the top of every iteration so GSPMD keeps the
+    per-head block layout stable through the whole fused loop — one
+    sharded executable, no mid-scan resharding/all-gather of the
+    arena. Purely a layout pin: the computed values are unchanged."""
     import jax
     import jax.numpy as jnp
 
@@ -777,6 +784,8 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
         prev, table = spec_state
 
         def verify(inputs, arena, ts, done):
+            if arena_constraint is not None:
+                arena = arena_constraint(arena)
             return gpt_decode_verify_pages(params, cfg, inputs, arena,
                                            pt, ts, done)
 
@@ -793,6 +802,8 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
 
     def body(carry, _):
         tok, arena, ts, keys, done, rem = carry
+        if arena_constraint is not None:
+            arena = arena_constraint(arena)
         logits, arena = gpt_decode_step_pages(
             params, cfg, tok, arena, pt, ts, done)
         nxt, keys = jax.vmap(sample_fn)(keys, logits, temps)

@@ -1,1 +1,1 @@
-from .plan import ShardingPlan  # noqa: F401
+from .plan import ServingTPPlan, ShardingPlan  # noqa: F401

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
-__all__ = ["ShardingPlan", "CollectiveSpmdPlan"]
+__all__ = ["ShardingPlan", "CollectiveSpmdPlan", "ServingTPPlan"]
 
 
 class ShardingPlan:
@@ -277,3 +277,160 @@ class CollectiveSpmdPlan(ShardingPlan):
             out_specs=(out_mut_specs, P(), P(), P()),
             check_vma=False)
         return jax.jit(smapped, donate_argnums=(0,))
+
+
+# Megatron-style tensor-parallel layout for the GPT decode parameter
+# pytree (gpt_decode.collect_gpt_params): column-parallel into the
+# sharded dimension, row-parallel out of it, so each transformer block
+# needs exactly ONE cross-chip reduction per matmul pair (GSPMD inserts
+# the psum after out/mlp2). Keys are (w spec, b spec) PartitionSpec
+# parts per projection; everything not listed (wte, wpe, layer norms)
+# replicates — the embedding/head read full logits on every chip, which
+# is what keeps the serving sampler a pure per-slot function.
+_GPT_TP_SPECS = {
+    "q": ((None, "tp"), ("tp",)),      # column: heads split over tp
+    "k": ((None, "tp"), ("tp",)),
+    "v": ((None, "tp"), ("tp",)),
+    "out": (("tp", None), ()),         # row: contraction dim split
+    "mlp1": ((None, "tp"), ("tp",)),   # column: ffn width split
+    "mlp2": (("tp", None), ()),        # row
+}
+
+
+class ServingTPPlan:
+    """Tensor-parallel mesh + partition placement for the serving
+    engine's pjit-sharded executable family (prefill, fused decode
+    chunk, verify, admit, release, swap) — the ParallelExecutor/
+    DeviceWorker multi-device INFERENCE story, reusing the same GSPMD
+    discipline the training ShardingPlan rides: annotate shardings on a
+    jax.sharding.Mesh, let the compiler partition the single XLA
+    computation and schedule the collectives over ICI.
+
+    Layout (mesh_shape=(tp,), one axis "tp"):
+
+      * params — Megatron TP (_GPT_TP_SPECS): q/k/v/mlp1 column-
+        parallel, out/mlp2 row-parallel, embeddings + LNs replicated.
+      * KV block arena (layers, 2, num_blocks, heads, bs, hd) — sharded
+        on the HEADS axis, co-located with the q/k/v shards so paged
+        attention never moves K/V across chips; per-chip HBM for the
+        arena is pool_bytes / tp (the serve-a-bigger-model win).
+      * page table, decode carry, threefry key rows, n-gram drafter
+        state — REPLICATED, so every host-side scheduler/allocator path
+        (admission, page mapping, prefix hashing, collect, swap) is
+        mesh-oblivious and unchanged.
+
+    Divisibility is enforced up front (heads % tp, ffn % tp): GSPMD
+    would pad uneven shards, and padded reductions break the
+    token-identity discipline the serving tests pin.
+    """
+
+    def __init__(self, cfg, mesh_shape: Tuple[int, ...],
+                 devices=None, axis_name: str = "tp"):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh_shape = tuple(int(m) for m in mesh_shape)
+        if len(mesh_shape) != 1 or mesh_shape[0] < 1:
+            raise ValueError(
+                f"serving mesh_shape must be a 1-tuple (tp,) with "
+                f"tp >= 1, got {mesh_shape}")
+        self.tp = mesh_shape[0]
+        self.mesh_shape = mesh_shape
+        self.axis_name = axis_name
+        devs = list(devices if devices is not None else jax.devices())
+        if self.tp > len(devs):
+            raise ValueError(
+                f"mesh_shape {mesh_shape} needs {self.tp} devices but "
+                f"only {len(devs)} are visible (on CPU, set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N)")
+        if cfg.heads % self.tp:
+            raise ValueError(
+                f"cfg.heads {cfg.heads} not divisible by tp {self.tp} "
+                "— attention heads shard evenly or not at all")
+        if cfg.ffn % self.tp:
+            raise ValueError(
+                f"cfg.ffn {cfg.ffn} not divisible by tp {self.tp}")
+        self.mesh = Mesh(np.asarray(devs[:self.tp]), (axis_name,))
+
+    # -- shardings -----------------------------------------------------------
+
+    def _nsh(self, *parts):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec(*parts))
+
+    @property
+    def replicated(self):
+        return self._nsh()
+
+    @property
+    def arena_sharding(self):
+        """(layers, 2, num_blocks, heads, bs, hd): heads on tp."""
+        return self._nsh(None, None, None, self.axis_name)
+
+    @property
+    def payload_sharding(self):
+        """Swap-out payload (layers, 2, P, heads, bs, hd): heads on tp
+        — BY CONSTRUCTION the same per-head split as the arena it was
+        gathered from (aliased so the two layouts can never diverge)."""
+        return self.arena_sharding
+
+    # -- placement -----------------------------------------------------------
+
+    def shard_params(self, params):
+        """device_put the GPT decode pytree onto the mesh under the
+        Megatron TP layout (embeddings/LNs replicated)."""
+        import jax
+
+        def put(v, *parts):
+            return jax.device_put(v, self._nsh(*parts))
+
+        out = {"wte": put(params["wte"]), "wpe": put(params["wpe"]),
+               "lnf": {k: put(v) for k, v in params["lnf"].items()},
+               "blocks": []}
+        for blk in params["blocks"]:
+            nb = {"ln1": {k: put(v) for k, v in blk["ln1"].items()},
+                  "ln2": {k: put(v) for k, v in blk["ln2"].items()}}
+            for nm, (wspec, bspec) in _GPT_TP_SPECS.items():
+                nb[nm] = {"w": put(blk[nm]["w"], *wspec),
+                          "b": put(blk[nm]["b"], *bspec)}
+            out["blocks"].append(nb)
+        return out
+
+    def shard_arena(self, arena):
+        """Place the KV block arena heads-sharded over the mesh."""
+        import jax
+        return jax.device_put(arena, self.arena_sharding)
+
+    def replicate(self, tree):
+        """device_put a pytree fully replicated (page table, decode
+        carry, sampler keys, drafter state — the host-logic surfaces)."""
+        import jax
+        rep = self.replicated
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, rep), tree)
+
+    # -- in-graph constraints ------------------------------------------------
+    #
+    # Applied to every jitted entry point's outputs (and, through the
+    # kernels' arena_constraint hook, inside the fused chunk scan): the
+    # donated buffers must come back with EXACTLY the layout they went
+    # in with, or XLA re-lays the arena out mid-pipeline and donation
+    # degrades to a copy.
+
+    def constrain_arena(self, arena):
+        import jax
+        return jax.lax.with_sharding_constraint(arena,
+                                                self.arena_sharding)
+
+    def constrain_payload(self, payload):
+        import jax
+        return jax.lax.with_sharding_constraint(payload,
+                                                self.payload_sharding)
+
+    def constrain_rep(self, tree):
+        """with_sharding_constraint(replicated) over a pytree."""
+        import jax
+        rep = self.replicated
+        return jax.tree_util.tree_map(
+            lambda v: jax.lax.with_sharding_constraint(v, rep), tree)

@@ -455,6 +455,19 @@ class Replica:
     def label(self) -> str:
         return self.engine.metrics.engine_label
 
+    @property
+    def mesh_shape(self):
+        """The replica engine's serving mesh geometry, (tp,) — (1,)
+        for a single-chip engine. Heterogeneous-mesh fleets are first-
+        class: admission routes on the LOGICAL gauges (queue depth,
+        slots, blocks), which are mesh-oblivious, and migration
+        tickets carry the full-head layout, so a tp=2 replica's
+        sequences rebalance onto tp=4 or single-chip peers like any
+        other handoff (ticket.compatible pre-screens geometry). The
+        field exists so /healthz and the rebalance journal can SHOW
+        which replicas are tensor-parallel."""
+        return self.engine.mesh_shape
+
     def load(self) -> int:
         """Live queue + slot occupancy, read from the engine's registry
         gauges (the same numbers a /metrics scrape sees)."""

@@ -284,6 +284,13 @@ class _Handler(BaseHTTPRequestHandler):
                  "queue_depth": int(r.engine.metrics.queue_depth),
                  "kv_blocks_used": int(r.engine.metrics.kv_blocks_used),
                  "kv_blocks_total": int(r.engine.metrics.kv_blocks_total),
+                 # mesh geometry next to the block gauges: which
+                 # replicas are tensor-parallel, and the KV bytes ONE
+                 # chip actually holds (pool_bytes / tp) — whole-arena
+                 # numbers alone would overstate per-chip HBM
+                 "mesh_shape": list(r.mesh_shape),
+                 "hbm_per_chip_bytes": int(
+                     r.engine.kv.hbm_per_chip_bytes),
                  "swapped_slots": int(r.engine.metrics.swapped_slots),
                  "preemptions": int(r.engine.metrics.preemptions),
                  # completed cross-replica migrations this replica

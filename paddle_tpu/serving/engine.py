@@ -94,6 +94,18 @@ class ServingConfig:
     token streams stay bit-identical to speculate_k=0;
     speculate_ngram sizes the hashed per-slot drafter table.
 
+    Mesh knob: mesh_shape=(tp,) builds the WHOLE executable family
+    (prefill, fused decode chunk, verify, admit, release, swap) GSPMD-
+    sharded over a tp-device tensor-parallel mesh — attention heads and
+    MLP widths split on the "tp" axis, the paged KV block arena sharded
+    per-head alongside them (each chip holds pool_bytes/tp), page table
+    and decode carry replicated. Token streams are pinned identical to
+    mesh_shape=None (single chip), greedy and seeded, with and without
+    speculation, across preempt/resume and migration; compile count is
+    unchanged. Requires tp visible devices and cfg.heads % tp ==
+    cfg.ffn % tp == 0. None (the default) builds the single-chip engine
+    with zero mesh machinery.
+
     Observability knobs: dispatch_timing=True attributes every fused
     decode dispatch's wall time into launch-side host work vs the
     blocking wait for its result (serving_dispatch_{host,device}_seconds
@@ -113,6 +125,7 @@ class ServingConfig:
                  speculate_ngram: int = 512,
                  preempt: bool = False,
                  preempt_policy="newest",
+                 mesh_shape: Optional[Sequence[int]] = None,
                  fault_plan=None,
                  dispatch_timing: bool = False,
                  clock: Callable[[], float] = time.monotonic):
@@ -150,6 +163,11 @@ class ServingConfig:
         # Resumed streams are bit-identical to never-preempted runs.
         self.preempt = bool(preempt)
         self.preempt_policy = preempt_policy
+        # tensor-parallel serving mesh (None = single chip): (tp,)
+        # normalized to a tuple; geometry/divisibility is validated by
+        # ServingTPPlan at engine construction where cfg is in hand
+        self.mesh_shape = tuple(int(m) for m in mesh_shape) \
+            if mesh_shape is not None else None
         # deterministic fault injection (serving.faults.FaultPlan):
         # scheduled step exceptions / forced page shortages / delays —
         # None in production
@@ -235,15 +253,27 @@ class ServingEngine:
         import jax.numpy as jnp
         dtype = params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
             else jnp.float32
+        # tensor-parallel mesh plan: built ONCE here (validates device
+        # count + head/ffn divisibility), threaded into the scheduler,
+        # which shards params + arena at construction so every jitted
+        # entry point compiles GSPMD-partitioned from its first trace
+        plan = None
+        if serving.mesh_shape is not None:
+            from ..parallel.plan import ServingTPPlan
+            plan = ServingTPPlan(cfg, serving.mesh_shape)
+        self.plan = plan
         self.kv = SlotKVCache(cfg, serving.num_slots, max_len, dtype,
                               block_size=serving.block_size,
                               num_blocks=serving.kv_blocks,
-                              prefix_cache=serving.prefix_cache)
+                              prefix_cache=serving.prefix_cache,
+                              mesh_shards=plan.tp if plan else 1,
+                              arena_device=plan.arena_sharding
+                              if plan else None)
         self.scheduler = ContinuousBatchingScheduler(
             params, cfg, self.kv, self.buckets, top_k=serving.top_k,
             decode_chunk=serving.decode_chunk, overlap=serving.overlap,
             speculate_k=serving.speculate_k,
-            speculate_ngram=serving.speculate_ngram)
+            speculate_ngram=serving.speculate_ngram, plan=plan)
         # launch-side heartbeat: bumped at dispatch ENQUEUE inside the
         # scheduler, not after step() returns — a device hang leaves the
         # host blocked in the next fetch, and the watchdog/flight record
@@ -264,6 +294,12 @@ class ServingEngine:
             # metrics reset keeps feeding the replacement instance
             self.scheduler.on_dispatch_timed = self._on_dispatch_timed
         self.metrics.kv_blocks_total = self.kv.blocks_total
+        # mesh geometry gauges, constant for the engine's life: the
+        # shard count and the PER-CHIP arena bytes (pool_bytes / tp) —
+        # the numbers /varz' mesh rollup and capacity planning read;
+        # whole-arena pool_bytes alone overstates per-chip HBM by tp
+        self.metrics.mesh_shards = self.kv.mesh_shards
+        self.metrics.kv_pool_per_chip_bytes = self.kv.hbm_per_chip_bytes
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
         # host swap pool: SwappedSequence records of preempted RUNNING
@@ -555,6 +591,11 @@ class ServingEngine:
         self.metrics.kv_blocks_cached = self.kv.blocks_cached
         self.metrics.prefix_cache_hits = self.kv.prefix_hits
         self.metrics.prefix_cache_misses = self.kv.prefix_misses
+        # constant mesh geometry refreshed with the other gauges so a
+        # replaced metrics instance (the bench's post-warmup reset)
+        # heals on the next step instead of scraping as single-chip
+        self.metrics.mesh_shards = self.kv.mesh_shards
+        self.metrics.kv_pool_per_chip_bytes = self.kv.hbm_per_chip_bytes
         return emitted
 
     def _admission_feasible(self, req, step_no: int) -> bool:
@@ -640,6 +681,14 @@ class ServingEngine:
         (they still owe tokens: drain loops must count them as work)."""
         return len(self._swapped)
 
+    @property
+    def mesh_shape(self):
+        """This engine's serving mesh geometry, (tp,) — (1,) for a
+        single-chip engine. The /healthz replica gauges and migration
+        tickets carry it so operators (and the router's handoff
+        journal) can see which replicas are tensor-parallel."""
+        return self.kv.mesh_shape
+
     # -- cross-replica migration ---------------------------------------------
 
     @property
@@ -699,7 +748,8 @@ class ServingEngine:
                 self.metrics.swapped_slots = len(self._swapped)
                 sw.req.state = "migrated"
                 ticket = MigrationTicket.from_swapped(
-                    sw, self.kv.block_size)
+                    sw, self.kv.block_size,
+                    mesh_shape=self.mesh_shape)
                 if rlog is not None:
                     rlog.event("migrate_out", request_id=rid,
                                replica=self.metrics.engine_label,
@@ -734,7 +784,8 @@ class ServingEngine:
         # "preempted" would miscount real preemptions in the summary
         sw = self.scheduler.swap_out(slot, journal=False)
         sw.req.state = "migrated"
-        ticket = MigrationTicket.from_swapped(sw, self.kv.block_size)
+        ticket = MigrationTicket.from_swapped(sw, self.kv.block_size,
+                                              mesh_shape=self.mesh_shape)
         if rlog is not None:
             rlog.event("migrate_out", request_id=rid,
                        replica=self.metrics.engine_label,

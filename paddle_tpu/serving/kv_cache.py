@@ -109,7 +109,8 @@ class SlotKVCache:
 
     def __init__(self, cfg, num_slots: int, max_len: int, dtype=None,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, mesh_shards: int = 1,
+                 arena_device=None):
         import jax.numpy as jnp
 
         if num_slots < 1:
@@ -118,6 +119,22 @@ class SlotKVCache:
             raise ValueError(f"max_len must be >= 1, got {max_len}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        # tensor-parallel shard count of the arena (mesh_shape=(tp,)):
+        # blocks/slots/refcounts are LOGICAL whole-arena units (each
+        # block's heads are split across chips, so the allocator is
+        # mesh-oblivious), but BYTES gauges must be per-chip-aware —
+        # reporting whole-arena pool_bytes as if one chip held it is
+        # exactly the operator-facing bug the hbm_per_chip_bytes split
+        # fixes.
+        if mesh_shards < 1:
+            raise ValueError(
+                f"mesh_shards must be >= 1, got {mesh_shards}")
+        if cfg.heads % mesh_shards:
+            raise ValueError(
+                f"cfg.heads {cfg.heads} not divisible by mesh_shards "
+                f"{mesh_shards} — the arena's heads axis shards evenly "
+                "or not at all")
+        self.mesh_shards = int(mesh_shards)
         self.cfg = cfg
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
@@ -135,7 +152,15 @@ class SlotKVCache:
             else jnp.dtype(jnp.float32)
         shape = (cfg.layers, 2, self.num_blocks, heads, self.block_size,
                  hd)
-        self.kv = jnp.zeros(shape, self.dtype)
+        # arena_device (a jax sharding/device or None = default): the
+        # arena must be ALLOCATED under its mesh sharding, not
+        # allocated whole and resharded after — allocate-then-move
+        # would transiently pin the full pool_bytes on one chip at
+        # construction, defeating exactly the per-chip capacity win a
+        # sharded pool exists for (invisible on CPU, an OOM on real
+        # chips sized near per-chip HBM)
+        self.kv = jnp.zeros(shape, self.dtype) if arena_device is None \
+            else jnp.zeros(shape, self.dtype, device=arena_device)
         # constant for the engine's life (donation reuses the buffer in
         # place every dispatch) — computed ONCE, no per-call numpy walk
         self._pool_bytes = math.prod(shape) * self.dtype.itemsize
@@ -454,16 +479,34 @@ class SlotKVCache:
 
     @property
     def pool_bytes(self) -> int:
-        """HBM footprint of the arena — constant for the engine's life
-        (donation reuses the same buffer in place every dispatch)."""
+        """WHOLE-ARENA HBM footprint — constant for the engine's life
+        (donation reuses the same buffer in place every dispatch). On a
+        tensor-parallel mesh this is the sum across chips; the number
+        one chip actually holds is hbm_per_chip_bytes."""
         return self._pool_bytes
 
-    def occupancy(self) -> Dict[str, int]:
+    @property
+    def mesh_shape(self) -> Tuple[int, ...]:
+        """The arena's mesh geometry, (tp,) — (1,) on a single chip."""
+        return (self.mesh_shards,)
+
+    @property
+    def hbm_per_chip_bytes(self) -> int:
+        """Arena bytes RESIDENT PER CHIP: the heads axis shards over
+        the tp mesh, so each chip holds pool_bytes / tp (exact —
+        divisibility is enforced at construction). This is the number
+        capacity planning must use on a sharded pool; pool_bytes alone
+        overstates per-chip HBM by the mesh factor."""
+        return self._pool_bytes // self.mesh_shards
+
+    def occupancy(self) -> Dict[str, object]:
         return {"num_slots": self.num_slots,
                 "active_slots": self.active_count,
                 "free_slots": self.free_count,
                 "live_positions": sum(self._len),
                 "pool_bytes": self.pool_bytes,
+                "hbm_per_chip_bytes": self.hbm_per_chip_bytes,
+                "mesh_shape": self.mesh_shape,
                 "block_size": self.block_size,
                 "blocks_total": self.blocks_total,
                 "blocks_used": self.blocks_used,

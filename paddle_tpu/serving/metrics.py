@@ -155,6 +155,12 @@ _HELP = {
     "kv_blocks_used": "KV arena blocks referenced by live sequences",
     "kv_blocks_cached": "unreferenced KV blocks kept warm for "
                         "prefix-cache hits (LRU-evicted under pressure)",
+    "mesh_shards": "tensor-parallel shard count of this engine's "
+                   "serving mesh (1 = single chip)",
+    "kv_pool_per_chip_bytes": "KV arena bytes resident PER CHIP "
+                              "(pool_bytes / mesh_shards — the "
+                              "capacity-planning number on a sharded "
+                              "pool)",
 }
 
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
@@ -163,7 +169,8 @@ _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
              "prefix_cache_hits", "prefix_cache_misses",
              "preemptions", "swap_ins")
 _GAUGES = ("active_slots", "queue_depth", "kv_blocks_total",
-           "kv_blocks_used", "kv_blocks_cached", "swapped_slots")
+           "kv_blocks_used", "kv_blocks_cached", "swapped_slots",
+           "mesh_shards", "kv_pool_per_chip_bytes")
 _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tpot": "serving_tpot_seconds",
                "queue_wait": "serving_queue_wait_seconds",

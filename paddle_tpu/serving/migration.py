@@ -44,6 +44,15 @@ preemption pinned: the serving sampler is a slot-independent
 counter-based threefry (scheduler._sample_row), so the restored key
 row continues the per-token split chain bit-exactly wherever — and on
 whichever replica — the sequence resumes.
+
+MESH PORTABILITY: tickets always carry the canonical FULL-HEAD host
+layout — a tensor-parallel source engine's swap-out device_get
+assembles the per-chip head shards before anything is ticketed — so a
+sequence extracted on a tp=2 replica lands on a tp=4 or single-chip
+peer and vice versa (`mesh_shape` rides along as an annotation).
+`validate_for` rejects, with TicketError instead of a crash, any
+payload whose head count is a per-chip shard rather than the full
+layout (the corrupted-shard case).
 """
 
 from __future__ import annotations
@@ -92,7 +101,7 @@ class MigrationTicket:
         # sequence state (SwappedSequence minus the engine-bound req)
         "pos", "produced", "seq", "length", "n_blocks", "block_size",
         "payload", "token", "ts", "remaining", "temp", "eos", "key_row",
-        "spec",
+        "spec", "mesh_shape",
     )
 
     def __init__(self, prompt, max_new, temperature, seed, eos_id,
@@ -100,7 +109,7 @@ class MigrationTicket:
                  n_blocks, block_size, payload, token, ts, remaining,
                  temp, eos, key_row, spec=None, tenant=None,
                  rerouted_from=(), slo_stamps=None, version=None,
-                 checksum=None, created_unix=None):
+                 checksum=None, created_unix=None, mesh_shape=(1,)):
         self.version = TICKET_VERSION if version is None else int(version)
         self.created_unix = time.time() if created_unix is None \
             else float(created_unix)
@@ -130,15 +139,27 @@ class MigrationTicket:
         self.eos = eos
         self.key_row = np.asarray(key_row)
         self.spec = spec
+        # source-replica mesh geometry, (tp,). An ANNOTATION like the
+        # tenant/hop fields (outside the checksum): the payload itself
+        # is always the canonical FULL-HEAD host layout — swap_out's
+        # device_get assembles the shards — so a ticket from a tp=2
+        # replica lands on any geometry-compatible peer, tp or single-
+        # chip; the field exists for the journal and for operators
+        # tracing which mesh a sequence came off.
+        self.mesh_shape = tuple(int(m) for m in mesh_shape)
         self.checksum = self._digest() if checksum is None else checksum
 
     # -- construction ---------------------------------------------------------
 
     @classmethod
-    def from_swapped(cls, sw, block_size: int) -> "MigrationTicket":
+    def from_swapped(cls, sw, block_size: int,
+                     mesh_shape=(1,)) -> "MigrationTicket":
         """Wrap a SwappedSequence (engine swap-pool record) into a
         portable ticket. `sw.req` stays behind on the source — the
-        ticket carries its parameters and emitted prefix instead."""
+        ticket carries its parameters and emitted prefix instead.
+        `mesh_shape` annotates the SOURCE replica's mesh geometry; the
+        payload is already the assembled full-head host layout
+        whatever the source mesh was."""
         req = sw.req
         return cls(
             prompt=req.prompt, max_new=sw.max_new,
@@ -149,7 +170,8 @@ class MigrationTicket:
             length=sw.length, n_blocks=sw.n_blocks,
             block_size=block_size, payload=sw.payload,
             token=sw.token, ts=sw.ts, remaining=sw.remaining,
-            temp=sw.temp, eos=sw.eos, key_row=sw.key_row, spec=sw.spec)
+            temp=sw.temp, eos=sw.eos, key_row=sw.key_row, spec=sw.spec,
+            mesh_shape=mesh_shape)
 
     # -- integrity ------------------------------------------------------------
 
@@ -233,6 +255,24 @@ class MigrationTicket:
                 f"engine {want}")
         shape = self.payload.shape
         arena = kv.kv.shape  # (L, 2, num_blocks, heads, bs, hd)
+        if len(shape) != 6:
+            # a malformed/truncated payload must reject cleanly, never
+            # crash an index below or the adopting swap_in scatter
+            raise TicketError(
+                f"ticket payload rank {len(shape)} != 6 — not a KV "
+                "block payload (layers, 2, blocks, heads, bs, hd)")
+        if shape[3] != arena[3]:
+            # MESH GEOMETRY: tickets always carry the canonical FULL-
+            # HEAD host layout (swap_out's device_get assembles the
+            # per-chip shards), so ANY head-count mismatch means the
+            # payload is a raw per-chip shard — or a different model —
+            # and no page-row scatter could ever place it soundly
+            raise TicketError(
+                f"KV mesh/head geometry mismatch: ticket payload "
+                f"carries {shape[3]} heads (source mesh "
+                f"{self.mesh_shape}), engine serves {arena[3]} heads "
+                f"(mesh {tuple(kv.mesh_shape)}) — tickets must hold "
+                "the assembled full-head layout, not a per-chip shard")
         per_block = (arena[0], arena[1], arena[3], arena[4], arena[5])
         got = (shape[0], shape[1]) + tuple(shape[3:])
         if got != per_block or shape[2] != self.n_blocks:
@@ -298,5 +338,6 @@ class MigrationTicket:
                 "tenant": self.tenant, "emitted": self.emitted,
                 "produced": self.produced, "max_new": self.max_new,
                 "n_blocks": self.n_blocks, "bytes": self.swap_bytes,
+                "mesh_shape": list(self.mesh_shape),
                 "rerouted_from": list(self.rerouted_from),
                 "checksum": self.checksum}

@@ -60,6 +60,19 @@ tokens, so a block fetched AFTER a slot was retired and re-admitted is
 never mis-attributed to the new occupant (its tokens start in a later
 dispatch by construction).
 
+TENSOR-PARALLEL MESH (ServingConfig(mesh_shape=(tp,))): the same
+executable family compiles GSPMD-partitioned over a pjit mesh —
+attention heads and MLP widths sharded on the "tp" axis (Megatron
+layout, parallel.plan.ServingTPPlan), the paged block arena sharded
+per-head alongside them, and the page table / decode carry / threefry
+key rows / drafter state replicated, so every host-side path in this
+file and kv_cache.py is mesh-oblivious. Streams are pinned
+token-identical to the single-chip engine (greedy and seeded, with and
+without speculation, across preempt/resume and migration), the compile
+count is unchanged, and donation still updates the sharded arena in
+place (the jitted entry points pin their output layouts so the carry
+round-trips bit-stable).
+
 Compile discipline (the point of the fixed shapes): executables =
 len(prefill buckets) + 1 fused decode chunk + 1 admission sampler
 (+ 1 release, compiled lazily on the first cancel). The page table is a
@@ -205,7 +218,7 @@ class ContinuousBatchingScheduler:
     def __init__(self, params, cfg, kv: SlotKVCache, buckets: ShapeBuckets,
                  top_k: int = 0, decode_chunk: int = 8,
                  overlap: bool = True, speculate_k: int = 0,
-                 speculate_ngram: int = 512):
+                 speculate_ngram: int = 512, plan=None):
         import jax
 
         if int(decode_chunk) < 1:
@@ -217,6 +230,25 @@ class ContinuousBatchingScheduler:
         if int(speculate_ngram) < 1:
             raise ValueError(
                 f"speculate_ngram must be >= 1, got {speculate_ngram}")
+        # tensor-parallel mesh plan (parallel.plan.ServingTPPlan) or
+        # None for the single-chip engine. With a plan, the params go
+        # on-device Megatron-TP-sharded and the arena heads-sharded
+        # NOW, so every jitted entry point below compiles GSPMD-
+        # partitioned from its first trace ("computation follows
+        # data"); the page table, decode carry, sampler keys, and
+        # drafter state are placed REPLICATED, which is what keeps all
+        # host-side scheduling/allocator logic mesh-oblivious.
+        self.plan = plan
+        if plan is not None:
+            params = plan.shard_params(params)
+            if getattr(kv.kv, "sharding", None) != plan.arena_sharding:
+                # engine-built pools arrive ALREADY allocated under the
+                # plan's sharding (SlotKVCache arena_device=...), which
+                # is the safe path — this fallback reshards a
+                # standalone-constructed pool and transiently holds the
+                # whole arena on one device, so it exists for direct
+                # scheduler construction only, never the engine path
+                kv.kv = plan.shard_arena(kv.kv)
         self.params = params
         self.cfg = cfg
         self.kv = kv
@@ -241,6 +273,8 @@ class ContinuousBatchingScheduler:
         # NOT jax.random — see _sample_row); every row is re-seeded
         # in-graph at admission, so zeros are fine here
         self._keys = jax.numpy.zeros((kv.num_slots, 2), jax.numpy.uint32)
+        if plan is not None:
+            self._keys = plan.replicate(self._keys)
         self._prefill_jit = None
         self._chunk_jit = None
         self._admit_jit = None
@@ -337,6 +371,23 @@ class ContinuousBatchingScheduler:
 
         # device page table: every row scratch until its slot admits
         self._pt = jnp.zeros((s_dim, self.kv.max_pages), jnp.int32)
+        if self.plan is not None:
+            self._state = self.plan.replicate(self._state)
+            self._pt = self.plan.replicate(self._pt)
+        # mesh output discipline: every jitted entry point pins its
+        # outputs' layouts (arena/payload heads-sharded, everything
+        # else replicated) so the donated buffers come back EXACTLY as
+        # they went in — without the constraints GSPMD may re-lay the
+        # carry out between dispatches and donation degrades to a
+        # copy. Single-chip engines pay nothing: the pins are identity.
+        if self.plan is None:
+            c_arena = c_payload = c_rep = (lambda t: t)
+            arena_con = None
+        else:
+            c_arena = self.plan.constrain_arena
+            c_payload = self.plan.constrain_payload
+            c_rep = self.plan.constrain_rep
+            arena_con = self.plan.constrain_arena
 
         def prefill_impl(params, arena, pt, state, tokens, pfx_len,
                          real_len, pages, slot):
@@ -352,7 +403,8 @@ class ContinuousBatchingScheduler:
                 # seeding is best-effort; drafts are always verified)
                 state = state[:7] + (gd.spec_ngram_seed(
                     state[7], slot, tokens[0], real_len),)
-            return logits[0], arena, pt, state
+            return (c_rep(logits[0]), c_arena(arena), c_rep(pt),
+                    c_rep(state))
 
         def admit_impl(keys, state, slot, seed, logits, temp, pos,
                        max_new, eos_id, prev_tok):
@@ -375,7 +427,7 @@ class ContinuousBatchingScheduler:
                 # sampled token); the table row was seeded at prefill
                 new_state += (state[6].at[slot].set(prev_tok),
                               state[7])
-            return first, keys, new_state
+            return c_rep(first), c_rep(keys), c_rep(new_state)
 
         def chunk_impl(params, arena, pt, keys, state):
             self._compile_events.append("decode_chunk")
@@ -387,17 +439,21 @@ class ContinuousBatchingScheduler:
                     temps, done, remaining, eos_ids, self.decode_chunk,
                     sample_fn=self._sample_row,
                     speculate_k=self.speculate_k,
-                    spec_state=(state[6], state[7]))
-                return ((block, counts), arena, keys,
-                        (tokens, ts, done, remaining, temps, eos_ids)
-                        + spec)
+                    spec_state=(state[6], state[7]),
+                    arena_constraint=arena_con)
+                return (c_rep((block, counts)), c_arena(arena),
+                        c_rep(keys),
+                        c_rep((tokens, ts, done, remaining, temps,
+                               eos_ids) + spec))
             block, tokens, arena, ts, keys, done, remaining = \
                 gd.gpt_decode_chunk_pages(
                     params, self.cfg, tokens, arena, pt, ts, keys,
                     temps, done, remaining, eos_ids, self.decode_chunk,
-                    sample_fn=self._sample_row)
-            return block, arena, keys, (tokens, ts, done, remaining,
-                                        temps, eos_ids)
+                    sample_fn=self._sample_row,
+                    arena_constraint=arena_con)
+            return (c_rep(block), c_arena(arena), c_rep(keys),
+                    c_rep((tokens, ts, done, remaining, temps,
+                           eos_ids)))
 
         def release_impl(pt, state, slot):
             # cancel path: the host verdict the in-graph done mask can't
@@ -412,7 +468,7 @@ class ContinuousBatchingScheduler:
             state = (tokens, ts, done.at[slot].set(True),
                      remaining.at[slot].set(0), temps, eos_ids) \
                 + tuple(state[6:])
-            return pt, state
+            return c_rep(pt), c_rep(state)
 
         def swapout_impl(arena, keys, state, blocks, slot):
             # host-swap copy-out: gather ONLY this slot's block rows
@@ -427,7 +483,11 @@ class ContinuousBatchingScheduler:
                     eos_ids[slot], keys[slot])
             if self.speculate_k:
                 rows += (state[6][slot], state[7][slot])
-            return (payload,) + rows
+            # payload stays heads-sharded on device; the device_get in
+            # swap_out assembles the FULL-HEAD host layout from the
+            # shards, which is what makes swap-pool records and
+            # MigrationTickets mesh-portable
+            return (c_payload(payload),) + c_rep(rows)
 
         def swapin_impl(arena, pt, keys, state, payload, blocks, slot,
                         token, ts_v, rem, temp, eos, key_row, *spec_rows):
@@ -451,7 +511,8 @@ class ContinuousBatchingScheduler:
                 prev, table = state[6], state[7]
                 new_state += (prev.at[slot].set(spec_rows[0]),
                               table.at[slot].set(spec_rows[1]))
-            return arena, pt, keys, new_state
+            return (c_arena(arena), c_rep(pt), c_rep(keys),
+                    c_rep(new_state))
 
         # donation (the executor's donate=True discipline): the arena,
         # the page table, the key table, and the decode carry are
@@ -909,6 +970,13 @@ class ContinuousBatchingScheduler:
                             + payload.shape[3:], payload.dtype)
             full[:, :, :sw.n_blocks] = payload
             payload = full
+        if self.plan is not None:
+            # parked records hold the canonical FULL-HEAD host layout
+            # (tickets are mesh-portable); split it back per-head over
+            # the mesh so the scatter stays chip-local
+            import jax
+            payload = jax.device_put(payload,
+                                     self.plan.payload_sharding)
         args = [self.kv.kv, self._pt, self._keys, self._state,
                 payload, row, np.int32(slot), sw.token, sw.ts,
                 sw.remaining, sw.temp, sw.eos, sw.key_row]

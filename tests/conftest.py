@@ -65,7 +65,14 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         fname = item.fspath.basename
         ident = item.nodeid.lower()
-        if fname in _SLOW_FILES or any(p in ident for p in _SLOW_PATTERNS):
+        if item.get_closest_marker("multichip") is not None:
+            # the 8-device mesh matrices (serving tensor-parallel
+            # identity sweeps etc.) run in their own lane —
+            # tools/run_multichip_tests.sh `-m multichip` — and are
+            # auto-slow so the tier-1 quick lane stays fast
+            item.add_marker(pytest.mark.slow)
+        elif fname in _SLOW_FILES or any(p in ident
+                                         for p in _SLOW_PATTERNS):
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.quick)

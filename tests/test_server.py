@@ -238,6 +238,12 @@ def test_healthz_and_metrics_endpoints(trained):
             assert {"engine", "active_slots", "queue_depth",
                     "kv_blocks_used",
                     "kv_blocks_total"} <= set(rep)
+            # mesh geometry rides next to the block gauges so an
+            # operator can see which replicas are tensor-parallel and
+            # what ONE chip holds (single-chip fleet here: tp=1,
+            # per-chip bytes == whole arena)
+            assert rep["mesh_shape"] == [1]
+            assert rep["hbm_per_chip_bytes"] > 0
         status, _, tokens, _ = sse_generate(
             srv.port, {"prompt": [1, 2, 3], "max_new_tokens": 3,
                        "tenant": "acme"})

@@ -1682,7 +1682,11 @@ def test_lifecycle_plane_disabled_is_noop(trained):
           "preemptions", "swap_ins")}
         | {f"serving_{n}" for n in
            ("active_slots", "queue_depth", "kv_blocks_total",
-            "kv_blocks_used", "kv_blocks_cached", "swapped_slots")}
+            "kv_blocks_used", "kv_blocks_cached", "swapped_slots",
+            # mesh geometry gauges are part of the BASE engine surface
+            # (single-chip engines publish mesh_shards=1 + whole-pool
+            # per-chip bytes), not a lifecycle-plane series
+            "mesh_shards", "kv_pool_per_chip_bytes")}
         | {"serving_ttft_seconds", "serving_tpot_seconds",
            "serving_queue_wait_seconds", "serving_tokens_per_dispatch",
            "serving_spec_accepted_run", "serving_swap_out_seconds",
@@ -2051,3 +2055,210 @@ def test_migration_request_log_chains_hops(trained):
     # the superseded id left the in-flight set at adoption, and the
     # new id went terminal at finish
     assert log.inflight_ids() == []
+
+
+# ---------------------------------------------------------------------------
+# multi-chip tensor-parallel serving (ServingConfig(mesh_shape=(tp,)))
+# ---------------------------------------------------------------------------
+#
+# The quick lane pins the tp=2 contract end to end (streams, compile
+# discipline, per-chip gauges, config validation, ticket shard
+# rejection); the full mesh matrix — mesh 1/2/4 x greedy/seeded x
+# speculate_k {0,4} x preempt-resume x migration — runs in the
+# multichip lane (tools/run_multichip_tests.sh, `-m multichip`,
+# auto-marked slow) under the same 8-device virtual mesh the
+# MULTICHIP_r0x benches use.
+
+def _mesh_mix_streams(trained, mesh, speculate_k=0, max_new=8,
+                      close=True, **kw):
+    """The shared mesh workload: four prompts, alternating greedy and
+    seeded sampling, on a fresh engine at the given mesh. Returns
+    (streams, stats, compile events, engine) — the engine is closed
+    (and returned closed) unless close=False, for callers that must
+    read its registry series before retirement."""
+    cfg, _ = trained
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 5, 7, 4)]
+    eng = make_engine(trained, mesh_shape=mesh, speculate_k=speculate_k,
+                      **kw)
+    reqs = [eng.submit(p, max_new_tokens=max_new,
+                       temperature=0.8 if i % 2 else 0.0, seed=i)
+            for i, p in enumerate(prompts)]
+    eng.run_until_drained()
+    out = [tuple(r.tokens) for r in reqs]
+    stats = eng.stats()
+    events = eng.scheduler.compile_events
+    if close:
+        eng.close()
+    return out, stats, events, eng
+
+
+def test_mesh_tp2_streams_compile_discipline_and_gauges(trained):
+    """Quick-lane mesh pin: a mesh_shape=(2,) engine emits the SAME
+    greedy and seeded streams as the single-chip engine, with the
+    sharded chunk loop traced ONCE and compile count still
+    O(buckets)+admit; occupancy/stats report the per-chip split
+    (hbm_per_chip_bytes = pool_bytes / 2, mesh_shape (2,)) and the
+    serving_mesh_shards / serving_kv_pool_per_chip_bytes gauges + the
+    /varz mesh rollup carry the same numbers off the scrape path."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.debug_server import _serving_varz
+
+    base, bstats, _, _ = _mesh_mix_streams(trained, None)
+    assert bstats["mesh_shape"] == (1,)
+    assert bstats["hbm_per_chip_bytes"] == bstats["pool_bytes"]
+
+    # close=False: the registry asserts below must read the labeled
+    # series before close() retires them
+    got, s, events, eng = _mesh_mix_streams(trained, (2,), close=False)
+    assert got == base, "tp=2 streams diverged from single-chip"
+    # compile discipline carries over EXACTLY: one executable per
+    # prefill bucket + ONE sharded fused chunk loop + one admit sampler
+    assert events.count("decode_chunk") == 1
+    assert len(events) <= 2 + 2   # len(buckets)=2 + chunk + admit
+    # per-chip-aware occupancy on the sharded pool
+    assert s["mesh_shape"] == (2,)
+    assert s["hbm_per_chip_bytes"] * 2 == s["pool_bytes"]
+    # registry truth BEFORE close() retires the labeled series
+    label = s["engine_label"]
+    snap = get_registry().snapshot()
+    for fam, want in (("serving_mesh_shards", 2),
+                      ("serving_kv_pool_per_chip_bytes",
+                       s["hbm_per_chip_bytes"])):
+        row = next(r for r in snap[fam]["series"]
+                   if r["labels"].get("engine") == label)
+        assert row["value"] == want, fam
+    assert _serving_varz(snap)["mesh"][label] == {
+        "mesh_shards": 2,
+        "kv_pool_per_chip_bytes": s["hbm_per_chip_bytes"]}
+    eng.close()
+
+
+def test_mesh_config_validation(trained):
+    """Bad mesh geometry fails LOUDLY at construction, before any
+    compile: heads not divisible by tp, more chips than devices, and a
+    non-(tp,) mesh tuple are all ValueErrors."""
+    with pytest.raises(ValueError, match="heads"):
+        make_engine(trained, mesh_shape=(3,))      # 4 heads % 3 != 0
+    with pytest.raises(ValueError, match="devices"):
+        make_engine(trained, mesh_shape=(16,))     # 8 visible
+    with pytest.raises(ValueError, match="1-tuple"):
+        make_engine(trained, mesh_shape=(2, 2))
+
+
+def test_migration_ticket_rejects_shard_layout_not_crash(trained):
+    """The corrupted-shard case: a ticket whose payload carries a
+    PER-CHIP head shard (or a mangled rank) instead of the assembled
+    full-head layout is rejected whole with TicketError — a typed
+    refusal naming the mesh geometry, never an IndexError/scatter
+    crash — and the unmolested ticket still adopts fine afterwards."""
+    from paddle_tpu.serving import TicketError
+
+    cfg, _ = trained
+    src = make_engine(trained, max_len=48)
+    dst = make_engine(trained, max_len=48)
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    req = src.submit(p, 40, temperature=0.8, seed=3)
+    _drive_until_running_with_tokens(src, req)
+    ticket = src.migrate_out(req)
+    assert ticket.mesh_shape == (1,)
+
+    half = ticket.payload[:, :, :, : cfg.heads // 2]
+    ticket.payload = half
+    ticket.checksum = ticket._digest()      # "valid" shard-layout ticket
+    with pytest.raises(TicketError, match="head geometry"):
+        dst.migrate_in(ticket)
+    ticket.payload = half.reshape(half.shape[0], -1)
+    ticket.checksum = ticket._digest()
+    with pytest.raises(TicketError, match="rank"):
+        dst.migrate_in(ticket)
+    # nothing was mutated on the refusing engine: restore and adopt
+    full = np.zeros(half.shape[:3] + (cfg.heads,) + half.shape[4:],
+                    half.dtype)
+    ticket.payload = full
+    ticket.checksum = ticket._digest()
+    req2 = dst.migrate_in(ticket)
+    dst.run_until_drained()
+    assert req2.state == "finished"
+    src.run_until_drained()
+    src.close(); dst.close()
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("k", [0, 4])
+@pytest.mark.parametrize("tp", [2, 4])
+def test_mesh_token_identity_matrix(trained, tp, k):
+    """The acceptance matrix: mesh (2,) and (4,) streams are identical
+    to mesh=(1,) — greedy AND seeded in the same batch, speculation on
+    and off — with the compile-counter pin that the sharded chunk loop
+    traced ONCE at every point."""
+    base, _, _, _ = _mesh_mix_streams(trained, None, speculate_k=k,
+                                      max_new=12)
+    got, s, events, _ = _mesh_mix_streams(trained, (tp,), speculate_k=k,
+                                          max_new=12)
+    assert got == base, (tp, k)
+    assert events.count("decode_chunk") == 1
+    assert s["mesh_shape"] == (tp,)
+    assert s["hbm_per_chip_bytes"] * tp == s["pool_bytes"]
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("tp", [2, 4])
+def test_mesh_preempt_resume_identity(trained, tp):
+    """Preempt/resume on a tensor-parallel engine: the over-subscribed
+    arena forces host-swap preemptions — the payload round-trips
+    host <-> sharded arena — and every stream is still identical to
+    sequential gpt_generate; the drain leaks nothing."""
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    eng = make_engine(trained, mesh_shape=(tp,), **PRESSURE)
+    outs = eng.generate(prompts, max_new_tokens=12)
+    s = eng.stats()
+    assert s["preemptions"] >= 1, "arena not tight enough to preempt"
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(o, sequential_ref(trained, p, 12))
+    assert s["swapped_slots"] == 0 and s["blocks_used"] == 0
+    assert s["swap_pool_bytes"] == 0
+    eng.close()
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("src_tp,dst_tp", [(2, 2), (2, 1), (1, 4)])
+def test_mesh_migration_matrix(trained, src_tp, dst_tp):
+    """Mesh-crossing migration: a mid-generation handoff lands
+    tp->same-tp, tp->single-chip, and single-chip->bigger-tp with
+    streams identical to a never-migrated run — the ticket's
+    device_get-assembled full-head payload is what makes the geometry
+    portable — and the mesh_shape annotation journals the source."""
+
+    def mesh(tp):
+        return (tp,) if tp > 1 else None
+
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    for temp, seed in ((0.0, 0), (0.8, 3)):
+        src = make_engine(trained, mesh_shape=mesh(src_tp), max_len=48)
+        dst = make_engine(trained, mesh_shape=mesh(dst_tp), max_len=48)
+        stream = []
+        req = src.submit(p, 40, temperature=temp, seed=seed,
+                         on_token=lambda r, t: stream.append(t))
+        _drive_until_running_with_tokens(src, req)
+        ticket = src.migrate_out(req)
+        assert ticket.mesh_shape == (src_tp,)
+        assert ticket.describe()["mesh_shape"] == [src_tp]
+        assert ticket.compatible(dst)
+        req2 = dst.migrate_in(ticket,
+                              on_token=lambda r, t: stream.append(t))
+        src.run_until_drained()
+        dst.run_until_drained()
+        assert req2.state == "finished"
+        ref_eng = make_engine(trained, max_len=48)
+        ref_stream = []
+        ref_eng.submit(p, 40, temperature=temp, seed=seed,
+                       on_token=lambda r, t: ref_stream.append(t))
+        ref_eng.run_until_drained()
+        assert stream == ref_stream, (src_tp, dst_tp, temp)
+        for eng in (src, dst, ref_eng):
+            s = eng.stats()
+            assert s["blocks_used"] == 0 and s["swapped_slots"] == 0
+            eng.close()

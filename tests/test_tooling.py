@@ -709,6 +709,39 @@ def test_bench_serving_rebalance_row_shape():
     assert not snap.get("server_migrations_total", {}).get("series")
 
 
+def test_bench_serving_mesh_row_shape():
+    """tools/bench_serving --mesh: one row per tensor-parallel mesh
+    size with the mesh_shape / hbm_per_chip_gb columns — per-chip KV
+    bytes must drop by exactly 1/tp against the mesh-1 row (the
+    serve-a-bigger-model win as a printed number), streams asserted
+    identical inside the workload itself (streams_identical pinned
+    True on every row)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_mesh("tiny", meshes=(1, 2), requests=3,
+                                  max_new=4)
+    assert len(rows) == 2                        # one row per mesh size
+    by_tp = {}
+    for row in rows:
+        e = row["extra"]
+        tp = e["mesh_shape"][0]
+        assert row["metric"] == f"tiny_serving_mesh{tp}"
+        assert row["value"] > 0 and row["unit"] == "tokens/s"
+        assert e["completed"] == 3
+        assert e["hbm_per_chip_gb"] > 0
+        assert e["pool_bytes"] > 0
+        assert e["streams_identical"] is True
+        assert e["compiled_executables"] > 0
+        assert e["dispatches"] > 0
+        by_tp[tp] = e
+    # the capacity win, measured: per-chip bytes halve EXACTLY at tp=2
+    # while the logical arena (pool_bytes, blocks) stays identical —
+    # pinned on the raw bytes column (the GB column is display-rounded)
+    assert by_tp[1]["pool_bytes"] == by_tp[2]["pool_bytes"]
+    assert by_tp[1]["hbm_per_chip_bytes"] == by_tp[1]["pool_bytes"]
+    assert by_tp[2]["hbm_per_chip_bytes"] * 2 == by_tp[2]["pool_bytes"]
+
+
 def test_serving_summary_stitches_migration_hops(tmp_path):
     """tools/serving_summary renders a migrated request as ONE
     timeline: the migrate_in's rerouted_from link joins the source and

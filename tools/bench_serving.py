@@ -76,6 +76,26 @@ tests/test_server.py):
 
     python tools/bench_serving.py tiny --rebalance
 
+`--mesh TP...` runs the TENSOR-PARALLEL MESH sweep instead: the same
+request mix on fresh engines at each mesh size (1 = the single-chip
+baseline engine, >1 = `ServingConfig(mesh_shape=(tp,))` with attention
+heads/MLP widths and the paged KV arena GSPMD-sharded over tp
+devices). One row per mesh size with `mesh_shape`, tokens/s, and
+`hbm_per_chip_gb` — the sharded arena's `pool_bytes / tp`, i.e. the KV
+bytes ONE chip actually holds, the serve-a-bigger-model win measured
+rather than asserted — plus the standard registry-sourced columns.
+Token streams are asserted IDENTICAL across every mesh size before any
+row prints. On a CPU host the sweep needs the virtual device flag
+(set automatically when possible):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python tools/bench_serving.py tiny --mesh 1 2 4
+
+Honest caveat: on a CPU host the tokens/s column measures GSPMD
+partition overhead, not a win — the mesh's perf regime is real
+multi-chip HBM bandwidth; hbm_per_chip_gb is the column that carries
+on any backend.
+
 `--speculate K...` runs the SPECULATIVE-DECODING workload instead: a
 repetitive-text request mix (prompts tile a short motif — the regime
 the in-graph n-gram self-drafter exists for) swept over the given
@@ -740,6 +760,120 @@ def run_speculate(name, speculate_ks=(0, 4), requests=None,
     return rows
 
 
+# mesh workload geometry per model: (prefill buckets, prompt length,
+# max_new, per-engine slots). The mix is the standard varied-length
+# blend; what the sweep varies is ONLY the mesh size, so the rows are
+# directly comparable and the streams can be asserted identical.
+MESH = {
+    "tiny": ((8, 16), 12, 32, 4),
+    "gpt2": ((32, 64), 48, 32, 4),
+}
+
+
+def run_mesh(name, meshes=(1, 2, 4), requests=None, max_new=None,
+             decode_chunk=8):
+    """The --mesh sweep: the same request mix on fresh engines at each
+    tensor-parallel mesh size. One row per size with `mesh_shape` and
+    `hbm_per_chip_gb` (= pool_bytes / tp — per-chip KV residency must
+    drop ~1/tp, the serve-a-bigger-model win as a printed number) next
+    to tokens/s and the standard registry-sourced columns. Token
+    streams are ASSERTED identical across all mesh sizes (greedy and
+    seeded) before any row prints — the sweep never trades correctness
+    for chips."""
+    import jax
+    import paddle_tpu as pt
+
+    gpt_kwargs, _, _, _ = MODELS[name]
+    buckets, prompt_len, row_max_new, slots = MESH[name]
+    max_new = max_new or row_max_new
+    requests = requests or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    avail = len(jax.devices())
+    usable = [tp for tp in meshes if tp <= avail]
+    dropped = [tp for tp in meshes if tp > avail]
+    if dropped:
+        print(f"bench_serving --mesh: skipping {dropped} — only "
+              f"{avail} devices visible (XLA_FLAGS="
+              "--xla_force_host_platform_device_count=N on CPU)",
+              file=sys.stderr)
+    cfg, params = build_params(gpt_kwargs)
+    max_len = prompt_len + max_new
+    rows, streams = [], {}
+    for tp in usable:
+        rng = np.random.RandomState(0)          # same mix per mesh row
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(
+                num_slots=slots, max_queue=requests,
+                prefill_buckets=buckets, max_len=max_len,
+                decode_chunk=decode_chunk,
+                mesh_shape=(tp,) if tp > 1 else None))
+        prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+                   .astype(np.int32) for _ in range(requests)]
+        # warm every executable (standard bench discipline), then drop
+        # the warmup's registry rows
+        wrng = np.random.RandomState(12345)
+        eng.generate([wrng.randint(0, cfg.vocab_size, (max(1, b - 2),))
+                      .astype(np.int32) for b in buckets],
+                     max_new_tokens=2)
+        old = eng.metrics
+        old.unregister()
+        eng.metrics = pt.serving.EngineMetrics(
+            max_tokens_per_dispatch=old.max_tokens_per_dispatch,
+            speculate_k=old.speculate_k)
+        eng.kv.prefix_hits = eng.kv.prefix_misses = 0
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=max_new,
+                           temperature=0.8 if i % 2 else 0.0, seed=i)
+                for i, p in enumerate(prompts)]
+        eng.run_until_drained()
+        dt = time.perf_counter() - t0
+        s = eng.stats()
+        label = s["engine_label"]
+        tokens = sum(len(r.tokens) for r in reqs)
+        streams[tp] = [tuple(r.tokens) for r in reqs]
+        dispatches = _registry_counter(label, "serving_dispatches_total")
+        rows.append({
+            "metric": f"{name}_serving_mesh{tp}",
+            "value": round(tokens / dt, 2),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "extra": {
+                "requests": requests,
+                "completed": s["completed"],
+                "max_new": max_new,
+                "num_slots": slots,
+                "decode_chunk": decode_chunk,
+                "mesh_shape": [tp],
+                # the capacity win: KV arena bytes ONE chip holds (the
+                # GB column is display-rounded; the bytes column is
+                # exact — pool_bytes / tp — and is what tests pin)
+                "hbm_per_chip_gb": round(
+                    s["hbm_per_chip_bytes"] / 2 ** 30, 6),
+                "hbm_per_chip_bytes": s["hbm_per_chip_bytes"],
+                "pool_bytes": s["pool_bytes"],
+                "blocks_total": s["blocks_total"],
+                "dispatches": dispatches,
+                "tokens_per_dispatch": round(tokens / dispatches, 2)
+                    if dispatches else None,
+                "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2)
+                    if s["mean_ttft"] is not None else None,
+                "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3)
+                    if s["mean_tpot"] is not None else None,
+                "compiled_executables": s["compiled_executables"],
+                # pinned before printing: every mesh size emitted the
+                # same greedy AND seeded streams as mesh 1
+                "streams_identical": True,
+            },
+        })
+        eng.close()
+    first = usable[0] if usable else None
+    for tp in usable[1:]:
+        assert streams[tp] == streams[first], (
+            f"mesh {tp} streams diverged from mesh {first}")
+    return rows
+
+
 def _sse_generate(port, payload, timeout=120):
     """POST /v1/generate and consume the SSE stream, stamping
     perf_counter at every frame. Returns (status, tokens, stamps,
@@ -978,6 +1112,16 @@ def main(argv=None):
                     help="run the prefix-sharing workload instead: N "
                          "requests over one long system prompt, prefix "
                          "cache off (cold) vs on, TTFT compared per row")
+    ap.add_argument("--mesh", type=int, nargs="+", default=None,
+                    metavar="TP",
+                    help="run the tensor-parallel mesh sweep instead: "
+                         "the same request mix at each mesh size "
+                         "(1 = single-chip baseline), one row per TP "
+                         "with mesh_shape + hbm_per_chip_gb (= "
+                         "pool_bytes / tp) next to tokens/s; streams "
+                         "asserted identical across sizes. On CPU the "
+                         "virtual-device flag is set automatically "
+                         "when jax is not yet imported")
     ap.add_argument("--speculate", type=int, nargs="+", default=None,
                     metavar="K",
                     help="run the speculative-decoding workload "
@@ -1014,6 +1158,32 @@ def main(argv=None):
     bad = [k for k in args.decode_chunk if k < 1]
     if bad:
         ap.error(f"--decode-chunk values must be >= 1, got {bad}")
+    if args.mesh is not None:
+        bad = [t for t in args.mesh if t < 1]
+        if bad:
+            ap.error(f"--mesh values must be >= 1, got {bad}")
+        clashing = [f for f, on in (("--shared-prefix", args.shared_prefix),
+                                    ("--speculate",
+                                     args.speculate is not None),
+                                    ("--http", args.http),
+                                    ("--rebalance", args.rebalance),
+                                    ("--oversubscribe",
+                                     args.oversubscribe)) if on]
+        if clashing:
+            ap.error(f"--mesh replaces the standard workload; "
+                     f"drop {' '.join(clashing)}")
+        # CPU hosts: materialize enough virtual devices BEFORE jax
+        # initializes (imports are all function-local above, so a
+        # plain CLI invocation reaches here jax-free); once jax is in,
+        # the flag is the operator's job — mirror the MULTICHIP_r0x
+        # invocation (tools/run_multichip_tests.sh)
+        need = max(args.mesh)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if (need > 1 and "jax" not in sys.modules
+                and "xla_force_host_platform_device_count" not in flags):
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count"
+                        f"={need}").strip()
     if args.speculate is not None:
         bad = [k for k in args.speculate if k < 0]
         if bad:
@@ -1051,7 +1221,9 @@ def main(argv=None):
         print(f"debug server: http://127.0.0.1:{port}", file=sys.stderr)
     try:
         for name in args.models or list(MODELS):
-            if args.shared_prefix:
+            if args.mesh is not None:
+                rows = run_mesh(name, meshes=tuple(args.mesh))
+            elif args.shared_prefix:
                 rows = run_shared_prefix(name)
             elif args.rebalance:
                 rows = run_rebalance(name)

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Multichip lane: run every `-m multichip` test (the serving
+# tensor-parallel mesh matrices and friends) under the same 8-device
+# virtual CPU mesh the MULTICHIP_r0x benches are invoked with — so the
+# GSPMD-sharded serving path cannot rot silently between tier-1 runs.
+#
+#   tools/run_multichip_tests.sh            # the whole multichip lane
+#   tools/run_multichip_tests.sh -k mesh    # subset, extra args pass
+#                                           # through to pytest
+#
+# The mesh token-identity matrix (mesh 1/2/4 x greedy/seeded x
+# speculate_k {0,4} x preempt-resume) and the sharded compile-count
+# pins live in tests/test_serving.py; `--mesh` bench rows come from
+#   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+#       JAX_PLATFORMS=cpu python tools/bench_serving.py tiny --mesh 1 2 4
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+exec python -m pytest tests/ -q -m multichip \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
