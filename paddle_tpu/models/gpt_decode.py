@@ -35,13 +35,24 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["collect_gpt_params", "gpt_forward_logits", "gpt_prefill",
+__all__ = ["collect_gpt_params", "quantize_params", "gpt_forward_logits",
+           "gpt_prefill",
            "gpt_prefill_padded", "gpt_decode_step", "gpt_decode_step_slots",
            "gpt_decode_chunk_slots", "gpt_prefill_pages",
            "gpt_decode_step_pages", "gpt_decode_chunk_pages",
            "gpt_decode_verify_slots", "gpt_decode_verify_pages",
-           "spec_ngram_seed", "gpt_generate",
+           "spec_ngram_seed", "gpt_generate", "QUANTIZED_KV_KERNELS",
            "threefry2x32", "sample_key", "sample_split", "sample_gumbel"]
+
+# The paged kernels whose in-graph KV dequant path exists: a quantized
+# (int8 + scale plane) arena may ONLY flow through kernels named here.
+# Config validation reads this to refuse combinations whose dequant
+# path is not covered (e.g. speculate_k > 0 needs the verify kernel)
+# instead of silently falling back to garbage reads — there is no fp32
+# fallback anywhere in the quantized path.
+QUANTIZED_KV_KERNELS = ("gpt_prefill_pages", "gpt_decode_step_pages",
+                        "gpt_decode_chunk_pages",
+                        "gpt_decode_verify_pages")
 
 
 def _ln_names(name):
@@ -76,6 +87,43 @@ def collect_gpt_params(scope, cfg, prefix="gpt", dtype=None):
     return p
 
 
+def quantize_params(params, cfg):
+    """Weight-only int8 quantization of the decode parameter pytree:
+    the q/k/v/out/mlp1/mlp2 matmul weights become per-OUTPUT-CHANNEL
+    abs-max int8 (the reference's FakeChannelWiseQuantizeAbsMax
+    discipline, quant_axis=1 for [in, out] mul weights) with f32
+    scales; embeddings, layer norms, and biases stay full precision —
+    they are a rounding error of the byte budget and the LN statistics
+    are the numerics the token-identity tests lean on. The returned
+    pytree's quantized projections hold {"w_q": int8 (in, out),
+    "w_s": f32 (out,), "b": ...}; _dense applies the dequant IN-GRAPH
+    as (x @ w_q) * w_s, so the fp32 weight matrix is never
+    materialized — HBM holds one byte per weight plus one scale per
+    output channel, and XLA fuses the scale multiply into the matmul's
+    consumer. Deterministic: a pure function of the weights, so two
+    engines quantizing the same checkpoint serve bit-identical
+    streams."""
+    import jax.numpy as jnp
+
+    def q(w):
+        w32 = jnp.asarray(w).astype(jnp.float32)
+        s = jnp.max(jnp.abs(w32), axis=0)            # (out,)
+        safe = jnp.where(s > 0, s, 1.0)
+        wq = jnp.clip(jnp.round(w32 * (127.0 / safe)),
+                      -127, 127).astype(jnp.int8)
+        return wq, (s / 127.0).astype(jnp.float32)
+
+    out = {"wte": params["wte"], "wpe": params["wpe"],
+           "lnf": params["lnf"], "blocks": []}
+    for blk in params["blocks"]:
+        nb = {"ln1": blk["ln1"], "ln2": blk["ln2"]}
+        for nm in ("q", "k", "v", "out", "mlp1", "mlp2"):
+            wq, ws = q(blk[nm]["w"])
+            nb[nm] = {"w_q": wq, "w_s": ws, "b": blk[nm]["b"]}
+        out["blocks"].append(nb)
+    return out
+
+
 def _ln(x, p, eps=1e-5):
     import jax
     import jax.numpy as jnp
@@ -88,6 +136,13 @@ def _ln(x, p, eps=1e-5):
 
 
 def _dense(x, p):
+    if "w_q" in p:
+        # weight-only int8: dequant fused into the matmul epilogue —
+        # (x @ w_q) * s == x @ (w_q * s) exactly for per-output-channel
+        # scales (the scale factors out of the contraction), so the
+        # int8 matrix is the only weight tensor resident
+        y = (x @ p["w_q"].astype(x.dtype)) * p["w_s"].astype(x.dtype)
+        return y + p["b"].astype(x.dtype)
     return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
 
 
@@ -355,11 +410,12 @@ def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
 
     heads = cfg.heads
     hd = cfg.hidden // cfg.heads
-    bs = arena.shape[4]
+    data, _scales = _arena_parts(arena)
+    bs = data.shape[4]
     s_dim, P = pt.shape
     D = toks.shape[1]
     L = P * bs
-    dtype = arena.dtype
+    dtype = _arena_compute_dtype(params, data, _scales)
     rows = jnp.arange(s_dim)[:, None]
     pos = ts[:, None] + jnp.arange(D)[None, :]           # (S, D)
     x = (params["wte"][toks] + params["wpe"][pos]).astype(dtype)
@@ -374,10 +430,10 @@ def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
         q = _dense(h, blk["q"]).reshape(s_dim, D, heads, hd)
         k = _dense(h, blk["k"]).reshape(s_dim, D, heads, hd)
         v = _dense(h, blk["v"]).reshape(s_dim, D, heads, hd)
-        arena = arena.at[li, 0, wblk, :, woff, :].set(k)
-        arena = arena.at[li, 1, wblk, :, woff, :].set(v)
-        K = _gather_pages(arena[li, 0], pt)        # (S, n, L, hd)
-        V = _gather_pages(arena[li, 1], pt)
+        arena = _kv_write(arena, li, 0, wblk, woff, k)
+        arena = _kv_write(arena, li, 1, wblk, woff, v)
+        K = _kv_gather(arena, li, 0, pt, dtype)    # (S, n, L, hd)
+        V = _kv_gather(arena, li, 1, pt, dtype)
         scores = jnp.einsum("bqnd,bnkd->bnqk", q, K,
                             preferred_element_type=jnp.float32)
         scores = jnp.where(pos_mask[:, None, :, :],
@@ -621,6 +677,80 @@ def _gather_pages(plane, pages):
                      g.shape[-1])
 
 
+# -- quantized block arena ---------------------------------------------------
+#
+# A quantized arena is the pytree (data, scales): data is the usual
+# (layers, 2, num_blocks, heads, block_size, hd) laid down in int8, and
+# scales is the per-block scale PLANE (layers, 2, num_blocks, heads,
+# block_size) — one f32 abs-max scale per written K/V row per head, so
+# every scatter quantizes chip-locally (the heads axis shards over the
+# tp mesh exactly like the data) and every page gather dequantizes
+# in-graph right before the attention matmul. The paged kernels below
+# accept either form; the scratch-block discipline covers BOTH leaves
+# (a frozen slot's redirected write dirties scratch data AND scratch
+# scales, never a reallocated block's).
+
+def _arena_parts(arena):
+    """(data, scales) of a paged arena — scales is None for the
+    full-precision (bare-array) form."""
+    if isinstance(arena, tuple):
+        return arena
+    return arena, None
+
+
+def _arena_compute_dtype(params, data, scales):
+    """The activation dtype a paged kernel runs in: the arena dtype for
+    the full-precision form (f32/bf16 engines), the params' wte-derived
+    dtype for a quantized arena (int8 is storage, never math)."""
+    import jax.numpy as jnp
+    if scales is None:
+        return data.dtype
+    return params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
+        else jnp.float32
+
+
+def _quantize_rows(val):
+    """Per-(row, head) abs-max int8: val (..., heads, hd) ->
+    (q int8 same shape, scale f32 (..., heads)). Zero rows quantize to
+    zero with scale zero — dequant reproduces the zeros exactly."""
+    import jax.numpy as jnp
+    v32 = val.astype(jnp.float32)
+    a = jnp.max(jnp.abs(v32), axis=-1)               # (..., heads)
+    safe = jnp.where(a > 0, a, 1.0)
+    q = jnp.clip(jnp.round(v32 * (127.0 / safe[..., None])),
+                 -127, 127).astype(jnp.int8)
+    return q, (a / 127.0).astype(jnp.float32)
+
+
+def _kv_write(arena, li, j, wblk, woff, val):
+    """One ride-along K/V scatter (j = 0 for K, 1 for V): plain write
+    on a full-precision arena, quantize-at-scatter on a quantized one
+    (data row + its scale-plane entry land through the SAME redirected
+    block index, so the scratch/frozen-slot discipline holds for
+    both)."""
+    data, scales = _arena_parts(arena)
+    if scales is None:
+        return data.at[li, j, wblk, :, woff, :].set(val)
+    q, s = _quantize_rows(val)
+    return (data.at[li, j, wblk, :, woff, :].set(q),
+            scales.at[li, j, wblk, :, woff].set(s))
+
+
+def _kv_gather(arena, li, j, pages, dtype):
+    """Page-gather one K or V matrix, dequantized in-graph for a
+    quantized arena: rows come back as int8 * their scale-plane entry,
+    fused right before the attention einsum — the only dequant site,
+    no fp32 copy of the pool ever exists."""
+    data, scales = _arena_parts(arena)
+    k = _gather_pages(data[li, j], pages)
+    if scales is None:
+        return k
+    g = scales[li, j][pages]              # (..., P, heads, bs)
+    g = g.swapaxes(-3, -2)                # (..., heads, P, bs)
+    s = g.reshape(*g.shape[:-2], g.shape[-2] * g.shape[-1])
+    return k.astype(dtype) * s[..., None].astype(dtype)
+
+
 def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
                       pages):
     """Paged prefill of ONE sequence's prompt SUFFIX into its arena
@@ -655,9 +785,10 @@ def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
 
     heads, hd = cfg.heads, cfg.hidden // cfg.heads
     b, B = tokens.shape
-    bs = arena.shape[4]
+    data, _scales = _arena_parts(arena)
+    bs = data.shape[4]
     L = pages.shape[0] * bs
-    dtype = arena.dtype
+    dtype = _arena_compute_dtype(params, data, _scales)
     j = jnp.arange(B)
     pos = pfx_len + j                              # absolute positions
     x = (params["wte"][tokens[0]] + params["wpe"][pos]).astype(dtype)
@@ -673,10 +804,10 @@ def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
         q = _dense(h, blk["q"]).reshape(B, heads, hd)
         k = _dense(h, blk["k"]).reshape(B, heads, hd)
         v = _dense(h, blk["v"]).reshape(B, heads, hd)
-        arena = arena.at[li, 0, wblk, :, woff, :].set(k)
-        arena = arena.at[li, 1, wblk, :, woff, :].set(v)
-        K = _gather_pages(arena[li, 0], pages)     # (heads, L, hd)
-        V = _gather_pages(arena[li, 1], pages)
+        arena = _kv_write(arena, li, 0, wblk, woff, k)
+        arena = _kv_write(arena, li, 1, wblk, woff, v)
+        K = _kv_gather(arena, li, 0, pages, dtype)  # (heads, L, hd)
+        V = _kv_gather(arena, li, 1, pages, dtype)
         scores = jnp.einsum("bnd,nkd->bnk", q, K,
                             preferred_element_type=jnp.float32)
         scores = jnp.where(mask[:, None, :], scores / np.sqrt(hd), -1e30)
@@ -708,10 +839,11 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
 
     heads = cfg.heads
     hd = cfg.hidden // cfg.heads
-    bs = arena.shape[4]
+    data, _scales = _arena_parts(arena)
+    bs = data.shape[4]
     s_dim, P = pt.shape
     L = P * bs
-    dtype = arena.dtype
+    dtype = _arena_compute_dtype(params, data, _scales)
     rows = jnp.arange(s_dim)
     x = (params["wte"][tokens] + params["wpe"][ts]).astype(dtype)[:, None]
     pos_mask = (jnp.arange(L)[None, :] <= ts[:, None])     # [S, L]
@@ -724,10 +856,10 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
         q = _dense(h, blk["q"]).reshape(s_dim, heads, 1, hd)
         k = _dense(h, blk["k"]).reshape(s_dim, heads, hd)
         v = _dense(h, blk["v"]).reshape(s_dim, heads, hd)
-        arena = arena.at[li, 0, wblk, :, woff, :].set(k)
-        arena = arena.at[li, 1, wblk, :, woff, :].set(v)
-        K = _gather_pages(arena[li, 0], pt)    # (S, heads, L, hd)
-        V = _gather_pages(arena[li, 1], pt)
+        arena = _kv_write(arena, li, 0, wblk, woff, k)
+        arena = _kv_write(arena, li, 1, wblk, woff, v)
+        K = _kv_gather(arena, li, 0, pt, dtype)  # (S, heads, L, hd)
+        V = _kv_gather(arena, li, 1, pt, dtype)
         scores = jnp.einsum("bnqd,bnkd->bnqk", q, K,
                             preferred_element_type=jnp.float32)
         scores = jnp.where(pos_mask[:, None, None, :],
@@ -772,7 +904,16 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
     scan carry at the top of every iteration so GSPMD keeps the
     per-head block layout stable through the whole fused loop — one
     sharded executable, no mid-scan resharding/all-gather of the
-    arena. Purely a layout pin: the computed values are unchanged."""
+    arena. Purely a layout pin: the computed values are unchanged.
+
+    QUANTIZED ARENA: `arena` may be the (int8 data, f32 scale plane)
+    pytree — the scan carries both leaves, every ride-along write
+    quantizes at the scatter and every page gather dequantizes
+    in-graph (see _kv_write/_kv_gather), and the frozen-slot scratch
+    redirect covers data AND scales. Streams from a quantized engine
+    are bit-identical to themselves across chunk sizes, preemption,
+    and mesh shapes — the same determinism contract as fp32, pinned
+    against its own quantized reference rather than the fp32 one."""
     import jax
     import jax.numpy as jnp
 

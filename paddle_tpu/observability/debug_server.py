@@ -165,13 +165,17 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
             "swap_ins": "serving_swap_ins_total",
             "swapped_slots": "serving_swapped_slots",
         }),
-        # tensor-parallel mesh geometry per engine: shard count and the
-        # PER-CHIP arena bytes (pool_bytes / tp) — so an operator can
-        # see which replicas are tensor-parallel and what one chip
+        # tensor-parallel mesh + quantization geometry per engine:
+        # shard count, the PER-CHIP arena bytes (pool_bytes / tp), the
+        # arena storage itemsize (1 = int8-quantized KV), and the
+        # served weight bytes — so an operator can see which replicas
+        # are tensor-parallel and/or quantized and what one chip
         # actually holds, straight off the scrape path
         "mesh": registry_rollup(snap, {
             "mesh_shards": "serving_mesh_shards",
             "kv_pool_per_chip_bytes": "serving_kv_pool_per_chip_bytes",
+            "kv_dtype_bytes": "serving_kv_dtype_bytes",
+            "weight_bytes": "serving_weight_bytes",
         }),
         # host/device dispatch split (ServingConfig(dispatch_timing)):
         # mean launch-side host ms per fused dispatch — the pinned

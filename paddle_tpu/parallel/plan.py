@@ -379,7 +379,13 @@ class ServingTPPlan:
 
     def shard_params(self, params):
         """device_put the GPT decode pytree onto the mesh under the
-        Megatron TP layout (embeddings/LNs replicated)."""
+        Megatron TP layout (embeddings/LNs replicated). Weight-only
+        int8 projections (gpt_decode.quantize_params: {"w_q", "w_s",
+        "b"}) shard w_q exactly as the fp32 w would, and the
+        per-output-channel scale vector rides the BIAS spec — scales
+        and bias live on the same (output) axis, so column-parallel
+        scales split over tp with their channels and row-parallel
+        scales replicate."""
         import jax
 
         def put(v, *parts):
@@ -392,13 +398,20 @@ class ServingTPPlan:
             nb = {"ln1": {k: put(v) for k, v in blk["ln1"].items()},
                   "ln2": {k: put(v) for k, v in blk["ln2"].items()}}
             for nm, (wspec, bspec) in _GPT_TP_SPECS.items():
-                nb[nm] = {"w": put(blk[nm]["w"], *wspec),
-                          "b": put(blk[nm]["b"], *bspec)}
+                if "w_q" in blk[nm]:
+                    nb[nm] = {"w_q": put(blk[nm]["w_q"], *wspec),
+                              "w_s": put(blk[nm]["w_s"], *bspec),
+                              "b": put(blk[nm]["b"], *bspec)}
+                else:
+                    nb[nm] = {"w": put(blk[nm]["w"], *wspec),
+                              "b": put(blk[nm]["b"], *bspec)}
             out["blocks"].append(nb)
         return out
 
     def shard_arena(self, arena):
-        """Place the KV block arena heads-sharded over the mesh."""
+        """Place the KV block arena heads-sharded over the mesh (a
+        quantized pool's (data, scales) pytree shards both leaves —
+        device_put broadcasts the single sharding)."""
         import jax
         return jax.device_put(arena, self.arena_sharding)
 
@@ -419,14 +432,20 @@ class ServingTPPlan:
     # degrades to a copy.
 
     def constrain_arena(self, arena):
+        """with_sharding_constraint(heads on tp) over the arena — the
+        bare data array, or the (int8 data, f32 scale plane) pytree of
+        a quantized pool (the heads axis is dim 3 in both leaves, so
+        one spec pins both)."""
         import jax
-        return jax.lax.with_sharding_constraint(arena,
-                                                self.arena_sharding)
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, self.arena_sharding), arena)
 
     def constrain_payload(self, payload):
         import jax
-        return jax.lax.with_sharding_constraint(payload,
-                                                self.payload_sharding)
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.with_sharding_constraint(
+                p, self.payload_sharding), payload)
 
     def constrain_rep(self, tree):
         """with_sharding_constraint(replicated) over a pytree."""

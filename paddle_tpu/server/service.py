@@ -291,6 +291,13 @@ class _Handler(BaseHTTPRequestHandler):
                  "mesh_shape": list(r.mesh_shape),
                  "hbm_per_chip_bytes": int(
                      r.engine.kv.hbm_per_chip_bytes),
+                 # quantization identity: the arena storage dtype and
+                 # the served weight bytes — operators sizing a fleet
+                 # must see which replicas run quantized (a
+                 # dtype-blind reading of the block gauges would
+                 # overstate an int8 replica's HBM ~4x)
+                 "kv_dtype": r.engine.kv.kv_dtype,
+                 "weight_bytes": int(r.engine.weight_bytes),
                  "swapped_slots": int(r.engine.metrics.swapped_slots),
                  "preemptions": int(r.engine.metrics.preemptions),
                  # completed cross-replica migrations this replica

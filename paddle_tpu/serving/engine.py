@@ -106,6 +106,26 @@ class ServingConfig:
     cfg.ffn % tp == 0. None (the default) builds the single-chip engine
     with zero mesh machinery.
 
+    Quantization knobs (both default None = full precision):
+    weight_dtype="int8" quantizes the q/k/v/out/mlp matmul weights to
+    per-output-channel int8 + f32 scales at engine construction, with
+    dequant fused in-graph (embeddings/LNs/biases stay fp32);
+    kv_dtype="int8" allocates the paged block arena as int8 with a
+    per-block f32 scale plane — K/V rows quantize at the ride-along
+    scatter and dequantize inside the page-gather attention of
+    prefill/decode/verify. Together they roughly quadruple resident
+    weights+KV per chip; the tokens/s-per-GB win and the accuracy
+    budget (greedy token agreement, max logit delta vs fp32) are
+    MEASURED by `bench_serving --quantize` and pinned in tests.
+    Quantized streams stay deterministic — bit-identical to themselves
+    across chunk sizes, preempt/resume, migration, and mesh shapes —
+    and swap/migration payloads carry dtype + scales (a
+    dtype-mismatched MigrationTicket rejects with TicketError).
+    Unknown dtype strings raise at construction; kv_dtype="int8" with
+    speculate_k > 0 additionally requires the verify kernel's dequant
+    path (gpt_decode.QUANTIZED_KV_KERNELS) — covered today, asserted
+    so it can never silently rot.
+
     Observability knobs: dispatch_timing=True attributes every fused
     decode dispatch's wall time into launch-side host work vs the
     blocking wait for its result (serving_dispatch_{host,device}_seconds
@@ -126,6 +146,8 @@ class ServingConfig:
                  preempt: bool = False,
                  preempt_policy="newest",
                  mesh_shape: Optional[Sequence[int]] = None,
+                 weight_dtype: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  fault_plan=None,
                  dispatch_timing: bool = False,
                  clock: Callable[[], float] = time.monotonic):
@@ -168,6 +190,27 @@ class ServingConfig:
         # ServingTPPlan at engine construction where cfg is in hand
         self.mesh_shape = tuple(int(m) for m in mesh_shape) \
             if mesh_shape is not None else None
+        # quantized serving (both off by default): weight_dtype="int8"
+        # runs the q/k/v/out/mlp matmuls against per-output-channel
+        # int8 weights with the dequant fused in-graph
+        # (gpt_decode.quantize_params); kv_dtype="int8" packs the
+        # paged block arena as int8 with a per-block scale plane,
+        # quantize-at-scatter / dequant-at-gather. Unknown values are
+        # a LOUD config error here — there is no silent fp32 fallback
+        # anywhere in the quantized path. Accuracy is a measured,
+        # pinned budget (bench_serving --quantize; tests), not a
+        # promise of fp32 bit-identity: a quantized engine is
+        # bit-identical to ITSELF across chunk sizes, preemption,
+        # migration, and mesh shapes.
+        for knob, val in (("weight_dtype", weight_dtype),
+                          ("kv_dtype", kv_dtype)):
+            if val not in (None, "int8"):
+                raise ValueError(
+                    f"unknown {knob} {val!r}: expected None (full "
+                    "precision) or 'int8' — quantized serving never "
+                    "falls back silently")
+        self.weight_dtype = weight_dtype
+        self.kv_dtype = kv_dtype
         # deterministic fault injection (serving.faults.FaultPlan):
         # scheduled step exceptions / forced page shortages / delays —
         # None in production
@@ -253,6 +296,37 @@ class ServingEngine:
         import jax.numpy as jnp
         dtype = params["wte"].dtype if params["wte"].dtype == jnp.bfloat16 \
             else jnp.float32
+        # quantized serving: weight-only int8 happens HERE, before the
+        # scheduler shards anything, so the int8 tensors + scales ride
+        # the same Megatron TP placement the fp32 weights would. The
+        # kv_dtype="int8" x speculate_k gate is a coverage assert, not
+        # a policy: the verify kernel must carry the in-graph dequant
+        # path (gpt_decode.QUANTIZED_KV_KERNELS) or the combination
+        # refuses loudly — a quantized arena must never flow through a
+        # kernel that would read its int8 rows as values.
+        from ..models import gpt_decode as _gd
+        if serving.kv_dtype == "int8" and serving.speculate_k > 0 \
+                and "gpt_decode_verify_pages" not in \
+                _gd.QUANTIZED_KV_KERNELS:
+            raise ValueError(
+                "kv_dtype='int8' with speculate_k > 0 requires the "
+                "verify kernel's dequant path "
+                "(gpt_decode.QUANTIZED_KV_KERNELS lacks "
+                "'gpt_decode_verify_pages') — refusing rather than "
+                "silently reading quantized rows as values")
+        if serving.weight_dtype == "int8":
+            params = _gd.quantize_params(params, cfg)
+        # whole-model parameter bytes AS SERVED (post-quantization,
+        # pre-sharding: the sum across chips on a mesh) — the
+        # capacity-planning number next to pool_bytes — and the dtype
+        # label stats() reports: the quantization knob when set, else
+        # the ACTUAL matmul-weight dtype (a bf16 checkpoint serves
+        # bfloat16 weights, not "float32")
+        import jax
+        self.weight_bytes = int(sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)))
+        self._weight_dtype = serving.weight_dtype \
+            or str(jnp.dtype(params["wte"].dtype))
         # tensor-parallel mesh plan: built ONCE here (validates device
         # count + head/ffn divisibility), threaded into the scheduler,
         # which shards params + arena at construction so every jitted
@@ -268,7 +342,8 @@ class ServingEngine:
                               prefix_cache=serving.prefix_cache,
                               mesh_shards=plan.tp if plan else 1,
                               arena_device=plan.arena_sharding
-                              if plan else None)
+                              if plan else None,
+                              kv_dtype=serving.kv_dtype)
         self.scheduler = ContinuousBatchingScheduler(
             params, cfg, self.kv, self.buckets, top_k=serving.top_k,
             decode_chunk=serving.decode_chunk, overlap=serving.overlap,
@@ -294,12 +369,17 @@ class ServingEngine:
             # metrics reset keeps feeding the replacement instance
             self.scheduler.on_dispatch_timed = self._on_dispatch_timed
         self.metrics.kv_blocks_total = self.kv.blocks_total
-        # mesh geometry gauges, constant for the engine's life: the
-        # shard count and the PER-CHIP arena bytes (pool_bytes / tp) —
-        # the numbers /varz' mesh rollup and capacity planning read;
-        # whole-arena pool_bytes alone overstates per-chip HBM by tp
+        # mesh + quantization geometry gauges, constant for the
+        # engine's life: the shard count, the PER-CHIP arena bytes
+        # (pool_bytes / tp), the arena storage itemsize, and the
+        # served weight bytes — the numbers /varz' mesh rollup and
+        # capacity planning read; whole-arena pool_bytes alone
+        # overstates per-chip HBM by tp, and a dtype-blind reader
+        # would overstate a quantized pool ~4x
         self.metrics.mesh_shards = self.kv.mesh_shards
         self.metrics.kv_pool_per_chip_bytes = self.kv.hbm_per_chip_bytes
+        self.metrics.kv_dtype_bytes = self.kv.dtype.itemsize
+        self.metrics.weight_bytes = self.weight_bytes
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
         # host swap pool: SwappedSequence records of preempted RUNNING
@@ -591,11 +671,14 @@ class ServingEngine:
         self.metrics.kv_blocks_cached = self.kv.blocks_cached
         self.metrics.prefix_cache_hits = self.kv.prefix_hits
         self.metrics.prefix_cache_misses = self.kv.prefix_misses
-        # constant mesh geometry refreshed with the other gauges so a
-        # replaced metrics instance (the bench's post-warmup reset)
-        # heals on the next step instead of scraping as single-chip
+        # constant mesh/quantization geometry refreshed with the other
+        # gauges so a replaced metrics instance (the bench's
+        # post-warmup reset) heals on the next step instead of
+        # scraping as single-chip full-precision
         self.metrics.mesh_shards = self.kv.mesh_shards
         self.metrics.kv_pool_per_chip_bytes = self.kv.hbm_per_chip_bytes
+        self.metrics.kv_dtype_bytes = self.kv.dtype.itemsize
+        self.metrics.weight_bytes = self.weight_bytes
         return emitted
 
     def _admission_feasible(self, req, step_no: int) -> bool:
@@ -927,6 +1010,11 @@ class ServingEngine:
         s = self.metrics.snapshot()
         s.update(self.kv.occupancy())
         s["queue_depth"] = len(self._queue)
+        # quantization identity next to the pool numbers (occupancy
+        # already carries kv_dtype): which weight path this engine
+        # serves and the bytes it actually holds
+        s["weight_dtype"] = self._weight_dtype
+        s["weight_bytes"] = self.weight_bytes
         # host memory the swap pool currently pins (0 when nothing is
         # preempted — the pool exists only under pressure)
         s["swap_pool_bytes"] = sum(sw.swap_bytes for sw in self._swapped)

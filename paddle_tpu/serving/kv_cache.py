@@ -110,7 +110,7 @@ class SlotKVCache:
     def __init__(self, cfg, num_slots: int, max_len: int, dtype=None,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  prefix_cache: bool = True, mesh_shards: int = 1,
-                 arena_device=None):
+                 arena_device=None, kv_dtype: Optional[str] = None):
         import jax.numpy as jnp
 
         if num_slots < 1:
@@ -148,10 +148,25 @@ class SlotKVCache:
                 f"num_blocks must be >= 2 (scratch + 1), got {num_blocks}")
         self.prefix_cache_enabled = bool(prefix_cache)
         heads, hd = cfg.heads, cfg.hidden // cfg.heads
-        self.dtype = jnp.dtype(dtype) if dtype is not None \
-            else jnp.dtype(jnp.float32)
+        # kv_dtype: the arena STORAGE discipline — None keeps the
+        # compute-dtype slab ("float32"/"bfloat16" pool), "int8" packs
+        # one byte per K/V value plus a per-(block, head, row) f32
+        # scale plane (models/gpt_decode quantize-at-scatter /
+        # dequant-at-gather). Anything else is a loud config error —
+        # there is no silent fp32 fallback for an unknown dtype.
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r}: expected None "
+                "(full precision) or 'int8'")
+        self.kv_quantized = kv_dtype == "int8"
+        if self.kv_quantized:
+            self.dtype = jnp.dtype(jnp.int8)
+        else:
+            self.dtype = jnp.dtype(dtype) if dtype is not None \
+                else jnp.dtype(jnp.float32)
         shape = (cfg.layers, 2, self.num_blocks, heads, self.block_size,
                  hd)
+        scale_shape = shape[:-1]          # one scale per K/V row per head
         # arena_device (a jax sharding/device or None = default): the
         # arena must be ALLOCATED under its mesh sharding, not
         # allocated whole and resharded after — allocate-then-move
@@ -159,11 +174,24 @@ class SlotKVCache:
         # construction, defeating exactly the per-chip capacity win a
         # sharded pool exists for (invisible on CPU, an OOM on real
         # chips sized near per-chip HBM)
-        self.kv = jnp.zeros(shape, self.dtype) if arena_device is None \
-            else jnp.zeros(shape, self.dtype, device=arena_device)
+        def alloc(shp, dt):
+            return jnp.zeros(shp, dt) if arena_device is None \
+                else jnp.zeros(shp, dt, device=arena_device)
+
+        self.kv = alloc(shape, self.dtype)
+        # the scale plane shards on the heads axis alongside the data
+        # (same PartitionSpec prefix — dim 3), so quantize/dequant stay
+        # chip-local on a tp mesh
+        self.kv_scales = alloc(scale_shape, jnp.float32) \
+            if self.kv_quantized else None
         # constant for the engine's life (donation reuses the buffer in
-        # place every dispatch) — computed ONCE, no per-call numpy walk
+        # place every dispatch) — computed ONCE from the ACTUAL arena
+        # itemsize(s), never an assumed fp32: an int8 pool is data
+        # bytes + its f32 scale plane, a quarter-ish of the slab a
+        # dtype-blind formula would report
         self._pool_bytes = math.prod(shape) * self.dtype.itemsize
+        if self.kv_quantized:
+            self._pool_bytes += math.prod(scale_shape) * 4
         # -- slot allocator (page-table rows) --
         self._free = list(range(self.num_slots - 1, -1, -1))  # pop->0,1,..
         self._free_set = set(self._free)           # O(1) double-free check
@@ -477,12 +505,43 @@ class SlotKVCache:
     def length(self, slot: int) -> int:
         return self._len[slot]
 
+    # -- arena threading ----------------------------------------------------
+
+    @property
+    def arena(self):
+        """What the scheduler's jitted entry points thread and donate:
+        the bare data array for a full-precision pool, the (data,
+        scale plane) pytree for an int8 pool — the form the paged
+        kernels' _arena_parts expects. Same donation discipline either
+        way (a tuple donates both leaves)."""
+        if self.kv_scales is not None:
+            return (self.kv, self.kv_scales)
+        return self.kv
+
+    def store_arena(self, arena) -> None:
+        """Store a dispatch's arena output back (the donated buffers'
+        successors) — the write half of the `arena` property."""
+        if self.kv_scales is not None:
+            self.kv, self.kv_scales = arena
+        else:
+            self.kv = arena
+
+    @property
+    def kv_dtype(self) -> str:
+        """The arena's storage dtype name ("float32" / "bfloat16" /
+        "int8") — the string occupancy(), /varz, and /healthz report;
+        migration tickets are dtype-checked against the numpy dtype
+        behind it."""
+        return str(self.dtype)
+
     @property
     def pool_bytes(self) -> int:
         """WHOLE-ARENA HBM footprint — constant for the engine's life
-        (donation reuses the same buffer in place every dispatch). On a
-        tensor-parallel mesh this is the sum across chips; the number
-        one chip actually holds is hbm_per_chip_bytes."""
+        (donation reuses the same buffer in place every dispatch),
+        derived from the ACTUAL storage itemsize plus the scale plane
+        on a quantized pool. On a tensor-parallel mesh this is the sum
+        across chips; the number one chip actually holds is
+        hbm_per_chip_bytes."""
         return self._pool_bytes
 
     @property
@@ -506,6 +565,7 @@ class SlotKVCache:
                 "live_positions": sum(self._len),
                 "pool_bytes": self.pool_bytes,
                 "hbm_per_chip_bytes": self.hbm_per_chip_bytes,
+                "kv_dtype": self.kv_dtype,
                 "mesh_shape": self.mesh_shape,
                 "block_size": self.block_size,
                 "blocks_total": self.blocks_total,

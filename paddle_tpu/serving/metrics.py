@@ -161,6 +161,14 @@ _HELP = {
                               "(pool_bytes / mesh_shards — the "
                               "capacity-planning number on a sharded "
                               "pool)",
+    "kv_dtype_bytes": "bytes per stored K/V value in the paged arena "
+                      "(4 = float32, 2 = bfloat16, 1 = int8-quantized "
+                      "— scale planes excluded; pool gauges carry the "
+                      "full footprint)",
+    "weight_bytes": "whole-model parameter bytes as served (post-"
+                    "quantization; summed across chips on a mesh) — "
+                    "the weight half of the capacity budget next to "
+                    "the KV pool gauges",
 }
 
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
@@ -170,7 +178,8 @@ _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
              "preemptions", "swap_ins")
 _GAUGES = ("active_slots", "queue_depth", "kv_blocks_total",
            "kv_blocks_used", "kv_blocks_cached", "swapped_slots",
-           "mesh_shards", "kv_pool_per_chip_bytes")
+           "mesh_shards", "kv_pool_per_chip_bytes",
+           "kv_dtype_bytes", "weight_bytes")
 _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tpot": "serving_tpot_seconds",
                "queue_wait": "serving_queue_wait_seconds",

@@ -67,7 +67,12 @@ import numpy as np
 __all__ = ["MigrationTicket", "MigrationError", "TicketError",
            "TICKET_VERSION"]
 
-TICKET_VERSION = 1
+# version 2: the digest layout grew the quantized-KV scale plane (a
+# presence byte + dtype/shape/bytes when present). Bumped so a v1
+# ticket meeting new code — a rolling upgrade with old- and new-code
+# replicas coexisting — refuses as a typed VERSION mismatch instead of
+# being misdiagnosed as payload corruption by the checksum compare.
+TICKET_VERSION = 2
 
 
 class MigrationError(RuntimeError):
@@ -101,7 +106,7 @@ class MigrationTicket:
         # sequence state (SwappedSequence minus the engine-bound req)
         "pos", "produced", "seq", "length", "n_blocks", "block_size",
         "payload", "token", "ts", "remaining", "temp", "eos", "key_row",
-        "spec", "mesh_shape",
+        "spec", "mesh_shape", "scales",
     )
 
     def __init__(self, prompt, max_new, temperature, seed, eos_id,
@@ -109,7 +114,8 @@ class MigrationTicket:
                  n_blocks, block_size, payload, token, ts, remaining,
                  temp, eos, key_row, spec=None, tenant=None,
                  rerouted_from=(), slo_stamps=None, version=None,
-                 checksum=None, created_unix=None, mesh_shape=(1,)):
+                 checksum=None, created_unix=None, mesh_shape=(1,),
+                 scales=None):
         self.version = TICKET_VERSION if version is None else int(version)
         self.created_unix = time.time() if created_unix is None \
             else float(created_unix)
@@ -132,6 +138,11 @@ class MigrationTicket:
         # numpy dtypes preserved verbatim: the adopting swap_in jit must
         # see the signature the preemption path already compiled
         self.payload = np.asarray(payload)
+        # quantized-KV sources: the payload's f32 scale-plane rows
+        # ((L, 2, n_blocks, heads, bs)) — sequence state like the
+        # payload itself, INSIDE the checksum; None from a
+        # full-precision pool
+        self.scales = None if scales is None else np.asarray(scales)
         self.token = token
         self.ts = ts
         self.remaining = remaining
@@ -171,7 +182,7 @@ class MigrationTicket:
             block_size=block_size, payload=sw.payload,
             token=sw.token, ts=sw.ts, remaining=sw.remaining,
             temp=sw.temp, eos=sw.eos, key_row=sw.key_row, spec=sw.spec,
-            mesh_shape=mesh_shape)
+            mesh_shape=mesh_shape, scales=sw.scales)
 
     # -- integrity ------------------------------------------------------------
 
@@ -183,8 +194,10 @@ class MigrationTicket:
     @property
     def swap_bytes(self) -> int:
         """Host footprint of the ticket's KV payload (the journal's
-        `bytes` field and the transfer-size a scheduler would weigh)."""
-        return int(self.payload.nbytes)
+        `bytes` field and the transfer-size a scheduler would weigh);
+        a quantized payload's scale-plane rows count too."""
+        return int(self.payload.nbytes) + (
+            int(self.scales.nbytes) if self.scales is not None else 0)
 
     def _digest(self) -> str:
         """blake2b over every sequence-critical field. Annotations the
@@ -203,6 +216,17 @@ class MigrationTicket:
         h.update(str(self.payload.dtype).encode())
         h.update(np.asarray(self.payload.shape, np.int64).tobytes())
         h.update(np.ascontiguousarray(self.payload).tobytes())
+        # the scale plane is sequence state exactly like the int8 rows
+        # it dequantizes (a corrupted scale silently rescales every
+        # value in its row), so the dtype/shape/bytes — and its very
+        # presence — commit to the digest
+        if self.scales is None:
+            h.update(b"\x00")
+        else:
+            h.update(b"\x01")
+            h.update(str(self.scales.dtype).encode())
+            h.update(np.asarray(self.scales.shape, np.int64).tobytes())
+            h.update(np.ascontiguousarray(self.scales).tobytes())
         h.update(np.ascontiguousarray(self.key_row).tobytes())
         for row in (self.token, self.ts, self.remaining, self.temp,
                     self.eos):
@@ -250,9 +274,16 @@ class MigrationTicket:
         # value read here would either crash or force a device sync
         want = np.dtype(kv.dtype)
         if self.payload.dtype != want:
+            # quantization geometry is part of the pool's identity: an
+            # fp32 sequence cannot land in an int8 arena (or vice
+            # versa) — the refusal must be typed, never a scatter
+            # crash or a silent re-dtype
             raise TicketError(
-                f"KV dtype mismatch: ticket {self.payload.dtype}, "
-                f"engine {want}")
+                f"KV dtype mismatch: ticket payload {self.payload.dtype}"
+                f", engine kv_dtype {want} — a "
+                f"{'quantized' if want == np.int8 else 'full-precision'}"
+                " pool only adopts sequences serialized in its own "
+                "storage dtype")
         shape = self.payload.shape
         arena = kv.kv.shape  # (L, 2, num_blocks, heads, bs, hd)
         if len(shape) != 6:
@@ -280,6 +311,21 @@ class MigrationTicket:
                 f"KV block geometry mismatch: ticket payload {shape} "
                 f"({self.n_blocks} blocks), engine per-block "
                 f"{per_block}")
+        quantized = kv.kv_scales is not None
+        if quantized != (self.scales is not None):
+            raise TicketError(
+                f"KV scale-plane mismatch: ticket "
+                f"{'carries' if self.scales is not None else 'lacks'} "
+                f"a scale plane, engine kv_dtype {want} "
+                f"{'requires' if quantized else 'forbids'} one")
+        if self.scales is not None:
+            want_s = shape[:5]            # (L, 2, blocks, heads, bs)
+            if (self.scales.dtype != np.float32
+                    or tuple(self.scales.shape) != want_s):
+                raise TicketError(
+                    f"KV scale-plane geometry mismatch: ticket scales "
+                    f"{self.scales.dtype}{tuple(self.scales.shape)}, "
+                    f"expected float32{want_s}")
         if self.n_blocks > kv.max_pages:
             raise TicketError(
                 f"sequence holds {self.n_blocks} blocks but the engine "
@@ -330,7 +376,7 @@ class MigrationTicket:
             req, self.pos, self.produced, self.max_new, self.eos_id,
             self.seq, self.length, self.n_blocks, self.payload,
             self.token, self.ts, self.remaining, self.temp, self.eos,
-            self.key_row, self.spec)
+            self.key_row, self.spec, scales=self.scales)
 
     def describe(self) -> Dict[str, Any]:
         """Journal/debug summary (no payload bytes)."""
@@ -338,6 +384,7 @@ class MigrationTicket:
                 "tenant": self.tenant, "emitted": self.emitted,
                 "produced": self.produced, "max_new": self.max_new,
                 "n_blocks": self.n_blocks, "bytes": self.swap_bytes,
+                "kv_dtype": str(self.payload.dtype),
                 "mesh_shape": list(self.mesh_shape),
                 "rerouted_from": list(self.rerouted_from),
                 "checksum": self.checksum}

@@ -170,11 +170,12 @@ class SwappedSequence:
 
     __slots__ = ("req", "pos", "produced", "max_new", "eos_id",
                  "seq", "length", "n_blocks", "payload", "token", "ts",
-                 "remaining", "temp", "eos", "key_row", "spec")
+                 "remaining", "temp", "eos", "key_row", "spec",
+                 "scales")
 
     def __init__(self, req, pos, produced, max_new, eos_id, seq,
                  length, n_blocks, payload, token, ts, remaining, temp,
-                 eos, key_row, spec=None):
+                 eos, key_row, spec=None, scales=None):
         self.req = req
         self.pos = pos
         self.produced = produced
@@ -191,11 +192,17 @@ class SwappedSequence:
         self.eos = eos
         self.key_row = key_row
         self.spec = spec                  # (prev, ngram row) or None
+        self.scales = scales              # quantized pools: the f32
+        #                                   scale-plane rows of payload
+        #                                   (L, 2, P, heads, bs); None
+        #                                   on a full-precision pool
 
     @property
     def swap_bytes(self) -> int:
-        """Host swap-pool footprint of this record's KV payload."""
-        return self.payload.nbytes
+        """Host swap-pool footprint of this record's KV payload
+        (scale-plane rows included on a quantized pool)."""
+        return self.payload.nbytes + (self.scales.nbytes
+                                      if self.scales is not None else 0)
 
 
 class _Inflight(NamedTuple):
@@ -245,10 +252,12 @@ class ContinuousBatchingScheduler:
                 # engine-built pools arrive ALREADY allocated under the
                 # plan's sharding (SlotKVCache arena_device=...), which
                 # is the safe path — this fallback reshards a
-                # standalone-constructed pool and transiently holds the
-                # whole arena on one device, so it exists for direct
-                # scheduler construction only, never the engine path
-                kv.kv = plan.shard_arena(kv.kv)
+                # standalone-constructed pool (data AND, on a
+                # quantized pool, the scale plane) and transiently
+                # holds the whole arena on one device, so it exists for
+                # direct scheduler construction only, never the engine
+                # path
+                kv.store_arena(plan.shard_arena(kv.arena))
         self.params = params
         self.cfg = cfg
         self.kv = kv
@@ -475,9 +484,16 @@ class ContinuousBatchingScheduler:
             # (scratch-padded to max_pages — one executable whatever the
             # block count) plus its rows of the decode carry. Read-only:
             # nothing is donated, the arena stays live for the release
-            # + later dispatches enqueued behind this.
+            # + later dispatches enqueued behind this. On a quantized
+            # pool the payload is the (int8 data, f32 scales) pair —
+            # both gathers ride the same block row, so a parked record
+            # always carries the scales its rows dequantize under.
             self._compile_events.append("swap_out")
-            payload = jnp.take(arena, blocks, axis=2)
+            if isinstance(arena, tuple):
+                payload = tuple(jnp.take(a, blocks, axis=2)
+                                for a in arena)
+            else:
+                payload = jnp.take(arena, blocks, axis=2)
             tokens, ts, _done, remaining, temps, eos_ids = state[:6]
             rows = (tokens[slot], ts[slot], remaining[slot], temps[slot],
                     eos_ids[slot], keys[slot])
@@ -495,9 +511,15 @@ class ContinuousBatchingScheduler:
             # freshly adopted page row (padding lanes land in scratch,
             # the trash lane) and rebuild the slot's decode-carry rows
             # exactly as saved — the PRNG chain continues where it
-            # stopped, so resumed streams are bit-identical.
+            # stopped, so resumed streams are bit-identical. Quantized
+            # pools scatter data and scale plane together; the int8
+            # rows are restored verbatim, never re-quantized.
             self._compile_events.append("swap_in")
-            arena = arena.at[:, :, blocks].set(payload)
+            if isinstance(arena, tuple):
+                arena = tuple(a.at[:, :, blocks].set(p)
+                              for a, p in zip(arena, payload))
+            else:
+                arena = arena.at[:, :, blocks].set(payload)
             pt = pt.at[slot].set(blocks)
             keys = keys.at[slot].set(key_row)
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
@@ -607,11 +629,12 @@ class ContinuousBatchingScheduler:
                                   prefix_len=pfx_len,
                                   request_id=getattr(req, "request_id",
                                                      None)):
-            logits, self.kv.kv, self._pt, self._state = \
+            logits, arena, self._pt, self._state = \
                 self._prefill_jit(
-                    self.params, self.kv.kv, self._pt, self._state,
+                    self.params, self.kv.arena, self._pt, self._state,
                     padded, np.int32(pfx_len), np.int32(suffix_len),
                     pages, np.int32(slot))
+            self.kv.store_arena(arena)
             first, self._keys, self._state = self._admit_jit(
                 self._keys, self._state, np.int32(slot), np.int32(seed),
                 logits, np.float32(temperature), np.int32(p_len),
@@ -689,9 +712,10 @@ class ContinuousBatchingScheduler:
                                   slots=self.kv.num_slots,
                                   chunk=self.decode_chunk,
                                   index=self._launches):
-            block, self.kv.kv, self._keys, self._state = self._chunk_jit(
-                self.params, self.kv.kv, self._pt, self._keys,
+            block, arena, self._keys, self._state = self._chunk_jit(
+                self.params, self.kv.arena, self._pt, self._keys,
                 self._state)
+            self.kv.store_arena(arena)
         host_s = (time.perf_counter() - host_t0) if self.dispatch_timing \
             else 0.0
         counts = None
@@ -905,7 +929,7 @@ class ContinuousBatchingScheduler:
         n_blocks = self.kv.mapped_block_count(slot)
         blocks_row = self.kv.page_table[slot].copy()
         host = jax.device_get(self._swapout_jit(
-            self.kv.kv, self._keys, self._state, blocks_row,
+            self.kv.arena, self._keys, self._state, blocks_row,
             np.int32(slot)))
         payload, token, ts, rem, temp, eos, key_row = host[:7]
         spec = (host[7], host[8]) if self.speculate_k else None
@@ -915,12 +939,18 @@ class ContinuousBatchingScheduler:
         # max_pages/n_blocks times the KV bytes actually owned (and
         # swap_pool_bytes would report the inflated number); swap_in
         # re-pads host-side before the scatter, executable unchanged
+        scales = None
+        if isinstance(payload, tuple):            # quantized pool
+            payload, scales = payload
+            scales = np.ascontiguousarray(
+                np.asarray(scales)[:, :, :n_blocks])
         payload = np.ascontiguousarray(
             np.asarray(payload)[:, :, :n_blocks])
         sw = SwappedSequence(
             st.req, st.pos, st.produced, st.max_new, st.eos_id,
             st.seq, self.kv.length(slot), n_blocks, payload,
-            token, ts, rem, temp, eos, np.asarray(key_row), spec)
+            token, ts, rem, temp, eos, np.asarray(key_row), spec,
+            scales=scales)
         self._pt, self._state = self._release_jit(
             self._pt, self._state, np.int32(slot))
         self.kv.free(slot)
@@ -964,26 +994,34 @@ class ContinuousBatchingScheduler:
         # re-pad the parked payload to the executable's max_pages width
         # (swap_out slices it to the owned rows); the pad lanes ride
         # the row's scratch entries, i.e. land in the trash block
-        payload = sw.payload
-        if payload.shape[2] < len(row):
-            full = np.zeros(payload.shape[:2] + (len(row),)
-                            + payload.shape[3:], payload.dtype)
-            full[:, :, :sw.n_blocks] = payload
-            payload = full
+
+        def repad(part):
+            if part.shape[2] >= len(row):
+                return part
+            full = np.zeros(part.shape[:2] + (len(row),)
+                            + part.shape[3:], part.dtype)
+            full[:, :, :sw.n_blocks] = part
+            return full
+
+        payload = repad(sw.payload)
+        if sw.scales is not None:         # quantized pool: data+scales
+            payload = (payload, repad(sw.scales))
         if self.plan is not None:
             # parked records hold the canonical FULL-HEAD host layout
             # (tickets are mesh-portable); split it back per-head over
-            # the mesh so the scatter stays chip-local
+            # the mesh so the scatter stays chip-local (data and scale
+            # plane share the heads-axis spec)
             import jax
             payload = jax.device_put(payload,
                                      self.plan.payload_sharding)
-        args = [self.kv.kv, self._pt, self._keys, self._state,
+        args = [self.kv.arena, self._pt, self._keys, self._state,
                 payload, row, np.int32(slot), sw.token, sw.ts,
                 sw.remaining, sw.temp, sw.eos, sw.key_row]
         if self.speculate_k:
             args += [sw.spec[0], sw.spec[1]]
-        self.kv.kv, self._pt, self._keys, self._state = \
+        arena, self._pt, self._keys, self._state = \
             self._swapin_jit(*args)
+        self.kv.store_arena(arena)
         st = _Running(sw.req, pos=sw.pos, max_new=sw.max_new,
                       eos_id=sw.eos_id, live_from=self._launches,
                       seq=sw.seq)

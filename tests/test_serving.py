@@ -1683,10 +1683,12 @@ def test_lifecycle_plane_disabled_is_noop(trained):
         | {f"serving_{n}" for n in
            ("active_slots", "queue_depth", "kv_blocks_total",
             "kv_blocks_used", "kv_blocks_cached", "swapped_slots",
-            # mesh geometry gauges are part of the BASE engine surface
-            # (single-chip engines publish mesh_shards=1 + whole-pool
-            # per-chip bytes), not a lifecycle-plane series
-            "mesh_shards", "kv_pool_per_chip_bytes")}
+            # mesh + quantization geometry gauges are part of the BASE
+            # engine surface (single-chip fp32 engines publish
+            # mesh_shards=1, whole-pool per-chip bytes, itemsize 4 and
+            # the served weight bytes), not a lifecycle-plane series
+            "mesh_shards", "kv_pool_per_chip_bytes",
+            "kv_dtype_bytes", "weight_bytes")}
         | {"serving_ttft_seconds", "serving_tpot_seconds",
            "serving_queue_wait_seconds", "serving_tokens_per_dispatch",
            "serving_spec_accepted_run", "serving_swap_out_seconds",
@@ -2131,7 +2133,9 @@ def test_mesh_tp2_streams_compile_discipline_and_gauges(trained):
         assert row["value"] == want, fam
     assert _serving_varz(snap)["mesh"][label] == {
         "mesh_shards": 2,
-        "kv_pool_per_chip_bytes": s["hbm_per_chip_bytes"]}
+        "kv_pool_per_chip_bytes": s["hbm_per_chip_bytes"],
+        "kv_dtype_bytes": 4,                # fp32 pool on this engine
+        "weight_bytes": s["weight_bytes"]}
     eng.close()
 
 
@@ -2262,3 +2266,331 @@ def test_mesh_migration_matrix(trained, src_tp, dst_tp):
             s = eng.stats()
             assert s["blocks_used"] == 0 and s["swapped_slots"] == 0
             eng.close()
+
+
+# ---------------------------------------------------------------------------
+# quantized serving (ServingConfig(weight_dtype="int8", kv_dtype="int8"))
+# ---------------------------------------------------------------------------
+#
+# The contract is DETERMINISM against itself plus a pinned accuracy
+# budget against fp32, never fp32 bit-identity: a quantized engine's
+# streams are bit-identical across fresh engines, chunk sizes,
+# preempt/resume, migration, and (multichip lane) mesh shapes, while
+# divergence from the fp32 engine stays inside the greedy-agreement /
+# logit-delta budget the bench measures (tools/bench_serving
+# --quantize; the budget itself is pinned in test_tooling).
+
+QUANT = dict(weight_dtype="int8", kv_dtype="int8")
+
+
+def _quant_mix_streams(trained, max_new=8, **kw):
+    """Four greedy prompts on a fresh engine; returns (streams, stats,
+    compile events). Greedy because the agreement budget is defined on
+    argmax streams; seeded determinism rides the same threefry pins as
+    fp32 (the sampler never sees the arena dtype)."""
+    cfg, _ = trained
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 5, 7, 4)]
+    eng = make_engine(trained, **kw)
+    reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run_until_drained()
+    out = [tuple(r.tokens) for r in reqs]
+    stats = eng.stats()
+    events = eng.scheduler.compile_events
+    eng.close()
+    return out, stats, events
+
+
+def test_quantize_params_weight_roundtrip(trained):
+    """quantize_params: every matmul weight becomes int8 with one f32
+    scale per OUTPUT channel, dequant error is bounded by half a
+    quantization step per entry, and embeddings/LNs/biases are the
+    exact fp32 originals (same objects, untouched)."""
+    cfg, params = trained
+    qp = gd.quantize_params(params, cfg)
+    assert qp["wte"] is params["wte"] and qp["wpe"] is params["wpe"]
+    assert qp["lnf"] is params["lnf"]
+    for blk, qblk in zip(params["blocks"], qp["blocks"]):
+        assert qblk["ln1"] is blk["ln1"] and qblk["ln2"] is blk["ln2"]
+        for nm in ("q", "k", "v", "out", "mlp1", "mlp2"):
+            w = np.asarray(blk[nm]["w"], np.float32)
+            wq = np.asarray(qblk[nm]["w_q"])
+            ws = np.asarray(qblk[nm]["w_s"])
+            assert wq.dtype == np.int8 and ws.dtype == np.float32
+            assert wq.shape == w.shape and ws.shape == (w.shape[1],)
+            assert qblk[nm]["b"] is blk[nm]["b"]
+            # per-channel abs-max: |w - w_q*s| <= s/2 everywhere, and
+            # the max-magnitude entry of each channel hits +-127
+            err = np.abs(w - wq.astype(np.float32) * ws)
+            assert (err <= ws / 2 + 1e-7).all()
+            assert (np.abs(wq).max(axis=0)[ws > 0] == 127).all()
+
+
+def test_quantized_engine_determinism_agreement_and_compile_bound(trained):
+    """The quantized tentpole's quick-lane pins: (1) two fresh
+    int8-w+int8-kv engines emit bit-identical streams, (2) chunk size
+    does not move a quantized stream (the fused-loop invariance fp32
+    pins, re-pinned on the dequant path), (3) greedy agreement with
+    the fp32 engine meets the >=0.99 budget on the mix, and (4) the
+    compile discipline is unchanged: O(buckets) prefills + ONE chunk
+    loop + admit."""
+    base, _, _ = _quant_mix_streams(trained)
+    got, s, events = _quant_mix_streams(trained, **QUANT)
+    got2, _, _ = _quant_mix_streams(trained, **QUANT)
+    assert got == got2, "quantized engine not deterministic"
+    chunk1, _, _ = _quant_mix_streams(trained, decode_chunk=1, **QUANT)
+    assert got == chunk1, "quantized stream moved with chunk size"
+    pairs = [(a, b) for qs, rs in zip(got, base) for a, b in zip(qs, rs)]
+    agree = sum(a == b for a, b in pairs) / len(pairs)
+    assert agree >= 0.99, f"greedy agreement {agree} below budget"
+    assert events.count("decode_chunk") == 1
+    assert len(events) <= len((4, 8)) + 2, events
+    assert s["kv_dtype"] == "int8" and s["weight_dtype"] == "int8"
+
+
+def test_quantized_preempt_resume_identity(trained):
+    """Lifecycle corner: preempt -> host-swap -> resume of an int8-KV
+    sequence (payload + scale plane round-trip host memory) is
+    bit-identical to the never-preempted QUANTIZED stream, and the
+    drain leaks neither blocks nor swap-pool bytes."""
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    ref = make_engine(trained, num_slots=4, decode_chunk=4,
+                      block_size=4, **QUANT)
+    refs = [tuple(o.tolist()) for o in
+            ref.generate(prompts, max_new_tokens=12)]
+    ref.close()
+    tight = make_engine(trained, **PRESSURE, **QUANT)
+    outs = [tuple(o.tolist()) for o in
+            tight.generate(prompts, max_new_tokens=12)]
+    s = tight.stats()
+    assert s["preemptions"] >= 1, "arena not tight enough to preempt"
+    assert outs == refs
+    assert s["swapped_slots"] == 0 and s["blocks_used"] == 0
+    assert s["swap_pool_bytes"] == 0
+    tight.close()
+
+
+def test_quantized_migration_identity_and_dtype_rejects(trained):
+    """Lifecycle corner: an int8-KV sequence migrates int8->int8 with
+    the stream bit-identical to a never-migrated quantized run; a
+    dtype-mismatched handoff (fp32 ticket -> int8 engine and int8 ->
+    fp32) rejects whole with TicketError — a typed refusal, never a
+    scatter crash — and a tampered scale plane fails the checksum."""
+    from paddle_tpu.serving import TicketError
+
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    src = make_engine(trained, max_len=48, **QUANT)
+    dst = make_engine(trained, max_len=48, **QUANT)
+    stream = []
+    req = src.submit(p, 30, on_token=lambda r, t: stream.append(t))
+    _drive_until_running_with_tokens(src, req)
+    ticket = src.migrate_out(req)
+    assert ticket.payload.dtype == np.int8
+    assert ticket.scales is not None
+    assert ticket.scales.dtype == np.float32
+    assert ticket.describe()["kv_dtype"] == "int8"
+    assert ticket.swap_bytes == ticket.payload.nbytes \
+        + ticket.scales.nbytes
+    # scale-plane corruption is caught by the checksum (a flipped
+    # scale would silently rescale a whole row: sequence state)
+    good = ticket.scales
+    tampered = good.copy()
+    tampered[0, 0, 0, 0, 0] += 1.0
+    ticket.scales = tampered
+    assert not ticket.verify()
+    with pytest.raises(TicketError, match="checksum"):
+        dst.migrate_in(ticket)
+    ticket.scales = good
+    assert ticket.verify()
+    req2 = dst.migrate_in(ticket, on_token=lambda r, t: stream.append(t))
+    src.run_until_drained()
+    dst.run_until_drained()
+    assert req2.state == "finished"
+    ref = make_engine(trained, max_len=48, **QUANT)
+    ref_stream = []
+    ref.submit(p, 30, on_token=lambda r, t: ref_stream.append(t))
+    ref.run_until_drained()
+    assert stream == ref_stream
+    # dtype mismatches reject whole, both directions
+    f32 = make_engine(trained, max_len=48)
+    req3 = f32.submit(p, 30)
+    _drive_until_running_with_tokens(f32, req3)
+    t32 = f32.migrate_out(req3)
+    with pytest.raises(TicketError, match="dtype"):
+        make_engine(trained, max_len=48, **QUANT).migrate_in(t32)
+    q_req = ref.submit(p, 30)
+    _drive_until_running_with_tokens(ref, q_req)
+    tq = ref.migrate_out(q_req)
+    with pytest.raises(TicketError, match="dtype"):
+        f32.migrate_in(tq)
+    f32.run_until_drained()
+    ref.run_until_drained()
+    src.close(); dst.close(); f32.close(); ref.close()
+
+
+def test_quantized_prefix_cache_cow_scale_consistency(trained):
+    """Lifecycle corner: COW prefix sharing of QUANTIZED blocks — a
+    second request hash-hitting the first's prompt blocks maps the
+    same int8 rows AND the same scale-plane entries, so its stream is
+    bit-identical to a cold (cache-off) quantized run of the same
+    request. Divergent tails stay isolated exactly as in fp32."""
+    cfg, _ = trained
+    sys_prompt = np.arange(1, 9, dtype=np.int32)         # two full blocks
+    tails = [np.asarray([13, 17], np.int32), np.asarray([19, 23], np.int32)]
+    prompts = [np.concatenate([sys_prompt, t]) for t in tails]
+
+    def run(prefix_cache):
+        eng = make_engine(trained, block_size=4, prefix_cache=prefix_cache,
+                          prefill_buckets=(4, 16), **QUANT)
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run_until_drained()
+        s = eng.stats()
+        eng.close()
+        return [tuple(r.tokens) for r in reqs], s
+
+    cold, s_cold = run(False)
+    warm, s_warm = run(True)
+    assert s_cold["prefix_hits"] == 0
+    assert s_warm["prefix_hits"] > 0, "mix never hit the prefix cache"
+    assert warm == cold, "shared quantized blocks changed a stream"
+
+
+def test_quantized_spec_stream_identity(trained):
+    """speculate_k > 0 on an int8-KV arena (the verify kernel's
+    dequant path): streams bit-identical to the quantized
+    speculate_k=0 engine, with acceptance actually happening."""
+    spec, s, events = _quant_mix_streams(trained, max_new=12,
+                                         decode_chunk=4, speculate_k=4,
+                                         **QUANT)
+    base, _, _ = _quant_mix_streams(trained, max_new=12, decode_chunk=4,
+                                    **QUANT)
+    assert spec == base, "speculative quantized stream diverged"
+    assert events.count("decode_chunk") == 1
+    assert s["spec_proposed"] > 0
+
+
+def test_quantized_config_validation(trained):
+    """Unknown dtype strings raise at construction with a clear
+    message (no silent fp32 fallback), the SlotKVCache rejects them
+    too, and the kv_dtype x speculate_k gate keys on the verify
+    kernel's published dequant coverage (QUANTIZED_KV_KERNELS) — strip
+    the verify kernel from it and the combination must refuse."""
+    cfg, _ = trained
+    with pytest.raises(ValueError, match="weight_dtype"):
+        make_engine(trained, weight_dtype="int4")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        make_engine(trained, kv_dtype="fp8")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        SlotKVCache(cfg, 2, 32, kv_dtype="int4")
+    covered = gd.QUANTIZED_KV_KERNELS
+    try:
+        gd.QUANTIZED_KV_KERNELS = tuple(
+            k for k in covered if k != "gpt_decode_verify_pages")
+        with pytest.raises(ValueError, match="verify"):
+            make_engine(trained, speculate_k=2, **QUANT)
+        # without speculation the verify kernel is never entered, so
+        # the reduced coverage still serves
+        eng = make_engine(trained, **QUANT)
+        eng.close()
+    finally:
+        gd.QUANTIZED_KV_KERNELS = covered
+
+
+def test_quantized_byte_accounting_and_gauges(trained):
+    """Satellite pin: pool_bytes derives from the ACTUAL arena
+    itemsize plus the scale plane — int8 data bytes + f32 scales, a
+    dtype-blind fp32 formula would overstate ~4x — occupancy/stats
+    carry kv_dtype/weight_dtype, and the serving_kv_dtype_bytes /
+    serving_weight_bytes gauges + the /varz mesh rollup expose the
+    same numbers off the scrape path."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.debug_server import _serving_varz
+
+    cfg, params = trained
+    eng = make_engine(trained, **QUANT)
+    kv = eng.kv
+    heads, hd = cfg.heads, cfg.hidden // cfg.heads
+    data = cfg.layers * 2 * kv.num_blocks * heads * kv.block_size * hd
+    scales = cfg.layers * 2 * kv.num_blocks * heads * kv.block_size
+    assert kv.pool_bytes == data * 1 + scales * 4
+    s = eng.stats()
+    assert s["kv_dtype"] == "int8" and s["weight_dtype"] == "int8"
+    assert s["hbm_per_chip_bytes"] == kv.pool_bytes   # single chip
+    # served weight bytes: int8 matmul weights + f32 scales/bias/
+    # embeddings/LNs — must match the actual pytree
+    import jax
+    assert s["weight_bytes"] == sum(
+        leaf.nbytes for leaf in
+        jax.tree_util.tree_leaves(eng.scheduler.params))
+    f32 = make_engine(trained)
+    sf = f32.stats()
+    assert sf["kv_dtype"] == "float32"
+    assert sf["weight_dtype"] == "float32"
+    assert sf["pool_bytes"] > s["pool_bytes"] * 2     # the capacity win
+    assert sf["weight_bytes"] > s["weight_bytes"] * 2
+    label = s["engine_label"]
+    snap = get_registry().snapshot()
+    for fam, want in (("serving_kv_dtype_bytes", 1),
+                      ("serving_weight_bytes", s["weight_bytes"])):
+        row = next(r for r in snap[fam]["series"]
+                   if r["labels"].get("engine") == label)
+        assert row["value"] == want, fam
+    mesh_row = _serving_varz(snap)["mesh"][label]
+    assert mesh_row["kv_dtype_bytes"] == 1
+    assert mesh_row["weight_bytes"] == s["weight_bytes"]
+    eng.close(); f32.close()
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("tp", [2, 4])
+def test_quantized_mesh_identity(trained, tp):
+    """Multichip lane: the quantized engine's mesh self-identity — a
+    mesh (tp,) int8-w+int8-kv engine emits bit-identical streams to
+    the single-chip quantized engine (the int8 tensors + scales shard
+    on the same Megatron axes, the scale plane alongside the arena's
+    heads), with the sharded chunk loop traced once and the per-chip
+    gauges splitting the dtype-aware pool bytes exactly."""
+    base, _, _ = _quant_mix_streams(trained, max_new=12, **QUANT)
+    got, s, events = _quant_mix_streams(trained, max_new=12,
+                                        mesh_shape=(tp,), **QUANT)
+    assert got == base, f"quantized tp={tp} streams diverged"
+    assert events.count("decode_chunk") == 1
+    assert s["kv_dtype"] == "int8"
+    assert s["hbm_per_chip_bytes"] * tp == s["pool_bytes"]
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("src_tp,dst_tp", [(2, 2), (2, 1)])
+def test_quantized_mesh_migration_identity(trained, src_tp, dst_tp):
+    """Multichip lane: tp->tp and tp->single migration of an int8-KV
+    sequence — the ticket's device_get-assembled FULL-HEAD payload and
+    scale plane land on either geometry with the stream bit-identical
+    to a never-migrated quantized run."""
+
+    def mesh(tp):
+        return (tp,) if tp > 1 else None
+
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    src = make_engine(trained, mesh_shape=mesh(src_tp), max_len=48,
+                      **QUANT)
+    dst = make_engine(trained, mesh_shape=mesh(dst_tp), max_len=48,
+                      **QUANT)
+    stream = []
+    req = src.submit(p, 30, on_token=lambda r, t: stream.append(t))
+    _drive_until_running_with_tokens(src, req)
+    ticket = src.migrate_out(req)
+    assert ticket.payload.dtype == np.int8
+    assert ticket.scales is not None
+    assert ticket.compatible(dst)
+    req2 = dst.migrate_in(ticket, on_token=lambda r, t: stream.append(t))
+    src.run_until_drained()
+    dst.run_until_drained()
+    assert req2.state == "finished"
+    ref = make_engine(trained, max_len=48, **QUANT)
+    ref_stream = []
+    ref.submit(p, 30, on_token=lambda r, t: ref_stream.append(t))
+    ref.run_until_drained()
+    assert stream == ref_stream, (src_tp, dst_tp)
+    src.close(); dst.close(); ref.close()

@@ -10,7 +10,11 @@
 #
 # The mesh token-identity matrix (mesh 1/2/4 x greedy/seeded x
 # speculate_k {0,4} x preempt-resume) and the sharded compile-count
-# pins live in tests/test_serving.py; `--mesh` bench rows come from
+# pins live in tests/test_serving.py, as do the QUANTIZED-mesh
+# identity pins (int8-w+int8-kv engines bit-identical to their own
+# single-chip streams at tp 2/4, plus tp->tp / tp->single migration
+# of an int8-KV sequence — test_quantized_mesh_*); `--mesh` bench
+# rows come from
 #   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 #       JAX_PLATFORMS=cpu python tools/bench_serving.py tiny --mesh 1 2 4
 set -euo pipefail
