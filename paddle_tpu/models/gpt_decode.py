@@ -39,6 +39,7 @@ __all__ = ["collect_gpt_params", "quantize_params", "gpt_forward_logits",
            "gpt_prefill",
            "gpt_prefill_padded", "gpt_decode_step", "gpt_decode_step_slots",
            "gpt_decode_chunk_slots", "gpt_prefill_pages",
+           "gpt_prefill_chunk_pages",
            "gpt_decode_step_pages", "gpt_decode_chunk_pages",
            "gpt_decode_verify_slots", "gpt_decode_verify_pages",
            "spec_ngram_seed", "gpt_generate", "QUANTIZED_KV_KERNELS",
@@ -50,7 +51,8 @@ __all__ = ["collect_gpt_params", "quantize_params", "gpt_forward_logits",
 # path is not covered (e.g. speculate_k > 0 needs the verify kernel)
 # instead of silently falling back to garbage reads — there is no fp32
 # fallback anywhere in the quantized path.
-QUANTIZED_KV_KERNELS = ("gpt_prefill_pages", "gpt_decode_step_pages",
+QUANTIZED_KV_KERNELS = ("gpt_prefill_pages", "gpt_prefill_chunk_pages",
+                        "gpt_decode_step_pages",
                         "gpt_decode_chunk_pages",
                         "gpt_decode_verify_pages")
 
@@ -781,6 +783,46 @@ def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
     Compiles once per SUFFIX bucket — prefix-cache hits shrink the
     suffix into the small buckets, which is where the TTFT win on
     shared-prompt traffic comes from."""
+    return _prefill_pages_body(params, cfg, tokens, pfx_len, real_len,
+                               arena, pages)
+
+
+def gpt_prefill_chunk_pages(params, cfg, tokens, start_pos, real_len,
+                            arena, pages):
+    """Budget-bounded CHUNKED-PREFILL pass: process up to B suffix
+    tokens of ONE sequence's prompt starting at absolute position
+    `start_pos`, attending over everything already resident in its
+    arena blocks through the page row (vLLM/Sarathi-style chunked
+    prefill, so a long prompt never monopolizes the device in one
+    dispatch).
+
+    Identical math to gpt_prefill_pages with one contract relaxed:
+    `start_pos` is an ARBITRARY absolute position — the previous
+    chunk's fill frontier — not a block-aligned prefix-cache hit
+    length. Positions [0, start_pos) must already be resident (earlier
+    chunks and/or shared prefix blocks; enqueued-in-order dispatches
+    satisfy this without a sync), rows [start_pos, start_pos+real_len)
+    are written through the page row exactly as the monolithic kernel
+    writes them, and pad rows land in scratch. Because the per-position
+    math is gpt_prefill_pages' row-for-row, running a prompt suffix as
+    N chunks produces the same K/V rows — and the same last-position
+    logits on the final chunk — as one monolithic dispatch, which is
+    what keeps chunked streams token-identical to prefill_chunk=None.
+
+    Returns (logits of position start_pos+real_len-1, (1, V) f32,
+    arena) — only the FINAL chunk's logits are consumed (they seed the
+    first sampled token); earlier chunks' are dead values the scheduler
+    never fetches. Compiles once per CHUNK bucket, so chunking grows
+    the executable family by at most O(prefill buckets)."""
+    return _prefill_pages_body(params, cfg, tokens, start_pos, real_len,
+                               arena, pages)
+
+
+def _prefill_pages_body(params, cfg, tokens, pfx_len, real_len, arena,
+                        pages):
+    """Shared body of gpt_prefill_pages / gpt_prefill_chunk_pages: one
+    loop so the monolithic and chunked prefill math can never diverge
+    (the chunked path's token-parity guarantee depends on it)."""
     import jax.numpy as jnp
 
     heads, hd = cfg.heads, cfg.hidden // cfg.heads

@@ -158,6 +158,16 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
             # until the engine has run a speculative pass
             ("spec_accept_ratio",
              ratio("spec_accepted", "spec_proposed"))]),
+        # chunked prefill: how many budget-bounded prefill chunk
+        # dispatches ran, per admission — >1 means long prompts are
+        # really being split and interleaved with decode (0/None on
+        # monolithic engines: the knob is off or nothing admitted)
+        "prefill": registry_rollup(snap, {
+            "prefill_chunks": "serving_prefill_chunks_total",
+            "admitted": "serving_admitted_total",
+        }, derived=[
+            ("prefill_chunks_per_admission",
+             ratio("prefill_chunks", "admitted"))]),
         # host-swap preemption: how often page pressure evicted a
         # running sequence, how many resumed, how many sit parked NOW
         "preemption": registry_rollup(snap, {

@@ -8,7 +8,9 @@ cached prefixes, copy-on-write isolation) and O(buckets) compiled
 shapes (`kv_cache`), an iteration-level scheduler that admits by pages
 needed and interleaves suffix prefills with fused chunked decode over a
 donated, device-resident pipeline — `decode_chunk` tokens per dispatch,
-the next dispatch launched before the previous block is fetched
+the next dispatch launched before the previous block is fetched, and
+optionally budget-bounded CHUNKED PREFILL (`prefill_chunk`) so a long
+prompt never stalls co-batched decode streams
 (`scheduler`) — a request-lifecycle engine with bounded admission and
 streaming callbacks (`engine`), and request/engine metrics incl. the
 dispatch-amortization and block/prefix-cache series (`metrics`).

@@ -35,7 +35,7 @@ from ..observability import watchdog as _watchdog
 from ..observability.tracer import get_tracer, request_scope, trace_span
 from .kv_cache import ShapeBuckets, SlotKVCache
 from .metrics import EngineMetrics, RequestMetrics
-from .scheduler import ContinuousBatchingScheduler
+from .scheduler import PREFILL_PENDING, ContinuousBatchingScheduler
 
 _TRACER = get_tracer()
 
@@ -86,6 +86,21 @@ class ServingConfig:
     run out); prefix_cache toggles hashed prefix sharing (shared system
     prompts are prefilled and stored once, refcounted, LRU-kept while
     unreferenced).
+
+    Chunked-prefill knob: prefill_chunk=N (None = today's monolithic
+    prefill, bit-identical, zero new executables) splits every
+    prompt's suffix prefill into budget-bounded chunk dispatches of at
+    most N tokens, interleaved one budget per engine step with the
+    fused decode dispatches — a long prompt no longer stalls every
+    co-batched decode stream for its whole prefill (the TPOT p99
+    spike chunking exists to kill), at the cost of a bounded TTFT
+    stretch for the long prompt itself (its prefill now shares ticks
+    with decode). Chunk shapes come from the SAME suffix buckets, so
+    the executable family grows by at most O(prefill buckets); token
+    streams are pinned identical to prefill_chunk=None across greedy/
+    seeded, speculation, quantized KV, mesh, and preempt/resume.
+    Mid-prefill sequences are not migratable (typed MigrationError)
+    and never preemption victims; cancel frees their pages.
 
     Speculation knobs: speculate_k > 0 turns every fused decode
     iteration into a draft -> verify -> accept pass over k self-drafted
@@ -143,6 +158,7 @@ class ServingConfig:
                  prefix_cache: bool = True,
                  speculate_k: int = 0,
                  speculate_ngram: int = 512,
+                 prefill_chunk: Optional[int] = None,
                  preempt: bool = False,
                  preempt_policy="newest",
                  mesh_shape: Optional[Sequence[int]] = None,
@@ -176,6 +192,16 @@ class ServingConfig:
         # speculate_ngram sizes the hashed trigram table per slot.
         self.speculate_k = int(speculate_k)
         self.speculate_ngram = int(speculate_ngram)
+        # chunked prefill (None = monolithic, the bit-identical
+        # default): per-tick prefill token budget AND per-dispatch
+        # chunk ceiling — the Sarathi-style piggyback discipline that
+        # keeps a long prompt's prefill from stalling co-batched decode
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None, got "
+                f"{prefill_chunk}")
+        self.prefill_chunk = int(prefill_chunk) \
+            if prefill_chunk is not None else None
         # host-swap preemption (off by default — opt in where the arena
         # is deliberately oversubscribed): under page pressure the
         # engine evicts the policy-chosen RUNNING sequence's pages to a
@@ -348,7 +374,13 @@ class ServingEngine:
             params, cfg, self.kv, self.buckets, top_k=serving.top_k,
             decode_chunk=serving.decode_chunk, overlap=serving.overlap,
             speculate_k=serving.speculate_k,
-            speculate_ngram=serving.speculate_ngram, plan=plan)
+            speculate_ngram=serving.speculate_ngram, plan=plan,
+            prefill_chunk=serving.prefill_chunk)
+        # chunked-prefill telemetry: one counter bump + one latency
+        # sample per dispatched chunk (bound through self.metrics at
+        # call time, so a bench's metrics reset keeps feeding the
+        # replacement instance)
+        self.scheduler.on_prefill_chunk = self._on_prefill_chunk
         # launch-side heartbeat: bumped at dispatch ENQUEUE inside the
         # scheduler, not after step() returns — a device hang leaves the
         # host blocked in the next fetch, and the watchdog/flight record
@@ -642,7 +674,17 @@ class ServingEngine:
                     temperature=req.temperature, seed=req.seed,
                     eos_id=req.eos_id)
                 assert event is not None  # can_admit checked, same thread
-                self._emit(event)
+                if event is not PREFILL_PENDING:
+                    self._emit(event)
+                    emitted += 1
+                # else: chunked prefill — pages mapped, first token
+                # surfaces from a later advance_prefill tick below
+        # chunked prefill: dispatch at most one prefill token budget,
+        # interleaved with (and ordered before) this tick's decode
+        # dispatch; completed prefills' first tokens fan out here.
+        # No-op (one attribute read) on a monolithic engine.
+        for event in self.scheduler.advance_prefill():
+            self._emit(event)
             emitted += 1
         events = self.scheduler.step()
         if events:
@@ -694,12 +736,21 @@ class ServingEngine:
         if self.faults is not None and self.faults.deny_pages(step_no):
             return False
         if self._swapped:
-            # conservative page reservation: ignores the prefix-cache
-            # hits the admission might enjoy, so it can only
-            # over-reserve
+            # page reservation for parked sequences, checked against
+            # the blocks this admission would ACTUALLY consume from
+            # the available supply (blocks_needed's non-mutating
+            # planner walk: fresh pages + LRU hits it would incref out
+            # of the evictable pool; hits on a live sequence's blocks
+            # are free), not the full prompt. Reserving
+            # blocks_for(prompt + budget) here over-reserved by the
+            # live-shared hit depth: with the swap pool non-empty, a
+            # prompt sharing a running sequence's prefix that
+            # comfortably fit could requeue at the head of the line
+            # and starve admission.
             reserved = sum(s.n_blocks for s in self._swapped)
-            need = self.kv.blocks_for(req.prompt.size
-                                      + req.max_new_tokens)
+            need = self.kv.blocks_needed(req.prompt,
+                                         req.prompt.size
+                                         + req.max_new_tokens)
             if self.kv.blocks_available < reserved + need:
                 return False
             # no slot reservation needed: the resume-first loop at the
@@ -841,6 +892,18 @@ class ServingEngine:
                                produced=ticket.produced)
                 return ticket
 
+        # mid-chunked-prefill: the fill cursor is not ticketable (the
+        # slot has no sampled token, no key-chain position, and its
+        # blocks are part-filled) — a typed refusal, never a corrupt
+        # handoff; the sequence keeps prefilling here and migrates
+        # normally once its first token lands
+        for pf in self.scheduler._prefilling.values():
+            if getattr(pf.req, "request_id", None) == rid:
+                raise MigrationError(
+                    f"request {rid} is mid-prefill (chunked-prefill "
+                    "cursor not yet ticketable); migrate_out refused — "
+                    "retry after its first token")
+
         def _find_slot():
             return next(
                 (s for s, st in self.scheduler._running.items()
@@ -928,6 +991,10 @@ class ServingEngine:
 
     def _on_dispatch_launched(self) -> None:
         self.metrics.dispatches += 1
+
+    def _on_prefill_chunk(self, seconds: float) -> None:
+        self.metrics.prefill_chunks += 1
+        self.metrics.observe_prefill_chunk(seconds)
 
     def _on_dispatch_timed(self, host_s: float, device_s: float) -> None:
         self.metrics.observe_dispatch_split(host_s, device_s)

@@ -221,6 +221,14 @@ class SlotKVCache:
         # hashing the prompt twice; any allocator mutation invalidates
         self._plan_gen = 0
         self._plan_cache = None
+        # deferred prefix-cache registration (chunked prefill):
+        # slot -> [(block index in the page row, digest, block)] of
+        # fresh full prompt blocks NOT yet published to the hash table —
+        # a block only registers once the chunk dispatch that fills it
+        # has been enqueued (register_prefix), so a concurrent
+        # admission can never hash-hit unfilled rows. Dropped whole on
+        # free(slot) (cancel/preempt mid-prefill).
+        self._pending_reg: Dict[int, List[Tuple[int, bytes, int]]] = {}
 
     # -- slot allocation ----------------------------------------------------
 
@@ -257,6 +265,9 @@ class SlotKVCache:
             raise ValueError(f"double free of slot {slot}")
         # deepest blocks decref'd (and LRU-inserted) first: shallow
         # prefix blocks land most-recently-used, evicted last
+        # unpublished prefix digests die with the slot: their blocks'
+        # fills may never have been dispatched (mid-prefill cancel)
+        self._pending_reg.pop(slot, None)
         for b in reversed(self._slot_blocks[slot]):
             self._decref(b)
         self._slot_blocks[slot] = []
@@ -342,14 +353,17 @@ class SlotKVCache:
         return digests
 
     def _plan(self, prompt: np.ndarray,
-              total_positions: int) -> Tuple[list, List[int], int, bool]:
+              total_positions: int
+              ) -> Tuple[list, List[int], int, int, bool]:
         """The admission plan, computed WITHOUT mutating anything:
-        (digests of registerable full blocks, hit block ids, total
-        blocks needed, feasible-right-now). Hit blocks currently in the
-        LRU pool would be claimed, not evicted, so they are excluded
-        from the evictable supply. Memoized per (prompt, total) until
-        the next allocator mutation — the can_map() check and the
-        map_slot() that follows share one digest walk."""
+        (digests of registerable full blocks, hit block ids, count of
+        hits currently in the LRU pool, total blocks needed,
+        feasible-right-now). LRU hits would be claimed, not evicted,
+        so they are excluded from the evictable supply — and they are
+        what blocks_needed() charges against availability. Memoized
+        per (prompt, total) until the next allocator mutation — the
+        can_map() check and the map_slot() that follows share one
+        digest walk."""
         key = (prompt.tobytes(), int(total_positions))
         if self._plan_cache is not None:
             gen, k, plan = self._plan_cache
@@ -374,7 +388,7 @@ class SlotKVCache:
         feasible = (total_blocks - len(hit_blocks)
                     <= len(self._free_blocks) + len(self._lru)
                     - lru_hits)
-        plan = (digests, hit_blocks, total_blocks, feasible)
+        plan = (digests, hit_blocks, lru_hits, total_blocks, feasible)
         self._plan_cache = (self._plan_gen, key, plan)
         return plan
 
@@ -383,10 +397,30 @@ class SlotKVCache:
         allocator state — the engine's pages-aware admission check
         (stamp/count a request as admitted only when it will fit)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        return self._plan(prompt, total_positions)[3]
+        return self._plan(prompt, total_positions)[4]
+
+    def blocks_needed(self, prompt: np.ndarray,
+                      total_positions: int) -> int:
+        """Blocks a map_slot() of this request would actually CONSUME
+        from blocks_available RIGHT NOW: fresh pages (total minus
+        prefix-cache hits) PLUS the hit blocks currently sitting in
+        the LRU pool — claiming those increfs them out of the
+        evictable supply, so they cost availability exactly like a
+        fresh page even though they cost no prefill. Hits on blocks a
+        live sequence already references are genuinely free.
+        Non-mutating (the planner's memoized digest walk). This is
+        the number page reservations must use: reserving
+        blocks_for(total) for a prompt whose prefix is shared with a
+        RUNNING sequence over-reserves by the whole hit depth and can
+        starve admission at a near-full arena."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        _, hit_blocks, lru_hits, total_blocks, _ = \
+            self._plan(prompt, total_positions)
+        return total_blocks - len(hit_blocks) + lru_hits
 
     def map_slot(self, slot: int, prompt: np.ndarray,
-                 total_positions: int) -> Optional[Tuple[np.ndarray, int]]:
+                 total_positions: int,
+                 register: bool = True) -> Optional[Tuple[np.ndarray, int]]:
         """Map the pages a request needs into `slot`'s page row.
 
         prompt: the request's token ids; total_positions: p_len +
@@ -404,7 +438,14 @@ class SlotKVCache:
         suffix prefill always recomputes the last prompt position (its
         logits seed the first token), and the first block the request
         writes into is private by construction — the copy-on-write
-        guarantee."""
+        guarantee.
+
+        `register=False` (chunked prefill) defers publishing this
+        prompt's fresh full blocks to the prefix hash table: the caller
+        releases them block by block via register_prefix() as the
+        chunk dispatches that fill them are enqueued. Hits are still
+        CONSUMED either way — deferral only gates what later
+        admissions may share FROM this one."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p_len = prompt.size
         if not 1 <= total_positions <= self.max_pages * self.block_size:
@@ -416,7 +457,7 @@ class SlotKVCache:
                 f"prompt ({p_len}) longer than total_positions "
                 f"({total_positions})")
         bs = self.block_size
-        digests, claimed, total_blocks, feasible = \
+        digests, claimed, _lru_hits, total_blocks, feasible = \
             self._plan(prompt, total_positions)
         if not feasible:
             return None
@@ -432,14 +473,46 @@ class SlotKVCache:
         # register this prompt's fresh FULL blocks so later admissions
         # can share them (content is deterministic in the prefix tokens;
         # the filling prefill dispatch is enqueued before any dispatch
-        # that could read a future hit). A digest already registered to
-        # another block keeps its original mapping.
-        for i in range(len(claimed), len(digests)):
-            if digests[i] not in self._by_hash:
-                self._by_hash[digests[i]] = blocks[i]
-                self._hash_of[blocks[i]] = digests[i]
+        # that could read a future hit — with register=False the caller
+        # upholds that invariant chunk by chunk via register_prefix).
+        # A digest already registered to another block keeps its
+        # original mapping.
+        pending = [(i, digests[i], blocks[i])
+                   for i in range(len(claimed), len(digests))]
+        if register:
+            for _, d, b in pending:
+                if d not in self._by_hash:
+                    self._by_hash[d] = b
+                    self._hash_of[b] = d
+        elif pending:
+            self._pending_reg[slot] = pending
         row = self._install_blocks(slot, blocks, p_len)
         return row, len(claimed) * bs
+
+    def register_prefix(self, slot: int, frontier: int) -> None:
+        """Publish `slot`'s deferred prefix digests for every full
+        block now COVERED by the fill frontier (`frontier` = absolute
+        positions whose filling dispatch is enqueued): block i
+        registers once (i+1)*block_size <= frontier. The chunked-
+        prefill caller invokes this right after each chunk dispatch,
+        so device dispatch order guarantees a later hit's prefill
+        reads filled rows. No-op for slots with nothing pending."""
+        pending = self._pending_reg.get(slot)
+        if not pending:
+            return
+        keep: List[Tuple[int, bytes, int]] = []
+        for i, d, b in pending:
+            if (i + 1) * self.block_size <= frontier:
+                if d not in self._by_hash:
+                    self._by_hash[d] = b
+                    self._hash_of[b] = d
+                    self._plan_gen += 1   # plans may now see the hit
+            else:
+                keep.append((i, d, b))
+        if keep:
+            self._pending_reg[slot] = keep
+        else:
+            self._pending_reg.pop(slot, None)
 
     def _install_blocks(self, slot: int, blocks, length: int):
         """Install already-claimed+increffed blocks into `slot`'s page

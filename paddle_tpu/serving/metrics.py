@@ -134,7 +134,12 @@ _HELP = {
     "shed": "requests rejected at the admission door",
     "tokens_out": "total generated tokens",
     "decode_steps": "batched decode steps executed",
-    "prefills": "prefill dispatches",
+    "prefills": "prefill admissions (one per admitted request — a "
+                "chunked-prefill engine's per-dispatch count is "
+                "serving_prefill_chunks_total)",
+    "prefill_chunks": "budget-bounded chunked-prefill dispatches "
+                      "(ServingConfig(prefill_chunk=N); 0 on a "
+                      "monolithic engine)",
     "dispatches": "fused decode-chunk dispatches launched",
     "spec_proposed": "draft tokens proposed by the speculative "
                      "n-gram drafter (k per live verify pass)",
@@ -172,7 +177,7 @@ _HELP = {
 }
 
 _COUNTERS = ("submitted", "admitted", "completed", "shed", "tokens_out",
-             "decode_steps", "prefills", "dispatches",
+             "decode_steps", "prefills", "prefill_chunks", "dispatches",
              "spec_proposed", "spec_accepted",
              "prefix_cache_hits", "prefix_cache_misses",
              "preemptions", "swap_ins")
@@ -186,7 +191,8 @@ _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "tokens_per_dispatch": "serving_tokens_per_dispatch",
                "spec_accepted_run": "serving_spec_accepted_run",
                "swap_out": "serving_swap_out_seconds",
-               "swap_in": "serving_swap_in_seconds"}
+               "swap_in": "serving_swap_in_seconds",
+               "prefill_chunk": "serving_prefill_chunk_seconds"}
 _HIST_HELP = {
     "ttft": "request ttft in seconds",
     "tpot": "request tpot in seconds",
@@ -201,6 +207,9 @@ _HIST_HELP = {
                 "(pipeline fence + device_get of the slot's blocks)",
     "swap_in": "host-swap restore latency per resume in seconds "
                "(block adoption + scatter + carry rebuild)",
+    "prefill_chunk": "launch-side wall seconds per chunked-prefill "
+                     "dispatch (staging + trace/enqueue of the chunk "
+                     "executable; empty on a monolithic engine)",
 }
 
 # host/device dispatch split (ServingConfig(dispatch_timing=True) only:
@@ -337,6 +346,12 @@ class EngineMetrics:
         tokens (0..speculate_k) — the per-pass acceptance distribution
         behind the /varz acceptance-ratio rollup."""
         self._hists["spec_accepted_run"].observe(float(accepted))
+
+    def observe_prefill_chunk(self, seconds: float) -> None:
+        """One chunked-prefill dispatch spent `seconds` launch-side —
+        the per-chunk latency series behind the bench's
+        prefill_chunk_ms column and the /varz prefill rollup."""
+        self._hists["prefill_chunk"].observe(float(seconds))
 
     def observe_swap(self, direction: str, seconds: float) -> None:
         """One host-swap transfer took `seconds`; direction is

@@ -95,6 +95,34 @@ placement, admission timing, co-batched load, and host-swap
 preemption — but a different key schedule than gpt_generate's single
 chain.
 
+CHUNKED PREFILL (prefill_chunk=N, None = monolithic): a long prompt's
+single prefill dispatch is the one work unit that can monopolize the
+device — every co-batched decode stream stalls for its whole duration,
+which is exactly the TPOT p99 spike at peak load. With a budget set,
+admission maps pages exactly as today but the prompt suffix runs as a
+SEQUENCE of budget-bounded chunk dispatches (gpt_prefill_chunk_pages,
+shapes drawn from the same suffix buckets, so the executable family
+grows by at most O(prefill buckets)): the slot rides the fused decode
+chunk loop FROZEN meanwhile (its device done row is still True from
+its previous life, so the in-graph scratch redirect keeps its
+ride-along writes off reallocated blocks — the PR 6 discipline needs
+no new machinery), the host carries the fill cursor in a _Prefill
+record and threads it into each chunk as the traced start position,
+and the engine advances at most `prefill_chunk` prefill tokens per
+tick (advance_prefill) INTERLEAVED with decode dispatches — the
+Sarathi-style piggyback. The LAST chunk's logits feed the same
+admission sampler executable that monolithic prefill uses, so the
+first token — and every token after it — is token-identical to
+prefill_chunk=None (per-position prefill math is shared with the
+monolithic kernel; see gpt_prefill_chunk_pages). Prefix-cache
+REGISTRATION is deferred per block until the chunk that fills it has
+been enqueued (kv_cache.map_slot(register=False) +
+register_prefix): a concurrent admission must never hash-hit a block
+whose filling dispatch hasn't been ordered before its own prefill.
+Mid-prefill slots are not migratable (the engine refuses with a typed
+MigrationError) and never chosen as preemption victims; cancel frees
+their pages through the same release executable as running slots.
+
 SPECULATIVE DECODING (speculate_k > 0): every chunk iteration becomes a
 draft -> verify -> accept pass — a per-slot trigram table (carried in
 the donated device state, seeded from the prompt at prefill) proposes
@@ -129,7 +157,13 @@ from .kv_cache import ShapeBuckets, SlotKVCache
 _TRACER = get_tracer()
 
 __all__ = ["ContinuousBatchingScheduler", "SequenceEvent",
-           "SwappedSequence"]
+           "SwappedSequence", "PREFILL_PENDING"]
+
+# admit()'s "admission succeeded, first token pending" sentinel
+# (chunked prefill only): pages are mapped and the slot is prefilling,
+# but the first-token event will surface from a later advance_prefill
+# tick. Distinct from None, which still means "no slot/pages right now".
+PREFILL_PENDING = object()
 
 
 class SequenceEvent(NamedTuple):
@@ -158,6 +192,36 @@ class _Running:
         self.seq = seq                    # admission order (preemption
         #                                   policies key on it; preserved
         #                                   across swap-out/swap-in)
+
+
+class _Prefill:
+    """Host-side state of a slot mid-CHUNKED-PREFILL: pages are mapped,
+    zero or more budget-bounded chunks have been dispatched, and the
+    first token has not been sampled yet. `cursor` counts suffix tokens
+    whose filling chunk is already enqueued; the next chunk starts at
+    absolute position start + cursor. Not migratable, not a preemption
+    victim — the record exists only between admission and the final
+    chunk's admit-sample."""
+
+    __slots__ = ("req", "suffix", "start", "cursor", "p_len", "max_new",
+                 "temperature", "seed", "eos_id", "pages", "seq",
+                 "chunk_index", "prev_tok")
+
+    def __init__(self, req, suffix, start, p_len, max_new, temperature,
+                 seed, eos_id, pages, seq, prev_tok):
+        self.req = req
+        self.suffix = suffix              # (suffix_len,) int32 host copy
+        self.start = start                # pfx_len at admission
+        self.cursor = 0                   # suffix tokens enqueued so far
+        self.p_len = p_len
+        self.max_new = max_new
+        self.temperature = temperature
+        self.seed = seed
+        self.eos_id = eos_id
+        self.pages = pages                # (max_pages,) page row
+        self.seq = seq                    # admission order
+        self.chunk_index = 0              # next chunk's journal index
+        self.prev_tok = prev_tok          # prompt[-1], the drafter seed
 
 
 class SwappedSequence:
@@ -225,12 +289,16 @@ class ContinuousBatchingScheduler:
     def __init__(self, params, cfg, kv: SlotKVCache, buckets: ShapeBuckets,
                  top_k: int = 0, decode_chunk: int = 8,
                  overlap: bool = True, speculate_k: int = 0,
-                 speculate_ngram: int = 512, plan=None):
+                 speculate_ngram: int = 512, plan=None,
+                 prefill_chunk: Optional[int] = None):
         import jax
 
         if int(decode_chunk) < 1:
             raise ValueError(
                 f"decode_chunk must be >= 1, got {decode_chunk}")
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
         if int(speculate_k) < 0:
             raise ValueError(
                 f"speculate_k must be >= 0, got {speculate_k}")
@@ -267,6 +335,18 @@ class ContinuousBatchingScheduler:
         self.overlap = bool(overlap)
         self.speculate_k = int(speculate_k)
         self.speculate_ngram = int(speculate_ngram)
+        # chunked prefill (None = monolithic, bit-identical to the
+        # pre-knob engine with zero new executables): the per-tick
+        # prefill token budget AND the per-dispatch chunk ceiling
+        self.prefill_chunk = int(prefill_chunk) \
+            if prefill_chunk is not None else None
+        # slots mid-chunked-prefill (slot -> _Prefill); driver-thread
+        # state like _running, advanced one budget of chunks per tick
+        self._prefilling: Dict[int, _Prefill] = {}
+        # fired once per dispatched prefill chunk with its launch-side
+        # wall seconds — the engine hangs the serving_prefill_chunks
+        # counter + chunk-latency histogram here
+        self.on_prefill_chunk = None
         # host-side speculation telemetry, accumulated at collect over
         # LIVE verify passes only (frozen ride-alongs excluded): the
         # engine syncs these cumulative totals into its registry
@@ -285,6 +365,7 @@ class ContinuousBatchingScheduler:
         if plan is not None:
             self._keys = plan.replicate(self._keys)
         self._prefill_jit = None
+        self._prefill_chunk_jit = None
         self._chunk_jit = None
         self._admit_jit = None
         self._release_jit = None
@@ -410,6 +491,29 @@ class ContinuousBatchingScheduler:
                 # n-grams, then seed from THIS prompt's suffix (with a
                 # prefix-cache hit the hit blocks' tokens aren't here —
                 # seeding is best-effort; drafts are always verified)
+                state = state[:7] + (gd.spec_ngram_seed(
+                    state[7], slot, tokens[0], real_len),)
+            return (c_rep(logits[0]), c_arena(arena), c_rep(pt),
+                    c_rep(state))
+
+        def prefill_chunk_impl(params, arena, pt, state, tokens,
+                               start_pos, real_len, pages, slot):
+            # chunked prefill: per-position math shared with
+            # prefill_impl (gpt_prefill_chunk_pages rides the same
+            # body), start_pos is the host-carried fill cursor. The
+            # page-row install is idempotent across a prompt's chunks —
+            # one executable per chunk bucket, whatever the chunk index.
+            self._compile_events.append(
+                f"prefill_chunk:L{tokens.shape[1]}")
+            logits, arena = gd.gpt_prefill_chunk_pages(
+                params, self.cfg, tokens, start_pos, real_len, arena,
+                pages)
+            pt = pt.at[slot].set(pages)
+            if self.speculate_k:
+                # same slot-reuse hygiene as monolithic prefill; the
+                # reset-per-chunk only costs acceptance rate on long
+                # prompts (drafts are always verified — the stream is a
+                # pure function of the sampler chain, never the table)
                 state = state[:7] + (gd.spec_ngram_seed(
                     state[7], slot, tokens[0], real_len),)
             return (c_rep(logits[0]), c_arena(arena), c_rep(pt),
@@ -544,6 +648,9 @@ class ContinuousBatchingScheduler:
         # no donation, no copy); prefill/release update it in place.
         self._prefill_jit = jax.jit(prefill_impl,
                                     donate_argnums=(1, 2, 3))
+        if self.prefill_chunk is not None:
+            self._prefill_chunk_jit = jax.jit(prefill_chunk_impl,
+                                              donate_argnums=(1, 2, 3))
         self._admit_jit = jax.jit(admit_impl, donate_argnums=(0, 1))
         self._chunk_jit = jax.jit(chunk_impl, donate_argnums=(1, 3, 4))
         self._release_jit = jax.jit(release_impl, donate_argnums=(0, 1))
@@ -565,7 +672,15 @@ class ContinuousBatchingScheduler:
 
     @property
     def active_count(self) -> int:
-        return len(self._running)
+        """Slots owing work: decoding sequences plus slots still
+        mid-chunked-prefill (drain loops must count both)."""
+        return len(self._running) + len(self._prefilling)
+
+    @property
+    def prefilling_count(self) -> int:
+        """Slots currently mid-chunked-prefill (0 on a monolithic
+        engine)."""
+        return len(self._prefilling)
 
     @property
     def dispatch_count(self) -> int:
@@ -607,18 +722,38 @@ class ContinuousBatchingScheduler:
 
         With a dispatch in flight, everything here just enqueues behind
         it (the arena/page-table/state inputs are its output futures);
-        only the first-token fetch at the end waits."""
+        only the first-token fetch at the end waits.
+
+        CHUNKED PREFILL (prefill_chunk set): pages are mapped exactly
+        as above, but no prefill dispatch runs here — the slot is
+        registered as mid-prefill and PREFILL_PENDING is returned; the
+        engine's advance_prefill ticks dispatch the budget-bounded
+        chunks (first one in this same engine step) and the first-token
+        event surfaces when the final chunk's logits are sampled.
+        Prefix-cache registration of this prompt's fresh full blocks is
+        DEFERRED until the chunk that fills each block has been
+        enqueued (a concurrent admission must never hit a block whose
+        filling dispatch isn't ordered before its own prefill)."""
         self._ensure_jits()
         slot = self.kv.alloc()
         if slot is None:
             return None
         prompt = np.asarray(prompt, np.int32).reshape(1, -1)
         p_len = prompt.shape[1]
-        mapped = self.kv.map_slot(slot, prompt[0], p_len + int(max_new))
+        mapped = self.kv.map_slot(slot, prompt[0], p_len + int(max_new),
+                                  register=self.prefill_chunk is None)
         if mapped is None:
             self.kv.free(slot)           # page shortage: slot untouched
             return None
         pages, pfx_len = mapped
+        if self.prefill_chunk is not None:
+            self._prefilling[slot] = _Prefill(
+                req, np.ascontiguousarray(prompt[0, pfx_len:]),
+                int(pfx_len), p_len, int(max_new), float(temperature),
+                int(seed), eos_id, pages, self._admit_counter,
+                int(prompt[0, -1]))
+            self._admit_counter += 1
+            return PREFILL_PENDING
         suffix_len = p_len - pfx_len
         bucket = self.buckets.bucket_for(suffix_len)
         padded = self._staging_for(bucket)
@@ -635,22 +770,36 @@ class ContinuousBatchingScheduler:
                     padded, np.int32(pfx_len), np.int32(suffix_len),
                     pages, np.int32(slot))
             self.kv.store_arena(arena)
-            first, self._keys, self._state = self._admit_jit(
-                self._keys, self._state, np.int32(slot), np.int32(seed),
-                logits, np.float32(temperature), np.int32(p_len),
-                np.int32(max_new),
-                np.int32(-1 if eos_id is None else eos_id),
-                np.int32(prompt[0, -1]))
-        first = int(first)
+        event = self._sample_first(
+            slot, req, logits, p_len, max_new, temperature, seed,
+            eos_id, int(prompt[0, -1]), self._admit_counter)
+        self._admit_counter += 1
         rlog = _request_log.get_request_log()
         if rlog is not None:
             rlog.event("prefill",
                        request_id=getattr(req, "request_id", None),
                        slot=slot, bucket=bucket, prompt_len=p_len,
                        prefix_len=int(pfx_len), suffix_len=suffix_len)
+        return event
+
+    def _sample_first(self, slot, req, logits, p_len, max_new,
+                      temperature, seed, eos_id, prev_tok,
+                      seq) -> SequenceEvent:
+        """Sample the first token from last-position prefill logits and
+        promote the slot to _running — the shared tail of monolithic
+        admit() and the final prefill chunk (_prefill_step). ONE body
+        so first-token finish semantics can never diverge between the
+        two paths (the chunked-streams-identical contract depends on
+        it)."""
+        first, self._keys, self._state = self._admit_jit(
+            self._keys, self._state, np.int32(slot), np.int32(seed),
+            logits, np.float32(temperature), np.int32(p_len),
+            np.int32(max_new),
+            np.int32(-1 if eos_id is None else eos_id),
+            np.int32(prev_tok))
+        first = int(first)
         st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
-                      live_from=self._launches, seq=self._admit_counter)
-        self._admit_counter += 1
+                      live_from=self._launches, seq=seq)
         finished = (st.produced >= max_new
                     or (eos_id is not None and first == eos_id))
         if finished:
@@ -658,6 +807,84 @@ class ContinuousBatchingScheduler:
         else:
             self._running[slot] = st
         return SequenceEvent(req, first, finished)
+
+    def advance_prefill(self) -> List[SequenceEvent]:
+        """One CHUNKED-PREFILL tick: dispatch budget-bounded prefill
+        chunks — at most `prefill_chunk` suffix tokens in total — for
+        the oldest-admitted mid-prefill slots, oldest first. Called by
+        the engine once per step, right before the decode dispatch, so
+        a long prompt's prefill interleaves with decode instead of
+        monopolizing the device (the Sarathi piggyback: every tick
+        pays at most one chunk of prefill next to its decode chunk).
+        Returns the first-token events of sequences whose FINAL chunk
+        completed this tick (sampled by the same admission executable
+        as monolithic prefill). No-op ([] after one attribute read) on
+        a monolithic engine."""
+        if not self._prefilling:
+            return []
+        events: List[SequenceEvent] = []
+        budget = self.prefill_chunk
+        while self._prefilling and budget > 0:
+            slot = min(self._prefilling,
+                       key=lambda s: self._prefilling[s].seq)
+            pf = self._prefilling[slot]
+            n = min(self.prefill_chunk, pf.suffix.size - pf.cursor)
+            if n > budget:
+                break                    # per-tick token budget spent
+            budget -= n
+            event = self._prefill_step(slot, n)
+            if event is not None:
+                events.append(event)
+        return events
+
+    def _prefill_step(self, slot: int, n: int) -> Optional[SequenceEvent]:
+        """Dispatch ONE prefill chunk of `n` suffix tokens for `slot`
+        (padded to its shape bucket). On the final chunk, sample the
+        first token, promote the slot to _running, and return its
+        event; None otherwise."""
+        pf = self._prefilling[slot]
+        bucket = self.buckets.bucket_for(n)
+        padded = self._staging_for(bucket)
+        padded[0, :n] = pf.suffix[pf.cursor:pf.cursor + n]
+        padded[0, n:] = 0
+        start = pf.start + pf.cursor
+        t0 = time.perf_counter()
+        with profiler.RecordEvent("serving/prefill_chunk", bucket=bucket,
+                                  prompt_len=pf.p_len, slot=slot,
+                                  start_pos=start, chunk_len=n,
+                                  chunk_index=pf.chunk_index,
+                                  request_id=getattr(pf.req,
+                                                     "request_id", None)):
+            logits, arena, self._pt, self._state = \
+                self._prefill_chunk_jit(
+                    self.params, self.kv.arena, self._pt, self._state,
+                    padded, np.int32(start), np.int32(n), pf.pages,
+                    np.int32(slot))
+            self.kv.store_arena(arena)
+        pf.cursor += n
+        # publish this prompt's full blocks whose fill is now enqueued:
+        # only from here on may a concurrent admission hash-hit them
+        self.kv.register_prefix(slot, pf.start + pf.cursor)
+        if self.on_prefill_chunk is not None:
+            self.on_prefill_chunk(time.perf_counter() - t0)
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            rlog.event("prefill",
+                       request_id=getattr(pf.req, "request_id", None),
+                       slot=slot, bucket=bucket, prompt_len=pf.p_len,
+                       prefix_len=pf.start, suffix_len=n,
+                       chunk_index=pf.chunk_index,
+                       budget=self.prefill_chunk)
+        pf.chunk_index += 1
+        if pf.cursor < pf.suffix.size:
+            return None
+        # final chunk: its last-position logits seed the first token
+        # through the SAME admission sampler executable — and the same
+        # promotion body — the monolithic path uses
+        del self._prefilling[slot]
+        return self._sample_first(
+            slot, pf.req, logits, pf.p_len, pf.max_new, pf.temperature,
+            pf.seed, pf.eos_id, pf.prev_tok, pf.seq)
 
     def step(self) -> List[SequenceEvent]:
         """One pipeline tick: launch the next chunk dispatch over the
@@ -854,6 +1081,18 @@ class ContinuousBatchingScheduler:
         for slot, st in list(self._running.items()):
             if st.req is req:
                 del self._running[slot]
+                self._pt, self._state = self._release_jit(
+                    self._pt, self._state, np.int32(slot))
+                self.kv.free(slot)
+                return True
+        # mid-chunked-prefill: same release discipline — the slot's
+        # page row points at scratch BEFORE its blocks can be
+        # reallocated, every mapped page (prefix hits included) is
+        # freed, and any not-yet-registered prefix blocks are dropped
+        # unpublished (kv.free clears the deferred-registration list)
+        for slot, pf in list(self._prefilling.items()):
+            if pf.req is req:
+                del self._prefilling[slot]
                 self._pt, self._state = self._release_jit(
                     self._pt, self._state, np.int32(slot))
                 self.kv.free(slot)

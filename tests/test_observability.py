@@ -604,7 +604,7 @@ def test_serving_varz_uses_rollup_for_every_block(tiny_engine_params):
     from paddle_tpu.observability.debug_server import _serving_varz
     varz = _serving_varz(obs.get_registry().snapshot())
     assert set(varz) == {"prefix_hit_ratio", "spec_accept_ratio",
-                         "preemption", "mesh",
+                         "prefill", "preemption", "mesh",
                          "host_overhead_per_dispatch",
                          "slo", "migration"}
     # the migration plane is dormant here: the rollup key exists but

@@ -1677,7 +1677,11 @@ def test_lifecycle_plane_disabled_is_noop(trained):
     expected = (
         {f"serving_{n}_total" for n in
          ("submitted", "admitted", "completed", "shed", "tokens_out",
-          "decode_steps", "prefills", "dispatches", "spec_proposed",
+          # prefill_chunks is part of the BASE engine surface like the
+          # swap counters (monolithic engines publish it at 0); the
+          # chunked-prefill KNOB adds zero families beyond this set
+          "decode_steps", "prefills", "prefill_chunks", "dispatches",
+          "spec_proposed",
           "spec_accepted", "prefix_cache_hits", "prefix_cache_misses",
           "preemptions", "swap_ins")}
         | {f"serving_{n}" for n in
@@ -1692,7 +1696,8 @@ def test_lifecycle_plane_disabled_is_noop(trained):
         | {"serving_ttft_seconds", "serving_tpot_seconds",
            "serving_queue_wait_seconds", "serving_tokens_per_dispatch",
            "serving_spec_accepted_run", "serving_swap_out_seconds",
-           "serving_swap_in_seconds"})
+           "serving_swap_in_seconds",
+           "serving_prefill_chunk_seconds"})
     labeled = {name for name, fam in snap.items()
                if any(r["labels"].get("engine") == label
                       for r in fam.get("series", []))}
@@ -2594,3 +2599,386 @@ def test_quantized_mesh_migration_identity(trained, src_tp, dst_tp):
     ref.run_until_drained()
     assert stream == ref_stream, (src_tp, dst_tp)
     src.close(); dst.close(); ref.close()
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill (ServingConfig(prefill_chunk=N))
+# ---------------------------------------------------------------------------
+#
+# The tentpole contract: splitting a prompt's suffix prefill into
+# budget-bounded chunk dispatches interleaved with decode changes WHEN
+# tokens arrive (no monolithic dispatch stalls co-batched streams),
+# never WHICH — streams are pinned identical to prefill_chunk=None
+# across greedy/seeded x speculate_k x kv_dtype x preempt/resume (and
+# mesh, in the multichip lane), with the executable family growing by
+# at most O(prefill buckets).
+
+
+def _chunked_mix_streams(trained, prefill_chunk, max_new=6, **kw):
+    """Shared chunked-prefill workload: varied prompt lengths spanning
+    several chunk boundaries, alternating greedy and seeded sampling,
+    on a fresh engine. Returns (streams, stats, compile events)."""
+    cfg, _ = trained
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 7, 15, 14, 6, 11)]
+    eng = make_engine(trained, num_slots=3, prefill_buckets=(4, 8, 16),
+                      prefill_chunk=prefill_chunk, **kw)
+    reqs = [eng.submit(p, max_new_tokens=max_new,
+                       temperature=0.8 if i % 2 else 0.0, seed=i)
+            for i, p in enumerate(prompts)]
+    eng.run_until_drained()
+    out = [tuple(r.tokens) for r in reqs]
+    stats = eng.stats()
+    events = eng.scheduler.compile_events
+    eng.close()
+    return out, stats, events
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("k", [0, 4])
+def test_chunked_prefill_stream_identity_matrix(trained, k, kv_dtype):
+    """The acceptance matrix (single-chip half): prefill_chunk=4
+    streams are bit-identical to prefill_chunk=None — greedy AND
+    seeded in the same batch, speculation on/off, fp32 AND quantized
+    KV blocks — while the chunked engine's executables come from the
+    CHUNK buckets only (the monolithic prefill family never traces)
+    and the counter stays O(prefill buckets)+admit+1 chunk loop."""
+    base, bstats, bevents = _chunked_mix_streams(
+        trained, None, speculate_k=k, kv_dtype=kv_dtype)
+    got, s, events = _chunked_mix_streams(
+        trained, 4, speculate_k=k, kv_dtype=kv_dtype)
+    assert got == base, (k, kv_dtype)
+    # monolithic engine: no chunk executables, no chunk dispatches
+    assert not [e for e in bevents if e.startswith("prefill_chunk")]
+    assert bstats["prefill_chunks"] == 0
+    # chunked engine: prefill flows through the chunk family ONLY,
+    # every shape a bucket <= the chunk budget, decode chunk traced once
+    assert not [e for e in events if e.startswith("prefill:")]
+    chunk_shapes = {e for e in events if e.startswith("prefill_chunk")}
+    assert chunk_shapes <= {"prefill_chunk:L4"}, events
+    assert events.count("decode_chunk") == 1
+    assert len(events) <= len((4, 8, 16)) + 2, events
+    assert s["prefill_chunks"] > 0
+    assert s["completed"] == 6
+
+
+def test_chunked_prefill_mid_batch_long_prompt_does_not_stall_streams(
+        trained):
+    """Behavioral half of the tentpole: a long prompt admitted while
+    short streams are decoding runs its prefill as multiple chunk
+    dispatches (registry-counted) interleaved with decode — the short
+    streams keep emitting between the long prompt's admission and its
+    first token — and every stream still matches sequential
+    gpt_generate."""
+    cfg, _ = trained
+    rng = np.random.RandomState(5)
+    shorts = [rng.randint(0, cfg.vocab_size, (3,)).astype(np.int32)
+              for _ in range(2)]
+    long_p = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+    eng = make_engine(trained, num_slots=3, prefill_buckets=(4, 8, 16),
+                      max_len=32, prefill_chunk=4, decode_chunk=1)
+    sreqs = [eng.submit(p, max_new_tokens=10) for p in shorts]
+    while any(len(r.tokens) < 2 for r in sreqs):
+        eng.step()
+    counts = sum(len(r.tokens) for r in sreqs)
+    lreq = eng.submit(long_p, max_new_tokens=4)
+    # drive while the long prompt is mid-prefill: the shorts must make
+    # progress BEFORE its first token lands (no monolithic stall)
+    while not lreq.tokens:
+        eng.step()
+        assert eng.scheduler.prefilling_count <= 1
+    assert sum(len(r.tokens) for r in sreqs) > counts, \
+        "short streams stalled across the long prompt's prefill"
+    eng.run_until_drained()
+    for r in sreqs:
+        np.testing.assert_array_equal(
+            r.output(), sequential_ref(trained, r.prompt, 10))
+    np.testing.assert_array_equal(
+        lreq.output(), sequential_ref(trained, long_p, 4))
+    # 16 suffix tokens at budget 4 = 4 chunk dispatches for the long
+    # prompt alone; the engine counter saw every one
+    assert eng.stats()["prefill_chunks"] >= 4
+    eng.close()
+
+
+@pytest.mark.parametrize("k", [0, 2])
+def test_chunked_prefill_preempt_resume_identity(trained, k):
+    """Chunked prefill composes with host-swap preemption: the
+    over-subscribed PRESSURE arena forces preemptions on a chunked
+    engine and every stream (greedy and seeded, with and without
+    speculation) is bit-identical to an unpressured chunked run; the
+    drain leaks nothing."""
+    cfg, _ = trained
+    prompts = _pressure_prompts(cfg)
+    tight = make_engine(trained, speculate_k=k, prefill_chunk=4,
+                        **PRESSURE)
+    t_reqs = [tight.submit(p, max_new_tokens=12,
+                           temperature=0.7 if i % 2 else 0.0, seed=i)
+              for i, p in enumerate(prompts)]
+    tight.run_until_drained()
+    assert tight.stats()["preemptions"] >= 1
+    loose = make_engine(trained, speculate_k=k, prefill_chunk=4,
+                        num_slots=4, block_size=4, decode_chunk=4)
+    l_reqs = [loose.submit(p, max_new_tokens=12,
+                           temperature=0.7 if i % 2 else 0.0, seed=i)
+              for i, p in enumerate(prompts)]
+    loose.run_until_drained()
+    assert loose.stats()["preemptions"] == 0
+    assert [r.tokens for r in t_reqs] == [r.tokens for r in l_reqs]
+    s = tight.stats()
+    assert s["swapped_slots"] == 0 and s["blocks_used"] == 0
+    tight.close(); loose.close()
+
+
+def test_chunked_prefill_shared_prefix_admitted_mid_prefill(trained):
+    """Deferred prefix-cache registration: a second request sharing a
+    long prefix is admitted WHILE the first is still mid-chunked-
+    prefill. It may only hash-hit blocks whose filling chunk is
+    already enqueued (register_prefix's frontier), so both streams
+    stay bit-identical to sequential gpt_generate — a hit on an
+    unfilled block would read zeros and corrupt the second stream."""
+    cfg, _ = trained
+    rng = np.random.RandomState(9)
+    sys_prompt = rng.randint(0, cfg.vocab_size, (12,)).astype(np.int32)
+    p1 = np.concatenate(
+        [sys_prompt, rng.randint(0, 97, (3,))]).astype(np.int32)
+    p2 = np.concatenate(
+        [sys_prompt, rng.randint(0, 97, (3,))]).astype(np.int32)
+    eng = make_engine(trained, num_slots=2, prefill_buckets=(4, 8, 16),
+                      max_len=32, block_size=4, prefill_chunk=4)
+    r1 = eng.submit(p1, max_new_tokens=6)
+    eng.step()                         # first chunk dispatched only
+    assert eng.scheduler.prefilling_count == 1
+    r2 = eng.submit(p2, max_new_tokens=6)
+    eng.run_until_drained()
+    np.testing.assert_array_equal(
+        r1.output(), sequential_ref(trained, p1, 6))
+    np.testing.assert_array_equal(
+        r2.output(), sequential_ref(trained, p2, 6))
+    # blocks the first admission had already filled were shared in
+    assert eng.kv.prefix_hits >= 1
+    # nothing left pending after the drain
+    assert not eng.kv._pending_reg
+    eng.close()
+
+
+def test_mid_prefill_cancel_frees_all_pages(trained):
+    """Cancel of a mid-chunked-prefill sequence releases the slot
+    in-graph (page row to scratch) and frees EVERY mapped page —
+    prefix hits included — with its unpublished prefix digests
+    dropped; nothing leaks and the engine keeps serving."""
+    cfg, _ = trained
+    rng = np.random.RandomState(3)
+    long_p = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+    eng = make_engine(trained, num_slots=2, prefill_buckets=(4, 8, 16),
+                      max_len=32, block_size=4, prefill_chunk=4)
+    req = eng.submit(long_p, max_new_tokens=6)
+    eng.step()
+    assert eng.scheduler.prefilling_count == 1
+    assert eng.kv.blocks_used > 0
+    assert eng.cancel(req)
+    eng.step()                         # deferred cancel applies
+    assert eng.scheduler.prefilling_count == 0
+    assert eng.kv.blocks_used == 0
+    assert eng.kv.free_count == 2
+    assert not eng.kv._pending_reg     # unpublished digests dropped
+    assert req.state == "cancelled" and req.tokens == []
+    # the engine still serves cleanly after the aborted prefill
+    out = eng.generate([long_p], max_new_tokens=4)[0]
+    np.testing.assert_array_equal(out, sequential_ref(trained, long_p, 4))
+    eng.close()
+
+
+def test_mid_prefill_migration_refused_not_victim(trained):
+    """Mid-prefill sequences hand off safely or not at all: migrate_out
+    REFUSES with a typed MigrationError while the fill cursor is live
+    (never a corrupt ticket), the preemption victim picker never
+    chooses a mid-prefill slot, and the same request migrates normally
+    once its first token lands — bit-identical on the target."""
+    from paddle_tpu.serving import MigrationError
+
+    cfg, _ = trained
+    rng = np.random.RandomState(13)
+    long_p = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+    eng = make_engine(trained, num_slots=2, prefill_buckets=(4, 8, 16),
+                      max_len=32, prefill_chunk=4, decode_chunk=2)
+    req = eng.submit(long_p, max_new_tokens=12)
+    eng.step()
+    assert eng.scheduler.prefilling_count == 1
+    with pytest.raises(MigrationError, match="mid-prefill"):
+        eng.migrate_out(req)
+    # the refusal left the sequence exactly where it was (still
+    # prefilling, still holding its pages) and it is never a victim
+    assert eng.scheduler.prefilling_count == 1
+    assert eng.scheduler.pick_victim() is None
+    while len(req.tokens) < 2:
+        eng.step()
+    ticket = eng.migrate_out(req)      # now ticketable
+    dst = make_engine(trained, num_slots=2, prefill_buckets=(4, 8, 16),
+                      max_len=32, prefill_chunk=4, decode_chunk=2)
+    req2 = dst.migrate_in(ticket)
+    dst.run_until_drained()
+    assert req2.state == "finished"
+    full = np.concatenate([long_p, np.asarray(req2.tokens, np.int32)])
+    np.testing.assert_array_equal(
+        full, sequential_ref(trained, long_p, 12))
+    eng.run_until_drained()
+    assert eng.kv.blocks_used == 0
+    eng.close(); dst.close()
+
+
+def test_chunked_prefill_request_log_and_metrics(trained):
+    """Observability satellites: each chunk journals a `prefill` event
+    carrying chunk_index/budget, serving_summary renders the
+    PREFILL(xn) annotation and per-chain chunk count, the
+    serving_prefill_chunks_total counter and
+    serving_prefill_chunk_seconds histogram carry one entry per
+    dispatched chunk (retired on close()), and the /varz serving
+    rollup derives prefill_chunks_per_admission from the same
+    series."""
+    import sys as _sys, os as _os
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools"))
+    import serving_summary
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability import request_log as rl
+    from paddle_tpu.observability.debug_server import _serving_varz
+
+    cfg, _ = trained
+    rng = np.random.RandomState(31)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (14, 3)]
+    with rl.request_logging() as log:
+        eng = make_engine(trained, num_slots=2,
+                          prefill_buckets=(4, 8, 16), max_len=32,
+                          prefill_chunk=4)
+        reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        eng.run_until_drained()
+        s = eng.stats()
+        label = s["engine_label"]
+        snap = get_registry().snapshot()
+        eng.close()
+    # per-chunk journal: the 14-token prompt ran 4 chunks, each with
+    # its index and the tick budget
+    long_rid = reqs[0].request_id
+    chunk_evs = [e for e in log.recent() if e["kind"] == "prefill"
+                 and e["request_id"] == long_rid]
+    assert [e["chunk_index"] for e in chunk_evs] == [0, 1, 2, 3]
+    assert all(e["budget"] == 4 for e in chunk_evs)
+    assert sum(e["suffix_len"] for e in chunk_evs) == 14
+    # serving_summary: one row per chain with the annotation + count
+    rows = serving_summary.summarize(log.recent())
+    row = next(r for r in rows if r["request_id"] == long_rid)
+    assert row["prefill_chunks"] == 4
+    assert "PREFILL(x4)" in row["annotations"]
+    short_row = next(r for r in rows
+                     if r["request_id"] == reqs[1].request_id)
+    assert short_row["prefill_chunks"] == 1      # one chunk, no banner
+    assert not [a for a in short_row["annotations"]
+                if a.startswith("PREFILL")]
+    # registry truth: counter == dispatched chunks == histogram count
+    total = s["prefill_chunks"]
+    assert total >= 5                   # 4 + 1
+    ctr = next(r for r in snap["serving_prefill_chunks_total"]["series"]
+               if r["labels"].get("engine") == label)
+    assert ctr["value"] == total
+    hist = next(
+        r for r in snap["serving_prefill_chunk_seconds"]["series"]
+        if r["labels"].get("engine") == label)
+    assert hist["count"] == total and hist["sum"] > 0
+    assert s["mean_prefill_chunk"] > 0
+    # /varz rollup: chunks per admission off the same scrape
+    varz = _serving_varz(snap)["prefill"][label]
+    assert varz["prefill_chunks"] == total
+    assert varz["admitted"] == 2
+    assert varz["prefill_chunks_per_admission"] == round(total / 2, 4)
+    # close() retired the labeled series
+    snap2 = get_registry().snapshot()
+    assert not any(
+        r["labels"].get("engine") == label
+        for r in snap2.get("serving_prefill_chunks_total",
+                           {}).get("series", []))
+
+
+def test_requeue_reservation_counts_prefix_hits(trained):
+    """Bugfix regression: with a sequence parked in the swap pool, the
+    head-of-line page reservation must charge an admission only for
+    the blocks it would ACTUALLY consume from the available supply —
+    fresh pages plus LRU hits it would incref out of the evictable
+    pool; hits on a RUNNING sequence's referenced blocks are free.
+    A prompt sharing a running sequence's prefix in the near-full
+    window (pages cover reserved + consumed but not reserved + full
+    prompt) used to over-reserve by its whole hit depth and requeue
+    instead of admitting. The window arises mid-burst when an earlier
+    admission preempts a victim and a later shared-prefix request
+    must fit the remaining pages, so the check is probed directly at
+    the exact arena state, then the engine is drained normally
+    (parked victim resumed, every stream intact)."""
+    import types
+
+    cfg, _ = trained
+    rng = np.random.RandomState(17)
+    long_p = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+    # block_size 4: long prompt + 4 new = 5 blocks, first 3 shareable
+    eng = make_engine(trained, num_slots=3, prefill_buckets=(4, 8, 16),
+                      max_len=32, block_size=4, kv_blocks=16,
+                      decode_chunk=2, preempt=True)
+    # a RUNNING holder keeps the shared prefix blocks referenced —
+    # hits on them consume nothing from the available supply (budget
+    # sized so it is still mid-stream at the probe below)
+    holder = eng.submit(long_p, max_new_tokens=16)
+    while not holder.tokens:
+        eng.step()
+    # park one sequence, the reservation the admission must respect
+    vic = eng.submit(rng.randint(0, 97, (5,)).astype(np.int32),
+                     max_new_tokens=12)
+    while not vic.tokens:
+        eng.step()
+    eng._fence()
+    assert holder.state == "running"   # prefix blocks still referenced
+    victim_slot = eng.scheduler.pick_victim()     # newest = vic
+    sw = eng.scheduler.swap_out(victim_slot)
+    eng._swapped.append(sw)
+    avail = eng.kv.blocks_available
+    reserved = sum(s.n_blocks for s in eng._swapped)
+    full = eng.kv.blocks_for(long_p.size + 4)
+    need = eng.kv.blocks_needed(long_p, long_p.size + 4)
+    assert need < full                   # live-referenced hits are free
+    assert reserved + need <= avail < reserved + full, \
+        (reserved, need, full, avail)    # exactly the regression window
+    probe = types.SimpleNamespace(prompt=long_p, max_new_tokens=4)
+    assert eng._admission_feasible(probe, 0), \
+        "hit-aware reservation refused a shared-prefix prompt that fits"
+    # normal service resumes cleanly: the parked victim swaps back in
+    # with strict priority and finishes its full budget, and the
+    # shared-prefix prompt serves bit-identically
+    req = eng.submit(long_p, max_new_tokens=4)
+    eng.run_until_drained()
+    assert vic.state == "finished" and len(vic.tokens) == 12
+    assert holder.state == "finished" and req.state == "finished"
+    np.testing.assert_array_equal(
+        req.output(), sequential_ref(trained, long_p, 4))
+    assert eng.stats()["blocks_used"] == 0
+    # everything retired: the prefix blocks fell to the LRU pool, and
+    # claiming LRU hits consumes evictable supply — blocks_needed now
+    # charges them like fresh pages (the under-count guard)
+    assert eng.kv.blocks_needed(long_p, long_p.size + 4) == full
+    eng.close()
+
+
+@pytest.mark.multichip
+def test_chunked_prefill_mesh_tp2_identity(trained):
+    """Quick-lane mesh pin for chunked prefill: a mesh_shape=(2,)
+    engine with prefill_chunk on emits the same greedy and seeded
+    streams as the single-chip MONOLITHIC engine — the chunk kernel's
+    GSPMD sharding composes with the budget discipline — and its
+    executables still come from the chunk buckets only."""
+    base, _, _ = _chunked_mix_streams(trained, None)
+    got, s, events = _chunked_mix_streams(trained, 4, mesh_shape=(2,))
+    assert got == base
+    assert not [e for e in events if e.startswith("prefill:")]
+    assert events.count("decode_chunk") == 1
+    assert s["mesh_shape"] == (2,)
+    assert s["prefill_chunks"] > 0

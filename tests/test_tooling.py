@@ -277,6 +277,41 @@ def test_bench_serving_oversubscribe_row_shape():
     assert 0 < e["blocks_used_peak"] <= e["blocks_total"]
 
 
+def test_bench_serving_mixed_row_shape():
+    """tools/bench_serving --mixed: two rows (chunking off, then on)
+    over the long-prompt + short-decode workload — the off row shows
+    zero chunk dispatches, the on row shows the long prompt really
+    split (registry-sourced prefill_chunks), both carry the
+    p99_tpot_ms / long_ttft_ms columns, the on row carries the
+    improvement ratios, and the streams were asserted bit-identical
+    inside the workload itself (streams_identical pinned True)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_mixed("tiny", requests=2, short_max_new=8)
+    assert len(rows) == 2                  # chunking off, then on
+    off, on = rows
+    assert off["metric"] == "tiny_serving_mixed_chunk0"
+    assert on["metric"].startswith("tiny_serving_mixed_chunk")
+    assert on["metric"] != off["metric"]
+    for row in rows:
+        assert row["value"] > 0 and row["unit"] == "tokens/s"
+        e = row["extra"]
+        assert e["p99_tpot_ms"] is not None and e["p99_tpot_ms"] > 0
+        assert e["long_ttft_ms"] > 0
+        assert e["streams_identical"] is True
+        assert e["compiled_executables"] > 0
+    # the off row ran monolithic (no chunk dispatches, no chunk
+    # latency samples); the on row really split the long prompt
+    assert off["extra"]["prefill_chunk"] is None
+    assert off["extra"]["prefill_chunks"] == 0
+    assert off["extra"]["prefill_chunk_ms"] is None
+    assert on["extra"]["prefill_chunk"] >= 1
+    assert on["extra"]["prefill_chunks"] >= 4   # the long prompt alone
+    assert on["extra"]["prefill_chunk_ms"] > 0
+    assert on["extra"]["p99_tpot_improvement"] is not None
+    assert on["extra"]["long_ttft_ratio"] is not None
+
+
 def test_bench_serving_debug_port_flag(capsys, monkeypatch):
     """--debug-port serves the diagnostics plane for the bench run and
     tears it down afterwards."""

@@ -13,8 +13,11 @@
 # pins live in tests/test_serving.py, as do the QUANTIZED-mesh
 # identity pins (int8-w+int8-kv engines bit-identical to their own
 # single-chip streams at tp 2/4, plus tp->tp / tp->single migration
-# of an int8-KV sequence — test_quantized_mesh_*); `--mesh` bench
-# rows come from
+# of an int8-KV sequence — test_quantized_mesh_*) and the
+# CHUNKED-PREFILL mesh pin (prefill_chunk on a tp=2 mesh streams
+# identical to single-chip monolithic, chunk-bucket executables only —
+# test_chunked_prefill_mesh_tp2_identity); `--mesh` bench rows come
+# from
 #   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 #       JAX_PLATFORMS=cpu python tools/bench_serving.py tiny --mesh 1 2 4
 set -euo pipefail

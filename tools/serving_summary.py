@@ -20,6 +20,9 @@ Usage:
 Annotations:
   PREEMPT    the sequence was host-swapped out under page pressure
              (and later resumed)
+  PREFILL(xn)  the prompt was prefilled in n budget-bounded chunks
+             interleaved with decode (ServingConfig(prefill_chunk=N);
+             per-chunk ``prefill`` events carry chunk_index/budget)
   FAILOVER   the stream was re-submitted after a replica failure
   MIGRATE    the sequence was live-migrated across replicas (count in
              parentheses when it hopped more than once); migration
@@ -126,7 +129,12 @@ def summarize(events):
                       "shed": "shed"}.get(terminal["kind"])
         decode_evs = [rec for rec in evs if rec["kind"] == "decode"]
         t_admit = first.get("admitted", {}).get("t_mono")
-        t_prefill = first.get("prefill", {}).get("t_mono")
+        # the prefill phase ends at the LAST prefill event: a chunked
+        # prompt journals one event per chunk across many ticks, and
+        # stamping the first would fold chunks 1..n-1 into decode_ms
+        # (monolithic chains have exactly one, so last == first)
+        t_prefill = next((rec.get("t_mono") for rec in reversed(evs)
+                          if rec["kind"] == "prefill"), None)
         t_end = terminal.get("t_mono") if terminal is not None else None
         tokens = None
         for rec in (closed, first.get("finished")):
@@ -138,6 +146,13 @@ def summarize(events):
         notes = []
         if "preempted" in kinds:
             notes.append("PREEMPT")
+        # chunked prefill: >1 journaled prefill chunk for this chain
+        # (monolithic prefill events carry no chunk_index and never
+        # annotate)
+        chunks = sum(1 for rec in evs if rec["kind"] == "prefill"
+                     and rec.get("chunk_index") is not None)
+        if chunks > 1:
+            notes.append(f"PREFILL(x{chunks})")
         migrations = kinds.count("migrate_in")
         if migrations:
             notes.append("MIGRATE" if migrations == 1
@@ -170,6 +185,7 @@ def summarize(events):
             "decode_ms": _ms(t_prefill, t_end),
             "total_ms": _ms(t0, t_end),
             "dispatches": len(decode_evs),
+            "prefill_chunks": chunks,
             "preemptions": kinds.count("preempted"),
             "migrations": migrations,
             "annotations": notes,
