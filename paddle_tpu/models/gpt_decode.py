@@ -43,6 +43,7 @@ __all__ = ["collect_gpt_params", "quantize_params", "gpt_forward_logits",
            "gpt_decode_step_pages", "gpt_decode_chunk_pages",
            "gpt_decode_verify_slots", "gpt_decode_verify_pages",
            "spec_ngram_seed", "gpt_generate", "QUANTIZED_KV_KERNELS",
+           "ADAPTER_KERNELS", "ADAPTER_PROJECTIONS",
            "threefry2x32", "sample_key", "sample_split", "sample_gumbel"]
 
 # The paged kernels whose in-graph KV dequant path exists: a quantized
@@ -55,6 +56,22 @@ QUANTIZED_KV_KERNELS = ("gpt_prefill_pages", "gpt_prefill_chunk_pages",
                         "gpt_decode_step_pages",
                         "gpt_decode_chunk_pages",
                         "gpt_decode_verify_pages")
+
+# The paged kernels whose per-slot LoRA gather-matmul path exists: an
+# engine with an adapter pool may ONLY dispatch kernels named here
+# (the QUANTIZED_KV_KERNELS discipline applied to multi-tenant
+# adapters). Config validation reads this to refuse combinations whose
+# low-rank path is not covered (speculate_k > 0 needs the verify
+# kernel's adapter path) instead of silently serving base-model tokens
+# for an adapterized request.
+ADAPTER_KERNELS = ("gpt_prefill_pages", "gpt_prefill_chunk_pages",
+                   "gpt_decode_step_pages",
+                   "gpt_decode_chunk_pages",
+                   "gpt_decode_verify_pages")
+
+# projections the low-rank adapter path covers (every matmul in the
+# block: attention q/k/v/out + both MLP projections)
+ADAPTER_PROJECTIONS = ("q", "k", "v", "out", "mlp1", "mlp2")
 
 
 def _ln_names(name):
@@ -151,6 +168,54 @@ def _dense(x, p):
 def _gelu_tanh(x):
     import jax
     return jax.nn.gelu(x, approximate=True)
+
+
+# -- multi-tenant LoRA adapter path -----------------------------------------
+#
+# An adapter pool is the pytree {proj: {"a": (N, L, in, rank),
+# "b": (N, L, rank, out)}} over ADAPTER_PROJECTIONS — N device-resident
+# low-rank variants stacked on a leading adapter axis (row 0 is the
+# reserved identity: all zeros, so base-model requests gather a
+# mathematically-exact no-op). The serving kernels gather each slot's
+# A/B rows by its adapter id and add x @ A_s @ B_s to the base
+# projection output — a batched gather-matmul (BGMV), so S co-batched
+# slots can each hit a DIFFERENT adapter inside one fused dispatch with
+# zero shape change and zero extra executables. The base matmul is
+# untouched (int8 weights keep their fused dequant); the low-rank path
+# runs in f32 regardless of the serving dtype — at rank r it is a
+# rounding error of the FLOPs and the adapters are trained artifacts
+# whose numerics should not depend on the engine's storage dtype.
+
+def _lora_layer(adapters, adapter_ids, li, live):
+    """Per-layer gathered LoRA operands: {proj: (A, B, live) | None}.
+    adapter_ids is an (S,) int32 vector (per-slot decode) or a traced
+    scalar (single-sequence prefill); `live` is the pre-broadcast
+    (adapter_ids != 0) mask selecting the base output bit-exactly for
+    identity rows (adding an all-zero delta could still flip -0.0)."""
+    if adapters is None:
+        return {nm: None for nm in ADAPTER_PROJECTIONS}
+    return {nm: (adapters[nm]["a"][adapter_ids, li],
+                 adapters[nm]["b"][adapter_ids, li], live)
+            for nm in ADAPTER_PROJECTIONS}
+
+
+def _dense_a(x, p, lora):
+    """_dense plus the gathered low-rank delta: y + (x @ A_s @ B_s) in
+    f32, selected per slot so adapter-0 rows return the base `y`
+    BIT-IDENTICALLY (jnp.where on the whole row, not an add of zeros).
+    lora=None is the adapterless engine: exactly _dense, same graph."""
+    import jax.numpy as jnp
+    y = _dense(x, p)
+    if lora is None:
+        return y
+    a, b, live = lora
+    xf = x.astype(jnp.float32)
+    if a.ndim == 2:                      # single-sequence prefill
+        d = (xf @ a) @ b
+    else:                                # per-slot gathered (S, ...)
+        d = jnp.einsum("s...r,sro->s...o",
+                       jnp.einsum("s...i,sir->s...r", xf, a), b)
+    return jnp.where(live, y + d.astype(y.dtype), y)
 
 
 def _split_heads(x, heads):
@@ -397,7 +462,8 @@ def gpt_decode_verify_slots(params, cfg, toks, cache, ts):
     return (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32), cache
 
 
-def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
+def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None,
+                            adapters=None, adapter_ids=None):
     """gpt_decode_verify_slots over the PAGED pool: the D per-slot K/V
     writes go through the page table, and two redirects keep the arena
     sound — `done` slots write the reserved scratch block (the frozen-
@@ -418,6 +484,8 @@ def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
     D = toks.shape[1]
     L = P * bs
     dtype = _arena_compute_dtype(params, data, _scales)
+    live = None if adapters is None \
+        else (adapter_ids != 0)[:, None, None]
     rows = jnp.arange(s_dim)[:, None]
     pos = ts[:, None] + jnp.arange(D)[None, :]           # (S, D)
     x = (params["wte"][toks] + params["wpe"][pos]).astype(dtype)
@@ -428,10 +496,11 @@ def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
         wblk = jnp.where(done[:, None], 0, wblk)
     woff = pos % bs
     for li, blk in enumerate(params["blocks"]):
+        la = _lora_layer(adapters, adapter_ids, li, live)
         h = _ln(x, blk["ln1"])
-        q = _dense(h, blk["q"]).reshape(s_dim, D, heads, hd)
-        k = _dense(h, blk["k"]).reshape(s_dim, D, heads, hd)
-        v = _dense(h, blk["v"]).reshape(s_dim, D, heads, hd)
+        q = _dense_a(h, blk["q"], la["q"]).reshape(s_dim, D, heads, hd)
+        k = _dense_a(h, blk["k"], la["k"]).reshape(s_dim, D, heads, hd)
+        v = _dense_a(h, blk["v"], la["v"]).reshape(s_dim, D, heads, hd)
         arena = _kv_write(arena, li, 0, wblk, woff, k)
         arena = _kv_write(arena, li, 1, wblk, woff, v)
         K = _kv_gather(arena, li, 0, pt, dtype)    # (S, n, L, hd)
@@ -443,9 +512,10 @@ def gpt_decode_verify_pages(params, cfg, toks, arena, pt, ts, done=None):
         probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
         probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
         ctx = jnp.einsum("bnqk,bnkd->bqnd", probs, V).reshape(s_dim, D, -1)
-        x = x + _dense(ctx, blk["out"])
+        x = x + _dense_a(ctx, blk["out"], la["out"])
         h = _ln(x, blk["ln2"])
-        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+        x = x + _dense_a(_gelu_tanh(_dense_a(h, blk["mlp1"], la["mlp1"])),
+                         blk["mlp2"], la["mlp2"])
     x = _ln(x, params["lnf"])
     return (x @ params["wte"].T.astype(x.dtype)).astype(jnp.float32), arena
 
@@ -754,7 +824,7 @@ def _kv_gather(arena, li, j, pages, dtype):
 
 
 def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
-                      pages):
+                      pages, adapters=None, adapter_id=None):
     """Paged prefill of ONE sequence's prompt SUFFIX into its arena
     blocks, attending over an already-cached prefix through the page
     table — the single prefill entry point of the paged serving pool
@@ -782,13 +852,20 @@ def gpt_prefill_pages(params, cfg, tokens, pfx_len, real_len, arena,
     Returns (logits of position pfx_len+real_len-1, (1, V) f32, arena).
     Compiles once per SUFFIX bucket — prefix-cache hits shrink the
     suffix into the small buckets, which is where the TTFT win on
-    shared-prompt traffic comes from."""
+    shared-prompt traffic comes from.
+
+    `adapters`/`adapter_id` (multi-tenant serving, else None): the
+    device-resident LoRA pool and THIS sequence's traced adapter id —
+    every projection gathers its A/B rows and adds the low-rank delta
+    (id 0 selects the base output bit-exactly), so the prompt's K/V
+    rows are computed under the same adapter the decode path serves."""
     return _prefill_pages_body(params, cfg, tokens, pfx_len, real_len,
-                               arena, pages)
+                               arena, pages, adapters, adapter_id)
 
 
 def gpt_prefill_chunk_pages(params, cfg, tokens, start_pos, real_len,
-                            arena, pages):
+                            arena, pages, adapters=None,
+                            adapter_id=None):
     """Budget-bounded CHUNKED-PREFILL pass: process up to B suffix
     tokens of ONE sequence's prompt starting at absolute position
     `start_pos`, attending over everything already resident in its
@@ -815,11 +892,11 @@ def gpt_prefill_chunk_pages(params, cfg, tokens, start_pos, real_len,
     never fetches. Compiles once per CHUNK bucket, so chunking grows
     the executable family by at most O(prefill buckets)."""
     return _prefill_pages_body(params, cfg, tokens, start_pos, real_len,
-                               arena, pages)
+                               arena, pages, adapters, adapter_id)
 
 
 def _prefill_pages_body(params, cfg, tokens, pfx_len, real_len, arena,
-                        pages):
+                        pages, adapters=None, adapter_id=None):
     """Shared body of gpt_prefill_pages / gpt_prefill_chunk_pages: one
     loop so the monolithic and chunked prefill math can never diverge
     (the chunked path's token-parity guarantee depends on it)."""
@@ -831,6 +908,7 @@ def _prefill_pages_body(params, cfg, tokens, pfx_len, real_len, arena,
     bs = data.shape[4]
     L = pages.shape[0] * bs
     dtype = _arena_compute_dtype(params, data, _scales)
+    live = None if adapters is None else (adapter_id != 0)
     j = jnp.arange(B)
     pos = pfx_len + j                              # absolute positions
     x = (params["wte"][tokens[0]] + params["wpe"][pos]).astype(dtype)
@@ -842,10 +920,11 @@ def _prefill_pages_body(params, cfg, tokens, pfx_len, real_len, arena,
                      0)
     woff = pos % bs
     for li, blk in enumerate(params["blocks"]):
+        la = _lora_layer(adapters, adapter_id, li, live)
         h = _ln(x, blk["ln1"])
-        q = _dense(h, blk["q"]).reshape(B, heads, hd)
-        k = _dense(h, blk["k"]).reshape(B, heads, hd)
-        v = _dense(h, blk["v"]).reshape(B, heads, hd)
+        q = _dense_a(h, blk["q"], la["q"]).reshape(B, heads, hd)
+        k = _dense_a(h, blk["k"], la["k"]).reshape(B, heads, hd)
+        v = _dense_a(h, blk["v"], la["v"]).reshape(B, heads, hd)
         arena = _kv_write(arena, li, 0, wblk, woff, k)
         arena = _kv_write(arena, li, 1, wblk, woff, v)
         K = _kv_gather(arena, li, 0, pages, dtype)  # (heads, L, hd)
@@ -856,14 +935,16 @@ def _prefill_pages_body(params, cfg, tokens, pfx_len, real_len, arena,
         probs = jnp.exp(scores - jnp.max(scores, -1, keepdims=True))
         probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
         ctx = jnp.einsum("bnk,nkd->bnd", probs, V).reshape(B, -1)
-        x = x + _dense(ctx, blk["out"])
+        x = x + _dense_a(ctx, blk["out"], la["out"])
         h = _ln(x, blk["ln2"])
-        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+        x = x + _dense_a(_gelu_tanh(_dense_a(h, blk["mlp1"], la["mlp1"])),
+                         blk["mlp2"], la["mlp2"])
     last = x[real_len - 1][None, None]             # (1, 1, h)
     return _head_logits(params, last), arena
 
 
-def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
+def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None,
+                          adapters=None, adapter_ids=None):
     """gpt_decode_step_slots over a PAGED pool: per-slot K/V live in
     arena blocks indirected through a page table instead of contiguous
     slab rows. tokens/ts: (S,) int32, pt: (S, P) int32 page table,
@@ -876,7 +957,13 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
     page row. `done` (S,) bool redirects frozen slots' K/V writes to
     the reserved scratch block 0 in-graph (their gathers still read
     stale blocks — garbage logits the host discards). done=None keeps
-    every write live (single-sequence/unit-test use)."""
+    every write live (single-sequence/unit-test use).
+
+    `adapters`/`adapter_ids` (multi-tenant serving, else None): the
+    LoRA pool + an (S,) int32 per-slot adapter-id vector — every
+    projection gathers each slot's A/B rows and adds x @ A_s @ B_s, so
+    co-batched slots hit DIFFERENT adapters in this one dispatch
+    (id 0 rows select the base output bit-exactly)."""
     import jax.numpy as jnp
 
     heads = cfg.heads
@@ -886,6 +973,8 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
     s_dim, P = pt.shape
     L = P * bs
     dtype = _arena_compute_dtype(params, data, _scales)
+    live = None if adapters is None \
+        else (adapter_ids != 0)[:, None, None]
     rows = jnp.arange(s_dim)
     x = (params["wte"][tokens] + params["wpe"][ts]).astype(dtype)[:, None]
     pos_mask = (jnp.arange(L)[None, :] <= ts[:, None])     # [S, L]
@@ -894,10 +983,11 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
         wblk = jnp.where(done, 0, wblk)        # frozen -> scratch block
     woff = ts % bs
     for li, blk in enumerate(params["blocks"]):
+        la = _lora_layer(adapters, adapter_ids, li, live)
         h = _ln(x, blk["ln1"])
-        q = _dense(h, blk["q"]).reshape(s_dim, heads, 1, hd)
-        k = _dense(h, blk["k"]).reshape(s_dim, heads, hd)
-        v = _dense(h, blk["v"]).reshape(s_dim, heads, hd)
+        q = _dense_a(h, blk["q"], la["q"]).reshape(s_dim, heads, 1, hd)
+        k = _dense_a(h, blk["k"], la["k"]).reshape(s_dim, heads, hd)
+        v = _dense_a(h, blk["v"], la["v"]).reshape(s_dim, heads, hd)
         arena = _kv_write(arena, li, 0, wblk, woff, k)
         arena = _kv_write(arena, li, 1, wblk, woff, v)
         K = _kv_gather(arena, li, 0, pt, dtype)  # (S, heads, L, hd)
@@ -910,16 +1000,18 @@ def gpt_decode_step_pages(params, cfg, tokens, arena, pt, ts, done=None):
         probs = (probs / probs.sum(-1, keepdims=True)).astype(dtype)
         ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, V)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(s_dim, 1, -1)
-        x = x + _dense(ctx, blk["out"])
+        x = x + _dense_a(ctx, blk["out"], la["out"])
         h = _ln(x, blk["ln2"])
-        x = x + _dense(_gelu_tanh(_dense(h, blk["mlp1"])), blk["mlp2"])
+        x = x + _dense_a(_gelu_tanh(_dense_a(h, blk["mlp1"], la["mlp1"])),
+                         blk["mlp2"], la["mlp2"])
     return _head_logits(params, x), arena
 
 
 def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
                            temps, done, remaining, eos_ids, chunk,
                            sample_fn=None, speculate_k=0,
-                           spec_state=None, arena_constraint=None):
+                           spec_state=None, arena_constraint=None,
+                           adapters=None, adapter_ids=None):
     """gpt_decode_chunk_slots over the paged pool: `chunk` iterations of
     gpt_decode_step_pages + per-slot sampling + in-graph EOS/budget
     masking in ONE lax.scan. Carry/masking semantics are identical to
@@ -955,7 +1047,14 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
     redirect covers data AND scales. Streams from a quantized engine
     are bit-identical to themselves across chunk sizes, preemption,
     and mesh shapes — the same determinism contract as fp32, pinned
-    against its own quantized reference rather than the fp32 one."""
+    against its own quantized reference rather than the fp32 one.
+
+    ADAPTERS: `adapters`/`adapter_ids` (the LoRA pool + the (S,) int32
+    per-slot id vector from the decode carry) thread to every inner
+    step/verify pass — both are read-only through the scan (ids change
+    only at admission, exactly like the page table), so the fused loop
+    stays ONE executable however many distinct adapters the batch
+    mixes."""
     import jax
     import jax.numpy as jnp
 
@@ -970,7 +1069,9 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
             if arena_constraint is not None:
                 arena = arena_constraint(arena)
             return gpt_decode_verify_pages(params, cfg, inputs, arena,
-                                           pt, ts, done)
+                                           pt, ts, done,
+                                           adapters=adapters,
+                                           adapter_ids=adapter_ids)
 
         def body(carry, _):
             return _spec_step(verify, sample_fn, temps, eos_ids,
@@ -988,7 +1089,8 @@ def gpt_decode_chunk_pages(params, cfg, tokens, arena, pt, ts, keys,
         if arena_constraint is not None:
             arena = arena_constraint(arena)
         logits, arena = gpt_decode_step_pages(
-            params, cfg, tok, arena, pt, ts, done)
+            params, cfg, tok, arena, pt, ts, done,
+            adapters=adapters, adapter_ids=adapter_ids)
         nxt, keys = jax.vmap(sample_fn)(keys, logits, temps)
         emit = jnp.where(done, tok, nxt)
         rem = jnp.where(done, rem, rem - 1)

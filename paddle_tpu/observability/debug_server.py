@@ -140,7 +140,7 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
     operator would otherwise derive from counter pairs by hand, all
     built by registry_rollup over the snapshot only — no engine
     references, same as every other /varz column."""
-    return {
+    out = {
         "prefix_hit_ratio": registry_rollup(snap, {
             "prefix_cache_hits": "serving_prefix_cache_hits_total",
             "prefix_cache_misses": "serving_prefix_cache_misses_total",
@@ -232,6 +232,20 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
             ("goodput_ratio",
              ratio("goodput_tokens", "tokens"))]),
     }
+    # multi-tenant adapter pool: residency + pool HBM + upload/evict
+    # churn per engine. The families are conditional (registered only
+    # on engines built with an AdapterPool), so the block appears only
+    # when some engine actually serves adapters — adapterless fleets
+    # keep their /varz payload byte-identical to pre-adapter builds.
+    adapters = registry_rollup(snap, {
+        "adapters_resident": "serving_adapters_resident",
+        "adapter_pool_bytes": "serving_adapter_pool_bytes",
+        "adapter_uploads": "serving_adapter_uploads_total",
+        "adapter_evictions": "serving_adapter_evictions_total",
+    })
+    if adapters:
+        out["adapters"] = adapters
+    return out
 
 
 _BAD_LIMIT = object()   # _parse_limit sentinel: 400 already sent

@@ -375,6 +375,26 @@ class ServingTPPlan:
         gathered from (aliased so the two layouts can never diverge)."""
         return self.arena_sharding
 
+    def adapter_shardings(self, nm: str):
+        """(A, B) NamedShardings for one projection's LoRA pool leaves —
+        A (num_adapters, layers, in, rank), B (num_adapters, layers,
+        rank, out) — placed so the low-rank path composes with the
+        Megatron layout with ZERO extra collectives: column-parallel
+        projections (q/k/v/mlp1, out axis split) replicate the tiny A
+        and shard B on its out axis, so x@A@B lands pre-split exactly
+        like x@W's columns; row-parallel projections (out/mlp2, in axis
+        split) shard A on its in axis and replicate B, so each chip's
+        partial x@A rides the SAME psum the base matmul already pays.
+        The rank axis never shards (no divisibility demand on r); the
+        in/out axes inherit the heads%tp / ffn%tp checks from
+        construction (hidden = heads*head_dim)."""
+        wspec, _ = _GPT_TP_SPECS[nm]
+        if wspec == (None, "tp"):               # column-parallel
+            return (self._nsh(),
+                    self._nsh(None, None, None, "tp"))
+        return (self._nsh(None, None, "tp", None),   # row-parallel
+                self._nsh())
+
     # -- placement -----------------------------------------------------------
 
     def shard_params(self, params):

@@ -33,12 +33,12 @@ Quick start:
     server.shutdown()          # drain, then refcounted engine close()
 """
 
-from .router import (DrainingError, QuotaConfig, QuotaExceededError,
-                     RebalanceConfig, Router, RouterMetrics, SLOConfig,
-                     StreamHandle, TokenBucket)
+from .router import (AdapterConfig, DrainingError, QuotaConfig,
+                     QuotaExceededError, RebalanceConfig, Router,
+                     RouterMetrics, SLOConfig, StreamHandle, TokenBucket)
 from .service import GenerationServer, ServerConfig, serve
 
 __all__ = ["GenerationServer", "ServerConfig", "serve", "Router",
            "StreamHandle", "TokenBucket", "QuotaConfig",
            "QuotaExceededError", "DrainingError", "RouterMetrics",
-           "SLOConfig", "RebalanceConfig"]
+           "SLOConfig", "RebalanceConfig", "AdapterConfig"]

@@ -91,7 +91,7 @@ from ..serving.migration import MigrationError
 
 __all__ = ["Router", "StreamHandle", "TokenBucket", "QuotaConfig",
            "QuotaExceededError", "DrainingError", "RouterMetrics",
-           "SLOConfig", "RebalanceConfig"]
+           "SLOConfig", "RebalanceConfig", "AdapterConfig"]
 
 
 class QuotaExceededError(RuntimeError):
@@ -169,6 +169,26 @@ class SLOConfig:
                                         ("tpot", self.tpot_s),
                                         ("e2e", self.e2e_s))
                 if v is not None}
+
+
+class AdapterConfig:
+    """Per-tenant LoRA adapter binding, wired through the router like
+    QuotaConfig (``adapters`` per tenant + ``default_adapter`` for
+    unlisted tenants): every request the tenant routes is submitted
+    under ``adapter_id``, pinning that adapter's pool row on the chosen
+    replica for the request's lifetime. ``adapter_id=0`` is the base
+    model (an explicit binding to "no adapter"). A tenant bound to an
+    adapter nobody uploaded fails at engine admission with
+    UnknownAdapterError — a ValueError, so the HTTP tier's existing
+    400 mapping is the typed 4xx — and burns no quota (the router's
+    not-granted refund path covers engine validation errors)."""
+
+    def __init__(self, adapter_id: int):
+        if not isinstance(adapter_id, int) or isinstance(adapter_id, bool) \
+                or adapter_id < 0:
+            raise ValueError(
+                f"adapter_id must be an int >= 0, got {adapter_id!r}")
+        self.adapter_id = int(adapter_id)
 
 
 class RebalanceConfig:
@@ -1163,7 +1183,9 @@ class Router:
                  restart_backoff_cap_s: float = 2.0,
                  slos: Optional[Dict[str, SLOConfig]] = None,
                  default_slo: Optional[SLOConfig] = None,
-                 rebalance: Optional[RebalanceConfig] = None):
+                 rebalance: Optional[RebalanceConfig] = None,
+                 adapters: Optional[Dict[str, AdapterConfig]] = None,
+                 default_adapter: Optional[AdapterConfig] = None):
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine replica")
@@ -1193,6 +1215,11 @@ class Router:
         # is dormant — zero registry series, zero per-close work
         self._slo_cfg = dict(slos or {})
         self._default_slo = default_slo
+        # per-tenant adapter bindings (same wiring pattern): resolved at
+        # submit, riding submit_kw so failover re-submissions and the
+        # migration plane keep the same adapter without re-resolution
+        self._adapter_cfg = dict(adapters or {})
+        self._default_adapter = default_adapter
         self._buckets: Dict[str, Optional[TokenBucket]] = {}
         self._bucket_lock = threading.Lock()
         self._admit_lock = threading.Lock()
@@ -1278,12 +1305,19 @@ class Router:
     def submit(self, prompt, max_new_tokens: int, tenant: str = "default",
                deadline_s: Optional[float] = None,
                temperature: float = 0.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> StreamHandle:
+               eos_id: Optional[int] = None,
+               adapter_id: Optional[int] = None) -> StreamHandle:
         """Route one request. Raises DrainingError (draining/closed),
         QuotaExceededError (tenant bucket empty), EngineOverloadError
         (EVERY replica shed — the least-loaded replica's structured
         error propagates), or ValueError (request can never be served,
-        straight from engine validation)."""
+        straight from engine validation — including UnknownAdapterError
+        for an adapter nobody uploaded, the typed 4xx).
+
+        `adapter_id=None` (the default) resolves the tenant's
+        AdapterConfig binding (`adapters`/`default_adapter`, the quota
+        wiring pattern); an explicit int — including 0 — overrides the
+        binding for this request."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._admit_lock:
             if self._draining or self._closed:
@@ -1306,6 +1340,12 @@ class Router:
                     _watchdog.notify_overload(
                         f"router-{self.metrics.label}")
                     raise QuotaExceededError(tenant, retry)
+            if adapter_id is None:
+                adapter_cfg = self._adapter_cfg.get(tenant,
+                                                    self._default_adapter)
+                adapter_id = 0 if adapter_cfg is None \
+                    else adapter_cfg.adapter_id
+            adapter_id = int(adapter_id)
             order = self._healthy_order()
             last_err: Optional[EngineOverloadError] = None
             granted = False
@@ -1326,14 +1366,15 @@ class Router:
                     handle.submit_kw = dict(
                         max_new_tokens=max_new_tokens,
                         temperature=temperature, seed=seed,
-                        eos_id=eos_id)
+                        eos_id=eos_id, adapter_id=adapter_id)
                     engine = replica.engine
                     try:
                         req = engine.submit(
                             prompt, max_new_tokens,
                             temperature=temperature,
                             seed=seed, eos_id=eos_id,
-                            on_token=handle._on_token)
+                            on_token=handle._on_token,
+                            adapter_id=adapter_id)
                     except EngineOverloadError as e:
                         last_err = e
                         continue
@@ -1343,7 +1384,8 @@ class Router:
                     rlog = _request_log.get_request_log()
                     if rlog is not None:
                         rlog.event("routed", request_id=req.request_id,
-                                   tenant=tenant, replica=replica.label)
+                                   tenant=tenant, replica=replica.label,
+                                   adapter_id=adapter_id)
                     if not replica.adopt(handle, engine):
                         # the replica died between submit and watch and
                         # its stranded-stream sweep missed this handle:

@@ -160,6 +160,13 @@ def _parse_request(payload: Dict[str, Any], cfg: ServerConfig):
     if eos_id is not None and (not isinstance(eos_id, int)
                                or isinstance(eos_id, bool) or eos_id < 0):
         raise ValueError("'eos_id' must be an integer >= 0 (or absent)")
+    adapter_id = payload.get("adapter_id")
+    if adapter_id is not None and (not isinstance(adapter_id, int)
+                                   or isinstance(adapter_id, bool)
+                                   or adapter_id < 0):
+        raise ValueError("'adapter_id' must be an integer >= 0 "
+                         "(0 = base model; absent = the tenant's "
+                         "configured adapter binding)")
     deadline_s = payload.get("deadline_s", cfg.default_deadline_s)
     if deadline_s is not None:
         if not isinstance(deadline_s, (int, float)) \
@@ -169,7 +176,8 @@ def _parse_request(payload: Dict[str, Any], cfg: ServerConfig):
             deadline_s = min(float(deadline_s), cfg.max_deadline_s)
     return np.asarray(prompt, np.int32), dict(
         max_new_tokens=max_new, temperature=float(temperature),
-        seed=int(seed), eos_id=eos_id, deadline_s=deadline_s)
+        seed=int(seed), eos_id=eos_id, deadline_s=deadline_s,
+        adapter_id=adapter_id)
 
 
 def _retry_after_header(retry_after_s: Optional[float],
@@ -304,7 +312,14 @@ class _Handler(BaseHTTPRequestHandler):
                  # sourced / adopted (host mirrors of the
                  # server_migrations_total accounting)
                  "migrations_out": r.migrations_out,
-                 "migrations_in": r.migrations_in}
+                 "migrations_in": r.migrations_in,
+                 # adapter pool occupancy: 0 on adapterless replicas
+                 # (no pool ⇒ nothing resident), so operators can see
+                 # at a glance which replicas can adopt an
+                 # adapter-bearing migration ticket
+                 "adapters_resident": int(
+                     r.engine.adapters.resident_count)
+                 if r.engine.adapters is not None else 0}
                 for r in router.replicas],
         }, status=503 if draining else 200)
 

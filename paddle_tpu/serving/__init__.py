@@ -20,6 +20,10 @@ saved model dir, or `ServingEngine(params, cfg)` over an in-memory
 parameter pytree.
 """
 
+from .adapters import (AdapterError, AdapterGeometryError, AdapterPool,
+                       AdapterPoolFullError, AdapterReferencedError,
+                       UnknownAdapterError, adapter_geometry,
+                       make_adapter)
 from .engine import (DEFAULT_RETRY_AFTER_S, EngineOverloadError,
                      GenerationRequest, ServingConfig, ServingEngine)
 from .faults import FaultPlan, InjectedFault
@@ -37,4 +41,8 @@ __all__ = ["ServingEngine", "ServingConfig", "GenerationRequest",
            "SwappedSequence", "FaultPlan", "InjectedFault",
            "EngineMetrics", "RequestMetrics",
            "MigrationTicket", "MigrationError", "TicketError",
-           "TICKET_VERSION"]
+           "TICKET_VERSION",
+           "AdapterPool", "AdapterError", "UnknownAdapterError",
+           "AdapterGeometryError", "AdapterPoolFullError",
+           "AdapterReferencedError", "adapter_geometry",
+           "make_adapter"]

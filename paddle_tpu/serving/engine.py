@@ -141,6 +141,21 @@ class ServingConfig:
     path (gpt_decode.QUANTIZED_KV_KERNELS) — covered today, asserted
     so it can never silently rot.
 
+    Multi-tenant adapter knobs (both default None = adapterless, the
+    bit-identical pre-adapter engine with zero new executables or
+    registry series): max_adapters=N + adapter_rank=r allocate a
+    device-resident LoRA pool of N rows (row 0 = the reserved base
+    identity) at rank r over the q/k/v/out/mlp1/mlp2 projections
+    (serving.adapters.AdapterPool). upload_adapter()/evict_adapter()
+    manage residency under a refcount+LRU discipline; submit(
+    adapter_id=k) routes a request to a resident adapter (unknown id =
+    typed UnknownAdapterError, a ValueError for the HTTP 400 mapping).
+    Co-batched requests hit different adapters inside ONE fused chunk
+    dispatch; compile count stays O(buckets)+admit+1 and adapter_id=0
+    streams are bit-identical to an adapterless engine. Both knobs must
+    be set together; geometry is validated here with typed errors — no
+    silent fallback (the weight_dtype discipline).
+
     Observability knobs: dispatch_timing=True attributes every fused
     decode dispatch's wall time into launch-side host work vs the
     blocking wait for its result (serving_dispatch_{host,device}_seconds
@@ -164,6 +179,8 @@ class ServingConfig:
                  mesh_shape: Optional[Sequence[int]] = None,
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
+                 max_adapters: Optional[int] = None,
+                 adapter_rank: Optional[int] = None,
                  fault_plan=None,
                  dispatch_timing: bool = False,
                  clock: Callable[[], float] = time.monotonic):
@@ -237,6 +254,30 @@ class ServingConfig:
                     "falls back silently")
         self.weight_dtype = weight_dtype
         self.kv_dtype = kv_dtype
+        # multi-tenant adapter pool (both None = adapterless): the two
+        # knobs travel together — a pool needs both its row count and
+        # its rank, and validation is LOUD at construction (the
+        # weight_dtype discipline: no silent fallback, no deferred
+        # surprise at first upload)
+        if (max_adapters is None) != (adapter_rank is None):
+            raise ValueError(
+                "max_adapters and adapter_rank must be set together "
+                f"(got max_adapters={max_adapters!r}, "
+                f"adapter_rank={adapter_rank!r}) — an adapter pool "
+                "needs both its row count and its rank")
+        if max_adapters is not None:
+            if not isinstance(max_adapters, int) \
+                    or isinstance(max_adapters, bool) or max_adapters < 2:
+                raise ValueError(
+                    f"max_adapters must be an int >= 2 (row 0 is the "
+                    f"reserved base identity), got {max_adapters!r}")
+            if not isinstance(adapter_rank, int) \
+                    or isinstance(adapter_rank, bool) or adapter_rank < 1:
+                raise ValueError(
+                    f"adapter_rank must be an int >= 1, got "
+                    f"{adapter_rank!r}")
+        self.max_adapters = max_adapters
+        self.adapter_rank = adapter_rank
         # deterministic fault injection (serving.faults.FaultPlan):
         # scheduled step exceptions / forced page shortages / delays —
         # None in production
@@ -262,12 +303,14 @@ class GenerationRequest:
                  on_token: Optional[Callable[["GenerationRequest", int],
                                              Any]],
                  clock: Callable[[], float],
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 adapter_id: int = 0):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.eos_id = eos_id
+        self.adapter_id = int(adapter_id)
         self.on_token = on_token
         self.tokens: List[int] = []
         self.state = "queued"
@@ -340,6 +383,24 @@ class ServingEngine:
                 "(gpt_decode.QUANTIZED_KV_KERNELS lacks "
                 "'gpt_decode_verify_pages') — refusing rather than "
                 "silently reading quantized rows as values")
+        # multi-tenant adapters: same coverage-assert discipline — every
+        # kernel this engine can dispatch must carry the per-slot
+        # gather-matmul low-rank path (gpt_decode.ADAPTER_KERNELS), or
+        # the combination refuses at construction instead of silently
+        # serving base-model tokens for an adapterized request
+        if serving.max_adapters is not None:
+            needed = {"gpt_prefill_pages", "gpt_decode_chunk_pages"}
+            if serving.speculate_k > 0:
+                needed.add("gpt_decode_verify_pages")
+            if serving.prefill_chunk is not None:
+                needed.add("gpt_prefill_chunk_pages")
+            missing = sorted(needed - set(_gd.ADAPTER_KERNELS))
+            if missing:
+                raise ValueError(
+                    "max_adapters requires the per-slot adapter path in "
+                    f"every dispatched kernel; gpt_decode.ADAPTER_KERNELS "
+                    f"lacks {missing} — refusing rather than silently "
+                    "serving base-model tokens")
         if serving.weight_dtype == "int8":
             params = _gd.quantize_params(params, cfg)
         # whole-model parameter bytes AS SERVED (post-quantization,
@@ -362,6 +423,15 @@ class ServingEngine:
             from ..parallel.plan import ServingTPPlan
             plan = ServingTPPlan(cfg, serving.mesh_shape)
         self.plan = plan
+        # device-resident LoRA pool, allocated AFTER the plan so on a
+        # mesh every A/B stack materializes under its TP sharding
+        # (column projections shard B on the out axis, row projections
+        # shard A on the in axis — plan.adapter_shardings)
+        self.adapters = None
+        if serving.max_adapters is not None:
+            from .adapters import AdapterPool
+            self.adapters = AdapterPool(cfg, serving.max_adapters,
+                                        serving.adapter_rank, plan=plan)
         self.kv = SlotKVCache(cfg, serving.num_slots, max_len, dtype,
                               block_size=serving.block_size,
                               num_blocks=serving.kv_blocks,
@@ -375,7 +445,8 @@ class ServingEngine:
             decode_chunk=serving.decode_chunk, overlap=serving.overlap,
             speculate_k=serving.speculate_k,
             speculate_ngram=serving.speculate_ngram, plan=plan,
-            prefill_chunk=serving.prefill_chunk)
+            prefill_chunk=serving.prefill_chunk,
+            adapters=self.adapters)
         # chunked-prefill telemetry: one counter bump + one latency
         # sample per dispatched chunk (bound through self.metrics at
         # call time, so a bench's metrics reset keeps feeding the
@@ -394,7 +465,8 @@ class ServingEngine:
                                      * serving.decode_chunk
                                      * (1 + serving.speculate_k)),
             speculate_k=serving.speculate_k,
-            dispatch_timing=serving.dispatch_timing)
+            dispatch_timing=serving.dispatch_timing,
+            adapters=self.adapters is not None)
         if serving.dispatch_timing:
             self.scheduler.dispatch_timing = True
             # bound through self.metrics at CALL time so a bench's
@@ -412,6 +484,8 @@ class ServingEngine:
         self.metrics.kv_pool_per_chip_bytes = self.kv.hbm_per_chip_bytes
         self.metrics.kv_dtype_bytes = self.kv.dtype.itemsize
         self.metrics.weight_bytes = self.weight_bytes
+        if self.adapters is not None:
+            self._sync_adapter_metrics()
         self._queue: List[GenerationRequest] = []
         self._pending_cancels: List[GenerationRequest] = []
         # host swap pool: SwappedSequence records of preempted RUNNING
@@ -449,12 +523,24 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                seed: int = 0, eos_id: Optional[int] = None,
-               on_token: Optional[Callable] = None) -> GenerationRequest:
+               on_token: Optional[Callable] = None,
+               adapter_id: int = 0) -> GenerationRequest:
         """Enqueue one generate request. Raises ValueError for requests
-        that can never be served (too long for the buckets/pool) and
-        EngineOverloadError when the queue is full (backpressure: the
-        caller sheds load or retries later; nothing queues unboundedly)."""
+        that can never be served (too long for the buckets/pool,
+        unknown/unresident adapter_id) and EngineOverloadError when the
+        queue is full (backpressure: the caller sheds load or retries
+        later; nothing queues unboundedly). adapter_id pins the named
+        LoRA adapter (uploaded via upload_adapter) for this request's
+        whole lifetime — its pool row cannot be evicted or overwritten
+        until the request finishes, cancels, or migrates away."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        adapter_id = int(adapter_id)
+        if adapter_id < 0:
+            raise ValueError(f"adapter_id must be >= 0, got {adapter_id}")
+        if adapter_id and self.adapters is None:
+            raise ValueError(
+                f"adapter_id {adapter_id} on an engine with no adapter "
+                "pool (ServingConfig(max_adapters=..., adapter_rank=...))")
         if prompt.size < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -479,7 +565,8 @@ class ServingEngine:
             prompt, max_new_tokens, temperature, seed, eos_id, on_token,
             self.config.clock,
             request_id=f"{self.metrics.engine_label}-"
-                       f"{next(self._rid_counter)}")
+                       f"{next(self._rid_counter)}",
+            adapter_id=adapter_id)
         if _TRACER.enabled:  # queue-wait anchor; no clock read when off
             req._submit_ns = time.monotonic_ns()
         rlog = _request_log.get_request_log()
@@ -487,11 +574,20 @@ class ServingEngine:
             rlog.event("submitted", request_id=req.request_id,
                        engine=self.metrics.engine_label,
                        prompt_len=int(prompt.size),
-                       max_new=int(max_new_tokens))
+                       max_new=int(max_new_tokens),
+                       adapter_id=adapter_id)
         with self._lock:
+            # pin the adapter row FIRST: an unknown id is the typed 4xx
+            # (UnknownAdapterError is a ValueError) and must not count
+            # as a submission; once acquired, the row survives every
+            # upload/evict until this request's terminal release
+            if adapter_id:
+                self.adapters.acquire(adapter_id)
             self.metrics.submitted += 1
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.shed += 1
+                if adapter_id:   # release-then-raise: shed pins nothing
+                    self.adapters.release(adapter_id)
                 req.state = "shed"
                 shed_depth = len(self._queue)
                 queued_depth = None
@@ -536,6 +632,12 @@ class ServingEngine:
             req.state = "finished"
             req.metrics.mark_finished()
             self.metrics.record(req.metrics)
+            aid = getattr(req, "adapter_id", 0)
+            if aid and self.adapters is not None:
+                # terminal unpin: the adapter row becomes LRU-evictable
+                # again (lock: submit acquires from client threads)
+                with self._lock:
+                    self.adapters.release(aid)
             rlog = _request_log.get_request_log()
             if rlog is not None:
                 rlog.event(
@@ -657,7 +759,8 @@ class ServingEngine:
             rlog = _request_log.get_request_log()
             if rlog is not None:
                 rlog.event("admitted", request_id=req.request_id,
-                           queue_wait_s=req.metrics.queue_wait)
+                           queue_wait_s=req.metrics.queue_wait,
+                           adapter_id=getattr(req, "adapter_id", 0))
             if _TRACER.enabled and req._submit_ns is not None:
                 # the queue-wait interval only materializes as a span at
                 # admission (submit -> slot), retroactively timed
@@ -672,7 +775,8 @@ class ServingEngine:
                 event = self.scheduler.admit(
                     req, req.prompt, req.max_new_tokens,
                     temperature=req.temperature, seed=req.seed,
-                    eos_id=req.eos_id)
+                    eos_id=req.eos_id,
+                    adapter_id=getattr(req, "adapter_id", 0))
                 assert event is not None  # can_admit checked, same thread
                 if event is not PREFILL_PENDING:
                     self._emit(event)
@@ -721,6 +825,8 @@ class ServingEngine:
         self.metrics.kv_pool_per_chip_bytes = self.kv.hbm_per_chip_bytes
         self.metrics.kv_dtype_bytes = self.kv.dtype.itemsize
         self.metrics.weight_bytes = self.weight_bytes
+        if self.adapters is not None:
+            self._sync_adapter_metrics()
         return emitted
 
     def _admission_feasible(self, req, step_no: int) -> bool:
@@ -750,14 +856,18 @@ class ServingEngine:
             reserved = sum(s.n_blocks for s in self._swapped)
             need = self.kv.blocks_needed(req.prompt,
                                          req.prompt.size
-                                         + req.max_new_tokens)
+                                         + req.max_new_tokens,
+                                         adapter_id=getattr(
+                                             req, "adapter_id", 0))
             if self.kv.blocks_available < reserved + need:
                 return False
             # no slot reservation needed: the resume-first loop at the
             # top of every step hands freed slots to parked sequences
             # BEFORE any admission runs, and the sampler is
             # slot-independent, so resumes take whatever row frees up
-        if self.scheduler.can_admit(req.prompt, req.max_new_tokens):
+        aid = getattr(req, "adapter_id", 0)
+        if self.scheduler.can_admit(req.prompt, req.max_new_tokens,
+                                    adapter_id=aid):
             return True
         if not self.config.preempt or self._swapped:
             # preempting while sequences already wait in the swap pool
@@ -765,7 +875,8 @@ class ServingEngine:
             # always queues
             return False
         while not self.scheduler.can_admit(req.prompt,
-                                           req.max_new_tokens):
+                                           req.max_new_tokens,
+                                           adapter_id=aid):
             if not self._preempt_once(req):
                 return False
         return True
@@ -781,7 +892,9 @@ class ServingEngine:
         # out NOW (and may retire slots — re-check before sacrificing
         # anything)
         self._fence()
-        if self.scheduler.can_admit(req.prompt, req.max_new_tokens):
+        if self.scheduler.can_admit(req.prompt, req.max_new_tokens,
+                                    adapter_id=getattr(
+                                        req, "adapter_id", 0)):
             return True
         slot = self.scheduler.pick_victim(self.config.preempt_policy)
         if slot is None:
@@ -883,13 +996,16 @@ class ServingEngine:
                 sw.req.state = "migrated"
                 ticket = MigrationTicket.from_swapped(
                     sw, self.kv.block_size,
-                    mesh_shape=self.mesh_shape)
+                    mesh_shape=self.mesh_shape,
+                    adapter_digest=self._adapter_digest_for(sw))
+                self._release_migrated(sw)
                 if rlog is not None:
                     rlog.event("migrate_out", request_id=rid,
                                replica=self.metrics.engine_label,
                                phase="parked", blocks=ticket.n_blocks,
                                bytes=ticket.swap_bytes,
-                               produced=ticket.produced)
+                               produced=ticket.produced,
+                               adapter_id=ticket.adapter_id)
                 return ticket
 
         # mid-chunked-prefill: the fill cursor is not ticketable (the
@@ -930,15 +1046,35 @@ class ServingEngine:
         # "preempted" would miscount real preemptions in the summary
         sw = self.scheduler.swap_out(slot, journal=False)
         sw.req.state = "migrated"
-        ticket = MigrationTicket.from_swapped(sw, self.kv.block_size,
-                                              mesh_shape=self.mesh_shape)
+        ticket = MigrationTicket.from_swapped(
+            sw, self.kv.block_size, mesh_shape=self.mesh_shape,
+            adapter_digest=self._adapter_digest_for(sw))
+        self._release_migrated(sw)
         if rlog is not None:
             rlog.event("migrate_out", request_id=rid,
                        replica=self.metrics.engine_label,
                        phase="running", blocks=ticket.n_blocks,
                        bytes=ticket.swap_bytes,
-                       produced=ticket.produced)
+                       produced=ticket.produced,
+                       adapter_id=ticket.adapter_id)
         return ticket
+
+    def _adapter_digest_for(self, sw) -> bytes:
+        """The content digest a migration ticket commits for the
+        sequence's adapter (b"" for the base identity / adapterless) —
+        read BEFORE the refcount release so the row is still pinned."""
+        aid = getattr(sw, "adapter_id", 0)
+        if not aid or self.adapters is None:
+            return b""
+        return self.adapters.digest_of(aid)
+
+    def _release_migrated(self, sw) -> None:
+        """Drop the departing sequence's adapter pin: the ticket now
+        carries (id, digest), and the target re-acquires on adoption."""
+        aid = getattr(sw, "adapter_id", 0)
+        if aid and self.adapters is not None:
+            with self._lock:
+                self.adapters.release(aid)
 
     def migrate_in(self, ticket, on_token: Optional[Callable] = None
                    ) -> GenerationRequest:
@@ -963,11 +1099,18 @@ class ServingEngine:
         if self.faults is not None:
             self.faults.migration_phase("adopt")
         ticket.validate_for(self)
+        aid = getattr(ticket, "adapter_id", 0)
+        if aid:
+            # validate_for proved residency + digest match; pin the row
+            # for the adopted request's lifetime, exactly as submit does
+            with self._lock:
+                self.adapters.acquire(aid)
         req = GenerationRequest(
             ticket.prompt, ticket.max_new, ticket.temperature,
             ticket.seed, ticket.eos_id, on_token, self.config.clock,
             request_id=f"{self.metrics.engine_label}-"
-                       f"{next(self._rid_counter)}")
+                       f"{next(self._rid_counter)}",
+            adapter_id=aid)
         req.tokens = list(ticket.tokens)
         req.state = "running"
         # adoption stamps: queue_wait/ttft on THIS engine measure the
@@ -986,7 +1129,8 @@ class ServingEngine:
                        replica=self.metrics.engine_label,
                        rerouted_from=ticket.request_id,
                        bytes=ticket.swap_bytes,
-                       produced=ticket.produced)
+                       produced=ticket.produced,
+                       adapter_id=aid)
         return req
 
     def _on_dispatch_launched(self) -> None:
@@ -1048,6 +1192,14 @@ class ServingEngine:
                 req.state = "cancelled"
                 self._pending_cancels.append(req)
                 cancelled_from = "running"
+            if cancelled_from is not None:
+                aid = getattr(req, "adapter_id", 0)
+                if aid and self.adapters is not None:
+                    # terminal unpin (safe even with the slot still
+                    # live until the driver's next step: a cancelled
+                    # request's emissions are swallowed, so a row
+                    # reassigned meanwhile only feeds discarded tokens)
+                    self.adapters.release(aid)
         if cancelled_from is None:
             return False
         rlog = _request_log.get_request_log()
@@ -1055,6 +1207,58 @@ class ServingEngine:
             rlog.event("cancelled", request_id=req.request_id,
                        was=cancelled_from, tokens=len(req.tokens))
         return True
+
+    # -- multi-tenant adapters ----------------------------------------------
+
+    def _require_adapters(self):
+        if self.adapters is None:
+            raise ValueError(
+                "this engine has no adapter pool "
+                "(ServingConfig(max_adapters=..., adapter_rank=...))")
+        return self.adapters
+
+    def _sync_adapter_metrics(self) -> None:
+        """Mirror the pool's authoritative host bookkeeping into the
+        registry series (same discipline as the prefix-cache counters:
+        the scrape reads exactly what the allocator knows)."""
+        pool = self.adapters
+        self.metrics.adapters_resident = pool.resident_count
+        self.metrics.adapter_pool_bytes = pool.pool_bytes
+        self.metrics.adapter_uploads = pool.uploads_total
+        self.metrics.adapter_evictions = pool.evictions_total
+
+    def upload_adapter(self, adapter_id: int, weights) -> int:
+        """Install a LoRA adapter's A/B stack under `adapter_id`,
+        validating geometry against the base model and LRU-evicting the
+        oldest unreferenced resident under pressure. Returns the pool
+        row claimed. Typed AdapterError subclasses (all ValueError) on
+        bad geometry, a referenced id, or a pool with every row pinned.
+        Thread-safe against submit/cancel; fixed pool shapes mean zero
+        recompiles — the next dispatch simply reads the new rows."""
+        pool = self._require_adapters()
+        with self._lock:
+            row = pool.upload(adapter_id, weights)
+            self._sync_adapter_metrics()
+        rlog = _request_log.get_request_log()
+        if rlog is not None:   # journal outside the lock (JSONL write)
+            rlog.event("adapter_upload", engine=self.metrics.engine_label,
+                       adapter_id=int(adapter_id), row=row,
+                       resident=pool.resident_count)
+        return row
+
+    def evict_adapter(self, adapter_id: int) -> None:
+        """Explicitly drop a resident adapter, freeing its pool row.
+        AdapterReferencedError while any live request pins it;
+        UnknownAdapterError if it is not resident."""
+        pool = self._require_adapters()
+        with self._lock:
+            pool.evict(adapter_id)
+            self._sync_adapter_metrics()
+        rlog = _request_log.get_request_log()
+        if rlog is not None:
+            rlog.event("adapter_evict", engine=self.metrics.engine_label,
+                       adapter_id=int(adapter_id),
+                       resident=pool.resident_count)
 
     # -- observability ------------------------------------------------------
 
@@ -1085,6 +1289,10 @@ class ServingEngine:
         # host memory the swap pool currently pins (0 when nothing is
         # preempted — the pool exists only under pressure)
         s["swap_pool_bytes"] = sum(sw.swap_bytes for sw in self._swapped)
+        # adapter pool occupancy (multi-tenant serving): resident count,
+        # device bytes the pool pins, cumulative upload/eviction totals
+        if self.adapters is not None:
+            s.update(self.adapters.occupancy())
         s["compiled_executables"] = self.scheduler.compile_count
         # the registry label this engine's serving_* series carry, so a
         # caller can find them in observability.get_registry().snapshot()

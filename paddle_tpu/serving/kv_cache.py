@@ -338,13 +338,22 @@ class SlotKVCache:
 
     # -- hashed prefix cache ------------------------------------------------
 
-    def _chain_digests(self, prompt: np.ndarray, n_full: int):
+    def _chain_digests(self, prompt: np.ndarray, n_full: int,
+                       adapter_id: int = 0):
         """Chained per-block digests: digest[i] commits to the whole
         prefix tokens[0 : (i+1)*block_size], so a hit at block i implies
-        hits at every block before it."""
+        hits at every block before it. The adapter id SALTS the chain
+        seed: a prefix computed under LoRA adapter k holds different
+        K/V content than the same tokens under the base model (or any
+        other adapter), so cross-adapter sharing would be silent output
+        corruption. adapter_id=0 seeds with the legacy empty chain, so
+        an adapterless engine's digests — and its cross-request sharing
+        — are byte-identical to pre-adapter builds."""
         bs = self.block_size
         data = np.ascontiguousarray(prompt[:n_full * bs], np.int32)
         digests, h = [], b""
+        if adapter_id:
+            h = np.int64(adapter_id).tobytes()
         for i in range(n_full):
             h = hashlib.blake2b(
                 h + data[i * bs:(i + 1) * bs].tobytes(),
@@ -353,7 +362,7 @@ class SlotKVCache:
         return digests
 
     def _plan(self, prompt: np.ndarray,
-              total_positions: int
+              total_positions: int, adapter_id: int = 0
               ) -> Tuple[list, List[int], int, int, bool]:
         """The admission plan, computed WITHOUT mutating anything:
         (digests of registerable full blocks, hit block ids, count of
@@ -364,7 +373,7 @@ class SlotKVCache:
         per (prompt, total) until the next allocator mutation — the
         can_map() check and the map_slot() that follows share one
         digest walk."""
-        key = (prompt.tobytes(), int(total_positions))
+        key = (prompt.tobytes(), int(total_positions), int(adapter_id))
         if self._plan_cache is not None:
             gen, k, plan = self._plan_cache
             if gen == self._plan_gen and k == key:
@@ -374,7 +383,8 @@ class SlotKVCache:
         # shareable: full blocks strictly before position p_len-1 (the
         # suffix prefill always recomputes the last prompt position)
         shareable = (p_len - 1) // self.block_size
-        digests = self._chain_digests(prompt, p_len // self.block_size) \
+        digests = self._chain_digests(prompt, p_len // self.block_size,
+                                      adapter_id) \
             if self.prefix_cache_enabled else []
         hit_blocks: List[int] = []
         lru_hits = 0
@@ -392,15 +402,16 @@ class SlotKVCache:
         self._plan_cache = (self._plan_gen, key, plan)
         return plan
 
-    def can_map(self, prompt: np.ndarray, total_positions: int) -> bool:
+    def can_map(self, prompt: np.ndarray, total_positions: int,
+                adapter_id: int = 0) -> bool:
         """Feasibility of map_slot() RIGHT NOW, without mutating any
         allocator state — the engine's pages-aware admission check
         (stamp/count a request as admitted only when it will fit)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        return self._plan(prompt, total_positions)[4]
+        return self._plan(prompt, total_positions, adapter_id)[4]
 
     def blocks_needed(self, prompt: np.ndarray,
-                      total_positions: int) -> int:
+                      total_positions: int, adapter_id: int = 0) -> int:
         """Blocks a map_slot() of this request would actually CONSUME
         from blocks_available RIGHT NOW: fresh pages (total minus
         prefix-cache hits) PLUS the hit blocks currently sitting in
@@ -415,12 +426,13 @@ class SlotKVCache:
         starve admission at a near-full arena."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         _, hit_blocks, lru_hits, total_blocks, _ = \
-            self._plan(prompt, total_positions)
+            self._plan(prompt, total_positions, adapter_id)
         return total_blocks - len(hit_blocks) + lru_hits
 
     def map_slot(self, slot: int, prompt: np.ndarray,
                  total_positions: int,
-                 register: bool = True) -> Optional[Tuple[np.ndarray, int]]:
+                 register: bool = True,
+                 adapter_id: int = 0) -> Optional[Tuple[np.ndarray, int]]:
         """Map the pages a request needs into `slot`'s page row.
 
         prompt: the request's token ids; total_positions: p_len +
@@ -458,7 +470,7 @@ class SlotKVCache:
                 f"({total_positions})")
         bs = self.block_size
         digests, claimed, _lru_hits, total_blocks, feasible = \
-            self._plan(prompt, total_positions)
+            self._plan(prompt, total_positions, adapter_id)
         if not feasible:
             return None
         for b in claimed:

@@ -227,6 +227,28 @@ _TIMING_HELP = {
                        "its result (un-hidden device execution)",
 }
 
+# multi-tenant adapter pool series (ServingConfig(max_adapters=...)
+# engines only — the adapterless default must add ZERO registry
+# families/series, same discipline as the dispatch-timing pair): the
+# resident count / device bytes the pool pins, and the cumulative
+# upload/eviction totals mirrored from the pool's host bookkeeping.
+_ADAPTER_COUNTERS = ("adapter_uploads", "adapter_evictions")
+_ADAPTER_GAUGES = ("adapters_resident", "adapter_pool_bytes")
+_ADAPTER_HELP = {
+    "adapter_uploads": "LoRA adapter uploads installed into the "
+                       "device pool (re-uploads of a resident id "
+                       "included)",
+    "adapter_evictions": "LoRA adapters dropped from the pool "
+                         "(explicit evicts + LRU evictions under "
+                         "upload pressure)",
+    "adapters_resident": "uploaded LoRA adapters currently resident "
+                         "in the device pool (the reserved base "
+                         "identity row excluded)",
+    "adapter_pool_bytes": "device bytes the LoRA A/B pool pins "
+                          "(constant for the engine's life — the "
+                          "pool is allocated whole at construction)",
+}
+
 def _count_buckets(upper: int):
     """Power-of-two count-histogram bounds covering [1, upper] — the
     scale-free grid for "how many per dispatch" distributions."""
@@ -263,7 +285,8 @@ class EngineMetrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  engine_label: Optional[str] = None,
                  max_tokens_per_dispatch: Optional[int] = None,
-                 speculate_k: int = 0, dispatch_timing: bool = False):
+                 speculate_k: int = 0, dispatch_timing: bool = False,
+                 adapters: bool = False):
         self._registry = registry or get_registry()
         self.engine_label = str(engine_label if engine_label is not None
                                 else next(EngineMetrics._ids))
@@ -275,6 +298,7 @@ class EngineMetrics:
                                         else None)
         self.speculate_k = int(speculate_k)
         self.dispatch_timing = bool(dispatch_timing)
+        self.adapters = bool(adapters)
         label = {"engine": self.engine_label}
         self._families = []
         self._series = {}
@@ -319,6 +343,19 @@ class EngineMetrics:
                 fam = self._registry.histogram(full, _TIMING_HELP[key])
                 self._families.append(fam)
                 self._hists[key] = fam.labels(**label)
+        if self.adapters:
+            # adapter pool series, registered ONLY for pool-carrying
+            # engines — the adapterless family set is pinned unchanged
+            for name in _ADAPTER_COUNTERS:
+                fam = self._registry.counter(
+                    f"serving_{name}_total", _ADAPTER_HELP[name])
+                self._families.append(fam)
+                self._series[name] = fam.labels(**label)
+            for name in _ADAPTER_GAUGES:
+                fam = self._registry.gauge(
+                    f"serving_{name}", _ADAPTER_HELP[name])
+                self._families.append(fam)
+                self._series[name] = fam.labels(**label)
 
     def unregister(self) -> None:
         """Remove this engine's labeled series from the registry so a
@@ -385,6 +422,9 @@ class EngineMetrics:
         out: Dict[str, Optional[float]] = {}
         for name in _COUNTERS + _GAUGES:
             out[name] = int(self._series[name].value)
+        for name in _ADAPTER_COUNTERS + _ADAPTER_GAUGES:
+            if name in self._series:   # pool-carrying engines only
+                out[name] = int(self._series[name].value)
         for key, h in self._hists.items():
             out[f"mean_{key}"] = h.mean
             out[f"p50_{key}"] = h.quantile(0.5)
@@ -404,4 +444,11 @@ def _make_prop(name: str, doc: str) -> property:
 
 for _name in _COUNTERS + _GAUGES:
     setattr(EngineMetrics, _name, _make_prop(_name, _HELP[_name]))
+del _name
+
+# adapter properties exist on every instance; the backing series only
+# when the engine was built with adapters=True (the engine guards every
+# access behind its pool being non-None)
+for _name in _ADAPTER_COUNTERS + _ADAPTER_GAUGES:
+    setattr(EngineMetrics, _name, _make_prop(_name, _ADAPTER_HELP[_name]))
 del _name

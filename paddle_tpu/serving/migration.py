@@ -72,7 +72,13 @@ __all__ = ["MigrationTicket", "MigrationError", "TicketError",
 # ticket meeting new code — a rolling upgrade with old- and new-code
 # replicas coexisting — refuses as a typed VERSION mismatch instead of
 # being misdiagnosed as payload corruption by the checksum compare.
-TICKET_VERSION = 2
+# version 3: the digest grew the sequence's adapter identity —
+# (adapter_id, content digest of the adapter's A/B bytes at extraction)
+# — so a multi-tenant sequence can never silently resume against the
+# WRONG adapter (a different tenant's weights under a recycled id, or
+# the base model on a pool that never saw the upload). Same rolling-
+# upgrade rationale for the bump.
+TICKET_VERSION = 3
 
 
 class MigrationError(RuntimeError):
@@ -106,7 +112,7 @@ class MigrationTicket:
         # sequence state (SwappedSequence minus the engine-bound req)
         "pos", "produced", "seq", "length", "n_blocks", "block_size",
         "payload", "token", "ts", "remaining", "temp", "eos", "key_row",
-        "spec", "mesh_shape", "scales",
+        "spec", "mesh_shape", "scales", "adapter_id", "adapter_digest",
     )
 
     def __init__(self, prompt, max_new, temperature, seed, eos_id,
@@ -115,7 +121,7 @@ class MigrationTicket:
                  temp, eos, key_row, spec=None, tenant=None,
                  rerouted_from=(), slo_stamps=None, version=None,
                  checksum=None, created_unix=None, mesh_shape=(1,),
-                 scales=None):
+                 scales=None, adapter_id=0, adapter_digest=b""):
         self.version = TICKET_VERSION if version is None else int(version)
         self.created_unix = time.time() if created_unix is None \
             else float(created_unix)
@@ -158,13 +164,22 @@ class MigrationTicket:
         # chip; the field exists for the journal and for operators
         # tracing which mesh a sequence came off.
         self.mesh_shape = tuple(int(m) for m in mesh_shape)
+        # multi-tenant adapter identity: the logical id the sequence was
+        # decoding under (0 = base model) plus the CONTENT digest of the
+        # adapter's A/B bytes at extraction (AdapterPool.digest_of, b""
+        # for the identity). Both inside the checksum — adapter identity
+        # is sequence state, not an annotation: resuming a stream under
+        # different low-rank weights changes every subsequent token.
+        self.adapter_id = int(adapter_id)
+        self.adapter_digest = bytes(adapter_digest)
         self.checksum = self._digest() if checksum is None else checksum
 
     # -- construction ---------------------------------------------------------
 
     @classmethod
     def from_swapped(cls, sw, block_size: int,
-                     mesh_shape=(1,)) -> "MigrationTicket":
+                     mesh_shape=(1,),
+                     adapter_digest=b"") -> "MigrationTicket":
         """Wrap a SwappedSequence (engine swap-pool record) into a
         portable ticket. `sw.req` stays behind on the source — the
         ticket carries its parameters and emitted prefix instead.
@@ -182,7 +197,9 @@ class MigrationTicket:
             block_size=block_size, payload=sw.payload,
             token=sw.token, ts=sw.ts, remaining=sw.remaining,
             temp=sw.temp, eos=sw.eos, key_row=sw.key_row, spec=sw.spec,
-            mesh_shape=mesh_shape, scales=sw.scales)
+            mesh_shape=mesh_shape, scales=sw.scales,
+            adapter_id=getattr(sw, "adapter_id", 0),
+            adapter_digest=adapter_digest)
 
     # -- integrity ------------------------------------------------------------
 
@@ -234,6 +251,16 @@ class MigrationTicket:
         if self.spec is not None:
             for row in self.spec:
                 h.update(np.ascontiguousarray(np.asarray(row)).tobytes())
+        # adapter identity: the id AND the adapter-content digest commit
+        # (presence-byte pattern, like the scale plane) — a tampered id
+        # or a swapped-out adapter body both surface as checksum
+        # mismatch, before the digest-vs-target-pool compare even runs
+        h.update(np.int64(self.adapter_id).tobytes())
+        if self.adapter_digest:
+            h.update(b"\x01")
+            h.update(self.adapter_digest)
+        else:
+            h.update(b"\x00")
         return h.hexdigest()
 
     def verify(self) -> bool:
@@ -339,6 +366,31 @@ class MigrationTicket:
             raise TicketError(
                 f"sequence needs {kv.blocks_for(total)} KV blocks but "
                 f"the engine arena only has {kv.blocks_total}")
+        if self.adapter_id:
+            # adapter-aware adoption: the target must HOLD the same
+            # adapter — same logical id, same bytes (content digest) —
+            # before the sequence may resume under it. Typed refusals
+            # for each failure mode; the router's compatible() pre-
+            # screen runs these too (digest compare is 16 bytes, not a
+            # payload walk).
+            pool = getattr(engine, "adapters", None)
+            if pool is None:
+                raise TicketError(
+                    f"sequence decodes under adapter {self.adapter_id} "
+                    "but the target engine has no adapter pool "
+                    "(ServingConfig(max_adapters=...))")
+            if not pool.is_resident(self.adapter_id):
+                raise TicketError(
+                    f"adapter {self.adapter_id} is not resident on the "
+                    f"target pool (resident: {list(pool.resident)}) — "
+                    "upload it there before migrating the sequence")
+            if pool.digest_of(self.adapter_id) != self.adapter_digest:
+                raise TicketError(
+                    f"adapter {self.adapter_id} content mismatch: the "
+                    "target pool holds DIFFERENT bytes under this id "
+                    "than the sequence was decoding against — refusing "
+                    "rather than silently switching the stream's "
+                    "low-rank weights")
         k = engine.config.speculate_k
         if bool(k) != (self.spec is not None):
             raise TicketError(
@@ -376,7 +428,8 @@ class MigrationTicket:
             req, self.pos, self.produced, self.max_new, self.eos_id,
             self.seq, self.length, self.n_blocks, self.payload,
             self.token, self.ts, self.remaining, self.temp, self.eos,
-            self.key_row, self.spec, scales=self.scales)
+            self.key_row, self.spec, scales=self.scales,
+            adapter_id=self.adapter_id)
 
     def describe(self) -> Dict[str, Any]:
         """Journal/debug summary (no payload bytes)."""
@@ -385,6 +438,7 @@ class MigrationTicket:
                 "produced": self.produced, "max_new": self.max_new,
                 "n_blocks": self.n_blocks, "bytes": self.swap_bytes,
                 "kv_dtype": str(self.payload.dtype),
+                "adapter_id": self.adapter_id,
                 "mesh_shape": list(self.mesh_shape),
                 "rerouted_from": list(self.rerouted_from),
                 "checksum": self.checksum}

@@ -140,6 +140,23 @@ froze at. `_needs_dispatch` keeps using `chunk` as each in-flight
 dispatch's GUARANTEED token floor — acceptance only over-delivers, so
 the 1/chunk steady-state dispatch bound is preserved and the only cost
 of a lucky streak is one EOS-style overshoot dispatch at the tail.
+
+MULTI-TENANT ADAPTERS (adapters=AdapterPool): co-batched slots each hit
+a DIFFERENT LoRA adapter inside the same fused dispatch. A per-slot
+adapter-ROW vector rides as the LAST element of the donated decode
+carry (row 0 = base identity), and the pool pytree is passed into every
+jitted entry point as a READ-ONLY extra argument — never donated, never
+closed over (a closure would bake the traced value in as a constant and
+uploads would be silently ignored), so an upload is a pure value update
+at fixed shape: zero recompiles, compile count unchanged. The kernels
+gather A/B rows by the carry vector and add the fp32 low-rank delta to
+the base projections (gpt_decode._dense_a); slots on adapter 0 SELECT
+the untouched base activation, which is what makes adapter_id=0 streams
+bit-identical to an adapterless engine. Host records carry the LOGICAL
+adapter id (the pool row is re-resolved at swap-in/migration — rows of
+referenced adapters cannot be reassigned while any record holds them,
+the pool's refcount rule). With adapters=None, every impl builds
+EXACTLY the pre-adapter graph.
 """
 
 from __future__ import annotations
@@ -180,9 +197,10 @@ class _Running:
     reset in-graph at admission."""
 
     __slots__ = ("req", "pos", "produced", "max_new", "eos_id",
-                 "live_from", "seq")
+                 "live_from", "seq", "adapter_id")
 
-    def __init__(self, req, pos, max_new, eos_id, live_from, seq=0):
+    def __init__(self, req, pos, max_new, eos_id, live_from, seq=0,
+                 adapter_id=0):
         self.req = req
         self.pos = pos                    # absolute position fed next
         self.produced = 1                 # prefill already sampled one
@@ -192,6 +210,7 @@ class _Running:
         self.seq = seq                    # admission order (preemption
         #                                   policies key on it; preserved
         #                                   across swap-out/swap-in)
+        self.adapter_id = adapter_id      # LOGICAL adapter id (0 = base)
 
 
 class _Prefill:
@@ -205,10 +224,10 @@ class _Prefill:
 
     __slots__ = ("req", "suffix", "start", "cursor", "p_len", "max_new",
                  "temperature", "seed", "eos_id", "pages", "seq",
-                 "chunk_index", "prev_tok")
+                 "chunk_index", "prev_tok", "adapter_id")
 
     def __init__(self, req, suffix, start, p_len, max_new, temperature,
-                 seed, eos_id, pages, seq, prev_tok):
+                 seed, eos_id, pages, seq, prev_tok, adapter_id=0):
         self.req = req
         self.suffix = suffix              # (suffix_len,) int32 host copy
         self.start = start                # pfx_len at admission
@@ -222,6 +241,7 @@ class _Prefill:
         self.seq = seq                    # admission order
         self.chunk_index = 0              # next chunk's journal index
         self.prev_tok = prev_tok          # prompt[-1], the drafter seed
+        self.adapter_id = adapter_id      # LOGICAL adapter id (0 = base)
 
 
 class SwappedSequence:
@@ -235,11 +255,11 @@ class SwappedSequence:
     __slots__ = ("req", "pos", "produced", "max_new", "eos_id",
                  "seq", "length", "n_blocks", "payload", "token", "ts",
                  "remaining", "temp", "eos", "key_row", "spec",
-                 "scales")
+                 "scales", "adapter_id")
 
     def __init__(self, req, pos, produced, max_new, eos_id, seq,
                  length, n_blocks, payload, token, ts, remaining, temp,
-                 eos, key_row, spec=None, scales=None):
+                 eos, key_row, spec=None, scales=None, adapter_id=0):
         self.req = req
         self.pos = pos
         self.produced = produced
@@ -260,6 +280,9 @@ class SwappedSequence:
         #                                   scale-plane rows of payload
         #                                   (L, 2, P, heads, bs); None
         #                                   on a full-precision pool
+        self.adapter_id = adapter_id      # LOGICAL adapter id (0 =
+        #                                   base); the pool row is
+        #                                   re-resolved at swap-in
 
     @property
     def swap_bytes(self) -> int:
@@ -290,7 +313,8 @@ class ContinuousBatchingScheduler:
                  top_k: int = 0, decode_chunk: int = 8,
                  overlap: bool = True, speculate_k: int = 0,
                  speculate_ngram: int = 512, plan=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 adapters=None):
         import jax
 
         if int(decode_chunk) < 1:
@@ -340,6 +364,11 @@ class ContinuousBatchingScheduler:
         # prefill token budget AND the per-dispatch chunk ceiling
         self.prefill_chunk = int(prefill_chunk) \
             if prefill_chunk is not None else None
+        # multi-tenant LoRA pool (serving.adapters.AdapterPool) or None.
+        # The pool pytree is read fresh from self.adapters.pool at every
+        # dispatch and passed AS AN ARGUMENT — see the module docstring
+        # for why it is never donated and never closed over.
+        self.adapters = adapters
         # slots mid-chunked-prefill (slot -> _Prefill); driver-thread
         # state like _running, advanced one budget of chunks per tick
         self._prefilling: Dict[int, _Prefill] = {}
@@ -458,6 +487,12 @@ class ContinuousBatchingScheduler:
                 jnp.zeros((s_dim,), jnp.int32),          # prev
                 jnp.full((s_dim, self.speculate_ngram + 1), -1,
                          jnp.int32))                     # ngram table
+        adapters_on = self.adapters is not None
+        if adapters_on:
+            # per-slot adapter POOL ROW vector, ALWAYS the last carry
+            # element (spec rows, if any, keep indices 6/7): row 0 is
+            # the base identity, so zeros mean "no adapter" everywhere
+            self._state += (jnp.zeros((s_dim,), jnp.int32),)
 
         # device page table: every row scratch until its slot admits
         self._pt = jnp.zeros((s_dim, self.kv.max_pages), jnp.int32)
@@ -479,12 +514,19 @@ class ContinuousBatchingScheduler:
             c_rep = self.plan.constrain_rep
             arena_con = self.plan.constrain_arena
 
+        # adapter extras ride VARARGS tails: adapterless callers pass
+        # nothing, so the traced adapterless graphs are argument-for-
+        # argument the pre-adapter ones (the identity pin's strongest
+        # form), and donate_argnums positions never shift. With
+        # adapters on, prefill gets (pool, scalar row), chunk gets
+        # (pool,) — the per-slot row vector is already in the carry.
         def prefill_impl(params, arena, pt, state, tokens, pfx_len,
-                         real_len, pages, slot):
+                         real_len, pages, slot, *alo):
             self._compile_events.append(f"prefill:L{tokens.shape[1]}")
             logits, arena = gd.gpt_prefill_pages(
                 params, self.cfg, tokens, pfx_len, real_len, arena,
-                pages)
+                pages, adapters=alo[0] if alo else None,
+                adapter_id=alo[1] if alo else None)
             pt = pt.at[slot].set(pages)
             if self.speculate_k:
                 # slot reuse hygiene: wipe the previous occupant's
@@ -492,12 +534,12 @@ class ContinuousBatchingScheduler:
                 # prefix-cache hit the hit blocks' tokens aren't here —
                 # seeding is best-effort; drafts are always verified)
                 state = state[:7] + (gd.spec_ngram_seed(
-                    state[7], slot, tokens[0], real_len),)
+                    state[7], slot, tokens[0], real_len),) + state[8:]
             return (c_rep(logits[0]), c_arena(arena), c_rep(pt),
                     c_rep(state))
 
         def prefill_chunk_impl(params, arena, pt, state, tokens,
-                               start_pos, real_len, pages, slot):
+                               start_pos, real_len, pages, slot, *alo):
             # chunked prefill: per-position math shared with
             # prefill_impl (gpt_prefill_chunk_pages rides the same
             # body), start_pos is the host-carried fill cursor. The
@@ -507,7 +549,8 @@ class ContinuousBatchingScheduler:
                 f"prefill_chunk:L{tokens.shape[1]}")
             logits, arena = gd.gpt_prefill_chunk_pages(
                 params, self.cfg, tokens, start_pos, real_len, arena,
-                pages)
+                pages, adapters=alo[0] if alo else None,
+                adapter_id=alo[1] if alo else None)
             pt = pt.at[slot].set(pages)
             if self.speculate_k:
                 # same slot-reuse hygiene as monolithic prefill; the
@@ -515,12 +558,12 @@ class ContinuousBatchingScheduler:
                 # prompts (drafts are always verified — the stream is a
                 # pure function of the sampler chain, never the table)
                 state = state[:7] + (gd.spec_ngram_seed(
-                    state[7], slot, tokens[0], real_len),)
+                    state[7], slot, tokens[0], real_len),) + state[8:]
             return (c_rep(logits[0]), c_arena(arena), c_rep(pt),
                     c_rep(state))
 
         def admit_impl(keys, state, slot, seed, logits, temp, pos,
-                       max_new, eos_id, prev_tok):
+                       max_new, eos_id, prev_tok, *aid):
             self._compile_events.append("admit_sample")
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
             keys = keys.at[slot].set(gd.sample_key(seed))
@@ -540,11 +583,18 @@ class ContinuousBatchingScheduler:
                 # sampled token); the table row was seeded at prefill
                 new_state += (state[6].at[slot].set(prev_tok),
                               state[7])
+            if aid:
+                # stamp this slot's adapter POOL ROW into the carry —
+                # from the next chunk on, the gather path serves it
+                new_state += (state[-1].at[slot].set(aid[0]),)
             return c_rep(first), c_rep(keys), c_rep(new_state)
 
-        def chunk_impl(params, arena, pt, keys, state):
+        def chunk_impl(params, arena, pt, keys, state, *apool):
             self._compile_events.append("decode_chunk")
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
+            ad = apool[0] if apool else None
+            aids = state[-1] if apool else None
+            tail = (state[-1],) if apool else ()
             if self.speculate_k:
                 (block, counts, tokens, arena, ts, keys, done,
                  remaining, spec) = gd.gpt_decode_chunk_pages(
@@ -553,20 +603,22 @@ class ContinuousBatchingScheduler:
                     sample_fn=self._sample_row,
                     speculate_k=self.speculate_k,
                     spec_state=(state[6], state[7]),
-                    arena_constraint=arena_con)
+                    arena_constraint=arena_con,
+                    adapters=ad, adapter_ids=aids)
                 return (c_rep((block, counts)), c_arena(arena),
                         c_rep(keys),
                         c_rep((tokens, ts, done, remaining, temps,
-                               eos_ids) + spec))
+                               eos_ids) + spec + tail))
             block, tokens, arena, ts, keys, done, remaining = \
                 gd.gpt_decode_chunk_pages(
                     params, self.cfg, tokens, arena, pt, ts, keys,
                     temps, done, remaining, eos_ids, self.decode_chunk,
                     sample_fn=self._sample_row,
-                    arena_constraint=arena_con)
+                    arena_constraint=arena_con,
+                    adapters=ad, adapter_ids=aids)
             return (c_rep(block), c_arena(arena), c_rep(keys),
                     c_rep((tokens, ts, done, remaining, temps,
-                           eos_ids)))
+                           eos_ids) + tail))
 
         def release_impl(pt, state, slot):
             # cancel path: the host verdict the in-graph done mask can't
@@ -610,7 +662,10 @@ class ContinuousBatchingScheduler:
             return (c_payload(payload),) + c_rep(rows)
 
         def swapin_impl(arena, pt, keys, state, payload, blocks, slot,
-                        token, ts_v, rem, temp, eos, key_row, *spec_rows):
+                        token, ts_v, rem, temp, eos, key_row, *extra):
+            # extra = spec rows (prev, ngram) when speculating, then the
+            # adapter pool row when adapters are on — same varargs-tail
+            # convention as the other impls
             # host-swap restore: scatter the payload back through the
             # freshly adopted page row (padding lanes land in scratch,
             # the trash lane) and rebuild the slot's decode-carry rows
@@ -635,8 +690,10 @@ class ContinuousBatchingScheduler:
                          eos_ids.at[slot].set(eos))
             if self.speculate_k:
                 prev, table = state[6], state[7]
-                new_state += (prev.at[slot].set(spec_rows[0]),
-                              table.at[slot].set(spec_rows[1]))
+                new_state += (prev.at[slot].set(extra[0]),
+                              table.at[slot].set(extra[1]))
+            if adapters_on:
+                new_state += (state[-1].at[slot].set(extra[-1]),)
             return (c_arena(arena), c_rep(pt), c_rep(keys),
                     c_rep(new_state))
 
@@ -698,7 +755,23 @@ class ContinuousBatchingScheduler:
             buf = self._staging[bucket] = np.zeros((1, bucket), np.int32)
         return buf
 
-    def can_admit(self, prompt: np.ndarray, max_new: int) -> bool:
+    def _adapter_args(self, adapter_id: int) -> tuple:
+        """The varargs tail the prefill entry points take: (pool pytree,
+        scalar pool ROW) with adapters on, () adapterless — so the
+        adapterless dispatches are argument-for-argument the pre-adapter
+        calls. The pool is read FRESH from the AdapterPool here (never
+        cached) so uploads between dispatches are always visible."""
+        if self.adapters is None:
+            if adapter_id:
+                raise ValueError(
+                    f"adapter_id {adapter_id} on an engine with no "
+                    "adapter pool (ServingConfig(max_adapters=...))")
+            return ()
+        return (self.adapters.pool,
+                np.int32(self.adapters.row_of(adapter_id)))
+
+    def can_admit(self, prompt: np.ndarray, max_new: int,
+                  adapter_id: int = 0) -> bool:
         """True when admit() would succeed RIGHT NOW: a page-table row
         is free and the arena can supply the pages the request needs
         (prefix-cache hits counted, LRU blocks evictable). Only valid
@@ -707,11 +780,13 @@ class ContinuousBatchingScheduler:
         if self.kv.free_count < 1:
             return False
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        return self.kv.can_map(prompt, prompt.size + int(max_new))
+        return self.kv.can_map(prompt, prompt.size + int(max_new),
+                               adapter_id=adapter_id)
 
     def admit(self, req, prompt: np.ndarray, max_new: int,
               temperature: float = 0.0, seed: int = 0,
-              eos_id: Optional[int] = None) -> Optional[SequenceEvent]:
+              eos_id: Optional[int] = None,
+              adapter_id: int = 0) -> Optional[SequenceEvent]:
         """Claim a slot, map the pages the request needs (hash-hit
         prefix blocks shared in, refcounted), prefill the prompt SUFFIX
         into the fresh blocks (padded to its shape bucket), sample the
@@ -741,7 +816,8 @@ class ContinuousBatchingScheduler:
         prompt = np.asarray(prompt, np.int32).reshape(1, -1)
         p_len = prompt.shape[1]
         mapped = self.kv.map_slot(slot, prompt[0], p_len + int(max_new),
-                                  register=self.prefill_chunk is None)
+                                  register=self.prefill_chunk is None,
+                                  adapter_id=adapter_id)
         if mapped is None:
             self.kv.free(slot)           # page shortage: slot untouched
             return None
@@ -751,7 +827,7 @@ class ContinuousBatchingScheduler:
                 req, np.ascontiguousarray(prompt[0, pfx_len:]),
                 int(pfx_len), p_len, int(max_new), float(temperature),
                 int(seed), eos_id, pages, self._admit_counter,
-                int(prompt[0, -1]))
+                int(prompt[0, -1]), adapter_id=adapter_id)
             self._admit_counter += 1
             return PREFILL_PENDING
         suffix_len = p_len - pfx_len
@@ -768,11 +844,12 @@ class ContinuousBatchingScheduler:
                 self._prefill_jit(
                     self.params, self.kv.arena, self._pt, self._state,
                     padded, np.int32(pfx_len), np.int32(suffix_len),
-                    pages, np.int32(slot))
+                    pages, np.int32(slot), *self._adapter_args(adapter_id))
             self.kv.store_arena(arena)
         event = self._sample_first(
             slot, req, logits, p_len, max_new, temperature, seed,
-            eos_id, int(prompt[0, -1]), self._admit_counter)
+            eos_id, int(prompt[0, -1]), self._admit_counter,
+            adapter_id=adapter_id)
         self._admit_counter += 1
         rlog = _request_log.get_request_log()
         if rlog is not None:
@@ -784,22 +861,25 @@ class ContinuousBatchingScheduler:
 
     def _sample_first(self, slot, req, logits, p_len, max_new,
                       temperature, seed, eos_id, prev_tok,
-                      seq) -> SequenceEvent:
+                      seq, adapter_id=0) -> SequenceEvent:
         """Sample the first token from last-position prefill logits and
         promote the slot to _running — the shared tail of monolithic
         admit() and the final prefill chunk (_prefill_step). ONE body
         so first-token finish semantics can never diverge between the
         two paths (the chunked-streams-identical contract depends on
         it)."""
+        aid_row = () if self.adapters is None \
+            else (np.int32(self.adapters.row_of(adapter_id)),)
         first, self._keys, self._state = self._admit_jit(
             self._keys, self._state, np.int32(slot), np.int32(seed),
             logits, np.float32(temperature), np.int32(p_len),
             np.int32(max_new),
             np.int32(-1 if eos_id is None else eos_id),
-            np.int32(prev_tok))
+            np.int32(prev_tok), *aid_row)
         first = int(first)
         st = _Running(req, pos=p_len, max_new=max_new, eos_id=eos_id,
-                      live_from=self._launches, seq=seq)
+                      live_from=self._launches, seq=seq,
+                      adapter_id=adapter_id)
         finished = (st.produced >= max_new
                     or (eos_id is not None and first == eos_id))
         if finished:
@@ -859,7 +939,7 @@ class ContinuousBatchingScheduler:
                 self._prefill_chunk_jit(
                     self.params, self.kv.arena, self._pt, self._state,
                     padded, np.int32(start), np.int32(n), pf.pages,
-                    np.int32(slot))
+                    np.int32(slot), *self._adapter_args(pf.adapter_id))
             self.kv.store_arena(arena)
         pf.cursor += n
         # publish this prompt's full blocks whose fill is now enqueued:
@@ -884,7 +964,8 @@ class ContinuousBatchingScheduler:
         del self._prefilling[slot]
         return self._sample_first(
             slot, pf.req, logits, pf.p_len, pf.max_new, pf.temperature,
-            pf.seed, pf.eos_id, pf.prev_tok, pf.seq)
+            pf.seed, pf.eos_id, pf.prev_tok, pf.seq,
+            adapter_id=pf.adapter_id)
 
     def step(self) -> List[SequenceEvent]:
         """One pipeline tick: launch the next chunk dispatch over the
@@ -939,9 +1020,11 @@ class ContinuousBatchingScheduler:
                                   slots=self.kv.num_slots,
                                   chunk=self.decode_chunk,
                                   index=self._launches):
+            apool = () if self.adapters is None \
+                else (self.adapters.pool,)
             block, arena, self._keys, self._state = self._chunk_jit(
                 self.params, self.kv.arena, self._pt, self._keys,
-                self._state)
+                self._state, *apool)
             self.kv.store_arena(arena)
         host_s = (time.perf_counter() - host_t0) if self.dispatch_timing \
             else 0.0
@@ -1189,7 +1272,7 @@ class ContinuousBatchingScheduler:
             st.req, st.pos, st.produced, st.max_new, st.eos_id,
             st.seq, self.kv.length(slot), n_blocks, payload,
             token, ts, rem, temp, eos, np.asarray(key_row), spec,
-            scales=scales)
+            scales=scales, adapter_id=st.adapter_id)
         self._pt, self._state = self._release_jit(
             self._pt, self._state, np.int32(slot))
         self.kv.free(slot)
@@ -1258,12 +1341,19 @@ class ContinuousBatchingScheduler:
                 sw.remaining, sw.temp, sw.eos, sw.key_row]
         if self.speculate_k:
             args += [sw.spec[0], sw.spec[1]]
+        if self.adapters is not None:
+            # re-resolve the pool ROW at resume: the engine holds the
+            # id's refcount across the park, so the row cannot have
+            # been reassigned — but it IS a lookup, never a stale copy
+            args += [np.int32(self.adapters.row_of(
+                getattr(sw, "adapter_id", 0)))]
         arena, self._pt, self._keys, self._state = \
             self._swapin_jit(*args)
         self.kv.store_arena(arena)
         st = _Running(sw.req, pos=sw.pos, max_new=sw.max_new,
                       eos_id=sw.eos_id, live_from=self._launches,
-                      seq=sw.seq)
+                      seq=sw.seq,
+                      adapter_id=getattr(sw, "adapter_id", 0))
         st.produced = sw.produced
         self._running[slot] = st
         rlog = _request_log.get_request_log()

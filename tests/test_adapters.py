@@ -1,0 +1,563 @@
+"""Multi-tenant LoRA adapter serving (paddle_tpu.serving.adapters +
+the per-slot batched gather-matmul in models/gpt_decode's fused
+kernels).
+
+Pins the subsystem's four contracts: (1) IDENTITY — adapter_id=0
+streams are bit-identical to an adapterless engine (not merely close)
+across greedy/seeded x speculate_k {0,4} x kv_dtype {fp32,int8} and
+through preempt/resume and migration; (2) ISOLATION — >=3 distinct
+adapters co-batched through slot churn each reproduce their dedicated
+single-adapter engine's streams bit-for-bit, greedy AND seeded, with
+compile count still O(buckets)+admit+1; (3) POOL DISCIPLINE — uploads
+are geometry-validated, rows are refcount+LRU managed exactly like KV
+blocks (evict/re-upload refused while referenced, LRU eviction only of
+unreferenced rows, pool-full is typed), and an unknown adapter id is a
+typed 4xx-able error at every door; (4) PORTABILITY — migration
+tickets carry (adapter_id, content digest) inside their checksum, so
+an adapter-bearing sequence lands only on a pool holding the SAME
+bytes under that id (typed TicketError otherwise: no pool, not
+resident, content mismatch, tampered payload). All CPU-fast on the
+tiny GPT; the tp=2 mesh matrix rides the multichip lane
+(tools/run_multichip_tests.sh)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+from paddle_tpu.models import gpt_decode as gd
+from paddle_tpu.serving import (AdapterGeometryError, AdapterPool,
+                                AdapterPoolFullError,
+                                AdapterReferencedError, ServingConfig,
+                                ServingEngine, TicketError,
+                                UnknownAdapterError, make_adapter)
+
+
+def tiny_cfg():
+    return GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                     max_pos=64, dropout=0.0, attn_impl="xla")
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """(cfg, params) of a randomly initialised tiny GPT."""
+    cfg = tiny_cfg()
+    main, startup, fetches = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+    return cfg, params
+
+
+RANK = 2
+
+
+def make_engine(trained, adapters=True, **kw):
+    cfg, params = trained
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("prefill_buckets", (4, 8))
+    kw.setdefault("max_len", 32)
+    if adapters:
+        kw.setdefault("max_adapters", 4)
+        kw.setdefault("adapter_rank", RANK)
+    return ServingEngine(params, cfg, ServingConfig(**kw))
+
+
+def _mix_streams(eng, cfg, adapter_ids, max_new=8):
+    """Shared workload: one request per adapter id, alternating greedy
+    and seeded sampling, co-batched through whatever slot churn the
+    engine's num_slots forces. Returns the streams in submit order."""
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, cfg.vocab_size, (3 + i % 4,))
+               .astype(np.int32) for i in range(len(adapter_ids))]
+    reqs = [eng.submit(p, max_new_tokens=max_new, adapter_id=aid,
+                       temperature=0.8 if i % 2 else 0.0, seed=i)
+            for i, (p, aid) in enumerate(zip(prompts, adapter_ids))]
+    eng.run_until_drained()
+    return [tuple(r.tokens) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# config + upload validation (the typed front doors)
+# ---------------------------------------------------------------------------
+
+def test_servingconfig_adapter_validation(trained):
+    """The knobs are a pair: both-or-neither, max_adapters >= 2 (row 0
+    is the identity), adapter_rank >= 1, bools excluded — all refused
+    at config time, before any device allocation."""
+    with pytest.raises(ValueError, match="adapter_rank"):
+        ServingConfig(max_adapters=4)
+    with pytest.raises(ValueError, match="max_adapters"):
+        ServingConfig(adapter_rank=2)
+    with pytest.raises(ValueError, match="identity"):
+        ServingConfig(max_adapters=1, adapter_rank=2)
+    with pytest.raises(ValueError, match="max_adapters"):
+        ServingConfig(max_adapters=True, adapter_rank=2)
+    with pytest.raises(ValueError, match="adapter_rank"):
+        ServingConfig(max_adapters=4, adapter_rank=0)
+    # a nonzero adapter_id on an adapterless engine is refused at
+    # submit, naming the knobs that would enable the pool
+    eng = make_engine(trained, adapters=False)
+    with pytest.raises(ValueError, match="max_adapters"):
+        eng.submit(np.asarray([1, 2, 3], np.int32), 4, adapter_id=1)
+    with pytest.raises(ValueError, match="adapter_id"):
+        eng.submit(np.asarray([1, 2, 3], np.int32), 4, adapter_id=-1)
+    eng.close()
+
+
+def test_upload_geometry_validation(trained):
+    """Uploads are validated against the base geometry up front: wrong
+    rank, wrong width, and missing projections are typed
+    AdapterGeometryErrors (ValueError subclasses — the HTTP 400
+    mapping), and id 0 can never be uploaded over."""
+    cfg, _ = trained
+    eng = make_engine(trained)
+    good = make_adapter(cfg, RANK, seed=1)
+    assert eng.upload_adapter(1, good) >= 1          # row claimed
+    with pytest.raises(AdapterGeometryError, match="rank"):
+        eng.upload_adapter(2, make_adapter(cfg, RANK + 1, seed=2))
+    bad_width = make_adapter(cfg, RANK, seed=2)
+    bad_width["q"]["a"] = bad_width["q"]["a"][:, :-1]
+    with pytest.raises(AdapterGeometryError, match="geometry"):
+        eng.upload_adapter(2, bad_width)
+    partial = {"q": good["q"]}
+    with pytest.raises(AdapterGeometryError, match="missing"):
+        eng.upload_adapter(2, partial)
+    with pytest.raises(AdapterGeometryError, match="identity"):
+        eng.upload_adapter(0, good)
+    assert isinstance(AdapterGeometryError("x"), ValueError)
+    # the failed uploads left the pool untouched
+    assert eng.adapters.resident == (1,)
+    eng.close()
+
+
+def test_pool_refcount_lru_discipline(trained):
+    """The kv_cache discipline on adapter rows: evict/re-upload refused
+    while referenced, LRU eviction claims only the OLDEST unreferenced
+    row under pressure, and a pool whose every row is pinned refuses
+    new uploads with the typed pool-full error."""
+    cfg, _ = trained
+    pool = AdapterPool(cfg, max_adapters=4, rank=RANK)   # 3 usable rows
+    for aid in (1, 2, 3):
+        pool.upload(aid, make_adapter(cfg, RANK, seed=aid))
+    assert pool.resident == (1, 2, 3)
+    pool.acquire(1)
+    # referenced: evict and re-upload both refused, typed
+    with pytest.raises(AdapterReferencedError, match="evict"):
+        pool.evict(1)
+    with pytest.raises(AdapterReferencedError, match="re-upload"):
+        pool.upload(1, make_adapter(cfg, RANK, seed=9))
+    # pressure evicts the LRU unreferenced id (2, not the pinned 1)
+    pool.upload(4, make_adapter(cfg, RANK, seed=4))
+    assert pool.resident == (1, 3, 4)
+    assert pool.evictions_total == 1
+    # every row pinned -> typed pool-full on a fresh id
+    pool.acquire(3)
+    pool.acquire(4)
+    with pytest.raises(AdapterPoolFullError, match="full"):
+        pool.upload(5, make_adapter(cfg, RANK, seed=5))
+    # release unpins: evict succeeds and frees the row
+    pool.release(1)
+    pool.evict(1)
+    assert not pool.is_resident(1)
+    pool.upload(5, make_adapter(cfg, RANK, seed=5))
+    assert pool.resident == (3, 4, 5)
+    # the reserved identity and unknown ids are typed refusals
+    with pytest.raises(UnknownAdapterError):
+        pool.evict(77)
+    with pytest.raises(ValueError, match="identity"):
+        pool.evict(0)
+    with pytest.raises(UnknownAdapterError):
+        pool.row_of(77)
+
+
+def test_unknown_adapter_typed_error_at_submit(trained):
+    """Routing to an adapter nobody uploaded is the typed 4xx
+    (UnknownAdapterError, a ValueError) at the submit door — and the
+    refused request leaks nothing: the engine drains clean and serves
+    the next request normally."""
+    eng = make_engine(trained)
+    with pytest.raises(UnknownAdapterError, match="not resident"):
+        eng.submit(np.asarray([1, 2, 3], np.int32), 4, adapter_id=9)
+    assert isinstance(UnknownAdapterError("x"), ValueError)
+    req = eng.submit(np.asarray([1, 2, 3], np.int32), 4)
+    eng.run_until_drained()
+    assert req.state == "finished"
+    s = eng.stats()
+    assert s["blocks_used"] == 0 and s["adapters_resident"] == 0
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# identity: adapter_id=0 == adapterless, bit for bit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("k", [0, 4])
+def test_adapter0_identity_matrix(trained, k, kv_dtype):
+    """The acceptance matrix's single-chip half: an adapter-pool engine
+    driving every request at adapter_id=0 emits bit-identical greedy
+    AND seeded streams to the adapterless engine — speculation on and
+    off, fp32 and int8 KV — with the SAME compile-event sequence (the
+    pool adds zero executables)."""
+    cfg, _ = trained
+    kw = dict(speculate_k=k, kv_dtype=kv_dtype, max_len=48)
+    base = make_engine(trained, adapters=False, **kw)
+    ref = _mix_streams(base, cfg, [0, 0, 0, 0])
+    base_events = base.scheduler.compile_events
+    base.close()
+    eng = make_engine(trained, **kw)
+    # a resident (never-routed) adapter must not perturb id-0 streams
+    eng.upload_adapter(1, make_adapter(cfg, RANK, seed=1))
+    got = _mix_streams(eng, cfg, [0, 0, 0, 0])
+    assert got == ref, (k, kv_dtype)
+    assert eng.scheduler.compile_events == base_events
+    eng.close()
+
+
+def test_adapter0_identity_through_preempt_resume(trained):
+    """Identity holds through host-swap preemption: an over-subscribed
+    adapter-pool arena (all requests at id 0) streams bit-identical to
+    the unpressured adapterless run, and the drain leaks nothing."""
+    cfg, _ = trained
+    pressure = dict(num_slots=4, max_queue=16, block_size=4,
+                    kv_blocks=12, decode_chunk=4, preempt=True)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 7, 4, 6)]
+    ref_eng = make_engine(trained, adapters=False, num_slots=4,
+                          block_size=4, decode_chunk=4)
+    refs = [ref_eng.submit(p, 12, temperature=0.8, seed=3)
+            for p in prompts]
+    ref_eng.run_until_drained()
+    eng = make_engine(trained, **pressure)
+    eng.upload_adapter(1, make_adapter(cfg, RANK, seed=1))
+    reqs = [eng.submit(p, 12, temperature=0.8, seed=3, adapter_id=0)
+            for p in prompts]
+    eng.run_until_drained()
+    assert eng.stats()["preemptions"] >= 1      # pressure was real
+    assert [tuple(r.tokens) for r in reqs] \
+        == [tuple(r.tokens) for r in refs]
+    assert eng.stats()["blocks_used"] == 0
+    ref_eng.close(); eng.close()
+
+
+# ---------------------------------------------------------------------------
+# isolation: co-batched adapters == each alone
+# ---------------------------------------------------------------------------
+
+def test_cobatched_adapters_bit_identical_to_dedicated(trained):
+    """THE acceptance pin: three distinct adapters plus the base
+    identity co-batched on 2 slots (so requests queue and slots churn)
+    each emit exactly the stream a dedicated engine holding only that
+    adapter emits — greedy AND seeded — and the compile count stays
+    O(buckets)+admit+1 fused chunk loop."""
+    cfg, _ = trained
+    eng = make_engine(trained)
+    for aid in (1, 2, 3):
+        eng.upload_adapter(aid, make_adapter(cfg, RANK, seed=aid))
+    ids = [1, 2, 3, 0, 1, 2, 3, 0]
+    got = _mix_streams(eng, cfg, ids)
+    events = eng.scheduler.compile_events
+    assert events.count("decode_chunk") == 1
+    assert len(events) <= 2 + 2     # len(buckets)=2 + chunk + admit
+    s = eng.stats()
+    assert s["adapters_resident"] == 3 and s["adapter_uploads"] == 3
+    eng.close()
+    # dedicated engines: same submit-order mix restricted to one id
+    distinct = []
+    for aid in (0, 1, 2, 3):
+        solo = make_engine(trained)
+        if aid:
+            solo.upload_adapter(aid,
+                                make_adapter(cfg, RANK, seed=aid))
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, cfg.vocab_size, (3 + i % 4,))
+                   .astype(np.int32) for i in range(len(ids))]
+        picks = [i for i, a in enumerate(ids) if a == aid]
+        reqs = [solo.submit(prompts[i], max_new_tokens=8,
+                            adapter_id=aid,
+                            temperature=0.8 if i % 2 else 0.0, seed=i)
+                for i in picks]
+        solo.run_until_drained()
+        for i, r in zip(picks, reqs):
+            assert tuple(r.tokens) == got[i], (aid, i)
+        if aid:
+            distinct.append(tuple(solo.adapters.digest_of(aid)))
+        solo.close()
+    # the adapters are genuinely distinct tenants, not near-ties: every
+    # adapter's greedy stream differs from the base identity's
+    assert len(set(distinct)) == 3
+    assert got[0] != got[3] and got[1] != got[7]
+
+
+# ---------------------------------------------------------------------------
+# migration: adapter identity is sequence state
+# ---------------------------------------------------------------------------
+
+def _drive_until_running_with_tokens(eng, req, n=2):
+    while len(req.tokens) < n:
+        eng.step()
+    assert not req.finished
+
+
+def test_adapter_migration_identity(trained):
+    """An adapter-bearing sequence migrated mid-generation onto a pool
+    holding the SAME adapter bytes resumes bit-identically to a
+    never-migrated run — greedy and seeded — and the ticket journals
+    the adapter id."""
+    cfg, _ = trained
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    for temp, seed in ((0.0, 0), (0.8, 3)):
+        src = make_engine(trained, decode_chunk=4, max_len=48)
+        dst = make_engine(trained, decode_chunk=4, max_len=48)
+        for e in (src, dst):
+            e.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+        stream = []
+        req = src.submit(p, 40, temperature=temp, seed=seed,
+                         adapter_id=2,
+                         on_token=lambda r, t: stream.append(t))
+        _drive_until_running_with_tokens(src, req)
+        ticket = src.migrate_out(req)
+        assert ticket.verify()
+        assert ticket.adapter_id == 2
+        assert ticket.describe()["adapter_id"] == 2
+        # the source released its pin when the sequence left
+        assert src.adapters.refcount(2) == 0
+        req2 = dst.migrate_in(ticket,
+                              on_token=lambda r, t: stream.append(t))
+        assert dst.adapters.refcount(2) == 1
+        src.run_until_drained()
+        dst.run_until_drained()
+        assert req2.state == "finished"
+        ref_eng = make_engine(trained, decode_chunk=4, max_len=48)
+        ref_eng.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+        ref_stream = []
+        ref_eng.submit(p, 40, temperature=temp, seed=seed,
+                       adapter_id=2,
+                       on_token=lambda r, t: ref_stream.append(t))
+        ref_eng.run_until_drained()
+        assert stream == ref_stream, temp
+        assert dst.adapters.refcount(2) == 0    # released at finish
+        src.close(); dst.close(); ref_eng.close()
+
+
+def test_adapter_migration_ticket_rejections(trained):
+    """The ticket's adapter rails, all typed TicketErrors with nothing
+    mutated on the refusing engine: a target with NO pool, a target
+    pool missing the id, a target holding DIFFERENT bytes under the
+    id, and a tampered payload failing the checksum (which commits to
+    (adapter_id, digest) since TICKET_VERSION 3)."""
+    cfg, _ = trained
+    src = make_engine(trained, decode_chunk=4, max_len=48)
+    src.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+    p = np.asarray([5, 7, 11], np.int32)
+    req = src.submit(p, 30, adapter_id=2)
+    _drive_until_running_with_tokens(src, req)
+    ticket = src.migrate_out(req)
+    assert ticket.version == pt.serving.TICKET_VERSION
+
+    no_pool = make_engine(trained, adapters=False, decode_chunk=4,
+                          max_len=48)
+    with pytest.raises(TicketError, match="no adapter pool"):
+        no_pool.migrate_in(ticket)
+    missing = make_engine(trained, decode_chunk=4, max_len=48)
+    with pytest.raises(TicketError, match="not resident"):
+        missing.migrate_in(ticket)
+    different = make_engine(trained, decode_chunk=4, max_len=48)
+    different.upload_adapter(2, make_adapter(cfg, RANK, seed=99))
+    with pytest.raises(TicketError, match="mismatch"):
+        different.migrate_in(ticket)
+    for eng in (no_pool, missing, different):
+        assert eng.stats()["swapped_slots"] == 0
+        assert eng.stats()["blocks_used"] == 0
+    # tampering with the payload breaks the checksum even though the
+    # adapter fields agree
+    tampered = ticket.payload.copy()
+    tampered[0, 0, 0, 0, 0, 0] += 1.0
+    good_payload, ticket.payload = ticket.payload, tampered
+    assert not ticket.verify()
+    ok = make_engine(trained, decode_chunk=4, max_len=48)
+    ok.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+    with pytest.raises(TicketError, match="checksum"):
+        ok.migrate_in(ticket)
+    # the intact ticket still adopts fine after every rejection
+    ticket.payload = good_payload
+    assert ticket.verify()
+    req2 = ok.migrate_in(ticket)
+    src.run_until_drained()
+    ok.run_until_drained()
+    assert req2.state == "finished"
+    for eng in (src, no_pool, missing, different, ok):
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: conditional families, rollup, request-log stamps
+# ---------------------------------------------------------------------------
+
+def test_adapter_metric_families_and_varz_rollup(trained):
+    """The pool's four registry families exist exactly on adapter
+    engines (the adapterless family-set pin in test_serving stays
+    intact because they are flag-conditional), carry upload/evict
+    truth, and roll up into the /varz "adapters" block — which is
+    ABSENT from a snapshot with no adapter engines."""
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.debug_server import _serving_varz
+
+    cfg, _ = trained
+    plain = make_engine(trained, adapters=False)
+    assert "adapters" not in _serving_varz(get_registry().snapshot())
+    plain.close()
+
+    eng = make_engine(trained)
+    label = eng.stats()["engine_label"]
+    eng.upload_adapter(1, make_adapter(cfg, RANK, seed=1))
+    eng.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+    eng.evict_adapter(2)
+    snap = get_registry().snapshot()
+    varz = _serving_varz(snap)["adapters"][label]
+    assert varz == {"adapters_resident": 1,
+                    "adapter_pool_bytes": eng.adapters.pool_bytes,
+                    "adapter_uploads": 2,
+                    "adapter_evictions": 1}
+    # close() retires the labeled series like every other family
+    eng.close()
+    snap = get_registry().snapshot()
+    assert not any(
+        r["labels"].get("engine") == label
+        for r in snap.get("serving_adapters_resident",
+                          {}).get("series", []))
+
+
+def test_adapter_request_log_stamps(trained):
+    """Lifecycle events carry the adapter id end to end: submitted and
+    admitted stamp adapter_id, pool lifecycle journals adapter_upload /
+    adapter_evict, and migrate_out/migrate_in stamp the id on both
+    sides of a hop."""
+    from paddle_tpu.observability import request_log as rl
+
+    cfg, _ = trained
+    with rl.request_logging() as log:
+        src = make_engine(trained, decode_chunk=4, max_len=48)
+        dst = make_engine(trained, decode_chunk=4, max_len=48)
+        for e in (src, dst):
+            e.upload_adapter(3, make_adapter(cfg, RANK, seed=3))
+        req = src.submit(np.asarray([2, 7, 1], np.int32), 30,
+                         adapter_id=3)
+        _drive_until_running_with_tokens(src, req)
+        req2 = dst.migrate_in(src.migrate_out(req))
+        src.run_until_drained()
+        dst.run_until_drained()
+        assert req2.state == "finished"
+        src.close(); dst.close()
+    events = log.recent()
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["kind"], []).append(e)
+    assert [e["adapter_id"] for e in by_kind["adapter_upload"]] == [3, 3]
+    for kind in ("submitted", "admitted", "migrate_out", "migrate_in"):
+        assert any(e.get("adapter_id") == 3 for e in by_kind[kind]), kind
+
+
+def test_engine_stats_and_healthz_surface(trained):
+    """stats() exposes the pool occupancy block and close() releases
+    nothing it shouldn't: upload/evict via the engine move the gauges
+    synchronously (no step needed)."""
+    cfg, _ = trained
+    eng = make_engine(trained)
+    s = eng.stats()
+    assert s["max_adapters"] == 4 and s["adapter_rank"] == RANK
+    assert s["adapters_resident"] == 0
+    assert s["adapter_pool_bytes"] == eng.adapters.pool_bytes > 0
+    eng.upload_adapter(1, make_adapter(cfg, RANK, seed=1))
+    assert eng.stats()["adapters_resident"] == 1
+    assert eng.metrics.adapters_resident == 1
+    eng.evict_adapter(1)
+    assert eng.stats()["adapters_resident"] == 0
+    assert eng.stats()["adapter_evictions"] == 1
+    # adapterless stats() has NO adapter keys (surface unchanged)
+    plain = make_engine(trained, adapters=False)
+    assert "adapters_resident" not in plain.stats()
+    plain.close(); eng.close()
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel mesh (multichip lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+def test_adapter_mesh_tp2_identity(trained):
+    """The tp=2 adapter pin: a mesh_shape=(2,) adapter engine emits
+    bit-identical streams to the single-chip adapter engine for the
+    SAME co-batched multi-adapter mix (distinct adapters + the base
+    identity, greedy and seeded), and adapter_id=0 on the mesh matches
+    the adapterless mesh engine."""
+    cfg, _ = trained
+    ids = [1, 2, 3, 0]
+
+    def run(mesh, adapters=True, mix=ids):
+        eng = make_engine(trained, adapters=adapters, mesh_shape=mesh,
+                          max_len=48)
+        if adapters:
+            for aid in (1, 2, 3):
+                eng.upload_adapter(aid,
+                                   make_adapter(cfg, RANK, seed=aid))
+        got = _mix_streams(eng, cfg, mix)
+        events = eng.scheduler.compile_events
+        eng.close()
+        return got, events
+
+    base, _ = run(None)
+    tp2, events = run((2,))
+    assert tp2 == base, "tp=2 adapter streams diverged from single-chip"
+    assert events.count("decode_chunk") == 1
+    assert len(events) <= 2 + 2
+    # id 0 on the mesh == the adapterless mesh engine
+    plain, _ = run((2,), adapters=False, mix=[0, 0, 0, 0])
+    zeros, _ = run((2,), mix=[0, 0, 0, 0])
+    assert zeros == plain
+
+
+@pytest.mark.multichip
+@pytest.mark.parametrize("dst_tp", [2, 1])
+def test_adapter_mesh_migration_identity(trained, dst_tp):
+    """tp->tp and tp->single migration of an adapter-bearing sequence:
+    the ticket's assembled-full-head payload plus the (adapter_id,
+    digest) commitment adopt cleanly onto a target at a DIFFERENT mesh
+    holding the same adapter bytes, and the stream stays bit-identical
+    to a never-migrated single-chip run."""
+    cfg, _ = trained
+
+    def mesh(tp):
+        return (tp,) if tp > 1 else None
+
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    src = make_engine(trained, mesh_shape=(2,), decode_chunk=4,
+                      max_len=48)
+    dst = make_engine(trained, mesh_shape=mesh(dst_tp), decode_chunk=4,
+                      max_len=48)
+    for e in (src, dst):
+        e.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+    stream = []
+    req = src.submit(p, 40, temperature=0.8, seed=3, adapter_id=2,
+                     on_token=lambda r, t: stream.append(t))
+    _drive_until_running_with_tokens(src, req)
+    ticket = src.migrate_out(req)
+    assert ticket.adapter_id == 2
+    req2 = dst.migrate_in(ticket,
+                          on_token=lambda r, t: stream.append(t))
+    src.run_until_drained()
+    dst.run_until_drained()
+    assert req2.state == "finished"
+    ref_eng = make_engine(trained, decode_chunk=4, max_len=48)
+    ref_eng.upload_adapter(2, make_adapter(cfg, RANK, seed=2))
+    ref_stream = []
+    ref_eng.submit(p, 40, temperature=0.8, seed=3, adapter_id=2,
+                   on_token=lambda r, t: ref_stream.append(t))
+    ref_eng.run_until_drained()
+    assert stream == ref_stream, dst_tp
+    src.close(); dst.close(); ref_eng.close()

@@ -912,3 +912,42 @@ def test_bench_serving_quantize_row_shape():
     ratio = (by_mode["int8w_int8kv"]["tokens_per_s_per_gb"]
              / by_mode["fp32"]["tokens_per_s_per_gb"])
     assert ratio >= 1.7, f"tokens/s-per-GB ratio {ratio:.2f} < 1.7"
+
+
+def test_bench_serving_adapters_row_shape():
+    """tools/bench_serving --adapters: one row per pool population
+    (1 vs N adapters co-batched) with the registry-sourced pool
+    columns. Determinism (fresh-engine re-run) and isolation (each
+    co-batched request vs a dedicated single-adapter engine) are
+    asserted INSIDE the workload, so this pin runs it small and checks
+    the row shape: n_adapters / adapters_resident / adapter_uploads /
+    adapter_evictions / adapter_pool_bytes, the constant-pool-bytes
+    invariant (uploads are value updates at fixed shape), and compile
+    count still O(buckets)+admit+1 with adapters in the batch."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_serving
+    rows = bench_serving.run_adapters("tiny", n_adapters=3, requests=6,
+                                      max_new=16)
+    assert len(rows) == 2                 # 1-adapter vs N-adapter rows
+    by_pop = {}
+    for row in rows:
+        e = row["extra"]
+        n = int(row["metric"].rsplit("_", 1)[1])
+        assert row["value"] > 0 and row["unit"] == "tokens/s"
+        assert e["completed"] == 6
+        assert e["n_adapters"] == n
+        assert e["adapters_resident"] == n
+        assert e["adapter_uploads"] == n
+        assert e["adapter_evictions"] == 0
+        assert e["adapter_pool_bytes"] > 0
+        assert e["streams_deterministic"] is True
+        # compile discipline unchanged by the adapter pool: 2 buckets
+        # + chunk loop + admit sampler
+        assert e["compiled_executables"] <= 2 + 2
+        by_pop[n] = e
+    assert set(by_pop) == {1, 3}
+    # the pool is fixed-shape: residency varies, bytes do not
+    assert by_pop[1]["adapter_pool_bytes"] \
+        == by_pop[3]["adapter_pool_bytes"]
+    # isolation was really asserted on the co-batched row
+    assert by_pop[3]["streams_isolated"] is True

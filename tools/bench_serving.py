@@ -121,6 +121,20 @@ committed tokens per verify pass, the raw tokens-per-model-pass lever
 the no-speculation baseline with None in the spec columns):
 
     python tools/bench_serving.py tiny --speculate 0 4
+
+`--adapters N` runs the MULTI-TENANT ADAPTER sweep instead: the same
+greedy request mix on fresh engines with ONE LoRA adapter resident vs
+N distinct adapters co-batched (requests round-robin over the adapter
+ids through the per-slot batched gather-matmul), one row per pool
+population. Rows carry the registry-sourced pool columns
+(`adapters_resident`, `adapter_pool_bytes`, `adapter_uploads`,
+`adapter_evictions` — the serving_adapter* families) next to tokens/s.
+Before any row prints the workload asserts (1) determinism — a second
+fresh engine reproduces every stream bit-for-bit — and (2) isolation —
+each co-batched request matches a dedicated engine holding only its
+adapter:
+
+    python tools/bench_serving.py tiny --adapters 3
 """
 
 import argparse
@@ -1267,6 +1281,177 @@ def run_quantize(name, requests=None, max_new=None, decode_chunk=8):
     return rows
 
 
+def run_adapters(name, n_adapters=None, requests=None, max_new=None,
+                 decode_chunk=8, adapter_rank=4):
+    """The --adapters sweep: the same greedy request mix on fresh
+    engines serving ONE LoRA adapter vs N distinct adapters co-batched
+    (requests round-robin over the adapter ids), one row per pool
+    population. Rows carry the registry-sourced pool columns
+    (`adapters_resident` / `adapter_pool_bytes` /
+    `adapter_uploads` / `adapter_evictions` — the
+    serving_adapter* families, not engine internals) next to tokens/s,
+    so the cost of multi-tenant batched gather-matmul vs single-tenant
+    serving is a printed delta. Before ANY row prints, two contracts
+    are asserted inside the workload: (1) determinism — a second fresh
+    engine at the same pool population reproduces every stream
+    bit-for-bit; (2) isolation — every request in the N-adapter
+    co-batched row is re-run on a dedicated fresh engine holding ONLY
+    its adapter and must match bit-for-bit (cross-tenant contamination
+    would show up here first).
+
+    Honest caveat: on a CPU host the tokens/s delta measures XLA's
+    fp32 gather-einsum emulation; the per-slot gather-matmul's perf
+    regime is real-chip HBM. The bytes and residency columns carry on
+    any backend."""
+    import paddle_tpu as pt
+
+    gpt_kwargs, _, _, _ = MODELS[name]
+    buckets, prompt_len, row_max_new, slots = QUANTIZE[name]
+    max_new = max_new or row_max_new
+    n_adapters = n_adapters or 3
+    requests = requests or int(
+        os.environ.get("BENCH_SERVING_REQUESTS", "16"))
+    cfg, params = build_params(gpt_kwargs)
+    max_len = prompt_len + max_new
+    # same prompt mix for every row/engine; what varies is which
+    # adapter each request decodes through
+    mix_rng = np.random.RandomState(0)
+    prompts = [mix_rng.randint(0, cfg.vocab_size, (prompt_len,))
+               .astype(np.int32) for _ in range(requests)]
+
+    def run_mix(adapter_ids, upload_ids):
+        """One fresh engine: upload `upload_ids` (deterministic
+        per-id weights), drive the mix with per-request `adapter_ids`,
+        return (streams, stats, wall, registry columns)."""
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(
+                num_slots=slots, max_queue=requests,
+                prefill_buckets=buckets, max_len=max_len,
+                decode_chunk=decode_chunk,
+                max_adapters=n_adapters + 1,
+                adapter_rank=adapter_rank))
+        for aid in upload_ids:
+            eng.upload_adapter(
+                aid, pt.serving.make_adapter(cfg, adapter_rank,
+                                             seed=aid))
+        # warm every executable (standard bench discipline), then drop
+        # the warmup's registry rows — the fresh EngineMetrics keeps
+        # the adapter families alive so the row's columns still come
+        # off the registry
+        wrng = np.random.RandomState(12345)
+        eng.generate([wrng.randint(0, cfg.vocab_size, (max(1, b - 2),))
+                      .astype(np.int32) for b in buckets],
+                     max_new_tokens=2)
+        old = eng.metrics
+        old.unregister()
+        eng.metrics = pt.serving.EngineMetrics(
+            max_tokens_per_dispatch=old.max_tokens_per_dispatch,
+            speculate_k=old.speculate_k, adapters=True)
+        eng._sync_adapter_metrics()
+        eng.kv.prefix_hits = eng.kv.prefix_misses = 0
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=max_new, adapter_id=aid)
+                for p, aid in zip(prompts, adapter_ids)]
+        eng.run_until_drained()
+        dt = time.perf_counter() - t0
+        s = eng.stats()
+        label = s["engine_label"]
+        reg = {col: _registry_counter(label, family) for col, family in
+               (("dispatches", "serving_dispatches_total"),
+                ("adapters_resident", "serving_adapters_resident"),
+                ("adapter_pool_bytes", "serving_adapter_pool_bytes"),
+                ("adapter_uploads", "serving_adapter_uploads_total"),
+                ("adapter_evictions",
+                 "serving_adapter_evictions_total"))}
+        eng.close()
+        return [tuple(r.tokens) for r in reqs], s, dt, reg
+
+    all_ids = list(range(1, n_adapters + 1))
+    rows = []
+    for n_pop in (1, n_adapters):
+        ids = all_ids[:n_pop]
+        adapter_ids = [ids[i % len(ids)] for i in range(requests)]
+        streams, s, dt, reg = run_mix(adapter_ids, ids)
+        # determinism pinned PER ROW before printing (the quantize
+        # sweep's discipline): a second fresh engine at the same pool
+        # population must reproduce every stream bit-for-bit
+        streams2, _, _, _ = run_mix(adapter_ids, ids)
+        assert streams == streams2, (
+            f"{n_pop}-adapter streams are not deterministic across "
+            "fresh engines")
+        if n_pop > 1:
+            # isolation pinned: each co-batched request must match a
+            # dedicated engine holding ONLY its adapter
+            _assert_isolation(pt, params, cfg, buckets, prompt_len,
+                              max_new, slots, decode_chunk,
+                              n_adapters, adapter_rank, prompts,
+                              adapter_ids, streams, ids)
+        tokens = sum(len(st) for st in streams)
+        rows.append({
+            "metric": f"{name}_serving_adapters_{n_pop}",
+            "value": round(tokens / dt, 2),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "extra": {
+                "requests": requests,
+                "completed": s["completed"],
+                "max_new": max_new,
+                "num_slots": slots,
+                "decode_chunk": decode_chunk,
+                "n_adapters": n_pop,
+                "adapter_rank": adapter_rank,
+                "adapters_resident": reg["adapters_resident"],
+                "adapter_pool_bytes": reg["adapter_pool_bytes"],
+                "adapter_uploads": reg["adapter_uploads"],
+                "adapter_evictions": reg["adapter_evictions"],
+                "streams_deterministic": True,    # asserted above
+                "streams_isolated": n_pop > 1,    # asserted above
+                "dispatches": reg["dispatches"],
+                "tokens_per_dispatch": round(
+                    tokens / reg["dispatches"], 2)
+                    if reg["dispatches"] else None,
+                "mean_ttft_ms": round(s["mean_ttft"] * 1e3, 2)
+                    if s["mean_ttft"] is not None else None,
+                "mean_tpot_ms": round(s["mean_tpot"] * 1e3, 3)
+                    if s["mean_tpot"] is not None else None,
+                "compiled_executables": s["compiled_executables"],
+            },
+        })
+    return rows
+
+
+def _assert_isolation(pt, params, cfg, buckets, prompt_len, max_new,
+                      slots, decode_chunk, n_adapters, adapter_rank,
+                      prompts, adapter_ids, streams, ids):
+    """Re-run each adapter's co-batched requests on a dedicated fresh
+    engine holding ONLY that adapter; every stream must match the
+    co-batched run bit-for-bit."""
+    for aid in ids:
+        picks = [i for i, a in enumerate(adapter_ids) if a == aid]
+        if not picks:
+            continue
+        eng = pt.serving.ServingEngine(
+            params, cfg,
+            pt.serving.ServingConfig(
+                num_slots=slots, max_queue=len(picks),
+                prefill_buckets=buckets,
+                max_len=prompt_len + max_new,
+                decode_chunk=decode_chunk,
+                max_adapters=n_adapters + 1,
+                adapter_rank=adapter_rank))
+        eng.upload_adapter(
+            aid, pt.serving.make_adapter(cfg, adapter_rank, seed=aid))
+        reqs = [eng.submit(prompts[i], max_new_tokens=max_new,
+                           adapter_id=aid) for i in picks]
+        eng.run_until_drained()
+        solo = [tuple(r.tokens) for r in reqs]
+        eng.close()
+        assert solo == [streams[i] for i in picks], (
+            f"adapter {aid}: co-batched streams diverge from a "
+            "dedicated single-adapter engine")
+
+
 def _sse_generate(port, payload, timeout=120):
     """POST /v1/generate and consume the SSE stream, stamping
     perf_counter at every frame. Returns (status, tokens, stamps,
@@ -1551,6 +1736,17 @@ def main(argv=None):
                          "quantized row's streams asserted "
                          "deterministic across fresh engines before "
                          "printing")
+    ap.add_argument("--adapters", type=int, default=None, metavar="N",
+                    help="run the multi-tenant adapter sweep instead: "
+                         "the same greedy mix on fresh engines with 1 "
+                         "vs N LoRA adapters resident (requests round-"
+                         "robin the adapter ids), one row per pool "
+                         "population with registry-sourced "
+                         "adapters_resident / adapter_pool_bytes / "
+                         "adapter_uploads / adapter_evictions columns; "
+                         "streams asserted deterministic across fresh "
+                         "engines AND bit-identical to dedicated "
+                         "single-adapter engines before printing")
     ap.add_argument("--oversubscribe", action="store_true",
                     help="run the over-subscription workload instead: "
                          "requests demanding more KV pages than the "
@@ -1583,7 +1779,8 @@ def main(argv=None):
         ("--mixed", args.mixed),
         ("--rebalance", args.rebalance),
         ("--oversubscribe", args.oversubscribe),
-        ("--quantize", args.quantize)) if on]
+        ("--quantize", args.quantize),
+        ("--adapters", args.adapters is not None)) if on]
     if len(replacing) > 1:
         ap.error(f"{replacing[0]} replaces the standard workload; "
                  f"drop {' '.join(replacing[1:])}")
@@ -1610,6 +1807,8 @@ def main(argv=None):
         bad = [k for k in args.speculate if k < 0]
         if bad:
             ap.error(f"--speculate values must be >= 0, got {bad}")
+    if args.adapters is not None and args.adapters < 1:
+        ap.error(f"--adapters must be >= 1, got {args.adapters}")
 
     server_started = False
     if args.debug_port is not None:
@@ -1630,6 +1829,8 @@ def main(argv=None):
                 rows = run_rebalance(name)
             elif args.quantize:
                 rows = run_quantize(name)
+            elif args.adapters is not None:
+                rows = run_adapters(name, n_adapters=args.adapters)
             elif args.oversubscribe:
                 rows = run_oversubscribe(name)
             elif args.speculate is not None:

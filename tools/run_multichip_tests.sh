@@ -16,8 +16,12 @@
 # of an int8-KV sequence — test_quantized_mesh_*) and the
 # CHUNKED-PREFILL mesh pin (prefill_chunk on a tp=2 mesh streams
 # identical to single-chip monolithic, chunk-bucket executables only —
-# test_chunked_prefill_mesh_tp2_identity); `--mesh` bench rows come
-# from
+# test_chunked_prefill_mesh_tp2_identity); the MULTI-TENANT ADAPTER
+# mesh pins live in tests/test_adapters.py (tp=2 adapter streams
+# bit-identical to single-chip per adapter, adapter_id=0 identical to
+# the adapterless engine, and tp->single migration of an
+# adapter-bearing sequence — test_*_tp2_* / test_adapter_migration_*);
+# `--mesh` bench rows come from
 #   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 #       JAX_PLATFORMS=cpu python tools/bench_serving.py tiny --mesh 1 2 4
 set -euo pipefail

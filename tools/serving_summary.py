@@ -31,6 +31,9 @@ Annotations:
              timeline keyed by its original id
   SLO-MISS   the stream closed outside one of its tenant's SLO
              objectives (named in parentheses)
+  ADAPTER(n) the request decoded through LoRA adapter n (multi-tenant
+             adapter pool; adapter_upload/adapter_evict pool lifecycle
+             events are engine-scoped and render as their own section)
   SHED       rejected at the engine admission door
   CANCELLED / DEADLINE  terminal reasons worth flagging
 """
@@ -56,7 +59,12 @@ EMPTY_HINT = ("no request events were written there. Install a "
 _PHASE_EVENTS = ("submitted", "queued", "routed", "admitted", "prefill",
                  "decode", "preempted", "swapped_in", "failover",
                  "displaced", "migrate_out", "migrate_in",
+                 "adapter_upload", "adapter_evict",
                  "finished", "cancelled", "shed", "stream_closed")
+
+# engine-scoped pool lifecycle kinds: journaled without a request_id,
+# so they never join a chain — rendered as their own section instead
+_POOL_EVENTS = ("adapter_upload", "adapter_evict")
 
 
 def load_events(path: str):
@@ -167,6 +175,12 @@ def summarize(events):
         missed = (closed or {}).get("slo_missed") or []
         if missed:
             notes.append(f"SLO-MISS({','.join(missed)})")
+        # nonzero adapter id = the request ran through a LoRA adapter;
+        # the submitted event always carries it when a pool is wired
+        adapter_id = next((rec.get("adapter_id") for rec in evs
+                           if rec.get("adapter_id")), None)
+        if adapter_id:
+            notes.append(f"ADAPTER({adapter_id})")
         if "shed" in kinds:
             notes.append("SHED")
         if reason == "cancelled":
@@ -188,6 +202,7 @@ def summarize(events):
             "prefill_chunks": chunks,
             "preemptions": kinds.count("preempted"),
             "migrations": migrations,
+            "adapter_id": adapter_id or 0,
             "annotations": notes,
             "events": [{"kind": rec["kind"],
                         "t_ms": _ms(t0, rec.get("t_mono")),
@@ -275,6 +290,20 @@ def main(argv=None):
               f"{_fmt(r['queue_ms']):>9}  {_fmt(r['decode_ms']):>10}  "
               f"{_fmt(r['total_ms']):>9}  {r['dispatches']:>4}  "
               f"{' '.join(r['annotations'])}")
+    # adapter pool lifecycle: engine-scoped (no request_id), so these
+    # never appear inside a chain — one line per upload/evict keeps the
+    # multi-tenant pool's churn visible next to the request table
+    pool_evs = [rec for rec in events if rec.get("kind") in _POOL_EVENTS
+                and rec.get("request_id") is None]
+    if pool_evs:
+        print("-- adapter pool events:")
+        for rec in sorted(pool_evs, key=lambda r: r.get("t_mono", 0)):
+            extras = {k: v for k, v in rec.items()
+                      if k not in ("kind", "ts", "t_mono") and
+                      v is not None}
+            detail = "  ".join(f"{k}={v}"
+                               for k, v in sorted(extras.items()))
+            print(f"   {rec['kind']:<14} {detail}")
     n_pre = sum(1 for r in rows if "PREEMPT" in r["annotations"])
     n_fo = sum(1 for r in rows if "FAILOVER" in r["annotations"])
     n_mig = sum(1 for r in rows if r["migrations"])
