@@ -11,6 +11,9 @@ Endpoints:
 
     /          index (HTML link list)
     /metrics   Prometheus text exposition of the process registry
+    /metricz   same exposition with optional label aggregation:
+               ?aggregate=engine merges per-replica series into fleet
+               totals so one scrape covers all replicas
     /healthz   JSON liveness: per-engine + executor heartbeats with
                last-progress ages, overall ok/stalled verdict
     /varz      JSON everything: registry snapshot + tracer stats +
@@ -18,6 +21,12 @@ Endpoints:
     /tracez    recent tracer spans as JSON; ?request_id= filters to one
                request's end-to-end timeline; ?limit=N newest N;
                ?chrome=1 downloads a catapult chrome-trace instead
+    /tickz     engine tick-profiler flight ring (tick_profile engines):
+               per-tick phase decomposition; ?engine= one engine,
+               ?limit=N newest N, ?chrome=1 chrome-trace download
+    /compilez  executable cost & compile journal (tick_profile
+               engines): per-family count/cost/share + compile-event
+               records; ?engine= one engine, ?limit=N newest records
     /requestz  serving request-lifecycle events (the installed request
                log's ring): in-flight ids + recent transitions;
                ?request_id= one request's timeline, ?limit=N newest N
@@ -41,7 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from .export import spans_to_events
+from .export import spans_to_events, ticks_to_events
 from .metrics import MetricsRegistry, get_registry
 from .tracer import Span, Tracer, get_tracer
 from . import request_log as _request_log
@@ -50,16 +59,24 @@ from . import watchdog as _watchdog
 
 __all__ = ["DebugServer", "start_debug_server", "acquire_debug_server",
            "release_debug_server", "stop_debug_server",
-           "get_debug_server", "registry_rollup", "ratio"]
+           "get_debug_server", "registry_rollup", "ratio",
+           "register_perf_source", "unregister_perf_source"]
 
 _INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
 <h1>paddle_tpu live diagnostics</h1><ul>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
 <li><a href="/healthz">/healthz</a> — engine/executor liveness</li>
 <li><a href="/varz">/varz</a> — registry + tracer + process snapshot</li>
+<li><a href="/metricz">/metricz</a> — Prometheus exposition with
+    optional aggregation (<code>?aggregate=engine</code>)</li>
 <li><a href="/tracez">/tracez</a> — recent spans
     (<code>?request_id=</code>, <code>?limit=</code>,
      <code>?chrome=1</code>)</li>
+<li><a href="/tickz">/tickz</a> — engine tick-profiler flight ring
+    (<code>?engine=</code>, <code>?limit=</code>,
+     <code>?chrome=1</code>)</li>
+<li><a href="/compilez">/compilez</a> — executable cost &amp; compile
+    journal (<code>?engine=</code>, <code>?limit=</code>)</li>
 <li><a href="/trainz">/trainz</a> — training telemetry: latest step
     scalars + recompile log (<code>?limit=</code>)</li>
 <li><a href="/requestz">/requestz</a> — serving request-lifecycle
@@ -72,6 +89,44 @@ _INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
 
 def _span_request_id(s: Span) -> Optional[str]:
     return s.args.get("request_id") if s.args else None
+
+
+# ---------------------------------------------------------------------------
+# perf-source registry: tick_profile engines register snapshot providers
+# here (closures over their flight ring / compile journal) so /tickz and
+# /compilez can serve them WITHOUT the server holding engine references —
+# the engine owns the lifecycle (register at construction, unregister in
+# close()), the server only ever iterates a copied mapping.
+# ---------------------------------------------------------------------------
+
+_PERF_SOURCES: Dict[str, Dict[str, Any]] = {"tick": {}, "compile": {}}
+_PERF_LOCK = threading.Lock()
+
+
+def register_perf_source(kind: str, label: str, provider) -> None:
+    """Install a zero-arg snapshot provider for `kind` ("tick" or
+    "compile") under an engine label. The tick_profile engine wiring;
+    last registration per (kind, label) wins."""
+    if kind not in _PERF_SOURCES:
+        raise ValueError(f"unknown perf-source kind {kind!r}: expected "
+                         f"one of {sorted(_PERF_SOURCES)}")
+    with _PERF_LOCK:
+        _PERF_SOURCES[kind][str(label)] = provider
+
+
+def unregister_perf_source(kind: str, label: str) -> None:
+    """Drop a provider (engine close(); unknown labels are a no-op —
+    teardown must be idempotent)."""
+    if kind not in _PERF_SOURCES:
+        raise ValueError(f"unknown perf-source kind {kind!r}: expected "
+                         f"one of {sorted(_PERF_SOURCES)}")
+    with _PERF_LOCK:
+        _PERF_SOURCES[kind].pop(str(label), None)
+
+
+def _perf_sources(kind: str) -> Dict[str, Any]:
+    with _PERF_LOCK:
+        return dict(_PERF_SOURCES[kind])
 
 
 def _series_by_label(snap: Dict[str, Any], family: str, label_key: str,
@@ -245,6 +300,21 @@ def _serving_varz(snap: Dict[str, Any]) -> Dict[str, Any]:
     })
     if adapters:
         out["adapters"] = adapters
+    # engine tick-phase attribution (ServingConfig(tick_profile=True)
+    # engines only — same conditional discipline as the adapter block:
+    # profile-less fleets keep their /varz payload byte-identical):
+    # per-phase tick counts, total seconds, and each phase's SHARE of
+    # all attributed host time — the where-did-the-tick-go rollup
+    tick = registry_rollup(snap, {
+        "count": ("serving_tick_phase_seconds", "count", int),
+        "seconds_total": ("serving_tick_phase_seconds", "sum", float),
+    }, label_key="phase")
+    if tick:
+        total = sum(row["seconds_total"] for row in tick.values())
+        for row in tick.values():
+            row["share"] = (round(row["seconds_total"] / total, 4)
+                            if total > 0 else None)
+        out["tick_phases"] = tick
     return out
 
 
@@ -253,9 +323,11 @@ _BAD_LIMIT = object()   # _parse_limit sentinel: 400 already sent
 
 def _parse_limit(h, q: Dict[str, str], default):
     """Parse ``?limit=`` for the ring-serving endpoints (/tracez,
-    /trainz, /requestz): a non-negative int, `default` when absent.
-    A malformed or negative value sends the 400 and returns
-    `_BAD_LIMIT` — the caller just returns."""
+    /trainz, /requestz, /tickz, /compilez): a non-negative int,
+    `default` when absent. A malformed or negative value sends the 400
+    and returns `_BAD_LIMIT` — the caller just returns. EVERY ring
+    endpoint must route its limit through here (the meta-test in
+    test_observability sweeps them all for the 400 contract)."""
     raw = q.get("limit")
     if raw is None:
         return default
@@ -337,8 +409,10 @@ class DebugServer:
             "debug_server_requests_total", "debug endpoint hits, by path")
         self.routes = {
             "/": self._index, "/metrics": self._metrics,
+            "/metricz": self._metricz,
             "/healthz": self._healthz, "/varz": self._varz,
             "/tracez": self._tracez, "/trainz": self._trainz,
+            "/tickz": self._tickz, "/compilez": self._compilez,
             "/requestz": self._requestz, "/stacksz": self._stacksz,
         }
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -366,6 +440,17 @@ class DebugServer:
 
     def _metrics(self, h: _Handler, q: Dict[str, str]) -> None:
         h._send(self._registry.to_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+
+    def _metricz(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Prometheus text exposition of the whole registry, with
+        optional label aggregation: ?aggregate=engine merges every
+        per-replica series into fleet totals (counters/gauges sum,
+        same-layout histograms merge bucket-wise), so one scrape line
+        covers all replicas in the process."""
+        text = self._registry.to_prometheus(
+            aggregate_label=q.get("aggregate"))
+        h._send(text.encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
 
     def _healthz(self, h: _Handler, q: Dict[str, str]) -> None:
@@ -460,6 +545,66 @@ class DebugServer:
             "log_path": logger.log_path if logger else None,
             "steps": logger.recent(limit) if logger else [],
             "recompiles": _train_stats.recompile_log(limit),
+        })
+
+    def _tickz(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Engine tick-profiler flight ring: per-tick phase
+        decomposition records from every registered tick_profile
+        engine. ?engine= one engine's ring; ?limit=N newest N per
+        engine; ?chrome=1 downloads the rings as a catapult
+        chrome-trace (one phase sub-span per record)."""
+        limit = _parse_limit(h, q, default=100)
+        if limit is _BAD_LIMIT:
+            return
+        sources = _perf_sources("tick")
+        engine = q.get("engine")
+        if engine is not None:
+            sources = {k: v for k, v in sources.items() if k == engine}
+        engines = {}
+        for label in sorted(sources):
+            records = list(sources[label]() or [])
+            engines[label] = records[-limit:] if limit else []
+        if _query_flag(q, "chrome"):
+            events = []
+            for label, records in engines.items():
+                events.extend(ticks_to_events(label, records))
+            payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+            h._send(json.dumps(payload, default=str).encode(),
+                    "application/json",
+                    extra={"Content-Disposition":
+                           'attachment; filename="ticks.json"'})
+            return
+        h._send_json({
+            "enabled": bool(sources),
+            "engine": engine,
+            "count": sum(len(v) for v in engines.values()),
+            "engines": engines,
+        })
+
+    def _compilez(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Executable cost & compile journal: per-family attribution
+        (calls, compiles, compile seconds + share, cost_analysis
+        FLOPs/bytes) and the compile-event records from every
+        registered tick_profile engine. ?engine= one engine;
+        ?limit=N newest N records per engine."""
+        limit = _parse_limit(h, q, default=None)
+        if limit is _BAD_LIMIT:
+            return
+        sources = _perf_sources("compile")
+        engine = q.get("engine")
+        if engine is not None:
+            sources = {k: v for k, v in sources.items() if k == engine}
+        engines = {}
+        for label in sorted(sources):
+            snap = dict(sources[label]() or {})
+            if limit is not None:
+                records = snap.get("records", [])
+                snap["records"] = records[-limit:] if limit else []
+            engines[label] = snap
+        h._send_json({
+            "enabled": bool(sources),
+            "engine": engine,
+            "engines": engines,
         })
 
     def _requestz(self, h: _Handler, q: Dict[str, str]) -> None:

@@ -26,8 +26,8 @@ from typing import Any, Dict, Iterable, List, Optional
 
 from .tracer import Span, Tracer, get_tracer
 
-__all__ = ["spans_to_events", "export_chrome_trace", "self_times",
-           "summarize", "summarize_chrome_events"]
+__all__ = ["spans_to_events", "ticks_to_events", "export_chrome_trace",
+           "self_times", "summarize", "summarize_chrome_events"]
 
 
 def spans_to_events(spans: Iterable[Span], pid: int = 0) -> List[dict]:
@@ -46,6 +46,37 @@ def spans_to_events(spans: Iterable[Span], pid: int = 0) -> List[dict]:
         if s.args:
             ev["args"] = dict(s.args)
         events.append(ev)
+    return events
+
+
+def ticks_to_events(label: str, records: Iterable[dict],
+                    pid: int = 0) -> List[dict]:
+    """Tick-profiler flight-ring records -> chrome trace events: one
+    track per engine label, one consecutive "X" event per non-zero
+    phase of each tick (scaled to the measured phase seconds, ending at
+    the record's t_mono stamp — the /tickz?chrome=1 renderer). Phase
+    order inside a record follows the engine's phases dict, which the
+    profiler keeps in tick execution order."""
+    tid = abs(hash(("tick", label))) % (1 << 31)
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "paddle_tpu"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+         "args": {"name": f"engine {label} ticks"}}]
+    for rec in records:
+        phases = rec.get("phases") or {}
+        end_us = float(rec.get("t_mono", 0.0)) * 1e6
+        ts = end_us - sum(float(s) for s in phases.values()) * 1e6
+        for phase, seconds in phases.items():
+            dur = float(seconds) * 1e6
+            if dur <= 0:
+                continue
+            events.append({"name": f"serving/tick/{phase}",
+                           "cat": "serving", "ph": "X", "ts": ts,
+                           "dur": dur, "pid": pid, "tid": tid,
+                           "args": {"engine": label,
+                                    "step": rec.get("step")}})
+            ts += dur
     return events
 
 

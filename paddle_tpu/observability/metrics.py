@@ -350,29 +350,84 @@ class MetricsRegistry:
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
 
-    def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def to_prometheus(self, aggregate_label: Optional[str] = None) -> str:
+        """Prometheus text exposition format 0.0.4.
+
+        `aggregate_label` merges every series carrying that label by
+        dropping it: counters and gauges sum their values; histograms
+        merge only when the colliding series share an identical bucket
+        layout (cumulative per-bucket counts sum elementwise, `_sum`
+        and `_count` add — cumulative counts are summable because each
+        input is already cumulative over the same bounds). Series NOT
+        carrying the label, and histogram series whose layouts differ,
+        pass through unmerged. One scrape of a router with
+        aggregate_label="engine" reads as fleet totals."""
         lines: List[str] = []
         for fam in self.families():
             name = _prom_name(fam.name)
             if fam.help:
                 lines.append(f"# HELP {name} {_prom_escape(fam.help)}")
             lines.append(f"# TYPE {name} {fam.kind}")
-            for labels, series in fam.series_items():
+            for labels, series in self._export_series(fam, aggregate_label):
                 if fam.kind == "histogram":
-                    for le, cum in series.cumulative_buckets():
+                    for le, cum in series["buckets"]:
                         lines.append(
                             f"{name}_bucket"
                             f"{_prom_labels({**labels, 'le': le})} {cum}")
                     lines.append(
                         f"{name}_sum{_prom_labels(labels)} "
-                        f"{_prom_num(series.sum)}")
+                        f"{_prom_num(series['sum'])}")
                     lines.append(
-                        f"{name}_count{_prom_labels(labels)} {series.count}")
+                        f"{name}_count{_prom_labels(labels)} "
+                        f"{series['count']}")
                 else:
                     lines.append(f"{name}{_prom_labels(labels)} "
-                                 f"{_prom_num(series.value)}")
+                                 f"{_prom_num(series['value'])}")
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _export_series(fam: MetricFamily,
+                       aggregate_label: Optional[str]):
+        """(labels, flat-series) pairs for exposition, optionally with
+        `aggregate_label` dropped and colliding series merged."""
+        flat: List[tuple] = []
+        for labels, series in fam.series_items():
+            if fam.kind == "histogram":
+                flat.append((labels, {
+                    "buckets": list(series.cumulative_buckets()),
+                    "sum": series.sum, "count": series.count}))
+            else:
+                flat.append((labels, {"value": series.value}))
+        if aggregate_label is None:
+            return flat
+        merged: Dict[tuple, tuple] = {}
+        order: List[tuple] = []
+        for labels, data in flat:
+            if aggregate_label not in labels:
+                key = ("raw", len(order))
+                merged[key] = (labels, data)
+                order.append(key)
+                continue
+            kept = {k: v for k, v in labels.items()
+                    if k != aggregate_label}
+            key = ("agg", tuple(sorted(kept.items())))
+            if fam.kind == "histogram":
+                # bucket layouts must match exactly to be summable
+                key = key + (tuple(le for le, _ in data["buckets"]),)
+            prev = merged.get(key)
+            if prev is None:
+                merged[key] = (kept, data)
+                order.append(key)
+            elif fam.kind == "histogram":
+                acc = prev[1]
+                acc["buckets"] = [
+                    (le, a + b) for (le, a), (_, b)
+                    in zip(acc["buckets"], data["buckets"])]
+                acc["sum"] += data["sum"]
+                acc["count"] += data["count"]
+            else:
+                prev[1]["value"] += data["value"]
+        return [merged[k] for k in order]
 
 
 def _prom_name(name: str) -> str:

@@ -236,6 +236,29 @@ class Tracer:
                           (end_ns - begin_ns) / 1e3, t.ident, t.name, 0,
                           _attach_request_id(args)))
 
+    def record_partition(self, prefix: str, end_ns: int,
+                         parts, cat: str = "",
+                         args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a just-closed window as CONSECUTIVE named sub-spans
+        scaled to measured durations: `parts` is [(name, seconds), ...]
+        in execution order, the window ends at `end_ns` (monotonic_ns)
+        and begins sum(seconds) earlier. The retroactive-partition
+        idiom the engine's tick profiler uses to land its per-phase
+        attribution on the trace timeline (`<prefix>/<name>` spans);
+        zero-duration parts are skipped — an idle phase must not spam
+        the ring."""
+        if not self._enabled:
+            return
+        begin_ns = end_ns - int(sum(s for _, s in parts) * 1e9)
+        cursor = begin_ns
+        for name, seconds in parts:
+            if seconds <= 0:
+                continue
+            nxt = cursor + int(seconds * 1e9)
+            self.record_complete(f"{prefix}/{name}", cursor, nxt,
+                                 cat, args)
+            cursor = nxt
+
     # -- inspection ----------------------------------------------------------
 
     def snapshot(self) -> List[Span]:

@@ -1277,6 +1277,20 @@ class Router:
         scoring happens here, so one report covers the fleet)."""
         return self.metrics.slo_report()
 
+    def prometheus_text(self, aggregate: bool = True) -> str:
+        """Prometheus text exposition of the process registry this
+        router's replicas publish into. With ``aggregate=True`` (the
+        default) every per-replica series — anything carrying an
+        ``engine`` label — merges into fleet totals by dropping the
+        label (counters/gauges sum; histograms sum their cumulative
+        buckets), so ONE scrape of the router covers every replica
+        without per-replica series cardinality; failed-and-rebuilt
+        replicas never leave half-dead labels in the scrape.
+        ``aggregate=False`` passes per-replica series through
+        unchanged (the /metricz?raw=1 escape hatch)."""
+        return self.metrics._registry.to_prometheus(
+            aggregate_label="engine" if aggregate else None)
+
     # -- admission ----------------------------------------------------------
 
     def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:

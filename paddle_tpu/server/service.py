@@ -12,6 +12,9 @@ the container has no web framework and needs none) exposing
                         per-replica slot/queue/block gauges
     GET  /metrics       Prometheus text exposition of the shared
                         process registry (serving_* + server_* series)
+    GET  /metricz       the same exposition with per-replica series
+                        aggregated into fleet totals (one scrape
+                        covers every replica; ?raw=1 disables)
     GET  /              endpoint index
 
 Request JSON: ``{"prompt": [ids...], "max_new_tokens": n}`` plus
@@ -44,7 +47,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -60,6 +63,9 @@ _INDEX = """<html><head><title>paddle_tpu server</title></head><body>
 <li><code>POST /v1/generate</code> — JSON in, SSE token stream out</li>
 <li><a href="/healthz">/healthz</a> — readiness + replica gauges</li>
 <li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
+<li><a href="/metricz">/metricz</a> — Prometheus text exposition with
+per-replica series aggregated into fleet totals (<code>?raw=1</code>
+for per-replica series)</li>
 <li><a href="/slozv">/slozv</a> — per-tenant SLO attainment + goodput</li>
 <li><code>POST /admin/restart</code> — zero-downtime rolling restart of
 one replica (<code>{"replica": i}</code>)</li>
@@ -230,6 +236,15 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/metrics":
                 self._send(srv._registry.to_prometheus().encode(),
                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/metricz":
+                # one scrape covers the fleet: per-replica ("engine"-
+                # labeled) series merge into totals unless ?raw=1
+                q = parse_qs(urlparse(self.path).query)
+                raw = (q.get("raw") or ["0"])[0] not in ("0", "", "false")
+                self._send(
+                    srv.router.prometheus_text(aggregate=not raw)
+                    .encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/slozv":
                 self._slozv(srv)
             elif path == "/v1/generate":
@@ -239,7 +254,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"no such endpoint {path!r}",
                      "endpoints": ["/", "/healthz", "/metrics",
-                                   "/slozv", "/v1/generate",
+                                   "/metricz", "/slozv", "/v1/generate",
                                    "/admin/restart"]},
                     status=404)
         except BrokenPipeError:

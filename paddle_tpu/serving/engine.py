@@ -23,6 +23,7 @@ feed a driver loop.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
@@ -34,8 +35,9 @@ from ..observability import request_log as _request_log
 from ..observability import watchdog as _watchdog
 from ..observability.tracer import get_tracer, request_scope, trace_span
 from .kv_cache import ShapeBuckets, SlotKVCache
-from .metrics import EngineMetrics, RequestMetrics
-from .scheduler import PREFILL_PENDING, ContinuousBatchingScheduler
+from .metrics import _TICK_PHASES, EngineMetrics, RequestMetrics
+from .scheduler import (PREFILL_PENDING, CompileJournal,
+                        ContinuousBatchingScheduler)
 
 _TRACER = get_tracer()
 
@@ -160,8 +162,18 @@ class ServingConfig:
     decode dispatch's wall time into launch-side host work vs the
     blocking wait for its result (serving_dispatch_{host,device}_seconds
     histograms; off by default — disabled adds zero registry series and
-    zero clock reads). The request event log is process-wide, not an
-    engine knob: observability.install_request_log()."""
+    zero clock reads). tick_profile=True turns on the performance-
+    attribution plane: every engine tick is decomposed into phases
+    (admit / prefill_chunk / launch / collect / stream / bookkeeping)
+    published as serving_tick_phase_seconds{phase} histograms, a
+    bounded per-tick flight ring (/tickz), and the executable
+    cost/compile journal (/compilez + serving_compiles_total{family},
+    serving_compile_seconds, and the derived serving_mfu_proxy /
+    serving_dispatch_hbm_bytes gauges). Off — the default — is pinned
+    a no-op: identical metric family set, bit-identical streams,
+    identical compile-event sequence. The request event log is
+    process-wide, not an engine knob:
+    observability.install_request_log()."""
 
     def __init__(self, num_slots: int = 4, max_queue: int = 16,
                  prefill_buckets: Optional[Sequence[int]] = None,
@@ -183,6 +195,7 @@ class ServingConfig:
                  adapter_rank: Optional[int] = None,
                  fault_plan=None,
                  dispatch_timing: bool = False,
+                 tick_profile: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         self.num_slots = int(num_slots)
         self.max_queue = int(max_queue)
@@ -288,6 +301,11 @@ class ServingConfig:
         # serving_dispatch_{host,device}_seconds; off, zero extra
         # registry series and zero extra clock reads)
         self.dispatch_timing = bool(dispatch_timing)
+        # performance-attribution plane (off by default — the disabled
+        # path is pinned byte-identical: no new registry families,
+        # identical streams, identical compile events): per-tick phase
+        # decomposition + flight ring + executable cost/compile journal
+        self.tick_profile = bool(tick_profile)
         self.clock = clock
 
 
@@ -334,6 +352,49 @@ def _default_buckets(max_len: int):
         s *= 2
     sizes.append(max_len)
     return sizes
+
+
+# per-tick flight records kept for /tickz (bounded: a day of serving
+# must not grow host memory — same discipline as the tracer ring)
+TICK_RING_SIZE = 256
+
+
+class _TickClock:
+    """Per-tick phase stopwatch (tick_profile engines only). One
+    instance lives for the engine's life; start() re-arms it at the top
+    of each tick and lap(phase) charges the wall time since the last
+    cut to the named phase — MINUS whatever the scheduler's hooked
+    launch/collect segments already claimed inside that window
+    (hook(), wired as scheduler.on_tick_phase, both credits the named
+    phase and accrues the deduction). The invariant this buys:
+    sum(phases.values()) == the tick's wall time, exactly — no double
+    counting, no unattributed residue — which is what the phase-share
+    rollup in /varz and the phase-sum sanity test key on."""
+
+    __slots__ = ("phases", "_t0", "_tick_t0", "_hooked")
+
+    def __init__(self):
+        self.phases = dict.fromkeys(_TICK_PHASES, 0.0)
+        self._t0 = self._tick_t0 = 0.0
+        self._hooked = 0.0
+
+    def start(self) -> None:
+        self._t0 = self._tick_t0 = time.perf_counter()
+        self._hooked = 0.0
+        for phase in _TICK_PHASES:
+            self.phases[phase] = 0.0
+
+    def hook(self, phase: str, seconds: float) -> None:
+        # a scheduler-owned segment (launch/collect) inside the current
+        # lap window: credit its own phase, deduct it from the lap
+        self.phases[phase] += seconds
+        self._hooked += seconds
+
+    def lap(self, phase: str) -> None:
+        now = time.perf_counter()
+        self.phases[phase] += (now - self._t0) - self._hooked
+        self._hooked = 0.0
+        self._t0 = now
 
 
 class ServingEngine:
@@ -466,12 +527,39 @@ class ServingEngine:
                                      * (1 + serving.speculate_k)),
             speculate_k=serving.speculate_k,
             dispatch_timing=serving.dispatch_timing,
-            adapters=self.adapters is not None)
+            adapters=self.adapters is not None,
+            tick_profile=serving.tick_profile)
         if serving.dispatch_timing:
             self.scheduler.dispatch_timing = True
             # bound through self.metrics at CALL time so a bench's
             # metrics reset keeps feeding the replacement instance
             self.scheduler.on_dispatch_timed = self._on_dispatch_timed
+        # performance-attribution plane (tick_profile=True only — the
+        # default constructs NONE of this: no stopwatch, no ring, no
+        # journal, and the registry family set is pinned unchanged)
+        self._tick = None
+        self._tick_ring = None
+        if serving.tick_profile:
+            self._tick = _TickClock()
+            self._tick_ring = collections.deque(maxlen=TICK_RING_SIZE)
+            # scheduler-owned launch/collect segments feed the same
+            # per-tick stopwatch the engine laps the host phases into
+            self.scheduler.on_tick_phase = self._tick.hook
+            journal = CompileJournal()
+            # bound through self.metrics at CALL time (bench reset
+            # discipline, same as the other hooks)
+            journal.on_compile = self._on_compile
+            self.scheduler.compile_journal = journal
+            # /tickz + /compilez read through the debug server's
+            # perf-source registry — closures here, unregistered in
+            # close(), so the server itself still holds no references
+            # into the engine beyond this explicit lifecycle
+            from ..observability import debug_server as _dbg
+            _dbg.register_perf_source(
+                "tick", self.metrics.engine_label, self._tick_records)
+            _dbg.register_perf_source(
+                "compile", self.metrics.engine_label,
+                self._compile_snapshot)
         self.metrics.kv_blocks_total = self.kv.blocks_total
         # mesh + quantization geometry gauges, constant for the
         # engine's life: the shard count, the PER-CHIP arena bytes
@@ -674,6 +762,10 @@ class ServingEngine:
     def _step_impl(self) -> int:
         step_no = self._step_no
         self._step_no += 1
+        tp = self._tick   # tick profiler (None = pinned off path:
+        #                   zero clock reads in this whole method)
+        if tp is not None:
+            tp.start()
         if self.faults is not None:
             # counter already advanced: an injected exception fires
             # exactly once, and a supervisor retrying the driver loop
@@ -694,6 +786,8 @@ class ServingEngine:
                     if len(self._swapped) != n:
                         self.metrics.swapped_slots = len(self._swapped)
             self._pending_cancels.clear()
+        if tp is not None:   # deferred cancels are bookkeeping, not
+            tp.lap("bookkeeping")   # admission work
         # resume-first: preempted sequences have strict priority over
         # new admissions for freed pages/slots (they hold finished work
         # and a host-side arena copy; admissions behind them are what
@@ -783,6 +877,8 @@ class ServingEngine:
                     emitted += 1
                 # else: chunked prefill — pages mapped, first token
                 # surfaces from a later advance_prefill tick below
+        if tp is not None:   # swap-ins, queue pops, and admissions
+            tp.lap("admit")  # (their prefill dispatches included)
         # chunked prefill: dispatch at most one prefill token budget,
         # interleaved with (and ordered before) this tick's decode
         # dispatch; completed prefills' first tokens fan out here.
@@ -790,13 +886,22 @@ class ServingEngine:
         for event in self.scheduler.advance_prefill():
             self._emit(event)
             emitted += 1
+        if tp is not None:
+            tp.lap("prefill_chunk")
         events = self.scheduler.step()
+        if tp is not None:
+            # the scheduler's hooked launch/collect segments already
+            # claimed their share of this window; the residue
+            # (_needs_dispatch scans, pipeline bookkeeping) is ours
+            tp.lap("bookkeeping")
         if events:
             self.metrics.decode_steps += 1
             self.metrics.observe_dispatch_tokens(len(events))
         for event in events:
             self._emit(event)
             emitted += 1
+        if tp is not None:   # token fan-out: callbacks + journal writes
+            tp.lap("stream")
         if self.scheduler.speculate_k:
             # speculation telemetry: the scheduler's cumulative host
             # totals ARE the registry truth (same discipline as the
@@ -827,6 +932,9 @@ class ServingEngine:
         self.metrics.weight_bytes = self.weight_bytes
         if self.adapters is not None:
             self._sync_adapter_metrics()
+        if tp is not None:
+            tp.lap("bookkeeping")   # gauge/counter sync tail
+            self._finish_tick(step_no, emitted)
         return emitted
 
     def _admission_feasible(self, req, step_no: int) -> bool:
@@ -1143,6 +1251,60 @@ class ServingEngine:
     def _on_dispatch_timed(self, host_s: float, device_s: float) -> None:
         self.metrics.observe_dispatch_split(host_s, device_s)
 
+    def _on_compile(self, family: str, seconds: float) -> None:
+        self.metrics.observe_compile(family, seconds)
+
+    @property
+    def compile_journal(self):
+        """The executable cost & compile journal (CompileJournal), or
+        None unless ServingConfig(tick_profile=True)."""
+        return self.scheduler.compile_journal
+
+    def _tick_records(self) -> List[Dict[str, Any]]:
+        """The /tickz perf-source provider: the bounded per-tick flight
+        ring, oldest first."""
+        return list(self._tick_ring) if self._tick_ring is not None \
+            else []
+
+    def _compile_snapshot(self) -> Dict[str, Any]:
+        """The /compilez perf-source provider: the journal's per-family
+        attribution table plus the compile-event records."""
+        journal = self.scheduler.compile_journal
+        if journal is None:
+            return {"families": {}, "records": []}
+        snap = journal.snapshot()
+        snap["records"] = list(journal.records)
+        return snap
+
+    def _finish_tick(self, step_no: int, emitted: int) -> None:
+        """Publish one completed tick: per-phase histogram samples, a
+        flight-ring record (t_mono-stamped so serving_summary --phases
+        can join it against the request log), and the journal-derived
+        mfu/bytes gauges."""
+        phases = self._tick.phases
+        wall = 0.0
+        for phase in _TICK_PHASES:
+            seconds = phases[phase]
+            wall += seconds
+            self.metrics.observe_tick_phase(phase, seconds)
+        self._tick_ring.append({
+            "step": step_no, "t_mono": time.monotonic(),
+            "wall_s": wall, "phases": dict(phases),
+            "emitted": emitted, "active": self.kv.active_count,
+            "queue": len(self._queue)})
+        journal = self.scheduler.compile_journal
+        if journal is not None:
+            self.metrics.set_perf_gauges(journal.mfu_proxy(),
+                                         journal.dispatch_hbm_bytes())
+        if _TRACER.enabled:
+            # retroactive phase sub-spans on the trace timeline, scaled
+            # to the measured durations (the decode_iter interpolation
+            # idiom): the tick just ended, so the window closes now
+            _TRACER.record_partition(
+                "serving/tick", time.monotonic_ns(),
+                [(phase, phases[phase]) for phase in _TICK_PHASES],
+                "serving", {"step": step_no, "emitted": emitted})
+
     def run_until_drained(self, max_steps: Optional[int] = None) -> int:
         """Step until queue, slots, and swap pool are empty; returns
         steps taken."""
@@ -1272,6 +1434,15 @@ class ServingEngine:
         replacement never kills diagnostics under a live engine.
         stats()/metrics keep working locally afterwards."""
         self.metrics.unregister()
+        if self._tick is not None:
+            # drop the /tickz + /compilez provider closures — the
+            # perf-source registry must never outlive the engine it
+            # reads from
+            from ..observability import debug_server as _dbg
+            _dbg.unregister_perf_source("tick",
+                                        self.metrics.engine_label)
+            _dbg.unregister_perf_source("compile",
+                                        self.metrics.engine_label)
         if self._debug_server_ref is not None:
             from ..observability.debug_server import release_debug_server
             token, self._debug_server_ref = self._debug_server_ref, None

@@ -17,7 +17,11 @@ decoder's `serving_spec_{proposed,accepted}_total`), gauges
 `serving_ttft_seconds` / `serving_tpot_seconds` /
 `serving_queue_wait_seconds` (and, only when the engine runs with
 `dispatch_timing=True`, the host/device split pair
-`serving_dispatch_{host,device}_seconds`) — so a Prometheus
+`serving_dispatch_{host,device}_seconds`; and, only with
+`tick_profile=True`, the performance-attribution plane:
+`serving_tick_phase_seconds{phase}`, `serving_compiles_total{family}`,
+`serving_compile_seconds`, and the derived `serving_mfu_proxy` /
+`serving_dispatch_hbm_bytes` gauges) — so a Prometheus
 scrape or `get_registry().snapshot()` sees the serving plane without
 holding the engine, and the bench's p50/p99 rows come registry-sourced.
 `snapshot()` still returns the same plain dict as before (scrapers and
@@ -227,6 +231,42 @@ _TIMING_HELP = {
                        "its result (un-hidden device execution)",
 }
 
+# performance-attribution plane (ServingConfig(tick_profile=True) only
+# — the disabled default must add ZERO registry families/series, same
+# discipline as the dispatch-timing pair): per-tick phase decomposition
+# of the GIL-bound host loop, plus the executable compile/cost journal
+# series the /compilez endpoint and the mfu-proxy gauges are derived
+# from.
+_TICK_PHASES = ("admit", "prefill_chunk", "launch", "collect",
+                "stream", "bookkeeping")
+# host-tick phases live at the microsecond scale, far below the
+# latency-seconds default grid — a dedicated fine grid keeps the phase
+# histograms from piling into the bottom bucket
+_TICK_PHASE_BUCKETS = (1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4,
+                       1e-3, 5e-3, 0.01, 0.05, 0.25)
+# compiles are seconds-to-minutes events; the default sub-second grid
+# would dump every real XLA compile into +Inf
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0)
+_TICK_HELP = {
+    "tick_phase": "host wall seconds per engine tick phase (admit / "
+                  "prefill_chunk / launch / collect / stream / "
+                  "bookkeeping) — the phase decomposition the native "
+                  "continuous-batching core is scoped and judged by",
+    "compiles": "executable compile events per jit family (one per "
+                "newly traced shape bucket; steady state adds none)",
+    "compile_seconds": "wall seconds spent inside dispatches that "
+                       "triggered a compile (trace + XLA compile + "
+                       "first execution)",
+    "mfu_proxy": "model-FLOPs-utilization proxy: cost_analysis FLOPs "
+                 "x dispatch rate over nominal peak FLOPs (override "
+                 "peak via PT_SERVING_PEAK_FLOPS) — a trend line, "
+                 "not an absolute MFU",
+    "dispatch_hbm_bytes": "cost_analysis bytes accessed per fused "
+                          "decode dispatch (the HBM roofline side of "
+                          "the attribution)",
+}
+
 # multi-tenant adapter pool series (ServingConfig(max_adapters=...)
 # engines only — the adapterless default must add ZERO registry
 # families/series, same discipline as the dispatch-timing pair): the
@@ -286,7 +326,7 @@ class EngineMetrics:
                  engine_label: Optional[str] = None,
                  max_tokens_per_dispatch: Optional[int] = None,
                  speculate_k: int = 0, dispatch_timing: bool = False,
-                 adapters: bool = False):
+                 adapters: bool = False, tick_profile: bool = False):
         self._registry = registry or get_registry()
         self.engine_label = str(engine_label if engine_label is not None
                                 else next(EngineMetrics._ids))
@@ -299,9 +339,15 @@ class EngineMetrics:
         self.speculate_k = int(speculate_k)
         self.dispatch_timing = bool(dispatch_timing)
         self.adapters = bool(adapters)
+        self.tick_profile = bool(tick_profile)
         label = {"engine": self.engine_label}
         self._families = []
         self._series = {}
+        # multi-label series (engine+phase / engine+family) tracked
+        # with their FULL label sets: MetricFamily.remove() matches the
+        # exact key tuple, so unregister()'s engine-only sweep would
+        # leave them behind
+        self._labeled = []
         for name in _COUNTERS:
             fam = self._registry.counter(
                 f"serving_{name}_total", _HELP[name])
@@ -343,6 +389,38 @@ class EngineMetrics:
                 fam = self._registry.histogram(full, _TIMING_HELP[key])
                 self._families.append(fam)
                 self._hists[key] = fam.labels(**label)
+        if self.tick_profile:
+            # performance-attribution series, registered ONLY when the
+            # tick profiler is on — the default family set is pinned
+            # unchanged (test_tick_profile_disabled_is_noop)
+            fam = self._registry.histogram(
+                "serving_tick_phase_seconds", _TICK_HELP["tick_phase"],
+                buckets=_TICK_PHASE_BUCKETS)
+            self._families.append(fam)
+            self._tick_phase = {}
+            for phase in _TICK_PHASES:
+                s = fam.labels(engine=self.engine_label, phase=phase)
+                self._tick_phase[phase] = s
+                self._labeled.append((fam, {"engine": self.engine_label,
+                                            "phase": phase}))
+            self._compiles_fam = self._registry.counter(
+                "serving_compiles_total", _TICK_HELP["compiles"])
+            self._families.append(self._compiles_fam)
+            self._compiles = {}   # family tag -> counter series (lazy)
+            fam = self._registry.histogram(
+                "serving_compile_seconds", _TICK_HELP["compile_seconds"],
+                buckets=_COMPILE_BUCKETS)
+            self._families.append(fam)
+            self._hists["compile"] = fam.labels(**label)
+            fam = self._registry.gauge(
+                "serving_mfu_proxy", _TICK_HELP["mfu_proxy"])
+            self._families.append(fam)
+            self._series["mfu_proxy"] = fam.labels(**label)
+            fam = self._registry.gauge(
+                "serving_dispatch_hbm_bytes",
+                _TICK_HELP["dispatch_hbm_bytes"])
+            self._families.append(fam)
+            self._series["dispatch_hbm_bytes"] = fam.labels(**label)
         if self.adapters:
             # adapter pool series, registered ONLY for pool-carrying
             # engines — the adapterless family set is pinned unchanged
@@ -362,6 +440,8 @@ class EngineMetrics:
         retired/replaced engine stops showing up in scrapes (a long-lived
         service recreating engines must not accumulate dead labels).
         snapshot() keeps working on the detached series."""
+        for fam, labels in self._labeled:
+            fam.remove(**labels)
         for fam in self._families:
             fam.remove(engine=self.engine_label)
 
@@ -396,6 +476,45 @@ class EngineMetrics:
         — the latency series behind the bench's swap_in_ms column."""
         self._hists[direction].observe(float(seconds))
 
+    def observe_tick_phase(self, phase: str, seconds: float) -> None:
+        """One engine tick spent `seconds` of host wall time in the
+        named phase — the decomposition behind the /varz tick_phases
+        rollup, the /tickz flight ring, and the bench's tick_phase_ms
+        columns. No-op unless this instance was built with
+        tick_profile=True (the series don't exist otherwise)."""
+        if not self.tick_profile:
+            return
+        self._tick_phase[phase].observe(float(seconds))
+
+    def observe_compile(self, family: str, seconds: float) -> None:
+        """One dispatch of jit family `family` triggered a compile that
+        took `seconds` wall time (trace + XLA compile + first run).
+        Series per family are minted lazily — families only exist once
+        they have compiled at least once. No-op unless tick_profile."""
+        if not self.tick_profile:
+            return
+        s = self._compiles.get(family)
+        if s is None:
+            labels = {"engine": self.engine_label, "family": family}
+            s = self._compiles_fam.labels(**labels)
+            self._compiles[family] = s
+            self._labeled.append((self._compiles_fam, labels))
+        s.inc()
+        self._hists["compile"].observe(float(seconds))
+
+    def set_perf_gauges(self, mfu_proxy: Optional[float],
+                        hbm_bytes: Optional[float]) -> None:
+        """Refresh the derived cost x dispatch-rate gauges from the
+        compile journal (None leaves a gauge untouched — cost analysis
+        is best-effort and may be unavailable for a family). No-op
+        unless tick_profile."""
+        if not self.tick_profile:
+            return
+        if mfu_proxy is not None:
+            self._series["mfu_proxy"].set(float(mfu_proxy))
+        if hbm_bytes is not None:
+            self._series["dispatch_hbm_bytes"].set(float(hbm_bytes))
+
     def observe_dispatch_split(self, host_s: float,
                                device_s: float) -> None:
         """One fused decode dispatch spent `host_s` launch-side and
@@ -425,6 +544,9 @@ class EngineMetrics:
         for name in _ADAPTER_COUNTERS + _ADAPTER_GAUGES:
             if name in self._series:   # pool-carrying engines only
                 out[name] = int(self._series[name].value)
+        for name in ("mfu_proxy", "dispatch_hbm_bytes"):
+            if name in self._series:   # tick_profile engines only
+                out[name] = float(self._series[name].value)
         for key, h in self._hists.items():
             out[f"mean_{key}"] = h.mean
             out[f"p50_{key}"] = h.quantile(0.5)

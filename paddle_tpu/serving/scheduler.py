@@ -161,6 +161,7 @@ EXACTLY the pre-adapter graph.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
@@ -173,8 +174,8 @@ from .kv_cache import ShapeBuckets, SlotKVCache
 
 _TRACER = get_tracer()
 
-__all__ = ["ContinuousBatchingScheduler", "SequenceEvent",
-           "SwappedSequence", "PREFILL_PENDING"]
+__all__ = ["CompileJournal", "ContinuousBatchingScheduler",
+           "SequenceEvent", "SwappedSequence", "PREFILL_PENDING"]
 
 # admit()'s "admission succeeded, first token pending" sentinel
 # (chunked prefill only): pages are mapped and the slot is prefilling,
@@ -290,6 +291,128 @@ class SwappedSequence:
         (scale-plane rows included on a quantized pool)."""
         return self.payload.nbytes + (self.scales.nbytes
                                       if self.scales is not None else 0)
+
+
+# nominal single-chip peak used by the MFU proxy when the operator
+# hasn't told us the real one (PT_SERVING_PEAK_FLOPS). Deliberately a
+# round 1 TFLOP/s: the gauge is a TREND line (cost x dispatch rate over
+# a constant), not an absolute utilization claim — see _TICK_HELP.
+_NOMINAL_PEAK_FLOPS = 1e12
+
+
+class CompileJournal:
+    """Executable cost & compile journal (ServingConfig(tick_profile=
+    True) only — the engine installs one on the scheduler's
+    `compile_journal` attribute; the None default is the pinned bare
+    path). Every jitted dispatch flows through _jit_call, which feeds
+    this journal: per-family call counts, and — on the calls that
+    actually traced a new executable (compile_events grew) — the
+    compile wall seconds plus jax's AOT `cost_analysis()` FLOPs /
+    HBM-bytes for the lowered computation. The derived views are what
+    /compilez, the serving_mfu_proxy / serving_dispatch_hbm_bytes
+    gauges, and tools/perf_summary.py's attribution table read.
+
+    Families are the scheduler's compile-event tags (prefill:L<bucket>,
+    prefill_chunk:L<bucket>, admit_sample, decode_chunk, release_slot,
+    swap_out, swap_in) — the same strings compile_events pins, so the
+    journal can never disagree with the compile-count hook."""
+
+    def __init__(self, clock=time.monotonic, peak_flops=None):
+        if peak_flops is None:
+            try:
+                peak_flops = float(
+                    os.environ.get("PT_SERVING_PEAK_FLOPS") or 0) or None
+            except ValueError:
+                peak_flops = None
+        self.peak_flops = float(peak_flops if peak_flops
+                                else _NOMINAL_PEAK_FLOPS)
+        self._clock = clock
+        self._t0 = clock()
+        # one record per compile event, in dispatch order — the
+        # /compilez ring (bounded by the caller's ?limit, not here:
+        # compiles are O(buckets), never O(requests))
+        self.records: List[Dict[str, Any]] = []
+        # family -> {calls, compiles, compile_s, flops, bytes_accessed}
+        # (flops/bytes are per-DISPATCH costs from the last probe;
+        # None while unknown — cost analysis is best-effort)
+        self.families: Dict[str, Dict[str, Any]] = {}
+        # fired (family, compile seconds) per compile event — the
+        # engine hangs serving_compiles_total{family} +
+        # serving_compile_seconds here
+        self.on_compile = None
+
+    def note_call(self, family: str, seconds: float, compiled: bool,
+                  cost: Optional[Dict[str, float]]) -> None:
+        fam = self.families.get(family)
+        if fam is None:
+            fam = self.families[family] = {
+                "calls": 0, "compiles": 0, "compile_s": 0.0,
+                "flops": None, "bytes_accessed": None}
+        fam["calls"] += 1
+        if not compiled:
+            return
+        fam["compiles"] += 1
+        fam["compile_s"] += seconds
+        flops = bytes_accessed = None
+        if cost:
+            flops = cost.get("flops")
+            bytes_accessed = cost.get("bytes accessed")
+        if flops is not None:
+            fam["flops"] = float(flops)
+        if bytes_accessed is not None:
+            fam["bytes_accessed"] = float(bytes_accessed)
+        self.records.append({
+            "family": family, "compile_s": float(seconds),
+            "flops": None if flops is None else float(flops),
+            "bytes_accessed": (None if bytes_accessed is None
+                               else float(bytes_accessed)),
+            "t_mono": self._clock()})
+        if self.on_compile is not None:
+            self.on_compile(family, seconds)
+
+    def mfu_proxy(self) -> Optional[float]:
+        """FLOPs issued per second over the journal's lifetime, as a
+        fraction of peak_flops: sum over families of calls x per-
+        dispatch FLOPs, divided by elapsed wall seconds and the peak.
+        None until at least one family has a known cost."""
+        elapsed = self._clock() - self._t0
+        if elapsed <= 0:
+            return None
+        issued = 0.0
+        known = False
+        for fam in self.families.values():
+            if fam["flops"] is not None:
+                issued += fam["calls"] * fam["flops"]
+                known = True
+        if not known:
+            return None
+        return issued / elapsed / self.peak_flops
+
+    def dispatch_hbm_bytes(self) -> Optional[float]:
+        """cost_analysis bytes accessed per fused decode dispatch (the
+        decode_chunk family's per-call cost); None while unknown."""
+        fam = self.families.get("decode_chunk")
+        if fam is None:
+            return None
+        return fam["bytes_accessed"]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /compilez + perf_summary view: per-family attribution
+        (count/cost/share of compile seconds) plus the derived
+        gauges."""
+        total_s = sum(f["compile_s"] for f in self.families.values())
+        families = {}
+        for name in sorted(self.families):
+            fam = dict(self.families[name])
+            fam["compile_share"] = (fam["compile_s"] / total_s
+                                    if total_s > 0 else 0.0)
+            families[name] = fam
+        return {"families": families,
+                "compiles_total": len(self.records),
+                "compile_seconds_total": total_s,
+                "peak_flops": self.peak_flops,
+                "mfu_proxy": self.mfu_proxy(),
+                "dispatch_hbm_bytes": self.dispatch_hbm_bytes()}
 
 
 class _Inflight(NamedTuple):
@@ -430,6 +553,19 @@ class ContinuousBatchingScheduler:
         # (jit copies feed arrays at dispatch, so mutation-after-call is
         # safe and admission never allocates)
         self._staging: Dict[int, np.ndarray] = {}
+        # executable cost & compile journal (CompileJournal, installed
+        # by the engine under ServingConfig(tick_profile=True)). The
+        # None default is the pinned bare path: _jit_call dispatches
+        # with one attribute read and ZERO clock reads or probes.
+        self.compile_journal = None
+        # True while _cost_probe re-lowers an already-compiled entry
+        # point: AOT lowering re-runs the impl body, and its
+        # _note_compile side effect must not inflate compile_events
+        self._probing = False
+        # fired ("launch"|"collect", host seconds) around the two
+        # step() segments when the engine's tick profiler is on — the
+        # engine folds them into its per-tick phase decomposition
+        self.on_tick_phase = None
 
     # -- jitted entry points ------------------------------------------------
     #
@@ -522,7 +658,7 @@ class ContinuousBatchingScheduler:
         # (pool,) — the per-slot row vector is already in the carry.
         def prefill_impl(params, arena, pt, state, tokens, pfx_len,
                          real_len, pages, slot, *alo):
-            self._compile_events.append(f"prefill:L{tokens.shape[1]}")
+            self._note_compile(f"prefill:L{tokens.shape[1]}")
             logits, arena = gd.gpt_prefill_pages(
                 params, self.cfg, tokens, pfx_len, real_len, arena,
                 pages, adapters=alo[0] if alo else None,
@@ -545,7 +681,7 @@ class ContinuousBatchingScheduler:
             # body), start_pos is the host-carried fill cursor. The
             # page-row install is idempotent across a prompt's chunks —
             # one executable per chunk bucket, whatever the chunk index.
-            self._compile_events.append(
+            self._note_compile(
                 f"prefill_chunk:L{tokens.shape[1]}")
             logits, arena = gd.gpt_prefill_chunk_pages(
                 params, self.cfg, tokens, start_pos, real_len, arena,
@@ -564,7 +700,7 @@ class ContinuousBatchingScheduler:
 
         def admit_impl(keys, state, slot, seed, logits, temp, pos,
                        max_new, eos_id, prev_tok, *aid):
-            self._compile_events.append("admit_sample")
+            self._note_compile("admit_sample")
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
             keys = keys.at[slot].set(gd.sample_key(seed))
             first, key_next = self._sample_row(keys[slot], logits, temp)
@@ -590,7 +726,7 @@ class ContinuousBatchingScheduler:
             return c_rep(first), c_rep(keys), c_rep(new_state)
 
         def chunk_impl(params, arena, pt, keys, state, *apool):
-            self._compile_events.append("decode_chunk")
+            self._note_compile("decode_chunk")
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
             ad = apool[0] if apool else None
             aids = state[-1] if apool else None
@@ -626,7 +762,7 @@ class ContinuousBatchingScheduler:
             # so its ride-along writes stop touching blocks admission
             # may reallocate (the drafter tail, if any, rides along
             # untouched: the next admission resets it at prefill)
-            self._compile_events.append("release_slot")
+            self._note_compile("release_slot")
             tokens, ts, done, remaining, temps, eos_ids = state[:6]
             pt = pt.at[slot].set(
                 jnp.zeros((pt.shape[1],), jnp.int32))
@@ -644,7 +780,7 @@ class ContinuousBatchingScheduler:
             # pool the payload is the (int8 data, f32 scales) pair —
             # both gathers ride the same block row, so a parked record
             # always carries the scales its rows dequantize under.
-            self._compile_events.append("swap_out")
+            self._note_compile("swap_out")
             if isinstance(arena, tuple):
                 payload = tuple(jnp.take(a, blocks, axis=2)
                                 for a in arena)
@@ -673,7 +809,7 @@ class ContinuousBatchingScheduler:
             # stopped, so resumed streams are bit-identical. Quantized
             # pools scatter data and scale plane together; the int8
             # rows are restored verbatim, never re-quantized.
-            self._compile_events.append("swap_in")
+            self._note_compile("swap_in")
             if isinstance(arena, tuple):
                 arena = tuple(a.at[:, :, blocks].set(p)
                               for a, p in zip(arena, payload))
@@ -716,6 +852,65 @@ class ContinuousBatchingScheduler:
                                    donate_argnums=(0, 1, 2, 3))
 
     # -- compile-counter hook ----------------------------------------------
+
+    def _note_compile(self, tag: str) -> None:
+        """The impl bodies' trace-time side effect: one append per
+        distinct input signature (= per compiled executable). Suppressed
+        while _cost_probe AOT-lowers an already-compiled entry point —
+        lowering re-runs the body, and a probe must never show up as a
+        compile."""
+        if not self._probing:
+            self._compile_events.append(tag)
+
+    def _jit_call(self, family: str, fn, *args):
+        """Dispatch a jitted entry point, feeding the compile journal
+        when one is installed. The journal-less default (the pinned
+        off path) is a single attribute read and a bare call — zero
+        clock reads, zero probes, identical compile events.
+
+        With a journal: the call is timed, and if compile_events grew
+        (this signature traced a new executable) the lowered
+        computation's cost_analysis() FLOPs/bytes are probed and the
+        event is journaled under `family` — the same tag string the
+        impl body appended, so journal and compile_events can never
+        disagree."""
+        journal = self.compile_journal
+        if journal is None:
+            return fn(*args)
+        n0 = len(self._compile_events)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        seconds = time.perf_counter() - t0
+        compiled = len(self._compile_events) > n0
+        cost = self._cost_probe(fn, args) if compiled else None
+        journal.note_call(family, seconds, compiled, cost)
+        return out
+
+    def _cost_probe(self, fn, args) -> Optional[Dict[str, float]]:
+        """Best-effort static cost of `fn` at these argument shapes:
+        AOT-lower on ShapeDtypeStruct avals (no second XLA compile, no
+        device work — the real executable was just built by the timed
+        call) and read cost_analysis() FLOPs / bytes accessed. Returns
+        None whenever the backend can't say — the journal records the
+        compile either way."""
+        import jax
+
+        try:
+            self._probing = True
+            avals = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                if hasattr(a, "shape") and hasattr(a, "dtype")
+                else np.asarray(a), args)
+            cost = fn.lower(*avals).cost_analysis()
+        except Exception:
+            return None
+        finally:
+            self._probing = False
+        if isinstance(cost, (list, tuple)):   # per-device reports
+            cost = cost[0] if cost else None
+        if not isinstance(cost, dict):
+            return None
+        return cost
 
     @property
     def compile_count(self) -> int:
@@ -841,7 +1036,8 @@ class ContinuousBatchingScheduler:
                                   request_id=getattr(req, "request_id",
                                                      None)):
             logits, arena, self._pt, self._state = \
-                self._prefill_jit(
+                self._jit_call(
+                    f"prefill:L{bucket}", self._prefill_jit,
                     self.params, self.kv.arena, self._pt, self._state,
                     padded, np.int32(pfx_len), np.int32(suffix_len),
                     pages, np.int32(slot), *self._adapter_args(adapter_id))
@@ -870,7 +1066,8 @@ class ContinuousBatchingScheduler:
         it)."""
         aid_row = () if self.adapters is None \
             else (np.int32(self.adapters.row_of(adapter_id)),)
-        first, self._keys, self._state = self._admit_jit(
+        first, self._keys, self._state = self._jit_call(
+            "admit_sample", self._admit_jit,
             self._keys, self._state, np.int32(slot), np.int32(seed),
             logits, np.float32(temperature), np.int32(p_len),
             np.int32(max_new),
@@ -936,7 +1133,8 @@ class ContinuousBatchingScheduler:
                                   request_id=getattr(pf.req,
                                                      "request_id", None)):
             logits, arena, self._pt, self._state = \
-                self._prefill_chunk_jit(
+                self._jit_call(
+                    f"prefill_chunk:L{bucket}", self._prefill_chunk_jit,
                     self.params, self.kv.arena, self._pt, self._state,
                     padded, np.int32(start), np.int32(n), pf.pages,
                     np.int32(slot), *self._adapter_args(pf.adapter_id))
@@ -980,12 +1178,25 @@ class ContinuousBatchingScheduler:
             return []
         self._ensure_jits()
         launched = False
+        hook = self.on_tick_phase   # tick profiler (None = pinned off
+        #                             path: zero clock reads)
         if self._running and self._needs_dispatch():
-            self._launch()
+            if hook is None:
+                self._launch()
+            else:
+                t0 = time.perf_counter()
+                self._launch()
+                hook("launch", time.perf_counter() - t0)
             launched = True
         if self._inflight and (len(self._inflight) > 1 or not launched
                                or not self.overlap):
-            return self._collect(self._inflight.pop(0))
+            fl = self._inflight.pop(0)
+            if hook is None:
+                return self._collect(fl)
+            t0 = time.perf_counter()
+            events = self._collect(fl)
+            hook("collect", time.perf_counter() - t0)
+            return events
         return []
 
     def _needs_dispatch(self) -> bool:
@@ -1022,7 +1233,8 @@ class ContinuousBatchingScheduler:
                                   index=self._launches):
             apool = () if self.adapters is None \
                 else (self.adapters.pool,)
-            block, arena, self._keys, self._state = self._chunk_jit(
+            block, arena, self._keys, self._state = self._jit_call(
+                "decode_chunk", self._chunk_jit,
                 self.params, self.kv.arena, self._pt, self._keys,
                 self._state, *apool)
             self.kv.store_arena(arena)
@@ -1164,7 +1376,8 @@ class ContinuousBatchingScheduler:
         for slot, st in list(self._running.items()):
             if st.req is req:
                 del self._running[slot]
-                self._pt, self._state = self._release_jit(
+                self._pt, self._state = self._jit_call(
+                    "release_slot", self._release_jit,
                     self._pt, self._state, np.int32(slot))
                 self.kv.free(slot)
                 return True
@@ -1176,7 +1389,8 @@ class ContinuousBatchingScheduler:
         for slot, pf in list(self._prefilling.items()):
             if pf.req is req:
                 del self._prefilling[slot]
-                self._pt, self._state = self._release_jit(
+                self._pt, self._state = self._jit_call(
+                    "release_slot", self._release_jit,
                     self._pt, self._state, np.int32(slot))
                 self.kv.free(slot)
                 return True
@@ -1250,7 +1464,8 @@ class ContinuousBatchingScheduler:
         st = self._running.pop(slot)
         n_blocks = self.kv.mapped_block_count(slot)
         blocks_row = self.kv.page_table[slot].copy()
-        host = jax.device_get(self._swapout_jit(
+        host = jax.device_get(self._jit_call(
+            "swap_out", self._swapout_jit,
             self.kv.arena, self._keys, self._state, blocks_row,
             np.int32(slot)))
         payload, token, ts, rem, temp, eos, key_row = host[:7]
@@ -1273,7 +1488,8 @@ class ContinuousBatchingScheduler:
             st.seq, self.kv.length(slot), n_blocks, payload,
             token, ts, rem, temp, eos, np.asarray(key_row), spec,
             scales=scales, adapter_id=st.adapter_id)
-        self._pt, self._state = self._release_jit(
+        self._pt, self._state = self._jit_call(
+            "release_slot", self._release_jit,
             self._pt, self._state, np.int32(slot))
         self.kv.free(slot)
         if journal:
@@ -1348,7 +1564,7 @@ class ContinuousBatchingScheduler:
             args += [np.int32(self.adapters.row_of(
                 getattr(sw, "adapter_id", 0)))]
         arena, self._pt, self._keys, self._state = \
-            self._swapin_jit(*args)
+            self._jit_call("swap_in", self._swapin_jit, *args)
         self.kv.store_arena(arena)
         st = _Running(sw.req, pos=sw.pos, max_new=sw.max_new,
                       eos_id=sw.eos_id, live_from=self._launches,

@@ -822,6 +822,362 @@ def test_flight_record_meta_joins_inflight_requests(tiny_engine_params,
     assert meta3["inflight_request_ids"] == []
 
 
+# ---------------------------------------------------------------------------
+# performance-attribution plane (tick profiler + compile journal +
+# /metricz exposition)
+# ---------------------------------------------------------------------------
+
+_TICK_PHASE_NAMES = {"admit", "prefill_chunk", "launch", "collect",
+                     "stream", "bookkeeping"}
+
+_PROFILE_FAMILIES = {"serving_tick_phase_seconds",
+                     "serving_compiles_total",
+                     "serving_compile_seconds",
+                     "serving_mfu_proxy",
+                     "serving_dispatch_hbm_bytes"}
+
+
+def _attr_engine(params, cfg, **kw):
+    return pt.serving.ServingEngine(
+        params, cfg, pt.serving.ServingConfig(
+            num_slots=2, max_queue=16, prefill_buckets=(4, 8),
+            max_len=32, **kw))
+
+
+def _attr_prompts(cfg, n=6):
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, cfg.vocab_size, (3 + i % 5,))
+            .astype(np.int32) for i in range(n)]
+
+
+def test_tick_profile_disabled_is_noop(tiny_engine_params):
+    """The off path is PINNED byte-identical: a default engine
+    registers no profile families, holds no journal or tick ring, and
+    its token streams + compile events match a tick_profile=True twin
+    exactly — flipping the knob changes observability only."""
+    cfg, params = tiny_engine_params
+    # materialize the standard serving families once so the before/
+    # after family-set comparison isolates THIS engine's additions
+    warm = _attr_engine(params, cfg)
+    warm.generate(_attr_prompts(cfg, 2), max_new_tokens=2)
+    warm.close()
+    before = set(obs.get_registry().snapshot())
+    assert not before & _PROFILE_FAMILIES     # nobody leaked them
+    eng = _attr_engine(params, cfg)
+    outs_off = eng.generate(_attr_prompts(cfg), max_new_tokens=4)
+    assert eng.compile_journal is None
+    assert eng._tick_records() == []
+    assert set(obs.get_registry().snapshot()) == before
+    # the profiled twin: identical streams, identical compile events
+    eng2 = _attr_engine(params, cfg, tick_profile=True)
+    outs_on = eng2.generate(_attr_prompts(cfg), max_new_tokens=4)
+    assert [list(map(int, o)) for o in outs_on] == \
+        [list(map(int, o)) for o in outs_off]
+    assert eng2.stats()["compiled_executables"] == \
+        eng.stats()["compiled_executables"]
+    assert eng2.compile_journal is not None
+    assert _PROFILE_FAMILIES <= set(obs.get_registry().snapshot())
+    label = eng2.stats()["engine_label"]
+    eng.close()
+    eng2.close()
+    # close() retires every profile series the twin registered
+    for fam in obs.get_registry().snapshot().values():
+        assert not any(s["labels"].get("engine") == label
+                       for s in fam["series"]), fam
+
+
+def test_tick_profile_phase_sum_matches_wall(tiny_engine_params):
+    """Every flight-ring record decomposes its tick exactly: the phase
+    seconds sum to the recorded wall time, phases come from the fixed
+    vocabulary, stamps are monotone, and the registry histograms carry
+    the same totals."""
+    cfg, params = tiny_engine_params
+    eng = _attr_engine(params, cfg, tick_profile=True)
+    try:
+        eng.generate(_attr_prompts(cfg), max_new_tokens=4)
+        recs = eng._tick_records()
+        assert recs
+        for rec in recs:
+            assert set(rec["phases"]) == _TICK_PHASE_NAMES
+            assert all(v >= 0.0 for v in rec["phases"].values()), rec
+            assert rec["wall_s"] == pytest.approx(
+                sum(rec["phases"].values()), abs=1e-9)
+            for key in ("step", "t_mono", "emitted", "active", "queue"):
+                assert key in rec, rec
+        stamps = [r["t_mono"] for r in recs]
+        assert stamps == sorted(stamps)
+        # registry agreement: per-phase histogram sums == ring totals
+        label = eng.stats()["engine_label"]
+        snap = obs.get_registry().snapshot()
+        series = {r["labels"]["phase"]: r
+                  for r in snap["serving_tick_phase_seconds"]["series"]
+                  if r["labels"].get("engine") == label}
+        assert set(series) == _TICK_PHASE_NAMES
+        for phase, row in series.items():
+            assert row["count"] == len(recs)
+            assert row["sum"] == pytest.approx(
+                sum(r["phases"][phase] for r in recs), rel=1e-9)
+        # the /varz rollup renders the same attribution with shares
+        from paddle_tpu.observability.debug_server import _serving_varz
+        varz = _serving_varz(snap)
+        assert set(varz["tick_phases"]) == _TICK_PHASE_NAMES
+        shares = [row["share"] for row in varz["tick_phases"].values()]
+        assert sum(shares) == pytest.approx(1.0, abs=0.01)
+    finally:
+        eng.close()
+
+
+def test_compile_journal_families_and_gauges(tiny_engine_params):
+    """The journal attributes every jit dispatch: family rows for both
+    prefill buckets, the fused decode chunk and the sampler, compile
+    wall seconds with shares summing to 1, cost_analysis-derived
+    per-dispatch FLOPs, and the live mfu-proxy / HBM gauges."""
+    cfg, params = tiny_engine_params
+    eng = _attr_engine(params, cfg, tick_profile=True)
+    try:
+        eng.generate(_attr_prompts(cfg), max_new_tokens=4)
+        snap = eng.compile_journal.snapshot()
+        fams = snap["families"]
+        assert "decode_chunk" in fams and "admit_sample" in fams
+        assert any(n.startswith("prefill:L") for n in fams)
+        for name, fam in fams.items():
+            assert fam["calls"] >= fam["compiles"] >= 1, (name, fam)
+            assert fam["compile_s"] >= 0.0
+            assert 0.0 <= fam["compile_share"] <= 1.0
+        assert snap["compiles_total"] == sum(
+            f["compiles"] for f in fams.values())
+        assert snap["compile_seconds_total"] > 0
+        assert sum(f["compile_share"] for f in fams.values()) == \
+            pytest.approx(1.0, abs=1e-6)
+        # cost model landed for the decode chunk -> derived gauges live
+        assert fams["decode_chunk"]["flops"] and \
+            fams["decode_chunk"]["flops"] > 0
+        assert 0 < snap["mfu_proxy"] < 1
+        assert snap["dispatch_hbm_bytes"] > 0
+        # the registry carries the same compile counts per family
+        label = eng.stats()["engine_label"]
+        reg = obs.get_registry().snapshot()
+        counts = {r["labels"]["family"]: r["value"]
+                  for r in reg["serving_compiles_total"]["series"]
+                  if r["labels"].get("engine") == label}
+        assert counts == {n: f["compiles"] for n, f in fams.items()}
+        assert next(
+            r for r in reg["serving_mfu_proxy"]["series"]
+            if r["labels"].get("engine") == label)["value"] > 0
+    finally:
+        eng.close()
+
+
+def _parse_prom_samples(text):
+    """Strict exposition parse: {family: {"help", "type"}} +
+    [(name, {label: value}, float)] samples; asserts HELP/TYPE precede
+    any sample of their family."""
+    metas, samples, seen_meta = {}, [], set()
+    for line in text.strip().split("\n"):
+        if line.startswith("# "):
+            kind, name, rest = line[2:].split(" ", 2)
+            assert kind in ("HELP", "TYPE"), line
+            metas.setdefault(name, {})[kind.lower()] = rest
+            seen_meta.add(name)
+            continue
+        m = re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+                     r'(?:\{(.*)\})? (\S+)$', line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labelstr, value = m.groups()
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert base in seen_meta or name in seen_meta, \
+            f"sample before HELP/TYPE: {line!r}"
+        labels = {}
+        for lm in re.finditer(r'([a-zA-Z_][a-zA-Z0-9_]*)='
+                              r'"((?:[^"\\]|\\.)*)"', labelstr or ""):
+            labels[lm.group(1)] = (lm.group(2)
+                                   .replace("\\n", "\n")
+                                   .replace('\\"', '"')
+                                   .replace("\\\\", "\\"))
+        samples.append((name, labels,
+                        float(value) if value != "+Inf"
+                        else float("inf")))
+    return metas, samples
+
+
+def test_metricz_strict_exposition(tiny_engine_params):
+    """/metricz satisfies a strict text-format 0.0.4 parse: HELP+TYPE
+    per family before its samples, per-series bucket monotonicity with
+    +Inf == _count, label escaping that round-trips, and
+    ?aggregate=engine folds the per-replica label away."""
+    import urllib.request
+    cfg, params = tiny_engine_params
+    nasty = 'C:\\tmp\\"q"\nnext'
+    obs.get_registry().counter(
+        "exposition_roundtrip_total",
+        "label-escape probe").labels(path=nasty).inc(3)
+    eng = _attr_engine(params, cfg, tick_profile=True)
+    server = obs.DebugServer(port=0)
+    try:
+        eng.generate(_attr_prompts(cfg), max_new_tokens=4)
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"{server.url}{path}", timeout=10) as r:
+                return r.headers, r.read().decode()
+
+        headers, text = get("/metricz")
+        assert headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        metas, samples = _parse_prom_samples(text)
+        for name, meta in metas.items():
+            assert set(meta) == {"help", "type"}, name
+            assert meta["type"].split()[-1] in (
+                "counter", "gauge", "histogram"), (name, meta)
+        # bucket monotonicity per series; +Inf bucket == _count
+        counts = {(n[:-6], tuple(sorted(l.items()))): v
+                  for n, l, v in samples if n.endswith("_count")}
+        buckets = {}
+        for n, labels, v in samples:
+            if not n.endswith("_bucket"):
+                continue
+            key = (n[:-7], tuple(sorted(
+                (k, lv) for k, lv in labels.items() if k != "le")))
+            buckets.setdefault(key, []).append(
+                (float(labels["le"]) if labels["le"] != "+Inf"
+                 else float("inf"), v))
+        assert buckets            # the profiled engine exported some
+        for key, rows in buckets.items():
+            rows.sort()
+            bounds = [b for b, _ in rows]
+            assert bounds == sorted(set(bounds)), (key, rows)
+            vals = [c for _, c in rows]
+            assert vals == sorted(vals), (key, rows)
+            assert rows[-1][0] == float("inf")
+            assert rows[-1][1] == counts[key], (key, rows)
+        # tick-phase histograms made it out the pipe
+        assert any(n == "serving_tick_phase_seconds_bucket"
+                   for n, _, _ in samples)
+        # label escaping round-trips through the strict parser
+        probe = [(l, v) for n, l, v in samples
+                 if n == "exposition_roundtrip_total"]
+        assert probe == [({"path": nasty}, 3.0)]
+        # aggregation folds the engine label into fleet totals
+        _, agg = get("/metricz?aggregate=engine")
+        assert 'engine="' not in agg
+        agg_samples = _parse_prom_samples(agg)[1]
+        label = eng.stats()["engine_label"]
+        sub = next(v for n, l, v in samples
+                   if n == "serving_submitted_total"
+                   and l.get("engine") == label)
+        agg_sub = next(v for n, l, v in agg_samples
+                       if n == "serving_submitted_total")
+        assert agg_sub >= sub
+    finally:
+        server.stop()
+        eng.close()
+
+
+def test_every_ring_endpoint_rejects_malformed_limit():
+    """Meta-test (satellite): EVERY ring-serving endpoint routes
+    ?limit= through _parse_limit — negative and non-integer values are
+    a 400 with a remediation message, never a 500 or a silent
+    full-ring dump."""
+    import urllib.error
+    import urllib.request
+    server = obs.DebugServer(port=0)
+    try:
+        for ep in ("/tracez", "/trainz", "/requestz", "/tickz",
+                   "/compilez"):
+            for bad in ("-1", "x", "1.5"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        f"{server.url}{ep}?limit={bad}", timeout=10)
+                assert ei.value.code == 400, (ep, bad)
+                body = json.loads(ei.value.read())
+                assert "limit" in body["error"], (ep, bad, body)
+            for good in ("0", "5"):
+                with urllib.request.urlopen(
+                        f"{server.url}{ep}?limit={good}",
+                        timeout=10) as r:
+                    assert r.status == 200, (ep, good)
+    finally:
+        server.stop()
+
+
+def test_tickz_compilez_endpoints_serve_and_filter(tiny_engine_params):
+    """/tickz and /compilez serve the live engine's rings with
+    ?engine= filtering, ?limit= slicing and the chrome-trace download;
+    close() deregisters the perf sources so the endpoints report
+    enabled=false afterwards."""
+    import urllib.request
+    cfg, params = tiny_engine_params
+    server = obs.DebugServer(port=0)
+    eng = _attr_engine(params, cfg, tick_profile=True)
+    try:
+        eng.generate(_attr_prompts(cfg), max_new_tokens=4)
+        label = eng.stats()["engine_label"]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"{server.url}{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        tickz = get("/tickz")
+        assert tickz["enabled"] is True
+        assert label in tickz["engines"] and tickz["count"] > 0
+        assert all(set(r["phases"]) == _TICK_PHASE_NAMES
+                   for r in tickz["engines"][label])
+        one = get(f"/tickz?engine={label}&limit=1")
+        assert list(one["engines"]) == [label]
+        assert len(one["engines"][label]) == 1
+        assert get("/tickz?engine=nope")["engines"] == {}
+        chrome = get(f"/tickz?chrome=1&engine={label}")
+        phs = [ev["ph"] for ev in chrome["traceEvents"]]
+        assert "X" in phs and set(phs) <= {"X", "M"}
+        compilez = get("/compilez")
+        assert compilez["enabled"] is True
+        snap = compilez["engines"][label]
+        assert "decode_chunk" in snap["families"]
+        assert snap["records"]
+        sliced = get("/compilez?limit=1")["engines"][label]
+        assert len(sliced["records"]) == 1
+        assert sliced["records"][0] == snap["records"][-1]
+        eng.close()
+        off = get("/tickz")
+        assert off["enabled"] is False and off["engines"] == {}
+        assert get("/compilez")["enabled"] is False
+    finally:
+        server.stop()
+        eng.close()
+
+
+def test_metric_name_lint_clean_and_catches_violations(
+        tiny_engine_params):
+    """tools/check_metrics as a tier-1 contract: the fully-populated
+    process registry (serving + profile + router families) lints
+    clean, and synthetic convention breaks are each reported."""
+    import os
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import check_metrics
+    cfg, params = tiny_engine_params
+    eng = _attr_engine(params, cfg, tick_profile=True)
+    try:
+        eng.generate(_attr_prompts(cfg), max_new_tokens=4)
+        problems = check_metrics.lint_registry(obs.get_registry())
+        assert problems == []
+    finally:
+        eng.close()
+    bad = {
+        "foo_seconds": {"type": "counter", "help": "counter suffix"},
+        "bar_stuff": {"type": "gauge", "help": "no unit"},
+        "baz_seconds": {"type": "histogram", "help": "  "},
+    }
+    msgs = check_metrics.lint_families(bad)
+    assert len(msgs) == 3
+    assert any("counter must end in _total" in m for m in msgs)
+    assert any("no unit suffix" in m for m in msgs)
+    assert any("help text is required" in m for m in msgs)
+
+
 if __name__ == "__main__":
     import sys
     sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -263,6 +263,37 @@ def test_healthz_and_metrics_endpoints(trained):
         assert "server_requests_total{" in text
         assert "server_active_streams{" in text
         assert "serving_submitted_total{" in text
+
+        # /metricz: the Prometheus surface with router-level
+        # aggregation — one scrape covers the whole 2-replica fleet
+        # (engine label folded into fleet totals); ?raw=1 keeps the
+        # per-replica series
+        def get_text(path):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            try:
+                conn.request("GET", path)
+                r = conn.getresponse()
+                assert r.status == 200
+                assert r.getheader("Content-Type").startswith(
+                    "text/plain; version=0.0.4")
+                return r.read().decode()
+            finally:
+                conn.close()
+
+        agg = get_text("/metricz")
+        assert 'engine="' not in agg
+        assert "serving_submitted_total " in agg       # fleet total
+        assert agg == srv.router.prometheus_text()
+        raw = get_text("/metricz?raw=1")
+        # each replica keeps its own engine-labelled series in raw mode
+        # (count per label, not in total: other engines from the same
+        # process may share the registry)
+        for rep in srv.router.replicas:
+            label = rep.engine.metrics.engine_label
+            assert raw.count(
+                'serving_submitted_total{engine="%s"' % label) == 1
+        assert raw == srv.router.prometheus_text(aggregate=False)
     finally:
         srv.shutdown()
 

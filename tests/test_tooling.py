@@ -186,6 +186,17 @@ def test_bench_serving_row_shape():
         assert row["extra"]["host_overhead_ms"] is not None
         assert row["extra"]["host_overhead_ms"] > 0
         assert row["extra"]["device_ms_per_dispatch"] is not None
+        # performance-attribution columns (tick-profiler PR): per-
+        # phase engine-host ms from serving_tick_phase_seconds, and
+        # the compile journal's FLOP-utilization proxy
+        phases = row["extra"]["tick_phase_ms"]
+        assert isinstance(phases, dict) and phases, row
+        assert set(phases) <= {"admit", "prefill_chunk", "launch",
+                               "collect", "stream", "bookkeeping"}
+        assert all(v >= 0 for v in phases.values())
+        assert phases["launch"] > 0          # dispatches really ticked
+        assert row["extra"]["mfu_proxy"] is not None
+        assert 0 < row["extra"]["mfu_proxy"] < 1
     # the traced re-run restored the disabled production default
     import paddle_tpu.observability as obs
     assert not obs.tracing_enabled()
@@ -364,6 +375,10 @@ def test_bench_serving_http_row_shape():
     assert e["goodput_tokens_per_s"] is not None
     assert e["goodput_tokens_per_s"] > 0
     assert e["host_overhead_ms"] is not None and e["host_overhead_ms"] > 0
+    # performance-attribution columns mirror the library rows
+    phases = e["tick_phase_ms"]
+    assert isinstance(phases, dict) and phases.get("launch", 0) > 0
+    assert e["mfu_proxy"] is not None and 0 < e["mfu_proxy"] < 1
     # the server was torn down: no leftover wire surface
     import paddle_tpu as pt
     snap = pt.observability.get_registry().snapshot()
@@ -684,6 +699,179 @@ def test_serving_summary_reconstructs_preempt_and_failover(tmp_path):
                        env=env)
     assert r.returncode == 2 and "not JSONL" in r.stderr
     assert "Traceback" not in r.stderr
+
+
+def _tiny_profiled_engine():
+    """A tick_profile=True tiny engine that has served a small mix —
+    the source for the perf-attribution CLI tests."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+
+    cfg = GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                    max_pos=64, dropout=0.0, attn_impl="xla")
+    main_prog, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+    eng = pt.serving.ServingEngine(
+        params, cfg, pt.serving.ServingConfig(
+            num_slots=2, max_queue=16, prefill_buckets=(4, 8),
+            max_len=32, tick_profile=True))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (3 + i % 5,))
+               .astype(np.int32) for i in range(6)]
+    eng.generate(prompts, max_new_tokens=4)
+    return eng
+
+
+def test_perf_summary_and_check_metrics_clis(tmp_path):
+    """tools/perf_summary renders the compile-journal attribution table
+    (+ the --ticks phase table) from saved /compilez + /tickz payloads,
+    and tools/check_metrics lints a live registry dump clean — both
+    degrade to exit 2 on unreadable input, 1 on findings (the
+    summary-CLI convention)."""
+    import paddle_tpu as pt
+
+    eng = _tiny_profiled_engine()
+    label = eng.stats()["engine_label"]
+    compilez = tmp_path / "compilez.json"
+    compilez.write_text(json.dumps(
+        {"engines": {label: eng._compile_snapshot()}}))
+    tickz = tmp_path / "tickz.json"
+    tickz.write_text(json.dumps(
+        {"engines": {label: eng._tick_records()}}))
+    regdump = tmp_path / "registry.json"
+    regdump.write_text(pt.observability.get_registry().to_json())
+    eng.close()
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    perf = os.path.join(REPO, "tools/perf_summary.py")
+    r = subprocess.run([sys.executable, perf, str(compilez),
+                        "--ticks", str(tickz)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    assert "decode_chunk" in r.stdout and "prefill:L" in r.stdout
+    assert "mfu_proxy=" in r.stdout and "tick phases" in r.stdout
+    assert "launch" in r.stdout
+    r = subprocess.run([sys.executable, perf, str(compilez),
+                        "--ticks", str(tickz), "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    fams = out["engines"][label]["families"]
+    assert fams["decode_chunk"]["calls"] >= 1
+    phases = out["tick_phases"]
+    assert phases["ticks"] >= 1
+    assert sum(p["share"] for p in phases["phases"]) == \
+        pytest.approx(1.0, abs=1e-6)
+    # degradation: absent file exits 2 with a remediation hint
+    r = subprocess.run([sys.executable, perf,
+                        str(tmp_path / "nope.json")],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "cannot read" in r.stderr
+    assert "Traceback" not in r.stderr
+
+    check = os.path.join(REPO, "tools/check_metrics.py")
+    r = subprocess.run([sys.executable, check, str(regdump)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "clean" in r.stdout
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "foo": {"type": "counter", "help": "no _total"},
+        "bar_seconds": {"type": "histogram", "help": ""}}))
+    r = subprocess.run([sys.executable, check, str(bad)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 1
+    assert "must end in _total" in r.stdout
+    assert "help text is required" in r.stdout
+    r = subprocess.run([sys.executable, check,
+                        str(tmp_path / "nope.json")],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "cannot read" in r.stderr
+
+
+def test_serving_summary_phases_footer(tmp_path):
+    """tools/serving_summary --phases joins the tick flight ring
+    against the request log via the monotonic stamps both sides carry:
+    the footer splits per-phase time into serving (ticks inside a
+    request window) vs other, and --json wraps rows + attribution."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTConfig, gpt_lm_program
+    from paddle_tpu.models import gpt_decode as gd
+    from paddle_tpu.observability.request_log import (
+        RequestLog, install_request_log, uninstall_request_log)
+
+    cfg = GPTConfig(vocab_size=97, hidden=32, layers=2, heads=4,
+                    max_pos=64, dropout=0.0, attn_impl="xla")
+    main_prog, startup, _ = gpt_lm_program(cfg, 8, is_test=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        params = gd.collect_gpt_params(scope, cfg)
+    install_request_log(RequestLog(log_dir=str(tmp_path)))
+    try:
+        eng = pt.serving.ServingEngine(
+            params, cfg, pt.serving.ServingConfig(
+                num_slots=2, max_queue=16, prefill_buckets=(4, 8),
+                max_len=32, tick_profile=True))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, (4 + i,))
+                   .astype(np.int32) for i in range(3)]
+        eng.generate(prompts, max_new_tokens=4)
+        label = eng.stats()["engine_label"]
+        ticks = eng._tick_records()
+        eng.close()
+    finally:
+        uninstall_request_log()
+    log_path = str(tmp_path / "serving.jsonl")
+    tickz = tmp_path / "tickz.json"
+    tickz.write_text(json.dumps({"engines": {label: ticks}}))
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cli = os.path.join(REPO, "tools/serving_summary.py")
+    r = subprocess.run([sys.executable, cli, log_path,
+                        "--phases", str(tickz)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    assert "-- tick phases" in r.stdout
+    assert "launch" in r.stdout and "serving_ms" in r.stdout
+    r = subprocess.run([sys.executable, cli, log_path,
+                        "--phases", str(tickz), "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert len(out["requests"]) == 3
+    attr = out["tick_phases"]
+    assert attr["ticks"] == len(ticks)
+    # the serving engine really ticked inside request windows
+    assert attr["in_request_windows"] >= 1
+    assert attr["serving"].get("launch", 0) > 0
+    # without --phases the bare-array row shape is preserved
+    r = subprocess.run([sys.executable, cli, log_path, "--json"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0 and isinstance(json.loads(r.stdout), list)
+    # a phases file with no usable records exits 2 with remediation
+    empty = tmp_path / "empty_ticks.json"
+    empty.write_text("[]")
+    r = subprocess.run([sys.executable, cli, log_path,
+                        "--phases", str(empty)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "tick_profile" in r.stderr
 
 
 def test_api_freeze_spec_is_current():

@@ -38,7 +38,14 @@ Dispatch-split columns (library + http rows; engines run with
 `dispatch_timing=True`): `host_overhead_ms` — mean launch-side host ms
 per fused decode dispatch from the serving_dispatch_host_seconds
 histogram, the pinned baseline the native continuous-batching core is
-judged against — and `device_ms_per_dispatch` next to it. The `--http`
+judged against — and `device_ms_per_dispatch` next to it. The engines
+also run with `tick_profile=True`, so every library + http row carries
+the performance-attribution columns: `tick_phase_ms` ({phase: mean
+host ms per engine tick} from the serving_tick_phase_seconds
+histograms — where each tick's wall time went between admit /
+prefill_chunk / launch / collect / stream / bookkeeping) and
+`mfu_proxy` (the compile journal's FLOPs-issued-per-second over
+PT_SERVING_PEAK_FLOPS). The `--http`
 rows additionally run under a generous default SLO and report
 registry-sourced `slo_attainment` (server_slo_{met,missed}_total) and
 `goodput_tokens_per_s` (server_goodput_tokens_total / wall time).
@@ -203,7 +210,8 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                                          prefill_buckets=buckets,
                                          max_len=max_len,
                                          decode_chunk=chunk,
-                                         dispatch_timing=True))
+                                         dispatch_timing=True,
+                                         tick_profile=True))
             prompts = [rng.randint(0, cfg.vocab_size,
                                    (prompt_lens[i % len(prompt_lens)],)
                                    ).astype(np.int32)
@@ -227,7 +235,8 @@ def run_model(name, concurrencies=None, requests_per_level=None,
             eng.metrics = pt.serving.EngineMetrics(
                 max_tokens_per_dispatch=old.max_tokens_per_dispatch,
                 speculate_k=old.speculate_k,
-                dispatch_timing=old.dispatch_timing)
+                dispatch_timing=old.dispatch_timing,
+                tick_profile=old.tick_profile)
             # the allocator's cumulative cache counters feed the new
             # series on the next step: drop the warmup's contribution
             eng.kv.prefix_hits = eng.kv.prefix_misses = 0
@@ -299,6 +308,13 @@ def run_model(name, concurrencies=None, requests_per_level=None,
                         label, "serving_dispatch_host_seconds"),
                     "device_ms_per_dispatch": _registry_hist_ms(
                         label, "serving_dispatch_device_seconds"),
+                    # tick-phase attribution (registry-sourced, the
+                    # serving_tick_phase_seconds histogram per phase):
+                    # mean host ms per tick spent in each engine phase,
+                    # and the journal-derived FLOP-utilization proxy
+                    "tick_phase_ms": _registry_tick_phase_ms(label),
+                    "mfu_proxy": _registry_gauge_value(
+                        label, "serving_mfu_proxy"),
                     **quantiles,
                 },
             })
@@ -545,6 +561,32 @@ def _registry_hist_ms(label, family, label_key="engine"):
     if not series or not series.get("count"):
         return None
     return round(series["sum"] / series["count"] * 1e3, 3)
+
+
+def _registry_tick_phase_ms(engine_label):
+    """{phase: mean ms per tick} from the serving_tick_phase_seconds
+    histogram — the per-phase engine-host attribution a
+    tick_profile=True scrape carries. None when the engine ran with
+    the profiler off (no series registered at all)."""
+    from paddle_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+    out = {}
+    fam = snap.get("serving_tick_phase_seconds", {})
+    for row in fam.get("series", []):
+        if row["labels"].get("engine") != engine_label:
+            continue
+        if row.get("count"):
+            out[row["labels"]["phase"]] = round(
+                row["sum"] / row["count"] * 1e3, 4)
+    return out or None
+
+
+def _registry_gauge_value(engine_label, family):
+    """One labeled gauge as a float (None when the series is absent —
+    e.g. the profiler was off and the family never registered)."""
+    series = _registry_series(engine_label, family)
+    return round(float(series["value"]), 10) if series else None
 
 
 # rebalance workload geometry per model: (prefill buckets, prompt
@@ -1513,7 +1555,8 @@ def run_http(name, concurrencies=None, requests_per_level=None,
                                      prefill_buckets=buckets,
                                      max_len=max_len,
                                      decode_chunk=decode_chunk,
-                                     dispatch_timing=True))
+                                     dispatch_timing=True,
+                                     tick_profile=True))
         prompts = [rng.randint(0, cfg.vocab_size,
                                (prompt_lens[i % len(prompt_lens)],)
                                ).astype(np.int32)
@@ -1527,7 +1570,8 @@ def run_http(name, concurrencies=None, requests_per_level=None,
         eng.metrics = pt.serving.EngineMetrics(
             max_tokens_per_dispatch=old.max_tokens_per_dispatch,
             speculate_k=old.speculate_k,
-            dispatch_timing=old.dispatch_timing)
+            dispatch_timing=old.dispatch_timing,
+            tick_profile=old.tick_profile)
         eng.kv.prefix_hits = eng.kv.prefix_misses = 0
         # generous default SLOs: the slo_attainment / goodput columns
         # are registry-sourced numbers a healthy run meets, so misses
@@ -1606,6 +1650,9 @@ def run_http(name, concurrencies=None, requests_per_level=None,
                 # the host/device dispatch split
                 "host_overhead_ms": _registry_hist_ms(
                     label, "serving_dispatch_host_seconds"),
+                "tick_phase_ms": _registry_tick_phase_ms(label),
+                "mfu_proxy": _registry_gauge_value(
+                    label, "serving_mfu_proxy"),
                 "slo_attainment": _registry_slo_attainment(
                     server.router.metrics.label),
                 "goodput_tokens_per_s": round(

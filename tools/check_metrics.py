@@ -1,0 +1,147 @@
+"""Metric-name lint over the observability registry.
+
+Prometheus naming conventions are easy to drift from one family at a
+time — a counter without ``_total``, a latency histogram without
+``_seconds``, a family registered with empty help that /metricz then
+exposes without a ``# HELP`` line. This tool pins the conventions as a
+checkable contract (and tests/test_observability.py runs it over the
+fully-populated registry as a tier-1 test, so a new family that breaks
+the convention fails CI, not a dashboard):
+
+* **counters** must end in ``_total``;
+* every family name must end in a unit suffix — ``_seconds``,
+  ``_bytes``, ``_total``, ``_ratio``, ``_per_s`` — unless it is an
+  explicitly enumerated dimensionless quantity (slot/queue/replica
+  occupancy gauges and count-distribution histograms, listed in
+  ``ALLOWED_DIMENSIONLESS``: additions are deliberate, one line of
+  diff each);
+* every family must carry non-empty help text.
+
+Usage:
+  python tools/check_metrics.py SNAPSHOT.json
+
+where SNAPSHOT.json is a registry dump (``registry.to_json()``, the
+/statusz ``metrics`` block, or the ``{name: {type, help, ...}}``
+mapping itself). Exits 0 when clean, 1 with one line per finding,
+2 (summary-CLI convention) for unreadable input.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_TOOLS, ".."))
+sys.path.insert(0, _TOOLS)
+
+from summary_io import (SummaryInputError, read_input,  # noqa: E402
+                        report_error)
+
+EMPTY_HINT = ("no registry snapshot was written there. Dump one with "
+              "get_registry().to_json() (or save /statusz) and "
+              "re-run.")
+
+# suffixes that name the unit (the Prometheus base-unit convention)
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio", "_per_s")
+
+# families that ARE dimensionless quantities: occupancy/config gauges
+# and count-distribution histograms where a unit suffix would be
+# noise. Every addition here is a deliberate one-line diff — new
+# families default to needing a unit suffix.
+ALLOWED_DIMENSIONLESS = frozenset({
+    # serving engine occupancy / geometry gauges
+    "serving_active_slots", "serving_queue_depth",
+    "serving_kv_blocks_used", "serving_kv_blocks_cached",
+    "serving_swapped_slots", "serving_mesh_shards",
+    "serving_adapters_resident",
+    # gauge named *_total before the convention existed: "total
+    # blocks in the arena" (a capacity, not an accumulation) —
+    # renaming would break every dashboard keyed on it
+    "serving_kv_blocks_total",
+    # count-distribution histograms (tokens per dispatch, accepted
+    # draft-run length): the sample IS a count
+    "serving_tokens_per_dispatch", "serving_spec_accepted_run",
+    # model-FLOP utilization proxies are already ratios by definition
+    "serving_mfu_proxy", "train_mfu",
+    # router occupancy gauges
+    "server_active_streams", "server_replicas", "server_draining",
+    # executor cache occupancy
+    "executor_cache_size", "executor_inflight_runs",
+    # training scalars whose unit is the model's own loss/grad scale
+    "train_loss", "train_grad_norm", "train_learning_rate",
+})
+
+
+def lint_families(families):
+    """Findings for a {name: {"type": ..., "help": ...}} mapping (the
+    registry snapshot / /statusz shape). Empty list = clean."""
+    problems = []
+    for name in sorted(families):
+        fam = families[name]
+        kind = fam.get("type", "?")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"{name}: counter must end in _total")
+        if not name.endswith(UNIT_SUFFIXES) \
+                and name not in ALLOWED_DIMENSIONLESS:
+            problems.append(
+                f"{name}: no unit suffix "
+                f"({'/'.join(UNIT_SUFFIXES)}) and not in "
+                "ALLOWED_DIMENSIONLESS")
+        if not (fam.get("help") or "").strip():
+            problems.append(
+                f"{name}: help text is required (/metricz emits no "
+                "# HELP line without it)")
+    return problems
+
+
+def lint_registry(registry):
+    """Findings for a live MetricsRegistry."""
+    return lint_families(registry.snapshot())
+
+
+def _extract_families(payload):
+    """Accept to_json() output directly or wrapped (a /statusz body
+    carrying the snapshot under "metrics")."""
+    if isinstance(payload, dict) and "metrics" in payload \
+            and isinstance(payload["metrics"], dict):
+        payload = payload["metrics"]
+    if not isinstance(payload, dict) or not all(
+            isinstance(v, dict) and "type" in v
+            for v in payload.values()):
+        raise SummaryInputError(
+            "input is not a registry snapshot (expected "
+            '{name: {"type": ..., "help": ...}} — to_json() output '
+            "or a /statusz body)")
+    return payload
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("snapshot", help="registry snapshot JSON path "
+                                     "(to_json() / /statusz)")
+    args = ap.parse_args(argv)
+    try:
+        raw = read_input(args.snapshot, empty_hint=EMPTY_HINT)
+        try:
+            payload = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise SummaryInputError(
+                f"{args.snapshot!r} is not JSON ({e.msg})")
+        families = _extract_families(payload)
+    except SummaryInputError as e:
+        return report_error("check_metrics", e)
+    problems = lint_families(families)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"check_metrics: {len(problems)} naming problem(s) in "
+              f"{len(families)} families", file=sys.stderr)
+        return 1
+    print(f"check_metrics: {len(families)} families clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,16 @@ into one timeline keyed by the ORIGINAL id.
 
 Usage:
   python tools/serving_summary.py LOG.jsonl [--last N] [--json]
-      [--request-id ID]
+      [--request-id ID] [--phases TICKS.json]
+
+``--phases`` takes a tick-profiler flight-ring dump (the /tickz JSON
+payload, or a bare list of tick records) and joins it against the
+request log via the monotonic stamps both sides carry: every tick
+whose end stamp falls inside some request chain's [first event, last
+event] window is attributed to serving work, the rest to idle/other,
+and a per-phase seconds+share footer renders under the request table
+(with ``--json``, the output becomes {"requests": rows,
+"tick_phases": footer}).
 
 Annotations:
   PREEMPT    the sequence was host-swapped out under page pressure
@@ -48,7 +57,7 @@ sys.path.insert(0, os.path.join(_TOOLS, ".."))
 sys.path.insert(0, _TOOLS)
 
 from summary_io import (SummaryInputError, load_jsonl_records,  # noqa: E402
-                        report_error)
+                        read_input, report_error)
 
 EMPTY_HINT = ("no request events were written there. Install a "
               "RequestLog with a log_dir (observability."
@@ -70,6 +79,87 @@ _POOL_EVENTS = ("adapter_upload", "adapter_evict")
 def load_events(path: str):
     return load_jsonl_records(path, empty_hint=EMPTY_HINT,
                               what="RequestLog")
+
+
+PHASES_EMPTY_HINT = ("no tick records were written there. Run the "
+                     "engine with ServingConfig(tick_profile=True) and "
+                     "save /tickz (or engine._tick_records()) as JSON, "
+                     "then re-run.")
+
+
+def load_phases(path: str):
+    """Tick-profiler flight-ring records: either the /tickz JSON
+    payload ({"engines": {label: [records...]}}) or a bare JSON list
+    of tick records. Records missing phases/t_mono are dropped (they
+    cannot join); returns them sorted by end stamp."""
+    raw = read_input(path, empty_hint=PHASES_EMPTY_HINT)
+    try:
+        payload = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise SummaryInputError(
+            f"{path!r} is not JSON ({e.msg}); expected a /tickz "
+            "payload or a list of tick records")
+    if isinstance(payload, dict):
+        recs = [rec for records in (payload.get("engines") or {}).values()
+                for rec in records]
+    elif isinstance(payload, list):
+        recs = payload
+    else:
+        raise SummaryInputError(
+            f"{path!r} holds a {type(payload).__name__}; expected a "
+            "/tickz payload or a list of tick records")
+    recs = [rec for rec in recs if isinstance(rec, dict)
+            and isinstance(rec.get("phases"), dict)
+            and rec.get("t_mono") is not None]
+    if not recs:
+        raise SummaryInputError(
+            f"{path!r} holds no tick records with phases/t_mono — "
+            + PHASES_EMPTY_HINT)
+    return sorted(recs, key=lambda rec: rec["t_mono"])
+
+
+def phase_attribution(events, ticks):
+    """Join tick records against request chains via the monotonic
+    stamps both sides carry: a tick (stamped at its END) lands in a
+    chain's window when its stamp falls inside [first event t_mono,
+    last event t_mono]. Per-phase seconds split into `serving` (ticks
+    inside some request window) and `other` (idle ticks, warmup, the
+    gap after the last token) — the footer that answers "where did
+    tick time go while requests were in flight"."""
+    windows = []
+    for _root, _chain, evs in _chains(events):
+        stamps = [rec["t_mono"] for rec in evs
+                  if rec.get("t_mono") is not None]
+        if stamps:
+            windows.append((min(stamps), max(stamps)))
+    serving: dict = {}
+    other: dict = {}
+    matched = 0
+    for tick in ticks:
+        t = tick["t_mono"]
+        hit = any(lo <= t <= hi for lo, hi in windows)
+        dst = serving if hit else other
+        if hit:
+            matched += 1
+        for phase, seconds in tick["phases"].items():
+            dst[phase] = dst.get(phase, 0.0) + float(seconds)
+    return {"ticks": len(ticks), "in_request_windows": matched,
+            "serving": serving, "other": other}
+
+
+def _print_phase_footer(attr):
+    total = sum(attr["serving"].values()) or None
+    print(f"-- tick phases ({attr['in_request_windows']}/{attr['ticks']}"
+          f" ticks inside request windows):")
+    print(f"   {'phase':<14}  {'serving_ms':>11}  {'share':>6}  "
+          f"{'other_ms':>9}")
+    phases = sorted(set(attr["serving"]) | set(attr["other"]),
+                    key=lambda p: -attr["serving"].get(p, 0.0))
+    for phase in phases:
+        s = attr["serving"].get(phase, 0.0)
+        share = f"{s / total:6.1%}" if total else "     -"
+        print(f"   {phase:<14}  {s * 1e3:>11.3f}  {share}  "
+              f"{attr['other'].get(phase, 0.0) * 1e3:>9.3f}")
 
 
 def _chains(events):
@@ -250,13 +340,22 @@ def main(argv=None):
                          "(matches any id in a failover chain)")
     ap.add_argument("--json", action="store_true",
                     help="print summary rows as one JSON array")
+    ap.add_argument("--phases", default=None, metavar="TICKS",
+                    help="tick-profiler flight ring (/tickz JSON or a "
+                         "list of tick records): render a per-phase "
+                         "attribution footer joined on monotonic "
+                         "stamps")
     args = ap.parse_args(argv)
 
     try:
         events = load_events(args.log)
         rows = summarize(events)
+        phases = load_phases(args.phases) \
+            if args.phases is not None else None
     except SummaryInputError as e:
         return report_error("serving_summary", e)
+    attribution = phase_attribution(events, phases) \
+        if phases is not None else None
     if args.request_id is not None:
         row = next((r for r in rows
                     if args.request_id in r["chain"]), None)
@@ -273,9 +372,18 @@ def main(argv=None):
     if args.last > 0:
         rows = rows[-args.last:]
     if args.json:
-        print(json.dumps(rows, indent=2, default=str))
+        # --phases wraps the array (rows + footer); the bare-array
+        # shape without it stays exactly what existing consumers parse
+        if attribution is not None:
+            print(json.dumps({"requests": rows,
+                              "tick_phases": attribution},
+                             indent=2, default=str))
+        else:
+            print(json.dumps(rows, indent=2, default=str))
         return 0
     if not rows:
+        if attribution is not None:
+            _print_phase_footer(attribution)
         print("no request records in event log")
         return 0
     rid_w = max(7, max(len(r["request_id"]) for r in rows))
@@ -313,6 +421,8 @@ def main(argv=None):
     print(f"-- {len(rows)} requests, {n_pre} preempted, "
           f"{n_fo} failed over, {n_mig} migrated, "
           f"{n_miss} SLO miss(es)")
+    if attribution is not None:
+        _print_phase_footer(attribution)
     return 0
 
 
