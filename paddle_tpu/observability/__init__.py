@@ -44,7 +44,19 @@ first-class layer):
   dumps stacks + spans + a metrics snapshot into a bounded-retention
   `flight_<ts>/` directory when a busy component stops moving;
   `dump_flight_record()` drives the same path manually, and overload
-  sheds can trigger it too.
+  sheds and firing alerts can trigger it too.
+* `timeseries` — bounded in-process time-series history over the
+  registry: opted-in families sample into fixed rings of
+  (monotonic_ts, value) points with windowed `rate()`/`delta()`/
+  `p_quantile()` derivations — the "is it getting worse" layer the
+  snapshot surfaces can't answer.
+* `alerts` — declarative alert engine over the store: `AlertRule`s
+  with fire/clear hold-downs, built-in multi-window SLO burn-rate +
+  anomaly detectors, `server_alerts_firing` gauges, a transition ring
+  at `/alertz` (+ `/statusz` health-score rollup), one watchdog flight
+  record per firing episode, and a `pressure_hint()` the router's
+  rebalancer consumes. `FleetHealth` wires store + sampler + engine in
+  one call (`Router(health=HealthConfig())`).
 
 Quick start:
 
@@ -59,8 +71,10 @@ Stdlib-only on import: safe to import anywhere in the framework with no
 jax side effects.
 """
 
-from . import (debug_server, export, metrics, request_log,  # noqa: F401
-               tracer, train_stats, watchdog)
+from . import (alerts, debug_server, export, metrics,  # noqa: F401
+               request_log, timeseries, tracer, train_stats, watchdog)
+from .alerts import (AlertEngine, AlertRule, FleetHealth, HealthConfig,
+                     builtin_rules)
 from .debug_server import (DebugServer, get_debug_server,
                            start_debug_server, stop_debug_server)
 from .export import export_chrome_trace, self_times, summarize
@@ -72,13 +86,14 @@ from .request_log import (RequestLog, get_request_log,
 from .tracer import (Span, Tracer, current_request_id, disable_tracing,
                      enable_tracing, get_tracer, request_scope, trace_span,
                      tracing_enabled)
+from .timeseries import Sampler, TimeSeriesStore
 from .train_stats import (StepLogger, attach_step_telemetry,
                           get_step_logger, install_step_logger,
                           recompile_log, step_logging,
                           uninstall_step_logger)
 from .watchdog import (FlightRecorder, ProgressMonitor, Watchdog,
                        dump_flight_record, format_all_stacks, get_watchdog,
-                       start_watchdog, stop_watchdog)
+                       notify_alert, start_watchdog, stop_watchdog)
 
 __all__ = [
     "Span", "Tracer", "get_tracer", "trace_span", "enable_tracing",
@@ -96,4 +111,7 @@ __all__ = [
     "recompile_log",
     "RequestLog", "install_request_log", "uninstall_request_log",
     "get_request_log", "request_logging",
+    "TimeSeriesStore", "Sampler",
+    "AlertRule", "AlertEngine", "FleetHealth", "HealthConfig",
+    "builtin_rules", "notify_alert",
 ]

@@ -30,6 +30,12 @@ Endpoints:
     /requestz  serving request-lifecycle events (the installed request
                log's ring): in-flight ids + recent transitions;
                ?request_id= one request's timeline, ?limit=N newest N
+    /alertz    fleet health alert plane (FleetHealth sources): per-rule
+               state + the bounded alert-transition ring;
+               ?source= one plane, ?limit=N newest transitions
+    /statusz   fleet health rollup: worst status + min health score
+               across planes, firing rules, recent transitions,
+               process block, registry snapshot; ?limit=N transitions
     /stacksz   all-thread Python stack dump (text/plain)
 
 `start_debug_server(port=0)` binds (0 = ephemeral), serves from daemon
@@ -82,6 +88,11 @@ _INDEX = """<html><head><title>paddle_tpu debug</title></head><body>
 <li><a href="/requestz">/requestz</a> — serving request-lifecycle
     events: in-flight ids + recent transitions
     (<code>?request_id=</code>, <code>?limit=</code>)</li>
+<li><a href="/alertz">/alertz</a> — fleet health alert plane: rule
+    states + transition ring (<code>?source=</code>,
+    <code>?limit=</code>)</li>
+<li><a href="/statusz">/statusz</a> — fleet health score rollup
+    (<code>?limit=</code>)</li>
 <li><a href="/stacksz">/stacksz</a> — all-thread stack dump</li>
 </ul></body></html>
 """
@@ -99,14 +110,16 @@ def _span_request_id(s: Span) -> Optional[str]:
 # close()), the server only ever iterates a copied mapping.
 # ---------------------------------------------------------------------------
 
-_PERF_SOURCES: Dict[str, Dict[str, Any]] = {"tick": {}, "compile": {}}
+_PERF_SOURCES: Dict[str, Dict[str, Any]] = {"tick": {}, "compile": {},
+                                            "alerts": {}}
 _PERF_LOCK = threading.Lock()
 
 
 def register_perf_source(kind: str, label: str, provider) -> None:
-    """Install a zero-arg snapshot provider for `kind` ("tick" or
-    "compile") under an engine label. The tick_profile engine wiring;
-    last registration per (kind, label) wins."""
+    """Install a zero-arg snapshot provider for `kind` ("tick",
+    "compile", or "alerts") under a source label. The tick_profile
+    engine / FleetHealth wiring; last registration per (kind, label)
+    wins."""
     if kind not in _PERF_SOURCES:
         raise ValueError(f"unknown perf-source kind {kind!r}: expected "
                          f"one of {sorted(_PERF_SOURCES)}")
@@ -181,12 +194,18 @@ def registry_rollup(snap: Dict[str, Any],
 def ratio(num: str, den, digits: int = 4, scale: float = 1.0):
     """derived-fn factory for registry_rollup: `num` over the SUM of
     `den` field(s), rounded, None on a zero denominator (a ratio with
-    no observations is unknown, not 0)."""
+    no observations is unknown, not 0). Columns that are absent or
+    themselves None (a derived column that degraded) read as 0 — the
+    ratio degrades to None instead of raising, keeping every /varz
+    block total even when a family hasn't registered yet."""
     den = (den,) if isinstance(den, str) else tuple(den)
 
     def fn(row: Dict[str, Any]):
-        d = sum(row[k] for k in den)
-        return round(row[num] * scale / d, digits) if d else None
+        d = sum(row.get(k) or 0 for k in den)
+        n = row.get(num)
+        if n is None or not d:
+            return None
+        return round(n * scale / d, digits)
     return fn
 
 
@@ -323,7 +342,8 @@ _BAD_LIMIT = object()   # _parse_limit sentinel: 400 already sent
 
 def _parse_limit(h, q: Dict[str, str], default):
     """Parse ``?limit=`` for the ring-serving endpoints (/tracez,
-    /trainz, /requestz, /tickz, /compilez): a non-negative int,
+    /trainz, /requestz, /tickz, /compilez, /alertz, /statusz): a
+    non-negative int,
     `default` when absent. A malformed or negative value sends the 400
     and returns `_BAD_LIMIT` — the caller just returns. EVERY ring
     endpoint must route its limit through here (the meta-test in
@@ -413,7 +433,8 @@ class DebugServer:
             "/healthz": self._healthz, "/varz": self._varz,
             "/tracez": self._tracez, "/trainz": self._trainz,
             "/tickz": self._tickz, "/compilez": self._compilez,
-            "/requestz": self._requestz, "/stacksz": self._stacksz,
+            "/requestz": self._requestz, "/alertz": self._alertz,
+            "/statusz": self._statusz, "/stacksz": self._stacksz,
         }
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -627,6 +648,74 @@ class DebugServer:
             "inflight": rlog.inflight_ids() if rlog else [],
             "request_id": rid,
             "events": events[-limit:] if limit else [],
+        })
+
+    def _alertz(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Fleet health alert plane: per-rule state + the bounded
+        alert-transition ring from every registered FleetHealth source.
+        ?source= one plane's payload; ?limit=N newest N transitions per
+        source (default 100)."""
+        limit = _parse_limit(h, q, default=100)
+        if limit is _BAD_LIMIT:
+            return
+        sources = _perf_sources("alerts")
+        source = q.get("source")
+        if source is not None:
+            sources = {k: v for k, v in sources.items() if k == source}
+        planes = {}
+        for label in sorted(sources):
+            snap = dict(sources[label]() or {})
+            trans = snap.get("transitions", [])
+            snap["transitions"] = trans[-limit:] if limit else []
+            planes[label] = snap
+        h._send_json({
+            "enabled": bool(sources),
+            "source": source,
+            "firing": sorted({r for s in planes.values()
+                              for r in s.get("firing", [])}),
+            "sources": planes,
+        })
+
+    def _statusz(self, h: _Handler, q: Dict[str, str]) -> None:
+        """Fleet health score rollup: the one-curl operator verdict.
+        Worst status and minimum health score across every registered
+        FleetHealth plane, the firing rule set, the newest transitions
+        (?limit=N, default 20), the process block, and the registry
+        snapshot under "metrics" (so one fetch feeds dashboards and
+        `tools/check_metrics.py` alike)."""
+        limit = _parse_limit(h, q, default=20)
+        if limit is _BAD_LIMIT:
+            return
+        sources = _perf_sources("alerts")
+        planes = {}
+        for label in sorted(sources):
+            planes[label] = dict(sources[label]() or {})
+        healths = [p.get("health", {}) for p in planes.values()]
+        scores = [h_.get("score") for h_ in healths
+                  if h_.get("score") is not None]
+        statuses = [h_.get("status", "ok") for h_ in healths]
+        status = ("page" if "page" in statuses
+                  else "warn" if "warn" in statuses else "ok")
+        recent = sorted(
+            (t for p in planes.values()
+             for t in p.get("transitions", [])),
+            key=lambda t: t.get("ts_unix", 0))
+        h._send_json({
+            "enabled": bool(sources),
+            "status": status,
+            "health_score": min(scores) if scores else 100.0,
+            "firing": sorted({r for p in planes.values()
+                              for r in p.get("firing", [])}),
+            "sources": {label: p.get("health", {})
+                        for label, p in planes.items()},
+            "transitions": recent[-limit:] if limit else [],
+            "process": {
+                "pid": os.getpid(),
+                "threads": threading.active_count(),
+                "server_uptime_s": round(
+                    time.time() - self._started_unix, 3),
+            },
+            "metrics": self._registry.snapshot(),
         })
 
     def _stacksz(self, h: _Handler, q: Dict[str, str]) -> None:

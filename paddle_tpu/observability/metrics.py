@@ -400,34 +400,53 @@ class MetricsRegistry:
                 flat.append((labels, {"value": series.value}))
         if aggregate_label is None:
             return flat
-        merged: Dict[tuple, tuple] = {}
+        groups: Dict[tuple, List[tuple]] = {}
         order: List[tuple] = []
         for labels, data in flat:
             if aggregate_label not in labels:
                 key = ("raw", len(order))
-                merged[key] = (labels, data)
+            else:
+                kept = {k: v for k, v in labels.items()
+                        if k != aggregate_label}
+                key = ("agg", tuple(sorted(kept.items())))
+            if key not in groups:
+                groups[key] = []
                 order.append(key)
+            groups[key].append((labels, data))
+        out: List[tuple] = []
+        for key in order:
+            members = groups[key]
+            if key[0] == "raw":
+                out.extend(members)
                 continue
-            kept = {k: v for k, v in labels.items()
+            kept = {k: v for k, v in members[0][0].items()
                     if k != aggregate_label}
-            key = ("agg", tuple(sorted(kept.items())))
-            if fam.kind == "histogram":
-                # bucket layouts must match exactly to be summable
-                key = key + (tuple(le for le, _ in data["buckets"]),)
-            prev = merged.get(key)
-            if prev is None:
-                merged[key] = (kept, data)
-                order.append(key)
-            elif fam.kind == "histogram":
-                acc = prev[1]
+            if fam.kind != "histogram":
+                out.append((kept, {"value": sum(d["value"]
+                                                for _, d in members)}))
+                continue
+            layouts = {tuple(le for le, _ in d["buckets"])
+                       for _, d in members}
+            if len(layouts) > 1:
+                # per-series `labels(_buckets=)` overrides gave this
+                # group mismatched bucket layouts: cumulative counts
+                # over different bounds are not summable, so fall back
+                # to emitting these series unaggregated under their
+                # ORIGINAL labels (dropping the aggregate label here
+                # would emit duplicate label sets in the exposition)
+                out.extend(members)
+                continue
+            acc = {"buckets": list(members[0][1]["buckets"]),
+                   "sum": members[0][1]["sum"],
+                   "count": members[0][1]["count"]}
+            for _, d in members[1:]:
                 acc["buckets"] = [
                     (le, a + b) for (le, a), (_, b)
-                    in zip(acc["buckets"], data["buckets"])]
-                acc["sum"] += data["sum"]
-                acc["count"] += data["count"]
-            else:
-                prev[1]["value"] += data["value"]
-        return [merged[k] for k in order]
+                    in zip(acc["buckets"], d["buckets"])]
+                acc["sum"] += d["sum"]
+                acc["count"] += d["count"]
+            out.append((kept, acc))
+        return out
 
 
 def _prom_name(name: str) -> str:

@@ -245,7 +245,8 @@ class StepLogger:
                       "last step learning rate").set(lr)
         if step_time_s is not None:
             reg.histogram("train_step_seconds",
-                          "training step wall time").observe(step_time_s)
+                          "training step wall time (default latency "
+                          "buckets, 0.5ms..10s)").observe(step_time_s)
         if ex_s is not None:
             reg.gauge("train_examples_per_s",
                       "last step examples/second").set(ex_s)

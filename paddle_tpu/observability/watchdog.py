@@ -51,7 +51,8 @@ from . import request_log as _request_log
 
 __all__ = ["ProgressMonitor", "FlightRecorder", "Watchdog",
            "start_watchdog", "stop_watchdog", "get_watchdog",
-           "dump_flight_record", "notify_overload", "format_all_stacks"]
+           "dump_flight_record", "notify_overload", "notify_alert",
+           "format_all_stacks"]
 
 DEFAULT_FLIGHT_DIR = "/tmp/paddle_tpu_flight"
 
@@ -276,6 +277,8 @@ class Watchdog:
         self._last_overload = -math.inf
         self._overload_lock = threading.Lock()
         self._pending_overload: Optional[str] = None
+        self._last_alert = -math.inf
+        self._pending_alert: Optional[Dict[str, str]] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -319,9 +322,12 @@ class Watchdog:
         unit-test entry point)."""
         with self._overload_lock:
             pending, self._pending_overload = self._pending_overload, None
+            alert, self._pending_alert = self._pending_alert, None
         path = None
         if pending is not None:
             path = self.recorder.dump("overload", {"engine": pending})
+        if alert is not None:
+            path = self.recorder.dump("alert", alert)
         stalled = self._monitor.stalled(self.stall_threshold)
         self._dumped &= set(stalled)        # progressed keys re-arm
         fresh = {k: v for k, v in stalled.items() if k not in self._dumped}
@@ -353,6 +359,21 @@ class Watchdog:
             self._last_overload = now
             self._pending_overload = engine_label
         self._wake.set()                    # dump promptly, not next poll
+
+    def alert(self, rule: str, severity: str = "warn") -> None:
+        """Called (via notify_alert) when an alert rule starts firing.
+        Same queue-onto-own-thread discipline as overload(): the alert
+        engine's evaluate pass must not pay for flight-record I/O, and
+        `overload_cooldown` rate-limits alert dumps the same way (the
+        engine already fires once per episode; the cooldown guards
+        against many rules firing together in one incident)."""
+        with self._overload_lock:
+            now = time.monotonic()
+            if now - self._last_alert < self.overload_cooldown:
+                return
+            self._last_alert = now
+            self._pending_alert = {"rule": rule, "severity": severity}
+        self._wake.set()
 
     def status(self) -> Dict[str, Any]:
         return {"running": self.running,
@@ -423,3 +444,14 @@ def notify_overload(engine_label: str) -> None:
             wd.overload(engine_label)
         except Exception:
             traceback.print_exc()  # shedding must still raise Overload
+
+
+def notify_alert(rule: str, severity: str = "warn") -> None:
+    """The alert engine's firing hook: one flight record per alert
+    episode when a watchdog is installed, a None-check otherwise."""
+    wd = _WATCHDOG
+    if wd is not None:
+        try:
+            wd.alert(rule, severity)
+        except Exception:
+            traceback.print_exc()  # alerting must outlive the recorder

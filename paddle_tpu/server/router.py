@@ -85,6 +85,7 @@ import numpy as np
 
 from ..observability import request_log as _request_log
 from ..observability import watchdog as _watchdog
+from ..observability.alerts import FleetHealth, HealthConfig
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..serving.engine import EngineOverloadError, ServingEngine
 from ..serving.migration import MigrationError
@@ -1045,7 +1046,8 @@ class RouterMetrics:
         hist = self._registry.histogram(
             "serving_migration_seconds",
             "end-to-end cross-replica migration latency: order "
-            "created -> sequence adopted on the target")
+            "created -> sequence adopted on the target "
+            "(default latency buckets, 0.5ms..10s)")
         self._inc(fam, reason=reason)
         self._observe(hist, seconds)
 
@@ -1185,7 +1187,8 @@ class Router:
                  default_slo: Optional[SLOConfig] = None,
                  rebalance: Optional[RebalanceConfig] = None,
                  adapters: Optional[Dict[str, AdapterConfig]] = None,
-                 default_adapter: Optional[AdapterConfig] = None):
+                 default_adapter: Optional[AdapterConfig] = None,
+                 health: Optional[HealthConfig] = None):
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine replica")
@@ -1235,6 +1238,15 @@ class Router:
         self._rebalance_stop = threading.Event()
         self._migrations: set = set()
         self._mig_lock = threading.Lock()
+        # fleet health & alerting plane (HealthConfig): store + sampler
+        # + alert engine over this router's registry. Families mint at
+        # construction — health=None keeps the registry family set and
+        # the thread list byte-identical to a plane-less build
+        self._health: Optional[FleetHealth] = None
+        if health is not None:
+            self._health = FleetHealth(
+                config=health, registry=self.metrics._registry,
+                label=self.metrics.label)
 
     # adoption attempts (initial target + re-placements) before a
     # migration falls back to failover semantics
@@ -1254,6 +1266,14 @@ class Router:
                 name=f"pt-serve-rebalance-{self.metrics.label}",
                 daemon=True)
             self._rebalance_thread.start()
+        if self._health is not None:
+            self._health.start()
+
+    @property
+    def health(self) -> Optional[FleetHealth]:
+        """The fleet health plane, when this router was built with a
+        HealthConfig (None otherwise)."""
+        return self._health
 
     @property
     def draining(self) -> bool:
@@ -1848,6 +1868,16 @@ class Router:
                     and int(hot.engine.metrics.queue_depth) > 0):
                 reason = "slo"
             last_missed = missed
+            # health-plane hint: a page-severity alert firing (burn
+            # rate, throughput collapse) is fleet-level evidence the
+            # hot replica should shed NOW — skip the hysteresis streak
+            # the raw pressure gap would still be accumulating
+            if (reason is None and cfg.slo_pressure
+                    and self._health is not None
+                    and self._health.pressure_hint() >= 1.0
+                    and gap > 0
+                    and int(hot.engine.metrics.queue_depth) > 0):
+                reason = "slo"
             if reason is None:
                 continue
             with self._mig_lock:
@@ -1967,6 +1997,8 @@ class Router:
         if self._closed:
             return
         self._stop_rebalancer()
+        if self._health is not None:
+            self._health.close()
         if drain:
             self.drain(timeout=timeout)
         with self._admit_lock:

@@ -15,6 +15,9 @@ the container has no web framework and needs none) exposing
     GET  /metricz       the same exposition with per-replica series
                         aggregated into fleet totals (one scrape
                         covers every replica; ?raw=1 disables)
+    GET  /alertz        fleet health alert plane (ServerConfig(health=
+                        HealthConfig())): rule states + transition ring
+    GET  /statusz       fleet health score rollup + replica states
     GET  /              endpoint index
 
 Request JSON: ``{"prompt": [ids...], "max_new_tokens": n}`` plus
@@ -53,6 +56,7 @@ import numpy as np
 
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..serving.engine import EngineOverloadError, ServingEngine
+from ..observability.alerts import HealthConfig
 from .router import (DrainingError, QuotaConfig, QuotaExceededError,
                      RebalanceConfig, Router, SLOConfig, StreamHandle)
 
@@ -67,6 +71,10 @@ _INDEX = """<html><head><title>paddle_tpu server</title></head><body>
 per-replica series aggregated into fleet totals (<code>?raw=1</code>
 for per-replica series)</li>
 <li><a href="/slozv">/slozv</a> — per-tenant SLO attainment + goodput</li>
+<li><a href="/alertz">/alertz</a> — fleet health alert plane: rule
+states + transition ring (<code>?limit=</code>)</li>
+<li><a href="/statusz">/statusz</a> — fleet health score rollup
+(<code>?limit=</code>)</li>
 <li><code>POST /admin/restart</code> — zero-downtime rolling restart of
 one replica (<code>{"replica": i}</code>)</li>
 </ul></body></html>
@@ -101,6 +109,7 @@ class ServerConfig:
                  restart_backoff_s: float = 0.05,
                  restart_backoff_cap_s: float = 2.0,
                  rebalance: Optional[RebalanceConfig] = None,
+                 health: Optional[HealthConfig] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.host = host
         self.port = int(port)
@@ -130,6 +139,10 @@ class ServerConfig:
         # pass-through; None — the default — means the rebalancer
         # thread and its migration registry families don't exist)
         self.rebalance = rebalance
+        # fleet health & alerting plane (router pass-through; None —
+        # the default — means no sampler thread and no alert registry
+        # families: the disabled path stays byte-identical)
+        self.health = health
         self.clock = clock
 
 
@@ -247,6 +260,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/slozv":
                 self._slozv(srv)
+            elif path == "/alertz":
+                self._alertz(srv)
+            elif path == "/statusz":
+                self._statusz(srv)
             elif path == "/v1/generate":
                 self._send_json({"error": "use POST"}, status=405,
                                 extra={"Allow": "POST"})
@@ -254,7 +271,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"no such endpoint {path!r}",
                      "endpoints": ["/", "/healthz", "/metrics",
-                                   "/metricz", "/slozv", "/v1/generate",
+                                   "/metricz", "/slozv", "/alertz",
+                                   "/statusz", "/v1/generate",
                                    "/admin/restart"]},
                     status=404)
         except BrokenPipeError:
@@ -350,6 +368,67 @@ class _Handler(BaseHTTPRequestHandler):
             "slo_enabled": router.slo_enabled,
             "replicas": len(router.replicas),
             "tenants": router.slo_report(),
+        })
+
+    def _parse_limit(self, default: int) -> Optional[int]:
+        """?limit= for the alert endpoints: non-negative int, `default`
+        when absent; malformed/negative sends the 400 (the debug-server
+        ring-endpoint contract) and returns None."""
+        q = parse_qs(urlparse(self.path).query)
+        raw = (q.get("limit") or [None])[0]
+        if raw is None:
+            return default
+        try:
+            limit = int(raw)
+        except ValueError:
+            limit = -1
+        if limit < 0:
+            self._send_json({"error": f"bad limit {raw!r}: expected a "
+                             "non-negative integer"}, status=400)
+            return None
+        return limit
+
+    def _alertz(self, srv: "GenerationServer") -> None:
+        """Fleet health alert plane for THIS router: per-rule state +
+        the bounded alert-transition ring (?limit=N newest transitions,
+        default 100). `enabled` False means the server was built
+        without a HealthConfig — the plane is dormant."""
+        limit = self._parse_limit(default=100)
+        if limit is None:
+            return
+        health = srv.router.health
+        if health is None:
+            self._send_json({"enabled": False, "firing": [],
+                             "transitions": []})
+            return
+        snap = health.snapshot()
+        trans = snap.get("transitions", [])
+        snap["transitions"] = trans[-limit:] if limit else []
+        snap["enabled"] = True
+        self._send_json(snap)
+
+    def _statusz(self, srv: "GenerationServer") -> None:
+        """Fleet health score rollup for THIS router: status + score +
+        firing rules + newest transitions (?limit=N, default 20), next
+        to the replica states /healthz already carries."""
+        limit = self._parse_limit(default=20)
+        if limit is None:
+            return
+        router = srv.router
+        health = router.health
+        h = health.health() if health is not None \
+            else {"status": "ok", "score": 100.0, "firing": []}
+        trans = (health.engine.transitions(limit)
+                 if health is not None else [])
+        self._send_json({
+            "enabled": health is not None,
+            "status": h["status"],
+            "health_score": h["score"],
+            "firing": h["firing"],
+            "transitions": trans,
+            "router": router.metrics.label,
+            "replicas": [{"engine": r.label, "state": r.state}
+                         for r in router.replicas],
         })
 
     def _admin_restart(self, srv: "GenerationServer") -> None:
@@ -551,7 +630,8 @@ class GenerationServer:
                 max_stream_retries=self.config.max_stream_retries,
                 restart_backoff_s=self.config.restart_backoff_s,
                 restart_backoff_cap_s=self.config.restart_backoff_cap_s,
-                rebalance=self.config.rebalance)
+                rebalance=self.config.rebalance,
+                health=self.config.health)
         self._registry = registry or get_registry()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -654,7 +734,8 @@ def serve(params, cfg, config: Optional[ServerConfig] = None,
                     max_stream_retries=config.max_stream_retries,
                     restart_backoff_s=config.restart_backoff_s,
                     restart_backoff_cap_s=config.restart_backoff_cap_s,
-                    rebalance=config.rebalance)
+                    rebalance=config.rebalance,
+                    health=config.health)
     server = GenerationServer(router, config, registry=registry)
     server.serve()
     return server

@@ -198,22 +198,31 @@ _HISTOGRAMS = {"ttft": "serving_ttft_seconds",
                "swap_in": "serving_swap_in_seconds",
                "prefill_chunk": "serving_prefill_chunk_seconds"}
 _HIST_HELP = {
-    "ttft": "request ttft in seconds",
-    "tpot": "request tpot in seconds",
-    "queue_wait": "request queue wait in seconds",
+    "ttft": "request ttft in seconds "
+            "(default latency buckets, 0.5ms..10s)",
+    "tpot": "request tpot in seconds "
+            "(default latency buckets, 0.5ms..10s)",
+    "queue_wait": "request queue wait in seconds "
+                  "(default latency buckets, 0.5ms..10s)",
     "tokens_per_dispatch": "tokens emitted per fused decode dispatch "
                            "(the chunk-amortization ratio: dispatches-"
-                           "per-token is its reciprocal)",
+                           "per-token is its reciprocal; power-of-two "
+                           "count buckets, widened per engine to its "
+                           "dispatch token ceiling)",
     "spec_accepted_run": "accepted draft-run length per speculative "
                          "verify pass (0 = every draft rejected; "
-                         "tokens per pass is this + 1)",
+                         "tokens per pass is this + 1; count buckets "
+                         "0..speculate_k per engine)",
     "swap_out": "host-swap copy-out latency per preemption in seconds "
-                "(pipeline fence + device_get of the slot's blocks)",
+                "(pipeline fence + device_get of the slot's blocks; "
+                "default latency buckets, 0.5ms..10s)",
     "swap_in": "host-swap restore latency per resume in seconds "
-               "(block adoption + scatter + carry rebuild)",
+               "(block adoption + scatter + carry rebuild; default "
+               "latency buckets, 0.5ms..10s)",
     "prefill_chunk": "launch-side wall seconds per chunked-prefill "
                      "dispatch (staging + trace/enqueue of the chunk "
-                     "executable; empty on a monolithic engine)",
+                     "executable; empty on a monolithic engine; "
+                     "default latency buckets, 0.5ms..10s)",
 }
 
 # host/device dispatch split (ServingConfig(dispatch_timing=True) only:
@@ -226,9 +235,11 @@ _TIMING_HISTOGRAMS = {"dispatch_host": "serving_dispatch_host_seconds",
 _TIMING_HELP = {
     "dispatch_host": "launch-side host seconds per fused decode "
                      "dispatch (arg flatten + enqueue; the host "
-                     "overhead the native-core work must shrink)",
+                     "overhead the native-core work must shrink; "
+                     "default latency buckets, 0.5ms..10s)",
     "dispatch_device": "blocking wait per fused decode dispatch for "
-                       "its result (un-hidden device execution)",
+                       "its result (un-hidden device execution; "
+                       "default latency buckets, 0.5ms..10s)",
 }
 
 # performance-attribution plane (ServingConfig(tick_profile=True) only
@@ -252,12 +263,13 @@ _TICK_HELP = {
     "tick_phase": "host wall seconds per engine tick phase (admit / "
                   "prefill_chunk / launch / collect / stream / "
                   "bookkeeping) — the phase decomposition the native "
-                  "continuous-batching core is scoped and judged by",
+                  "continuous-batching core is scoped and judged by "
+                  "(fine microsecond bucket grid, 1us..0.25s)",
     "compiles": "executable compile events per jit family (one per "
                 "newly traced shape bucket; steady state adds none)",
     "compile_seconds": "wall seconds spent inside dispatches that "
                        "triggered a compile (trace + XLA compile + "
-                       "first execution)",
+                       "first execution; coarse buckets, 10ms..60s)",
     "mfu_proxy": "model-FLOPs-utilization proxy: cost_analysis FLOPs "
                  "x dispatch rate over nominal peak FLOPs (override "
                  "peak via PT_SERVING_PEAK_FLOPS) — a trend line, "

@@ -12,6 +12,7 @@ profiler.RecordEvent API delegates to the tracer and is thread-safe
 under concurrent recording."""
 
 import json
+import os
 import re
 import tempfile
 import threading
@@ -1083,7 +1084,7 @@ def test_every_ring_endpoint_rejects_malformed_limit():
     server = obs.DebugServer(port=0)
     try:
         for ep in ("/tracez", "/trainz", "/requestz", "/tickz",
-                   "/compilez"):
+                   "/compilez", "/alertz", "/statusz"):
             for bad in ("-1", "x", "1.5"):
                 with pytest.raises(urllib.error.HTTPError) as ei:
                     urllib.request.urlopen(
@@ -1170,12 +1171,458 @@ def test_metric_name_lint_clean_and_catches_violations(
         "foo_seconds": {"type": "counter", "help": "counter suffix"},
         "bar_stuff": {"type": "gauge", "help": "no unit"},
         "baz_seconds": {"type": "histogram", "help": "  "},
+        "qux_seconds": {"type": "histogram",
+                        "help": "latency with undocumented layout"},
     }
     msgs = check_metrics.lint_families(bad)
-    assert len(msgs) == 3
+    assert len(msgs) == 4
     assert any("counter must end in _total" in m for m in msgs)
     assert any("no unit suffix" in m for m in msgs)
     assert any("help text is required" in m for m in msgs)
+    # a histogram whose help never mentions its bucket layout is a
+    # finding — but only ONE finding per family (blank help doesn't
+    # double-report)
+    assert any("bucket" in m and "qux_seconds" in m for m in msgs)
+    assert sum("baz_seconds" in m for m in msgs) == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet health & alerting plane (timeseries + alerts)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    """Injectable monotonic clock for the health plane."""
+
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def test_health_plane_disabled_is_noop():
+    """Acceptance pin: a process that never builds a FleetHealth/
+    AlertEngine has no sampler thread and no live health-plane series
+    in the registry — the disabled path stays byte-identical."""
+    assert "pt-health-sampler" not in {
+        t.name for t in threading.enumerate()}
+    snap = obs.get_registry().snapshot()
+    for name, fam in snap.items():
+        if name.startswith(("server_alerts", "server_alert",
+                            "server_health", "timeseries_")):
+            assert fam["series"] == [], name
+
+
+def test_timeseries_store_rate_delta_quantile_ring():
+    """TimeSeriesStore core: counters/gauges record `value`, histograms
+    their cumulative count+sum sub-series; rings are bounded at
+    `capacity`; rate/delta/p_quantile derive over the window and
+    aggregate across series with labels=None."""
+    reg = obs.MetricsRegistry()
+    clk = _FakeClock()
+    store = obs.TimeSeriesStore(registry=reg, capacity=8, clock=clk)
+    store.track("demo_total", "demo_gauge", "demo_seconds")
+    assert store.tracked() == ("demo_total", "demo_gauge",
+                               "demo_seconds")
+    ctr = reg.counter("demo_total", "h").labels(engine="e0")
+    gauge = reg.gauge("demo_gauge", "h").labels(engine="e0")
+    hist = reg.histogram("demo_seconds", "h (bucket)").labels(
+        engine="e0")
+    for i in range(20):
+        ctr.inc(5)
+        gauge.set(i)
+        hist.observe(0.25)
+        store.sample(now=clk.advance(1.0))
+    # ring bound: only the newest `capacity` points survive
+    pts = store.points("demo_total", {"engine": "e0"})
+    assert len(pts) == 8
+    assert pts == sorted(pts)
+    # counter rate: 5 increments per second
+    assert store.rate("demo_total", 6.0, now=clk.t) == \
+        pytest.approx(5.0)
+    # histogram count sub-series rates like a counter (1 observe/s)
+    assert store.rate("demo_seconds", 6.0, field="count",
+                      now=clk.t) == pytest.approx(1.0)
+    assert store.rate("demo_seconds", 6.0, field="sum",
+                      now=clk.t) == pytest.approx(0.25)
+    # gauge delta over the last 5 s: 5 in-window steps of +1
+    assert store.delta("demo_gauge", 5.0, now=clk.t) == \
+        pytest.approx(5.0)
+    # nearest-rank quantile pools in-window values
+    assert store.p_quantile("demo_gauge", 1.0, 5.0, now=clk.t) == 19.0
+    assert store.p_quantile("demo_gauge", 0.0, 5.0, now=clk.t) == 14.0
+    # latest() sums each series' newest point across label sets
+    reg.gauge("demo_gauge", "h").labels(engine="e1").set(100)
+    store.sample(now=clk.advance(1.0))
+    assert store.latest("demo_gauge") == pytest.approx(119.0)
+    assert store.latest("demo_gauge", {"engine": "e1"}) == 100.0
+    # empty window / unknown family degrade to None, never raise
+    assert store.rate("demo_total", 0.0, now=clk.t) is None
+    assert store.rate("nope_total", 60.0, now=clk.t) is None
+    assert store.delta("nope_total", 60.0, now=clk.t) is None
+    assert store.p_quantile("nope_total", 0.5, 60.0, now=clk.t) is None
+    with pytest.raises(ValueError):
+        store.p_quantile("demo_gauge", 1.5, 60.0)
+
+
+def test_timeseries_counter_reset_aware_rate():
+    """A tracked value that decreases reads as a restart from zero
+    (Prometheus counter semantics), not a negative rate."""
+    reg = obs.MetricsRegistry()
+    clk = _FakeClock()
+    store = obs.TimeSeriesStore(registry=reg, clock=clk)
+    store.track("demo_gauge")
+    g = reg.gauge("demo_gauge", "h").labels(k="a")
+    for v in (10.0, 20.0, 2.0, 4.0):       # reset between 20 and 2
+        g.set(v)
+        store.sample(now=clk.advance(1.0))
+    # increase = 10 (10->20) + 2 (restart) + 2 (2->4) over 3 s
+    assert store.rate("demo_gauge", 10.0, now=clk.t) == \
+        pytest.approx(14.0 / 3.0)
+
+
+def test_timeseries_cardinality_cap_and_eviction():
+    """Series past `max_series` are counted in dropped_series and never
+    stored; rings whose labels retire from the registry are evicted on
+    the next poll (a rebuilt engine reusing the label starts clean)."""
+    reg = obs.MetricsRegistry()
+    clk = _FakeClock()
+    store = obs.TimeSeriesStore(registry=reg, capacity=4, max_series=2,
+                                clock=clk)
+    store.track("demo_total")
+    fam = reg.counter("demo_total", "h")
+    for i in range(4):
+        fam.labels(engine=f"e{i}").inc()
+    store.sample(now=clk.advance(1.0))
+    assert store.series_count() == 2
+    assert store.stats()["dropped_series"] == 2
+    # retire a ring-holding series: the next poll evicts its ring and
+    # the freed slot admits a previously-dropped series on the poll
+    # after that
+    assert fam.remove(engine="e0")
+    store.sample(now=clk.advance(1.0))
+    assert store.stats()["evicted_series"] == 1
+    assert store.series_count() == 1
+    store.sample(now=clk.advance(1.0))
+    assert store.series_count() == 2
+    # untrack drops the family's rings wholesale
+    store.untrack("demo_total")
+    assert store.series_count() == 0
+
+
+def test_prometheus_aggregate_mixed_bucket_layouts_unaggregated():
+    """Regression (satellite): folding a histogram family whose series
+    carry DIFFERENT per-series bucket layouts must not silently merge
+    cumulative counts over mismatched bounds — those series are emitted
+    unaggregated under their original labels, while a same-layout
+    family still folds."""
+    reg = obs.MetricsRegistry()
+    mixed = reg.histogram("demo_mixed_tokens",
+                          "per-engine bucket layouts")
+    mixed.labels(engine="e0", _buckets=(1.0, 2.0)).observe(1.5)
+    mixed.labels(engine="e1", _buckets=(1.0, 4.0)).observe(3.0)
+    same = reg.histogram("demo_same_seconds", "one bucket layout",
+                         buckets=(0.1, 1.0))
+    same.labels(engine="e0").observe(0.05)
+    same.labels(engine="e1").observe(0.5)
+    text = reg.to_prometheus(aggregate_label="engine")
+    # mismatched layouts: both engine-labelled series survive verbatim
+    assert 'demo_mixed_tokens_bucket{engine="e0"' in text
+    assert 'demo_mixed_tokens_bucket{engine="e1"' in text
+    mixed_counts = [ln for ln in text.splitlines()
+                    if ln.startswith("demo_mixed_tokens_count")]
+    assert len(mixed_counts) == 2
+    assert all('engine="' in ln for ln in mixed_counts)
+    # a uniform layout still folds into one fleet series
+    same_lines = [ln for ln in text.splitlines()
+                  if ln.startswith("demo_same_seconds")]
+    assert same_lines and all('engine="' not in ln
+                              for ln in same_lines)
+    assert "demo_same_seconds_count 2" in text
+    # and the raw export is untouched by the fallback
+    raw = reg.to_prometheus()
+    assert raw.count("demo_mixed_tokens_count{") == 2
+
+
+def test_registry_rollup_ratio_edges():
+    """Satellite pin: zero denominators, absent families, and degraded
+    (None) columns all read as None from ratio() — never 0.0, never a
+    KeyError — and a rollup over only-absent families is empty."""
+    from paddle_tpu.observability.debug_server import (registry_rollup,
+                                                       ratio)
+    reg = obs.MetricsRegistry()
+    reg.counter("hits_total", "h").labels(engine="e0").inc(0)
+    snap = reg.snapshot()
+    rows = registry_rollup(
+        snap, {"hits": "hits_total", "misses": "misses_total"},
+        derived=[("ratio", ratio("hits", ("hits", "misses")))])
+    assert rows == {"e0": {"hits": 0, "misses": 0, "ratio": None}}
+    # a rollup where NO named family exists has no labels at all
+    assert registry_rollup(snap, {"x": "nope_total"}) == {}
+    fn = ratio("num", "den")
+    assert fn({"num": None, "den": 5}) is None    # degraded numerator
+    assert fn({"den": 5}) is None                 # absent numerator
+    assert fn({"num": 3, "den": 0}) is None       # zero denominator
+    assert fn({"num": 3, "den": None}) is None    # degraded denominator
+    assert fn({"num": 3}) is None                 # absent denominator
+    assert fn({"num": 3, "den": 4}) == pytest.approx(0.75)
+
+
+def test_alert_engine_state_machine_hold_downs():
+    """ok -> pending -> firing with the for_s hold-down; clear_for_s
+    keeps a flapping rule firing until it stays clean; exactly one
+    on_fire per episode; a broken expr never pages; unregister()
+    retires every minted series."""
+    reg = obs.MetricsRegistry()
+    clk = _FakeClock()
+    store = obs.TimeSeriesStore(registry=reg, clock=clk)
+    probe = {"v": None}
+    rule = obs.AlertRule("probe", lambda ctx: probe["v"], for_s=10.0,
+                         clear_for_s=10.0, severity="page",
+                         labels={"team": "serving"})
+    fired = []
+    eng = obs.AlertEngine(store, [rule], registry=reg, clock=clk,
+                          label="t",
+                          on_fire=lambda r, s: fired.append((r, s)))
+    assert eng.evaluate() == []
+    probe["v"] = 1.0
+    assert eng.evaluate(now=clk.advance(1.0)) == []     # pending
+    assert eng.evaluate(now=clk.advance(5.0)) == []     # 5s < for_s
+    assert eng.evaluate(now=clk.advance(5.0)) == ["probe"]
+    assert fired == [("probe", "page")]
+    assert eng.pressure_hint() == 1.0
+    assert eng.health() == {"status": "page", "score": 60.0,
+                            "firing": ["probe"]}
+
+    def firing_gauge():
+        rows = reg.snapshot()["server_alerts_firing"]["series"]
+        return {tuple(sorted(r["labels"].items())): r["value"]
+                for r in rows}
+
+    assert firing_gauge() == {(("rule", "probe"), ("severity", "page"),
+                               ("source", "t")): 1}
+    # flapping: a brief clean stretch does NOT clear (ok_since resets
+    # on re-violation)
+    probe["v"] = None
+    assert eng.evaluate(now=clk.advance(5.0)) == ["probe"]
+    probe["v"] = 2.0
+    assert eng.evaluate(now=clk.advance(1.0)) == ["probe"]
+    probe["v"] = None
+    assert eng.evaluate(now=clk.advance(5.0)) == ["probe"]
+    assert eng.evaluate(now=clk.advance(10.0)) == []    # held clean
+    assert fired == [("probe", "page")]                 # one episode
+    assert eng.pressure_hint() == 0.0
+    assert firing_gauge()[(("rule", "probe"), ("severity", "page"),
+                           ("source", "t"))] == 0
+    trans = eng.transitions()
+    assert [(t["from"], t["to"]) for t in trans] == [
+        ("ok", "pending"), ("pending", "firing"), ("firing", "ok")]
+    assert all(t["rule"] == "probe" and t["severity"] == "page"
+               and t["labels"] == {"team": "serving"} for t in trans)
+    assert eng.transitions(limit=1)[0]["to"] == "ok"
+    assert eng.transitions(limit=0) == []
+    # a broken expr evaluates as not-violating, never raises or pages
+    eng.add_rule(obs.AlertRule("broken", lambda ctx: 1 / 0,
+                               severity="page"))
+    assert eng.evaluate(now=clk.advance(1.0)) == []
+    with pytest.raises(ValueError):
+        eng.add_rule(obs.AlertRule("probe", lambda ctx: None))
+    eng.unregister()
+    snap = reg.snapshot()
+    for fam in ("server_alerts_firing", "server_alert_transitions_total",
+                "server_health_score"):
+        assert snap.get(fam, {}).get("series") == [], fam
+
+
+def test_slo_burn_storm_fires_one_flight_record_and_clears(
+        tmp_path, monkeypatch):
+    """Acceptance: an induced SLO-miss storm under a fake clock fires
+    the multi-window burn-rate rules within their windows, emits
+    exactly ONE watchdog flight record for the episode, surfaces at
+    /alertz and /statusz, and clears with the hold-down once the storm
+    stops. close() tears the whole plane down."""
+    import urllib.request
+    from paddle_tpu.observability import watchdog as wd_mod
+    reg = obs.MetricsRegistry()
+    clk = _FakeClock()
+    wd = obs.Watchdog(stall_threshold=30.0, base_dir=str(tmp_path),
+                      registry=reg)
+    monkeypatch.setattr(wd_mod, "_WATCHDOG", wd)   # installed, no thread
+    fh = obs.FleetHealth(config=obs.HealthConfig(interval_s=15.0),
+                         registry=reg, clock=clk, label="t")
+    met = reg.counter("server_slo_met_total", "h").labels(router="0")
+    missed = reg.counter("server_slo_missed_total",
+                         "h").labels(router="0")
+    server = obs.DebugServer(port=0)
+
+    def get(path):
+        with urllib.request.urlopen(f"{server.url}{path}",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        fh.start()
+        assert fh.sampler.running
+        assert "pt-health-sampler" in {t.name
+                                       for t in threading.enumerate()}
+        # 90%-miss storm, one tick per 15 s of fake time: the page tier
+        # (14.4x budget over 1h AND 5m) must fire within its short
+        # window once both windows carry >= 2 points
+        firing = []
+        for tick in range(40):                     # 10 min of storm
+            met.inc(1)
+            missed.inc(9)
+            firing = fh.tick(now=clk.advance(15.0))
+            if "slo_burn_rate_page" in firing:
+                break
+        assert "slo_burn_rate_page" in firing
+        assert tick * 15.0 <= 300.0                # within the 5m window
+        assert fh.pressure_hint() == 1.0
+        assert fh.health()["status"] == "page"
+        # the transition value is the short-window burn rate: 90% miss
+        # against a 1% budget reads ~90x
+        page_fire = [t for t in fh.engine.transitions()
+                     if t["rule"] == "slo_burn_rate_page"
+                     and t["to"] == "firing"]
+        assert len(page_fire) == 1
+        assert page_fire[0]["value"] == pytest.approx(90.0, rel=0.05)
+        # keep storming: the episode stays ONE episode
+        for _ in range(10):
+            met.inc(1)
+            missed.inc(9)
+            fh.tick(now=clk.advance(15.0))
+        assert wd.check() is not None              # drains the pending dump
+        assert len(wd.recorder.records()) == 1     # exactly one record
+        meta = json.loads(open(os.path.join(
+            wd.recorder.records()[0], "meta.json")).read())
+        assert meta["reason"] == "alert"
+        assert meta["details"]["rule"].startswith("slo_burn_rate")
+        assert wd.check() is None                  # nothing else queued
+        assert len(wd.recorder.records()) == 1
+        # the plane surfaces over HTTP while firing
+        alertz = get("/alertz")
+        assert alertz["enabled"] is True
+        assert "slo_burn_rate_page" in alertz["firing"]
+        src = alertz["sources"]["t"]
+        assert src["label"] == "t" and src["transitions"]
+        assert src["store"]["series"] > 0
+        assert get("/alertz?source=nope")["sources"] == {}
+        statusz = get("/statusz")
+        assert statusz["enabled"] is True
+        assert statusz["status"] == "page"
+        assert statusz["health_score"] <= 60.0
+        assert "slo_burn_rate_page" in statusz["firing"]
+        assert statusz["sources"]["t"]["status"] == "page"
+        assert statusz["process"]["pid"] == os.getpid()
+        # storm ends: the page tier needs clear_for_s=300s of clean
+        # short-window burn before resolving — count the clean time
+        clean_ticks = 0
+        while clean_ticks < 200:
+            met.inc(10)
+            firing = fh.tick(now=clk.advance(15.0))
+            clean_ticks += 1
+            if "slo_burn_rate_page" not in firing:
+                break
+        assert clean_ticks < 200
+        assert clean_ticks * 15.0 >= 300.0         # hold-down respected
+        assert "slo_burn_rate_page" not in firing
+        # the health-plane stat series advanced under the storm
+        snap = reg.snapshot()
+        pts = snap["timeseries_points_total"]["series"]
+        assert pts and pts[0]["value"] > 0
+    finally:
+        server.stop()
+        fh.close()
+        wd_mod.stop_watchdog()
+    # close(): sampler joined, endpoints dormant, every series retired
+    assert not fh.sampler.running
+    fh.close()                                     # idempotent
+    snap = reg.snapshot()
+    for name, fam in snap.items():
+        if name.startswith(("server_alerts", "server_alert",
+                            "server_health", "timeseries_")):
+            assert fam["series"] == [], name
+    with pytest.raises(RuntimeError):
+        fh.start()
+
+
+def test_alertz_statusz_endpoints_dormant_and_close_deregistered():
+    """/alertz and /statusz report enabled=false with empty rollups
+    when no FleetHealth source is registered, and a started plane
+    deregisters on close() (the /tickz close-discipline, satellite
+    sweep)."""
+    import urllib.request
+    server = obs.DebugServer(port=0)
+
+    def get(path):
+        with urllib.request.urlopen(f"{server.url}{path}",
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        alertz = get("/alertz")
+        assert alertz["enabled"] is False
+        assert alertz["firing"] == [] and alertz["sources"] == {}
+        statusz = get("/statusz")
+        assert statusz["enabled"] is False
+        assert statusz["status"] == "ok"
+        assert statusz["health_score"] == 100.0
+        assert statusz["transitions"] == []
+        # /statusz doubles as a registry dump check_metrics can lint
+        assert isinstance(statusz["metrics"], dict)
+        reg = obs.MetricsRegistry()
+        fh = obs.FleetHealth(config=obs.HealthConfig(interval_s=3600.0),
+                             registry=reg, label="zz")
+        fh.start()
+        assert get("/alertz")["enabled"] is True
+        assert "zz" in get("/alertz")["sources"]
+        assert get("/statusz")["sources"]["zz"]["status"] == "ok"
+        fh.close()
+        assert get("/alertz")["enabled"] is False
+        assert not fh.sampler.running
+    finally:
+        server.stop()
+
+
+def test_builtin_anomaly_rules_fire_on_their_signals():
+    """The non-SLO built-ins each fire on their induced signal:
+    throughput collapse (active slots, zero token flow), queue growth,
+    compile storm, prefix-hit-ratio drop."""
+    reg = obs.MetricsRegistry()
+    clk = _FakeClock()
+    fh = obs.FleetHealth(config=obs.HealthConfig(interval_s=15.0),
+                         registry=reg, clock=clk, label="t")
+    active = reg.gauge("serving_active_slots", "h").labels(engine="e")
+    queue = reg.gauge("serving_queue_depth", "h").labels(engine="e")
+    compiles = reg.counter("serving_compiles_total",
+                           "h").labels(engine="e")
+    hits = reg.counter("serving_prefix_cache_hits_total",
+                       "h").labels(engine="e")
+    misses = reg.counter("serving_prefix_cache_misses_total",
+                         "h").labels(engine="e")
+    tokens = reg.counter("serving_tokens_out_total",
+                         "h").labels(engine="e")
+    tokens.inc(0)
+    active.set(4)                      # slots busy, no tokens flowing
+    depth = 0
+    firing = []
+    for _ in range(40):
+        depth += 3
+        queue.set(depth)               # monotone queue growth
+        compiles.inc(10)               # ~0.67/s >> 0.1/s ceiling
+        hits.inc(1)
+        misses.inc(9)                  # 10% hit ratio < 50% floor
+        firing = fh.tick(now=clk.advance(15.0))
+        if len(firing) >= 4:
+            break
+    assert set(firing) >= {"throughput_collapse", "queue_growth",
+                           "compile_storm", "prefix_hit_ratio_drop"}
+    assert fh.health()["status"] == "page"     # collapse is page-tier
+    fh.close()
 
 
 if __name__ == "__main__":

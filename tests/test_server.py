@@ -1621,3 +1621,72 @@ def test_chaos_soak_with_migrations_every_request_terminal(trained):
                 assert r.engine.swapped_count == 0
     finally:
         router.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet health & alerting plane on the service surface
+# ---------------------------------------------------------------------------
+
+def test_alertz_statusz_service_plane(trained):
+    """/alertz and /statusz on the deployable server: dormant (enabled
+    false) without ServerConfig(health=), live with the built-in rule
+    set when configured, the ring-endpoint ?limit= 400 contract, and
+    shutdown retiring every health-plane series and the sampler
+    thread."""
+    from paddle_tpu.observability import HealthConfig
+    srv = make_server(trained)
+    try:
+        _, body = _get_json(srv.port, "/alertz", expect=200)
+        assert body == {"enabled": False, "firing": [],
+                        "transitions": []}
+        _, body = _get_json(srv.port, "/statusz", expect=200)
+        assert body["enabled"] is False and body["status"] == "ok"
+        assert body["health_score"] == 100.0
+        assert "pt-health-sampler" not in {
+            t.name for t in threading.enumerate()}
+    finally:
+        srv.shutdown()
+
+    srv = make_server(trained, n=2, server_kw=dict(
+        health=HealthConfig(interval_s=3600.0)))
+    try:
+        assert any(t.name == "pt-health-sampler"
+                   for t in threading.enumerate())
+        status, _, tokens, _ = sse_generate(
+            srv.port, {"prompt": [1, 2, 3], "max_new_tokens": 3})
+        assert status == 200 and len(tokens) == 3
+        _, body = _get_json(srv.port, "/alertz", expect=200)
+        assert body["enabled"] is True
+        rules = {r["rule"] for r in body["rules"]}
+        assert {"slo_burn_rate_page", "slo_burn_rate_warn",
+                "throughput_collapse", "queue_growth", "compile_storm",
+                "prefix_hit_ratio_drop"} <= rules
+        assert body["firing"] == []                 # healthy fleet
+        assert body["health"]["status"] == "ok"
+        _, body = _get_json(srv.port, "/statusz", expect=200)
+        assert body["enabled"] is True and body["status"] == "ok"
+        assert body["health_score"] == 100.0
+        assert body["router"] == srv.router.metrics.label
+        assert len(body["replicas"]) == 2
+        assert all(r["state"] == "ok" for r in body["replicas"])
+        # the ring-endpoint ?limit= contract (debug-server discipline)
+        for ep in ("/alertz", "/statusz"):
+            for bad in ("-1", "x", "1.5"):
+                status, body = _get_json(srv.port,
+                                         f"{ep}?limit={bad}")
+                assert status == 400, (ep, bad)
+                assert "limit" in body["error"], (ep, bad)
+            for good in ("0", "5"):
+                _get_json(srv.port, f"{ep}?limit={good}", expect=200)
+        # the health gauges ride the shared registry while serving
+        assert _registry_value("server_health_score",
+                               source=srv.router.metrics.label) == 100.0
+    finally:
+        srv.shutdown()
+    assert "pt-health-sampler" not in {
+        t.name for t in threading.enumerate()}
+    snap = pt.observability.get_registry().snapshot()
+    for name, fam in snap.items():
+        if name.startswith(("server_alerts", "server_alert",
+                            "server_health", "timeseries_")):
+            assert fam["series"] == [], name

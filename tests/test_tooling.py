@@ -1139,3 +1139,134 @@ def test_bench_serving_adapters_row_shape():
         == by_pop[3]["adapter_pool_bytes"]
     # isolation was really asserted on the co-batched row
     assert by_pop[3]["streams_isolated"] is True
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate (tools/bench_gate.py) + bench_serving --json
+# ---------------------------------------------------------------------------
+
+def _gate_artifact(tmp_path, name, rows):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_bench_gate_pass_and_regression_paths(tmp_path, capsys):
+    """tools/bench_gate compares bench artifacts: exit 0 when every
+    gated metric is within threshold, 1 on a regression (direction
+    inferred from the metric name: throughput regresses down, latency
+    up), explicit --metric thresholds override, and multiple baselines
+    average."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_gate
+    base = _gate_artifact(tmp_path, "base.json", [
+        {"metric": "tiny_serving_c4_k8", "value": 100.0,
+         "unit": "tokens/s"},
+        {"metric": "mean_ttft_ms", "value": 50.0}])
+    good = _gate_artifact(tmp_path, "good.json", [
+        {"metric": "tiny_serving_c4_k8", "value": 97.0},
+        {"metric": "mean_ttft_ms", "value": 52.0}])
+    bad = _gate_artifact(tmp_path, "bad.json", [
+        {"metric": "tiny_serving_c4_k8", "value": 70.0},
+        {"metric": "mean_ttft_ms", "value": 49.0}])
+
+    assert bench_gate.main([base, good]) == 0
+    out = capsys.readouterr().out
+    assert "within threshold" in out
+
+    # 30% throughput drop breaches the default -10% gate; the ttft
+    # IMPROVEMENT is not flagged (direction heuristic)
+    assert bench_gate.main([base, bad]) == 1
+    cap = capsys.readouterr()
+    assert "REGRESSION" in cap.out and "tiny_serving_c4_k8" in cap.out
+    assert cap.out.count("REGRESSION") == 1
+    assert "1 regression(s)" in cap.err
+
+    # explicit threshold: a 3% drop breaches -1%
+    assert bench_gate.main(
+        [base, good, "--metric", "tiny_serving_c4_k8:-1%"]) == 1
+    capsys.readouterr()
+    # a named metric absent from the artifacts is itself a finding
+    assert bench_gate.main([base, good, "--metric", "nope"]) == 1
+    assert "nope: - -> - [-10%] missing" in capsys.readouterr().out
+    # multiple baselines average: mean(100, 70) = 85 vs 97 passes
+    assert bench_gate.main([base, bad, good]) == 0
+    capsys.readouterr()
+    # disjoint metric sets never pass by vacuity
+    other = _gate_artifact(tmp_path, "other.json",
+                           [{"metric": "zzz", "value": 1.0}])
+    assert bench_gate.main([base, other]) == 1
+    assert "no shared metrics" in capsys.readouterr().err
+
+
+def test_bench_gate_wrapper_shape_and_exit_2(tmp_path):
+    """The BENCH_* runner wrapper compares by exit code (run_rc), and
+    unreadable/one-artifact inputs exit 2 with a remediation hint, no
+    traceback (the summary_io convention) — pinned over the wire like
+    the other summary CLIs."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    gate = os.path.join(REPO, "tools/bench_gate.py")
+    ok_run = tmp_path / "BENCH_r01.json"
+    ok_run.write_text(json.dumps(
+        {"n": 1, "cmd": ["pytest"], "rc": 0, "tail": "all passed"},
+        indent=2))
+    bad_run = tmp_path / "BENCH_r02.json"
+    bad_run.write_text(json.dumps(
+        {"n": 2, "cmd": ["pytest"], "rc": 1, "tail": "1 failed"},
+        indent=2))
+    r = subprocess.run([sys.executable, gate, str(ok_run),
+                        str(ok_run)], capture_output=True, text=True,
+                       timeout=120, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "run_rc" in r.stdout
+    r = subprocess.run([sys.executable, gate, str(ok_run),
+                        str(bad_run)], capture_output=True, text=True,
+                       timeout=120, env=env)
+    assert r.returncode == 1
+    assert "run_rc" in r.stdout and "REGRESSION" in r.stdout
+    # unreadable candidate: exit 2 + hint
+    r = subprocess.run([sys.executable, gate, str(ok_run),
+                        str(tmp_path / "nope.json")],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2
+    assert "cannot read" in r.stderr and "Traceback" not in r.stderr
+    # a single artifact cannot gate anything
+    r = subprocess.run([sys.executable, gate, str(ok_run)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "at least two" in r.stderr
+    # malformed threshold spec
+    r = subprocess.run([sys.executable, gate, str(ok_run),
+                        str(bad_run), "--metric", "run_rc:5%"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2 and "bad threshold" in r.stderr
+
+
+def test_bench_serving_json_artifact_feeds_bench_gate(
+        tmp_path, capsys, monkeypatch):
+    """--json OUT writes the stdout rows as a JSONL artifact whose
+    shape bench_gate loads directly — the perf-CI loop (bench twice,
+    gate the second run against the first) closes in-process."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_gate
+    import bench_serving
+    gpt_kwargs, _, prompt_lens, buckets = bench_serving.MODELS["tiny"]
+    monkeypatch.setitem(bench_serving.MODELS, "tiny",
+                        (gpt_kwargs, [1], prompt_lens, buckets))
+    monkeypatch.setenv("BENCH_SERVING_REQUESTS", "2")
+    out = tmp_path / "PERF_run.json"
+    bench_serving.main(["tiny", "--decode-chunk", "8",
+                        "--json", str(out)])
+    cap = capsys.readouterr()
+    assert f"wrote 1 row(s) to {out}" in cap.err
+    stdout_rows = [json.loads(ln)
+                   for ln in cap.out.strip().splitlines()]
+    artifact_rows = [json.loads(ln)
+                     for ln in out.read_text().strip().splitlines()]
+    assert artifact_rows == stdout_rows          # stdout-identical
+    assert artifact_rows[0]["unit"] == "tokens/s"
+    # the artifact gates against itself clean (zero drift)
+    assert bench_gate.main([str(out), str(out)]) == 0
+    assert "within threshold" in capsys.readouterr().out

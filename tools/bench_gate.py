@@ -1,0 +1,218 @@
+"""Bench regression gate: compare bench artifacts across runs.
+
+The repo's bench trajectory writes per-run artifacts (`PERF_*.json`,
+`MIXED_*.json`, `QUANT_*.json`, `ADAPTER_*.json`, `BENCH_*.json`,
+`tools/bench_serving.py --json OUT`) but nothing reads them ACROSS
+runs — a throughput regression is invisible until someone eyeballs two
+files. This tool is the missing perf-CI gate:
+
+    python tools/bench_gate.py BASELINE... CANDIDATE
+
+Two or more artifacts: every file but the last is baseline (multiple
+baselines average per metric — smoothing run-to-run jitter), the last
+is the candidate. Each artifact is either JSONL rows of
+``{"metric": name, "value": number, ...}`` (the bench_serving row
+shape every PERF_/MIXED_/QUANT_/ADAPTER_ file uses) or one JSON
+object (a ``{"metric", "value"}`` row, a list of rows, or the
+BENCH_* runner wrapper ``{"n", "cmd", "rc", "tail"}`` — compared by
+its exit code as ``run_rc``).
+
+Thresholds:
+
+* ``--metric NAME[:±PCT%]`` (repeatable) gates only the named metrics.
+  The signed threshold gives the regression direction: ``tps:-5%``
+  fails when the candidate drops more than 5% BELOW baseline (bigger
+  is better); ``ttft_ms:+10%`` fails when it rises more than 10%
+  ABOVE (smaller is better). Omitting the threshold uses the default
+  magnitude with the direction heuristic below. A named metric absent
+  from either side is itself a regression finding.
+* Without ``--metric`` every metric present on BOTH sides is gated at
+  ``--default-threshold`` (default 10%), direction-inferred from the
+  name/unit: time-like metrics (``*_ms``/``*_s``/``*_seconds``,
+  ttft/tpot/latency/rc) regress UP, everything else (throughput-like)
+  regresses DOWN.
+
+Exit status: 0 all gated metrics within threshold, 1 at least one
+regression (one line per finding), 2 unreadable/empty input with a
+remediation hint (the summary_io convention).
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+
+from summary_io import SummaryInputError, read_input, report_error  # noqa: E402
+
+EMPTY_HINT = ("no bench artifact was written there. Produce one with "
+              "tools/bench_serving.py --json OUT (or point at a "
+              "PERF_*/MIXED_*/QUANT_*/ADAPTER_*.json from a prior "
+              "run) and re-run.")
+
+# metrics that regress UPWARD (latency/cost); everything else is
+# throughput-like and regresses downward
+_HIGHER_IS_WORSE = re.compile(
+    r"(_ms|_s|_seconds|_rc|_pct|_bytes)$|ttft|tpot|latency|overhead",
+    re.IGNORECASE)
+
+_THRESHOLD_RE = re.compile(r"^([+-])(\d+(?:\.\d+)?)%?$")
+
+
+def parse_threshold(spec, name=""):
+    """'-5%' / '+10%' -> (direction, magnitude-pct). Direction '-'
+    fails on drops below baseline, '+' on rises above."""
+    m = _THRESHOLD_RE.match(spec.strip())
+    if not m:
+        raise SummaryInputError(
+            f"bad threshold {spec!r}{' for ' + name if name else ''}: "
+            "expected ±PCT% (e.g. -5% fails a >5% drop, +10% fails a "
+            ">10% rise)")
+    return m.group(1), float(m.group(2))
+
+
+def load_rows(path):
+    """{metric: mean value} for one artifact (duplicate metric rows —
+    repeated runs appended to one file — average)."""
+    raw = read_input(path, EMPTY_HINT)
+    rows = []
+    try:
+        payload = json.loads(raw)
+        if isinstance(payload, list):
+            rows = [r for r in payload if isinstance(r, dict)]
+        elif isinstance(payload, dict):
+            if "metric" in payload:
+                rows = [payload]
+            elif "rc" in payload and "cmd" in payload:
+                # the BENCH_* runner wrapper: the comparable signal is
+                # whether the run passed
+                rows = [{"metric": "run_rc", "value": payload["rc"]}]
+    except json.JSONDecodeError:
+        for lineno, line in enumerate(raw.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SummaryInputError(
+                    f"{path!r} is neither JSON nor JSONL "
+                    f"(line {lineno}: {e.msg})")
+            if isinstance(rec, dict):
+                rows.append(rec)
+    acc = {}
+    for row in rows:
+        name, value = row.get("metric"), row.get("value")
+        if not isinstance(name, str) \
+                or not isinstance(value, (int, float)) \
+                or isinstance(value, bool):
+            continue
+        acc.setdefault(name, []).append(float(value))
+    if not acc:
+        raise SummaryInputError(
+            f"{path!r} has no comparable metric rows (expected "
+            '{"metric": name, "value": number} rows, a row list, or '
+            "a BENCH_* runner wrapper)")
+    return {name: sum(vs) / len(vs) for name, vs in acc.items()}
+
+
+def default_direction(name):
+    return "+" if _HIGHER_IS_WORSE.search(name) else "-"
+
+
+def compare(baselines, candidate, gates, default_pct):
+    """Findings + report rows. `gates` is {metric: (dir, pct) or None}
+    (None = heuristic direction at default_pct); empty gates = every
+    metric on both sides."""
+    base = {}
+    for rows in baselines:
+        for name, value in rows.items():
+            base.setdefault(name, []).append(value)
+    base = {name: sum(vs) / len(vs) for name, vs in base.items()}
+    if gates:
+        names = sorted(gates)
+    else:
+        names = sorted(set(base) & set(candidate))
+    findings, report = [], []
+    for name in names:
+        spec = gates.get(name) if gates else None
+        direction, pct = spec if spec else (default_direction(name),
+                                            default_pct)
+        b, c = base.get(name), candidate.get(name)
+        if b is None or c is None:
+            side = "baseline" if b is None else "candidate"
+            findings.append(f"{name}: missing from {side}")
+            report.append((name, b, c, None, direction, pct, "missing"))
+            continue
+        if b == 0:
+            change = 0.0 if c == 0 else float("inf") * (1 if c > 0
+                                                        else -1)
+        else:
+            change = (c - b) / abs(b) * 100.0
+        bad = (change < -pct) if direction == "-" else (change > pct)
+        verdict = "REGRESSION" if bad else "ok"
+        if bad:
+            findings.append(
+                f"{name}: {b:g} -> {c:g} ({change:+.2f}%) breaches "
+                f"{direction}{pct:g}%")
+        report.append((name, b, c, change, direction, pct, verdict))
+    return findings, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="compare bench artifacts; non-zero on regression")
+    ap.add_argument("artifacts", nargs="+",
+                    help="2+ artifact paths: baselines..., candidate")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="NAME[:±PCT%]",
+                    help="gate only this metric (repeatable); the "
+                         "signed threshold sets the regression "
+                         "direction (-5% = fail a >5%% drop)")
+    ap.add_argument("--default-threshold", type=float, default=10.0,
+                    metavar="PCT",
+                    help="threshold magnitude when a metric has no "
+                         "explicit one (default %(default)s%%)")
+    args = ap.parse_args(argv)
+    try:
+        if len(args.artifacts) < 2:
+            raise SummaryInputError(
+                "need at least two artifacts (baseline... candidate); "
+                "got one. " + EMPTY_HINT.split(". ", 1)[0] + ".")
+        gates = {}
+        for spec in args.metric:
+            name, sep, thr = spec.partition(":")
+            if not name:
+                raise SummaryInputError(
+                    f"bad --metric {spec!r}: empty metric name")
+            gates[name] = parse_threshold(thr, name) if sep else None
+        loaded = [load_rows(p) for p in args.artifacts]
+    except SummaryInputError as e:
+        return report_error("bench_gate", e)
+    findings, report = compare(loaded[:-1], loaded[-1], gates,
+                               args.default_threshold)
+    print(f"bench_gate: {len(args.artifacts) - 1} baseline(s) vs "
+          f"{args.artifacts[-1]}")
+    for name, b, c, change, direction, pct, verdict in report:
+        b_s = "-" if b is None else f"{b:g}"
+        c_s = "-" if c is None else f"{c:g}"
+        ch = "" if change is None else f" {change:+.2f}%"
+        print(f"  {name}: {b_s} -> {c_s}{ch} "
+              f"[{direction}{pct:g}%] {verdict}")
+    if findings:
+        print(f"bench_gate: {len(findings)} regression(s) across "
+              f"{len(report)} gated metric(s)", file=sys.stderr)
+        return 1
+    if not report:
+        # nothing to gate is a pass-by-vacuity trap: say so loudly
+        print("bench_gate: no shared metrics to gate (artifacts have "
+              "disjoint metric sets)", file=sys.stderr)
+        return 1
+    print(f"bench_gate: {len(report)} metric(s) within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1807,6 +1807,10 @@ def main(argv=None):
                          "wire: one <model>_serving_http_c<cc> row per "
                          "concurrency with client-measured end-to-end "
                          "TTFT/TPOT next to the library-path rows")
+    ap.add_argument("--json", metavar="OUT", default=None,
+                    help="also write every result row as JSONL to OUT "
+                         "(the machine-readable artifact "
+                         "tools/bench_gate.py compares across runs)")
     args = ap.parse_args(argv)
     unknown = [m for m in args.models if m not in MODELS]
     if unknown:
@@ -1864,6 +1868,7 @@ def main(argv=None):
         port = start_debug_server(port=args.debug_port)
         server_started = True
         print(f"debug server: http://127.0.0.1:{port}", file=sys.stderr)
+    all_rows = []
     try:
         for name in args.models or list(MODELS):
             if args.mesh is not None:
@@ -1893,9 +1898,19 @@ def main(argv=None):
                         name, decode_chunk=max(args.decode_chunk))
             for row in rows:
                 print(json.dumps(row), flush=True)
+            all_rows.extend(rows)
     finally:
         if server_started:
             stop_debug_server()
+    if args.json is not None:
+        # stdout-identical rows, one artifact per invocation — written
+        # AFTER the loop so a crashed run leaves no half-artifact for
+        # bench_gate to mistake for a clean (slower) baseline
+        with open(args.json, "w") as f:
+            for row in all_rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"wrote {len(all_rows)} row(s) to {args.json}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -15,7 +15,12 @@ the convention fails CI, not a dashboard):
   occupancy gauges and count-distribution histograms, listed in
   ``ALLOWED_DIMENSIONLESS``: additions are deliberate, one line of
   diff each);
-* every family must carry non-empty help text.
+* every family must carry non-empty help text;
+* every **histogram** family must document its bucket layout in that
+  help text (the word "bucket" plus the grid/range) — the PR 9
+  per-series ``labels(_buckets=)`` override means the layout is no
+  longer guessable from the family name, and a reader of /metricz
+  should not have to find the registration site.
 
 Usage:
   python tools/check_metrics.py SNAPSHOT.json
@@ -70,6 +75,10 @@ ALLOWED_DIMENSIONLESS = frozenset({
     "executor_cache_size", "executor_inflight_runs",
     # training scalars whose unit is the model's own loss/grad scale
     "train_loss", "train_grad_norm", "train_learning_rate",
+    # fleet health & alerting plane: a firing flag, a [0, 100] score,
+    # and a ring-occupancy gauge — all dimensionless by construction
+    "server_alerts_firing", "server_health_score",
+    "timeseries_tracked_series",
 })
 
 
@@ -89,10 +98,16 @@ def lint_families(families):
                 f"{name}: no unit suffix "
                 f"({'/'.join(UNIT_SUFFIXES)}) and not in "
                 "ALLOWED_DIMENSIONLESS")
-        if not (fam.get("help") or "").strip():
+        help_text = (fam.get("help") or "").strip()
+        if not help_text:
             problems.append(
                 f"{name}: help text is required (/metricz emits no "
                 "# HELP line without it)")
+        elif kind == "histogram" and "bucket" not in help_text.lower():
+            problems.append(
+                f"{name}: histogram help must document its bucket "
+                "layout (per-series _buckets overrides make it "
+                "unguessable from the name)")
     return problems
 
 
